@@ -1,0 +1,31 @@
+"""ByzantineMomentum-TPU — a TPU-native (JAX/XLA/pjit/Pallas) framework for
+Byzantine-resilient distributed SGD experiments.
+
+Re-designed from scratch after the capabilities of LPD-EPFL/ByzantineMomentum
+("Distributed Momentum for Byzantine-resilient Stochastic Gradient Descent",
+El-Mhamdi, Guerraoui, Rouault — ICLR 2021; reference `README.md:1-8`).
+
+This is NOT a port: where the reference simulates n workers by n sequential
+PyTorch backprops on one model (reference `attack.py:786-795`), this framework
+computes the whole `(n, d)` gradient matrix in one `jax.vmap`'d XLA program;
+where the reference's aggregation rules operate on Python lists of flat
+tensors, ours are pure jnp kernels over the stacked `(n, d)` matrix that XLA
+fuses and tiles onto the MXU; and the per-step training loop — momentum
+placements, attack, defense, model update and the 25-column metric pipeline —
+is a single jit-compiled function.
+
+Subpackages:
+  ops       Gradient aggregation rules (GARs) — the algorithmic kernels.
+  attacks   Byzantine gradient synthesis (adaptive line-searched attacks).
+  models    Pure-pytree neural networks (init/apply pairs).
+  data      Device-staged datasets with in-graph batch sampling.
+  train     The jitted training step, metrics, checkpointing, host loop.
+  parallel  Mesh construction, sharded training step, distributed GARs.
+  utils     Registries, logging, key:value mini-language, job scheduler.
+"""
+
+__version__ = "0.1.0"
+
+from byzantinemomentum_tpu import utils  # noqa: F401
+from byzantinemomentum_tpu import ops  # noqa: F401
+from byzantinemomentum_tpu import attacks  # noqa: F401

@@ -1,0 +1,77 @@
+"""Byzantine gradient synthesis — the attack registry.
+
+TPU-native contract (redesign of reference `attacks/__init__.py:15-35`):
+an attack is a pure function
+
+    attack(grad_honests: f32[h, d], f_decl: int, f_real: int,
+           defense: callable, **kwargs) -> f32[f_real, d]
+
+where `defense(gradients=f32[n,d], f=int) -> f32[d]` is the live aggregation
+rule (adaptive attacks line-search against it *inside* the same XLA program,
+see `ops/linesearch.py`). The reference returns `f_real` references to one
+tensor; here the result is a stacked (f_real, d) matrix — identical
+semantics once concatenated with the honest rows.
+
+Registry parity: `attacks: name -> Attack`, each with `.checked` /
+`.unchecked` / `.check` members (reference `attacks/__init__.py:46-87`).
+"""
+
+import pathlib
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.ops import as_matrix
+
+__all__ = ["attacks", "register", "Attack"]
+
+# Registry: name -> Attack
+attacks = {}
+
+
+class Attack:
+    """A registered attack; calling it runs the checked path."""
+
+    def __init__(self, name, unchecked, check):
+        self.name = name
+        self.unchecked = unchecked
+        self.check = check
+
+    def checked(self, grad_honests, f_decl, f_real, defense=None, **kwargs):
+        grad_honests = as_matrix(grad_honests)
+        message = self.check(
+            grad_honests=grad_honests, f_decl=f_decl, f_real=f_real, defense=defense, **kwargs)
+        if message is not None:
+            raise utils.UserException(f"Attack {self.name!r} cannot be used: {message}")
+        result = self.unchecked(
+            grad_honests, f_decl=f_decl, f_real=f_real, defense=defense, **kwargs)
+        expected = (f_real, grad_honests.shape[1])
+        if result.shape != expected:
+            raise utils.UserException(
+                f"Attack {self.name!r} returned shape {result.shape}, expected {expected}")
+        return result
+
+    def __call__(self, grad_honests, f_decl, f_real, defense=None, **kwargs):
+        return self.checked(grad_honests, f_decl, f_real, defense=defense, **kwargs)
+
+    def __repr__(self):
+        return f"Attack({self.name!r})"
+
+
+def register(name, unchecked, check):
+    """Register an attack under `name` (reference `attacks/__init__.py:46-77`)."""
+    if name in attacks:
+        utils.warning(f"Attack {name!r} registered twice; keeping the last")
+    atk = Attack(name, unchecked, check)
+    attacks[name] = atk
+    return atk
+
+
+def empty_byzantine(grad_honests):
+    """The (0, d) result for f_real == 0 (reference returns an empty list)."""
+    return jnp.zeros((0, grad_honests.shape[1]), dtype=grad_honests.dtype)
+
+
+# Self-registering attack modules (plugin pattern, reference
+# `attacks/__init__.py:81-87`)
+utils.import_directory(__name__, pathlib.Path(__file__).parent)

@@ -1,0 +1,46 @@
+"""CGE-targeted attack (reference `attacks/anticge.py`).
+
+Exploits CGE's norm-sort: when f_real <= f_decl, submit the negated sum of
+the would-be-selected honest gradients, scaled to sit just under the
+(n - f_decl)-th smallest honest norm so every Byzantine gradient is
+selected (reference `anticge.py:49-78`); when f_real > f_decl, a Byzantine
+gradient is necessarily selected, so send NaN (reference `anticge.py:59-63`).
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+from byzantinemomentum_tpu.ops._common import sanitize_inf
+
+__all__ = ["attack"]
+
+
+def attack(grad_honests, f_decl, f_real, **kwargs):
+    """Generate the f_real Byzantine gradients (reference `anticge.py:49-78`)."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests)
+    d = grad_honests.shape[1]
+    if f_real > f_decl:
+        return jnp.full((f_real, d), jnp.nan, dtype=grad_honests.dtype)
+    h = grad_honests.shape[0]
+    norms = sanitize_inf(jnp.sqrt(jnp.sum(grad_honests * grad_honests, axis=1)))
+    order = jnp.argsort(norms, stable=True)
+    maxpos = h - f_decl
+    # Strictly below the (maxpos)-th smallest norm (reference uses
+    # math.nextafter toward 0, `anticge.py:66-69`).
+    maxnorm = jnp.nextafter(norms[order[maxpos]], jnp.float32(0))
+    vec = jnp.sum(grad_honests[order[:maxpos]], axis=0)
+    attnorm = jnp.sqrt(jnp.sum(vec * vec))
+    scale = jnp.where(attnorm > 0, -maxnorm / attnorm, 1.0)
+    byz_grad = vec * scale
+    return jnp.tile(byz_grad[None, :], (f_real, 1))
+
+
+def check(grad_honests, f_real, f_decl, **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return f"Expected a non-negative number of Byzantine gradients to generate, got {f_real!r}"
+
+
+register("anticge", attack, check)

@@ -1,0 +1,50 @@
+"""Strict "Fall of Empires" attack: byz = -epsilon * honest_average
+(reference `attacks/empire.py`; paper: Xie, Koyejo, Gupta, UAI 2019).
+
+Negative `epsilon` triggers the adaptive search over the live defense with
+`ceil(-epsilon)` evaluations (reference `empire.py:51-59`).
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+from byzantinemomentum_tpu.ops.linesearch import line_maximize
+
+__all__ = ["attack"]
+
+
+def attack(grad_honests, f_decl, f_real, defense, epsilon=1, **kwargs):
+    """Generate the f_real Byzantine gradients (reference `empire.py:29-64`)."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests)
+    grad_avg = jnp.mean(grad_honests, axis=0)
+
+    if epsilon < 0:
+        def eval_epsilon(x):
+            byz = grad_avg * (-x)
+            stacked = jnp.concatenate([grad_honests, jnp.tile(byz[None, :], (f_real, 1))])
+            aggregated = defense(gradients=stacked, f=f_decl) - grad_avg
+            return jnp.dot(aggregated, aggregated)
+
+        epsilon_eff = line_maximize(eval_epsilon, evals=math.ceil(-epsilon))
+    else:
+        epsilon_eff = epsilon
+
+    byz_grad = grad_avg * (-epsilon_eff)
+    return jnp.tile(byz_grad[None, :], (f_real, 1))
+
+
+def check(grad_honests, f_real, defense, epsilon=1, **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return f"Expected a non-negative number of Byzantine gradients to generate, got {f_real!r}"
+    if not callable(defense):
+        return f"Expected a callable for the aggregation rule, got {defense!r}"
+    if not isinstance(epsilon, int) or epsilon == 0:
+        return f"Expected a non-zero attack epsilon, got {epsilon!r}"
+
+
+register("empire-strict", attack, check)

@@ -1,0 +1,96 @@
+"""Identical-gradient attack family: `empire`, `little`, `bulyan`
+(reference `attacks/identical.py`; papers cited there: Fall of Empires,
+A Little is Enough, The Hidden Vulnerability).
+
+Each attack submits f_real copies of `avg + factor * direction`, where the
+direction is attack-specific and the factor is either fixed (positive
+`factor`) or found by line-searching the live defense's output displacement
+`||GAR(honest + byz) - avg||^2` with `ceil(-factor)` evaluations when
+`factor` is negative (reference `identical.py:66-77`).
+
+TPU design: the line search is `ops.linesearch.line_maximize` — a
+`lax.while_loop` whose body inlines the defense kernel, so the up-to-16
+defense evaluations stay inside the jitted training step.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+from byzantinemomentum_tpu.ops.linesearch import line_maximize
+
+__all__ = ["make_attack"]
+
+
+def make_attack(compute_direction):
+    """Build the attack closure for a direction function
+    `(grad_stack, grad_avg, **kwargs) -> f32[d]`
+    (reference `attacks/identical.py:38-88`)."""
+
+    def attack(grad_honests, f_decl, f_real, defense, factor=-16, negative=False, **kwargs):
+        if f_real == 0:
+            return empty_byzantine(grad_honests)
+        grad_avg = jnp.mean(grad_honests, axis=0)
+        grad_att = compute_direction(grad_honests, grad_avg, **kwargs)
+
+        if factor < 0:
+            # Adaptive factor: maximize the defense output displacement
+            # (reference `identical.py:66-77`).
+            def eval_factor(x):
+                eff = -x if negative else x
+                byz = grad_avg + eff * grad_att
+                stacked = jnp.concatenate([grad_honests, jnp.tile(byz[None, :], (f_real, 1))])
+                aggregated = defense(gradients=stacked, f=f_decl) - grad_avg
+                return jnp.dot(aggregated, aggregated)
+
+            factor_eff = line_maximize(eval_factor, evals=math.ceil(-factor))
+            factor_eff = -factor_eff if negative else factor_eff
+        else:
+            factor_eff = -factor if negative else factor
+
+        byz_grad = grad_avg + factor_eff * grad_att
+        return jnp.tile(byz_grad[None, :], (f_real, 1))
+
+    return attack
+
+
+def check(grad_honests, f_real, defense, factor=-16, negative=False, **kwargs):
+    """Parameter validity (reference `attacks/identical.py:91-108`)."""
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return f"Expected a non-negative number of Byzantine gradients to generate, got {f_real!r}"
+    if not callable(defense):
+        return f"Expected a callable for the aggregation rule, got {defense!r}"
+    if not ((isinstance(factor, float) and factor > 0) or (isinstance(factor, int) and factor != 0)):
+        return f"Expected a positive number or a negative integer for the attack factor, got {factor!r}"
+    if not isinstance(negative, bool):
+        return f"Expected a boolean for optional parameter 'negative', got {negative!r}"
+
+
+def direction_bulyan(grad_stack, grad_avg, target_idx=-1, **kwargs):
+    """Single-coordinate (or all-ones) direction, "The Hidden Vulnerability"
+    (reference `attacks/identical.py:114-127`)."""
+    if target_idx == "all":
+        return jnp.ones_like(grad_avg)
+    if not isinstance(target_idx, int):
+        raise ValueError(f'Expected an integer or "all" for target_idx, got {target_idx!r}')
+    return jnp.zeros_like(grad_avg).at[target_idx].set(1.0)
+
+
+def direction_empire(grad_stack, grad_avg, **kwargs):
+    """Negated honest average, "Fall of Empires"
+    (reference `attacks/identical.py:129-134`)."""
+    return -grad_avg
+
+
+def direction_little(grad_stack, grad_avg, **kwargs):
+    """Coordinate-wise sample standard deviation, "A Little is Enough"
+    (reference `attacks/identical.py:136-141`; torch `.var` is unbiased)."""
+    return jnp.sqrt(jnp.var(grad_stack, axis=0, ddof=1))
+
+
+for _name, _direction in (("bulyan", direction_bulyan), ("empire", direction_empire),
+                          ("little", direction_little)):
+    register(_name, make_attack(_direction), check)

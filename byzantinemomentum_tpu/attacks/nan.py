@@ -1,0 +1,28 @@
+"""All-NaN gradient attack (reference `attacks/nan.py`).
+
+Doubles as the framework's numerical fault-injection: GARs are expected to
+be NaN-resilient (reference `median.py:13`, `krum.py:46-47`, `brute.py:55-57`).
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+
+__all__ = ["attack"]
+
+
+def attack(grad_honests, f_real, **kwargs):
+    """Return f_real all-NaN gradients (reference `attacks/nan.py:24-40`)."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests)
+    return jnp.full((f_real, grad_honests.shape[1]), jnp.nan, dtype=grad_honests.dtype)
+
+
+def check(grad_honests, f_real, **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return f"Expected a non-negative number of Byzantine gradients to generate, got {f_real!r}"
+
+
+register("nan", attack, check)

@@ -1,0 +1,34 @@
+"""Extension skeleton for a new attack (parity with reference
+`attacks/template.py`).
+
+Copy this file, implement the two functions, and uncomment the registration:
+the plugin loader (`attacks/__init__.py`) imports every module in this
+directory at package load.
+"""
+
+__all__ = []
+
+
+def attack(grad_honests, f_decl, f_real, defense, **kwargs):
+    """Generate the Byzantine gradients.
+
+    Args:
+      grad_honests: f32[h, d] honest gradient matrix.
+      f_decl: static int, declared Byzantine count (what the defense tolerates).
+      f_real: static int, number of gradients to actually generate.
+      defense: live aggregation rule `(gradients=f32[n,d], f=int) -> f32[d]`.
+      **kwargs: attack-specific arguments from `--attack-args` (auto-typed).
+    Returns:
+      f32[f_real, d] Byzantine gradient matrix.
+    """
+    raise NotImplementedError
+
+
+def check(grad_honests, f_decl, f_real, defense, **kwargs):
+    """Return None if the arguments are valid, an error message otherwise."""
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+
+
+# from byzantinemomentum_tpu.attacks import register
+# register("template", attack, check)

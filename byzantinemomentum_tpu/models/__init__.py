@@ -1,0 +1,82 @@
+"""Model registry: name -> builder returning a `ModelDef`.
+
+A `ModelDef` is the TPU-native redesign of the reference's `Model` wrapper
+(reference `experiments/model.py:30-396`): instead of relinking torch
+parameters into a flat buffer, parameters live in a pytree and
+`jax.flatten_util.ravel_pytree` provides the flat `d`-dim gradient space
+on demand. Network state (BatchNorm running stats) is a separate pytree so
+the flat parameter space matches the reference's `d` (torch buffers are not
+parameters).
+
+Model names follow the reference's `<module>-<entrypoint>` convention
+(reference `experiments/model.py:40-90`): `simples-conv`, `simples-full`,
+`empire-cnn`, `wide_resnet-Wide_ResNet`, ...
+"""
+
+import dataclasses
+import pathlib
+import typing
+
+import jax
+import jax.flatten_util
+
+from byzantinemomentum_tpu import utils
+
+__all__ = ["ModelDef", "models", "register", "build", "flatten_params"]
+
+# Registry: name -> builder(**model_args) -> ModelDef
+models = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDef:
+    """A pure (init, apply) model.
+
+    init:  (key) -> (params, net_state)
+    apply: (params, net_state, x, train, rng) -> (output, new_net_state)
+    input_shape: per-example input shape (NHWC for images).
+    """
+    name: str
+    init: typing.Callable
+    apply: typing.Callable
+    input_shape: tuple
+
+    def param_count(self, key=None):
+        key = jax.random.PRNGKey(0) if key is None else key
+        params, _ = jax.eval_shape(self.init, key)
+        return sum(int(_size(leaf)) for leaf in jax.tree.leaves(params))
+
+
+def _size(leaf):
+    out = 1
+    for s in leaf.shape:
+        out *= s
+    return out
+
+
+def register(name, builder):
+    """Register a model builder under `name`."""
+    if name in models:
+        utils.warning(f"Model {name!r} registered twice; keeping the last")
+    models[name] = builder
+    return builder
+
+
+def build(name, **model_args):
+    """Instantiate a ModelDef by registry name
+    (reference `experiments/model.py:115-182`)."""
+    if name not in models:
+        utils.fatal_unavailable(models, name, what="model name")
+    return models[name](**model_args)
+
+
+def flatten_params(params):
+    """Flatten a parameter pytree into (flat f32[d], unravel fn) — the
+    TPU-native equivalent of the reference's flat-tensor relink
+    (reference `tools/pytorch.py:30-64`, `experiments/model.py:170`)."""
+    return jax.flatten_util.ravel_pytree(params)
+
+
+# Self-registering model modules (plugin pattern, reference
+# `experiments/model.py:60-90`)
+utils.import_directory(__name__, pathlib.Path(__file__).parent)

@@ -1,0 +1,246 @@
+"""Layer primitives and parameter-initialization registry for the
+pure-pytree model zoo.
+
+Design: a model is an (init, apply) pair over explicit parameter pytrees —
+no module framework — so `jax.vmap`/`jax.grad`/`pjit` compose directly and
+the flat gradient space is just `ravel_pytree(params)`. Layouts are NHWC
+(TPU-native); convolution kernels are HWIO.
+
+Initialization parity: torch's default Linear/Conv init is
+kaiming-uniform(a=sqrt(5)) for weights and U(+-1/sqrt(fan_in)) for biases —
+both reduce to U(+-1/sqrt(fan_in)) — which `default_dense_init` /
+`default_conv_init` reproduce (distributionally; RNG streams differ by
+construction). The named init registry mirrors the reference's exposure of
+`torch.nn.init.*_` (reference `experiments/model.py:92-113`), applied
+separately to multi-dim vs mono-dim parameters via `--init-multi` /
+`--init-mono`.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "dense_init", "dense_apply",
+    "conv_init", "conv_apply", "max_pool",
+    "batchnorm_init", "batchnorm_apply",
+    "dropout_apply",
+    "log_softmax",
+    "inits", "apply_named_init",
+]
+
+
+# --------------------------------------------------------------------------- #
+# Dense
+
+def dense_init(key, din, dout, dtype=jnp.float32):
+    """torch-default Linear init: W, b ~ U(+-1/sqrt(din))."""
+    kw, kb = jax.random.split(key)
+    bound = 1.0 / math.sqrt(din)
+    return {
+        "w": jax.random.uniform(kw, (din, dout), dtype, -bound, bound),
+        "b": jax.random.uniform(kb, (dout,), dtype, -bound, bound),
+    }
+
+
+def dense_apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+# --------------------------------------------------------------------------- #
+# Conv (NHWC x HWIO -> NHWC)
+
+def conv_init(key, kh, kw_, cin, cout, dtype=jnp.float32):
+    """torch-default Conv2d init: U(+-1/sqrt(cin*kh*kw))."""
+    kkey, bkey = jax.random.split(key)
+    fan_in = cin * kh * kw_
+    bound = 1.0 / math.sqrt(fan_in)
+    return {
+        "w": jax.random.uniform(kkey, (kh, kw_, cin, cout), dtype, -bound, bound),
+        "b": jax.random.uniform(bkey, (cout,), dtype, -bound, bound),
+    }
+
+
+def conv_apply(params, x, *, padding="VALID", stride=1):
+    stride = (stride, stride) if isinstance(stride, int) else stride
+    out = lax.conv_general_dilated(
+        x, params["w"], window_strides=stride, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + params["b"]
+
+
+def max_pool(x, window=2, stride=None):
+    stride = window if stride is None else stride
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID")
+
+
+# --------------------------------------------------------------------------- #
+# BatchNorm (torch semantics: batch stats in train mode, running stats in
+# eval; running update r <- (1-m) r + m s with unbiased batch variance)
+
+BN_MOMENTUM = 0.1
+BN_EPS = 1e-5
+
+
+def batchnorm_init(c, dtype=jnp.float32):
+    params = {"gamma": jnp.ones((c,), dtype), "beta": jnp.zeros((c,), dtype)}
+    state = {"mean": jnp.zeros((c,), dtype), "var": jnp.ones((c,), dtype)}
+    return params, state
+
+
+def batchnorm_apply(params, state, x, *, train):
+    """Normalize over all but the channel axis.
+
+    Returns (out, new_state); in train mode `new_state` carries the running
+    stats updated by THIS batch (the sequential-equivalent composition across
+    vmapped workers happens in the training step — see
+    `train/step.py:compose_bn_updates`).
+    """
+    axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)  # biased, used for normalization
+        count = x.size // x.shape[-1]
+        unbiased = var * (count / max(count - 1, 1))
+        new_state = {
+            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
+            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    inv = lax.rsqrt(var + BN_EPS)
+    out = (x - mean) * inv * params["gamma"] + params["beta"]
+    return out, new_state
+
+
+# --------------------------------------------------------------------------- #
+# Dropout
+
+def dropout_apply(rng, x, rate, *, train):
+    """Inverted dropout (torch semantics: scale by 1/(1-p) at train time)."""
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# --------------------------------------------------------------------------- #
+# Named init registry (`--init-multi` / `--init-mono`,
+# reference `experiments/model.py:92-113, 128-136, 157-164`)
+
+def _fans(shape):
+    if len(shape) < 2:
+        fan = shape[0] if shape else 1
+        return fan, fan
+    receptive = 1
+    for s in shape[:-2]:
+        receptive *= s
+    # HWIO kernels / (din, dout) dense matrices
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def _gain(nonlinearity, a=0.0):
+    if nonlinearity in ("sigmoid", "linear"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        return math.sqrt(2.0 / (1.0 + a * a))
+    return 1.0
+
+
+def _init_uniform(key, shape, a=0.0, b=1.0, **kw):
+    return jax.random.uniform(key, shape, jnp.float32, a, b)
+
+
+def _init_normal(key, shape, mean=0.0, std=1.0, **kw):
+    return mean + std * jax.random.normal(key, shape, jnp.float32)
+
+
+def _init_constant(key, shape, val=0.0, **kw):
+    return jnp.full(shape, val, jnp.float32)
+
+
+def _init_ones(key, shape, **kw):
+    return jnp.ones(shape, jnp.float32)
+
+
+def _init_zeros(key, shape, **kw):
+    return jnp.zeros(shape, jnp.float32)
+
+
+def _init_xavier_uniform(key, shape, gain=1.0, **kw):
+    fan_in, fan_out = _fans(shape)
+    bound = gain * math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+def _init_xavier_normal(key, shape, gain=1.0, **kw):
+    fan_in, fan_out = _fans(shape)
+    std = gain * math.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(key, shape, jnp.float32)
+
+
+def _init_kaiming_uniform(key, shape, a=0.0, mode="fan_in", nonlinearity="leaky_relu", **kw):
+    fan_in, fan_out = _fans(shape)
+    fan = fan_in if mode == "fan_in" else fan_out
+    bound = _gain(nonlinearity, a) * math.sqrt(3.0 / fan)
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+def _init_kaiming_normal(key, shape, a=0.0, mode="fan_in", nonlinearity="leaky_relu", **kw):
+    fan_in, fan_out = _fans(shape)
+    fan = fan_in if mode == "fan_in" else fan_out
+    std = _gain(nonlinearity, a) / math.sqrt(fan)
+    return std * jax.random.normal(key, shape, jnp.float32)
+
+
+def _init_orthogonal(key, shape, gain=1.0, **kw):
+    return gain * jax.nn.initializers.orthogonal()(key, shape, jnp.float32)
+
+
+inits = {
+    "uniform": _init_uniform,
+    "normal": _init_normal,
+    "constant": _init_constant,
+    "ones": _init_ones,
+    "zeros": _init_zeros,
+    "xavier_uniform": _init_xavier_uniform,
+    "xavier_normal": _init_xavier_normal,
+    "kaiming_uniform": _init_kaiming_uniform,
+    "kaiming_normal": _init_kaiming_normal,
+    "orthogonal": _init_orthogonal,
+}
+# Accept the torch in-place spellings too ("xavier_uniform_", ...)
+inits.update({k + "_": v for k, v in list(inits.items())})
+
+
+def apply_named_init(params, key, init_multi=None, init_multi_args=None,
+                     init_mono=None, init_mono_args=None):
+    """Re-initialize multi-dim params with `init_multi` and 1-dim params with
+    `init_mono` (reference `experiments/model.py:128-136, 157-164`)."""
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    out = []
+    for leaf, k in zip(leaves, keys):
+        if leaf.ndim >= 2 and init_multi is not None:
+            out.append(inits[init_multi](k, leaf.shape, **(init_multi_args or {})))
+        elif leaf.ndim < 2 and init_mono is not None:
+            out.append(inits[init_mono](k, leaf.shape, **(init_mono_args or {})))
+        else:
+            out.append(leaf)
+    return jax.tree.unflatten(treedef, out)

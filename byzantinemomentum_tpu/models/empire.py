@@ -1,0 +1,74 @@
+"""The "Fall of Empires" CIFAR CNN: `empire-cnn`
+(reference `experiments/models/empire.py:24-98`).
+
+Architecture (note the unusual conv -> relu -> BN order, kept for parity):
+  [conv3x3(3,64) relu bn] x2, maxpool2, dropout .25,
+  [conv3x3(64,128)... wait: conv3x3(64,128) relu bn, conv3x3(128,128) relu bn],
+  maxpool2, dropout .25, flatten(8192),
+  fc(8192,128) relu dropout .25 fc(128,10), log_softmax
+  (CIFAR-100 variant: fc(8192,256), fc(256,100)).
+
+BatchNorm + Dropout under vmap: each worker's forward normalizes with its
+own minibatch statistics (exactly torch train-mode behavior) and draws its
+own dropout mask from a per-worker PRNG key; the sequential running-stat
+update across workers is composed in the training step
+(`train/step.py:compose_bn_updates`) — see SURVEY.md §7 "hard parts" #2.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import (
+    batchnorm_apply, batchnorm_init, conv_apply, conv_init, dense_apply,
+    dense_init, dropout_apply, log_softmax, max_pool)
+
+__all__ = []
+
+
+def make_cnn(cifar100=False, **kwargs):
+    fc1_out = 256 if cifar100 else 128
+    n_classes = 100 if cifar100 else 10
+
+    def init(key):
+        keys = jax.random.split(key, 6)
+        params, state = {}, {}
+        params["c1"] = conv_init(keys[0], 3, 3, 3, 64)
+        params["b1"], state["b1"] = batchnorm_init(64)
+        params["c2"] = conv_init(keys[1], 3, 3, 64, 64)
+        params["b2"], state["b2"] = batchnorm_init(64)
+        params["c3"] = conv_init(keys[2], 3, 3, 64, 128)
+        params["b3"], state["b3"] = batchnorm_init(128)
+        params["c4"] = conv_init(keys[3], 3, 3, 128, 128)
+        params["b4"], state["b4"] = batchnorm_init(128)
+        params["f1"] = dense_init(keys[4], 8192, fc1_out)
+        params["f2"] = dense_init(keys[5], fc1_out, n_classes)
+        return params, state
+
+    def apply(params, state, x, train=False, rng=None):
+        if train and rng is None:
+            raise ValueError("empire-cnn needs a PRNG key in train mode (dropout)")
+        drop_keys = jax.random.split(rng, 3) if train else (None, None, None)
+        new_state = dict(state)
+        x = jax.nn.relu(conv_apply(params["c1"], x, padding="SAME"))
+        x, new_state["b1"] = batchnorm_apply(params["b1"], state["b1"], x, train=train)
+        x = jax.nn.relu(conv_apply(params["c2"], x, padding="SAME"))
+        x, new_state["b2"] = batchnorm_apply(params["b2"], state["b2"], x, train=train)
+        x = max_pool(x, 2)
+        x = dropout_apply(drop_keys[0], x, 0.25, train=train)
+        x = jax.nn.relu(conv_apply(params["c3"], x, padding="SAME"))
+        x, new_state["b3"] = batchnorm_apply(params["b3"], state["b3"], x, train=train)
+        x = jax.nn.relu(conv_apply(params["c4"], x, padding="SAME"))
+        x, new_state["b4"] = batchnorm_apply(params["b4"], state["b4"], x, train=train)
+        x = max_pool(x, 2)
+        x = dropout_apply(drop_keys[1], x, 0.25, train=train)
+        x = x.reshape((x.shape[0], -1))  # (B, 8*8*128) = (B, 8192)
+        x = jax.nn.relu(dense_apply(params["f1"], x))
+        x = dropout_apply(drop_keys[2], x, 0.25, train=train)
+        x = dense_apply(params["f2"], x)
+        return log_softmax(x), new_state
+
+    return ModelDef("empire-cnn", init, apply, (32, 32, 3))
+
+
+register("empire-cnn", make_cnn)

@@ -1,0 +1,87 @@
+"""Simple model collection: `simples-full`, `simples-conv`, `simples-logit`,
+`simples-linear` (reference `experiments/models/simples.py`).
+
+* full   — MNIST 784-100-10 MLP, relu + log_softmax (reference `:23-55`).
+* conv   — MNIST LeNet-style: conv(1->20,5) relu pool2, conv(20->50,5) relu
+           pool2, fc 800-500-10, log_softmax (reference `:60-98`; the CLI
+           default model, reference `attack.py:126-129`).
+* logit  — sigmoid(linear(din->dout)) (reference `:103-137`).
+* linear — linear(din->dout) (reference `:142-176`).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import (
+    conv_apply, conv_init, dense_apply, dense_init, log_softmax, max_pool)
+
+__all__ = []
+
+
+def make_full(**kwargs):
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        params = {
+            "f1": dense_init(k1, 28 * 28, 100),
+            "f2": dense_init(k2, 100, 10),
+        }
+        return params, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        x = x.reshape((x.shape[0], -1))
+        x = jax.nn.relu(dense_apply(params["f1"], x))
+        return log_softmax(dense_apply(params["f2"], x)), state
+
+    return ModelDef("simples-full", init, apply, (28, 28, 1))
+
+
+def make_conv(**kwargs):
+    def init(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        params = {
+            "c1": conv_init(k1, 5, 5, 1, 20),
+            "c2": conv_init(k2, 5, 5, 20, 50),
+            "f1": dense_init(k3, 800, 500),
+            "f2": dense_init(k4, 500, 10),
+        }
+        return params, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        x = jax.nn.relu(conv_apply(params["c1"], x, padding="VALID"))
+        x = max_pool(x, 2)
+        x = jax.nn.relu(conv_apply(params["c2"], x, padding="VALID"))
+        x = max_pool(x, 2)
+        x = x.reshape((x.shape[0], -1))  # (B, 4*4*50) = (B, 800)
+        x = jax.nn.relu(dense_apply(params["f1"], x))
+        return log_softmax(dense_apply(params["f2"], x)), state
+
+    return ModelDef("simples-conv", init, apply, (28, 28, 1))
+
+
+def make_logit(din=68, dout=1, **kwargs):
+    def init(key):
+        return {"linear": dense_init(key, din, dout)}, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        x = x.reshape((x.shape[0], din))
+        return jax.nn.sigmoid(dense_apply(params["linear"], x)), state
+
+    return ModelDef("simples-logit", init, apply, (din,))
+
+
+def make_linear(din=68, dout=1, **kwargs):
+    def init(key):
+        return {"linear": dense_init(key, din, dout)}, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        x = x.reshape((x.shape[0], din))
+        return dense_apply(params["linear"], x), state
+
+    return ModelDef("simples-linear", init, apply, (din,))
+
+
+register("simples-full", make_full)
+register("simples-conv", make_conv)
+register("simples-logit", make_logit)
+register("simples-linear", make_linear)

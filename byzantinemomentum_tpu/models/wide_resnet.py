@@ -1,0 +1,100 @@
+"""Wide ResNet: `wide_resnet-Wide_ResNet` — own implementation (the
+reference pulls WRN from a non-vendored git submodule, reference
+`experiments/models/wide_resnet.py` symlink + `.gitmodules:1-3`; used as
+`Wide_ResNet(depth, widen_factor, dropout_rate, num_classes)` by the
+appendix grid, reference `reproduce-appendix.py:124-125`).
+
+Pre-activation wide basic blocks: bn-relu-conv3x3-dropout-bn-relu-conv3x3
+with identity (or 1x1-conv) shortcut; groups of width 16k/32k/64k at
+strides 1/2/2; final bn-relu, global average pool, fc.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import (
+    batchnorm_apply, batchnorm_init, conv_apply, conv_init, dense_apply,
+    dense_init, dropout_apply, log_softmax)
+
+__all__ = []
+
+
+def _block_init(key, cin, cout, stride):
+    keys = jax.random.split(key, 3)
+    params = {
+        "conv1": conv_init(keys[0], 3, 3, cin, cout),
+        "conv2": conv_init(keys[1], 3, 3, cout, cout),
+    }
+    state = {}
+    params["bn1"], state["bn1"] = batchnorm_init(cin)
+    params["bn2"], state["bn2"] = batchnorm_init(cout)
+    if stride != 1 or cin != cout:
+        params["shortcut"] = conv_init(keys[2], 1, 1, cin, cout)
+    return params, state
+
+
+def _block_apply(params, state, x, stride, dropout_rate, train, rng):
+    new_state = dict(state)
+    out, new_state["bn1"] = batchnorm_apply(params["bn1"], state["bn1"], x, train=train)
+    out = jax.nn.relu(out)
+    shortcut = x
+    if "shortcut" in params:
+        shortcut = conv_apply(params["shortcut"], out, padding="VALID", stride=stride)
+    out = conv_apply(params["conv1"], out, padding="SAME", stride=stride)
+    out = dropout_apply(rng, out, dropout_rate, train=train)
+    out, new_state["bn2"] = batchnorm_apply(params["bn2"], state["bn2"], out, train=train)
+    out = jax.nn.relu(out)
+    out = conv_apply(params["conv2"], out, padding="SAME")
+    return out + shortcut, new_state
+
+
+def make_wide_resnet(depth=28, widen_factor=10, dropout_rate=0.3, num_classes=10, **kwargs):
+    assert (depth - 4) % 6 == 0, "Wide-ResNet depth must be 6n+4"
+    n_blocks = (depth - 4) // 6
+    widths = [16, 16 * widen_factor, 32 * widen_factor, 64 * widen_factor]
+    strides = [1, 2, 2]
+
+    def init(key):
+        keys = jax.random.split(key, 3 * n_blocks + 3)
+        params, state = {}, {}
+        params["conv0"] = conv_init(keys[0], 3, 3, 3, widths[0])
+        cin = widths[0]
+        ki = 1
+        for gi in range(3):
+            for bi in range(n_blocks):
+                stride = strides[gi] if bi == 0 else 1
+                name = f"g{gi}b{bi}"
+                params[name], state[name] = _block_init(keys[ki], cin, widths[gi + 1], stride)
+                cin = widths[gi + 1]
+                ki += 1
+        params["bn_out"], state["bn_out"] = batchnorm_init(widths[3])
+        params["fc"] = dense_init(keys[ki], widths[3], num_classes)
+        return params, state
+
+    def apply(params, state, x, train=False, rng=None):
+        if train and rng is None:
+            raise ValueError("wide_resnet needs a PRNG key in train mode (dropout)")
+        n_drop = 3 * n_blocks
+        drop_keys = jax.random.split(rng, n_drop) if train else [None] * n_drop
+        new_state = dict(state)
+        out = conv_apply(params["conv0"], x, padding="SAME")
+        ki = 0
+        for gi in range(3):
+            for bi in range(n_blocks):
+                stride = strides[gi] if bi == 0 else 1
+                name = f"g{gi}b{bi}"
+                out, new_state[name] = _block_apply(
+                    params[name], state[name], out, stride, dropout_rate, train, drop_keys[ki])
+                ki += 1
+        out, new_state["bn_out"] = batchnorm_apply(params["bn_out"], state["bn_out"], out, train=train)
+        out = jax.nn.relu(out)
+        out = jnp.mean(out, axis=(1, 2))  # global average pool (8x8 at CIFAR scale)
+        out = dense_apply(params["fc"], out)
+        return log_softmax(out), new_state
+
+    return ModelDef("wide_resnet-Wide_ResNet", init, apply, (32, 32, 3))
+
+
+register("wide_resnet-Wide_ResNet", make_wide_resnet)
+register("wide-resnet", make_wide_resnet)

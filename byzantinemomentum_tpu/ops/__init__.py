@@ -1,0 +1,104 @@
+"""Gradient aggregation rules (GARs) — the algorithmic kernels.
+
+Every GAR is a pure function over the *stacked* gradient matrix
+`G: f32[n, d]` (one row per worker) with a static Byzantine tolerance `f`,
+returning the aggregated gradient `f32[d]`. This is the TPU-native redesign
+of the reference's list-of-flat-tensors contract
+(reference `aggregators/__init__.py:15-31`): stacking lets XLA tile the
+sorts / pairwise distances / reductions onto the VPU/MXU, and the whole GAR
+inlines into the jitted training step.
+
+Registry parity with the reference (`aggregators/__init__.py:42-97`): each
+registered GAR exposes `.checked` (argument-validating wrapper), `.unchecked`
+(raw kernel), `.check`, `.upper_bound` (variance-norm ratio bound consumed by
+the study pipeline) and `.influence` (attack acceptation ratio). The registry
+maps `name -> GAR` in the module-level `gars` dict; modules in this directory
+self-register at import (same plugin pattern as the reference).
+
+A second, compiled fast tier is registered under `native-<name>` for the four
+GARs the reference accelerates natively (median, krum, bulyan, brute —
+reference `aggregators/median.py:41-49` etc.): on TPU the "native" tier is the
+jit-compiled kernel with the MXU-friendly dot-product distance path.
+"""
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import utils
+
+__all__ = ["gars", "register", "GAR", "as_matrix"]
+
+# Registry: name -> GAR
+gars = {}
+
+
+def as_matrix(gradients):
+    """Coerce a list of flat gradients or an (n, d) array into an (n, d) jnp
+    matrix (the canonical GAR input)."""
+    if isinstance(gradients, (list, tuple)):
+        return jnp.stack([jnp.asarray(g) for g in gradients])
+    gradients = jnp.asarray(gradients)
+    if gradients.ndim != 2:
+        raise utils.UserException(
+            f"Expected an (n, d) gradient matrix or a list of flat gradients, got shape {gradients.shape}")
+    return gradients
+
+
+class GAR:
+    """A registered gradient aggregation rule.
+
+    Calling the GAR object runs the checked path; `.unchecked` is the raw
+    kernel (mirrors the reference's `__debug__` switch,
+    `aggregators/__init__.py:60-61`, without requiring `python -OO`).
+    """
+
+    def __init__(self, name, unchecked, check, upper_bound=None, influence=None):
+        self.name = name
+        self.unchecked = unchecked
+        self.check = check
+        self.upper_bound = upper_bound
+        self.influence = influence
+
+    def checked(self, gradients, **kwargs):
+        gradients = as_matrix(gradients)
+        message = self.check(gradients=gradients, **kwargs)
+        if message is not None:
+            raise utils.UserException(f"Aggregation rule {self.name!r} cannot be used: {message}")
+        result = self.unchecked(gradients, **kwargs)
+        if result.shape != gradients.shape[1:]:
+            raise utils.UserException(
+                f"Aggregation rule {self.name!r} returned shape {result.shape}, expected {gradients.shape[1:]}")
+        return result
+
+    def __call__(self, gradients, **kwargs):
+        return self.checked(gradients, **kwargs)
+
+    def __repr__(self):
+        return f"GAR({self.name!r})"
+
+
+def register(name, unchecked, check, upper_bound=None, influence=None):
+    """Register a GAR under `name` (reference `aggregators/__init__.py:42-86`).
+
+    Args:
+      name: registry key.
+      unchecked: kernel `(G: f32[n,d], **kwargs) -> f32[d]`.
+      check: `(gradients, **kwargs) -> None | str` validity test.
+      upper_bound: optional `(n, f, d) -> float` theoretical ratio bound.
+      influence: optional `(honests, byzantines, **kwargs) -> float` attack
+        acceptation ratio.
+    Returns:
+      The GAR object.
+    """
+    if name in gars:
+        utils.warning(f"Aggregation rule {name!r} registered twice; keeping the last")
+    gar = GAR(name, unchecked, check, upper_bound=upper_bound, influence=influence)
+    gars[name] = gar
+    return gar
+
+
+# Self-registering kernel modules (plugin pattern, reference
+# `aggregators/__init__.py:91-97`)
+utils.import_directory(__name__, pathlib.Path(__file__).parent)

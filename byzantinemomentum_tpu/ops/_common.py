@@ -1,0 +1,88 @@
+"""Shared primitives for the GAR kernels.
+
+Semantics pinned against the reference implementation (PyTorch, circa 1.x):
+
+* Sorting places NaN last (torch.sort and jnp.sort agree on this).
+* "Median" means the *lower* median: `sorted[(n - 1) // 2]` — torch's
+  convention for even n, and the NaN-resilient behavior the reference's
+  median GAR documents (reference `aggregators/median.py:13`): with
+  f < n/2 NaN rows, NaNs sort last and the lower median stays finite.
+* Pairwise distances treat any non-finite value as +inf (reference
+  `aggregators/krum.py:46-48`, `bulyan.py:51-53`).
+* Selection ties resolve by stable sort order (Python's `list.sort` is
+  stable; `jnp.argsort(stable=True)` matches index-order tie-breaking).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "lower_median",
+    "pairwise_distances",
+    "closest_mean",
+    "sanitize_inf",
+]
+
+
+def lower_median(g):
+    """Coordinate-wise lower median over axis 0 with NaN-last ordering.
+
+    `f32[n, d] -> f32[d]`; equals torch's `median(dim=0)` index convention
+    (`sorted[(n-1)//2]`) and is NaN-resilient for < n/2 NaN rows.
+    """
+    n = g.shape[0]
+    return jnp.sort(g, axis=0)[(n - 1) // 2]
+
+
+def sanitize_inf(x):
+    """Replace non-finite entries by +inf (Byzantine-distance convention)."""
+    return jnp.where(jnp.isfinite(x), x, jnp.inf)
+
+
+def pairwise_distances(g, *, squared=False, method="dot"):
+    """All-pairs Euclidean distances over rows of `g: f32[n, d]`.
+
+    Non-finite distances map to +inf; the diagonal is forced to +inf so
+    per-row sorts naturally exclude self-distances.
+
+    Args:
+      g: (n, d) gradient matrix.
+      squared: return squared distances (aksel uses squared, krum/bulyan/brute
+        use plain norms — reference `aggregators/krum.py:42-48`,
+        `aksel.py:37-40`).
+      method: 'dot' uses the Gram-matrix identity ||x-y||² = ||x||²+||y||²-2x·y
+        — one MXU matmul, O(n²) memory, the TPU-native fast path; 'diff'
+        computes the difference reduction directly (bit-closer to the
+        reference's `sub().norm()`, O(n²·d) VPU work that XLA fuses without
+        materializing the (n, n, d) intermediate).
+    Returns:
+      (n, n) distance matrix, +inf on the diagonal.
+    """
+    n = g.shape[0]
+    if method == "dot":
+        sq = jnp.sum(g * g, axis=1)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (g @ g.T)
+        d2 = jnp.maximum(d2, 0.0)
+    elif method == "diff":
+        d2 = jax.vmap(lambda gi: jnp.sum((g - gi[None, :]) ** 2, axis=1))(g)
+    else:
+        raise ValueError(f"Unknown pairwise distance method {method!r}")
+    d2 = sanitize_inf(d2)
+    d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
+    if squared:
+        return d2
+    return sanitize_inf(jnp.sqrt(d2))
+
+
+def closest_mean(g, c, m):
+    """Coordinate-wise mean of the `m` values closest to center `c`.
+
+    `g: f32[n, d], c: f32[d], m: static int -> f32[d]` — the shared helper
+    behind phocas/meamed (reference `aggregators/trmean.py:35-50`) and
+    Bulyan's averaged median (reference `aggregators/bulyan.py:77-84`).
+    NaN deviations sort last, so NaN rows are excluded whenever m <= number
+    of finite values per coordinate.
+    """
+    dev = jnp.abs(g - c[None, :])
+    order = jnp.argsort(dev, axis=0, stable=True)[:m]
+    return jnp.mean(jnp.take_along_axis(g, order, axis=0), axis=0)

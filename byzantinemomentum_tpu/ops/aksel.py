@@ -1,0 +1,56 @@
+"""Aksel GAR (reference `aggregators/aksel.py`).
+
+Coordinate-wise median center, rank workers by squared L2 distance to it,
+average the c closest — c = (n+1)//2 in 'mid' mode, n-f in 'n-f' mode
+(reference `aggregators/aksel.py:24-64`).
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops._common import lower_median, sanitize_inf
+
+__all__ = ["aggregate", "selection"]
+
+
+def _count(n, f, mode):
+    if mode == "mid":
+        return (n + 1) // 2
+    if mode == "n-f":
+        return n - f
+    raise NotImplementedError(f"Unknown aksel mode {mode!r}")
+
+
+def selection(gradients, f, mode="mid"):
+    """Indices of the c gradients closest (squared L2) to the median
+    (reference `aggregators/aksel.py:24-53`); non-finite distances rank last."""
+    n = gradients.shape[0]
+    med = lower_median(gradients)
+    sqd = sanitize_inf(jnp.sum((gradients - med[None, :]) ** 2, axis=1))
+    return jnp.argsort(sqd, stable=True)[:_count(n, f, mode)]
+
+
+def aggregate(gradients, f, mode="mid", **kwargs):
+    """Aksel rule (reference `aggregators/aksel.py:55-64`)."""
+    return jnp.mean(gradients[selection(gradients, f, mode)], axis=0)
+
+
+def check(gradients, f, mode="mid", **kwargs):
+    n = gradients.shape[0]
+    if n < 1:
+        return f"Expected at least one gradient to aggregate, got {n}"
+    if not isinstance(f, int) or f < 1 or n < 2 * f + 1:
+        return f"Invalid number of Byzantine gradients to tolerate, got f = {f!r}, expected 1 <= f <= {(n - 1) // 2}"
+    if mode not in ("mid", "n-f"):
+        return f"Invalid operation mode {mode!r}"
+
+
+def influence(honests, byzantines, f, mode="mid", **kwargs):
+    """Fraction of selected gradients that are Byzantine
+    (reference `aggregators/aksel.py:83-105`)."""
+    gradients = jnp.concatenate([honests, byzantines], axis=0)
+    sel = selection(gradients, f, mode)
+    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+
+
+register("aksel", aggregate, check, influence=influence)

@@ -1,0 +1,29 @@
+"""Simple averaging GAR (reference `aggregators/average.py`)."""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.ops import register
+
+__all__ = ["aggregate"]
+
+
+def aggregate(gradients, **kwargs):
+    """Arithmetic mean over the worker axis
+    (reference `aggregators/average.py:21-29`)."""
+    return jnp.mean(gradients, axis=0)
+
+
+def check(gradients, **kwargs):
+    if gradients.shape[0] < 1:
+        return f"Expected at least one gradient to aggregate, got {gradients.shape[0]}"
+
+
+def influence(honests, byzantines, **kwargs):
+    """Attack acceptation ratio = f_real / n
+    (reference `aggregators/average.py:42-49`)."""
+    h = honests.shape[0]
+    b = byzantines.shape[0]
+    return b / (h + b)
+
+
+register("average", aggregate, check, influence=influence)

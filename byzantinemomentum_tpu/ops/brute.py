@@ -1,0 +1,89 @@
+"""Brute-force minimum-diameter GAR (reference `aggregators/brute.py`).
+
+Enumerate every size-(n-f) subset, compute its diameter (max pairwise
+distance), select the subset with minimal diameter, average it (reference
+`aggregators/brute.py:32-80`). Subsets containing a non-finite distance are
+dropped (diameter +inf here — equivalent as long as one finite subset
+exists, which the reference asserts).
+
+TPU design: the C(n, n-f) subset enumeration is data-independent, so the
+combination index matrix is precomputed on the host (lexicographic order =
+`itertools.combinations` = the reference's tie-break order, since
+`jnp.argmin` returns the first minimum) and the per-subset diameters become
+one vectorized gather + max over the (n, n) distance matrix.
+`native-brute` is the standalone-jitted fast tier (stands in for
+`native.brute.aggregate`, reference `brute.py:82-91`).
+"""
+
+import functools
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops._common import pairwise_distances
+
+__all__ = ["aggregate", "selection"]
+
+
+@functools.lru_cache(maxsize=None)
+def _combo_pairs(n, k):
+    """Host-precomputed (C, k) combination indices and (C, k*(k-1)/2, 2) pair
+    indices for diameter gathering."""
+    combos = np.array(list(itertools.combinations(range(n), k)), dtype=np.int32)
+    pair_pos = np.array(list(itertools.combinations(range(k), 2)), dtype=np.int32)
+    px = combos[:, pair_pos[:, 0]]  # (C, P)
+    py = combos[:, pair_pos[:, 1]]  # (C, P)
+    return combos, px, py
+
+
+def selection(gradients, f, *, method="dot"):
+    """Indices (as a (n-f,) array) of the minimum-diameter subset
+    (reference `aggregators/brute.py:32-68`)."""
+    n = gradients.shape[0]
+    combos, px, py = _combo_pairs(n, n - f)
+    dist = pairwise_distances(gradients, method=method)
+    diam = jnp.max(dist[px, py], axis=1)  # (C,) — +inf if any pair non-finite
+    best = jnp.argmin(diam)  # first minimum = lexicographically-first subset
+    return jnp.asarray(combos)[best]
+
+
+def aggregate(gradients, f, *, method="dot", **kwargs):
+    """Brute rule (reference `aggregators/brute.py:70-80`)."""
+    return jnp.mean(gradients[selection(gradients, f, method=method)], axis=0)
+
+
+_jitted = jax.jit(aggregate, static_argnames=("f", "method"))
+
+
+def aggregate_native(gradients, f, **kwargs):
+    """Compiled fast tier (TPU equivalent of `native.brute.aggregate`)."""
+    return _jitted(gradients, f)
+
+
+def check(gradients, f, **kwargs):
+    n = gradients.shape[0]
+    if n < 1:
+        return f"Expected at least one gradient to aggregate, got {n}"
+    if not isinstance(f, int) or f < 1 or n < 2 * f + 1:
+        return f"Invalid number of Byzantine gradients to tolerate, got f = {f!r}, expected 1 <= f <= {(n - 1) // 2}"
+
+
+def upper_bound(n, f, d):
+    """Variance-norm ratio bound (reference `aggregators/brute.py:107-116`)."""
+    import math
+    return (n - f) / (math.sqrt(8) * f)
+
+
+def influence(honests, byzantines, f, **kwargs):
+    """Fraction of selected gradients that are Byzantine
+    (reference `aggregators/brute.py:118-140`)."""
+    gradients = jnp.concatenate([honests, byzantines], axis=0)
+    sel = selection(gradients, f)
+    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+
+
+register("brute", aggregate, check, upper_bound=upper_bound, influence=influence)
+register("native-brute", aggregate_native, check, upper_bound=upper_bound)

@@ -1,0 +1,84 @@
+"""Bulyan over Multi-Krum GAR (reference `aggregators/bulyan.py`).
+
+Two stages:
+1. Iteratively select n-2f-2 Multi-Krum averages: at each round, average the
+   gradients with the m lowest scores (m shrinking as min(m, n-f-2-i)),
+   then prune the current minimum-score gradient (reference
+   `aggregators/bulyan.py:63-76`).
+2. Coordinate-wise "averaged median" over the selected stack with
+   m = |selected| - 2f (reference `bulyan.py:77-84`).
+
+Parity note on the reference's pruning (reference `bulyan.py:72-76`): the
+post-prune score-update loop there references an undefined variable and its
+branch is unreachable, so the *effective* reference behavior is "prune = set
+the minimum score to +inf, update nothing else". We reproduce that effective
+behavior (documented in SURVEY.md §2.1), not the dead code.
+
+Bulyan scores differ slightly from Krum's: sum of the m smallest neighbor
+distances (m = n-f-2 by default), not n-f-1 (reference `bulyan.py:56-62`).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops._common import closest_mean, lower_median, pairwise_distances
+
+__all__ = ["aggregate", "selected_stack"]
+
+
+def selected_stack(gradients, f, m=None, *, method="dot"):
+    """The (n-2f-2, d) stack of iterative Multi-Krum averages
+    (reference `aggregators/bulyan.py:63-76`, effective behavior)."""
+    n = gradients.shape[0]
+    m_max = n - f - 2
+    if m is None:
+        m = m_max
+    dist = pairwise_distances(gradients, method=method)  # diag = +inf
+    scores = jnp.sum(jnp.sort(dist, axis=1)[:, :m], axis=1)
+    rounds = n - 2 * f - 2
+    selected = []
+    # Static unrolled loop (n <= ~51 at paper scale): each round re-sorts the
+    # live scores, averages the current m best, prunes the arg-minimum.
+    for i in range(rounds):
+        m_i = min(m, m_max - i)
+        order = jnp.argsort(scores, stable=True)
+        selected.append(jnp.mean(gradients[order[:m_i]], axis=0))
+        scores = scores.at[order[0]].set(jnp.inf)
+    return jnp.stack(selected)
+
+
+def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
+    """Bulyan over Multi-Krum (reference `aggregators/bulyan.py:31-86`)."""
+    sel = selected_stack(gradients, f, m, method=method)
+    m2 = sel.shape[0] - 2 * f
+    return closest_mean(sel, lower_median(sel), m2)
+
+
+_jitted = jax.jit(aggregate, static_argnames=("f", "m", "method"))
+
+
+def aggregate_native(gradients, f, m=None, **kwargs):
+    """Compiled fast tier (TPU equivalent of `native.bulyan.aggregate`)."""
+    return _jitted(gradients, f, m)
+
+
+def check(gradients, f, m=None, **kwargs):
+    n = gradients.shape[0]
+    if n < 1:
+        return f"Expected at least one gradient to aggregate, got {n}"
+    if not isinstance(f, int) or f < 1 or n < 4 * f + 3:
+        return f"Invalid number of Byzantine gradients to tolerate, got f = {f!r}, expected 1 <= f <= {(n - 3) // 4}"
+    if m is not None and (not isinstance(m, int) or m < 1 or m > n - f - 2):
+        return f"Invalid number of selected gradients, got m = {m!r}, expected 1 <= m <= {n - f - 2}"
+
+
+def upper_bound(n, f, d):
+    """Variance-norm ratio bound (reference `aggregators/bulyan.py:119-128`)."""
+    return 1 / math.sqrt(2 * (n - f + f * (n + f * (n - f - 2) - 2) / (n - 2 * f - 2)))
+
+
+register("bulyan", aggregate, check, upper_bound=upper_bound)
+register("native-bulyan", aggregate_native, check, upper_bound=upper_bound)

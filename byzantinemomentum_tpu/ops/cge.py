@@ -1,0 +1,46 @@
+"""Comparative Gradient Elimination (CGE) GAR (reference `aggregators/cge.py`;
+algorithm from Liu, Gupta, Vaidya 2021, cited reference `cge.py:14-18`).
+
+Sort workers by gradient norm (non-finite -> +inf), average the n-f
+smallest-norm gradients (reference `aggregators/cge.py:28-57`).
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops._common import sanitize_inf
+
+__all__ = ["aggregate", "selection"]
+
+
+def norms(gradients):
+    """Per-worker L2 norms with non-finite mapped to +inf
+    (reference `aggregators/cge.py:28-40`)."""
+    return sanitize_inf(jnp.sqrt(jnp.sum(gradients * gradients, axis=1)))
+
+
+def selection(gradients, f):
+    """Indices of the n-f smallest-norm gradients, stable-tie order."""
+    n = gradients.shape[0]
+    return jnp.argsort(norms(gradients), stable=True)[:n - f]
+
+
+def aggregate(gradients, f, **kwargs):
+    """CGE rule (reference `aggregators/cge.py:42-57`)."""
+    return jnp.mean(gradients[selection(gradients, f)], axis=0)
+
+
+def check(gradients, f=None, m=None, **kwargs):
+    if gradients.shape[0] < 1:
+        return f"Expected at least one gradient to aggregate, got {gradients.shape[0]}"
+
+
+def influence(honests, byzantines, f, **kwargs):
+    """Fraction of selected gradients that are Byzantine
+    (reference `aggregators/cge.py:72-93`)."""
+    gradients = jnp.concatenate([honests, byzantines], axis=0)
+    sel = selection(gradients, f)
+    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+
+
+register("cge", aggregate, check, influence=influence)

@@ -1,0 +1,93 @@
+"""Budgeted 1-D arg-maximization on the non-negative reals — the engine of
+the adaptive attacks (reference `tools/misc.py:468-514`).
+
+The reference's algorithm is an expansion phase (double the step while the
+objective improves) followed by a contraction phase (probe shrinking steps
+around the incumbent), under a fixed evaluation budget. Because the budget
+is static, the whole search compiles to a single `lax.while_loop` whose body
+inlines the objective — so an adaptive attack that evaluates the live
+defense up to ~16 times per step stays inside one XLA program instead of
+16 host round-trips.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["line_maximize"]
+
+
+def line_maximize(scape, evals=16, start=0.0, delta=1.0, ratio=0.8):
+    """Best-effort arg-maximize `scape: R+ -> R` under an evaluation budget.
+
+    Traceable port of the reference's exact control flow
+    (`tools/misc.py:468-514`): same expansion/contraction schedule, same
+    tie-breaking (strict improvement only), same negative-x guard (repeated
+    halving toward the previous probe).
+
+    Args:
+      scape: traceable objective `f32[] -> f32[]`.
+      evals: static positive int, total evaluation budget.
+      start: initial x (non-negative).
+      delta: initial step.
+      ratio: contraction ratio in (0.5, 1).
+    Returns:
+      The best x found, as a traced f32 scalar.
+    """
+    start = jnp.float32(start)
+    delta0 = jnp.float32(delta)
+    ratio = jnp.float32(ratio)
+
+    best_y0 = scape(start)
+
+    # State: (phase, evals_left, best_x, best_y, prop_x, delta)
+    # phase 0 = expansion, 1 = contraction.
+    init = (jnp.int32(0), jnp.int32(evals - 1), start, best_y0, start, delta0)
+
+    def cond(state):
+        _, evals_left, *_ = state
+        return evals_left > 0
+
+    def body(state):
+        phase, evals_left, best_x, best_y, prop_x, delta = state
+
+        def expand(_):
+            px = best_x + delta
+            py = scape(px)
+            better = py > best_y
+            return (
+                jnp.where(better, 0, 1).astype(jnp.int32),  # stay expanding iff improved
+                evals_left - 1,
+                jnp.where(better, px, best_x),
+                jnp.where(better, py, best_y),
+                px,
+                jnp.where(better, delta * 2.0, delta * ratio),
+            )
+
+        def contract(_):
+            # Probe on the other side of the incumbent, guarding x >= 0 by
+            # halving toward the previous probe (reference `misc.py:499-506`).
+            def neg_guard(x):
+                return lax.while_loop(lambda v: v < 0, lambda v: (v + px_minus_src) / 2.0, x)
+
+            px_minus_src = prop_x
+            px = jnp.where(
+                prop_x < best_x,
+                prop_x + delta,
+                neg_guard(prop_x - delta),
+            )
+            py = scape(px)
+            better = py > best_y
+            return (
+                jnp.int32(1),
+                evals_left - 1,
+                jnp.where(better, px, best_x),
+                jnp.where(better, py, best_y),
+                px,
+                delta * ratio,
+            )
+
+        return lax.cond(phase == 0, expand, contract, operand=None)
+
+    _, _, best_x, _, _, _ = lax.while_loop(cond, body, init)
+    return best_x

@@ -1,0 +1,51 @@
+"""NaN-resilient coordinate-wise median GAR (reference `aggregators/median.py`).
+
+Semantics: lower median with NaN-last ordering — `sorted[(n-1)//2]` per
+coordinate. This matches the reference's documented NaN-resilience
+(`aggregators/median.py:13`) and torch's lower-median index convention;
+note that *modern* torch-CPU `median` propagates NaN instead, which would
+make the GAR meaningless under the `nan` attack — we keep the documented,
+sort-based semantics.
+
+The `native-median` registration is the compiled fast tier standing in for
+the reference's optional C++ `native.median.aggregate`
+(`aggregators/median.py:41-49`): on TPU it is the same kernel jit-compiled
+standalone.
+"""
+
+import math
+
+import jax
+
+from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops._common import lower_median
+
+__all__ = ["aggregate"]
+
+
+def aggregate(gradients, **kwargs):
+    """NaN-resilient coordinate-wise lower median
+    (reference `aggregators/median.py:31-39`)."""
+    return lower_median(gradients)
+
+
+_jitted = jax.jit(lower_median)
+
+
+def aggregate_native(gradients, **kwargs):
+    """Compiled fast tier (TPU equivalent of `native.median.aggregate`)."""
+    return _jitted(gradients)
+
+
+def check(gradients, **kwargs):
+    if gradients.shape[0] < 1:
+        return f"Expected at least one gradient to aggregate, got {gradients.shape[0]}"
+
+
+def upper_bound(n, f, d):
+    """Variance-norm ratio bound (reference `aggregators/median.py:62-71`)."""
+    return 1 / math.sqrt(n - f)
+
+
+register("median", aggregate, check, upper_bound=upper_bound)
+register("native-median", aggregate_native, check, upper_bound=upper_bound)

@@ -1,0 +1,46 @@
+"""Extension skeleton for a new GAR (parity with reference
+`aggregators/template.py`; workflow documented in the reference
+`README.md:151-159`).
+
+Copy this file, rename the functions, and the rule self-registers at
+import through the plugin loader (`ops/__init__.py`). A GAR kernel is a
+pure function over the stacked gradient matrix; keep `f` and any other
+structural arguments static (Python ints/strings) so jit can specialize.
+"""
+
+# To activate, copy this module and uncomment the registration at the bottom.
+
+__all__ = []
+
+
+def aggregate(gradients, f, **kwargs):
+    """Aggregate the (n, d) gradient matrix into a (d,) gradient.
+
+    Args:
+      gradients: f32[n, d] stacked worker gradients.
+      f: static int, declared Byzantine tolerance.
+      **kwargs: rule-specific arguments from `--gar-args` (auto-typed).
+    Returns:
+      f32[d] aggregated gradient.
+    """
+    raise NotImplementedError
+
+
+def check(gradients, f, **kwargs):
+    """Return None if the arguments are valid, an error message otherwise."""
+    if gradients.shape[0] < 1:
+        return "Expected at least one gradient to aggregate"
+
+
+def upper_bound(n, f, d):
+    """Optional: the paper's variance-norm ratio bound for this rule."""
+    return None
+
+
+def influence(honests, byzantines, f, **kwargs):
+    """Optional: fraction of Byzantine gradients accepted by the rule."""
+    return None
+
+
+# from byzantinemomentum_tpu.ops import register
+# register("template", aggregate, check, upper_bound=upper_bound, influence=influence)

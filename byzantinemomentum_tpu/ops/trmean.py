@@ -1,0 +1,52 @@
+"""Trimmed mean, Phocas and MeaMed GARs (reference `aggregators/trmean.py`).
+
+All three are coordinate-wise rules over the stacked `(n, d)` matrix:
+* trmean — sort each coordinate, average ranks [f, n-f)
+  (reference `aggregators/trmean.py:24-33`).
+* phocas — trmean center, then mean of the n-f coordinate-wise closest
+  values (reference `aggregators/trmean.py:81-94`).
+* meamed — median center, then mean of the n-f closest
+  (reference `aggregators/trmean.py:96-109`).
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops._common import closest_mean, lower_median
+
+__all__ = ["trmean", "aggregate_trmean", "aggregate_phocas", "aggregate_meamed"]
+
+
+def trmean(g, f):
+    """Coordinate-wise mean of sorted ranks [f, n-f)
+    (reference `aggregators/trmean.py:24-33`). NaN sorts last, so up to f NaN
+    rows are trimmed away."""
+    n = g.shape[0]
+    return jnp.mean(jnp.sort(g, axis=0)[f:n - f], axis=0)
+
+
+def aggregate_trmean(gradients, f, **kwargs):
+    return trmean(gradients, f)
+
+
+def aggregate_phocas(gradients, f, **kwargs):
+    g = gradients
+    return closest_mean(g, trmean(g, f), g.shape[0] - f)
+
+
+def aggregate_meamed(gradients, f, **kwargs):
+    g = gradients
+    return closest_mean(g, lower_median(g), g.shape[0] - f)
+
+
+def check(gradients, f, **kwargs):
+    n = gradients.shape[0]
+    if n < 1:
+        return f"Expected at least one gradient to aggregate, got {n}"
+    if not isinstance(f, int) or f < 1 or n < 2 * f + 1:
+        return f"Invalid number of Byzantine gradients to tolerate, got f = {f!r}, expected 1 <= f <= {(n - 1) // 2}"
+
+
+register("trmean", aggregate_trmean, check)
+register("phocas", aggregate_phocas, check)
+register("meamed", aggregate_meamed, check)

@@ -1,0 +1,30 @@
+"""Utility substrate: logging contexts, registries, the `key:value` plugin
+argument mini-language, timing scopes and misc helpers.
+
+Capability parity with the reference's `tools/` package (reference
+`tools/__init__.py`, `tools/misc.py`), re-designed for a JAX codebase:
+no global stdout wrapping, no torch dependencies.
+"""
+
+from byzantinemomentum_tpu.utils.logging import (  # noqa: F401
+    Context,
+    UserException,
+    UnavailableException,
+    trace,
+    info,
+    success,
+    warning,
+    error,
+    fatal,
+    fatal_unavailable,
+)
+from byzantinemomentum_tpu.utils.keyval import parse_keyval  # noqa: F401
+from byzantinemomentum_tpu.utils.misc import (  # noqa: F401
+    import_directory,
+    pairwise,
+    onetime,
+    TimedContext,
+    AccumulatedTimedContext,
+    deltatime_point,
+    deltatime_format,
+)

@@ -1,0 +1,46 @@
+"""The `key:value` plugin-argument mini-language.
+
+Every pluggable component (GAR, attack, model, loss, criterion, init) accepts
+extra arguments as a list of `key:value` strings with automatic
+bool/int/float/str typing — same surface as the reference
+(`tools/misc.py:175-235`, applied at `attack.py:244-248`).
+"""
+
+__all__ = ["parse_keyval"]
+
+
+def _auto_type(value):
+    low = value.lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        pass
+    return value
+
+
+def parse_keyval(entries):
+    """Parse a list of `key:value` strings into a dict with auto-typed values.
+
+    Args:
+      entries: iterable of strings, each `key:value`; a bare `key` maps to True.
+    Returns:
+      dict of parsed entries.
+    """
+    parsed = {}
+    if entries is None:
+        return parsed
+    for entry in entries:
+        if ":" in entry:
+            key, value = entry.split(":", 1)
+            parsed[key] = _auto_type(value)
+        else:
+            parsed[entry] = True
+    return parsed

@@ -1,0 +1,117 @@
+"""Colored, nested, thread-aware contextual logging.
+
+Same capability as the reference's `tools.Context` / `tools.trace..fatal`
+helpers (reference `tools/__init__.py:34-216`), without globally wrapping
+`sys.stdout`/`sys.stderr`: log lines are emitted explicitly, which plays
+nicer with JAX's own logging and with pytest capture.
+"""
+
+import sys
+import threading
+
+__all__ = [
+    "Context",
+    "UserException",
+    "UnavailableException",
+    "trace",
+    "info",
+    "success",
+    "warning",
+    "error",
+    "fatal",
+    "fatal_unavailable",
+]
+
+
+class UserException(RuntimeError):
+    """An error caused by invalid user input, printed without a traceback."""
+
+
+class UnavailableException(UserException):
+    """An unknown name was requested from a registry."""
+
+    def __init__(self, registry, name, what="entry"):
+        avail = ", ".join(repr(k) for k in sorted(registry))
+        super().__init__(f"Unknown {what} {name!r}, expected one of: {avail}")
+
+
+_COLORS = {
+    "trace": "\033[90m",
+    "info": "\033[0m",
+    "success": "\033[32m",
+    "warning": "\033[33m",
+    "error": "\033[31m",
+    "header": "\033[1;34m",
+}
+_RESET = "\033[0m"
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+class Context:
+    """Nested `[name]` logging scope, rendered as a prefix on emitted lines."""
+
+    def __init__(self, name, level="info"):
+        self.name = name
+        self.level = level
+
+    def __enter__(self):
+        _stack().append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def _emit(level, *args, file=None):
+    file = file if file is not None else (sys.stderr if level in ("warning", "error") else sys.stdout)
+    use_color = hasattr(file, "isatty") and file.isatty()
+    prefix = "".join(f"[{name}] " for name in _stack())
+    thread = threading.current_thread()
+    if thread is not threading.main_thread():
+        prefix = f"[{thread.name}] " + prefix
+    text = " ".join(str(a) for a in args)
+    if use_color:
+        print(f"{_COLORS.get(level, '')}{prefix}{text}{_RESET}", file=file, flush=True)
+    else:
+        print(f"{prefix}{text}", file=file, flush=True)
+
+
+def trace(*args):
+    _emit("trace", *args)
+
+
+def info(*args):
+    _emit("info", *args)
+
+
+def success(*args):
+    _emit("success", *args)
+
+
+def warning(*args):
+    _emit("warning", *args)
+
+
+def error(*args):
+    _emit("error", *args)
+
+
+def fatal(*args):
+    """Print an error and raise a UserException (reference exits the process;
+    raising keeps the framework usable as a library)."""
+    _emit("error", *args)
+    raise UserException(" ".join(str(a) for a in args))
+
+
+def fatal_unavailable(registry, name, what="entry"):
+    """Raise for an unknown registry name, listing the valid ones
+    (reference `tools/misc.py:35-75`)."""
+    raise UnavailableException(registry, name, what=what)

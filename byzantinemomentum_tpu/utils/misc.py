@@ -1,0 +1,133 @@
+"""Misc helpers: plugin directory auto-import, pair generation, one-time
+latches and wall-clock timing scopes.
+
+Parity targets in the reference: `tools/__init__.py:251-305`
+(`import_directory`), `tools/misc.py:259-343` (`onetime`, `TimedContext`),
+`tools/misc.py:519-529` (`pairwise`), `tools/pytorch.py:130-194`
+(`AccumulatedTimedContext`).
+"""
+
+import importlib
+import pathlib
+import threading
+import time
+
+from byzantinemomentum_tpu.utils import logging as _log
+
+__all__ = [
+    "import_directory",
+    "pairwise",
+    "onetime",
+    "TimedContext",
+    "AccumulatedTimedContext",
+    "deltatime_point",
+    "deltatime_format",
+]
+
+
+def import_directory(package, path):
+    """Import every python module in a directory, making plugin modules
+    self-register (the loader behind the GAR/attack/model/dataset registries,
+    reference `tools/__init__.py:280-305`).
+
+    Args:
+      package: fully qualified package name the modules belong to.
+      path: directory to scan (str or Path).
+    """
+    path = pathlib.Path(path)
+    for child in sorted(path.iterdir()):
+        if child.name.startswith("_") or not child.name.endswith(".py"):
+            continue
+        importlib.import_module(f"{package}.{child.stem}")
+
+
+def pairwise(data):
+    """Generate the pairs (data[i], data[j]) with i < j
+    (reference `tools/misc.py:519-529`)."""
+    n = len(data)
+    for i in range(n - 1):
+        for j in range(i + 1, n):
+            yield (data[i], data[j])
+
+
+def onetime(callback):
+    """Thread-safe one-time latch: returns (trigger, is_triggered) where
+    `trigger()` runs `callback` at most once (reference `tools/misc.py:259-302`
+    — used for graceful SIGINT/SIGTERM exit)."""
+    lock = threading.Lock()
+    state = {"done": False}
+
+    def trigger(*args, **kwargs):
+        with lock:
+            if state["done"]:
+                return
+            state["done"] = True
+        if callback is not None:
+            callback(*args, **kwargs)
+
+    def is_triggered():
+        with lock:
+            return state["done"]
+
+    return trigger, is_triggered
+
+
+def deltatime_point():
+    """Monotonic time point for interval measurement."""
+    return time.monotonic()
+
+
+def deltatime_format(seconds):
+    """Format a duration in seconds as `H:MM:SS.mmm`."""
+    sign = "-" if seconds < 0 else ""
+    seconds = abs(seconds)
+    hours, rem = divmod(seconds, 3600)
+    minutes, secs = divmod(rem, 60)
+    return f"{sign}{int(hours)}:{int(minutes):02d}:{secs:06.3f}"
+
+
+class TimedContext:
+    """Wall-clock scope printing elapsed time on exit
+    (reference `tools/misc.py:307-343`)."""
+
+    def __init__(self, label="elapsed"):
+        self._label = label
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        _log.trace(f"{self._label}: {deltatime_format(time.monotonic() - self._start)}")
+        return False
+
+
+class AccumulatedTimedContext:
+    """Re-enterable timing scope accumulating total elapsed time across
+    entries; `sync` calls a supplied barrier (e.g. `jax.block_until_ready`
+    on a sentinel) before each start/stop for honest device timing
+    (reference `tools/pytorch.py:130-194` used `torch.cuda.synchronize`)."""
+
+    def __init__(self, label="total", sync=None):
+        self._label = label
+        self._sync = sync
+        self._total = 0.0
+
+    def __enter__(self):
+        if self._sync is not None:
+            self._sync()
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sync is not None:
+            self._sync()
+        self._total += time.monotonic() - self._start
+        return False
+
+    @property
+    def total(self):
+        return self._total
+
+    def __str__(self):
+        return f"{self._label}: {deltatime_format(self._total)}"

@@ -1,0 +1,20 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding tests run without TPU hardware (the driver validates the
+real-TPU path separately via `__graft_entry__.dryrun_multichip`).
+
+Note: this environment's sitecustomize force-registers the `axon` TPU
+platform and overrides JAX_PLATFORMS, so the env var alone is not enough —
+`jax.config.update('jax_platforms', 'cpu')` after import is what actually
+keeps backend init off the (possibly absent) TPU tunnel.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

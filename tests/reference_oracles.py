@@ -1,0 +1,176 @@
+"""PyTorch-CPU oracles for differential tests.
+
+These re-state the *behavior* of the reference's aggregation rules and
+attacks (reference `/root/reference/aggregators/`, `/root/reference/attacks/`)
+in independent torch code, used only as test fixtures: the framework's jnp
+kernels must agree with them on identical inputs (within f32 tolerance).
+
+The single deliberate divergence: "median" here means the sort-based lower
+median with NaN-last ordering (the semantics the reference documents and its
+original CUDA runtime provided), because modern torch-CPU `median` propagates
+NaN — see `byzantinemomentum_tpu/ops/_common.py`.
+"""
+
+import itertools
+import math
+
+import torch
+
+
+def lower_median(stack):
+    n = stack.shape[0]
+    return stack.sort(dim=0).values[(n - 1) // 2]
+
+
+def pairwise_dist_matrix(stack):
+    n = stack.shape[0]
+    dist = torch.full((n, n), math.inf, dtype=stack.dtype)
+    for i in range(n):
+        for j in range(i + 1, n):
+            val = (stack[i] - stack[j]).norm().item()
+            if not math.isfinite(val):
+                val = math.inf
+            dist[i, j] = dist[j, i] = val
+    return dist
+
+
+def gar_average(stack, f=None):
+    return stack.mean(dim=0)
+
+
+def gar_median(stack, f=None):
+    return lower_median(stack)
+
+
+def gar_trmean(stack, f):
+    n = stack.shape[0]
+    return stack.sort(dim=0).values[f:n - f].mean(dim=0)
+
+
+def _closest_mean(stack, center, m):
+    dev = (stack - center).abs()
+    idx = dev.argsort(dim=0, stable=True)[:m]
+    return stack.gather(0, idx).mean(dim=0)
+
+
+def gar_phocas(stack, f):
+    return _closest_mean(stack, gar_trmean(stack, f), stack.shape[0] - f)
+
+
+def gar_meamed(stack, f):
+    return _closest_mean(stack, lower_median(stack), stack.shape[0] - f)
+
+
+def krum_scores(stack, f):
+    n = stack.shape[0]
+    dist = pairwise_dist_matrix(stack)
+    scores = []
+    for i in range(n):
+        row = sorted(dist[i, j].item() for j in range(n) if j != i)
+        scores.append(sum(row[:n - f - 1]))
+    return scores
+
+
+def gar_krum(stack, f, m=None):
+    n = stack.shape[0]
+    if m is None:
+        m = n - f - 2
+    scores = krum_scores(stack, f)
+    order = sorted(range(n), key=lambda i: scores[i])
+    return stack[order[:m]].mean(dim=0)
+
+
+def gar_bulyan(stack, f, m=None):
+    n = stack.shape[0]
+    m_max = n - f - 2
+    if m is None:
+        m = m_max
+    dist = pairwise_dist_matrix(stack)
+    # Bulyan scores: sum of the m smallest neighbor distances per row
+    # (self-distance is +inf so it never enters for m <= n-1).
+    scores = []
+    for i in range(n):
+        row = sorted(dist[i, j].item() for j in range(n))
+        scores.append(sum(row[:m]))
+    scores = list(scores)
+    rounds = n - 2 * f - 2
+    selected = torch.empty((rounds, stack.shape[1]), dtype=stack.dtype)
+    for i in range(rounds):
+        m_i = min(m, m_max - i)
+        order = sorted(range(n), key=lambda g: scores[g])
+        selected[i] = stack[order[:m_i]].mean(dim=0)
+        scores[order[0]] = math.inf  # effective reference pruning (dead update)
+    m2 = rounds - 2 * f
+    return _closest_mean(selected, lower_median(selected), m2)
+
+
+def gar_aksel(stack, f, mode="mid"):
+    n = stack.shape[0]
+    med = lower_median(stack)
+    sqd = []
+    for i in range(n):
+        val = (stack[i] - med).pow(2).sum().item()
+        sqd.append(val if math.isfinite(val) else math.inf)
+    c = (n + 1) // 2 if mode == "mid" else n - f
+    order = sorted(range(n), key=lambda i: sqd[i])
+    return stack[order[:c]].mean(dim=0)
+
+
+def gar_cge(stack, f):
+    n = stack.shape[0]
+    norms = []
+    for i in range(n):
+        val = stack[i].norm().item()
+        norms.append(val if math.isfinite(val) else math.inf)
+    order = sorted(range(n), key=lambda i: norms[i])
+    return stack[order[:n - f]].mean(dim=0)
+
+
+def gar_brute(stack, f):
+    n = stack.shape[0]
+    dist = pairwise_dist_matrix(stack)
+    best_set, best_diam = None, None
+    for combo in itertools.combinations(range(n), n - f):
+        diam = 0.0
+        ok = True
+        for x, y in itertools.combinations(combo, 2):
+            val = dist[x, y].item()
+            if not math.isfinite(val):
+                ok = False
+                break
+            diam = max(diam, val)
+        if ok and (best_set is None or diam < best_diam):
+            best_set, best_diam = combo, diam
+    return stack[list(best_set)].mean(dim=0)
+
+
+def line_maximize(scape, evals=16, start=0.0, delta=1.0, ratio=0.8):
+    """Reference search schedule (reference `tools/misc.py:468-514`)."""
+    best_x = start
+    best_y = scape(best_x)
+    evals -= 1
+    prop_x = best_x
+    while evals > 0:
+        prop_x = best_x + delta
+        prop_y = scape(prop_x)
+        evals -= 1
+        if prop_y > best_y:
+            best_x, best_y = prop_x, prop_y
+            delta *= 2
+        else:
+            delta *= ratio
+            break
+    while evals > 0:
+        if prop_x < best_x:
+            prop_x += delta
+        else:
+            x = prop_x - delta
+            while x < 0:
+                x = (x + prop_x) / 2
+            prop_x = x
+        prop_y = scape(prop_x)
+        evals -= 1
+        if prop_y > best_y:
+            best_x, best_y = prop_x, prop_y
+        delta *= ratio
+    return best_x

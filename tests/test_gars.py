@@ -1,0 +1,168 @@
+"""Unit + differential tests for the GAR kernels.
+
+Strategy (SURVEY.md §4): hand-computable small cases, NaN fault injection,
+convex-hull properties, and differential tests against independent
+PyTorch-CPU oracles on random matrices.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import ops
+
+from . import reference_oracles as oracle
+
+RNG = np.random.default_rng(42)
+
+
+def rand_grads(n, d, nan_rows=0):
+    g = RNG.normal(size=(n, d)).astype(np.float32)
+    for i in range(nan_rows):
+        g[n - 1 - i] = np.nan
+    return g
+
+
+ORACLES = {
+    "average": (oracle.gar_average, {}),
+    "median": (oracle.gar_median, {}),
+    "native-median": (oracle.gar_median, {}),
+    "trmean": (oracle.gar_trmean, {"f": True}),
+    "phocas": (oracle.gar_phocas, {"f": True}),
+    "meamed": (oracle.gar_meamed, {"f": True}),
+    "krum": (oracle.gar_krum, {"f": True}),
+    "native-krum": (oracle.gar_krum, {"f": True}),
+    "bulyan": (oracle.gar_bulyan, {"f": True}),
+    "native-bulyan": (oracle.gar_bulyan, {"f": True}),
+    "aksel": (oracle.gar_aksel, {"f": True}),
+    "cge": (oracle.gar_cge, {"f": True}),
+    "brute": (oracle.gar_brute, {"f": True}),
+    "native-brute": (oracle.gar_brute, {"f": True}),
+}
+
+
+def test_registry_complete():
+    """Every reference GAR (SURVEY.md §2.1) is registered, plus the four
+    native fast tiers (reference §2.9)."""
+    expected = {"average", "median", "trmean", "phocas", "meamed", "krum",
+                "bulyan", "aksel", "cge", "brute",
+                "native-median", "native-krum", "native-bulyan", "native-brute"}
+    assert expected <= set(ops.gars)
+
+
+@pytest.mark.parametrize("name", sorted(ORACLES))
+@pytest.mark.parametrize("n,f,d", [(11, 2, 13), (15, 3, 7), (25, 5, 4)])
+def test_differential_vs_torch(name, n, f, d):
+    fn, kw = ORACLES[name]
+    g = rand_grads(n, d)
+    kwargs = {"f": f} if kw.get("f") else {}
+    got = np.asarray(ops.gars[name](jnp.asarray(g), **kwargs))
+    want = fn(torch.from_numpy(g.copy()), **kwargs).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("name", ["median", "trmean", "phocas", "meamed",
+                                  "krum", "bulyan", "aksel", "cge", "brute"])
+def test_nan_resilience(name):
+    """With f NaN rows, the aggregate must stay finite (the reference's core
+    robustness claim; `nan` attack doubles as fault injection)."""
+    n, f, d = 11, 2, 9
+    g = rand_grads(n, d, nan_rows=f)
+    out = np.asarray(ops.gars[name](jnp.asarray(g), f=f))
+    assert np.isfinite(out).all(), f"{name} leaked NaN"
+
+
+@pytest.mark.parametrize("name", ["median", "trmean", "phocas", "meamed",
+                                  "krum", "bulyan", "aksel", "cge", "brute"])
+def test_nan_differential(name):
+    fn, kw = ORACLES[name]
+    n, f, d = (15, 3, 6) if name == "bulyan" else (13, 3, 6)  # bulyan needs n >= 4f+3
+    g = rand_grads(n, d, nan_rows=f)
+    got = np.asarray(ops.gars[name](jnp.asarray(g), f=f))
+    want = fn(torch.from_numpy(g.copy()), f=f).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_median_hand_values():
+    g = jnp.asarray(np.array([[1., 5.], [3., 1.], [2., 9.]], dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(ops.gars["median"](g)), [2., 5.])
+    # Even n -> lower median
+    g4 = jnp.asarray(np.array([[1.], [4.], [2.], [3.]], dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(ops.gars["median"](g4)), [2.])
+
+
+def test_trmean_hand_values():
+    g = jnp.asarray(np.array([[0.], [1.], [2.], [3.], [100.]], dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(ops.gars["trmean"](g, f=1)), [2.])
+
+
+def test_krum_rejects_outlier():
+    """An extreme outlier must never be selected."""
+    n, f, d = 9, 2, 5
+    g = rand_grads(n, d)
+    g[-1] = 1e6
+    sel = np.asarray(__import__("byzantinemomentum_tpu.ops.krum", fromlist=["selection"]).selection(jnp.asarray(g), f))
+    assert n - 1 not in sel
+
+
+def test_convex_hull_coordinate_rules():
+    """Coordinate-wise rules stay within per-coordinate honest min/max when
+    all inputs are honest."""
+    g = rand_grads(9, 6)
+    arr = jnp.asarray(g)
+    for name in ("median", "trmean", "phocas", "meamed"):
+        kwargs = {} if name == "median" else {"f": 2}
+        out = np.asarray(ops.gars[name](arr, **kwargs))
+        assert (out >= g.min(axis=0) - 1e-6).all() and (out <= g.max(axis=0) + 1e-6).all(), name
+
+
+def test_checked_contract_errors():
+    g = jnp.zeros((4, 3))
+    with pytest.raises(Exception):
+        ops.gars["krum"].checked(g, f=1)  # needs n >= 2f+3 = 5
+    with pytest.raises(Exception):
+        ops.gars["bulyan"].checked(g, f=1)  # needs n >= 4f+3 = 7
+    with pytest.raises(Exception):
+        ops.gars["trmean"].checked(g, f=2)  # needs n >= 2f+1 = 5
+
+
+def test_upper_bounds_match_reference_formulas():
+    import math
+    n, f, d = 25, 5, 1000
+    assert ops.gars["median"].upper_bound(n, f, d) == pytest.approx(1 / math.sqrt(n - f))
+    assert ops.gars["brute"].upper_bound(n, f, d) == pytest.approx((n - f) / (math.sqrt(8) * f))
+    krum_ub = 1 / math.sqrt(2 * (n - f + f * (n + f * (n - f - 2) - 2) / (n - 2 * f - 2)))
+    assert ops.gars["krum"].upper_bound(n, f, d) == pytest.approx(krum_ub)
+    assert ops.gars["bulyan"].upper_bound(n, f, d) == pytest.approx(krum_ub)
+
+
+def test_influence_range_and_zero_for_honest_only():
+    n, f = 11, 2
+    honests = jnp.asarray(rand_grads(n - f, 5))
+    byz = jnp.asarray(np.full((f, 5), 1e6, dtype=np.float32))
+    for name in ("average", "krum", "aksel", "cge", "brute"):
+        gar = ops.gars[name]
+        assert gar.influence is not None, name
+        ratio = float(gar.influence(honests, byz, f=f))
+        assert 0.0 <= ratio <= 1.0, name
+        if name != "average":
+            # A huge-norm outlier should be rejected by the robust rules
+            assert ratio == 0.0, name
+
+
+def test_distance_methods_agree():
+    from byzantinemomentum_tpu.ops._common import pairwise_distances
+    g = jnp.asarray(rand_grads(12, 33))
+    d_dot = np.asarray(pairwise_distances(g, method="dot"))
+    d_diff = np.asarray(pairwise_distances(g, method="diff"))
+    off = ~np.eye(12, dtype=bool)
+    np.testing.assert_allclose(d_dot[off], d_diff[off], rtol=1e-4, atol=1e-5)
+
+
+def test_gar_list_input_compat():
+    """GARs also accept the reference-style list-of-flat-gradients input."""
+    rows = [np.float32(r) for r in rand_grads(5, 3)]
+    out = ops.gars["average"]([jnp.asarray(r) for r in rows])
+    np.testing.assert_allclose(np.asarray(out), np.stack(rows).mean(axis=0), rtol=1e-6)
