@@ -1,0 +1,11 @@
+#!/usr/bin/env python3
+"""Experiment driver entry point — `python3 attack.py [flags]`, same surface
+as the reference's `attack.py` (smoke test by convention: run with no flags,
+reference `README.md:148-149`)."""
+
+import sys
+
+from byzantinemomentum_tpu.cli.attack import main
+
+if __name__ == "__main__":
+    sys.exit(main())

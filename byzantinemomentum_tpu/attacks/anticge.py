@@ -29,7 +29,11 @@ def attack(grad_honests, f_decl, f_real, **kwargs):
     # Strictly below the (maxpos)-th smallest norm (reference uses
     # math.nextafter toward 0, `anticge.py:66-69`).
     maxnorm = jnp.nextafter(norms[order[maxpos]], jnp.float32(0))
-    vec = jnp.sum(grad_honests[order[:maxpos]], axis=0)
+    # Reference quirk preserved: the accumulator starts as a CLONE of the
+    # smallest-norm gradient and the sum loop then adds it AGAIN
+    # (reference `anticge.py:71-73`), so the direction is
+    # 2*g(0) + g(1) + ... + g(maxpos-1).
+    vec = grad_honests[order[0]] + jnp.sum(grad_honests[order[:maxpos]], axis=0)
     attnorm = jnp.sqrt(jnp.sum(vec * vec))
     scale = jnp.where(attnorm > 0, -maxnorm / attnorm, 1.0)
     byz_grad = vec * scale

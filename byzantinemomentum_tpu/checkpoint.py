@@ -1,0 +1,85 @@
+"""Checkpoint/restore of the full training state.
+
+Redesign of reference `experiments/checkpoint.py:30-169` + the load/init
+logic of `attack.py:621-682`: instead of a collection of torch `state_dict`s
+keyed by class name, a checkpoint here is one msgpack file holding the whole
+`TrainState` pytree — params, momentum buffer(s), origin, past-gradient
+ring, counters AND the PRNG key. Checkpointing the PRNG key fixes the
+reference's documented limitation that resumed runs are not reproducible
+(reference `README.md:105`, `attack.py:297-300`).
+
+Validation parity on load (reference `attack.py:629-667`): version match,
+non-negative counters, momentum buffer shape/count checks.
+"""
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.engine.state import TrainState
+
+__all__ = ["VERSION", "save", "load"]
+
+# Must be unique and incremented on every incompatible layout change
+# (reference `attack.py:622` — the reference is at version 4; this framework
+# numbers its own lineage).
+VERSION = 1
+
+
+def save(path, state):
+    """Serialize `state` to `path` (reference `Checkpoint.save`,
+    `experiments/checkpoint.py:134-148`)."""
+    state = jax.device_get(state)
+    payload = {"version": VERSION, "state": dict(state._asdict())}
+    data = serialization.msgpack_serialize(payload)
+    path = pathlib.Path(path)
+    path.write_bytes(data)
+    return path
+
+
+def load(path, template):
+    """Deserialize a checkpoint against a template `TrainState` (shapes are
+    taken from the template, values from the file), with the reference's
+    validation (reference `attack.py:624-667`)."""
+    raw = serialization.msgpack_restore(pathlib.Path(path).read_bytes())
+    version = raw.get("version")
+    if version != VERSION:
+        raise utils.UserException(
+            f"Unable to load checkpoint {str(path)!r}: expected version "
+            f"{VERSION!r}, got {version!r}")
+    stored = raw.get("state")
+    if not isinstance(stored, dict):
+        raise utils.UserException(
+            f"Unable to load checkpoint {str(path)!r}: missing state payload")
+
+    out = {}
+    for name, ref in template._asdict().items():
+        if name not in stored:
+            raise utils.UserException(
+                f"Unable to load checkpoint {str(path)!r}: missing field {name!r}")
+        value = stored[name]
+        if name == "net_state":
+            value = serialization.from_state_dict(ref, value)
+        else:
+            value = jnp.asarray(value)
+            ref_arr = jnp.asarray(ref)
+            if value.shape != ref_arr.shape:
+                raise utils.UserException(
+                    f"Unable to load checkpoint {str(path)!r}: field {name!r} "
+                    f"has shape {tuple(value.shape)}, expected "
+                    f"{tuple(ref_arr.shape)}")
+            if name in ("steps", "datapoints") and int(value) < 0:
+                raise utils.UserException(
+                    f"Unable to load checkpoint {str(path)!r}: invalid "
+                    f"{name} counter {int(value)!r}")
+            if name == "rng":
+                # PRNG keys may round-trip as uint32 arrays
+                value = value.astype(np.uint32)
+            elif ref_arr.dtype != value.dtype:
+                value = value.astype(ref_arr.dtype)
+        out[name] = value
+    return TrainState(**out)

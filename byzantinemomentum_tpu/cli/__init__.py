@@ -1,0 +1,1 @@
+"""Command-line entry points (the reference's L4/L5 scripts as a package)."""

@@ -1,0 +1,504 @@
+"""The experiment driver — flag-for-flag parity with the reference's
+`attack.py` (reference `attack.py:51-240` for the CLI surface).
+
+Division of labor (redesigned for TPU): the whole per-step computation is one
+jitted XLA program (`engine/step.py`); this driver only parses flags, samples
+host batches, runs milestones (eval / checkpoint / user input), formats the
+`eval` and 25-column `study` CSVs (byte-compatible with the reference's
+`study.Session` parser, reference `study.py:216-229`) and handles graceful
+SIGINT/SIGTERM (reference `attack.py:41-45`).
+"""
+
+import argparse
+import code
+import json
+import math
+import os
+import pathlib
+import signal
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byzantinemomentum_tpu import attacks as attacks_mod
+from byzantinemomentum_tpu import checkpoint as checkpoint_mod
+from byzantinemomentum_tpu import data as data_mod
+from byzantinemomentum_tpu import losses as losses_mod
+from byzantinemomentum_tpu import models as models_mod
+from byzantinemomentum_tpu import ops as ops_mod
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.engine import EngineConfig, STUDY_COLUMNS, build_engine
+from byzantinemomentum_tpu.models.core import apply_named_init
+
+__all__ = ["process_commandline", "main"]
+
+
+def process_commandline(argv=None):
+    """Parse the command line (reference `attack.py:51-240`; same flags)."""
+    parser = argparse.ArgumentParser(
+        prog="attack", formatter_class=argparse.RawTextHelpFormatter)
+    add = parser.add_argument
+    add("--seed", type=int, default=-1,
+        help="Fixed seed for reproducibility, negative for random seed")
+    add("--device", type=str, default="auto",
+        help="JAX device/platform to run on ('auto', 'tpu', 'cpu', ...)")
+    add("--device-gar", type=str, default="same",
+        help="Device on which to run the GAR, 'same' for no change (on TPU "
+             "the GAR fuses into the training program; this seam is kept "
+             "for config parity)")
+    add("--nb-steps", type=int, default=-1,
+        help="Number of (additional) training steps, negative for no limit")
+    add("--nb-workers", type=int, default=11, help="Total number of workers")
+    add("--nb-for-study", type=int, default=11,
+        help="Gradients to compute for study purposes only")
+    add("--nb-for-study-past", type=int, default=20,
+        help="Past gradients kept for the curvature metric")
+    add("--nb-decl-byz", type=int, default=4,
+        help="Number of declared Byzantine workers")
+    add("--nb-real-byz", type=int, default=0,
+        help="Number of actually Byzantine workers")
+    add("--init-multi", type=str, default=None,
+        help="Multi-dimensional parameter init algorithm")
+    add("--init-multi-args", nargs="*",
+        help="key:value args for --init-multi")
+    add("--init-mono", type=str, default=None,
+        help="Mono-dimensional parameter init algorithm")
+    add("--init-mono-args", nargs="*",
+        help="key:value args for --init-mono")
+    add("--gar", type=str, default="average", help="Aggregation rule")
+    add("--gar-args", nargs="*", help="key:value args for the GAR")
+    add("--gars", type=str, default=None,
+        help="Random per-step GAR mixture: 'name[,freq[,json-args]];...'")
+    add("--attack", type=str, default="nan", help="Attack to use")
+    add("--attack-args", nargs="*", help="key:value args for the attack")
+    add("--model", type=str, default="simples-conv", help="Model to train")
+    add("--model-args", nargs="*", help="key:value args for the model")
+    add("--loss", type=str, default="nll", help="Loss to use")
+    add("--loss-args", nargs="*", help="key:value args for the loss")
+    add("--criterion", type=str, default="top-k", help="Criterion to use")
+    add("--criterion-args", nargs="*", help="key:value args for the criterion")
+    add("--dataset", type=str, default="mnist", help="Dataset to use")
+    add("--batch-size", type=int, default=25, help="Training batch size")
+    add("--batch-size-test", type=int, default=100, help="Test batch size")
+    add("--batch-size-test-reps", type=int, default=100,
+        help="Number of test batches per evaluation")
+    add("--no-transform", action="store_true", default=False,
+        help="Disable dataset transformations (normalization, flips)")
+    add("--learning-rate", type=float, default=0.01, help="Learning rate")
+    add("--learning-rate-decay", type=int, default=5000,
+        help="Hyperbolic half-decay time, non-positive for no decay")
+    add("--learning-rate-decay-delta", type=int, default=1,
+        help="Steps between two learning-rate updates")
+    add("--learning-rate-schedule", type=str, default=None,
+        help="Piecewise schedule '<init lr>[,<from step>,<new lr>]*'")
+    add("--momentum", type=float, default=0.9, help="Momentum")
+    add("--dampening", type=float, default=0., help="Dampening")
+    add("--momentum-nesterov", action="store_true", default=False,
+        help="Nesterov lookahead variant")
+    add("--momentum-at", type=str, default="update",
+        help="Momentum placement: 'update', 'server' or 'worker'")
+    add("--weight-decay", type=float, default=0., help="Weight decay")
+    add("--l1-regularize", type=float, default=None,
+        help="L1 loss regularization factor")
+    add("--l2-regularize", type=float, default=None,
+        help="L2 loss regularization factor")
+    add("--gradient-clip", type=float, default=None,
+        help="Per-gradient L2 clip threshold")
+    add("--nb-local-steps", type=int, default=1,
+        help="Local SGD steps per global step (implemented here; the "
+             "reference advertises but disables it)")
+    add("--load-checkpoint", type=str, default=None,
+        help="Checkpoint to resume from")
+    add("--result-directory", type=str, default=None,
+        help="Directory for results (eval/study CSVs, checkpoints)")
+    add("--evaluation-delta", type=int, default=100,
+        help="Steps between evaluations, 0 for none")
+    add("--checkpoint-delta", type=int, default=0,
+        help="Steps between checkpoints, 0 for none")
+    add("--user-input-delta", type=int, default=0,
+        help="Steps between interactive prompts, 0 for none")
+    return parser.parse_args(sys.argv[1:] if argv is None else argv)
+
+
+def _postprocess(args):
+    """Derivations and checks (reference `attack.py:242-313`)."""
+    for name in ("init_multi", "init_mono", "gar", "attack", "model", "loss",
+                 "criterion"):
+        name = f"{name}_args"
+        keyval = getattr(args, name)
+        setattr(args, name, utils.parse_keyval(keyval))
+    args.nb_honests = args.nb_workers - args.nb_real_byz
+    if args.nb_honests < 0:
+        utils.fatal(f"Invalid arguments: there are more real Byzantine "
+                    f"workers ({args.nb_real_byz}) than total workers "
+                    f"({args.nb_workers})")
+    if args.nb_decl_byz > args.nb_workers:
+        utils.fatal(f"Invalid arguments: there are more declared Byzantine "
+                    f"workers ({args.nb_decl_byz}) than total workers "
+                    f"({args.nb_workers})")
+    # Learning rate plan (reference `attack.py:253-289`)
+    if args.learning_rate_schedule is None:
+        if args.learning_rate <= 0:
+            utils.fatal(f"Invalid arguments: non-positive learning rate "
+                        f"{args.learning_rate}")
+        if args.learning_rate_decay_delta <= 0:
+            utils.fatal(f"Invalid arguments: non-positive learning rate "
+                        f"decay delta {args.learning_rate_decay_delta}")
+
+        def compute_new_learning_rate(steps):
+            if (args.learning_rate_decay > 0
+                    and steps % args.learning_rate_decay_delta == 0):
+                return args.learning_rate / (steps / args.learning_rate_decay + 1)
+            return None
+
+        def initial_lr(steps):
+            # lr in effect at loop entry: the value set at the most recent
+            # update boundary (args.learning_rate when no decay — the
+            # reference seeds the optimizer with it, `attack.py:544`)
+            if args.learning_rate_decay <= 0:
+                return args.learning_rate
+            last = steps - steps % args.learning_rate_decay_delta
+            return args.learning_rate / (last / args.learning_rate_decay + 1)
+    else:
+        numbers = args.learning_rate_schedule.split(",")
+        flat = tuple(float(x) if i % 2 == 0 else int(x)
+                     for i, x in enumerate(numbers))
+        schedule = [(0, flat[0])]
+        for i in range(1, len(flat), 2):
+            step, lr = flat[i], flat[i + 1]
+            if step <= schedule[-1][0]:
+                utils.fatal("Invalid arguments: learning rate schedule step "
+                            "numbers must be strictly increasing")
+            schedule.append((step, lr))
+
+        def compute_new_learning_rate(steps):
+            for step, lr in schedule:
+                if steps == step:
+                    return lr
+            return None
+
+        def initial_lr(steps):
+            current = schedule[0][1]
+            for step, lr in schedule:
+                if step <= steps:
+                    current = lr
+            return current
+    args.compute_new_learning_rate = compute_new_learning_rate
+    args.initial_lr = initial_lr
+    if args.momentum_at not in ("update", "server", "worker"):
+        utils.fatal_unavailable(("update", "server", "worker"),
+                                args.momentum_at, what="momentum position")
+    if args.nb_local_steps < 1:
+        utils.fatal(f"Invalid arguments: non-positive number of local steps "
+                    f"{args.nb_local_steps}")
+    if args.seed >= 0 and args.load_checkpoint is not None:
+        utils.warning("Unable to enforce reproducibility when a checkpoint "
+                      "is loaded; ignoring seed")
+        args.seed = -1
+    # Study coercions (reference `attack.py:301-313`)
+    if args.result_directory is None:
+        args.nb_for_study = 0
+        args.nb_for_study_past = 0
+    else:
+        if args.nb_for_study_past < 1:
+            utils.warning("At least one gradient must exist in the past to "
+                          "study honest curvature; set '--nb-for-study-past 1'")
+            args.nb_for_study_past = 1
+        elif math.isclose(args.momentum, 0.0) and args.nb_for_study_past > 1:
+            utils.warning("Momentum is (almost) zero; set "
+                          "'--nb-for-study-past 1'")
+            args.nb_for_study_past = 1
+    return args
+
+
+def _parse_gars(spec):
+    """Parse the `--gars 'name,freq,json;...'` mixture string into
+    `[(gar, cumulative_freq, kwargs)]` (reference `attack.py:467-517`)."""
+    freq_sum = 0.0
+    defenses = []
+    for info in spec.split(";"):
+        info = info.split(",", maxsplit=2)
+        name = info[0].strip()
+        freq = 1.0
+        if len(info) >= 2:
+            raw = info[1].strip()
+            freq = 1.0 if raw == "-" else float(raw)
+        conf = {}
+        if len(info) >= 3:
+            try:
+                conf = json.loads(info[2].strip())
+            except json.decoder.JSONDecodeError as err:
+                utils.fatal(f"Invalid GAR arguments for GAR {name!r}: "
+                            f"{str(err).lower()}")
+            if not isinstance(conf, dict):
+                utils.fatal(f"Invalid GAR arguments for GAR {name!r}: "
+                            f"expected a dictionary")
+        if name not in ops_mod.gars:
+            utils.fatal_unavailable(ops_mod.gars, name, what="aggregation rule")
+        freq_sum += freq
+        defenses.append((ops_mod.gars[name], freq_sum, conf))
+    return defenses
+
+
+def _config_text(args):
+    """Human-readable run configuration (simplified tree rendering of the
+    reference's `cmd_make_tree`, `attack.py:314-397`)."""
+    lines = ["Configuration:"]
+    for name in sorted(vars(args)):
+        if name.startswith("_") or callable(getattr(args, name)):
+            continue
+        lines.append(f"  · {name} - {getattr(args, name)}")
+    return os.linesep.join(lines)
+
+
+class _ResultFiles:
+    """`result_make`/`result_get`/`result_store` parity
+    (reference `attack.py:403-448`): '# '-prefixed tab-separated header,
+    rows prefixed with the line separator (no trailing newline)."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        self._fds = {}
+
+    def make(self, name, *fields):
+        if self.directory is None:
+            raise RuntimeError("No result is to be output")
+        if name in self._fds:
+            raise KeyError(f"Name {name!r} is already bound to a result file")
+        fd = (self.directory / name).open("w")
+        fd.write("# " + "\t".join(str(field) for field in fields))
+        fd.flush()
+        self._fds[name] = fd
+
+    def get(self, name):
+        if self.directory is None:
+            return None
+        return self._fds.get(name)
+
+    def store(self, fd, *entries):
+        fd.write(os.linesep + "\t".join(str(entry) for entry in entries))
+        fd.flush()
+
+    def close(self):
+        for fd in self._fds.values():
+            fd.close()
+
+
+def main(argv=None):
+    """Run one experiment (the reference's whole `attack.py` flow)."""
+    # Graceful exit latch (reference `attack.py:41-45`)
+    exit_trigger, exit_is_requested = utils.onetime(None)
+    try:
+        signal.signal(signal.SIGINT, lambda *_: exit_trigger())
+        signal.signal(signal.SIGTERM, lambda *_: exit_trigger())
+    except ValueError:
+        pass  # Not in the main thread
+
+    with utils.Context("cmdline", "info"):
+        args = _postprocess(process_commandline(argv))
+
+    with utils.Context("setup", "info"):
+        # Device selection: 'auto' = JAX default platform
+        if args.device.lower() not in ("auto", ""):
+            jax.config.update("jax_platforms", args.device.lower())
+        if args.device_gar.lower() != "same":
+            utils.warning(
+                "'--device-gar' is kept for config parity only: on TPU the "
+                "GAR fuses into the training program (no device hop)")
+        # Seeding (reference `attack.py:453-459`; JAX PRNG is explicit)
+        reproducible = args.seed >= 0
+        seed = args.seed if reproducible else int.from_bytes(os.urandom(4), "little")
+        np.random.seed(seed % 2**32)
+        root_key = jax.random.PRNGKey(seed)
+
+        # Defense(s)
+        if args.gars is None:
+            if args.gar not in ops_mod.gars:
+                utils.fatal_unavailable(ops_mod.gars, args.gar,
+                                        what="aggregation rule")
+            defenses = [(ops_mod.gars[args.gar], 1.0, args.gar_args)]
+        else:
+            defenses = _parse_gars(args.gars)
+            args.gar_args = {}
+        # Attack
+        if args.attack not in attacks_mod.attacks:
+            utils.fatal_unavailable(attacks_mod.attacks, args.attack,
+                                    what="attack")
+        attack = attacks_mod.attacks[args.attack]
+        # Model
+        model_def = models_mod.build(args.model, **args.model_args)
+        # Datasets
+        trainset, testset = data_mod.make_datasets(
+            args.dataset, args.batch_size, args.batch_size_test,
+            no_transform=args.no_transform, seed=seed % 2**32)
+        # Losses (reference `attack.py:534-541`)
+        loss = losses_mod.Loss(args.loss, **args.loss_args)
+        if args.l1_regularize is not None:
+            loss = loss + args.l1_regularize * losses_mod.Loss("l1")
+        if args.l2_regularize is not None:
+            loss = loss + args.l2_regularize * losses_mod.Loss("l2")
+        criterion = losses_mod.Criterion(args.criterion, **args.criterion_args)
+
+        # Engine
+        cfg = EngineConfig(
+            nb_workers=args.nb_workers, nb_decl_byz=args.nb_decl_byz,
+            nb_real_byz=args.nb_real_byz,
+            nb_for_study=(args.nb_for_study if args.result_directory else 0),
+            nb_for_study_past=max(args.nb_for_study_past, 1),
+            momentum=args.momentum, dampening=args.dampening,
+            nesterov=args.momentum_nesterov, momentum_at=args.momentum_at,
+            weight_decay=args.weight_decay, gradient_clip=args.gradient_clip,
+            nb_local_steps=args.nb_local_steps)
+        engine = build_engine(
+            cfg=cfg, model_def=model_def, loss=loss, criterion=criterion,
+            defenses=defenses, attack=attack, attack_kwargs=args.attack_args)
+
+        # One-time contract validation (the reference validates on every call
+        # through the 'checked' wrappers, `aggregators/__init__.py:52-61`;
+        # with a single compiled program, validating once at setup is the
+        # equivalent guarantee)
+        dummy = jnp.zeros((args.nb_workers, 2), jnp.float32)
+        for gar, _, kwargs in defenses:
+            message = gar.check(gradients=dummy, f=args.nb_decl_byz, **kwargs)
+            if message is not None:
+                utils.fatal(f"Aggregation rule {gar.name!r} cannot be used: "
+                            f"{message}")
+        message = attack.check(
+            grad_honests=jnp.zeros((args.nb_honests, 2), jnp.float32),
+            f_decl=args.nb_decl_byz, f_real=args.nb_real_byz,
+            defense=lambda **kw: None, **args.attack_args)
+        if message is not None:
+            utils.fatal(f"Attack {attack.name!r} cannot be used: {message}")
+
+        # Result directory (reference `attack.py:549-591`)
+        results = None
+        if args.result_directory is not None:
+            resdir = pathlib.Path(args.result_directory).resolve()
+            try:
+                resdir.mkdir(mode=0o755, parents=True, exist_ok=True)
+            except Exception as err:
+                utils.warning(f"Unable to create the result directory "
+                              f"{str(resdir)!r} ({err}); no result stored")
+                args.result_directory = None
+                args.checkpoint_delta = 0
+            else:
+                args.result_directory = resdir
+                results = _ResultFiles(resdir)
+                if args.evaluation_delta > 0:
+                    results.make("eval", "Step number", "Cross-accuracy")
+                if args.nb_for_study > 0:
+                    results.make("study", *STUDY_COLUMNS)
+                (resdir / "config").write_text(_config_text(args) + os.linesep)
+                with (resdir / "config.json").open("w") as fd:
+                    def jsonable(x):
+                        return x if type(x) in (str, int, float, bool,
+                                                type(None), dict, list) else str(x)
+                    json.dump({k: jsonable(v) for k, v in vars(args).items()
+                               if not k.startswith("_")
+                               and not callable(getattr(args, k))},
+                              fd, ensure_ascii=False, indent="\t")
+        elif args.checkpoint_delta != 0:
+            args.checkpoint_delta = 0
+            utils.warning("Argument '--checkpoint-delta' ignored as no "
+                          "'--result-directory' was specified")
+
+    # Load/initialize state (reference `attack.py:621-682`)
+    with utils.Context("load", "info"):
+        params, net_state = model_def.init(root_key)
+        if args.init_multi or args.init_mono:
+            params = apply_named_init(
+                params, jax.random.fold_in(root_key, 2),
+                init_multi=args.init_multi,
+                init_multi_args=args.init_multi_args,
+                init_mono=args.init_mono, init_mono_args=args.init_mono_args)
+        state = engine.init(root_key, params=params, net_state=net_state)
+        if args.load_checkpoint is not None:
+            try:
+                state = checkpoint_mod.load(args.load_checkpoint, state)
+            except utils.UserException:
+                raise
+            except Exception as err:
+                utils.fatal(f"Unable to load checkpoint "
+                            f"{args.load_checkpoint!r}: {err}")
+
+    # Training (reference `attack.py:685-885`)
+    with utils.Context("training", "info"):
+        steps_limit = (None if args.nb_steps < 0
+                       else int(state.steps) + args.nb_steps)
+        fd_eval = results.get("eval") if results else None
+        fd_study = results.get("study") if results else None
+        current_lr = args.initial_lr(int(state.steps))
+        float_format = "%.8e"  # f32 precision (reference `attack.py:870`)
+        just_loaded = args.load_checkpoint is not None
+
+        while not exit_is_requested():
+            steps = int(state.steps)
+            milestone_evaluation = (args.evaluation_delta > 0
+                                    and steps % args.evaluation_delta == 0)
+            milestone_checkpoint = (args.checkpoint_delta > 0
+                                    and steps % args.checkpoint_delta == 0)
+            milestone_user_input = (args.user_input_delta > 0
+                                    and steps % args.user_input_delta == 0)
+            if milestone_evaluation:
+                correct = 0.0
+                count = 0.0
+                for _ in range(args.batch_size_test_reps):
+                    x, y = testset.sample()
+                    res = engine.eval_step(state.theta, state.net_state,
+                                           jnp.asarray(x), jnp.asarray(y))
+                    correct += float(res[0])
+                    count += float(res[1])
+                acc = correct / count
+                utils.info(f"Accuracy (step {steps}): {acc * 100.:.2f}%")
+                if fd_eval is not None:
+                    results.store(fd_eval, steps, acc)
+            if milestone_checkpoint and not just_loaded:
+                filename = args.result_directory / f"checkpoint-{steps}"
+                try:
+                    checkpoint_mod.save(filename, state)
+                except Exception as err:
+                    utils.warning(f"Checkpoint save failed: {err}")
+            just_loaded = False
+            if milestone_user_input:
+                code.interact(banner=f"Interactive prompt (step {steps}); "
+                              "Ctrl-D to resume", local={"state": state,
+                                                         "engine": engine})
+            if steps_limit is not None and steps >= steps_limit:
+                break
+            new_lr = args.compute_new_learning_rate(steps)
+            if new_lr is not None:
+                current_lr = new_lr
+            # Sample the per-worker batches (host dataloader boundary,
+            # reference `experiments/dataset.py:208-218`)
+            S = cfg.nb_sampled
+            k = cfg.nb_local_steps
+            need = S * k
+            xs, ys = zip(*(trainset.sample() for _ in range(need)))
+            xs = np.stack(xs)
+            ys = np.stack(ys)
+            if k > 1:
+                xs = xs.reshape((S, k) + xs.shape[1:])
+                ys = ys.reshape((S, k) + ys.shape[1:])
+            # 'Training point count' is the value at loop entry, BEFORE this
+            # step's increment (reference `attack.py:696, 844`)
+            datapoints = int(state.datapoints)
+            state, metrics = engine.train_step(
+                state, jnp.asarray(xs), jnp.asarray(ys),
+                jnp.float32(current_lr))
+            if fd_study is not None:
+                metrics = jax.device_get(metrics)
+                row = [steps, datapoints]
+                for column in STUDY_COLUMNS[2:-1]:
+                    row.append(float_format % float(metrics[column]))
+                row.append(float(metrics["Attack acceptation ratio"]))
+                results.store(fd_study, *row)
+
+        if results is not None:
+            results.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

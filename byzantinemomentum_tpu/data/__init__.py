@@ -1,0 +1,204 @@
+"""Dataset registry and host-side input pipeline.
+
+TPU-native redesign of the reference's `experiments/dataset.py`: instead of
+wrapping torchvision `DataLoader`s into infinite generators (reference
+`dataset.py:100-132`, `:248-268`), a dataset here is a pair of in-memory
+numpy arrays plus a batch sampler that yields **fixed-shape** `(B, ...)`
+batches forever. Fixed shapes matter on TPU: a varying trailing batch would
+retrigger XLA compilation every epoch, so the train sampler wraps the epoch
+boundary by completing the last batch from the next shuffle (the same scheme
+the reference itself uses for tensor-level datasets, `dataset.py:315-328`)
+instead of emitting a short batch.
+
+Transforms follow the reference's defaults (`dataset.py:32-49`): MNIST
+normalization (0.1307, 0.3081); CIFAR normalization (0.4914, 0.4822, 0.4465)
+/ (0.2023, 0.1994, 0.2010) + random horizontal flip; FashionMNIST random
+horizontal flip. Note the reference applies the *same* transform list to the
+test set (flips included) — that quirk is preserved.
+
+Raw data is loaded from disk when present (see `sources.py` for search paths
+and the pure-numpy idx/pickle parsers); otherwise a deterministic synthetic
+fallback with the same shapes and cardinalities is generated, so the whole
+framework runs hermetically (this environment has no network egress and no
+torchvision).
+"""
+
+import numpy as np
+
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.data import sources
+
+__all__ = [
+    "datasets", "register", "Dataset", "make_datasets", "batch_dataset",
+    "normalizations", "flip_train",
+]
+
+# Registry: name -> loader() -> dict with keys
+#   train_x, train_y, test_x, test_y  (numpy; images uint8 HWC, labels int)
+datasets = {}
+
+# Per-dataset normalization constants: name -> (mean, std) over channels,
+# applied after scaling to [0, 1] (reference `dataset.py:32-49`).
+normalizations = {
+    "mnist": ((0.1307,), (0.3081,)),
+    "cifar10": ((0.4914, 0.4822, 0.4465), (0.2023, 0.1994, 0.2010)),
+    "cifar100": ((0.4914, 0.4822, 0.4465), (0.2023, 0.1994, 0.2010)),
+}
+
+# Datasets whose default transform includes a random horizontal flip
+# (reference `dataset.py:32-41`; applied to train AND test there).
+flip_train = {"fashionmnist", "cifar10", "cifar100"}
+
+
+def register(name, loader):
+    """Register a dataset loader under `name`
+    (reference `experiments/dataset.py:100-163` plugin discovery)."""
+    if name in datasets:
+        utils.warning(f"Dataset {name!r} registered twice; keeping the last")
+    datasets[name] = loader
+    return loader
+
+
+class Dataset:
+    """An infinite, fixed-shape batch sampler over an in-memory split.
+
+    Mirrors the reference `Dataset.sample()` contract
+    (`experiments/dataset.py:208-218`): every call yields one `(inputs,
+    labels)` batch; the train flavor shuffles per epoch, the test flavor
+    cycles in order (reference `make_datasets`, `dataset.py:296-299`).
+    """
+
+    def __init__(self, inputs, labels, batch_size, *, train, transform,
+                 seed=0, name="dataset"):
+        if len(inputs) < 1 or len(inputs) != len(labels):
+            raise utils.UserException(
+                f"Invalid dataset {name!r}: {len(inputs)} inputs vs {len(labels)} labels")
+        self.name = name
+        self._inputs = inputs
+        self._labels = labels
+        self._batch = min(batch_size or len(inputs), len(inputs))
+        self._train = train
+        self._transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._cursor = 0
+        self._order = None
+        if train:
+            self._order = self._rng.permutation(len(inputs))
+
+    def __len__(self):
+        return len(self._inputs)
+
+    @property
+    def batch_size(self):
+        return self._batch
+
+    def sample(self):
+        """Return the next `(inputs f32[B, ...], labels[B])` batch."""
+        n = len(self._inputs)
+        end = self._cursor + self._batch
+        if self._train:
+            if end >= n:
+                # Epoch boundary: complete the batch from a fresh shuffle
+                # (>= so the permutation regenerates even when the batch
+                # size divides the dataset size exactly)
+                select = self._order[self._cursor:]
+                self._order = self._rng.permutation(n)
+                extra = end - n
+                if extra:
+                    select = np.concatenate([select, self._order[:extra]])
+            else:
+                select = self._order[self._cursor:end]
+        else:
+            if end > n:
+                select = np.concatenate(
+                    [np.arange(self._cursor, n), np.arange(end % n)])
+            else:
+                select = np.arange(self._cursor, end)
+        self._cursor = end % n
+        x = self._inputs[select]
+        y = self._labels[select]
+        if self._transform is not None:
+            x = self._transform(x, self._rng)
+        return x, y
+
+    # Generator protocol compatibility (the reference exposes datasets as
+    # infinite iterables too, `dataset.py:220-243`)
+    def __iter__(self):
+        while True:
+            yield self.sample()
+
+
+def _image_transform(name, no_transform):
+    """Build the default per-batch transform for an image dataset: uint8 HWC
+    -> float32 in [0,1], then normalization and (optionally) random
+    horizontal flips (reference `dataset.py:32-63`)."""
+    norm = normalizations.get(name)
+    flip = (name in flip_train) and not no_transform
+
+    def transform(batch, rng):
+        x = batch.astype(np.float32) / 255.0
+        if flip:
+            mask = rng.random(len(x)) < 0.5
+            x[mask] = x[mask, :, ::-1, :]
+        if norm is not None and not no_transform:
+            mean = np.asarray(norm[0], np.float32)
+            std = np.asarray(norm[1], np.float32)
+            x = (x - mean) / std
+        return x
+
+    return transform
+
+
+def make_datasets(dataset, train_batch=None, test_batch=None, *,
+                  no_transform=False, seed=0, **custom_args):
+    """Build the (trainset, testset) pair for a registered dataset name
+    (reference `experiments/dataset.py:270-301`).
+
+    `no_transform` maps the reference's `--no-transform` (raw ToTensor only,
+    reference `attack.py:527-530`): scaling to [0,1] without normalization or
+    flips.
+    """
+    if dataset not in datasets:
+        utils.fatal_unavailable(datasets, dataset, what="dataset name")
+    raw = datasets[dataset](**custom_args)
+    if raw.get("kind", "image") == "image":
+        transform = _image_transform(dataset, no_transform)
+    else:
+        transform = None
+    trainset = Dataset(raw["train_x"], raw["train_y"], train_batch,
+                       train=True, transform=transform, seed=seed,
+                       name=dataset)
+    testset = Dataset(raw["test_x"], raw["test_y"], test_batch,
+                      train=False, transform=transform, seed=seed + 1,
+                      name=dataset)
+    return trainset, testset
+
+
+def batch_dataset(inputs, labels, *, train=False, batch_size=None,
+                  split=0.75, seed=0, name="custom"):
+    """Split a raw tensor dataset and wrap one side in a sampler
+    (reference `experiments/dataset.py:303-354`): `split < 1` is the train
+    fraction, `split >= 1` the number of train samples."""
+    n = len(inputs)
+    if n < 1 or len(labels) != n:
+        raise utils.UserException(
+            f"Invalid or different input/output lengths: {len(inputs)} vs {len(labels)}")
+    split_pos = min(max(1, int(n * split)) if split < 1 else int(split), n - 1)
+    if train:
+        return Dataset(inputs[:split_pos], labels[:split_pos], batch_size,
+                       train=True, transform=None, seed=seed, name=name)
+    return Dataset(inputs[split_pos:], labels[split_pos:], batch_size,
+                   train=False, transform=None, seed=seed, name=name)
+
+
+# --------------------------------------------------------------------------- #
+# Built-in datasets (reference: torchvision's MNIST/FashionMNIST/CIFAR
+# wrapped at `dataset.py:100-132`; LIBSVM phishing at
+# `experiments/datasets/svm.py`)
+
+register("mnist", lambda **kw: sources.load_mnist("mnist", **kw))
+register("fashionmnist", lambda **kw: sources.load_mnist("fashionmnist", **kw))
+register("cifar10", lambda **kw: sources.load_cifar(10, **kw))
+register("cifar100", lambda **kw: sources.load_cifar(100, **kw))
+
+from byzantinemomentum_tpu.data import svm as _svm  # noqa: E402  (self-registers "phishing")

@@ -1,0 +1,64 @@
+"""LIBSVM `phishing` dataset (reference `experiments/datasets/svm.py`).
+
+68 dense features parsed from the LIBSVM sparse text format, labels in
+{0, 1} shaped (N, 1) float32, split 8400/rest into train/test (reference
+`svm.py:126` — 8400 chosen for divisibility). Loads `phishing` /
+`phishing.txt` from the data dirs (no network egress here, so no download
+path — the reference's `download=True` URL fetch is replaced by disk
+discovery); falls back to a deterministic synthetic linearly-separable-ish
+binary problem with identical shapes.
+"""
+
+import os
+
+import numpy as np
+
+from byzantinemomentum_tpu import data as _data
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.data import sources
+
+__all__ = ["load_phishing"]
+
+FEATURES = 68
+SPLIT = 8400
+TOTAL = 11055  # cardinality of the published LIBSVM phishing dataset
+
+
+def _parse_libsvm(path):
+    text = path.read_text().strip().split("\n")
+    inputs = np.zeros((len(text), FEATURES), np.float32)
+    labels = np.empty((len(text), 1), np.float32)
+    for index, entry in enumerate(text):
+        parts = entry.split()
+        labels[index, 0] = 1.0 if parts[0] == "1" else 0.0
+        for setter in parts[1:]:
+            offset, value = setter.split(":")
+            inputs[index, int(offset) - 1] = float(value)
+    return inputs, labels
+
+
+def _synthetic_phishing():
+    total = int(os.environ.get("BMT_SYNTH_TRAIN", TOTAL))
+    rng = np.random.default_rng(0x5F15)
+    w = rng.normal(size=(FEATURES,)).astype(np.float32)
+    inputs = rng.random((total, FEATURES), dtype=np.float32)
+    logits = (inputs - 0.5) @ w + rng.normal(0, 0.5, total).astype(np.float32)
+    labels = (logits > 0).astype(np.float32)[:, None]
+    return inputs, labels
+
+
+def load_phishing(**unused):
+    path = sources._find("phishing", "phishing.txt", "phishing.libsvm")
+    if path is not None:
+        inputs, labels = _parse_libsvm(path)
+    else:
+        utils.trace("phishing: raw file not found on disk; using the "
+                    "deterministic synthetic fallback")
+        inputs, labels = _synthetic_phishing()
+    split = min(SPLIT, len(inputs) - 1)
+    return {"train_x": inputs[:split], "train_y": labels[:split],
+            "test_x": inputs[split:], "test_y": labels[split:],
+            "kind": "raw"}
+
+
+_data.register("phishing", load_phishing)

@@ -1,0 +1,19 @@
+"""Training engine: jitted per-step program + host-side state management.
+
+This is the TPU-native redesign of the reference's 200-line training loop
+(reference `attack.py:685-885`): the whole per-step computation — vmapped
+honest gradients, clipping, momentum placement, attack synthesis, robust
+aggregation, model update and the 25-column study metrics — compiles into a
+single XLA program `train_step(state, xs, ys, lr) -> (state, metrics)`. The
+host loop (see `cli/driver.py`) only samples batches, formats CSV rows and
+handles milestones (eval/checkpoint/SIGINT), mirroring the reference's
+division of labor with the device.
+"""
+
+from byzantinemomentum_tpu.engine.config import EngineConfig
+from byzantinemomentum_tpu.engine.state import TrainState
+from byzantinemomentum_tpu.engine.step import Engine, build_engine
+from byzantinemomentum_tpu.engine.metrics import STUDY_COLUMNS
+
+__all__ = ["EngineConfig", "TrainState", "Engine", "build_engine",
+           "STUDY_COLUMNS"]

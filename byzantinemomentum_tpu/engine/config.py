@@ -1,0 +1,55 @@
+"""Static engine configuration — everything that shapes the compiled step.
+
+All fields are trace-time constants: changing any of them rebuilds the XLA
+program (the learning rate is deliberately NOT here — it is a runtime scalar
+so the reference's per-step lr schedules don't retrigger compilation).
+"""
+
+import dataclasses
+
+__all__ = ["EngineConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Mirror of the reference's derived argument set
+    (reference `attack.py:242-313`)."""
+
+    nb_workers: int = 11          # --nb-workers
+    nb_decl_byz: int = 4          # --nb-decl-byz (f declared)
+    nb_real_byz: int = 0          # --nb-real-byz (f actually attacking)
+    nb_for_study: int = 0         # --nb-for-study (0 = study disabled)
+    nb_for_study_past: int = 1    # --nb-for-study-past (past-gradient ring)
+    momentum: float = 0.9         # --momentum (mu)
+    dampening: float = 0.0        # --dampening (lambda)
+    nesterov: bool = False        # --momentum-nesterov
+    momentum_at: str = "update"   # --momentum-at in {update, server, worker}
+    weight_decay: float = 0.0     # --weight-decay (applied in the update)
+    gradient_clip: float = None   # --gradient-clip (per-sampled-grad L2 cap)
+    nb_local_steps: int = 1       # --nb-local-steps (multi-local-step SGD)
+
+    def __post_init__(self):
+        if self.momentum_at not in ("update", "server", "worker"):
+            raise ValueError(f"Invalid momentum placement {self.momentum_at!r}")
+        if self.nb_real_byz > self.nb_workers:
+            raise ValueError(
+                f"More real Byzantine workers ({self.nb_real_byz}) than total "
+                f"workers ({self.nb_workers})")
+        if self.nb_local_steps < 1:
+            raise ValueError(
+                f"Non-positive number of local steps {self.nb_local_steps}")
+
+    @property
+    def nb_honests(self):
+        """Honest worker count = n - f_real (reference `attack.py:250`)."""
+        return self.nb_workers - self.nb_real_byz
+
+    @property
+    def nb_sampled(self):
+        """Gradients computed per step = max(honests, study extras)
+        (reference `attack.py:764`)."""
+        return max(self.nb_honests, self.nb_for_study)
+
+    @property
+    def study(self):
+        return self.nb_for_study > 0

@@ -1,0 +1,135 @@
+"""In-graph study metrics — the 25-column per-step diagnostic pipeline.
+
+Reference: the `study` CSV schema (`attack.py:564-571`), the per-step
+computation (`attack.py:842-878`) and the `compute_avg_dev_max` helper
+(`tools/pytorch.py:97-125`). Formula parity notes:
+
+* "norm" columns are the norm OF the class average (not the average of
+  norms), and cosines are normalized by those average-norms — a deliberate
+  reference quirk preserved here.
+* "deviation" is the SAMPLE standard deviation (n-1 denominator) of the
+  per-gradient L2 deviations from the class average; NaN for < 2 gradients.
+* The composite curvature is `mu * sum_i mu^i <avg_t, past_i>` over the
+  `appendleft`-ordered ring of past sampled averages (`attack.py:861-866`).
+
+Everything is computed inside the jitted step and returned as a flat dict of
+f32 scalars; the host merely formats them (`%.8e`, reference
+`attack.py:869-870`).
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["STUDY_COLUMNS", "avg_dev_max", "cosine", "study_metrics",
+           "push_past"]
+
+# CSV header, byte-identical to the reference's (reference `attack.py:564-571`)
+STUDY_COLUMNS = (
+    "Step number", "Training point count",
+    "Average loss", "l2 from origin",
+    "Sampled gradient deviation", "Honest gradient deviation", "Attack gradient deviation",
+    "Sampled gradient norm", "Honest gradient norm", "Attack gradient norm", "Defense gradient norm",
+    "Sampled max coordinate", "Honest max coordinate", "Attack max coordinate", "Defense max coordinate",
+    "Sampled-honest cosine", "Sampled-attack cosine", "Sampled-defense cosine",
+    "Honest-attack cosine", "Honest-defense cosine", "Attack-defense cosine",
+    "Sampled-prev cosine", "Sampled composite curvature",
+    "Attack acceptation ratio",
+)
+
+_NAN = jnp.float32(jnp.nan)
+
+
+def avg_dev_max(G):
+    """(average gradient, ||avg||, sample std-dev of deviations, max |avg|)
+    over the rows of `G: f32[m, d]` (reference `tools/pytorch.py:97-125`).
+
+    Returns (None, nan, nan, nan) for m == 0 and dev = nan for m == 1,
+    matching the reference's edge cases.
+    """
+    m = G.shape[0]
+    if m == 0:
+        return None, _NAN, _NAN, _NAN
+    avg = jnp.mean(G, axis=0)
+    norm_avg = jnp.sqrt(jnp.sum(avg * avg))
+    norm_max = jnp.max(jnp.abs(avg))
+    if m >= 2:
+        dev = G - avg
+        dev = jnp.sqrt(jnp.sum(dev * dev) / (m - 1))
+    else:
+        dev = _NAN
+    return avg, norm_avg, norm_max, dev
+
+
+def cosine(a, na, b, nb):
+    """dot(a, b) / (na * nb) — the reference's 'cosine of solid angles'
+    normalized by average-norms (reference `attack.py:854-859`)."""
+    if a is None or b is None:
+        return _NAN
+    return jnp.dot(a, b) / na / nb
+
+
+def push_past(past_grads, past_norms, past_count, grad, norm):
+    """`deque.appendleft` on the past-gradient ring
+    (reference `attack.py:868`)."""
+    if past_grads.shape[0] == 0:
+        return past_grads, past_norms, past_count
+    past_grads = jnp.concatenate([grad[None, :], past_grads[:-1]])
+    past_norms = jnp.concatenate([norm[None], past_norms[:-1]])
+    past_count = jnp.minimum(past_count + 1, past_grads.shape[0])
+    return past_grads, past_norms, past_count
+
+
+def study_metrics(*, loss_avg, l2_origin, G_sampled, G_honest, G_attack,
+                  grad_defense, accept_ratio, past_grads, past_norms,
+                  past_count, momentum):
+    """Compute the 17+5 metric values of one step
+    (reference `attack.py:842-866`). Returns (metrics dict, new past ring)."""
+    sampled_avg, sampled_na, sampled_mx, sampled_dev = avg_dev_max(G_sampled)
+    honest_avg, honest_na, honest_mx, honest_dev = avg_dev_max(G_honest)
+    attack_avg, attack_na, attack_mx, attack_dev = avg_dev_max(G_attack)
+    defense_na = jnp.sqrt(jnp.sum(grad_defense * grad_defense))
+    defense_mx = jnp.max(jnp.abs(grad_defense))
+
+    P = past_grads.shape[0]
+    if P > 0:
+        has_past = past_count > 0
+        cosin_sampled = jnp.where(
+            has_past,
+            jnp.dot(sampled_avg, past_grads[0]) / sampled_na / past_norms[0],
+            _NAN)
+        # mu * sum_i mu^i <sampled_avg, past_i> over the valid entries
+        weights = momentum ** jnp.arange(P, dtype=jnp.float32)
+        valid = (jnp.arange(P) < past_count).astype(jnp.float32)
+        dots = past_grads @ sampled_avg
+        curv_sampled = jnp.where(
+            has_past, momentum * jnp.sum(weights * valid * dots), _NAN)
+    else:
+        cosin_sampled = _NAN
+        curv_sampled = _NAN
+
+    metrics = {
+        "Average loss": loss_avg,
+        "l2 from origin": l2_origin,
+        "Sampled gradient deviation": sampled_dev,
+        "Honest gradient deviation": honest_dev,
+        "Attack gradient deviation": attack_dev,
+        "Sampled gradient norm": sampled_na,
+        "Honest gradient norm": honest_na,
+        "Attack gradient norm": attack_na,
+        "Defense gradient norm": defense_na,
+        "Sampled max coordinate": sampled_mx,
+        "Honest max coordinate": honest_mx,
+        "Attack max coordinate": attack_mx,
+        "Defense max coordinate": defense_mx,
+        "Sampled-honest cosine": cosine(sampled_avg, sampled_na, honest_avg, honest_na),
+        "Sampled-attack cosine": cosine(sampled_avg, sampled_na, attack_avg, attack_na),
+        "Sampled-defense cosine": cosine(sampled_avg, sampled_na, grad_defense, defense_na),
+        "Honest-attack cosine": cosine(honest_avg, honest_na, attack_avg, attack_na),
+        "Honest-defense cosine": cosine(honest_avg, honest_na, grad_defense, defense_na),
+        "Attack-defense cosine": cosine(attack_avg, attack_na, grad_defense, defense_na),
+        "Sampled-prev cosine": cosin_sampled,
+        "Sampled composite curvature": curv_sampled,
+        "Attack acceptation ratio": accept_ratio,
+    }
+    new_past = push_past(past_grads, past_norms, past_count,
+                         sampled_avg, sampled_na)
+    return metrics, new_past

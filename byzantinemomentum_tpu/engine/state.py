@@ -1,0 +1,62 @@
+"""Training state pytree.
+
+The reference scatters its mutable state across the torch model, the
+optimizer and a `Storage` dict (reference `attack.py:668-681`); here it is
+one immutable NamedTuple-of-arrays, so a step is a pure function and the
+whole thing checkpoints/donates/shards uniformly.
+
+Parameters live as ONE flat `f32[d]` vector — the TPU-native mirror of the
+reference's relink-into-a-flat-buffer design (reference
+`tools/pytorch.py:30-64`, `experiments/model.py:170`): all momentum algebra,
+GAR kernels and study metrics operate directly on flat vectors, and
+`unravel` (a pytree of cheap reshapes, fused by XLA) recovers the structured
+parameters only inside the model's forward pass.
+"""
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TrainState"]
+
+
+class TrainState(typing.NamedTuple):
+    """One step's complete input/output state."""
+
+    theta: jax.Array             # f32[d] flat parameters
+    net_state: typing.Any        # model state pytree (BatchNorm running stats)
+    momentum_server: jax.Array   # f32[d] (zeros when placement is 'worker')
+    momentum_workers: jax.Array  # f32[h, d] (shape (0, d) unless 'worker')
+    origin: jax.Array            # f32[d] initial params (zeros if no study)
+    past_grads: jax.Array        # f32[P, d] ring of past sampled averages
+    past_norms: jax.Array        # f32[P] their norms ('appendleft' order)
+    past_count: jax.Array        # i32[] number of valid past entries
+    steps: jax.Array             # i32[] step counter
+    datapoints: jax.Array        # i32[] training point counter
+    rng: jax.Array               # PRNG key (checkpointed — fixes the
+    #                              reference's resume nondeterminism,
+    #                              reference README.md:105)
+
+
+def init_state(cfg, theta, net_state, rng, *, study):
+    """Fresh-run initialization (reference `attack.py:668-681`)."""
+    d = theta.shape[0]
+    h = cfg.nb_honests
+    past = cfg.nb_for_study_past if study else 0
+    return TrainState(
+        theta=theta,
+        net_state=net_state,
+        momentum_server=jnp.zeros((d,), theta.dtype),
+        momentum_workers=jnp.zeros(
+            (h if cfg.momentum_at == "worker" else 0, d), theta.dtype),
+        # A distinct buffer from theta: the state pytree is donated to the
+        # jitted step, and XLA rejects donating one buffer twice.
+        origin=jnp.array(theta, copy=True) if study else jnp.zeros((0,), theta.dtype),
+        past_grads=jnp.zeros((past, d), theta.dtype),
+        past_norms=jnp.zeros((past,), theta.dtype),
+        past_count=jnp.int32(0),
+        steps=jnp.int32(0),
+        datapoints=jnp.int32(0),
+        rng=rng,
+    )

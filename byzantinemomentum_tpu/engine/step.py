@@ -1,0 +1,321 @@
+"""The jitted training step and its builder.
+
+One call to `Engine.train_step(state, xs, ys, lr)` performs everything the
+reference does per iteration of its hot loop (reference `attack.py:752-882`):
+
+  honest phase  — `jax.vmap` of the per-worker loss/gradient over the worker
+                  axis (the reference's sequential backprops,
+                  `attack.py:786-795`), with the Nesterov parameter lookahead
+                  variant (`attack.py:757-783`);
+  clipping      — per-sampled-gradient L2 cap (`attack.py:776-779, 791-794`);
+  momentum      — one of the three placements (`attack.py:799-810, 832-839`);
+  attack        — Byzantine row synthesis, with adaptive line searches
+                  against the inlined defense (`attack.py:818`);
+  defense       — the GAR kernel over the stacked (n, d) matrix
+                  (`attack.py:821`);
+  update        — SGD with weight decay (`attack.py:832-839`,
+                  torch-SGD semantics from `attack.py:543-544`);
+  metrics       — the 25-column study pipeline, in-graph
+                  (`attack.py:842-878`).
+
+Multi-local-step SGD (`--nb-local-steps > 1`) is implemented (via
+`lax.scan` over local steps), unlike the reference where it is advertised
+but hard-disabled (`attack.py:796-798`).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.engine import metrics as metrics_mod
+from byzantinemomentum_tpu.engine.state import TrainState, init_state
+from byzantinemomentum_tpu.models import flatten_params
+from byzantinemomentum_tpu.models.core import BN_MOMENTUM
+
+__all__ = ["Engine", "build_engine"]
+
+
+def _clip_rows(G, clip):
+    """Per-row L2 clip: row *= clip/||row|| iff ||row|| > clip
+    (reference `attack.py:776-779`)."""
+    if clip is None:
+        return G
+    norms = jnp.sqrt(jnp.sum(G * G, axis=1, keepdims=True))
+    scale = jnp.where(norms > clip, clip / norms, 1.0)
+    return G * scale
+
+
+def compose_bn_updates(net_state0, per_worker_states, count):
+    """Sequential-equivalent composition of per-worker BatchNorm running-stat
+    updates.
+
+    The reference runs workers sequentially through one module, so running
+    stats fold as r_k = (1-m) r_{k-1} + m s_k over the k-th worker's batch
+    stats (reference `experiments/model.py:246-248`, `models/empire.py:36-47`).
+    Under vmap every worker computed r0-based updates `new_i = (1-m) r0 +
+    m s_i` instead; inverting for s_i and refolding yields the exact
+    sequential result:  r_S = (1-m)^S r0 + m * sum_i (1-m)^(S-1-i) s_i.
+    """
+    if not jax.tree.leaves(net_state0):
+        return net_state0
+    m = BN_MOMENTUM
+    decay = (1.0 - m) ** count
+    weights = (1.0 - m) ** jnp.arange(count - 1, -1, -1, dtype=jnp.float32)
+
+    def fold(r0, new_stack):
+        s = (new_stack - (1.0 - m) * r0) / m  # per-worker batch stats
+        contrib = jnp.tensordot(weights, s, axes=1)
+        return decay * r0 + m * contrib
+
+    return jax.tree.map(fold, net_state0, per_worker_states)
+
+
+class Engine:
+    """Compiled training/eval programs for one experiment configuration."""
+
+    def __init__(self, cfg, model_def, loss, criterion, defenses, attack,
+                 attack_kwargs):
+        """Use `build_engine` — this constructor wires the already-resolved
+        pieces.
+
+        Args:
+          cfg: `EngineConfig`.
+          model_def: `models.ModelDef`.
+          loss: callable `(output, target, theta) -> scalar`.
+          criterion: callable `(output, target) -> f32[2]`.
+          defenses: list of `(gar, freq_cum, kwargs)` — one entry for a
+            single `--gar`, several for a `--gars` random mixture
+            (reference `attack.py:467-517`).
+          attack: `attacks.Attack` (or None when f_real == 0 paths are
+            exercised with the `nan` default).
+          attack_kwargs: plugin args for the attack.
+        """
+        self.cfg = cfg
+        self.model_def = model_def
+        self.loss = loss
+        self.criterion = criterion
+        self.defenses = defenses
+        self.attack = attack
+        self.attack_kwargs = dict(attack_kwargs or {})
+
+        params, net_state = model_def.init(jax.random.PRNGKey(0))
+        theta0, unravel = flatten_params(params)
+        self.d = theta0.shape[0]
+        self.unravel = unravel
+        self._net_state0 = net_state
+
+        self.train_step = jax.jit(self._train_step, donate_argnums=(0,))
+        self.eval_step = jax.jit(self._eval_step)
+
+    # ----------------------------------------------------------------- #
+    # Initialization
+
+    def init(self, key, params=None, net_state=None, *, study=None):
+        """Build a fresh `TrainState` (reference `attack.py:668-681`)."""
+        study = self.cfg.study if study is None else study
+        if params is None:
+            params, net_state = self.model_def.init(key)
+        theta, _ = flatten_params(params)
+        return init_state(self.cfg, theta, net_state,
+                          jax.random.fold_in(key, 1), study=study)
+
+    # ----------------------------------------------------------------- #
+    # Per-worker gradient
+
+    def _worker_grad(self, theta, net_state, x, y, rng):
+        def scalar_loss(th):
+            params = self.unravel(th)
+            out, new_state = self.model_def.apply(
+                params, net_state, x, train=True, rng=rng)
+            return self.loss(out, y, th), new_state
+        (loss_val, new_state), grad = jax.value_and_grad(
+            scalar_loss, has_aux=True)(theta)
+        return loss_val, grad, new_state
+
+    def _local_steps(self, theta, net_state, xs, ys, rng, lr):
+        """`k` local SGD steps; the submitted gradient is the accumulated
+        parameter displacement divided by the learning rate — the standard
+        local-SGD pseudo-gradient (capability the reference gates off,
+        `attack.py:796-798`). `xs: f32[k, B, ...]`."""
+        rngs = jax.random.split(rng, xs.shape[0])
+        def body(carry, inputs):
+            th, st = carry
+            x, y, r = inputs
+            loss_val, grad, new_st = self._worker_grad(th, st, x, y, r)
+            return (th - lr * grad, new_st), loss_val
+        (theta_end, state_end), losses = lax.scan(
+            body, (theta, net_state), (xs, ys, rngs))
+        grad = (theta - theta_end) / lr
+        return losses[0], grad, state_end
+
+    # ----------------------------------------------------------------- #
+    # Defense dispatch (single GAR or per-step random mixture)
+
+    def _run_defense(self, G, mix_u):
+        cfg = self.cfg
+        if len(self.defenses) == 1:
+            gar, _, kwargs = self.defenses[0]
+            return gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs)
+        branches = [
+            (lambda G, gar=gar, kwargs=kwargs:
+             gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs))
+            for gar, _, kwargs in self.defenses
+        ]
+        return lax.switch(self._mixture_index(mix_u), branches, G)
+
+    def _mixture_index(self, mix_u):
+        cum = jnp.asarray([fc for _, fc, _ in self.defenses], jnp.float32)
+        return jnp.searchsorted(cum, mix_u * cum[-1], side="right").astype(
+            jnp.int32).clip(0, len(self.defenses) - 1)
+
+    def _run_influence(self, G_honest, G_attack, mix_u):
+        cfg = self.cfg
+        nan = jnp.float32(jnp.nan)
+
+        def one(gar, kwargs):
+            if gar.influence is None:
+                return nan
+            return jnp.float32(gar.influence(
+                G_honest, G_attack, f=cfg.nb_decl_byz, **kwargs))
+
+        if len(self.defenses) == 1:
+            gar, _, kwargs = self.defenses[0]
+            return one(gar, kwargs)
+        idx = self._mixture_index(mix_u)
+        return lax.switch(
+            idx,
+            [lambda g=gar, k=kwargs: one(g, k) for gar, _, kwargs in self.defenses])
+
+    # ----------------------------------------------------------------- #
+    # The step
+
+    def _train_step(self, state: TrainState, xs, ys, lr):
+        """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
+        cfg = self.cfg
+        S, h = cfg.nb_sampled, cfg.nb_honests
+        mu, damp = cfg.momentum, cfg.dampening
+
+        rng, mix_key, *wkeys = jax.random.split(state.rng, S + 2)
+        wkeys = jnp.stack(wkeys)
+        mix_u = jax.random.uniform(mix_key)
+
+        # --- honest phase (vmapped; reference `attack.py:752-795`) --- #
+        if cfg.nesterov:
+            if cfg.momentum_at == "worker":
+                # Per-worker lookahead theta - mu*lr*m_i; study extras beyond
+                # the h buffers use zero lookahead (the reference would index
+                # out of bounds in that configuration, `attack.py:766-767`).
+                pad = jnp.zeros((S - h, self.d), state.theta.dtype)
+                buffers = jnp.concatenate([state.momentum_workers, pad])
+                theta_eff = state.theta[None, :] - (mu * lr) * buffers
+                theta_axis = 0
+            else:
+                theta_eff = state.theta - (mu * lr) * state.momentum_server
+                theta_axis = None
+        else:
+            theta_eff = state.theta
+            theta_axis = None
+
+        if cfg.nb_local_steps == 1:
+            worker = self._worker_grad
+        else:
+            worker = functools.partial(self._local_steps, lr=lr)
+        losses, grads, new_states = jax.vmap(
+            worker, in_axes=(theta_axis, None, 0, 0, 0))(
+                theta_eff, state.net_state, xs, ys, wkeys)
+
+        G_sampled = _clip_rows(grads, cfg.gradient_clip)
+        loss_avg = jnp.mean(losses)
+        net_state = compose_bn_updates(state.net_state, new_states, S)
+
+        # --- momentum placement on honest rows (`attack.py:799-810`) --- #
+        if cfg.momentum_at == "worker":
+            new_mw = mu * state.momentum_workers + (1.0 - damp) * G_sampled[:h]
+            G_honest = new_mw
+        elif cfg.momentum_at == "server":
+            new_mw = state.momentum_workers
+            G_honest = (1.0 - damp) * G_sampled[:h] + mu * state.momentum_server
+        else:
+            new_mw = state.momentum_workers
+            G_honest = G_sampled[:h]
+
+        # --- attack phase (`attack.py:818`) --- #
+        def defense_fn(gradients, f):
+            return self._run_defense(gradients, mix_u)
+
+        if cfg.nb_real_byz > 0:
+            G_attack = self.attack.unchecked(
+                G_honest, f_decl=cfg.nb_decl_byz, f_real=cfg.nb_real_byz,
+                defense=defense_fn, **self.attack_kwargs)
+        else:
+            G_attack = jnp.zeros((0, self.d), G_honest.dtype)
+
+        # --- defense phase (`attack.py:821-822`) --- #
+        G_all = jnp.concatenate([G_honest, G_attack])
+        grad_defense = self._run_defense(G_all, mix_u)
+        accept_ratio = self._run_influence(G_honest, G_attack, mix_u)
+
+        # --- model update (`attack.py:832-839`) --- #
+        if cfg.momentum_at == "worker":
+            new_ms = state.momentum_server
+            update_grad = grad_defense
+        elif cfg.momentum_at == "server":
+            new_ms = grad_defense
+            update_grad = grad_defense
+        else:
+            new_ms = mu * state.momentum_server + (1.0 - damp) * grad_defense
+            update_grad = new_ms
+
+        if cfg.study:
+            l2_origin = jnp.sqrt(
+                jnp.sum((state.theta - state.origin) ** 2))
+        theta = state.theta - lr * (update_grad
+                                    + cfg.weight_decay * state.theta)
+
+        # --- study metrics (`attack.py:842-878`) --- #
+        if cfg.study:
+            metrics, (pg, pn, pc) = metrics_mod.study_metrics(
+                loss_avg=loss_avg, l2_origin=l2_origin,
+                G_sampled=G_sampled, G_honest=G_honest, G_attack=G_attack,
+                grad_defense=grad_defense, accept_ratio=accept_ratio,
+                past_grads=state.past_grads, past_norms=state.past_norms,
+                past_count=state.past_count, momentum=mu)
+        else:
+            metrics = {}
+            pg, pn, pc = state.past_grads, state.past_norms, state.past_count
+
+        new_state = TrainState(
+            theta=theta, net_state=net_state,
+            momentum_server=new_ms, momentum_workers=new_mw,
+            origin=state.origin,
+            past_grads=pg, past_norms=pn, past_count=pc,
+            steps=state.steps + 1,
+            datapoints=state.datapoints
+            + self._batch_of(xs) * h * cfg.nb_local_steps,
+            rng=rng,
+        )
+        return new_state, metrics
+
+    def _batch_of(self, xs):
+        """Per-worker batch size from the stacked input
+        (xs: [S, B, ...] or [S, k, B, ...])."""
+        return xs.shape[2] if self.cfg.nb_local_steps > 1 else xs.shape[1]
+
+    # ----------------------------------------------------------------- #
+    # Evaluation (reference `experiments/model.py:382-396`)
+
+    def _eval_step(self, theta, net_state, x, y):
+        params = self.unravel(theta)
+        out, _ = self.model_def.apply(params, net_state, x, train=False,
+                                      rng=jax.random.PRNGKey(0))
+        return self.criterion(out, y)
+
+
+def build_engine(*, cfg, model_def, loss, criterion, defenses, attack=None,
+                 attack_kwargs=None):
+    """Assemble an `Engine` (the reference's `setup` phase,
+    `attack.py:451-591`, collapsed into one constructor)."""
+    return Engine(cfg, model_def, loss, criterion, defenses, attack,
+                  attack_kwargs)

@@ -1,0 +1,199 @@
+"""Loss and criterion registries.
+
+TPU-native redesign of reference `experiments/loss.py`: a loss is a pure
+traceable function `(output, target, params_flat) -> scalar` (the reference's
+exact signature, `loss.py:154-166`), wrapped in a `Loss` object composable
+with `+` and `*` — used by the driver to add `--l1-regularize` /
+`--l2-regularize` param-norm terms (reference `loss.py:168-207`,
+`attack.py:534-538`).
+
+The reference auto-registers every `torch.nn.modules.loss.*Loss` under its
+lower-cased stripped name (`loss.py:87-109`); here the same names are
+provided by explicit jnp implementations of the ones the experiment grids
+and models actually use, plus the custom `l1`/`l2` param-norm losses
+(`loss.py:31-67`).
+
+A criterion maps `(output, target) -> f32[2] = [#correct, batch]`
+(reference `loss.py:209-310`): built-ins `top-k` and `sigmoid`.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import utils
+
+__all__ = ["Loss", "Criterion", "losses", "criteria", "register_loss",
+           "register_criterion"]
+
+# Registries: name -> builder(**kwargs) -> callable
+losses = {}
+criteria = {}
+
+
+def register_loss(name, builder):
+    if name in losses:
+        utils.warning(f"Loss {name!r} registered twice; keeping the last")
+    losses[name] = builder
+    return builder
+
+
+def register_criterion(name, builder):
+    if name in criteria:
+        utils.warning(f"Criterion {name!r} registered twice; keeping the last")
+    criteria[name] = builder
+    return builder
+
+
+class Loss:
+    """A composable loss: `Loss("nll") + 0.1 * Loss("l2")`
+    (reference `experiments/loss.py:111-207`)."""
+
+    def __init__(self, name_build, *args, **kwargs):
+        if callable(name_build):
+            self._fn = name_build
+            self.name = getattr(name_build, "__name__", "custom")
+        else:
+            if name_build not in losses:
+                utils.fatal_unavailable(losses, name_build, what="loss name")
+            self._fn = losses[name_build](*args, **kwargs)
+            self.name = name_build
+
+    def __call__(self, output, target, params):
+        return self._fn(output, target, params)
+
+    def __add__(self, other):
+        if not isinstance(other, Loss):
+            return NotImplemented
+        a, b = self._fn, other._fn
+        out = Loss(lambda o, t, p: a(o, t, p) + b(o, t, p))
+        out.name = f"{self.name}+{other.name}"
+        return out
+
+    def __mul__(self, factor):
+        fn = self._fn
+        out = Loss(lambda o, t, p: fn(o, t, p) * factor)
+        out.name = f"{factor}*{self.name}"
+        return out
+
+    __rmul__ = __mul__
+
+    def __repr__(self):
+        return f"Loss({self.name!r})"
+
+
+class Criterion:
+    """An evaluation metric returning `[#correct, batch]`
+    (reference `experiments/loss.py:209-310`)."""
+
+    def __init__(self, name, **kwargs):
+        if name not in criteria:
+            utils.fatal_unavailable(criteria, name, what="criterion name")
+        self._fn = criteria[name](**kwargs)
+        self.name = name
+
+    def __call__(self, output, target):
+        return self._fn(output, target)
+
+    def __repr__(self):
+        return f"Criterion({self.name!r})"
+
+
+# --------------------------------------------------------------------------- #
+# Built-in losses
+
+def _nll(**kw):
+    """Negative log-likelihood over log-probability outputs, mean reduction
+    (torch `NLLLoss` semantics — models in `models/simples.py` end with
+    log_softmax, matching the reference's default pairing,
+    `attack.py:134-137`)."""
+    def loss(output, target, params):
+        picked = jnp.take_along_axis(
+            output, target.reshape(-1, 1).astype(jnp.int32), axis=1)
+        return -jnp.mean(picked)
+    return loss
+
+
+def _crossentropy(**kw):
+    """Cross-entropy over raw logits (torch `CrossEntropyLoss`)."""
+    def loss(output, target, params):
+        logp = output - jnp.max(output, axis=1, keepdims=True)
+        logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=1, keepdims=True))
+        picked = jnp.take_along_axis(
+            logp, target.reshape(-1, 1).astype(jnp.int32), axis=1)
+        return -jnp.mean(picked)
+    return loss
+
+
+def _mse(**kw):
+    def loss(output, target, params):
+        return jnp.mean((output - target.reshape(output.shape)) ** 2)
+    return loss
+
+
+def _l1loss(**kw):
+    """Torch `L1Loss` (mean absolute error) — distinct from the `l1`
+    param-norm regularizer below, mirroring the reference where the custom
+    `l1` replaces torch's in the registry (`loss.py:105-107`)."""
+    def loss(output, target, params):
+        return jnp.mean(jnp.abs(output - target.reshape(output.shape)))
+    return loss
+
+
+def _bce(**kw):
+    """Torch `BCELoss` over probabilities in [0, 1]."""
+    eps = 1e-12
+    def loss(output, target, params):
+        target = target.reshape(output.shape)
+        return -jnp.mean(target * jnp.log(output + eps)
+                         + (1.0 - target) * jnp.log(1.0 - output + eps))
+    return loss
+
+
+def _l1(**kw):
+    """Param-norm L1 regularizer `‖θ‖₁` (reference `loss.py:31-40`)."""
+    def loss(output, target, params):
+        return jnp.sum(jnp.abs(params))
+    return loss
+
+
+def _l2(**kw):
+    """Param-norm L2 regularizer `‖θ‖₂` (reference `loss.py:42-51` — note:
+    the norm itself, not its square)."""
+    def loss(output, target, params):
+        return jnp.sqrt(jnp.sum(params * params))
+    return loss
+
+
+register_loss("nll", _nll)
+register_loss("crossentropy", _crossentropy)
+register_loss("mse", _mse)
+register_loss("l1loss", _l1loss)
+register_loss("bce", _bce)
+register_loss("l1", _l1)
+register_loss("l2", _l2)
+
+
+# --------------------------------------------------------------------------- #
+# Built-in criteria
+
+def _topk(k=1, **kw):
+    """`top-k` criterion (reference `loss.py:213-234`)."""
+    def criterion(output, target):
+        k_eff = min(k, output.shape[1])
+        _, idx = jax.lax.top_k(output, k_eff)
+        correct = jnp.any(idx == target.reshape(-1, 1), axis=1)
+        return jnp.array([jnp.sum(correct), output.shape[0]], jnp.float32)
+    return criterion
+
+
+def _sigmoid(**kw):
+    """`sigmoid` criterion for binary outputs in [0, 1]
+    (reference `loss.py:236-252`)."""
+    def criterion(output, target):
+        correct = jnp.abs(target.reshape(output.shape) - output) < 0.5
+        return jnp.array([jnp.sum(correct), correct.size], jnp.float32)
+    return criterion
+
+
+register_criterion("top-k", _topk)
+register_criterion("sigmoid", _sigmoid)

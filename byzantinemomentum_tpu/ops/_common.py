@@ -21,7 +21,24 @@ __all__ = [
     "pairwise_distances",
     "closest_mean",
     "sanitize_inf",
+    "selection_influence",
 ]
+
+
+def selection_influence(selection_fn):
+    """Build the 'fraction of selected gradients that are Byzantine'
+    influence helper for a selection-based GAR.
+
+    The reference computes this per GAR by identity comparison over the
+    selected tensors (e.g. `aggregators/krum.py:126-150`); on the stacked
+    matrix it is index-range membership: a selected index >= len(honests)
+    is a Byzantine row. `selection_fn(gradients, f, **kwargs) -> i32[m]`.
+    """
+    def influence(honests, byzantines, f, **kwargs):
+        gradients = jnp.concatenate([honests, byzantines], axis=0)
+        sel = selection_fn(gradients, f, **kwargs)
+        return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+    return influence
 
 
 def lower_median(g):

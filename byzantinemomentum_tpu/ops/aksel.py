@@ -8,7 +8,7 @@ average the c closest — c = (n+1)//2 in 'mid' mode, n-f in 'n-f' mode
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import lower_median, sanitize_inf
+from byzantinemomentum_tpu.ops._common import lower_median, sanitize_inf, selection_influence
 
 __all__ = ["aggregate", "selection"]
 
@@ -45,12 +45,9 @@ def check(gradients, f, mode="mid", **kwargs):
         return f"Invalid operation mode {mode!r}"
 
 
-def influence(honests, byzantines, f, mode="mid", **kwargs):
-    """Fraction of selected gradients that are Byzantine
-    (reference `aggregators/aksel.py:83-105`)."""
-    gradients = jnp.concatenate([honests, byzantines], axis=0)
-    sel = selection(gradients, f, mode)
-    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+# Fraction of selected gradients that are Byzantine (reference
+# `aggregators/aksel.py:83-105`)
+influence = selection_influence(selection)
 
 
 register("aksel", aggregate, check, influence=influence)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import pairwise_distances
+from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
 
 __all__ = ["aggregate", "selection"]
 
@@ -77,12 +77,9 @@ def upper_bound(n, f, d):
     return (n - f) / (math.sqrt(8) * f)
 
 
-def influence(honests, byzantines, f, **kwargs):
-    """Fraction of selected gradients that are Byzantine
-    (reference `aggregators/brute.py:118-140`)."""
-    gradients = jnp.concatenate([honests, byzantines], axis=0)
-    sel = selection(gradients, f)
-    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+# Fraction of selected gradients that are Byzantine (reference
+# `aggregators/brute.py:118-140`)
+influence = selection_influence(selection)
 
 
 register("brute", aggregate, check, upper_bound=upper_bound, influence=influence)

@@ -8,7 +8,7 @@ smallest-norm gradients (reference `aggregators/cge.py:28-57`).
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import sanitize_inf
+from byzantinemomentum_tpu.ops._common import sanitize_inf, selection_influence
 
 __all__ = ["aggregate", "selection"]
 
@@ -35,12 +35,9 @@ def check(gradients, f=None, m=None, **kwargs):
         return f"Expected at least one gradient to aggregate, got {gradients.shape[0]}"
 
 
-def influence(honests, byzantines, f, **kwargs):
-    """Fraction of selected gradients that are Byzantine
-    (reference `aggregators/cge.py:72-93`)."""
-    gradients = jnp.concatenate([honests, byzantines], axis=0)
-    sel = selection(gradients, f)
-    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+# Fraction of selected gradients that are Byzantine (reference
+# `aggregators/cge.py:72-93`)
+influence = selection_influence(selection)
 
 
 register("cge", aggregate, check, influence=influence)

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import pairwise_distances
+from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
 
 __all__ = ["aggregate", "scores", "selection"]
 
@@ -74,15 +74,9 @@ def upper_bound(n, f, d):
     return 1 / math.sqrt(2 * (n - f + f * (n + f * (n - f - 2) - 2) / (n - 2 * f - 2)))
 
 
-def influence(honests, byzantines, f, m=None, **kwargs):
-    """Fraction of selected gradients that are Byzantine
-    (reference `aggregators/krum.py:126-150`; identity comparison there maps
-    to index-range membership on the stacked matrix here)."""
-    gradients = jnp.concatenate([honests, byzantines], axis=0)
-    if m is None:
-        m = gradients.shape[0] - f - 2
-    sel = selection(gradients, f, m)
-    return jnp.mean((sel >= honests.shape[0]).astype(jnp.float32))
+# Fraction of selected gradients that are Byzantine (reference
+# `aggregators/krum.py:126-150`)
+influence = selection_influence(selection)
 
 
 register("krum", aggregate, check, upper_bound=upper_bound, influence=influence)
