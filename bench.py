@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""Headline benchmark — prints ONE JSON line.
+
+North-star metric (BASELINE.json): simulated-distributed steps/sec on the
+CIFAR-10 configuration n=25, f=11, Bulyan vs empire(1.1), empire-cnn,
+batch 50, momentum 0.99 at update, clip 5, with the full 24-column study
+pipeline on (matching how the reference's `reproduce.py` actually runs its
+grid, reference `reproduce.py:165-209`).
+
+`vs_baseline` divides by the PyTorch-CPU steps/sec of the reference-style
+loop measured by `scripts/measure_torch_baseline.py` (recorded in
+`BASELINE_MEASURED.json`; the reference itself cannot run here — it imports
+torchvision, which is absent).
+"""
+
+import json
+import os
+import pathlib
+import time
+
+# Keep the synthetic fallback light: the benchmark needs batches, not epochs
+os.environ.setdefault("BMT_SYNTH_TRAIN", "5000")
+os.environ.setdefault("BMT_SYNTH_TEST", "500")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from byzantinemomentum_tpu import attacks, data, losses, models, ops  # noqa: E402
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine  # noqa: E402
+
+N_WORKERS = 25
+F = 11
+BATCH = 50
+WARMUP_STEPS = 2
+MIN_MEASURE_S = 5.0
+MAX_MEASURE_STEPS = 200
+
+
+def main():
+    cfg = EngineConfig(
+        nb_workers=N_WORKERS, nb_decl_byz=F, nb_real_byz=F,
+        nb_for_study=N_WORKERS, nb_for_study_past=1,
+        momentum=0.99, momentum_at="update", gradient_clip=5.0)
+    model_def = models.build("empire-cnn")
+    engine = build_engine(
+        cfg=cfg, model_def=model_def, loss=losses.Loss("nll"),
+        criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["bulyan"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+
+    state = engine.init(jax.random.PRNGKey(0))
+    trainset, _ = data.make_datasets("cifar10", BATCH, BATCH, seed=0)
+    S = cfg.nb_sampled
+    lr = jnp.float32(0.01)
+
+    def batches():
+        xs, ys = zip(*(trainset.sample() for _ in range(S)))
+        return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+
+    for _ in range(WARMUP_STEPS):
+        xs, ys = batches()
+        state, metrics = engine.train_step(state, xs, ys, lr)
+    jax.block_until_ready(state.theta)
+
+    steps = 0
+    start = time.monotonic()
+    while True:
+        xs, ys = batches()
+        state, metrics = engine.train_step(state, xs, ys, lr)
+        steps += 1
+        if steps >= MAX_MEASURE_STEPS:
+            break
+        if steps % 5 == 0:
+            jax.block_until_ready(state.theta)
+            if time.monotonic() - start >= MIN_MEASURE_S:
+                break
+    jax.block_until_ready(state.theta)
+    elapsed = time.monotonic() - start
+    steps_per_sec = steps / elapsed
+
+    baseline_path = pathlib.Path(__file__).resolve().parent / "BASELINE_MEASURED.json"
+    vs_baseline = None
+    if baseline_path.is_file():
+        baseline = json.loads(baseline_path.read_text())
+        ref = baseline.get("torch_cpu_steps_per_sec")
+        if ref:
+            vs_baseline = steps_per_sec / ref
+
+    print(json.dumps({
+        "metric": "sim_steps_per_sec_cifar10_n25_f11_bulyan",
+        "value": steps_per_sec,
+        "unit": "steps/s",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

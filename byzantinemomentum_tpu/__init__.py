@@ -11,7 +11,7 @@ computes the whole `(n, d)` gradient matrix in one `jax.vmap`'d XLA program;
 where the reference's aggregation rules operate on Python lists of flat
 tensors, ours are pure jnp kernels over the stacked `(n, d)` matrix that XLA
 fuses and tiles onto the MXU; and the per-step training loop — momentum
-placements, attack, defense, model update and the 25-column metric pipeline —
+placements, attack, defense, model update and the 24-column metric pipeline —
 is a single jit-compiled function.
 
 Subpackages:

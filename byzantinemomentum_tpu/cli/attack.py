@@ -4,7 +4,7 @@
 Division of labor (redesigned for TPU): the whole per-step computation is one
 jitted XLA program (`engine/step.py`); this driver only parses flags, samples
 host batches, runs milestones (eval / checkpoint / user input), formats the
-`eval` and 25-column `study` CSVs (byte-compatible with the reference's
+`eval` and 24-column `study` CSVs (byte-compatible with the reference's
 `study.Session` parser, reference `study.py:216-229`) and handles graceful
 SIGINT/SIGTERM (reference `attack.py:41-45`).
 """
@@ -163,8 +163,16 @@ def _postprocess(args):
             return args.learning_rate / (last / args.learning_rate_decay + 1)
     else:
         numbers = args.learning_rate_schedule.split(",")
-        flat = tuple(float(x) if i % 2 == 0 else int(x)
-                     for i, x in enumerate(numbers))
+        try:
+            flat = tuple(float(x) if i % 2 == 0 else int(x)
+                         for i, x in enumerate(numbers))
+        except ValueError as err:
+            utils.fatal(f"Invalid arguments: malformed learning rate "
+                        f"schedule {args.learning_rate_schedule!r} ({err})")
+        if len(flat) % 2 == 0:
+            utils.fatal(f"Invalid arguments: learning rate schedule "
+                        f"{args.learning_rate_schedule!r} must have the form "
+                        f"'<init lr>[,<from step>,<new lr>]*'")
         schedule = [(0, flat[0])]
         for i in range(1, len(flat), 2):
             step, lr = flat[i], flat[i + 1]

@@ -168,13 +168,19 @@ def synthetic_images(name, *, shape, classes, train, test):
     train = int(os.environ.get("BMT_SYNTH_TRAIN", train))
     test = int(os.environ.get("BMT_SYNTH_TEST", test))
     rng = np.random.default_rng(zlib.crc32(name.encode()))
-    protos = rng.integers(0, 256, size=(classes, *shape))
+    protos = rng.integers(0, 256, size=(classes, *shape)).astype(np.float32)
 
     def make(count, seed_off):
         r = np.random.default_rng((zlib.crc32(name.encode()) + seed_off) % (2**32))
         labels = r.integers(0, classes, size=count).astype(np.int32)
-        noise = r.normal(0.0, 48.0, size=(count, *shape))
-        images = np.clip(protos[labels] + noise, 0, 255).astype(np.uint8)
+        # f32 noise, generated in chunks: full-size CIFAR in f64 would peak
+        # at >1 GB for a fallback dataset
+        images = np.empty((count, *shape), np.uint8)
+        for lo in range(0, count, 8192):
+            hi = min(lo + 8192, count)
+            noise = 48.0 * r.standard_normal((hi - lo, *shape), dtype=np.float32)
+            np.clip(protos[labels[lo:hi]] + noise, 0, 255, out=noise)
+            images[lo:hi] = noise.astype(np.uint8)
         return images, labels
 
     train_x, train_y = make(train, 1)

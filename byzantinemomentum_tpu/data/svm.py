@@ -38,24 +38,29 @@ def _parse_libsvm(path):
 
 
 def _synthetic_phishing():
-    total = int(os.environ.get("BMT_SYNTH_TRAIN", TOTAL))
+    """Returns (inputs, labels, split): train size honors $BMT_SYNTH_TRAIN
+    (default: the real 8400 split) and test size $BMT_SYNTH_TEST (default:
+    the real remainder), so shrunken test runs keep a meaningful test set."""
+    train = min(int(os.environ.get("BMT_SYNTH_TRAIN", SPLIT)), SPLIT)
+    test = int(os.environ.get("BMT_SYNTH_TEST", TOTAL - SPLIT))
+    total = train + test
     rng = np.random.default_rng(0x5F15)
     w = rng.normal(size=(FEATURES,)).astype(np.float32)
     inputs = rng.random((total, FEATURES), dtype=np.float32)
     logits = (inputs - 0.5) @ w + rng.normal(0, 0.5, total).astype(np.float32)
     labels = (logits > 0).astype(np.float32)[:, None]
-    return inputs, labels
+    return inputs, labels, train
 
 
 def load_phishing(**unused):
     path = sources._find("phishing", "phishing.txt", "phishing.libsvm")
     if path is not None:
         inputs, labels = _parse_libsvm(path)
+        split = min(SPLIT, len(inputs) - 1)
     else:
         utils.trace("phishing: raw file not found on disk; using the "
                     "deterministic synthetic fallback")
-        inputs, labels = _synthetic_phishing()
-    split = min(SPLIT, len(inputs) - 1)
+        inputs, labels, split = _synthetic_phishing()
     return {"train_x": inputs[:split], "train_y": labels[:split],
             "test_x": inputs[split:], "test_y": labels[split:],
             "kind": "raw"}

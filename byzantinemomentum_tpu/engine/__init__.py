@@ -3,9 +3,9 @@
 This is the TPU-native redesign of the reference's 200-line training loop
 (reference `attack.py:685-885`): the whole per-step computation — vmapped
 honest gradients, clipping, momentum placement, attack synthesis, robust
-aggregation, model update and the 25-column study metrics — compiles into a
+aggregation, model update and the 24-column study metrics — compiles into a
 single XLA program `train_step(state, xs, ys, lr) -> (state, metrics)`. The
-host loop (see `cli/driver.py`) only samples batches, formats CSV rows and
+host loop (see `cli/attack.py`) only samples batches, formats CSV rows and
 handles milestones (eval/checkpoint/SIGINT), mirroring the reference's
 division of labor with the device.
 """

@@ -1,4 +1,4 @@
-"""In-graph study metrics — the 25-column per-step diagnostic pipeline.
+"""In-graph study metrics — the 24-column per-step diagnostic pipeline.
 
 Reference: the `study` CSV schema (`attack.py:564-571`), the per-step
 computation (`attack.py:842-878`) and the `compute_avg_dev_max` helper

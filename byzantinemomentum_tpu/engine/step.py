@@ -15,7 +15,7 @@ reference does per iteration of its hot loop (reference `attack.py:752-882`):
                   (`attack.py:821`);
   update        — SGD with weight decay (`attack.py:832-839`,
                   torch-SGD semantics from `attack.py:543-544`);
-  metrics       — the 25-column study pipeline, in-graph
+  metrics       — the 24-column study pipeline, in-graph
                   (`attack.py:842-878`).
 
 Multi-local-step SGD (`--nb-local-steps > 1`) is implemented (via
@@ -47,25 +47,39 @@ def _clip_rows(G, clip):
     return G * scale
 
 
-def compose_bn_updates(net_state0, per_worker_states, count):
+def compose_bn_updates(net_state0, per_worker_states, count, local_steps=1):
     """Sequential-equivalent composition of per-worker BatchNorm running-stat
     updates.
 
     The reference runs workers sequentially through one module, so running
     stats fold as r_k = (1-m) r_{k-1} + m s_k over the k-th worker's batch
     stats (reference `experiments/model.py:246-248`, `models/empire.py:36-47`).
-    Under vmap every worker computed r0-based updates `new_i = (1-m) r0 +
-    m s_i` instead; inverting for s_i and refolding yields the exact
-    sequential result:  r_S = (1-m)^S r0 + m * sum_i (1-m)^(S-1-i) s_i.
+    Under vmap every worker computed r0-based chains instead; inverting each
+    chain for its batch stats and refolding the full worker-major sequence
+    yields the exact sequential result:
+      r_T = (1-m)^T r0 + m * sum_t (1-m)^(T-1-t) s_t,  T = count*local_steps.
+
+    `per_worker_states` leaves: (count, ...) for local_steps == 1, else
+    (count, local_steps, ...) — each worker's chain of running states, all
+    chained from the shared r0.
     """
     if not jax.tree.leaves(net_state0):
         return net_state0
     m = BN_MOMENTUM
-    decay = (1.0 - m) ** count
-    weights = (1.0 - m) ** jnp.arange(count - 1, -1, -1, dtype=jnp.float32)
+    total = count * local_steps
+    decay = (1.0 - m) ** total
+    weights = (1.0 - m) ** jnp.arange(total - 1, -1, -1, dtype=jnp.float32)
 
     def fold(r0, new_stack):
-        s = (new_stack - (1.0 - m) * r0) / m  # per-worker batch stats
+        if local_steps == 1:
+            s = (new_stack - (1.0 - m) * r0) / m  # per-worker batch stats
+        else:
+            # Invert each worker's chain: new[j] = (1-m) new[j-1] + m s[j]
+            prev = jnp.concatenate([
+                jnp.broadcast_to(r0, new_stack[:, :1].shape),
+                new_stack[:, :-1]], axis=1)
+            s = ((new_stack - (1.0 - m) * prev) / m).reshape(
+                (total,) + r0.shape)
         contrib = jnp.tensordot(weights, s, axes=1)
         return decay * r0 + m * contrib
 
@@ -144,11 +158,13 @@ class Engine:
             th, st = carry
             x, y, r = inputs
             loss_val, grad, new_st = self._worker_grad(th, st, x, y, r)
-            return (th - lr * grad, new_st), loss_val
-        (theta_end, state_end), losses = lax.scan(
+            return (th - lr * grad, new_st), (loss_val, new_st)
+        (theta_end, _), (losses, state_chain) = lax.scan(
             body, (theta, net_state), (xs, ys, rngs))
         grad = (theta - theta_end) / lr
-        return losses[0], grad, state_end
+        # state_chain: each local step's running state, (k, ...) per leaf —
+        # compose_bn_updates needs the whole chain to stay exact
+        return losses[0], grad, state_chain
 
     # ----------------------------------------------------------------- #
     # Defense dispatch (single GAR or per-step random mixture)
@@ -228,7 +244,8 @@ class Engine:
 
         G_sampled = _clip_rows(grads, cfg.gradient_clip)
         loss_avg = jnp.mean(losses)
-        net_state = compose_bn_updates(state.net_state, new_states, S)
+        net_state = compose_bn_updates(state.net_state, new_states, S,
+                                       cfg.nb_local_steps)
 
         # --- momentum placement on honest rows (`attack.py:799-810`) --- #
         if cfg.momentum_at == "worker":

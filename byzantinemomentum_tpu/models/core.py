@@ -100,7 +100,7 @@ def batchnorm_apply(params, state, x, *, train):
     Returns (out, new_state); in train mode `new_state` carries the running
     stats updated by THIS batch (the sequential-equivalent composition across
     vmapped workers happens in the training step — see
-    `train/step.py:compose_bn_updates`).
+    `engine/step.py:compose_bn_updates`).
     """
     axes = tuple(range(x.ndim - 1))
     if train:

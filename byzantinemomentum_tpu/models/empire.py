@@ -2,8 +2,8 @@
 (reference `experiments/models/empire.py:24-98`).
 
 Architecture (note the unusual conv -> relu -> BN order, kept for parity):
-  [conv3x3(3,64) relu bn] x2, maxpool2, dropout .25,
-  [conv3x3(64,128)... wait: conv3x3(64,128) relu bn, conv3x3(128,128) relu bn],
+  conv3x3(3,64) relu bn, conv3x3(64,64) relu bn, maxpool2, dropout .25,
+  conv3x3(64,128) relu bn, conv3x3(128,128) relu bn,
   maxpool2, dropout .25, flatten(8192),
   fc(8192,128) relu dropout .25 fc(128,10), log_softmax
   (CIFAR-100 variant: fc(8192,256), fc(256,100)).
@@ -12,7 +12,7 @@ BatchNorm + Dropout under vmap: each worker's forward normalizes with its
 own minibatch statistics (exactly torch train-mode behavior) and draws its
 own dropout mask from a per-worker PRNG key; the sequential running-stat
 update across workers is composed in the training step
-(`train/step.py:compose_bn_updates`) — see SURVEY.md §7 "hard parts" #2.
+(`engine/step.py:compose_bn_updates`) — see SURVEY.md §7 "hard parts" #2.
 """
 
 import jax

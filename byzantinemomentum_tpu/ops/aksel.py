@@ -21,7 +21,7 @@ def _count(n, f, mode):
     raise NotImplementedError(f"Unknown aksel mode {mode!r}")
 
 
-def selection(gradients, f, mode="mid"):
+def selection(gradients, f, mode="mid", **kwargs):
     """Indices of the c gradients closest (squared L2) to the median
     (reference `aggregators/aksel.py:24-53`); non-finite distances rank last."""
     n = gradients.shape[0]

@@ -39,7 +39,7 @@ def _combo_pairs(n, k):
     return combos, px, py
 
 
-def selection(gradients, f, *, method="dot"):
+def selection(gradients, f, *, method="dot", **kwargs):
     """Indices (as a (n-f,) array) of the minimum-diameter subset
     (reference `aggregators/brute.py:32-68`)."""
     n = gradients.shape[0]

@@ -19,7 +19,7 @@ def norms(gradients):
     return sanitize_inf(jnp.sqrt(jnp.sum(gradients * gradients, axis=1)))
 
 
-def selection(gradients, f):
+def selection(gradients, f, **kwargs):
     """Indices of the n-f smallest-norm gradients, stable-tie order."""
     n = gradients.shape[0]
     return jnp.argsort(norms(gradients), stable=True)[:n - f]

@@ -35,7 +35,7 @@ def scores(gradients, f, *, method="dot"):
     return jnp.sum(jnp.sort(dist, axis=1)[:, :n - f - 1], axis=1)
 
 
-def selection(gradients, f, m=None, *, method="dot"):
+def selection(gradients, f, m=None, *, method="dot", **kwargs):
     """Indices of the m selected (lowest-score) gradients, stable-tie order
     (reference sorts scores with Python's stable sort, `krum.py:61-63`)."""
     n = gradients.shape[0]

@@ -1,0 +1,33 @@
+"""Multi-chip execution: device meshes, sharding specs and distributed GAR
+kernels.
+
+The reference has NO distributed backend (SURVEY.md §2.8: its "workers" are
+an in-process loop, its only transport a `.to(device)` move,
+reference `attack.py:811-815`). The TPU-native equivalent built here is the
+real thing: the `(n, d)` gradient matrix lives sharded across a
+`jax.sharding.Mesh`, and "communication" is XLA collectives over ICI —
+
+* **worker axis** (data parallel over simulated workers): per-worker batches
+  and gradients shard along `n`; the aggregation gathers rows, which XLA
+  lowers to an all-gather on ICI.
+* **model axis** (the `d` dimension, for models too large for one chip):
+  coordinate-wise GARs (median/trmean/phocas/meamed) shard trivially along
+  `d`; pairwise-distance GARs (krum/bulyan/brute) compute per-shard partial
+  Gram matrices and `psum` them over the model axis (`sharded.py`) — the
+  distance matrix is tiny (n x n), so only the reduction crosses chips.
+
+DCN enters only at the experiment-grid level (`tools.Jobs`-style scheduling
+of independent runs across hosts), exactly where the reference used
+process-level parallelism (reference `tools/jobs.py:148-191`).
+"""
+
+from byzantinemomentum_tpu.parallel.mesh import make_mesh, mesh_axes
+from byzantinemomentum_tpu.parallel.sharded import (
+    pairwise_distances_sharded,
+    shard_gar,
+    sharded_state_spec,
+    sharded_train_step,
+)
+
+__all__ = ["make_mesh", "mesh_axes", "pairwise_distances_sharded",
+           "shard_gar", "sharded_state_spec", "sharded_train_step"]

@@ -1,0 +1,46 @@
+"""Device-mesh construction.
+
+Axis naming convention used across the framework:
+  "workers" — the simulated-worker axis (data parallel): batches and the
+              (n, d) gradient matrix shard along it.
+  "model"   — the flat-parameter axis (d): parameters, momentum buffers and
+              gradient columns shard along it for large models.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "mesh_axes"]
+
+WORKERS, MODEL = "workers", "model"
+
+
+def mesh_axes():
+    return (WORKERS, MODEL)
+
+
+def make_mesh(n_devices=None, *, model_parallel=1, devices=None):
+    """Build a (workers, model) `Mesh` over the available devices.
+
+    Args:
+      n_devices: number of devices to use (default: all).
+      model_parallel: size of the model axis; the worker axis gets the rest.
+      devices: explicit device list (default: `jax.devices()`).
+    Returns:
+      `jax.sharding.Mesh` with axes ("workers", "model").
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"Requested {n_devices} devices but only {len(devices)} are "
+                f"available")
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(
+            f"Device count {n} is not divisible by model_parallel="
+            f"{model_parallel}")
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (WORKERS, MODEL))

@@ -1,0 +1,150 @@
+"""Sharded execution: distributed GAR kernels and the multi-chip training
+step.
+
+Design recipe (the scaling-book pattern): annotate shardings on the jitted
+step and let XLA insert the collectives. Two explicit `shard_map` kernels
+are provided for the cases where the communication pattern is worth pinning
+by hand:
+
+* `pairwise_distances_sharded` — the O(n²·d) distance computation behind
+  krum/bulyan/brute with `d` sharded over the "model" axis: each chip forms
+  its partial Gram matrix on the MXU and a single `psum` of the tiny (n, n)
+  result crosses ICI (instead of all-gathering the (n, d) matrix).
+* `shard_gar` — coordinate-wise GARs (median/trmean/phocas/meamed/average)
+  run on each chip's d-slice with NO communication at all; selection-based
+  GARs (krum) reuse the psum distances, then every chip applies the
+  (replicated, tiny) selection to its local slice.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from byzantinemomentum_tpu.engine.state import TrainState
+from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS
+
+__all__ = ["pairwise_distances_sharded", "shard_gar", "sharded_state_spec",
+           "sharded_train_step", "COORDINATE_WISE"]
+
+# GARs that act independently per coordinate: they shard over `d` with zero
+# communication (SURVEY.md §5.7: "coordinate-wise GARs shard trivially over
+# d; pairwise-distance GARs need a psum over d-shards").
+COORDINATE_WISE = frozenset(
+    {"average", "median", "trmean", "phocas", "meamed", "native-median"})
+
+
+def pairwise_distances_sharded(g, mesh):
+    """All-pairs Euclidean distances of the rows of `g: f32[n, d]` with `d`
+    sharded along the mesh's "model" axis.
+
+    Per shard: partial row-norms and partial Gram matrix (one MXU matmul),
+    then one `psum` of (n,) + (n, n) over ICI. Semantics match
+    `ops._common.pairwise_distances` ('dot' method): non-finite -> +inf,
+    +inf diagonal.
+    """
+    def kernel(g_local):
+        sq = jnp.sum(g_local * g_local, axis=1)
+        gram = g_local @ g_local.T
+        sq = jax.lax.psum(sq, MODEL)
+        gram = jax.lax.psum(gram, MODEL)
+        d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
+        d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
+        n = g_local.shape[0]
+        d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
+        return jnp.sqrt(d2)
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=P(None, MODEL), out_specs=P(None, None))(g)
+
+
+def shard_gar(gar, mesh, *, f, **kwargs):
+    """Wrap a registered GAR into a d-sharded callable `(G) -> f32[d]`.
+
+    Coordinate-wise rules run shard-locally. Krum-family rules compute the
+    psum'd distance matrix, derive the (replicated) selection, and average
+    the selected rows locally per shard.
+    """
+    if gar.name in COORDINATE_WISE:
+        def kernel(g_local):
+            return gar.unchecked(g_local, f=f, **kwargs)
+        return shard_map(kernel, mesh=mesh,
+                         in_specs=P(None, MODEL), out_specs=P(MODEL))
+
+    if gar.name in ("krum", "native-krum"):
+        def kernel(g_local):
+            n = g_local.shape[0]
+            dist = _psum_distances(g_local)
+            scores = jnp.sum(jnp.sort(dist, axis=1)[:, :n - f - 1], axis=1)
+            m = kwargs.get("m") or n - f - 2
+            sel = jnp.argsort(scores, stable=True)[:m]
+            return jnp.mean(g_local[sel], axis=0)
+
+        def _psum_distances(g_local):
+            sq = jax.lax.psum(jnp.sum(g_local * g_local, axis=1), MODEL)
+            gram = jax.lax.psum(g_local @ g_local.T, MODEL)
+            d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
+            d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
+            n = g_local.shape[0]
+            return jnp.sqrt(jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2))
+
+        return shard_map(kernel, mesh=mesh,
+                         in_specs=P(None, MODEL), out_specs=P(MODEL))
+
+    # Fallback: replicate (correct for any GAR; no d-sharding win)
+    def kernel_replicated(g):
+        return gar.unchecked(g, f=f, **kwargs)
+    return kernel_replicated
+
+
+def sharded_state_spec(cfg):
+    """PartitionSpecs for a `TrainState` on a (workers, model) mesh: all
+    d-dimensional buffers shard along "model"; scalars/counters/PRNG
+    replicate. (BatchNorm state replicates — it is tiny.)"""
+    def net_spec(net_state):
+        return jax.tree.map(lambda _: P(), net_state)
+
+    def spec(state):
+        return TrainState(
+            theta=P(MODEL),
+            net_state=net_spec(state.net_state),
+            momentum_server=P(MODEL),
+            momentum_workers=P(None, MODEL),
+            origin=P(MODEL) if state.origin.ndim else P(),
+            past_grads=P(None, MODEL),
+            past_norms=P(),
+            past_count=P(),
+            steps=P(),
+            datapoints=P(),
+            rng=P(),
+        )
+    return spec
+
+
+def sharded_train_step(engine, mesh, state_example):
+    """Compile the engine's training step for a multi-chip mesh.
+
+    Batches shard along "workers" (each chip computes its workers' gradients
+    — the reference's sequential honest phase, now data-parallel across
+    chips); parameters and momentum shard along "model". XLA inserts the
+    all-gather of gradient rows feeding the GAR and the collectives for the
+    d-sharded update.
+
+    Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
+    `engine.train_step`.
+    """
+    spec = sharded_state_spec(engine.cfg)(state_example)
+    state_shardings = jax.tree.map(
+        lambda p: NamedSharding(mesh, p), spec,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_sharding = NamedSharding(mesh, P(WORKERS))
+    lr_sharding = NamedSharding(mesh, P())
+    metrics_sharding = None  # replicated scalars; let XLA choose
+
+    return jax.jit(
+        engine._train_step,
+        in_shardings=(state_shardings, batch_sharding, batch_sharding,
+                      lr_sharding),
+        out_shardings=(state_shardings, metrics_sharding),
+        donate_argnums=(0,))

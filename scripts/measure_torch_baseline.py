@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Measure the PyTorch-CPU baseline for the headline benchmark.
+
+The reference cannot run in this environment (it imports torchvision at
+module load, which is not installed here), so the baseline is a faithful
+torch-CPU reimplementation of its hot loop in the reference's own
+implementation style (BASELINE.md: "PyTorch-CPU steps/sec of attack.py"):
+
+* sequential per-worker backprops on one shared model
+  (reference `attack.py:786-795`),
+* per-gradient L2 clip (`attack.py:791-794`),
+* momentum at update (`attack.py:836-838`),
+* empire attack, fixed factor (`attacks/identical.py:129-134`),
+* Bulyan with reference-style per-pair distance tensor ops
+  (`aggregators/bulyan.py:47-84`),
+* the study-metric passes (`attack.py:842-866`).
+
+Config = BASELINE.json #4: CIFAR-10 empire-cnn, n=25, f=11, batch 50,
+momentum 0.99, clip 5. Writes `BASELINE_MEASURED.json` at the repo root,
+which `bench.py` uses as the `vs_baseline` denominator.
+
+Usage: python scripts/measure_torch_baseline.py [--steps 3]
+"""
+
+import argparse
+import json
+import math
+import pathlib
+import sys
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from byzantinemomentum_tpu.data import sources  # noqa: E402
+
+N_WORKERS = 25
+F_DECL = 11
+F_REAL = 11
+BATCH = 50
+MOMENTUM = 0.99
+CLIP = 5.0
+LR = 0.01
+
+
+class EmpireCnn(nn.Module):
+    """Torch twin of `empire-cnn` (reference `experiments/models/empire.py:24-98`)."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2d(3, 64, 3, padding=1)
+        self.b1 = nn.BatchNorm2d(64)
+        self.c2 = nn.Conv2d(64, 64, 3, padding=1)
+        self.b2 = nn.BatchNorm2d(64)
+        self.c3 = nn.Conv2d(64, 128, 3, padding=1)
+        self.b3 = nn.BatchNorm2d(128)
+        self.c4 = nn.Conv2d(128, 128, 3, padding=1)
+        self.b4 = nn.BatchNorm2d(128)
+        self.f1 = nn.Linear(8192, 128)
+        self.f2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = self.b1(F.relu(self.c1(x)))
+        x = self.b2(F.relu(self.c2(x)))
+        x = F.dropout(F.max_pool2d(x, 2), 0.25, self.training)
+        x = self.b3(F.relu(self.c3(x)))
+        x = self.b4(F.relu(self.c4(x)))
+        x = F.dropout(F.max_pool2d(x, 2), 0.25, self.training)
+        x = x.flatten(1)
+        x = F.dropout(F.relu(self.f1(x)), 0.25, self.training)
+        return F.log_softmax(self.f2(x), dim=1)
+
+
+def flat_grad(model):
+    return torch.cat([p.grad.flatten() for p in model.parameters()])
+
+
+def bulyan(stack, f):
+    """Reference-style Bulyan: per-pair distance tensor ops + iterative
+    Multi-Krum selection + averaged median (reference `bulyan.py:47-84`)."""
+    n = stack.shape[0]
+    dist = torch.full((n, n), math.inf)
+    for i in range(n - 1):
+        for j in range(i + 1, n):
+            d = stack[i].sub(stack[j]).norm()
+            dist[i, j] = dist[j, i] = d if torch.isfinite(d) else math.inf
+    m_max = n - f - 2
+    scores = []
+    for i in range(n):
+        row = sorted(dist[i, j].item() for j in range(n))
+        scores.append(sum(row[:m_max]))
+    rounds = n - 2 * f - 2
+    selected = torch.empty((rounds, stack.shape[1]))
+    for i in range(rounds):
+        m_i = min(m_max, m_max - i)
+        order = sorted(range(n), key=lambda g: scores[g])
+        selected[i] = stack[order[:m_i]].mean(dim=0)
+        scores[order[0]] = math.inf
+    m2 = rounds - 2 * f
+    med = selected.sort(dim=0).values[(rounds - 1) // 2]
+    dev = (selected - med).abs()
+    idx = dev.argsort(dim=0, stable=True)[:m2]
+    return selected.gather(0, idx).mean(dim=0)
+
+
+def avg_dev_max(samples):
+    grad_avg = samples.mean(dim=0)
+    norm_avg = grad_avg.norm().item()
+    norm_max = grad_avg.abs().max().item()
+    norm_var = sum(float((g - grad_avg).dot(g - grad_avg)) for g in samples)
+    norm_dev = math.sqrt(norm_var / max(len(samples) - 1, 1))
+    return grad_avg, norm_avg, norm_dev, norm_max
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=3)
+    args = parser.parse_args()
+
+    torch.manual_seed(0)
+    raw = sources.load_cifar(10)
+    train_x = raw["train_x"].astype(np.float32) / 255.0
+    mean = np.asarray([0.4914, 0.4822, 0.4465], np.float32)
+    std = np.asarray([0.2023, 0.1994, 0.2010], np.float32)
+    train_x = ((train_x - mean) / std).transpose(0, 3, 1, 2)  # NCHW
+    train_y = raw["train_y"]
+
+    model = EmpireCnn()
+    model.train()
+    loss_fn = nn.NLLLoss()
+    rng = np.random.default_rng(0)
+    momentum_buf = None
+
+    def one_step():
+        nonlocal momentum_buf
+        grads = []
+        losses = []
+        for _ in range(N_WORKERS):
+            sel = rng.integers(0, len(train_x), BATCH)
+            x = torch.from_numpy(train_x[sel])
+            y = torch.from_numpy(train_y[sel]).long()
+            model.zero_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            g = flat_grad(model)
+            norm = g.norm().item()
+            if norm > CLIP:
+                g = g * (CLIP / norm)
+            grads.append(g.detach().clone())
+            losses.append(loss.item())
+        honests = grads[:N_WORKERS - F_REAL]
+        avg = torch.stack(honests).mean(dim=0)
+        byz = avg + 1.1 * (-avg)  # empire, factor 1.1
+        stack = torch.stack(honests + [byz] * F_REAL)
+        agg = bulyan(stack, F_DECL)
+        momentum_buf = (agg if momentum_buf is None
+                        else MOMENTUM * momentum_buf + agg)
+        with torch.no_grad():
+            offset = 0
+            for p in model.parameters():
+                num = p.numel()
+                p -= LR * momentum_buf[offset:offset + num].view_as(p)
+                offset += num
+        # Study metric passes (reference `attack.py:842-866`)
+        sampled = torch.stack(grads)
+        for part in (sampled, torch.stack(honests), stack[len(honests):]):
+            avg_dev_max(part)
+        agg.norm().item(), agg.abs().max().item()
+
+    one_step()  # warmup (allocator, thread pools)
+    start = time.monotonic()
+    for _ in range(args.steps):
+        one_step()
+    elapsed = time.monotonic() - start
+    steps_per_sec = args.steps / elapsed
+
+    out = {
+        "metric": "sim_steps_per_sec",
+        "config": "CIFAR-10 empire-cnn, n=25 f=11, bulyan vs empire(1.1), "
+                  "batch 50, momentum 0.99 at update, clip 5, torch-CPU "
+                  "reference-style loop",
+        "torch_cpu_steps_per_sec": steps_per_sec,
+        "elapsed_s": elapsed,
+        "steps": args.steps,
+    }
+    path = pathlib.Path(__file__).resolve().parent.parent / "BASELINE_MEASURED.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
