@@ -18,14 +18,27 @@ Subpackages:
   ops       Gradient aggregation rules (GARs) — the algorithmic kernels.
   attacks   Byzantine gradient synthesis (adaptive line-searched attacks).
   models    Pure-pytree neural networks (init/apply pairs).
-  data      Device-staged datasets with in-graph batch sampling.
-  train     The jitted training step, metrics, checkpointing, host loop.
+  data      Host datasets: loaders, samplers, synthetic fallbacks.
+  engine    The jitted training step, metrics, train state.
+  cli       The experiment driver (reference `attack.py` parity).
   parallel  Mesh construction, sharded training step, distributed GARs.
-  utils     Registries, logging, key:value mini-language, job scheduler.
+  native    Host C++ tier of the four accelerated GARs (ctypes).
+  utils     Registries, logging, key:value mini-language.
 """
+
+import os
 
 __version__ = "0.1.0"
 
 from byzantinemomentum_tpu import utils  # noqa: F401
 from byzantinemomentum_tpu import ops  # noqa: F401
 from byzantinemomentum_tpu import attacks  # noqa: F401
+
+# Opportunistic native tier, mirroring the reference's optional `import
+# native` (reference `aggregators/median.py:22-26`): adds `cpp-<gar>`
+# registry entries when the host toolchain is available. `BMT_NO_NATIVE=1`
+# skips the attempt (and the one-time g++ build).
+if not os.environ.get("BMT_NO_NATIVE"):
+    from byzantinemomentum_tpu import native as _native
+
+    _native.register_cpp_gars()

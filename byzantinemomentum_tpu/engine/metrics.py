@@ -35,7 +35,10 @@ STUDY_COLUMNS = (
     "Attack acceptation ratio",
 )
 
-_NAN = jnp.float32(jnp.nan)
+# NaN as a Python float: creating a device array at import time would
+# initialize the JAX backend before the CLI's --device platform selection
+# can take effect.
+_NAN = float("nan")
 
 
 def avg_dev_max(G):

@@ -1,0 +1,174 @@
+"""Native (host C++) GAR tier — ctypes bindings over `bmt_native.cpp`.
+
+Mirrors the reference's optional `native` module surface
+(`native.median.aggregate(gradients)`, `native.krum.aggregate(gradients, f,
+m)`, `native.bulyan.aggregate(gradients, f, m)`,
+`native.brute.aggregate(gradients, f)` — reference `aggregators/median.py:
+22-26` etc.): import `byzantinemomentum_tpu.native as native`, then
+`native.median.aggregate(G)`. The shared library is compiled on first use
+with g++ (this environment has no pybind11; ctypes needs no build-time
+Python headers) and cached next to the source. `native.available()` reports
+whether the toolchain succeeded — callers degrade to the jnp kernels
+otherwise, exactly how the reference degrades when its native module is
+absent.
+
+The tier also registers `cpp-<gar>` entries in the ops registry through
+`jax.pure_callback`, so the host kernels remain selectable from the CLI
+(`--gar cpp-median`) and usable inside the jitted training step. Note:
+host callbacks require backend support — the axon TPU backend does not
+implement them, so the `cpp-*` tier is a CPU-backend facility (its role:
+an independent oracle and host fast path, mirroring the reference where
+`native` was likewise an optional CPU-side accelerator).
+"""
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+from byzantinemomentum_tpu import utils
+
+__all__ = ["available", "median", "krum", "bulyan", "brute"]
+
+_HERE = pathlib.Path(__file__).parent
+_SRC = _HERE / "bmt_native.cpp"
+_LIB = _HERE / "libbmt_native.so"
+
+_lib = None
+_build_error = None
+
+
+def _load():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    try:
+        if (not _LIB.is_file()
+                or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+            # Build to a private temp file, then atomically publish: two
+            # processes may race on the first build, and CDLL of a
+            # half-written .so fails nondeterministically. (-O3 without
+            # -march=native: the cached .so may be reused on another host.)
+            import os
+            import tempfile
+            with tempfile.NamedTemporaryFile(
+                    suffix=".so", dir=str(_HERE), delete=False) as tmp:
+                tmp_path = tmp.name
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC",
+                     str(_SRC), "-o", tmp_path],
+                    check=True, capture_output=True, text=True)
+                os.replace(tmp_path, str(_LIB))
+            finally:
+                if pathlib.Path(tmp_path).exists():
+                    pathlib.Path(tmp_path).unlink()
+        lib = ctypes.CDLL(str(_LIB))
+        for name, argtypes in (
+                ("bmt_median", [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_void_p]),
+                ("bmt_krum", [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                              ctypes.c_int, ctypes.c_int, ctypes.c_void_p]),
+                ("bmt_bulyan", [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_int, ctypes.c_int, ctypes.c_void_p]),
+                ("bmt_brute", [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                               ctypes.c_int, ctypes.c_void_p])):
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = None
+        _lib = lib
+    except (subprocess.CalledProcessError, OSError) as err:
+        detail = getattr(err, "stderr", "") or str(err)
+        _build_error = detail
+        utils.warning(f"native GAR tier unavailable ({detail.strip()[:200]}); "
+                      "falling back to the jnp kernels")
+    return _lib
+
+
+def available():
+    """Whether the compiled tier loaded (builds on first call)."""
+    return _load() is not None
+
+
+def _prep(gradients):
+    g = np.ascontiguousarray(np.asarray(gradients, dtype=np.float32))
+    if g.ndim != 2:
+        raise ValueError(f"Expected an (n, d) matrix, got shape {g.shape}")
+    return g
+
+
+def _call(fn_name, gradients, *scalars):
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native tier unavailable: {_build_error}")
+    g = _prep(gradients)
+    n, d = g.shape
+    out = np.empty((d,), np.float32)
+    getattr(lib, fn_name)(
+        g.ctypes.data_as(ctypes.c_void_p), n, d, *scalars,
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+class _Entry:
+    """One `native.<gar>` namespace with the reference's `aggregate`
+    signature."""
+
+    def __init__(self, name, fn):
+        self.name = name
+        self.aggregate = fn
+
+    def __repr__(self):
+        return f"native.{self.name}"
+
+
+median = _Entry("median", lambda gradients: _call("bmt_median", gradients))
+krum = _Entry("krum", lambda gradients, f, m=None:
+              _call("bmt_krum", gradients, int(f),
+                    -1 if m is None else int(m)))
+bulyan = _Entry("bulyan", lambda gradients, f, m=None:
+                _call("bmt_bulyan", gradients, int(f),
+                      -1 if m is None else int(m)))
+brute = _Entry("brute", lambda gradients, f: _call("bmt_brute", gradients,
+                                                   int(f)))
+
+
+def register_cpp_gars():
+    """Register `cpp-<gar>` ops-registry entries backed by the host tier via
+    `jax.pure_callback` (keeps them usable inside the jitted step).
+
+    Registration is eager but the g++ build is NOT: the library compiles on
+    the first actual `cpp-*` aggregate call, so importing the package stays
+    cheap and processes that never select a cpp GAR never invoke the
+    toolchain."""
+    import jax
+    import jax.numpy as jnp
+
+    from byzantinemomentum_tpu import ops
+    from byzantinemomentum_tpu.ops import bulyan as bulyan_mod
+    from byzantinemomentum_tpu.ops import brute as brute_mod
+    from byzantinemomentum_tpu.ops import krum as krum_mod
+    from byzantinemomentum_tpu.ops import median as median_mod
+
+    def wrap(entry, scalar_args):
+        def unchecked(gradients, f=None, m=None, **kwargs):
+            args = {"f": f, "m": m}
+            call_args = tuple(args[a] for a in scalar_args)
+
+            def host(g):
+                return entry.aggregate(np.asarray(g), *call_args)
+
+            shape = jax.ShapeDtypeStruct(gradients.shape[1:], jnp.float32)
+            return jax.pure_callback(host, shape, gradients, vmap_method="sequential")
+        return unchecked
+
+    ops.register("cpp-median", wrap(median, ()), median_mod.check,
+                 upper_bound=median_mod.upper_bound)
+    ops.register("cpp-krum", wrap(krum, ("f", "m")), krum_mod.check,
+                 upper_bound=krum_mod.upper_bound)
+    ops.register("cpp-bulyan", wrap(bulyan, ("f", "m")), bulyan_mod.check,
+                 upper_bound=bulyan_mod.upper_bound)
+    ops.register("cpp-brute", wrap(brute, ("f",)), brute_mod.check,
+                 upper_bound=brute_mod.upper_bound)
+    return True

@@ -1,0 +1,191 @@
+// Native (host C++) tier of the four accelerated GARs.
+//
+// Mirrors the out-of-tree CPython extension the reference opportunistically
+// imports (`native.median.aggregate`, `native.krum.aggregate`,
+// `native.bulyan.aggregate`, `native.brute.aggregate` — reference
+// `aggregators/median.py:22-26`, `krum.py:22-26`, `bulyan.py:22-26`,
+// `brute.py:23-27`). On TPU the fast tier is the XLA-compiled kernel
+// (`native-<gar>` in the ops registry); this C++ tier serves as an
+// independent host oracle for differential tests and as a CPU fast path,
+// exposed to Python via ctypes (no pybind11 in this environment).
+//
+// Semantics pinned to the framework's jnp kernels (and through them to the
+// reference): non-finite distances -> +inf, lower median with NaN-last
+// ordering, stable tie-breaking by index, Bulyan's effective
+// prune-without-score-update behavior.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+constexpr double kInf = std::numeric_limits<double>::infinity();
+
+// NaN-last ascending comparator (matches jnp.sort / torch.sort semantics)
+inline bool nan_last_less(float a, float b) {
+  const bool na = std::isnan(a), nb = std::isnan(b);
+  if (na) return false;
+  if (nb) return true;
+  return a < b;
+}
+
+// Pairwise Euclidean distances, non-finite -> +inf, +inf diagonal.
+std::vector<double> pairwise(const float* g, int n, int d) {
+  std::vector<double> dist(static_cast<size_t>(n) * n, kInf);
+  for (int i = 0; i < n - 1; ++i) {
+    for (int j = i + 1; j < n; ++j) {
+      double acc = 0.0;
+      const float* gi = g + static_cast<size_t>(i) * d;
+      const float* gj = g + static_cast<size_t>(j) * d;
+      for (int k = 0; k < d; ++k) {
+        const double diff = static_cast<double>(gi[k]) - gj[k];
+        acc += diff * diff;
+      }
+      double val = std::sqrt(acc);
+      if (!std::isfinite(val)) val = kInf;
+      dist[static_cast<size_t>(i) * n + j] = val;
+      dist[static_cast<size_t>(j) * n + i] = val;
+    }
+  }
+  return dist;
+}
+
+// Stable argsort of scores (ascending), index order breaks ties.
+std::vector<int> stable_order(const std::vector<double>& scores) {
+  std::vector<int> order(scores.size());
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int a, int b) { return scores[a] < scores[b]; });
+  return order;
+}
+
+// Mean of the rows listed in sel[0..m) into out.
+void mean_rows(const float* g, int d, const std::vector<int>& sel, int m,
+               float* out) {
+  for (int k = 0; k < d; ++k) out[k] = 0.0f;
+  for (int s = 0; s < m; ++s) {
+    const float* row = g + static_cast<size_t>(sel[s]) * d;
+    for (int k = 0; k < d; ++k) out[k] += row[k];
+  }
+  const float inv = 1.0f / static_cast<float>(m);
+  for (int k = 0; k < d; ++k) out[k] *= inv;
+}
+
+// Krum-style scores: per row, sum of the `m` smallest neighbor distances
+// (the +inf diagonal sorts last and never enters for m <= n-1).
+std::vector<double> krum_scores(const std::vector<double>& dist, int n,
+                                int m) {
+  std::vector<double> scores(n);
+  std::vector<double> row(n);
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < n; ++j) row[j] = dist[static_cast<size_t>(i) * n + j];
+    std::sort(row.begin(), row.end());
+    double acc = 0.0;
+    for (int j = 0; j < m; ++j) acc += row[j];
+    scores[i] = acc;
+  }
+  return scores;
+}
+
+// Coordinate-wise lower median with NaN-last ordering into out.
+void lower_median(const float* g, int n, int d, float* out) {
+  std::vector<float> col(n);
+  const int mid = (n - 1) / 2;
+  for (int k = 0; k < d; ++k) {
+    for (int i = 0; i < n; ++i) col[i] = g[static_cast<size_t>(i) * d + k];
+    std::nth_element(col.begin(), col.begin() + mid, col.end(), nan_last_less);
+    out[k] = col[mid];
+  }
+}
+
+// Coordinate-wise mean of the m values closest to center (stable by index).
+void closest_mean(const float* g, int n, int d, const float* center, int m,
+                  float* out) {
+  std::vector<int> idx(n);
+  std::vector<float> dev(n);
+  for (int k = 0; k < d; ++k) {
+    for (int i = 0; i < n; ++i)
+      dev[i] = std::fabs(g[static_cast<size_t>(i) * d + k] - center[k]);
+    std::iota(idx.begin(), idx.end(), 0);
+    std::stable_sort(idx.begin(), idx.end(), [&](int a, int b) {
+      return nan_last_less(dev[a], dev[b]);
+    });
+    float acc = 0.0f;
+    for (int s = 0; s < m; ++s) acc += g[static_cast<size_t>(idx[s]) * d + k];
+    out[k] = acc / static_cast<float>(m);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// median: coordinate-wise lower median (cf. `native.median.aggregate`)
+void bmt_median(const float* g, int n, int d, float* out) {
+  lower_median(g, n, d, out);
+}
+
+// krum: Multi-Krum, m < 0 means the default m = n - f - 2
+// (cf. `native.krum.aggregate`)
+void bmt_krum(const float* g, int n, int d, int f, int m, float* out) {
+  if (m < 0) m = n - f - 2;
+  const auto dist = pairwise(g, n, d);
+  const auto scores = krum_scores(dist, n, n - f - 1);
+  auto order = stable_order(scores);
+  mean_rows(g, d, order, m, out);
+}
+
+// bulyan: iterative Multi-Krum selection + averaged median
+// (cf. `native.bulyan.aggregate`; effective reference pruning)
+void bmt_bulyan(const float* g, int n, int d, int f, int m, float* out) {
+  const int m_max = n - f - 2;
+  if (m < 0) m = m_max;
+  const auto dist = pairwise(g, n, d);
+  auto scores = krum_scores(dist, n, m);
+  const int rounds = n - 2 * f - 2;
+  std::vector<float> selected(static_cast<size_t>(rounds) * d);
+  for (int i = 0; i < rounds; ++i) {
+    const int m_i = std::min(m, m_max - i);
+    auto order = stable_order(scores);
+    mean_rows(g, d, order, m_i, selected.data() + static_cast<size_t>(i) * d);
+    scores[order[0]] = kInf;
+  }
+  const int m2 = rounds - 2 * f;
+  std::vector<float> med(d);
+  lower_median(selected.data(), rounds, d, med.data());
+  closest_mean(selected.data(), rounds, d, med.data(), m2, out);
+}
+
+// brute: minimum-diameter subset of size n - f (cf. `native.brute.aggregate`)
+void bmt_brute(const float* g, int n, int d, int f, float* out) {
+  const auto dist = pairwise(g, n, d);
+  const int k = n - f;
+  std::vector<int> combo(k);
+  std::iota(combo.begin(), combo.end(), 0);
+  std::vector<int> best;
+  double best_diam = kInf;
+  for (;;) {
+    double diam = 0.0;
+    for (int a = 0; a < k - 1 && diam < best_diam; ++a)
+      for (int b = a + 1; b < k; ++b)
+        diam = std::max(diam,
+                        dist[static_cast<size_t>(combo[a]) * n + combo[b]]);
+    if (best.empty() || diam < best_diam) {
+      best_diam = diam;
+      best = combo;
+    }
+    // next combination (lexicographic)
+    int i = k - 1;
+    while (i >= 0 && combo[i] == n - k + i) --i;
+    if (i < 0) break;
+    ++combo[i];
+    for (int j = i + 1; j < k; ++j) combo[j] = combo[j - 1] + 1;
+  }
+  mean_rows(g, d, best, k, out);
+}
+
+}  // extern "C"

@@ -43,20 +43,23 @@ def pairwise_distances_sharded(g, mesh):
     `ops._common.pairwise_distances` ('dot' method): non-finite -> +inf,
     +inf diagonal.
     """
-    def kernel(g_local):
-        sq = jnp.sum(g_local * g_local, axis=1)
-        gram = g_local @ g_local.T
-        sq = jax.lax.psum(sq, MODEL)
-        gram = jax.lax.psum(gram, MODEL)
-        d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
-        d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
-        n = g_local.shape[0]
-        d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
-        return jnp.sqrt(d2)
-
     return shard_map(
-        kernel, mesh=mesh,
+        _psum_pairwise, mesh=mesh,
         in_specs=P(None, MODEL), out_specs=P(None, None))(g)
+
+
+def _psum_pairwise(g_local):
+    """Shard-local body of the distributed pairwise-distance kernel: partial
+    row-norms + partial Gram on this d-slice, psum over the model axis.
+    (Single source of truth — the semantics must match
+    `ops._common.pairwise_distances`.)"""
+    sq = jax.lax.psum(jnp.sum(g_local * g_local, axis=1), MODEL)
+    gram = jax.lax.psum(g_local @ g_local.T, MODEL)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
+    d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
+    n = g_local.shape[0]
+    d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
+    return jnp.sqrt(d2)
 
 
 def shard_gar(gar, mesh, *, f, **kwargs):
@@ -75,19 +78,11 @@ def shard_gar(gar, mesh, *, f, **kwargs):
     if gar.name in ("krum", "native-krum"):
         def kernel(g_local):
             n = g_local.shape[0]
-            dist = _psum_distances(g_local)
+            dist = _psum_pairwise(g_local)
             scores = jnp.sum(jnp.sort(dist, axis=1)[:, :n - f - 1], axis=1)
             m = kwargs.get("m") or n - f - 2
             sel = jnp.argsort(scores, stable=True)[:m]
             return jnp.mean(g_local[sel], axis=0)
-
-        def _psum_distances(g_local):
-            sq = jax.lax.psum(jnp.sum(g_local * g_local, axis=1), MODEL)
-            gram = jax.lax.psum(g_local @ g_local.T, MODEL)
-            d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
-            d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
-            n = g_local.shape[0]
-            return jnp.sqrt(jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2))
 
         return shard_map(kernel, mesh=mesh,
                          in_specs=P(None, MODEL), out_specs=P(MODEL))
@@ -98,28 +93,23 @@ def shard_gar(gar, mesh, *, f, **kwargs):
     return kernel_replicated
 
 
-def sharded_state_spec(cfg):
+def sharded_state_spec(state):
     """PartitionSpecs for a `TrainState` on a (workers, model) mesh: all
     d-dimensional buffers shard along "model"; scalars/counters/PRNG
     replicate. (BatchNorm state replicates — it is tiny.)"""
-    def net_spec(net_state):
-        return jax.tree.map(lambda _: P(), net_state)
-
-    def spec(state):
-        return TrainState(
-            theta=P(MODEL),
-            net_state=net_spec(state.net_state),
-            momentum_server=P(MODEL),
-            momentum_workers=P(None, MODEL),
-            origin=P(MODEL) if state.origin.ndim else P(),
-            past_grads=P(None, MODEL),
-            past_norms=P(),
-            past_count=P(),
-            steps=P(),
-            datapoints=P(),
-            rng=P(),
-        )
-    return spec
+    return TrainState(
+        theta=P(MODEL),
+        net_state=jax.tree.map(lambda _: P(), state.net_state),
+        momentum_server=P(MODEL),
+        momentum_workers=P(None, MODEL),
+        origin=P(MODEL) if state.origin.ndim else P(),
+        past_grads=P(None, MODEL),
+        past_norms=P(),
+        past_count=P(),
+        steps=P(),
+        datapoints=P(),
+        rng=P(),
+    )
 
 
 def sharded_train_step(engine, mesh, state_example):
@@ -134,7 +124,7 @@ def sharded_train_step(engine, mesh, state_example):
     Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
     `engine.train_step`.
     """
-    spec = sharded_state_spec(engine.cfg)(state_example)
+    spec = sharded_state_spec(state_example)
     state_shardings = jax.tree.map(
         lambda p: NamedSharding(mesh, p), spec,
         is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,146 @@
+"""Experiment scheduler: a thread pool dispatching driver subprocesses over
+devices (reference `tools/jobs.py:27-248`).
+
+Capability parity:
+* one worker thread per (device × supercharge) slot
+  (reference `jobs.py:169-191`);
+* jobs are (name, seed, command) triples run as subprocesses with captured
+  stdout/stderr written next to the results (reference `jobs.py:111-146`);
+* idempotency — a job whose final result directory already exists is
+  skipped, so interrupted grids resume for free (reference `jobs.py:126-129`);
+* failure containment — a failed run's pending directory is renamed
+  `<name>.failed` and preserved for inspection (reference `jobs.py:140-144`);
+* per-seed expansion with the reference's default seeds 1..5
+  (reference `jobs.py:169`).
+
+On TPU, "devices" are whole accelerator slices/processes rather than the
+reference's per-GPU `--device cuda:N`: each slot exports its device string
+through the `BMT_JOB_DEVICE` environment variable and passes it to the
+driver's `--device` flag.
+"""
+
+import pathlib
+import queue
+import subprocess
+import sys
+import threading
+
+from byzantinemomentum_tpu.utils import logging as _log
+
+__all__ = ["Jobs", "dict_to_cmdlist"]
+
+DEFAULT_SEEDS = (1, 2, 3, 4, 5)
+
+
+def dict_to_cmdlist(options):
+    """Flatten `{flag: value}` into a command-line fragment
+    (reference `tools/jobs.py:27-46`): None skips the flag, True emits the
+    bare flag, lists emit one flag with several values."""
+    cmd = []
+    for key, value in options.items():
+        if value is None or value is False:
+            continue
+        cmd.append(f"--{key.replace('_', '-')}")
+        if value is True:
+            continue
+        if isinstance(value, (list, tuple)):
+            cmd.extend(str(v) for v in value)
+        else:
+            cmd.append(str(value))
+    return cmd
+
+
+class Jobs:
+    """Thread-pool scheduler of driver subprocesses."""
+
+    def __init__(self, results_dir, devices=("auto",), supercharge=1,
+                 seeds=DEFAULT_SEEDS):
+        """Args mirror the reference's (`tools/jobs.py:107-124`,
+        `--supercharge` from `reproduce.py:62-65`): one worker per device
+        repeated `supercharge` times."""
+        if supercharge < 1:
+            raise ValueError(f"Expected a positive supercharge, got {supercharge}")
+        self.results_dir = pathlib.Path(results_dir)
+        self.results_dir.mkdir(parents=True, exist_ok=True)
+        self.seeds = tuple(seeds)
+        self._queue = queue.Queue()
+        self._threads = []
+        self._started = False
+        self._devices = tuple(devices) * supercharge
+
+    def submit(self, name, command):
+        """Queue one experiment under `name`; it expands into one run per
+        seed, each appending `--seed <s> --result-directory <dir>`
+        (reference `tools/jobs.py:193-217`)."""
+        for seed in self.seeds:
+            self._queue.put((f"{name}-{seed}", seed, list(command)))
+
+    def _run_one(self, slot_device, run_name, seed, command):
+        final_dir = self.results_dir / run_name
+        if final_dir.exists():
+            _log.trace(f"{run_name}: already done, skipping")
+            return
+        pending = self.results_dir / f"{run_name}.pending"
+        if pending.exists():
+            # Rotate a stale pending dir out of the way
+            # (reference `tools/jobs.py:27-46` version rotation)
+            version = 0
+            while (self.results_dir / f"{run_name}.pending.{version}").exists():
+                version += 1
+            pending.rename(self.results_dir / f"{run_name}.pending.{version}")
+        pending.mkdir(parents=True)
+        cmd = command + ["--seed", str(seed),
+                         "--device", slot_device,
+                         "--result-directory", str(pending)]
+        _log.info(f"{run_name}: starting on {slot_device!r}")
+        with (pending / "stdout.log").open("wb") as out, \
+                (pending / "stderr.log").open("wb") as err:
+            result = subprocess.run(cmd, stdout=out, stderr=err,
+                                    env=self._env(slot_device))
+        if result.returncode == 0:
+            pending.rename(final_dir)
+            _log.success(f"{run_name}: done")
+        else:
+            pending.rename(self.results_dir / f"{run_name}.failed")
+            _log.error(f"{run_name}: failed with code {result.returncode} "
+                       f"(logs kept in {run_name}.failed)")
+
+    @staticmethod
+    def _env(device):
+        import os
+        env = dict(os.environ)
+        env["BMT_JOB_DEVICE"] = device
+        return env
+
+    def _worker(self, slot_device):
+        while True:
+            try:
+                run_name, seed, command = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._run_one(slot_device, run_name, seed, command)
+            except Exception as err:
+                _log.error(f"{run_name}: scheduler error: {err}")
+            finally:
+                self._queue.task_done()
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        for i, device in enumerate(self._devices):
+            t = threading.Thread(target=self._worker, args=(device,),
+                                 name=f"jobs-{device}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def wait(self, exit_is_requested=None):
+        """Run all queued jobs to completion (reference `jobs.py:219-239`);
+        `exit_is_requested()` polls an abort latch."""
+        self.start()
+        for t in self._threads:
+            while t.is_alive():
+                t.join(timeout=0.5)
+                if exit_is_requested is not None and exit_is_requested():
+                    return

@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Reproduce the appendix WideResNet-28-10 grid
+(reference `reproduce-appendix.py`; same constants,
+reference `reproduce-appendix.py:122-158`): CIFAR-10, n=11, f in {4, 2},
+batch 20, crossentropy, piecewise lr schedule, Nesterov momentum 0.99,
+20000 steps, GARs krum/median/bulyan.
+
+Usage mirrors `reproduce.py` (idempotent result directories, `--devices`,
+`--supercharge`).
+"""
+
+import argparse
+import pathlib
+import signal
+import sys
+
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.utils.jobs import DEFAULT_SEEDS, Jobs, dict_to_cmdlist
+
+GARS = ("krum", "median", "bulyan")
+ATTACKS = (("little", ("factor:1.5", "negative:True")),
+           ("empire", "factor:1.1"))
+
+ATTACK_PY = str(pathlib.Path(__file__).resolve().parent / "attack.py")
+
+
+def make_command(params):
+    return [sys.executable, ATTACK_PY] + dict_to_cmdlist(params)
+
+
+def submit(jobs):
+    base = {
+        "batch-size": 20,
+        "model": "wide_resnet-Wide_ResNet",
+        "model-args": ("depth:28", "widen_factor:10", "dropout_rate:0.3",
+                       "num_classes:10"),
+        "learning-rate-schedule": "0.02,8000,0.004,16000,0.0008",
+        "gradient-clip": 5, "loss": "crossentropy", "momentum": 0.99,
+        "momentum-nesterov": True, "l2-regularize": 5e-4,
+        "evaluation-delta": 100, "nb-steps": 20000, "nb-for-study": 1,
+        "nb-for-study-past": 1, "nb-workers": 11,
+    }
+    for ds in ("cifar10",):
+        for f, fm in ((4, 1), (2, 0)):
+            params = dict(base, dataset=ds)
+            params["nb-workers"] = base["nb-workers"] - f
+            jobs.submit(f"{ds}-average-n_{params['nb-workers']}-lr_pow-nesterov",
+                        make_command(params))
+            for gar in GARS[:len(GARS) - fm]:
+                for attack, attargs in ATTACKS:
+                    for momentum in ("update", "worker"):
+                        params = dict(base, dataset=ds)
+                        params["nb-decl-byz"] = f
+                        params["nb-real-byz"] = f
+                        params["gar"] = gar
+                        params["attack"] = attack
+                        params["attack-args"] = attargs
+                        params["momentum-at"] = momentum
+                        jobs.submit(
+                            f"{ds}-{attack}-{gar}-f_{f}-lr_pow"
+                            f"-at_{momentum}-nesterov",
+                            make_command(params))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-directory", type=str,
+                        default="results-data-appendix")
+    parser.add_argument("--devices", type=str, default="auto")
+    parser.add_argument("--supercharge", type=int, default=1)
+    args = parser.parse_args()
+
+    exit_trigger, exit_is_requested = utils.onetime(None)
+    signal.signal(signal.SIGINT, lambda *_: exit_trigger())
+    signal.signal(signal.SIGTERM, lambda *_: exit_trigger())
+
+    jobs = Jobs(pathlib.Path(args.data_directory),
+                devices=args.devices.split(","),
+                supercharge=args.supercharge, seeds=DEFAULT_SEEDS)
+    with utils.Context("experiments", "info"):
+        submit(jobs)
+        jobs.wait(exit_is_requested)
+
+
+if __name__ == "__main__":
+    main()

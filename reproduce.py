@@ -1,0 +1,246 @@
+#!/usr/bin/env python3
+"""Reproduce the paper's experiment grid: schedule every (dataset, GAR,
+attack, f, lr, momentum-at, nesterov, seed) driver run, then analyze and
+plot (reference `reproduce.py`; same grid constants,
+reference `reproduce.py:109-213`).
+
+Usage:
+  python3 reproduce.py [--data-directory results-data]
+                       [--plot-directory results-plot]
+                       [--devices auto[,auto...]] [--supercharge N]
+                       [--subset smoke|mnist|cifar|all]
+
+The grid is idempotent: completed result directories are skipped, failed
+ones are kept as `<name>.failed` (reference `tools/jobs.py:126-146`).
+`--subset smoke` runs a tiny 2-run sanity grid (not part of the paper).
+"""
+
+import argparse
+import pathlib
+import signal
+import sys
+
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.utils.jobs import DEFAULT_SEEDS, Jobs, dict_to_cmdlist
+
+# The paper's GAR list (reference `reproduce.py:109`)
+GARS = ("krum", "median", "trmean", "phocas", "meamed", "bulyan")
+# The paper's attacks (reference `reproduce.py:151`)
+ATTACKS = (("little", ("factor:1.5", "negative:True")),
+           ("empire", "factor:1.1"))
+
+ATTACK_PY = str(pathlib.Path(__file__).resolve().parent / "attack.py")
+
+
+def make_command(params):
+    return [sys.executable, ATTACK_PY] + dict_to_cmdlist(params)
+
+
+def submit_mnist(jobs):
+    """(Fashion-)MNIST grid (reference `reproduce.py:121-162`)."""
+    base = {
+        "batch-size": 83, "model": "simples-full", "loss": "nll",
+        "learning-rate-decay-delta": 300, "momentum": 0.9,
+        "l2-regularize": 1e-4, "evaluation-delta": 5, "gradient-clip": 2,
+        "nb-steps": 300, "nb-for-study": 1, "nb-for-study-past": 150,
+        "nb-workers": 51,
+    }
+    for ds in ("mnist", "fashionmnist"):
+        for f, fm in ((24, 1), (12, 0)):
+            for lr in (0.5, 0.02):
+                for nesterov in (False, True):
+                    suffix = "-nesterov" if nesterov else ""
+                    params = dict(base, dataset=ds)
+                    params["nb-workers"] = base["nb-workers"] - f
+                    params["learning-rate"] = lr
+                    params["momentum-nesterov"] = nesterov
+                    jobs.submit(
+                        f"{ds}-average-n_{params['nb-workers']}-lr_{lr}{suffix}",
+                        make_command(params))
+                    for gar in GARS[:len(GARS) - fm]:
+                        for attack, attargs in ATTACKS:
+                            for momentum in ("update", "worker"):
+                                params = dict(base, dataset=ds)
+                                params["learning-rate"] = lr
+                                params["nb-decl-byz"] = f
+                                params["nb-real-byz"] = f
+                                params["gar"] = gar
+                                params["attack"] = attack
+                                params["attack-args"] = attargs
+                                params["momentum-at"] = momentum
+                                params["momentum-nesterov"] = nesterov
+                                jobs.submit(
+                                    f"{ds}-{attack}-{gar}-f_{f}-lr_{lr}"
+                                    f"-at_{momentum}{suffix}",
+                                    make_command(params))
+
+
+def submit_cifar(jobs):
+    """CIFAR-10/100 grid (reference `reproduce.py:164-209`)."""
+    base = {
+        "batch-size": 50, "model": "empire-cnn", "loss": "nll",
+        "learning-rate-decay": 167, "momentum": 0.99, "l2-regularize": 1e-2,
+        "evaluation-delta": 100, "gradient-clip": 5, "nb-steps": 3000,
+        "nb-for-study": 1, "nb-for-study-past": 25, "nb-workers": 25,
+    }
+    for ds, mp in (("cifar10", "cifar100:False"), ("cifar100", "cifar100:True")):
+        for f, fm in ((11, 1), (5, 0)):
+            for lr, dd in ((0.01, 1500), (0.001, 3000)):
+                for nesterov in (False, True):
+                    suffix = "-nesterov" if nesterov else ""
+                    params = dict(base, dataset=ds)
+                    params["model-args"] = mp
+                    params["nb-workers"] = base["nb-workers"] - f
+                    params["learning-rate"] = lr
+                    params["learning-rate-decay-delta"] = dd
+                    params["momentum-nesterov"] = nesterov
+                    jobs.submit(
+                        f"{ds}-average-n_{params['nb-workers']}-lr_{lr}{suffix}",
+                        make_command(params))
+                    for gar in GARS[:len(GARS) - fm]:
+                        for attack, attargs in ATTACKS:
+                            for momentum in ("update", "worker"):
+                                params = dict(base, dataset=ds)
+                                params["model-args"] = mp
+                                params["learning-rate"] = lr
+                                params["learning-rate-decay-delta"] = dd
+                                params["nb-decl-byz"] = f
+                                params["nb-real-byz"] = f
+                                params["gar"] = gar
+                                params["attack"] = attack
+                                params["attack-args"] = attargs
+                                params["momentum-at"] = momentum
+                                params["momentum-nesterov"] = nesterov
+                                jobs.submit(
+                                    f"{ds}-{attack}-{gar}-f_{f}-lr_{lr}"
+                                    f"-at_{momentum}{suffix}",
+                                    make_command(params))
+
+
+def submit_smoke(jobs):
+    """Tiny sanity grid (non-paper) to validate the pipeline end-to-end."""
+    base = {
+        "batch-size": 16, "model": "simples-full", "loss": "nll",
+        "momentum": 0.9, "evaluation-delta": 2, "nb-steps": 4,
+        "nb-for-study": 11, "nb-for-study-past": 3, "nb-workers": 11,
+        "batch-size-test": 32, "batch-size-test-reps": 2,
+    }
+    for gar, f in (("median", 4), ("krum", 3)):
+        params = dict(base, gar=gar)
+        params["nb-decl-byz"] = f
+        params["nb-real-byz"] = f
+        params["attack"] = "empire"
+        params["attack-args"] = "factor:1.1"
+        jobs.submit(f"smoke-{gar}-f_{f}", make_command(params))
+
+
+def analyze(data_dir, plot_dir):
+    """Summary statistics + plots over completed result directories
+    (reference `reproduce.py:258-366`, `459-635`)."""
+    import numpy as np
+
+    import study
+
+    paths = sorted(p for p in data_dir.iterdir() if p.is_dir()
+                   and ".failed" not in p.name and ".pending" not in p.name)
+    if not paths:
+        utils.warning("No completed result directory to analyze")
+        return
+    plot_dir.mkdir(parents=True, exist_ok=True)
+
+    # Per-run max accuracy + ratio-condition counting
+    expwith = expzero = 0
+    best_ratio = None
+    with utils.Context("analysis", "info"):
+        for path in paths:
+            sess = study.Session(path)
+            if sess.data is None:
+                continue
+            acc = (sess.data["Cross-accuracy"].max()
+                   if "Cross-accuracy" in sess.data.columns else float("nan"))
+            line = f"{path.name}: max accuracy {acc:.4f}"
+            if sess.has_known_ratio():
+                expwith += 1
+                data = sess.compute_ratio(nowarn=True).data
+                valid = data["Ratio enough for GAR?"].fillna(False)
+                nbvalid = int(valid.sum())
+                nbtotal = max(int(data["Ratio enough for GAR?"].notna().sum()), 1)
+                pct = nbvalid / nbtotal * 100.0
+                if nbvalid == 0:
+                    expzero += 1
+                elif best_ratio is None or pct > best_ratio[2]:
+                    best_ratio = (nbvalid, nbtotal, pct)
+                line += f"; ratio ok {nbvalid}/{nbtotal} ({pct:.2f}%)"
+            utils.info(line)
+        if expwith:
+            utils.info(f"#experiments with ratio never validated: "
+                       f"{expzero}/{expwith} ({expzero / expwith * 100.:.2f}%)")
+        if best_ratio is not None:
+            utils.info(f"Maximum #steps with ratio validated: "
+                       f"{best_ratio[0]}/{best_ratio[1]} ({best_ratio[2]:.2f}%)")
+
+    # Accuracy curves with mean±std bands across seeds
+    groups = {}
+    for path in paths:
+        stem = path.name.rsplit("-", 1)[0]  # strip the -<seed> suffix
+        groups.setdefault(stem, []).append(path)
+    with utils.Context("plotting", "info"):
+        for stem, members in groups.items():
+            frames = []
+            for path in members:
+                sess = study.Session(path)
+                if sess.data is not None and "Cross-accuracy" in sess.data.columns:
+                    frames.append(sess.data["Cross-accuracy"].dropna())
+            if not frames:
+                continue
+            import pandas
+            joined = pandas.concat(frames, axis=1)
+            mean = joined.mean(axis=1)
+            std = joined.std(axis=1)
+            frame = pandas.DataFrame({
+                "Cross-accuracy": mean, "Cross-accuracy (std)": std})
+            plot = study.LinePlot()
+            plot.include(frame, "Cross-accuracy", errs=" (std)",
+                         label=stem)
+            plot.finalize(stem, "Step number", "Cross-accuracy", ymin=0.0,
+                          ymax=1.0)
+            plot.save(plot_dir / f"{stem}.png", xsize=4, ysize=3)
+            plot.close()
+        utils.info(f"Plots written to {plot_dir}")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-directory", type=str, default="results-data")
+    parser.add_argument("--plot-directory", type=str, default="results-plot")
+    parser.add_argument("--devices", type=str, default="auto",
+                        help="Comma-separated device list, one job slot each")
+    parser.add_argument("--supercharge", type=int, default=1,
+                        help="Concurrent runs per device")
+    parser.add_argument("--subset", type=str, default="all",
+                        choices=("smoke", "mnist", "cifar", "all"))
+    args = parser.parse_args()
+
+    exit_trigger, exit_is_requested = utils.onetime(None)
+    signal.signal(signal.SIGINT, lambda *_: exit_trigger())
+    signal.signal(signal.SIGTERM, lambda *_: exit_trigger())
+
+    data_dir = pathlib.Path(args.data_directory)
+    jobs = Jobs(data_dir, devices=args.devices.split(","),
+                supercharge=args.supercharge,
+                seeds=(1,) if args.subset == "smoke" else DEFAULT_SEEDS)
+    with utils.Context("experiments", "info"):
+        if args.subset == "smoke":
+            submit_smoke(jobs)
+        if args.subset in ("mnist", "all"):
+            submit_mnist(jobs)
+        if args.subset in ("cifar", "all"):
+            submit_cifar(jobs)
+        jobs.wait(exit_is_requested)
+
+    if not exit_is_requested():
+        analyze(data_dir, pathlib.Path(args.plot_directory))
+
+
+if __name__ == "__main__":
+    main()

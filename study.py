@@ -1,0 +1,346 @@
+#!/usr/bin/env python3
+"""Result-analysis library (NOT a main module — import it from an analysis
+script or notebook, reference `study.py:18-19`).
+
+Capability parity with the reference's `study.py`:
+* `Session` — loads one result directory (config, config.json, study CSV,
+  eval CSV) into a joined pandas DataFrame (reference `study.py:185-242`);
+  derived columns: epoch number, reconstructed learning rate, the
+  (deviation/norm)² ratio columns and the "Ratio enough for GAR?" check
+  against the GAR's theoretical `upper_bound(n, f, d)`
+  (reference `study.py:295-396`).
+* `LinePlot` / `BoxPlot` — thin matplotlib wrappers with mean±error bands,
+  dual y-axes, and box/violin overviews (reference `study.py:403-749`).
+"""
+
+import json
+import math
+import pathlib
+
+import pandas
+
+from byzantinemomentum_tpu import models, ops, utils
+
+__all__ = ["Session", "LinePlot", "BoxPlot"]
+
+# Training-set sizes for epoch derivation (reference `study.py:309`)
+TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000,
+                  "cifar10": 50000, "cifar100": 50000}
+
+
+class Session:
+    """Loaded results of one run directory."""
+
+    def __init__(self, path_results):
+        path_results = pathlib.Path(path_results)
+        if not path_results.exists():
+            raise utils.UserException(
+                f"Result directory {str(path_results)} cannot be accessed or "
+                f"does not exist")
+        self.name = path_results.name
+        self.path = path_results
+        self.config = self._read_text(path_results / "config")
+        self.json = self._read_json(path_results / "config.json")
+        data_study = self._read_csv(path_results / "study")
+        data_eval = self._read_csv(path_results / "eval")
+        if data_study is not None and data_eval is not None:
+            self.data = data_study.join(data_eval, how="outer")
+        else:
+            self.data = data_study if data_study is not None else data_eval
+        self.thresh = None
+
+    @staticmethod
+    def _read_text(path):
+        try:
+            return path.read_text().strip()
+        except Exception as err:
+            utils.warning(f"{path}: unable to read ({err})")
+            return None
+
+    @staticmethod
+    def _read_json(path):
+        try:
+            return json.loads(path.read_text())
+        except Exception as err:
+            utils.warning(f"{path}: unable to read ({err})")
+            return None
+
+    @staticmethod
+    def _read_csv(path):
+        """Parse the '# '-prefixed tab-separated result format
+        (reference `attack.py:403-448` writer, `study.py:216-229` reader)."""
+        try:
+            data = pandas.read_csv(path, sep="\t", index_col=0)
+            data.index.name = "Step number"
+            return data
+        except Exception as err:
+            utils.warning(f"{path}: unable to read ({err})")
+            return None
+
+    # ------------------------------------------------------------- #
+
+    def get(self, *only_columns):
+        """The DataFrame, optionally restricted to the given columns."""
+        if not only_columns:
+            return self.data
+        return self.data[list(only_columns)]
+
+    def has_known_ratio(self):
+        """Whether the run's GAR has a theoretical ratio bound."""
+        return self.calc_max_ratio(nowarn=True) is not None
+
+    def compute_all(self, nowarn=False):
+        """All derived columns (chainable)."""
+        self.compute_epoch()
+        self.compute_lr()
+        self.compute_ratio(nowarn=nowarn)
+        return self
+
+    def compute_epoch(self):
+        """Epoch number = training point count / train-set size
+        (reference `study.py:295-315`)."""
+        if "Epoch number" in self.data.columns:
+            return self
+        if self.json is None or "dataset" not in self.json:
+            utils.warning("No valid JSON configuration, cannot compute the "
+                          "epoch number")
+            return self
+        size = TRAINING_SIZES.get(self.json["dataset"])
+        if size is None:
+            utils.warning(f"Unknown dataset {self.json['dataset']!r}, cannot "
+                          f"compute the epoch number")
+            return self
+        self.data["Epoch number"] = self.data["Training point count"] / size
+        return self
+
+    def compute_lr(self):
+        """Reconstruct the per-step learning rate from the config
+        (reference `study.py:317-342`; schedules supported here, which the
+        reference leaves as a warning)."""
+        if "Learning rate" in self.data.columns:
+            return self
+        if self.json is None or "learning_rate" not in self.json:
+            utils.warning("No valid JSON configuration, cannot compute the "
+                          "learning rate")
+            return self
+        schedule = self.json.get("learning_rate_schedule")
+        steps = self.data.index
+        if schedule is None:
+            lr = self.json["learning_rate"]
+            decay = self.json.get("learning_rate_decay", 0)
+            delta = self.json.get("learning_rate_decay_delta", 1)
+            if decay > 0:
+                self.data["Learning rate"] = lr / (
+                    (steps // delta * delta) / decay + 1)
+            else:
+                self.data["Learning rate"] = lr
+        else:
+            flat = schedule.split(",")
+            pairs = [(0, float(flat[0]))]
+            for i in range(1, len(flat), 2):
+                pairs.append((int(flat[i]), float(flat[i + 1])))
+
+            def lr_at(step):
+                current = pairs[0][1]
+                for boundary, value in pairs:
+                    if boundary <= step:
+                        current = value
+                return current
+            self.data["Learning rate"] = [lr_at(s) for s in steps]
+        return self
+
+    def calc_max_ratio(self, nowarn=False):
+        """The GAR's theoretical max std-dev/norm ratio `upper_bound(n, f, d)`
+        with d = the model's parameter count (reference `study.py:344-374`)."""
+        if self.thresh is not None:
+            return None if self.thresh < 0 else self.thresh
+        if self.json is None or not all(
+                k in self.json for k in ("gar", "nb_workers", "nb_decl_byz")):
+            utils.warning("No valid JSON configuration, cannot compute the "
+                          "maximum variance-norm ratio")
+            return None
+        rule = ops.gars.get(self.json["gar"])
+        if rule is None or rule.upper_bound is None:
+            if not nowarn:
+                utils.warning(f"GAR {self.json['gar']!r} has no known ratio "
+                              f"threshold")
+            self.thresh = -1
+            return None
+        n = self.json["nb_workers"]
+        f = self.json["nb_decl_byz"]
+        model_args = self.json.get("model_args") or {}
+        d = models.build(self.json["model"], **model_args).param_count()
+        self.thresh = rule.upper_bound(n, f, d)
+        return self.thresh
+
+    def compute_ratio(self, nowarn=False):
+        """(deviation/norm)² ratio columns + the per-step check against the
+        GAR bound (reference `study.py:376-396`)."""
+        for clsname in ("Sampled", "Honest"):
+            column = f"{clsname} ratio"
+            if column not in self.data.columns:
+                self.data[column] = (
+                    self.data[f"{clsname} gradient deviation"]
+                    / self.data[f"{clsname} gradient norm"]) ** 2
+        if "Ratio enough for GAR?" not in self.data.columns:
+            max_ratio = self.calc_max_ratio(nowarn=nowarn)
+            if max_ratio is not None:
+                self.data["Ratio enough for GAR?"] = (
+                    self.data["Honest ratio"] < max_ratio ** 2)
+        return self
+
+    def __repr__(self):
+        return f"Session({self.name!r})"
+
+
+# --------------------------------------------------------------------------- #
+# Plotting
+
+def _plt():
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+    return plt
+
+
+LINESTYLES = ("-", "--", ":", "-.")
+
+
+class LinePlot:
+    """Line plot with optional ±error bands and up to two y-axes
+    (reference `study.py:403-619`)."""
+
+    def __init__(self, index=None):
+        plt = _plt()
+        self._fig, self._ax = plt.subplots()
+        self._axs = {}
+        self._tax = None
+        self._idx = index
+        self._cnt = 0
+        self._fin = False
+
+    def _get_ax(self, key):
+        if key in self._axs:
+            return self._axs[key]
+        if len(self._axs) >= 2:
+            raise RuntimeError("Line plot cannot have a 3rd y-axis")
+        ax = self._ax if not self._axs else self._ax.twinx()
+        if self._axs:
+            self._tax = ax
+        self._axs[key] = ax
+        return ax
+
+    def include(self, data, *cols, errs=None, lalp=1.0, label=None, ccnt=None):
+        """Plot the given column(s) of a Session/DataFrame; a column named
+        `<col><errs>` provides the ± band (reference `study.py:465-524`)."""
+        if isinstance(data, Session):
+            data = data.data
+        x = data.index if self._idx is None else data[self._idx]
+        for col in cols:
+            ln = self._cnt if ccnt is None else ccnt
+            style = LINESTYLES[ln % len(LINESTYLES)]
+            color = f"C{ln}"
+            ax = self._get_ax(cols[0])
+            y = data[col]
+            ax.plot(x, y, style, color=color, alpha=lalp,
+                    label=label or col)
+            if errs is not None and (col + errs) in data.columns:
+                e = data[col + errs]
+                ax.fill_between(x, y - e, y + e, color=color, alpha=0.2 * lalp)
+            self._cnt += 1
+        return self
+
+    def finalize(self, title, xlabel, ylabel, zlabel=None, xmin=None,
+                 xmax=None, ymin=None, ymax=None, zmin=None, zmax=None,
+                 legend=None):
+        """Titles, labels, limits, legend (reference `study.py:526-579`)."""
+        self._ax.set_title(title)
+        self._ax.set_xlabel(xlabel)
+        self._ax.set_ylabel(ylabel)
+        self._ax.set_xlim(left=xmin, right=xmax)
+        self._ax.set_ylim(bottom=ymin, top=ymax)
+        if self._tax is not None:
+            if zlabel is not None:
+                self._tax.set_ylabel(zlabel)
+            self._tax.set_ylim(bottom=zmin, top=zmax)
+        handles, labels = self._ax.get_legend_handles_labels()
+        if self._tax is not None:
+            h2, l2 = self._tax.get_legend_handles_labels()
+            handles += h2
+            labels += l2
+        if labels:
+            self._ax.legend(handles, labels,
+                            loc=legend if legend is not None else "best")
+        self._fig.tight_layout()
+        self._fin = True
+        return self
+
+    def display(self):
+        self._fig.show()
+        return self
+
+    def save(self, path, dpi=200, xsize=3, ysize=2):
+        self._fig.set_size_inches(xsize, ysize)
+        self._fig.savefig(str(path), dpi=dpi, bbox_inches="tight")
+        return self
+
+    def close(self):
+        import matplotlib.pyplot as plt
+        plt.close(self._fig)
+
+
+class BoxPlot:
+    """Box/violin overview across runs (reference `study.py:621-749`)."""
+
+    def __init__(self, index=None):
+        plt = _plt()
+        self._fig, self._ax = plt.subplots()
+        self._values = []
+        self._labels = []
+        self._hlines = []
+
+    def include(self, data, label):
+        """Add one distribution: a Session column selection, Series or
+        array (reference `study.py:645-665`)."""
+        if isinstance(data, Session):
+            data = data.data
+        values = getattr(data, "values", data)
+        values = [v for v in list(values) if v == v]  # drop NaN
+        self._values.append(values)
+        self._labels.append(label)
+        return self
+
+    def hline(self, y):
+        self._hlines.append(y)
+        return self
+
+    def finalize(self, title, ylabel, ymin=None, ymax=None, violin=False):
+        if violin:
+            self._ax.violinplot(self._values, showmedians=True)
+            self._ax.set_xticks(range(1, len(self._labels) + 1))
+            self._ax.set_xticklabels(self._labels, rotation=45, ha="right")
+        else:
+            self._ax.boxplot(self._values, tick_labels=self._labels)
+            for tick in self._ax.get_xticklabels():
+                tick.set_rotation(45)
+                tick.set_ha("right")
+        for y in self._hlines:
+            self._ax.axhline(y, linestyle="--", color="gray", linewidth=1)
+        self._ax.set_title(title)
+        self._ax.set_ylabel(ylabel)
+        self._ax.set_ylim(bottom=ymin, top=ymax)
+        self._fig.tight_layout()
+        return self
+
+    def display(self):
+        self._fig.show()
+        return self
+
+    def save(self, path, dpi=200, xsize=3, ysize=2):
+        self._fig.set_size_inches(xsize, ysize)
+        self._fig.savefig(str(path), dpi=dpi, bbox_inches="tight")
+        return self
+
+    def close(self):
+        import matplotlib.pyplot as plt
+        plt.close(self._fig)

@@ -1,0 +1,74 @@
+"""Native C++ tier tests: differential against the jnp kernels (which are
+themselves differentially tested against the torch oracles), including NaN
+resilience, and the `cpp-<gar>` pure_callback registry path."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzantinemomentum_tpu import native, ops
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def rand(n, d, seed=0, nan_rows=0):
+    rng = np.random.default_rng(seed)
+    g = rng.normal(size=(n, d)).astype(np.float32)
+    g[:nan_rows] = np.nan
+    return g
+
+
+@pytest.mark.parametrize("nan_rows", [0, 2])
+def test_median_matches_jnp(nan_rows):
+    g = rand(11, 33, seed=1, nan_rows=nan_rows)
+    got = native.median.aggregate(g)
+    want = np.asarray(ops.gars["median"].unchecked(jnp.asarray(g)))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert np.isfinite(got).all()
+
+
+@pytest.mark.parametrize("m", [None, 2])
+@pytest.mark.parametrize("nan_rows", [0, 2])
+def test_krum_matches_jnp(m, nan_rows):
+    g = rand(13, 24, seed=2, nan_rows=nan_rows)
+    got = native.krum.aggregate(g, 3, m)
+    want = np.asarray(ops.gars["krum"].unchecked(jnp.asarray(g), f=3, m=m))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("nan_rows", [0, 2])
+def test_bulyan_matches_jnp(nan_rows):
+    g = rand(13, 24, seed=3, nan_rows=nan_rows)
+    got = native.bulyan.aggregate(g, 2)
+    want = np.asarray(ops.gars["bulyan"].unchecked(jnp.asarray(g), f=2))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("nan_rows", [0, 2])
+def test_brute_matches_jnp(nan_rows):
+    g = rand(9, 16, seed=4, nan_rows=nan_rows)
+    got = native.brute.aggregate(g, 2)
+    want = np.asarray(ops.gars["brute"].unchecked(jnp.asarray(g), f=2))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_cpp_registry_entries_under_jit():
+    g = jnp.asarray(rand(13, 20, seed=5))
+    for name, kwargs in (("cpp-median", {}), ("cpp-krum", {}),
+                         ("cpp-bulyan", {"f": 2}), ("cpp-brute", {})):
+        f = kwargs.get("f", 3)
+        got = jax.jit(
+            lambda G, name=name, f=f: ops.gars[name].unchecked(G, f=f))(g)
+        want = ops.gars[name.removeprefix("cpp-")].unchecked(g, f=f)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+
+def test_large_d_consistency():
+    """The native tier must agree on realistic gradient sizes too."""
+    g = rand(11, 5000, seed=6)
+    got = native.krum.aggregate(g, 2)
+    want = np.asarray(ops.gars["krum"].unchecked(jnp.asarray(g), f=2))
+    np.testing.assert_allclose(got, want, atol=1e-4)

@@ -1,0 +1,111 @@
+"""L5 analysis tests: Session loading/derived columns and the Jobs
+scheduler's idempotency/failure contracts (reference `study.py:185-396`,
+`tools/jobs.py:107-248`)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import study
+from byzantinemomentum_tpu.cli.attack import main
+from byzantinemomentum_tpu.utils.jobs import Jobs, dict_to_cmdlist
+
+
+@pytest.fixture(autouse=True)
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+@pytest.fixture(scope="module")
+def result_dir(tmp_path_factory):
+    resdir = tmp_path_factory.mktemp("results") / "run"
+    os.environ.setdefault("BMT_SYNTH_TRAIN", "512")
+    os.environ.setdefault("BMT_SYNTH_TEST", "128")
+    main(["--nb-steps", "4", "--batch-size", "8", "--batch-size-test", "32",
+          "--batch-size-test-reps", "2", "--evaluation-delta", "2",
+          "--model", "simples-full", "--seed", "4", "--gar", "krum",
+          "--nb-decl-byz", "3", "--nb-real-byz", "3", "--attack", "empire",
+          "--attack-args", "factor:1.1", "--nb-for-study", "11",
+          "--nb-for-study-past", "2", "--result-directory", str(resdir)])
+    return resdir
+
+
+def test_session_loads_and_joins(result_dir):
+    sess = study.Session(result_dir)
+    assert sess.json["gar"] == "krum"
+    assert "Average loss" in sess.data.columns
+    assert "Cross-accuracy" in sess.data.columns  # joined from eval
+    assert sess.data.index.name == "Step number"
+
+
+def test_session_derived_columns(result_dir):
+    sess = study.Session(result_dir).compute_all()
+    data = sess.data
+    # Epoch = points / 60000 (mnist hardcoded size, reference study.py:309)
+    row = data.dropna(subset=["Training point count"]).iloc[1]
+    np.testing.assert_allclose(row["Epoch number"],
+                               row["Training point count"] / 60000)
+    # Hyperbolic lr reconstruction
+    assert "Learning rate" in data.columns
+    # Ratio columns + the bound check (krum has an upper_bound)
+    assert "Honest ratio" in data.columns
+    assert "Ratio enough for GAR?" in data.columns
+    assert sess.has_known_ratio()
+    np.testing.assert_allclose(
+        row["Honest ratio"],
+        (row["Honest gradient deviation"] / row["Honest gradient norm"]) ** 2)
+
+
+def test_session_missing_directory():
+    from byzantinemomentum_tpu import utils
+    with pytest.raises(utils.UserException):
+        study.Session("/nonexistent/result/dir")
+
+
+def test_line_and_box_plots(result_dir, tmp_path):
+    sess = study.Session(result_dir)
+    plot = study.LinePlot()
+    plot.include(sess, "Average loss")
+    plot.finalize("t", "step", "loss")
+    plot.save(tmp_path / "line.png")
+    plot.close()
+    box = study.BoxPlot()
+    box.include(sess.data["Average loss"], "run")
+    box.hline(1.0)
+    box.finalize("t", "loss")
+    box.save(tmp_path / "box.png")
+    box.close()
+    assert (tmp_path / "line.png").stat().st_size > 0
+    assert (tmp_path / "box.png").stat().st_size > 0
+
+
+def test_dict_to_cmdlist():
+    cmd = dict_to_cmdlist({
+        "nb-steps": 3, "momentum-nesterov": True, "skip-me": None,
+        "off": False, "attack-args": ("factor:1.5", "negative:True")})
+    assert cmd == ["--nb-steps", "3", "--momentum-nesterov",
+                   "--attack-args", "factor:1.5", "negative:True"]
+
+
+def test_jobs_run_skip_and_fail(tmp_path):
+    jobs = Jobs(tmp_path, devices=("auto",), seeds=(1,))
+    ok = [sys.executable, "-c",
+          "import sys, pathlib; "
+          "pathlib.Path(sys.argv[sys.argv.index('--result-directory')+1], "
+          "'out.txt').write_text('done')"]
+    bad = [sys.executable, "-c", "import sys; sys.exit(3)"]
+    jobs.submit("good", ok)
+    jobs.submit("bad", bad)
+    jobs.wait()
+    assert (tmp_path / "good-1" / "out.txt").read_text() == "done"
+    assert (tmp_path / "bad-1.failed" / "stderr.log").exists()
+    # Idempotency: resubmitting the completed job must skip it
+    marker = tmp_path / "good-1" / "out.txt"
+    marker.write_text("untouched")
+    jobs2 = Jobs(tmp_path, devices=("auto",), seeds=(1,))
+    jobs2.submit("good", ok)
+    jobs2.wait()
+    assert marker.read_text() == "untouched"
