@@ -24,7 +24,6 @@ os.environ.setdefault("BMT_SYNTH_TEST", "500")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 
 from byzantinemomentum_tpu import attacks, data, losses, models, ops  # noqa: E402
 from byzantinemomentum_tpu.engine import EngineConfig, build_engine  # noqa: E402
@@ -51,23 +50,26 @@ def main():
 
     state = engine.init(jax.random.PRNGKey(0))
     trainset, _ = data.make_datasets("cifar10", BATCH, BATCH, seed=0)
+    from byzantinemomentum_tpu.data.device import DeviceData
+    train_data = DeviceData(trainset)
+    engine.attach_data(train_data)
     S = cfg.nb_sampled
     lr = jnp.float32(0.01)
 
     def batches():
-        xs, ys = zip(*(trainset.sample() for _ in range(S)))
-        return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+        idx, flips = train_data.sample_indices(S)
+        return jnp.asarray(idx), jnp.asarray(flips)
 
     for _ in range(WARMUP_STEPS):
-        xs, ys = batches()
-        state, metrics = engine.train_step(state, xs, ys, lr)
+        idx, flips = batches()
+        state, metrics = engine.train_step_indexed(state, idx, flips, lr)
     jax.block_until_ready(state.theta)
 
     steps = 0
     start = time.monotonic()
     while True:
-        xs, ys = batches()
-        state, metrics = engine.train_step(state, xs, ys, lr)
+        idx, flips = batches()
+        state, metrics = engine.train_step_indexed(state, idx, flips, lr)
         steps += 1
         if steps >= MAX_MEASURE_STEPS:
             break

@@ -362,6 +362,15 @@ def main(argv=None):
         engine = build_engine(
             cfg=cfg, model_def=model_def, loss=loss, criterion=criterion,
             defenses=defenses, attack=attack, attack_kwargs=args.attack_args)
+        # Device-resident input fast path: stage the datasets in device
+        # memory once; per step only (S, B) index/flip arrays cross the host
+        # boundary (see `data/device.py`)
+        from byzantinemomentum_tpu.data.device import DeviceData
+        use_device_data = (DeviceData.supports(trainset)
+                           and DeviceData.supports(testset))
+        if use_device_data:
+            train_data, test_data = DeviceData.pair(trainset, testset)
+            engine.attach_data(train_data, test_data)
 
         # One-time contract validation (the reference validates on every call
         # through the 'checked' wrappers, `aggregators/__init__.py:52-61`;
@@ -453,9 +462,15 @@ def main(argv=None):
                 correct = 0.0
                 count = 0.0
                 for _ in range(args.batch_size_test_reps):
-                    x, y = testset.sample()
-                    res = engine.eval_step(state.theta, state.net_state,
-                                           jnp.asarray(x), jnp.asarray(y))
+                    if use_device_data:
+                        idx, flips = test_data.sample_indices(1)
+                        res = engine.eval_step_indexed(
+                            state.theta, state.net_state,
+                            jnp.asarray(idx[0]), jnp.asarray(flips[0]))
+                    else:
+                        x, y = testset.sample()
+                        res = engine.eval_step(state.theta, state.net_state,
+                                               jnp.asarray(x), jnp.asarray(y))
                     correct += float(res[0])
                     count += float(res[1])
                 acc = correct / count
@@ -483,18 +498,27 @@ def main(argv=None):
             S = cfg.nb_sampled
             k = cfg.nb_local_steps
             need = S * k
-            xs, ys = zip(*(trainset.sample() for _ in range(need)))
-            xs = np.stack(xs)
-            ys = np.stack(ys)
-            if k > 1:
-                xs = xs.reshape((S, k) + xs.shape[1:])
-                ys = ys.reshape((S, k) + ys.shape[1:])
             # 'Training point count' is the value at loop entry, BEFORE this
             # step's increment (reference `attack.py:696, 844`)
             datapoints = int(state.datapoints)
-            state, metrics = engine.train_step(
-                state, jnp.asarray(xs), jnp.asarray(ys),
-                jnp.float32(current_lr))
+            if use_device_data:
+                idx, flips = train_data.sample_indices(need)
+                if k > 1:
+                    idx = idx.reshape((S, k) + idx.shape[1:])
+                    flips = flips.reshape((S, k) + flips.shape[1:])
+                state, metrics = engine.train_step_indexed(
+                    state, jnp.asarray(idx), jnp.asarray(flips),
+                    jnp.float32(current_lr))
+            else:
+                xs, ys = zip(*(trainset.sample() for _ in range(need)))
+                xs = np.stack(xs)
+                ys = np.stack(ys)
+                if k > 1:
+                    xs = xs.reshape((S, k) + xs.shape[1:])
+                    ys = ys.reshape((S, k) + ys.shape[1:])
+                state, metrics = engine.train_step(
+                    state, jnp.asarray(xs), jnp.asarray(ys),
+                    jnp.float32(current_lr))
             if fd_study is not None:
                 metrics = jax.device_get(metrics)
                 row = [steps, datapoints]
@@ -505,6 +529,13 @@ def main(argv=None):
 
         if results is not None:
             results.close()
+    # A bounded run cut short by SIGINT/SIGTERM must not look successful:
+    # the Jobs scheduler treats exit 0 as "complete" and would permanently
+    # mark a truncated result directory as done (`utils/jobs.py`). Unlimited
+    # runs (--nb-steps < 0) are legitimately stopped by a signal.
+    if (exit_is_requested() and steps_limit is not None
+            and int(state.steps) < steps_limit):
+        return 130
     return 0
 
 

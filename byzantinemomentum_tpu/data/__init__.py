@@ -92,8 +92,10 @@ class Dataset:
     def batch_size(self):
         return self._batch
 
-    def sample(self):
-        """Return the next `(inputs f32[B, ...], labels[B])` batch."""
+    def sample_indices(self):
+        """Advance the sampler and return the next batch's indices
+        `i64[B]` — the cheap host half of the device-resident fast path
+        (the gather + transform run in-graph, see `data/device.py`)."""
         n = len(self._inputs)
         end = self._cursor + self._batch
         if self._train:
@@ -115,6 +117,19 @@ class Dataset:
             else:
                 select = np.arange(self._cursor, end)
         self._cursor = end % n
+        return select
+
+    def sample_flips(self):
+        """Random horizontal-flip mask `bool[B]` for this dataset's default
+        transform (all-False when flips don't apply)."""
+        if self._transform is not None and getattr(self._transform, "flip", False):
+            return self._rng.random(self._batch) < 0.5
+        return np.zeros(self._batch, bool)
+
+    def sample(self):
+        """Return the next `(inputs f32[B, ...], labels[B])` batch (host
+        materialization path, reference `dataset.py:208-218`)."""
+        select = self.sample_indices()
         x = self._inputs[select]
         y = self._labels[select]
         if self._transform is not None:
@@ -146,6 +161,9 @@ def _image_transform(name, no_transform):
             x = (x - mean) / std
         return x
 
+    # Metadata for the device-resident fast path (`data/device.py`)
+    transform.flip = flip
+    transform.norm = norm if not no_transform else None
     return transform
 
 

@@ -1,0 +1,79 @@
+"""Device-resident dataset: in-graph batch materialization.
+
+The reference's input pipeline moves every batch host -> device
+(reference `dataset.py:208-218`, `.to(device)` at `:217`). On TPU that
+transfer — S worker batches per step — dominates the step time for small
+models (measured: the n=25 CIFAR benchmark spent most of its step in host
+sampling). The fast path here stages the WHOLE dataset in HBM once as uint8
+(CIFAR-10 train = 150 MB — trivial against 16+ GB HBM), and per step ships
+only `(S, B)` int32 indices + a `(S, B)` flip mask; the gather, dtype
+conversion, normalization and horizontal flips all run inside the jitted
+training step and fuse with the forward pass.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DeviceData"]
+
+
+class DeviceData:
+    """Device copies of one split's inputs/labels + the traceable transform.
+
+    Build via `DeviceData.pair(trainset, testset)` from the host `Dataset`
+    objects, whose samplers keep driving index selection (identical epoch
+    and shuffle semantics; only materialization moves on-device).
+    """
+
+    def __init__(self, dataset):
+        self._host = dataset
+        self.inputs = jnp.asarray(dataset._inputs)
+        self.labels = jnp.asarray(dataset._labels)
+        transform = dataset._transform
+        self.flip = bool(getattr(transform, "flip", False))
+        norm = getattr(transform, "norm", None)
+        self.norm = None
+        if norm is not None:
+            self.norm = (jnp.asarray(norm[0], jnp.float32),
+                         jnp.asarray(norm[1], jnp.float32))
+        # Raw (non-image) datasets have no transform: gather passes through
+        self.is_image = transform is not None
+
+    @classmethod
+    def pair(cls, trainset, testset):
+        return cls(trainset), cls(testset)
+
+    @staticmethod
+    def supports(dataset):
+        """Whether the dataset's transform is expressible in-graph (the
+        default image transform or none); custom host transforms keep the
+        host materialization path."""
+        transform = dataset._transform
+        return transform is None or hasattr(transform, "flip")
+
+    @property
+    def batch_size(self):
+        return self._host.batch_size
+
+    def sample_indices(self, count):
+        """Host half: `(count, B)` indices + flip mask for `count` batches."""
+        idx = np.stack([self._host.sample_indices() for _ in range(count)])
+        flips = np.stack([self._host.sample_flips() for _ in range(count)])
+        return idx.astype(np.int32), flips
+
+    def gather(self, idx, flips):
+        """In-graph batch materialization: `idx: i32[..., B]` ->
+        `(f32[..., B, ...inputs], labels[..., B])`. Traceable; fuses into
+        the surrounding jitted program."""
+        x = jnp.take(self.inputs, idx, axis=0)
+        y = jnp.take(self.labels, idx, axis=0)
+        if self.is_image:
+            x = x.astype(jnp.float32) / 255.0
+            if self.flip:
+                flipped = jnp.flip(x, axis=-2)  # width axis of (..., H, W, C)
+                x = jnp.where(flips[..., None, None, None], flipped, x)
+            if self.norm is not None:
+                x = (x - self.norm[0]) / self.norm[1]
+        else:
+            x = x.astype(jnp.float32)
+        return x, y

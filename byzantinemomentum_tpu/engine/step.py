@@ -122,6 +122,27 @@ class Engine:
 
         self.train_step = jax.jit(self._train_step, donate_argnums=(0,))
         self.eval_step = jax.jit(self._eval_step)
+        self._train_data = None
+        self._test_data = None
+
+    def attach_data(self, train_data, test_data=None):
+        """Enable the device-resident input path (`data/device.py`): batches
+        materialize in-graph from `(S, B)` index arrays, removing the
+        host->device batch transfer from the step critical path."""
+        self._train_data = train_data
+        self._test_data = test_data
+        self.train_step_indexed = jax.jit(
+            self._train_step_indexed, donate_argnums=(0,))
+        self.eval_step_indexed = jax.jit(self._eval_step_indexed)
+        return self
+
+    def _train_step_indexed(self, state, idx, flips, lr):
+        xs, ys = self._train_data.gather(idx, flips)
+        return self._train_step(state, xs, ys, lr)
+
+    def _eval_step_indexed(self, theta, net_state, idx, flips):
+        x, y = self._test_data.gather(idx, flips)
+        return self._eval_step(theta, net_state, x, y)
 
     # ----------------------------------------------------------------- #
     # Initialization

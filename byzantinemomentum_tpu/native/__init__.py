@@ -151,6 +151,18 @@ def register_cpp_gars():
     from byzantinemomentum_tpu.ops import krum as krum_mod
     from byzantinemomentum_tpu.ops import median as median_mod
 
+    def checked_with_toolchain(check):
+        """Augment a GAR's `check` so selecting a cpp-* entry on a host
+        without a working toolchain fails at setup with a clear message,
+        not minutes later inside the first jitted step."""
+        def check_wrapper(gradients=None, **kwargs):
+            if not available():
+                return ("the native C++ tier is unavailable on this host "
+                        "(g++ build failed); use the jnp kernel of the same "
+                        "name instead")
+            return check(gradients=gradients, **kwargs)
+        return check_wrapper
+
     def wrap(entry, scalar_args):
         def unchecked(gradients, f=None, m=None, **kwargs):
             args = {"f": f, "m": m}
@@ -163,12 +175,16 @@ def register_cpp_gars():
             return jax.pure_callback(host, shape, gradients, vmap_method="sequential")
         return unchecked
 
-    ops.register("cpp-median", wrap(median, ()), median_mod.check,
+    ops.register("cpp-median", wrap(median, ()),
+                 checked_with_toolchain(median_mod.check),
                  upper_bound=median_mod.upper_bound)
-    ops.register("cpp-krum", wrap(krum, ("f", "m")), krum_mod.check,
+    ops.register("cpp-krum", wrap(krum, ("f", "m")),
+                 checked_with_toolchain(krum_mod.check),
                  upper_bound=krum_mod.upper_bound)
-    ops.register("cpp-bulyan", wrap(bulyan, ("f", "m")), bulyan_mod.check,
+    ops.register("cpp-bulyan", wrap(bulyan, ("f", "m")),
+                 checked_with_toolchain(bulyan_mod.check),
                  upper_bound=bulyan_mod.upper_bound)
-    ops.register("cpp-brute", wrap(brute, ("f",)), brute_mod.check,
+    ops.register("cpp-brute", wrap(brute, ("f",)),
+                 checked_with_toolchain(brute_mod.check),
                  upper_bound=brute_mod.upper_bound)
     return True

@@ -101,7 +101,16 @@ class Jobs:
             pending.rename(final_dir)
             _log.success(f"{run_name}: done")
         else:
-            pending.rename(self.results_dir / f"{run_name}.failed")
+            failed = self.results_dir / f"{run_name}.failed"
+            if failed.exists():
+                # Rotate the previous failure out of the way (os.rename
+                # cannot replace a non-empty directory)
+                version = 0
+                while (self.results_dir
+                       / f"{run_name}.failed.{version}").exists():
+                    version += 1
+                failed.rename(self.results_dir / f"{run_name}.failed.{version}")
+            pending.rename(failed)
             _log.error(f"{run_name}: failed with code {result.returncode} "
                        f"(logs kept in {run_name}.failed)")
 

@@ -1,0 +1,61 @@
+"""Device-resident input path: the in-graph gather must reproduce the host
+transform exactly (same indices + flip decisions -> same batch)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from byzantinemomentum_tpu import data
+from byzantinemomentum_tpu.data.device import DeviceData
+
+
+@pytest.fixture(autouse=True)
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "256")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "64")
+
+
+@pytest.mark.parametrize("name", ["mnist", "cifar10", "phishing"])
+def test_gather_matches_host_transform(name):
+    trainset, _ = data.make_datasets(name, 16, 16, seed=3)
+    dd = DeviceData(trainset)
+    idx = trainset.sample_indices()
+    flips = trainset.sample_flips()
+    x_dev, y_dev = dd.gather(jnp.asarray(idx.astype(np.int32)),
+                             jnp.asarray(flips))
+    # Host reference: same indices, same flip mask, same normalization
+    x_host = trainset._inputs[idx]
+    transform = trainset._transform
+    if transform is not None:
+        x_host = x_host.astype(np.float32) / 255.0
+        if transform.flip:
+            x_host[flips] = x_host[flips, :, ::-1, :]
+        if transform.norm is not None:
+            mean = np.asarray(transform.norm[0], np.float32)
+            std = np.asarray(transform.norm[1], np.float32)
+            x_host = (x_host - mean) / std
+    np.testing.assert_allclose(np.asarray(x_dev), x_host, rtol=1e-6,
+                               atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(y_dev), trainset._labels[idx])
+
+
+def test_gather_multi_batch_shapes():
+    trainset, _ = data.make_datasets("cifar10", 8, 8, seed=1)
+    dd = DeviceData(trainset)
+    idx, flips = dd.sample_indices(6)
+    x, y = dd.gather(jnp.asarray(idx), jnp.asarray(flips))
+    assert x.shape == (6, 8, 32, 32, 3)
+    assert y.shape == (6, 8)
+    # Local-steps layout (S, k, B)
+    x2, y2 = dd.gather(jnp.asarray(idx.reshape(3, 2, 8)),
+                       jnp.asarray(flips.reshape(3, 2, 8)))
+    assert x2.shape == (3, 2, 8, 32, 32, 3)
+
+
+def test_supports_detection():
+    trainset, _ = data.make_datasets("mnist", 8, 8)
+    assert DeviceData.supports(trainset)
+    custom = data.Dataset(np.zeros((10, 4), np.float32),
+                          np.zeros((10,), np.int32), 2, train=True,
+                          transform=lambda x, rng: x)
+    assert not DeviceData.supports(custom)
