@@ -27,14 +27,18 @@ __all__ = ["VERSION", "save", "load"]
 # Must be unique and incremented on every incompatible layout change
 # (reference `attack.py:622` — the reference is at version 4; this framework
 # numbers its own lineage).
-VERSION = 1
+VERSION = 2
 
 
 def save(path, state):
     """Serialize `state` to `path` (reference `Checkpoint.save`,
     `experiments/checkpoint.py:134-148`)."""
     state = jax.device_get(state)
-    payload = {"version": VERSION, "state": dict(state._asdict())}
+    # to_state_dict converts non-dict containers (e.g. optax opt_state
+    # tuples) into msgpack-serializable nested dicts
+    payload = {"version": VERSION,
+               "state": {name: serialization.to_state_dict(value)
+                         for name, value in state._asdict().items()}}
     data = serialization.msgpack_serialize(payload)
     path = pathlib.Path(path)
     path.write_bytes(data)
@@ -62,7 +66,7 @@ def load(path, template):
             raise utils.UserException(
                 f"Unable to load checkpoint {str(path)!r}: missing field {name!r}")
         value = stored[name]
-        if name == "net_state":
+        if name in ("net_state", "opt_state"):
             value = serialization.from_state_dict(ref, value)
         else:
             value = jnp.asarray(value)

@@ -100,6 +100,13 @@ def process_commandline(argv=None):
     add("--momentum-at", type=str, default="update",
         help="Momentum placement: 'update', 'server' or 'worker'")
     add("--weight-decay", type=float, default=0., help="Weight decay")
+    add("--optimizer", type=str, default="sgd",
+        help="Optimizer applying the final update (default 'sgd' = the "
+             "reference's torch-SGD semantics, reference attack.py:543-545)")
+    add("--optimizer-args", nargs="*", help="key:value args for the optimizer")
+    add("--trace-dir", type=str, default=None,
+        help="Capture a jax.profiler trace of the first steps into this "
+             "directory (opt-in, like the reference's TimedContext tools)")
     add("--l1-regularize", type=float, default=None,
         help="L1 loss regularization factor")
     add("--l2-regularize", type=float, default=None,
@@ -125,7 +132,7 @@ def process_commandline(argv=None):
 def _postprocess(args):
     """Derivations and checks (reference `attack.py:242-313`)."""
     for name in ("init_multi", "init_mono", "gar", "attack", "model", "loss",
-                 "criterion"):
+                 "criterion", "optimizer"):
         name = f"{name}_args"
         keyval = getattr(args, name)
         setattr(args, name, utils.parse_keyval(keyval))
@@ -359,9 +366,14 @@ def main(argv=None):
             nesterov=args.momentum_nesterov, momentum_at=args.momentum_at,
             weight_decay=args.weight_decay, gradient_clip=args.gradient_clip,
             nb_local_steps=args.nb_local_steps)
+        from byzantinemomentum_tpu import optim
+        optimizer = optim.build(args.optimizer,
+                                weight_decay=args.weight_decay,
+                                **args.optimizer_args)
         engine = build_engine(
             cfg=cfg, model_def=model_def, loss=loss, criterion=criterion,
-            defenses=defenses, attack=attack, attack_kwargs=args.attack_args)
+            defenses=defenses, attack=attack, attack_kwargs=args.attack_args,
+            optimizer=optimizer)
         # Device-resident input fast path: stage the datasets in device
         # memory once; per step only (S, B) index/flip arrays cross the host
         # boundary (see `data/device.py`)
@@ -439,6 +451,11 @@ def main(argv=None):
             except Exception as err:
                 utils.fatal(f"Unable to load checkpoint "
                             f"{args.load_checkpoint!r}: {err}")
+
+    # Opt-in profiler trace of the early steps (TPU counterpart of the
+    # reference's opt-in timing scopes, reference `tools/misc.py:307-343`)
+    if args.trace_dir is not None:
+        jax.profiler.start_trace(args.trace_dir)
 
     # Training (reference `attack.py:685-885`)
     with utils.Context("training", "info"):
@@ -529,6 +546,8 @@ def main(argv=None):
 
         if results is not None:
             results.close()
+    if args.trace_dir is not None:
+        jax.profiler.stop_trace()
     # A bounded run cut short by SIGINT/SIGTERM must not look successful:
     # the Jobs scheduler treats exit 0 as "complete" and would permanently
     # mark a truncated result directory as done (`utils/jobs.py`). Unlimited

@@ -142,6 +142,24 @@ class Dataset:
         while True:
             yield self.sample()
 
+    def epoch(self):
+        """Yield exactly one epoch of batches, in this sampler's order.
+
+        The reference advertises this but its implementation references a
+        nonexistent attribute and crashes (`dataset.py:220-243`, bug at
+        `:230` — documented in SURVEY.md); this one works. The final partial
+        batch is NOT padded (variable shape — prefer `sample()` on TPU).
+        """
+        n = len(self._inputs)
+        order = (self._order if self._train else np.arange(n))
+        for lo in range(0, n, self._batch):
+            select = order[lo:lo + self._batch]
+            x = self._inputs[select]
+            y = self._labels[select]
+            if self._transform is not None:
+                x = self._transform(x, self._rng)
+            yield x, y
+
 
 def _image_transform(name, no_transform):
     """Build the default per-batch transform for an image dataset: uint8 HWC

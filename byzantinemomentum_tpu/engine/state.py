@@ -26,6 +26,7 @@ class TrainState(typing.NamedTuple):
 
     theta: jax.Array             # f32[d] flat parameters
     net_state: typing.Any        # model state pytree (BatchNorm running stats)
+    opt_state: typing.Any        # optimizer state pytree (empty for plain SGD)
     momentum_server: jax.Array   # f32[d] (zeros when placement is 'worker')
     momentum_workers: jax.Array  # f32[h, d] (shape (0, d) unless 'worker')
     origin: jax.Array            # f32[d] initial params (zeros if no study)
@@ -39,7 +40,7 @@ class TrainState(typing.NamedTuple):
     #                              reference README.md:105)
 
 
-def init_state(cfg, theta, net_state, rng, *, study):
+def init_state(cfg, theta, net_state, rng, *, study, opt_state=()):
     """Fresh-run initialization (reference `attack.py:668-681`)."""
     d = theta.shape[0]
     h = cfg.nb_honests
@@ -47,6 +48,7 @@ def init_state(cfg, theta, net_state, rng, *, study):
     return TrainState(
         theta=theta,
         net_state=net_state,
+        opt_state=opt_state,
         momentum_server=jnp.zeros((d,), theta.dtype),
         momentum_workers=jnp.zeros(
             (h if cfg.momentum_at == "worker" else 0, d), theta.dtype),

@@ -90,7 +90,7 @@ class Engine:
     """Compiled training/eval programs for one experiment configuration."""
 
     def __init__(self, cfg, model_def, loss, criterion, defenses, attack,
-                 attack_kwargs):
+                 attack_kwargs, optimizer=None):
         """Use `build_engine` — this constructor wires the already-resolved
         pieces.
 
@@ -113,6 +113,10 @@ class Engine:
         self.defenses = defenses
         self.attack = attack
         self.attack_kwargs = dict(attack_kwargs or {})
+        if optimizer is None:
+            from byzantinemomentum_tpu import optim
+            optimizer = optim.build("sgd", weight_decay=cfg.weight_decay)
+        self.optimizer = optimizer
 
         params, net_state = model_def.init(jax.random.PRNGKey(0))
         theta0, unravel = flatten_params(params)
@@ -154,7 +158,8 @@ class Engine:
             params, net_state = self.model_def.init(key)
         theta, _ = flatten_params(params)
         return init_state(self.cfg, theta, net_state,
-                          jax.random.fold_in(key, 1), study=study)
+                          jax.random.fold_in(key, 1), study=study,
+                          opt_state=self.optimizer.init(theta))
 
     # ----------------------------------------------------------------- #
     # Per-worker gradient
@@ -309,8 +314,11 @@ class Engine:
         if cfg.study:
             l2_origin = jnp.sqrt(
                 jnp.sum((state.theta - state.origin) ** 2))
-        theta = state.theta - lr * (update_grad
-                                    + cfg.weight_decay * state.theta)
+        # The optimizer applies the final update (torch-SGD semantics by
+        # default, incl. --weight-decay; reference `attack.py:543-545`,
+        # `experiments/model.py:368-380`)
+        theta, opt_state = self.optimizer.update(
+            update_grad, state.opt_state, state.theta, lr)
 
         # --- study metrics (`attack.py:842-878`) --- #
         if cfg.study:
@@ -325,7 +333,7 @@ class Engine:
             pg, pn, pc = state.past_grads, state.past_norms, state.past_count
 
         new_state = TrainState(
-            theta=theta, net_state=net_state,
+            theta=theta, net_state=net_state, opt_state=opt_state,
             momentum_server=new_ms, momentum_workers=new_mw,
             origin=state.origin,
             past_grads=pg, past_norms=pn, past_count=pc,
@@ -352,8 +360,8 @@ class Engine:
 
 
 def build_engine(*, cfg, model_def, loss, criterion, defenses, attack=None,
-                 attack_kwargs=None):
+                 attack_kwargs=None, optimizer=None):
     """Assemble an `Engine` (the reference's `setup` phase,
     `attack.py:451-591`, collapsed into one constructor)."""
     return Engine(cfg, model_def, loss, criterion, defenses, attack,
-                  attack_kwargs)
+                  attack_kwargs, optimizer=optimizer)

@@ -164,11 +164,43 @@ def _l2(**kw):
     return loss
 
 
+def _smoothl1(beta=1.0, **kw):
+    """Torch `SmoothL1Loss` / Huber with delta=beta, mean reduction."""
+    def loss(output, target, params):
+        diff = jnp.abs(output - target.reshape(output.shape))
+        return jnp.mean(jnp.where(diff < beta,
+                                  0.5 * diff * diff / beta,
+                                  diff - 0.5 * beta))
+    return loss
+
+
+def _kldiv(**kw):
+    """Torch `KLDivLoss` (batchmean): inputs are log-probs, targets probs."""
+    eps = 1e-12
+    def loss(output, target, params):
+        target = target.reshape(output.shape)
+        return jnp.sum(target * (jnp.log(target + eps) - output)) / output.shape[0]
+    return loss
+
+
+def _hingeembedding(margin=1.0, **kw):
+    """Torch `HingeEmbeddingLoss`: targets in {1, -1}."""
+    def loss(output, target, params):
+        target = target.reshape(output.shape)
+        return jnp.mean(jnp.where(target > 0, output,
+                                  jnp.maximum(0.0, margin - output)))
+    return loss
+
+
 register_loss("nll", _nll)
 register_loss("crossentropy", _crossentropy)
 register_loss("mse", _mse)
 register_loss("l1loss", _l1loss)
 register_loss("bce", _bce)
+register_loss("smoothl1", _smoothl1)
+register_loss("huber", _smoothl1)
+register_loss("kldiv", _kldiv)
+register_loss("hingeembedding", _hingeembedding)
 register_loss("l1", _l1)
 register_loss("l2", _l2)
 

@@ -1,0 +1,106 @@
+"""Optimizer registry.
+
+The reference exposes every `torch.optim` subclass through its `Optimizer`
+wrapper (reference `experiments/optimizer.py:25-103`) while the driver always
+constructs SGD with momentum 0 — the Byzantine-momentum algebra is hand-rolled
+in the training loop (reference `attack.py:543-545`; the momentum placements
+live in `engine/step.py` here for the same reason).
+
+TPU-native design: an optimizer is a pair of pure functions over the flat
+parameter vector,
+
+    init(theta)                          -> opt_state (pytree)
+    update(grad, opt_state, theta, lr)   -> (new_theta, new_opt_state)
+
+with torch-style decoupled weight decay applied as `grad + wd * theta` before
+the transformation (exactly torch SGD's behavior, which the default "sgd"
+reproduces bit-for-bit). The adaptive optimizers are optax transformation
+chains with the learning rate applied outside, so per-step lr schedules don't
+retrigger compilation.
+"""
+
+import optax
+
+from byzantinemomentum_tpu import utils
+
+__all__ = ["optimizers", "register", "Optimizer", "build"]
+
+# Registry: name -> builder(**kwargs) -> Optimizer
+optimizers = {}
+
+
+class Optimizer:
+    """A named (init, update) pair (see module docstring)."""
+
+    def __init__(self, name, init, update):
+        self.name = name
+        self.init = init
+        self.update = update
+
+    def __repr__(self):
+        return f"Optimizer({self.name!r})"
+
+
+def register(name, builder):
+    if name in optimizers:
+        utils.warning(f"Optimizer {name!r} registered twice; keeping the last")
+    optimizers[name] = builder
+    return builder
+
+
+def build(name, weight_decay=0.0, **kwargs):
+    """Instantiate an optimizer by registry name
+    (reference `experiments/optimizer.py:53-74`)."""
+    if name not in optimizers:
+        utils.fatal_unavailable(optimizers, name, what="optimizer name")
+    return optimizers[name](weight_decay=weight_decay, **kwargs)
+
+
+def _plain_sgd(weight_decay=0.0, **kw):
+    """torch.optim.SGD with momentum 0 (the reference driver's choice,
+    reference `attack.py:543-545`): theta <- theta - lr*(g + wd*theta)."""
+    def init(theta):
+        return ()
+
+    def update(grad, opt_state, theta, lr):
+        return theta - lr * (grad + weight_decay * theta), opt_state
+
+    return Optimizer("sgd", init, update)
+
+
+def _from_optax(name, make_tx):
+    """Wrap an optax scale-by-* chain: lr multiplies the transformed update,
+    weight decay adds `wd * theta` to the gradient first (torch semantics)."""
+    def builder(weight_decay=0.0, **kwargs):
+        tx = make_tx(**kwargs)
+
+        def init(theta):
+            return tx.init(theta)
+
+        def update(grad, opt_state, theta, lr):
+            g = grad + weight_decay * theta
+            delta, opt_state = tx.update(g, opt_state, theta)
+            return theta + lr * delta, opt_state
+
+        return Optimizer(name, init, update)
+    return builder
+
+
+register("sgd", _plain_sgd)
+register("adam", _from_optax(
+    "adam", lambda b1=0.9, b2=0.999, eps=1e-8, **kw:
+    optax.chain(optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+                optax.scale(-1.0))))
+register("adamw", _from_optax(
+    "adamw", lambda b1=0.9, b2=0.999, eps=1e-8, wd=1e-2, **kw:
+    optax.chain(optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+                optax.add_decayed_weights(wd),
+                optax.scale(-1.0))))
+register("rmsprop", _from_optax(
+    "rmsprop", lambda decay=0.99, eps=1e-8, **kw:
+    optax.chain(optax.scale_by_rms(decay=decay, eps=eps),
+                optax.scale(-1.0))))
+register("adagrad", _from_optax(
+    "adagrad", lambda eps=1e-10, **kw:
+    optax.chain(optax.scale_by_rss(initial_accumulator_value=0.0, eps=eps),
+                optax.scale(-1.0))))

@@ -1,0 +1,120 @@
+"""Sequence/context parallelism: ring attention and Ulysses-style
+all-to-all attention over a mesh "seq" axis.
+
+The reference has no attention models at all (SURVEY.md §5.7), but
+long-context scaling is a first-class axis of this framework: the
+transformer family (`models/transformer.py`) can run with its sequence
+dimension sharded across chips, using either
+
+* **ring attention** (`ring_attention`) — K/V blocks rotate around the ring
+  via `lax.ppermute` while each chip holds its Q chunk, accumulating the
+  exact softmax with the online (max, sum) rescaling trick. Communication
+  per step: one (B, H, Lc, Dh) block to the ring neighbor — bandwidth
+  optimal over ICI, memory O(L/p) per chip.
+* **Ulysses / all-to-all** (`ulysses_attention`) — `lax.all_to_all` swaps
+  the head and sequence axes so each chip computes full-sequence attention
+  for H/p heads, then swaps back. One collective in, one out; requires
+  heads % p == 0.
+
+Both are exact (not approximations) and are verified against dense local
+attention in `tests/test_ring.py`.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention", "dense_attention"]
+
+_NEG = -1e30  # large-negative mask value (avoids -inf NaN propagation)
+
+
+def dense_attention(q, k, v, *, causal=True, base=0):
+    """Plain softmax attention `[B, H, L, Dh]` (single-device reference).
+
+    `base` offsets the query positions relative to the key positions —
+    used by the ring kernel for cross-block causal masks.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.float32(dh))
+    if causal:
+        qpos = base + jnp.arange(q.shape[2])[:, None]
+        kpos = jnp.arange(k.shape[2])[None, :]
+        scores = jnp.where(qpos >= kpos, scores, _NEG)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def ring_attention(q, k, v, axis_name, *, causal=True):
+    """Exact blockwise attention with the sequence sharded over `axis_name`.
+
+    Inputs are the LOCAL chunks `[B, H, Lc, Dh]` of the `[B, H, L, Dh]`
+    arrays (L = p * Lc, chunk i holding positions [i*Lc, (i+1)*Lc)). Must
+    run inside `shard_map` over a mesh with axis `axis_name`.
+
+    Online-softmax accumulation: for each of the p ring steps, the chip
+    scores its Q chunk against the currently-held K/V block, rescales its
+    running (output, max, normalizer) triple, and forwards the block to the
+    next ring neighbor via `ppermute`.
+    """
+    p = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, h, lc, dh = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(dh))
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    qpos = me * lc + jnp.arange(lc)  # global positions of local queries
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (me - i) % p  # ring step i holds the block that started at src
+        kpos = src * lc + jnp.arange(lc)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+        else:
+            mask = jnp.ones((lc, lc), bool)
+        scores = jnp.where(mask, scores, _NEG)
+        block_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, block_max)
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+        l = l * alpha + jnp.sum(probs, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", probs, v_cur)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o, m_new, l, k_next, v_next
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, lc), _NEG, q.dtype)
+    l0 = jnp.zeros((b, h, lc), q.dtype)
+    o, m, l, _, _ = lax.fori_loop(0, p, body, (o0, m0, l0, k, v))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal=True):
+    """All-to-all sequence parallelism (Ulysses): swap the sharded axis from
+    sequence to heads, run full-sequence dense attention on H/p local heads,
+    swap back. Inputs/outputs: local `[B, H, Lc, Dh]` chunks inside
+    `shard_map`; requires `H % p == 0`.
+    """
+    p = lax.axis_size(axis_name)
+    if q.shape[1] % p != 0:
+        raise ValueError(
+            f"ulysses_attention requires heads ({q.shape[1]}) divisible by "
+            f"the sequence-axis size ({p})")
+
+    def to_heads(x):
+        # [B, H, Lc, Dh] -> [B, H/p, L, Dh]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):
+        # [B, H/p, L, Dh] -> [B, H, Lc, Dh]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    out = dense_attention(to_heads(q), to_heads(k), to_heads(v),
+                          causal=causal)
+    return to_seq(out)

@@ -97,9 +97,13 @@ def sharded_state_spec(state):
     """PartitionSpecs for a `TrainState` on a (workers, model) mesh: all
     d-dimensional buffers shard along "model"; scalars/counters/PRNG
     replicate. (BatchNorm state replicates — it is tiny.)"""
+    d = state.theta.shape
     return TrainState(
         theta=P(MODEL),
         net_state=jax.tree.map(lambda _: P(), state.net_state),
+        opt_state=jax.tree.map(
+            lambda leaf: P(MODEL) if getattr(leaf, "shape", None) == d else P(),
+            state.opt_state),
         momentum_server=P(MODEL),
         momentum_workers=P(None, MODEL),
         origin=P(MODEL) if state.origin.ndim else P(),

@@ -22,7 +22,32 @@ __all__ = [
     "AccumulatedTimedContext",
     "deltatime_point",
     "deltatime_format",
+    "interactive",
+    "get_loaded_dependencies",
 ]
+
+
+def interactive(local=None):
+    """In-process REPL, resumed with Ctrl-D (reference `tools/misc.py:348-412`;
+    wired to `--user-input-delta` like the reference's `attack.py:733-734`)."""
+    import code
+    code.interact(banner="Interactive prompt; Ctrl-D to resume",
+                  local=local or {})
+
+
+def get_loaded_dependencies():
+    """List the loaded third-party modules with their versions
+    (reference `tools/misc.py:417-463` — used there to generate the README's
+    dependency table)."""
+    import sys
+    out = {}
+    for name, module in sorted(sys.modules.items()):
+        if "." in name or name.startswith("_"):
+            continue
+        version = getattr(module, "__version__", None)
+        if version is not None:
+            out[name] = str(version)
+    return out
 
 
 def import_directory(package, path):

@@ -1,0 +1,77 @@
+"""Off-the-main-path research helpers (reference `tools/pytorch.py:199-294`:
+`regression`, `WeightedMSELoss`, `pnm`) — jax-idiomatic equivalents.
+
+Like their reference counterparts, these support ad-hoc analysis scripts and
+are not used by the training pipeline.
+"""
+
+import numpy as np
+
+__all__ = ["regression", "weighted_mse", "pnm"]
+
+
+def regression(fn, params0, x, y, *, weights=None, steps=1000, lr=1e-2):
+    """Fit `fn(params, x) -> y` by (weighted) least squares with Adam on
+    `jax.grad` (reference `tools/pytorch.py:199-244` fitted with torch).
+
+    Args:
+      fn: traceable model `(params pytree, f32[n]) -> f32[n]`.
+      params0: initial parameter pytree.
+      x, y: data arrays.
+      weights: optional per-point weights (reference `WeightedMSELoss`,
+        `tools/pytorch.py:249-266`).
+      steps, lr: optimization budget.
+    Returns:
+      (fitted params pytree, final loss).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.ones_like(y) if weights is None else jnp.asarray(weights, jnp.float32)
+
+    def loss_fn(params):
+        return weighted_mse(fn(params, x), y, w)
+
+    tx = optax.adam(lr)
+    opt_state = tx.init(params0)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = params0
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state)
+    return params, float(loss)
+
+
+def weighted_mse(pred, target, weights):
+    """Weighted mean squared error (reference `WeightedMSELoss`,
+    `tools/pytorch.py:249-266`)."""
+    import jax.numpy as jnp
+    return jnp.sum(weights * (pred - target) ** 2) / jnp.sum(weights)
+
+
+def pnm(path, array):
+    """Dump a 2-D array as a portable anymap: PBM for bool, PGM for
+    uint8/float in [0, 1] (reference `tools/pytorch.py:271-294`)."""
+    array = np.asarray(array)
+    if array.ndim != 2:
+        raise ValueError(f"Expected a 2-D array, got shape {array.shape}")
+    with open(path, "wb") as fd:
+        if array.dtype == bool:
+            fd.write(b"P1\n%d %d\n" % (array.shape[1], array.shape[0]))
+            for row in array:
+                fd.write(b" ".join(b"1" if v else b"0" for v in row) + b"\n")
+        else:
+            if array.dtype != np.uint8:
+                array = np.clip(array * 255.0, 0, 255).astype(np.uint8)
+            fd.write(b"P2\n%d %d\n255\n" % (array.shape[1], array.shape[0]))
+            for row in array:
+                fd.write(b" ".join(b"%d" % v for v in row) + b"\n")
+    return path

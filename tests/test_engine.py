@@ -245,3 +245,38 @@ def test_gar_mixture_draws_all_branches():
         theta_prev = th
     # average branch moves coord0 by 1.0, median branch by 0.0
     assert 1.0 in deltas and 0.0 in deltas
+
+
+def test_optimizer_registry_adam_roundtrip(tmp_path):
+    """Adam via the optimizer registry: trains, and its moment buffers
+    survive a checkpoint roundtrip."""
+    from byzantinemomentum_tpu import checkpoint as ck
+    from byzantinemomentum_tpu import optim
+    rng = np.random.default_rng(8)
+    batches = [rng.normal(size=(3, 2, D)).astype(np.float32)
+               for _ in range(2)]
+    cfg = EngineConfig(nb_workers=3, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=0, momentum=0.0, momentum_at="update")
+    engine = build_engine(
+        cfg=cfg, model_def=probe_model(), loss=probe_loss(),
+        criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars["average"], 1.0, {})],
+        optimizer=optim.build("adam"))
+    state, _ = run_steps(engine, cfg, batches, 0.05, study=False)
+    assert jax.tree.leaves(state.opt_state)  # adam moments exist
+    path = ck.save(tmp_path / "checkpoint-adam", state)
+    restored = ck.load(path, engine.init(jax.random.PRNGKey(0)))
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_optimizer_sgd_matches_manual_update():
+    """The default optimizer reproduces theta -= lr*(g + wd*theta) exactly
+    (torch-SGD semantics, reference attack.py:543-545)."""
+    from byzantinemomentum_tpu import optim
+    opt = optim.build("sgd", weight_decay=0.1)
+    theta = jnp.arange(4, dtype=jnp.float32)
+    grad = jnp.ones(4, jnp.float32)
+    new, st = opt.update(grad, opt.init(theta), theta, 0.5)
+    np.testing.assert_allclose(np.asarray(new),
+                               np.asarray(theta - 0.5 * (grad + 0.1 * theta)))
