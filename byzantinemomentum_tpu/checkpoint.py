@@ -30,25 +30,37 @@ __all__ = ["VERSION", "save", "load"]
 VERSION = 2
 
 
-def save(path, state):
+def save(path, state, *, data_state=None):
     """Serialize `state` to `path` (reference `Checkpoint.save`,
-    `experiments/checkpoint.py:134-148`)."""
+    `experiments/checkpoint.py:134-148`).
+
+    `data_state` optionally carries the host data-sampler snapshots
+    (`Dataset.get_state()` dicts, e.g. {"train": ..., "test": ...}) so a
+    resumed run replays the exact same batch sequence — the dataloader-state
+    gap the reference documents as unfixed (reference `README.md:105`).
+    """
     state = jax.device_get(state)
     # to_state_dict converts non-dict containers (e.g. optax opt_state
     # tuples) into msgpack-serializable nested dicts
     payload = {"version": VERSION,
                "state": {name: serialization.to_state_dict(value)
                          for name, value in state._asdict().items()}}
+    if data_state is not None:
+        payload["data"] = data_state
     data = serialization.msgpack_serialize(payload)
     path = pathlib.Path(path)
     path.write_bytes(data)
     return path
 
 
-def load(path, template):
+def load(path, template, *, return_data=False):
     """Deserialize a checkpoint against a template `TrainState` (shapes are
     taken from the template, values from the file), with the reference's
-    validation (reference `attack.py:624-667`)."""
+    validation (reference `attack.py:624-667`).
+
+    With `return_data=True` returns `(state, data_state)` where `data_state`
+    is the sampler snapshot stored by `save` (or None for checkpoints
+    written without one)."""
     raw = serialization.msgpack_restore(pathlib.Path(path).read_bytes())
     version = raw.get("version")
     if version != VERSION:
@@ -86,4 +98,7 @@ def load(path, template):
             elif ref_arr.dtype != value.dtype:
                 value = value.astype(ref_arr.dtype)
         out[name] = value
-    return TrainState(**out)
+    state = TrainState(**out)
+    if return_data:
+        return state, raw.get("data")
+    return state

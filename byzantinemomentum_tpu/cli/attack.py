@@ -445,12 +445,28 @@ def main(argv=None):
         state = engine.init(root_key, params=params, net_state=net_state)
         if args.load_checkpoint is not None:
             try:
-                state = checkpoint_mod.load(args.load_checkpoint, state)
+                state, data_state = checkpoint_mod.load(
+                    args.load_checkpoint, state, return_data=True)
             except utils.UserException:
                 raise
             except Exception as err:
                 utils.fatal(f"Unable to load checkpoint "
                             f"{args.load_checkpoint!r}: {err}")
+            else:
+                if data_state is not None:
+                    try:
+                        snaps = (data_state["train"], data_state["test"])
+                        trainset.set_state(snaps[0])
+                        testset.set_state(snaps[1])
+                    except Exception as err:
+                        utils.warning(
+                            f"Checkpoint sampler state only partially or not "
+                            f"restored ({err}); resumed batch order may "
+                            f"differ")
+                else:
+                    utils.warning(
+                        "Checkpoint carries no sampler state; resumed batch "
+                        "order will differ from the uninterrupted run")
 
     # Opt-in profiler trace of the early steps (TPU counterpart of the
     # reference's opt-in timing scopes, reference `tools/misc.py:307-343`)
@@ -475,29 +491,36 @@ def main(argv=None):
                                     and steps % args.checkpoint_delta == 0)
             milestone_user_input = (args.user_input_delta > 0
                                     and steps % args.user_input_delta == 0)
+            # Sampler snapshot BEFORE the evaluation consumes test batches,
+            # so a resumed run replays this step's evaluation exactly
+            data_snapshot = None
+            if milestone_checkpoint and not just_loaded:
+                data_snapshot = {"train": trainset.get_state(),
+                                 "test": testset.get_state()}
             if milestone_evaluation:
-                correct = 0.0
-                count = 0.0
-                for _ in range(args.batch_size_test_reps):
-                    if use_device_data:
-                        idx, flips = test_data.sample_indices(1)
-                        res = engine.eval_step_indexed(
-                            state.theta, state.net_state,
-                            jnp.asarray(idx[0]), jnp.asarray(flips[0]))
-                    else:
-                        x, y = testset.sample()
-                        res = engine.eval_step(state.theta, state.net_state,
-                                               jnp.asarray(x), jnp.asarray(y))
-                    correct += float(res[0])
-                    count += float(res[1])
-                acc = correct / count
+                # One compiled program + one host transfer per evaluation
+                # (the reference runs batch_size_test_reps separate
+                # synchronous calls, `attack.py:709-715`)
+                reps = args.batch_size_test_reps
+                if use_device_data:
+                    idx, flips = test_data.sample_indices(reps)
+                    res = engine.eval_many_indexed(
+                        state.theta, state.net_state,
+                        jnp.asarray(idx), jnp.asarray(flips))
+                else:
+                    bxs, bys = zip(*(testset.sample() for _ in range(reps)))
+                    res = engine.eval_many(
+                        state.theta, state.net_state,
+                        jnp.asarray(np.stack(bxs)), jnp.asarray(np.stack(bys)))
+                acc = float(res[0]) / float(res[1])
                 utils.info(f"Accuracy (step {steps}): {acc * 100.:.2f}%")
                 if fd_eval is not None:
                     results.store(fd_eval, steps, acc)
             if milestone_checkpoint and not just_loaded:
                 filename = args.result_directory / f"checkpoint-{steps}"
                 try:
-                    checkpoint_mod.save(filename, state)
+                    checkpoint_mod.save(filename, state,
+                                        data_state=data_snapshot)
                 except Exception as err:
                     utils.warning(f"Checkpoint save failed: {err}")
             just_loaded = False

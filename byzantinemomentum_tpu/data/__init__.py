@@ -126,6 +126,54 @@ class Dataset:
             return self._rng.random(self._batch) < 0.5
         return np.zeros(self._batch, bool)
 
+    def get_state(self):
+        """Snapshot of the sampler's mutable state (cursor, epoch order, RNG)
+        for exact checkpoint/resume — the reference documents that it does
+        NOT checkpoint dataloader state and that resumed runs are therefore
+        not reproducible (reference `README.md:105`); this closes that gap.
+        The returned dict is msgpack/JSON-serializable (PCG64 raw state ints
+        exceed 64 bits, so they are encoded as strings)."""
+        rng_state = self._rng.bit_generator.state
+        return {
+            "cursor": int(self._cursor),
+            "order": None if self._order is None else np.asarray(self._order).tolist(),
+            "rng": {
+                "bit_generator": rng_state["bit_generator"],
+                "state": {k: str(v) for k, v in rng_state["state"].items()},
+                "has_uint32": int(rng_state["has_uint32"]),
+                "uinteger": int(rng_state["uinteger"]),
+            },
+        }
+
+    def set_state(self, snapshot):
+        """Restore a `get_state` snapshot. Decodes everything (and lets the
+        bit-generator validate its state) before assigning cursor/order, so
+        a malformed snapshot raises without leaving this sampler
+        half-restored."""
+        n = len(self._inputs)
+        cursor = int(snapshot["cursor"])
+        if not 0 <= cursor < n:
+            raise utils.UserException(
+                f"Sampler snapshot cursor {cursor} out of range for dataset "
+                f"{self.name!r} of size {n}")
+        order = snapshot["order"]
+        order = None if order is None else np.asarray(order, np.int64)
+        if order is not None and (len(order) != n or (order >= n).any()
+                                  or (order < 0).any()):
+            raise utils.UserException(
+                f"Sampler snapshot order is inconsistent with dataset "
+                f"{self.name!r} of size {n} (snapshot covers "
+                f"{0 if order is None else len(order)} samples)")
+        rng = snapshot["rng"]
+        self._rng.bit_generator.state = {
+            "bit_generator": rng["bit_generator"],
+            "state": {k: int(v) for k, v in rng["state"].items()},
+            "has_uint32": int(rng["has_uint32"]),
+            "uinteger": int(rng["uinteger"]),
+        }
+        self._cursor = cursor
+        self._order = order
+
     def sample(self):
         """Return the next `(inputs f32[B, ...], labels[B])` batch (host
         materialization path, reference `dataset.py:208-218`)."""

@@ -126,6 +126,7 @@ class Engine:
 
         self.train_step = jax.jit(self._train_step, donate_argnums=(0,))
         self.eval_step = jax.jit(self._eval_step)
+        self.eval_many = jax.jit(self._eval_many)
         self._train_data = None
         self._test_data = None
 
@@ -138,6 +139,7 @@ class Engine:
         self.train_step_indexed = jax.jit(
             self._train_step_indexed, donate_argnums=(0,))
         self.eval_step_indexed = jax.jit(self._eval_step_indexed)
+        self.eval_many_indexed = jax.jit(self._eval_many_indexed)
         return self
 
     def _train_step_indexed(self, state, idx, flips, lr):
@@ -357,6 +359,28 @@ class Engine:
         out, _ = self.model_def.apply(params, net_state, x, train=False,
                                       rng=jax.random.PRNGKey(0))
         return self.criterion(out, y)
+
+    def _eval_many(self, theta, net_state, xs, ys):
+        """One compiled evaluation over a whole milestone: `lax.scan` of the
+        criterion across `reps` stacked test batches, returning the summed
+        `[#correct, #samples]` — one host transfer per evaluation instead of
+        the reference's one synchronous call per batch
+        (reference `attack.py:709-715`). `xs: f32[reps, B, ...]`."""
+        def body(acc, xy):
+            x, y = xy
+            return acc + self._eval_step(theta, net_state, x, y), None
+        acc, _ = lax.scan(body, jnp.zeros((2,), jnp.float32), (xs, ys))
+        return acc
+
+    def _eval_many_indexed(self, theta, net_state, idx, flips):
+        """`_eval_many` over the device-resident test split: ships only the
+        `(reps, B)` index/flip arrays; batches materialize in-graph."""
+        def body(acc, inp):
+            i, fl = inp
+            x, y = self._test_data.gather(i, fl)
+            return acc + self._eval_step(theta, net_state, x, y), None
+        acc, _ = lax.scan(body, jnp.zeros((2,), jnp.float32), (idx, flips))
+        return acc
 
 
 def build_engine(*, cfg, model_def, loss, criterion, defenses, attack=None,

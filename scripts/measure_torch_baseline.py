@@ -15,11 +15,15 @@ implementation style (BASELINE.md: "PyTorch-CPU steps/sec of attack.py"):
   (`aggregators/bulyan.py:47-84`),
 * the study-metric passes (`attack.py:842-866`).
 
-Config = BASELINE.json #4: CIFAR-10 empire-cnn, n=25, f=11, batch 50,
-momentum 0.99, clip 5. Writes `BASELINE_MEASURED.json` at the repo root,
-which `bench.py` uses as the `vs_baseline` denominator.
+Config: CIFAR-10 empire-cnn, n=25, f=5, batch 50, momentum 0.99, clip 5,
+nb-for-study=1 — the reference grid's own Bulyan cell (Bulyan requires
+n >= 4f+3, so the grid excludes it at f=11; reference `reproduce.py:165-209`,
+`aggregators/bulyan.py:102-117`). With nb-for-study=1 the reference computes
+max(nb_honests, 1) = n - f_real = 20 gradients per step (`attack.py:764`),
+which is what the loop below does. Writes `BASELINE_MEASURED.json` at the
+repo root, which `bench.py` uses as the `vs_baseline` denominator.
 
-Usage: python scripts/measure_torch_baseline.py [--steps 3]
+Usage: python scripts/measure_torch_baseline.py [--steps 20]
 """
 
 import argparse
@@ -39,8 +43,9 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from byzantinemomentum_tpu.data import sources  # noqa: E402
 
 N_WORKERS = 25
-F_DECL = 11
-F_REAL = 11
+F_DECL = 5
+F_REAL = 5
+N_HONEST = N_WORKERS - F_REAL
 BATCH = 50
 MOMENTUM = 0.99
 CLIP = 5.0
@@ -118,8 +123,11 @@ def avg_dev_max(samples):
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument("--steps", type=int, default=20)
     args = parser.parse_args()
+
+    assert N_WORKERS >= 4 * F_DECL + 3, (
+        f"Bulyan requires n >= 4f+3 (n={N_WORKERS}, f={F_DECL})")
 
     torch.manual_seed(0)
     raw = sources.load_cifar(10)
@@ -139,7 +147,7 @@ def main():
         nonlocal momentum_buf
         grads = []
         losses = []
-        for _ in range(N_WORKERS):
+        for _ in range(N_HONEST):
             sel = rng.integers(0, len(train_x), BATCH)
             x = torch.from_numpy(train_x[sel])
             y = torch.from_numpy(train_y[sel]).long()
@@ -152,11 +160,12 @@ def main():
                 g = g * (CLIP / norm)
             grads.append(g.detach().clone())
             losses.append(loss.item())
-        honests = grads[:N_WORKERS - F_REAL]
+        honests = grads[:N_HONEST]
         avg = torch.stack(honests).mean(dim=0)
         byz = avg + 1.1 * (-avg)  # empire, factor 1.1
         stack = torch.stack(honests + [byz] * F_REAL)
         agg = bulyan(stack, F_DECL)
+        assert torch.isfinite(agg).all(), "non-finite defense gradient"
         momentum_buf = (agg if momentum_buf is None
                         else MOMENTUM * momentum_buf + agg)
         with torch.no_grad():
@@ -180,8 +189,9 @@ def main():
 
     out = {
         "metric": "sim_steps_per_sec",
-        "config": "CIFAR-10 empire-cnn, n=25 f=11, bulyan vs empire(1.1), "
-                  "batch 50, momentum 0.99 at update, clip 5, torch-CPU "
+        "config": "CIFAR-10 empire-cnn, n=25 f=5, bulyan vs empire(1.1), "
+                  "batch 50, momentum 0.99 at update, clip 5, "
+                  "nb-for-study=1 (20 backprops/step), torch-CPU "
                   "reference-style loop",
         "torch_cpu_steps_per_sec": steps_per_sec,
         "elapsed_s": elapsed,
