@@ -196,6 +196,15 @@ class Engine:
 
     # ----------------------------------------------------------------- #
     # Defense dispatch (single GAR or per-step random mixture)
+    #
+    # DELIBERATE DIVERGENCE from the reference: a `--gars` mixture here
+    # draws ONE GAR per step (`mix_u` is shared by the attack's inner
+    # defense evaluations, the outer aggregation and the influence), while
+    # the reference re-draws `random.random()` on every defense call
+    # (reference `attack.py:504-509`), so its adaptive attacks line-search
+    # against a per-call random GAR. Per-step drawing makes the attack
+    # optimize against the defense actually used that step — deterministic
+    # under the step PRNG, and at least as favorable to the attacker.
 
     def _run_defense(self, G, mix_u):
         cfg = self.cfg

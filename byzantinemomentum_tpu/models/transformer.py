@@ -1,0 +1,127 @@
+"""Transformer classifier: `transformer-classifier`.
+
+The reference has no attention models (SURVEY.md §5.7 — it scales in worker
+count and model dimension, not sequence length), but long-context scaling is
+a first-class axis of this framework, so the model zoo carries a sequence
+model wired to the sequence-parallel kernels in `parallel/ring.py`.
+
+Design: images tokenize as rows — `(B, H, W, C) -> (B, L=H, W*C)` — giving
+mnist L=28 / cifar L=32 sequences without a new data pipeline; then a
+standard pre-LN encoder (MHA + MLP blocks), mean pool, linear head,
+log-softmax. The attention implementation is selected at build time:
+
+  attn_impl="dense"   — single-device softmax attention (default);
+  attn_impl="ring"    — ring attention: K/V blocks rotate over the mesh
+                        axis `seq_axis` via `lax.ppermute` (run the model
+                        under `shard_map` with the sequence sharded);
+  attn_impl="ulysses" — all-to-all head/sequence swap over `seq_axis`.
+
+All three are exact — `tests/test_ring.py` verifies the sharded variants
+reproduce the dense logits on a virtual 8-device mesh. Under sequence
+sharding, per-token ops run on local chunks; the positional table is sliced
+by `axis_index`, and the mean pool closes with a `psum`.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import dense_init
+from byzantinemomentum_tpu.parallel.ring import (
+    dense_attention, ring_attention, ulysses_attention)
+
+__all__ = []
+
+
+def _ln_init(dim):
+    return {"g": jnp.ones((dim,), jnp.float32),
+            "b": jnp.zeros((dim,), jnp.float32)}
+
+
+def _ln_apply(p, x, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * p["g"] + p["b"]
+
+
+def make_transformer(depth=2, dim=64, heads=4, mlp_ratio=4, num_classes=10,
+                     input_shape=(28, 28, 1), causal=False,
+                     attn_impl="dense", seq_axis="seq", **kwargs):
+    if attn_impl not in ("dense", "ring", "ulysses"):
+        raise ValueError(f"Unknown attention implementation {attn_impl!r}")
+    if dim % heads != 0:
+        raise ValueError(f"dim={dim} not divisible by heads={heads}")
+    h_img, w_img, c_img = input_shape
+    seq_len, token_dim = h_img, w_img * c_img
+    head_dim = dim // heads
+    hidden = mlp_ratio * dim
+
+    def init(key):
+        keys = jax.random.split(key, 2 + 4 * depth + 1)
+        params = {
+            "embed": dense_init(keys[0], token_dim, dim),
+            "pos": 0.02 * jax.random.normal(keys[1], (seq_len, dim),
+                                            jnp.float32),
+            "head": dense_init(keys[-1], dim, num_classes),
+            "ln_f": _ln_init(dim),
+            "blocks": [],
+        }
+        for i in range(depth):
+            k = keys[2 + 4 * i: 6 + 4 * i]
+            params["blocks"].append({
+                "ln1": _ln_init(dim), "ln2": _ln_init(dim),
+                "qkv": dense_init(k[0], dim, 3 * dim),
+                "proj": dense_init(k[1], dim, dim),
+                "fc1": dense_init(k[2], dim, hidden),
+                "fc2": dense_init(k[3], hidden, dim),
+            })
+        return params, {}
+
+    def attend(q, k, v):
+        # (B, L, H, Dh) -> (B, H, L, Dh) expected by the kernels
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        if attn_impl == "ring":
+            out = ring_attention(q, k, v, seq_axis, causal=causal)
+        elif attn_impl == "ulysses":
+            out = ulysses_attention(q, k, v, seq_axis, causal=causal)
+        else:
+            out = dense_attention(q, k, v, causal=causal)
+        return jnp.swapaxes(out, 1, 2)
+
+    def apply(params, state, x, train=False, rng=None):
+        b = x.shape[0]
+        x = x.reshape(b, x.shape[1], -1)  # (B, L or Lc, W*C) row tokens
+        lc = x.shape[1]
+        x = x @ params["embed"]["w"] + params["embed"]["b"]
+        if attn_impl == "dense":
+            pos = params["pos"][:lc]
+        else:
+            # Local chunk of the (replicated) positional table
+            me = lax.axis_index(seq_axis)
+            pos = lax.dynamic_slice_in_dim(params["pos"], me * lc, lc)
+        x = x + pos[None]
+        for blk in params["blocks"]:
+            y = _ln_apply(blk["ln1"], x)
+            qkv = y @ blk["qkv"]["w"] + blk["qkv"]["b"]
+            q, k, v = (t.reshape(b, lc, heads, head_dim)
+                       for t in jnp.split(qkv, 3, axis=-1))
+            y = attend(q, k, v).reshape(b, lc, dim)
+            x = x + (y @ blk["proj"]["w"] + blk["proj"]["b"])
+            y = _ln_apply(blk["ln2"], x)
+            y = jax.nn.gelu(y @ blk["fc1"]["w"] + blk["fc1"]["b"])
+            x = x + (y @ blk["fc2"]["w"] + blk["fc2"]["b"])
+        x = _ln_apply(params["ln_f"], x)
+        pooled = jnp.sum(x, axis=1)
+        total = seq_len
+        if attn_impl != "dense":
+            pooled = lax.psum(pooled, seq_axis)
+        pooled = pooled / total
+        out = pooled @ params["head"]["w"] + params["head"]["b"]
+        return jax.nn.log_softmax(out), state
+
+    return ModelDef("transformer-classifier", init, apply, input_shape)
+
+
+register("transformer-classifier", make_transformer)
+register("transformer", make_transformer)

@@ -22,6 +22,11 @@ process-level parallelism (reference `tools/jobs.py:148-191`).
 """
 
 from byzantinemomentum_tpu.parallel.mesh import make_mesh, mesh_axes
+from byzantinemomentum_tpu.parallel.ring import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from byzantinemomentum_tpu.parallel.sharded import (
     pairwise_distances_sharded,
     shard_gar,
@@ -30,4 +35,5 @@ from byzantinemomentum_tpu.parallel.sharded import (
 )
 
 __all__ = ["make_mesh", "mesh_axes", "pairwise_distances_sharded",
-           "shard_gar", "sharded_state_spec", "sharded_train_step"]
+           "shard_gar", "sharded_state_spec", "sharded_train_step",
+           "dense_attention", "ring_attention", "ulysses_attention"]

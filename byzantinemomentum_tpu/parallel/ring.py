@@ -86,9 +86,11 @@ def ring_attention(q, k, v, axis_name, *, causal=True):
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return o, m_new, l, k_next, v_next
 
+    # Derived from q (not fresh constants) so the shard_map varying-axis
+    # checker sees the carry as device-varying from the start
     o0 = jnp.zeros_like(q)
-    m0 = jnp.full((b, h, lc), _NEG, q.dtype)
-    l0 = jnp.zeros((b, h, lc), q.dtype)
+    m0 = jnp.full_like(q[..., 0], _NEG)
+    l0 = jnp.zeros_like(q[..., 0])
     o, m, l, _, _ = lax.fori_loop(0, p, body, (o0, m0, l0, k, v))
     return o / jnp.maximum(l, 1e-20)[..., None]
 

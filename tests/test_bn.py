@@ -1,0 +1,251 @@
+"""BatchNorm-under-vmap parity tests — SURVEY §7 hard-part #2.
+
+The reference runs the S sampled workers *sequentially through one torch
+module*, so BatchNorm running stats fold worker-after-worker within a step
+(reference `experiments/model.py:246-248`, `models/empire.py:36-47`). The
+TPU engine computes all workers under `jax.vmap` (every chain starts from
+the shared pre-step stats) and reconstructs the sequential result with
+`compose_bn_updates` (`engine/step.py`). These tests pin that algebra:
+
+1. against a float64 numpy sequential fold (incl. multi-local-step chains),
+2. against a live `torch.nn.BatchNorm2d` driven worker-by-worker,
+3. end-to-end through the engine on `empire-cnn` vs a sequential re-apply,
+4. train/eval smoke for `empire-cnn` and forward/step for `wide_resnet`.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+
+from byzantinemomentum_tpu import losses, ops
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+from byzantinemomentum_tpu.engine.step import compose_bn_updates
+from byzantinemomentum_tpu.models import build as build_model
+from byzantinemomentum_tpu.models.core import (
+    BN_MOMENTUM, batchnorm_apply, batchnorm_init)
+
+
+def _sequential_fold(r0, stats, m=BN_MOMENTUM):
+    """Reference-semantics oracle: fold batch stats one worker at a time,
+    in float64 (reference `experiments/model.py:246-248`)."""
+    r = np.asarray(r0, np.float64)
+    for s in stats:
+        r = (1.0 - m) * r + m * np.asarray(s, np.float64)
+    return r
+
+
+def test_compose_algebra_matches_sequential_fold():
+    rng = np.random.default_rng(0)
+    C, S = 4, 7
+    m = BN_MOMENTUM
+    r0 = rng.normal(size=(C,)).astype(np.float32)
+    stats = rng.normal(size=(S, C)).astype(np.float32)
+    # What each vmapped worker reports: its own one-step chain from r0
+    per_worker = (1.0 - m) * r0 + m * stats
+    out = compose_bn_updates(
+        {"r": jnp.asarray(r0)}, {"r": jnp.asarray(per_worker)}, S)
+    np.testing.assert_allclose(
+        np.asarray(out["r"]), _sequential_fold(r0, stats),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_compose_algebra_matches_sequential_fold_local_steps():
+    """Multi-local-step chains: worker w's scan yields the running states
+    new[w, 0..k-1], each chained from the previous within the worker but all
+    rooted at the shared r0; the composed result must equal the worker-major
+    sequential fold over all S*k batch stats."""
+    rng = np.random.default_rng(1)
+    C, S, K = 3, 4, 3
+    m = BN_MOMENTUM
+    r0 = rng.normal(size=(C,)).astype(np.float32)
+    stats = rng.normal(size=(S, K, C)).astype(np.float32)
+    chains = np.empty_like(stats)
+    for w in range(S):
+        prev = r0
+        for j in range(K):
+            prev = (1.0 - m) * prev + m * stats[w, j]
+            chains[w, j] = prev
+    out = compose_bn_updates(
+        {"r": jnp.asarray(r0)}, {"r": jnp.asarray(chains)}, S, K)
+    np.testing.assert_allclose(
+        np.asarray(out["r"]),
+        _sequential_fold(r0, stats.reshape(S * K, C)),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_vmapped_bn_compose_matches_torch_sequential():
+    """Drive a live torch BatchNorm2d worker-by-worker (exactly what the
+    reference's per-worker backprops do to the module) and check both the
+    per-worker normalized outputs and the final running stats."""
+    rng = np.random.default_rng(2)
+    S, B, H, W, C = 5, 6, 3, 3, 4
+    x = rng.normal(size=(S, B, H, W, C)).astype(np.float32)
+    gamma = rng.normal(size=(C,)).astype(np.float32)
+    beta = rng.normal(size=(C,)).astype(np.float32)
+    r_mean0 = rng.normal(size=(C,)).astype(np.float32)
+    r_var0 = rng.uniform(0.5, 2.0, size=(C,)).astype(np.float32)
+
+    params = {"gamma": jnp.asarray(gamma), "beta": jnp.asarray(beta)}
+    state = {"mean": jnp.asarray(r_mean0), "var": jnp.asarray(r_var0)}
+    outs, new_states = jax.vmap(
+        lambda xb: batchnorm_apply(params, state, xb, train=True))(
+            jnp.asarray(x))
+    composed = compose_bn_updates(state, new_states, S)
+
+    bn = torch.nn.BatchNorm2d(C, eps=1e-5, momentum=BN_MOMENTUM)
+    with torch.no_grad():
+        bn.weight.copy_(torch.from_numpy(gamma))
+        bn.bias.copy_(torch.from_numpy(beta))
+        bn.running_mean.copy_(torch.from_numpy(r_mean0))
+        bn.running_var.copy_(torch.from_numpy(r_var0))
+    bn.train()
+    for w in range(S):
+        xt = torch.from_numpy(x[w].transpose(0, 3, 1, 2))  # NCHW
+        out_t = bn(xt).detach().numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(outs[w]), out_t,
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(composed["mean"]),
+                               bn.running_mean.detach().numpy(),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(composed["var"]),
+                               bn.running_var.detach().numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+def _cnn_engine(nb_workers=4, nb_real_byz=1, nb_for_study=5, **kw):
+    cfg = EngineConfig(
+        nb_workers=nb_workers, nb_decl_byz=1, nb_real_byz=nb_real_byz,
+        nb_for_study=nb_for_study, nb_for_study_past=1,
+        momentum=0.9, momentum_at="update", gradient_clip=5.0, **kw)
+    from byzantinemomentum_tpu import attacks
+    engine = build_engine(
+        cfg=cfg, model_def=build_model("empire-cnn"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["average"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+    return cfg, engine
+
+
+def test_empire_cnn_step_composes_bn_exactly():
+    """One engine step on empire-cnn (with S = nb_for_study > nb_honests
+    study extras, all of which update BN stats in the reference,
+    `attack.py:764, 786`) must produce the same net_state as sequentially
+    re-applying the model worker-by-worker with the same inputs and
+    per-worker dropout keys."""
+    cfg, engine = _cnn_engine()
+    state = engine.init(jax.random.PRNGKey(3))
+    S, B = cfg.nb_sampled, 4
+    assert S > cfg.nb_honests  # the study-extra case is exercised
+    rng = np.random.default_rng(4)
+    xs = jnp.asarray(rng.normal(size=(S, B, 32, 32, 3)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(S, B)).astype(np.int32))
+
+    # Capture oracle inputs BEFORE the step: train_step donates its state
+    _, _, *wkeys = jax.random.split(state.rng, S + 2)
+    params = engine.unravel(jnp.copy(state.theta))
+    st = jax.tree.map(jnp.copy, state.net_state)
+
+    new_state, _ = engine.train_step(state, xs, ys, jnp.float32(0.01))
+
+    # Sequential oracle: same per-worker keys as the engine's split
+    for w in range(S):
+        _, st = engine.model_def.apply(params, st, xs[w], train=True,
+                                       rng=wkeys[w])
+    for leaf_seq, leaf_eng in zip(jax.tree.leaves(st),
+                                  jax.tree.leaves(new_state.net_state)):
+        np.testing.assert_allclose(np.asarray(leaf_eng), np.asarray(leaf_seq),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_empire_cnn_local_steps_compose_bn_exactly():
+    """Same oracle with nb_local_steps=2: stats must fold worker-major over
+    every local step's batch (the capability the reference gates off,
+    reference `attack.py:796-798`)."""
+    cfg, engine = _cnn_engine(nb_local_steps=2)
+    state = engine.init(jax.random.PRNGKey(5))
+    S, K, B = cfg.nb_sampled, 2, 3
+    lr = jnp.float32(0.01)
+    rng = np.random.default_rng(6)
+    xs = jnp.asarray(rng.normal(size=(S, K, B, 32, 32, 3)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(S, K, B)).astype(np.int32))
+
+    _, _, *wkeys = jax.random.split(state.rng, S + 2)
+    st = jax.tree.map(jnp.copy, state.net_state)
+    theta0 = jnp.copy(state.theta)
+
+    new_state, _ = engine.train_step(state, xs, ys, lr)
+
+    for w in range(S):
+        # Replicate the local-step scan: theta descends locally, state chains
+        th = theta0
+        rngs = jax.random.split(wkeys[w], K)
+        for j in range(K):
+            def scalar_loss(t, x=xs[w, j], y=ys[w, j], r=rngs[j], s=st):
+                out, new_s = engine.model_def.apply(
+                    engine.unravel(t), s, x, train=True, rng=r)
+                return engine.loss(out, y, t), new_s
+            (_, st), g = jax.value_and_grad(scalar_loss, has_aux=True)(th)
+            th = th - lr * g
+    for leaf_seq, leaf_eng in zip(jax.tree.leaves(st),
+                                  jax.tree.leaves(new_state.net_state)):
+        np.testing.assert_allclose(np.asarray(leaf_eng), np.asarray(leaf_seq),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_empire_cnn_train_eval_smoke():
+    """empire-cnn learns the synthetic CIFAR prototypes well above chance,
+    and eval consumes the composed running stats without blowing up."""
+    from byzantinemomentum_tpu import data
+    cfg = EngineConfig(nb_workers=4, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=0, momentum=0.9, momentum_at="update",
+                       gradient_clip=5.0)
+    engine = build_engine(
+        cfg=cfg, model_def=build_model("empire-cnn"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["average"], 1.0, {})])
+    trainset, testset = data.make_datasets("cifar10", 16, 64, seed=0)
+    state = engine.init(jax.random.PRNGKey(7))
+    for _ in range(30):
+        xs, ys = zip(*(trainset.sample() for _ in range(cfg.nb_sampled)))
+        state, _ = engine.train_step(
+            state, jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
+            jnp.float32(0.05))
+    x, y = testset.sample()
+    res = engine.eval_step(state.theta, state.net_state,
+                           jnp.asarray(x), jnp.asarray(y))
+    acc = float(res[0]) / float(res[1])
+    assert np.isfinite(acc) and acc > 0.3  # 10 classes, chance = 0.1
+    # Running stats did move off their init values
+    assert not np.allclose(np.asarray(state.net_state["b1"]["mean"]), 0.0)
+
+
+def test_wide_resnet_forward_and_step():
+    """wide_resnet builds, runs forward with the right output shape, and
+    takes one finite training step (small depth/width for CI speed)."""
+    model_def = build_model("wide_resnet-Wide_ResNet",
+                           depth=10, widen_factor=1, dropout_rate=0.3,
+                           num_classes=10)
+    params, net_state = model_def.init(jax.random.PRNGKey(8))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    out, _ = model_def.apply(params, net_state, x, train=False,
+                             rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 10)
+    # Log-softmax outputs: rows sum to 1 in probability space
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(axis=1), 1.0,
+                               rtol=1e-5)
+
+    cfg = EngineConfig(nb_workers=3, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=0, momentum=0.9, momentum_at="update")
+    engine = build_engine(
+        cfg=cfg, model_def=model_def, loss=losses.Loss("nll"),
+        criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["median"], 1.0, {})])
+    state = engine.init(jax.random.PRNGKey(9))
+    rng = np.random.default_rng(10)
+    xs = jnp.asarray(rng.normal(size=(3, 2, 32, 32, 3)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(3, 2)).astype(np.int32))
+    new_state, _ = engine.train_step(state, xs, ys, jnp.float32(0.01))
+    assert np.isfinite(np.asarray(new_state.theta)).all()
+    assert int(new_state.steps) == 1

@@ -110,6 +110,110 @@ def test_momentum_placements_match_reference_algebra(momentum_at, nesterov):
                                rtol=1e-5, atol=1e-6)
 
 
+def quad_loss():
+    """Quadratic probe: loss = 0.5*||theta - mean(batch)||^2, so the
+    gradient theta - mean(batch) DEPENDS on theta — Nesterov's lookahead
+    measurably changes the trajectory (unlike the linear probe above)."""
+    return losses.Loss(lambda output, target, params:
+                       0.5 * jnp.sum((params - jnp.mean(output, axis=0)) ** 2))
+
+
+def make_quad_engine(**cfg_kwargs):
+    cfg = EngineConfig(**cfg_kwargs)
+    return cfg, build_engine(
+        cfg=cfg, model_def=probe_model(), loss=quad_loss(),
+        criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars["average"], 1.0, {})])
+
+
+def numpy_reference_quad(batches, lr, *, momentum_at, mu=0.9, damp=0.1,
+                         nesterov=False, h=None):
+    """Numpy transcription of the reference loop for the quadratic probe,
+    including the exact Nesterov lookahead: theta shifted by
+    -momentum*lr*buffer before each backprop and restored after — per-worker
+    buffers for worker placement, the server buffer otherwise (reference
+    `attack.py:757-783`); study extras beyond the h worker buffers get zero
+    lookahead (the engine's defined behavior where the reference would index
+    out of bounds)."""
+    S = batches[0].shape[0]
+    h = S if h is None else h
+    theta = np.zeros(D, np.float32)
+    m_server = np.zeros(D, np.float32)
+    m_workers = np.zeros((h, D), np.float32)
+    for xs in batches:
+        means = xs.mean(axis=1)  # (S, D)
+        grads = np.empty((S, D), np.float32)
+        for i in range(S):
+            if not nesterov:
+                lookahead = theta
+            elif momentum_at == "worker":
+                buf = m_workers[i] if i < h else np.zeros(D, np.float32)
+                lookahead = theta - mu * lr * buf
+            else:
+                lookahead = theta - mu * lr * m_server
+            grads[i] = lookahead - means[i]
+        if momentum_at == "worker":
+            m_workers = mu * m_workers + (1 - damp) * grads[:h]
+            honest = m_workers
+        elif momentum_at == "server":
+            honest = (1 - damp) * grads[:h] + mu * m_server
+        else:
+            honest = grads[:h]
+        d_agg = honest.mean(axis=0)
+        if momentum_at == "worker":
+            update = d_agg
+        elif momentum_at == "server":
+            m_server = d_agg
+            update = d_agg
+        else:
+            m_server = mu * m_server + (1 - damp) * d_agg
+            update = m_server
+        theta = theta - lr * update
+    return theta
+
+
+@pytest.mark.parametrize("momentum_at", ["update", "server", "worker"])
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_nesterov_lookahead_matches_reference_algebra(momentum_at, nesterov):
+    """Theta-dependent probe: the lookahead path is discriminated from plain
+    momentum (the trajectories provably differ), and each variant matches
+    the reference's exact lookahead algebra (`attack.py:757-783`)."""
+    rng = np.random.default_rng(13)
+    batches = [rng.normal(size=(5, 4, D)).astype(np.float32) for _ in range(5)]
+    cfg, engine = make_quad_engine(
+        nb_workers=5, nb_decl_byz=1, nb_real_byz=0, nb_for_study=0,
+        momentum=0.9, dampening=0.1, momentum_at=momentum_at,
+        nesterov=nesterov)
+    state, _ = run_steps(engine, cfg, batches, 0.3, study=False)
+    expected = numpy_reference_quad(batches, 0.3, momentum_at=momentum_at,
+                                    nesterov=nesterov)
+    np.testing.assert_allclose(np.asarray(state.theta), expected,
+                               rtol=1e-5, atol=1e-6)
+    # The test can fail: flipping nesterov must move the trajectory
+    other = numpy_reference_quad(batches, 0.3, momentum_at=momentum_at,
+                                 nesterov=not nesterov)
+    assert np.linalg.norm(expected - other) > 1e-4
+
+
+def test_nesterov_worker_study_extras_zero_lookahead():
+    """Worker placement with S > h study extras: the extras' gradients use
+    zero lookahead while the h honest workers use their own buffers."""
+    rng = np.random.default_rng(14)
+    S, h = 6, 3
+    batches = [rng.normal(size=(S, 2, D)).astype(np.float32)
+               for _ in range(4)]
+    cfg, engine = make_quad_engine(
+        nb_workers=h, nb_decl_byz=1, nb_real_byz=0, nb_for_study=S,
+        nb_for_study_past=1, momentum=0.9, dampening=0.0,
+        momentum_at="worker", nesterov=True)
+    assert cfg.nb_sampled == S and cfg.nb_honests == h
+    state, _ = run_steps(engine, cfg, batches, 0.3)
+    expected = numpy_reference_quad(batches, 0.3, momentum_at="worker",
+                                    damp=0.0, nesterov=True, h=h)
+    np.testing.assert_allclose(np.asarray(state.theta), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_clipping_and_weight_decay():
     rng = np.random.default_rng(4)
     batches = [10.0 * rng.normal(size=(3, 2, D)).astype(np.float32)

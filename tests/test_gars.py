@@ -166,3 +166,75 @@ def test_gar_list_input_compat():
     rows = [np.float32(r) for r in rand_grads(5, 3)]
     out = ops.gars["average"]([jnp.asarray(r) for r in rows])
     np.testing.assert_allclose(np.asarray(out), np.stack(rows).mean(axis=0), rtol=1e-6)
+
+
+def test_brute_unranking_matches_itertools():
+    """The in-graph combinatorial unranking enumerates subsets in exactly
+    `itertools.combinations` (lexicographic) order — the order the
+    reference's Python loop iterates in, which the first-minimum tie-break
+    depends on."""
+    import itertools
+    import jax
+    from byzantinemomentum_tpu.ops.brute import _binom_table, _unrank_masks
+    n, k = 9, 5
+    tbl = jnp.asarray(_binom_table(n, k).astype(np.int32))
+    total = int(_binom_table(n, k)[n, k])
+    ranks = jnp.arange(total, dtype=jnp.int32)
+    masks = np.asarray(_unrank_masks(ranks, n, k, tbl))
+    got = [tuple(np.nonzero(m)[0]) for m in masks]
+    want = list(itertools.combinations(range(n), k))
+    assert got == want
+
+
+def test_brute_tie_break_first_minimum():
+    """Duplicated rows create diameter ties; the selected subset must be the
+    lexicographically first (= reference iteration order)."""
+    base = rand_grads(3, 4)
+    # 5 rows: rows 0,1,2 distinct, rows 3,4 copies of rows 0,1 — many
+    # size-3 subsets share the minimal diameter
+    g = np.concatenate([base, base[:2]], axis=0)
+    from byzantinemomentum_tpu.ops.brute import selection
+    sel = sorted(int(i) for i in np.asarray(selection(jnp.asarray(g), 1)))
+    import itertools
+    dist = np.full((5, 5), 0.0)
+    for i in range(5):
+        for j in range(5):
+            dist[i, j] = np.linalg.norm(g[i] - g[j])
+    best_set, best_diam = None, None
+    for combo in itertools.combinations(range(5), 4):
+        diam = max(dist[x][y] for x, y in itertools.combinations(combo, 2))
+        if best_set is None or diam < best_diam - 1e-12:
+            best_set, best_diam = combo, diam
+    assert sel == sorted(best_set)
+
+
+def test_brute_paper_scale_streams():
+    """n=25, f=11 — C(25,14) = 4,457,400 subsets, the config the reference
+    grid actually runs brute-class diameters at. The streaming enumeration
+    must complete in bounded memory and agree with a numpy oracle computed
+    from the same distance matrix."""
+    n, f, d = 25, 11, 64
+    g = rand_grads(n, d)
+    got = np.asarray(ops.gars["brute"](jnp.asarray(g), f=f))
+    # Oracle: stream the same enumeration in numpy (vectorized per block)
+    import itertools
+    dist = np.linalg.norm(g[:, None, :] - g[None, :, :], axis=-1)
+    best_diam, best_combo = np.inf, None
+    block, cur = [], []
+    for combo in itertools.combinations(range(n), n - f):
+        cur.append(combo)
+        if len(cur) == 65536:
+            block = np.asarray(cur, np.int32)
+            diams = dist[block[:, :, None], block[:, None, :]].max(axis=(1, 2))
+            i = int(np.argmin(diams))
+            if diams[i] < best_diam:
+                best_diam, best_combo = float(diams[i]), tuple(block[i])
+            cur = []
+    if cur:
+        block = np.asarray(cur, np.int32)
+        diams = dist[block[:, :, None], block[:, None, :]].max(axis=(1, 2))
+        i = int(np.argmin(diams))
+        if diams[i] < best_diam:
+            best_diam, best_combo = float(diams[i]), tuple(block[i])
+    want = g[list(best_combo)].mean(axis=0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)

@@ -1,0 +1,133 @@
+"""Sequence/context parallelism tests: ring attention and Ulysses all-to-all
+attention (`parallel/ring.py`) verified EXACT against dense attention on the
+virtual 8-device CPU mesh, both as raw kernels and end-to-end through the
+`transformer-classifier` model (`models/transformer.py`)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from byzantinemomentum_tpu import losses, ops
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+from byzantinemomentum_tpu.models import build as build_model
+from byzantinemomentum_tpu.parallel import (
+    dense_attention, ring_attention, ulysses_attention)
+
+B, H, L, DH = 2, 8, 32, 4
+P_SEQ = 8  # sequence-axis size = the virtual device count
+
+
+def seq_mesh():
+    devices = np.asarray(jax.devices()[:P_SEQ])
+    return Mesh(devices, ("seq",))
+
+
+def rand_qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(rng.normal(size=(B, H, L, DH)).astype(np.float32)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = rand_qkv(0)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=causal))
+    mesh = seq_mesh()
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq"))
+    got = np.asarray(jax.jit(fn)(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    q, k, v = rand_qkv(1)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=causal))
+    mesh = seq_mesh()
+    fn = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=causal),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq"))
+    got = np.asarray(jax.jit(fn)(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_ring_attention_gradients_match_dense():
+    """Backprop through the ring (ppermute + fori_loop online softmax) must
+    agree with dense attention's gradients — training under sequence
+    sharding is exact, not just inference."""
+    q, k, v = rand_qkv(2)
+    t = np.random.default_rng(3).normal(size=(B, H, L, DH)).astype(np.float32)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) * t)
+
+    mesh = seq_mesh()
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq"))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) * t)
+
+    args = (jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_want = jax.grad(loss_dense, argnums=(0, 1, 2))(*args)
+    g_got = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(*args)
+    for got, want in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=5e-5, atol=5e-6)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_transformer_sequence_sharded_matches_dense(impl):
+    """The full transformer-classifier under sequence sharding (rows of the
+    image sharded over the mesh) reproduces the single-device logits: local
+    positional slices, per-chunk blocks and the psum'd mean pool compose
+    exactly."""
+    kwargs = dict(depth=2, dim=32, heads=8, num_classes=10,
+                  input_shape=(32, 32, 3))
+    dense_model = build_model("transformer-classifier", **kwargs)
+    shard_model = build_model("transformer-classifier", attn_impl=impl,
+                              **kwargs)
+    params, _ = dense_model.init(jax.random.PRNGKey(4))
+    x = np.random.default_rng(5).normal(
+        size=(3, 32, 32, 3)).astype(np.float32)
+
+    want, _ = dense_model.apply(params, {}, jnp.asarray(x), train=False)
+    mesh = seq_mesh()
+    fn = shard_map(
+        lambda p, xb: shard_model.apply(p, {}, xb, train=False)[0],
+        mesh=mesh, in_specs=(P(), P(None, "seq")), out_specs=P())
+    got = jax.jit(fn)(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_transformer_engine_step():
+    """transformer-classifier plugs into the full engine (vmapped workers,
+    GAR, momentum) like any registered model."""
+    model_def = build_model("transformer-classifier", depth=1, dim=16,
+                            heads=2, input_shape=(28, 28, 1))
+    cfg = EngineConfig(nb_workers=3, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=0, momentum=0.9, momentum_at="update")
+    engine = build_engine(
+        cfg=cfg, model_def=model_def, loss=losses.Loss("nll"),
+        criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["median"], 1.0, {})])
+    state = engine.init(jax.random.PRNGKey(6))
+    rng = np.random.default_rng(7)
+    xs = jnp.asarray(rng.normal(size=(3, 4, 28, 28, 1)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(3, 4)).astype(np.int32))
+    new_state, _ = engine.train_step(state, xs, ys, jnp.float32(0.01))
+    assert np.isfinite(np.asarray(new_state.theta)).all()
+    assert int(new_state.steps) == 1
