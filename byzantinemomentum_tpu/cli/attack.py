@@ -48,6 +48,13 @@ def process_commandline(argv=None):
         help="Device on which to run the GAR, 'same' for no change (on TPU "
              "the GAR fuses into the training program; this seam is kept "
              "for config parity)")
+    add("--dtype", type=str, default="float32",
+        help="Parameter/gradient dtype: float32, bfloat16, float16, float64 "
+             "(the reference Configuration's dtype, configuration.py:26-101)")
+    add("--compute-dtype", type=str, default=None,
+        help="Forward/backward compute dtype; default = --dtype. "
+             "'--dtype float32 --compute-dtype bfloat16' = TPU mixed "
+             "precision (bf16 MXU matmuls, f32 master weights/momentum/GAR)")
     add("--nb-steps", type=int, default=-1,
         help="Number of (additional) training steps, negative for no limit")
     add("--nb-workers", type=int, default=11, help="Total number of workers")
@@ -318,6 +325,15 @@ def main(argv=None):
         # Device selection: 'auto' = JAX default platform
         if args.device.lower() not in ("auto", ""):
             jax.config.update("jax_platforms", args.device.lower())
+        # Dtype selection (reference `attack.py:461`, Configuration dtype)
+        from byzantinemomentum_tpu.engine.config import DTYPES
+        for name in (args.dtype, args.compute_dtype):
+            if name is not None and name not in DTYPES:
+                utils.fatal_unavailable(sorted(set(DTYPES)), name,
+                                        what="dtype")
+        if jnp.float64 in (DTYPES[args.dtype],
+                           DTYPES[args.compute_dtype or args.dtype]):
+            jax.config.update("jax_enable_x64", True)
         if args.device_gar.lower() != "same":
             utils.warning(
                 "'--device-gar' is kept for config parity only: on TPU the "
@@ -365,7 +381,8 @@ def main(argv=None):
             momentum=args.momentum, dampening=args.dampening,
             nesterov=args.momentum_nesterov, momentum_at=args.momentum_at,
             weight_decay=args.weight_decay, gradient_clip=args.gradient_clip,
-            nb_local_steps=args.nb_local_steps)
+            nb_local_steps=args.nb_local_steps,
+            dtype=args.dtype, compute_dtype=args.compute_dtype)
         from byzantinemomentum_tpu import optim
         optimizer = optim.build(args.optimizer,
                                 weight_decay=args.weight_decay,
@@ -480,7 +497,12 @@ def main(argv=None):
         fd_eval = results.get("eval") if results else None
         fd_study = results.get("study") if results else None
         current_lr = args.initial_lr(int(state.steps))
-        float_format = "%.8e"  # f32 precision (reference `attack.py:870`)
+        # Dtype-dependent CSV precision (reference `attack.py:870`; bf16 has
+        # f16-like mantissa width, so it shares the "%.4e" format)
+        float_format = {
+            jnp.float16: "%.4e", jnp.bfloat16: "%.4e",
+            jnp.float32: "%.8e", jnp.float64: "%.16e",
+        }.get(cfg.jnp_dtype, "%s")
         just_loaded = args.load_checkpoint is not None
 
         while not exit_is_requested():

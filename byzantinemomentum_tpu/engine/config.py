@@ -7,7 +7,18 @@ so the reference's per-step lr schedules don't retrigger compilation).
 
 import dataclasses
 
-__all__ = ["EngineConfig"]
+import jax.numpy as jnp
+
+__all__ = ["EngineConfig", "DTYPES"]
+
+# Accepted dtype spellings (reference `experiments/configuration.py:26-101`
+# carries a torch dtype; bfloat16 is the TPU-native addition)
+DTYPES = {
+    "float32": jnp.float32, "f32": jnp.float32, "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float16": jnp.float16, "f16": jnp.float16, "fp16": jnp.float16,
+    "float64": jnp.float64, "f64": jnp.float64, "fp64": jnp.float64,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -27,10 +38,26 @@ class EngineConfig:
     weight_decay: float = 0.0     # --weight-decay (applied in the update)
     gradient_clip: float = None   # --gradient-clip (per-sampled-grad L2 cap)
     nb_local_steps: int = 1       # --nb-local-steps (multi-local-step SGD)
+    dtype: str = "float32"        # --dtype: parameter/state/gradient dtype
+    #                               (reference `configuration.py:26-101`)
+    compute_dtype: str = None     # --compute-dtype: forward/backward dtype;
+    #                               None = same as `dtype`. Setting bf16 with
+    #                               f32 params = TPU mixed precision (bf16
+    #                               MXU matmuls, f32 master weights/momentum/
+    #                               GAR space) — a capability beyond the
+    #                               reference's single-dtype Configuration.
 
     def __post_init__(self):
         if self.momentum_at not in ("update", "server", "worker"):
             raise ValueError(f"Invalid momentum placement {self.momentum_at!r}")
+        if self.dtype not in DTYPES:
+            raise ValueError(
+                f"Invalid dtype {self.dtype!r}; expected one of "
+                f"{sorted(set(DTYPES))}")
+        if self.compute_dtype is not None and self.compute_dtype not in DTYPES:
+            raise ValueError(
+                f"Invalid compute dtype {self.compute_dtype!r}; expected one "
+                f"of {sorted(set(DTYPES))}")
         if self.nb_real_byz > self.nb_workers:
             raise ValueError(
                 f"More real Byzantine workers ({self.nb_real_byz}) than total "
@@ -53,3 +80,13 @@ class EngineConfig:
     @property
     def study(self):
         return self.nb_for_study > 0
+
+    @property
+    def jnp_dtype(self):
+        """Parameter/state dtype as a jnp dtype."""
+        return DTYPES[self.dtype]
+
+    @property
+    def jnp_compute_dtype(self):
+        """Forward/backward compute dtype as a jnp dtype."""
+        return DTYPES[self.compute_dtype or self.dtype]

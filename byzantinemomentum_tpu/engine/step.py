@@ -37,6 +37,13 @@ from byzantinemomentum_tpu.models.core import BN_MOMENTUM
 __all__ = ["Engine", "build_engine"]
 
 
+def _cast_tree(tree, dtype):
+    """Cast every inexact leaf of a pytree to `dtype` (ints/keys untouched)."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact) else x, tree)
+
+
 def _clip_rows(G, clip):
     """Per-row L2 clip: row *= clip/||row|| iff ||row|| > clip
     (reference `attack.py:776-779`)."""
@@ -71,6 +78,13 @@ def compose_bn_updates(net_state0, per_worker_states, count, local_steps=1):
     weights = (1.0 - m) ** jnp.arange(total - 1, -1, -1, dtype=jnp.float32)
 
     def fold(r0, new_stack):
+        # The chain inversion is precision-sensitive; run it in at least f32
+        # (f64 stays f64) and cast back so low-precision dtypes keep the
+        # state dtype stable (donation requires output dtypes to match)
+        out_dtype = r0.dtype
+        acc = jnp.promote_types(out_dtype, jnp.float32)
+        r0 = r0.astype(acc)
+        new_stack = new_stack.astype(acc)
         if local_steps == 1:
             s = (new_stack - (1.0 - m) * r0) / m  # per-worker batch stats
         else:
@@ -81,7 +95,7 @@ def compose_bn_updates(net_state0, per_worker_states, count, local_steps=1):
             s = ((new_stack - (1.0 - m) * prev) / m).reshape(
                 (total,) + r0.shape)
         contrib = jnp.tensordot(weights, s, axes=1)
-        return decay * r0 + m * contrib
+        return (decay * r0 + m * contrib).astype(out_dtype)
 
     return jax.tree.map(fold, net_state0, per_worker_states)
 
@@ -107,6 +121,15 @@ class Engine:
           attack_kwargs: plugin args for the attack.
         """
         self.cfg = cfg
+        # f64 without the x64 flag would silently truncate every cast to f32
+        # while the run labels itself float64 — refuse upfront (the CLI flips
+        # the flag itself; library callers must opt in explicitly)
+        if (jnp.float64 in (cfg.jnp_dtype, cfg.jnp_compute_dtype)
+                and not jax.config.jax_enable_x64):
+            raise ValueError(
+                "dtype float64 requires x64 mode: call "
+                "jax.config.update('jax_enable_x64', True) before building "
+                "the engine")
         self.model_def = model_def
         self.loss = loss
         self.criterion = criterion
@@ -119,10 +142,14 @@ class Engine:
         self.optimizer = optimizer
 
         params, net_state = model_def.init(jax.random.PRNGKey(0))
+        # Parameters live in cfg.dtype (reference Configuration's dtype,
+        # `configuration.py:26-101`); the unravel closure is built over the
+        # cast leaves so the flat vector round-trips in that dtype.
+        params = _cast_tree(params, cfg.jnp_dtype)
         theta0, unravel = flatten_params(params)
         self.d = theta0.shape[0]
         self.unravel = unravel
-        self._net_state0 = net_state
+        self._net_state0 = _cast_tree(net_state, cfg.jnp_dtype)
 
         self.train_step = jax.jit(self._train_step, donate_argnums=(0,))
         self.eval_step = jax.jit(self._eval_step)
@@ -158,6 +185,8 @@ class Engine:
         study = self.cfg.study if study is None else study
         if params is None:
             params, net_state = self.model_def.init(key)
+        params = _cast_tree(params, self.cfg.jnp_dtype)
+        net_state = _cast_tree(net_state, self.cfg.jnp_dtype)
         theta, _ = flatten_params(params)
         return init_state(self.cfg, theta, net_state,
                           jax.random.fold_in(key, 1), study=study,
@@ -167,8 +196,16 @@ class Engine:
     # Per-worker gradient
 
     def _worker_grad(self, theta, net_state, x, y, rng):
+        cdtype = self.cfg.jnp_compute_dtype
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            x = x.astype(cdtype)
+
         def scalar_loss(th):
-            params = self.unravel(th)
+            # Forward/backward run in the compute dtype; when it differs from
+            # the parameter dtype (mixed precision) the casts' transposes
+            # bring the gradient back in the parameter dtype — bf16 MXU
+            # matmuls with f32 master weights, momentum and GAR space.
+            params = _cast_tree(self.unravel(th), cdtype)
             out, new_state = self.model_def.apply(
                 params, net_state, x, train=True, rng=rng)
             return self.loss(out, y, th), new_state
@@ -249,6 +286,9 @@ class Engine:
         cfg = self.cfg
         S, h = cfg.nb_sampled, cfg.nb_honests
         mu, damp = cfg.momentum, cfg.dampening
+        # The lr arrives as an f32 scalar; cast so the momentum/update algebra
+        # stays in the parameter dtype (f32*bf16 would silently promote)
+        lr = jnp.asarray(lr).astype(state.theta.dtype)
 
         rng, mix_key, *wkeys = jax.random.split(state.rng, S + 2)
         wkeys = jnp.stack(wkeys)
@@ -303,12 +343,15 @@ class Engine:
             G_attack = self.attack.unchecked(
                 G_honest, f_decl=cfg.nb_decl_byz, f_real=cfg.nb_real_byz,
                 defense=defense_fn, **self.attack_kwargs)
+            # Attack internals (line-search factors) may promote to f32;
+            # pin the Byzantine rows back to the gradient dtype
+            G_attack = G_attack.astype(G_honest.dtype)
         else:
             G_attack = jnp.zeros((0, self.d), G_honest.dtype)
 
         # --- defense phase (`attack.py:821-822`) --- #
         G_all = jnp.concatenate([G_honest, G_attack])
-        grad_defense = self._run_defense(G_all, mix_u)
+        grad_defense = self._run_defense(G_all, mix_u).astype(G_honest.dtype)
         accept_ratio = self._run_influence(G_honest, G_attack, mix_u)
 
         # --- model update (`attack.py:832-839`) --- #
@@ -364,7 +407,11 @@ class Engine:
     # Evaluation (reference `experiments/model.py:382-396`)
 
     def _eval_step(self, theta, net_state, x, y):
-        params = self.unravel(theta)
+        cdtype = self.cfg.jnp_compute_dtype
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            x = x.astype(cdtype)
+        params = _cast_tree(self.unravel(theta), cdtype)
+        net_state = _cast_tree(net_state, cdtype)
         out, _ = self.model_def.apply(params, net_state, x, train=False,
                                       rng=jax.random.PRNGKey(0))
         return self.criterion(out, y)

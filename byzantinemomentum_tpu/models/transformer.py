@@ -113,10 +113,13 @@ def make_transformer(depth=2, dim=64, heads=4, mlp_ratio=4, num_classes=10,
             x = x + (y @ blk["fc2"]["w"] + blk["fc2"]["b"])
         x = _ln_apply(params["ln_f"], x)
         pooled = jnp.sum(x, axis=1)
-        total = seq_len
-        if attn_impl != "dense":
-            pooled = lax.psum(pooled, seq_axis)
-        pooled = pooled / total
+        if attn_impl == "dense":
+            # Divide by the actual token count (the pos[:lc] slice tolerates
+            # sequences shorter than the configured seq_len)
+            pooled = pooled / lc
+        else:
+            # Sharded: each chip holds lc = L/p tokens of the full sequence
+            pooled = lax.psum(pooled, seq_axis) / seq_len
         out = pooled @ params["head"]["w"] + params["head"]["b"]
         return jax.nn.log_softmax(out), state
 

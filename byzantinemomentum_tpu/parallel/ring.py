@@ -36,6 +36,8 @@ def dense_attention(q, k, v, *, causal=True, base=0):
     used by the ring kernel for cross-block causal masks.
     """
     dh = q.shape[-1]
+    # Softmax statistics in f32 regardless of the input dtype (the usual
+    # flash-attention accumulator rule); output cast back to q.dtype
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
         jnp.float32(dh))
     if causal:
@@ -43,7 +45,7 @@ def dense_attention(q, k, v, *, causal=True, base=0):
         kpos = jnp.arange(k.shape[2])[None, :]
         scores = jnp.where(qpos >= kpos, scores, _NEG)
     weights = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v).astype(q.dtype)
 
 
 def ring_attention(q, k, v, axis_name, *, causal=True):
@@ -87,12 +89,15 @@ def ring_attention(q, k, v, axis_name, *, causal=True):
         return o, m_new, l, k_next, v_next
 
     # Derived from q (not fresh constants) so the shard_map varying-axis
-    # checker sees the carry as device-varying from the start
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full_like(q[..., 0], _NEG)
-    l0 = jnp.zeros_like(q[..., 0])
+    # checker sees the carry as device-varying from the start. Accumulators
+    # are f32 whatever the input dtype (the body's f32 `scale` promotes the
+    # statistics, so a low-precision carry would change type across
+    # iterations); the output is cast back at the end.
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    m0 = jnp.full_like(q[..., 0], _NEG, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
     o, m, l, _, _ = lax.fori_loop(0, p, body, (o0, m0, l0, k, v))
-    return o / jnp.maximum(l, 1e-20)[..., None]
+    return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name, *, causal=True):

@@ -1,0 +1,176 @@
+"""Dtype-knob tests (VERDICT r1 items 2/9): the `--dtype` flag mirrors the
+reference Configuration's dtype (reference
+`experiments/configuration.py:26-101`); `--compute-dtype` adds TPU mixed
+precision (bf16 forward/backward, f32 master weights/momentum/GAR space).
+
+GAR differentials at bf16 tolerances, engine state-dtype invariants, and a
+CLI smoke run per dtype."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import models as models_mod
+from byzantinemomentum_tpu import losses as losses_mod
+from byzantinemomentum_tpu import ops as ops_mod
+from byzantinemomentum_tpu.cli.attack import main
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+
+# bf16 has an 8-bit mantissa: kernels on bf16 inputs should agree with the
+# f32 kernel on the same values to ~1e-2 relative
+BF16_TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def _rand(n, d, seed=0):
+    return np.random.default_rng(seed).standard_normal((n, d)).astype(np.float32)
+
+
+@pytest.mark.parametrize("name", ["average", "median", "trmean", "phocas",
+                                  "meamed", "krum", "bulyan", "aksel", "cge"])
+def test_gar_bf16_matches_f32(name):
+    # Compare the kernel at bf16 against the f32 kernel on the SAME
+    # bf16-rounded values: identical selection decisions, so the remaining
+    # difference is pure kernel arithmetic precision (input-rounding-induced
+    # selection flips are the dtype's semantics, not a kernel defect)
+    Gbf = jnp.asarray(_rand(11, 40)).astype(jnp.bfloat16)
+    G32 = Gbf.astype(jnp.float32)
+    gar = ops_mod.gars[name]
+    out32 = np.asarray(gar.unchecked(G32, f=2))
+    outbf = np.asarray(gar.unchecked(Gbf, f=2).astype(jnp.float32))
+    np.testing.assert_allclose(outbf, out32, **BF16_TOL)
+
+
+def test_gar_bf16_output_dtype_follows_input():
+    G = jnp.asarray(_rand(9, 16)).astype(jnp.bfloat16)
+    for name in ("average", "median", "krum"):
+        out = ops_mod.gars[name].unchecked(G, f=2)
+        assert out.dtype == jnp.bfloat16, name
+
+
+def _build(dtype=None, compute_dtype=None, momentum_at="update"):
+    cfg = EngineConfig(
+        nb_workers=5, nb_decl_byz=1, nb_real_byz=0, momentum=0.9,
+        momentum_at=momentum_at,
+        dtype=dtype or "float32", compute_dtype=compute_dtype)
+    model = models_mod.build("simples-full")
+    loss = losses_mod.Loss("nll")
+    crit = losses_mod.Criterion("top-k")
+    return build_engine(cfg=cfg, model_def=model, loss=loss, criterion=crit,
+                        defenses=[(ops_mod.gars["median"], 1.0, {})])
+
+
+def _batches(cfg, model, seed=3):
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal(
+        (cfg.nb_sampled, 4) + model.input_shape).astype(np.float32)
+    ys = rng.integers(0, 10, (cfg.nb_sampled, 4)).astype(np.int32)
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def test_full_bf16_state_dtypes_stable():
+    eng = _build(dtype="bfloat16")
+    state = eng.init(jax.random.PRNGKey(0))
+    assert state.theta.dtype == jnp.bfloat16
+    assert state.momentum_server.dtype == jnp.bfloat16
+    xs, ys = _batches(eng.cfg, eng.model_def)
+    for _ in range(2):
+        state, _ = eng.train_step(state, xs, ys, jnp.float32(0.05))
+    assert state.theta.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(state.theta.astype(jnp.float32))))
+
+
+def test_mixed_precision_master_stays_f32_and_tracks_f32_run():
+    eng32 = _build()
+    engmp = _build(dtype="float32", compute_dtype="bfloat16")
+    s32 = eng32.init(jax.random.PRNGKey(0))
+    smp = engmp.init(jax.random.PRNGKey(0))
+    assert smp.theta.dtype == jnp.float32
+    xs, ys = _batches(eng32.cfg, eng32.model_def)
+    for _ in range(3):
+        s32, _ = eng32.train_step(s32, xs, ys, jnp.float32(0.05))
+        smp, _ = engmp.train_step(smp, xs, ys, jnp.float32(0.05))
+    assert smp.theta.dtype == jnp.float32
+    assert smp.momentum_server.dtype == jnp.float32
+    # Same trajectory up to bf16 forward/backward rounding
+    np.testing.assert_allclose(np.asarray(smp.theta), np.asarray(s32.theta),
+                               rtol=5e-2, atol=5e-3)
+    # ... but not bit-identical (the bf16 path must actually engage)
+    assert not np.array_equal(np.asarray(smp.theta), np.asarray(s32.theta))
+
+
+def test_full_bf16_with_attack_and_worker_momentum():
+    """Attack line-search + worker momentum buffers keep the bf16 dtype
+    (donation requires stable state dtypes across steps)."""
+    from byzantinemomentum_tpu import attacks as attacks_mod
+    cfg = EngineConfig(
+        nb_workers=7, nb_decl_byz=2, nb_real_byz=2, momentum=0.9,
+        momentum_at="worker", dtype="bfloat16")
+    model = models_mod.build("simples-full")
+    eng = build_engine(
+        cfg=cfg, model_def=model, loss=losses_mod.Loss("nll"),
+        criterion=losses_mod.Criterion("top-k"),
+        defenses=[(ops_mod.gars["median"], 1.0, {})],
+        attack=attacks_mod.attacks["empire"], attack_kwargs={"factor": 1.1})
+    state = eng.init(jax.random.PRNGKey(1))
+    xs, ys = _batches(cfg, model)
+    for _ in range(2):
+        state, _ = eng.train_step(state, xs, ys, jnp.float32(0.05))
+    assert state.theta.dtype == jnp.bfloat16
+    assert state.momentum_workers.dtype == jnp.bfloat16
+
+
+@pytest.fixture
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+@pytest.mark.parametrize("dtype,fmt_digits", [("bfloat16", 4), ("float32", 8)])
+def test_cli_dtype_smoke(tmp_path, small_synth, dtype, fmt_digits):
+    """Smoke run at each dtype: finite study metrics, dtype-dependent CSV
+    precision (reference `attack.py:870`)."""
+    resdir = tmp_path / dtype
+    rc = main(["--nb-steps", "2", "--batch-size", "8",
+               "--batch-size-test", "32", "--batch-size-test-reps", "1",
+               "--evaluation-delta", "2", "--model", "simples-full",
+               "--seed", "7", "--gar", "median", "--nb-workers", "7",
+               "--nb-decl-byz", "2", "--nb-for-study", "7",
+               "--nb-for-study-past", "2", "--dtype", dtype,
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    lines = (resdir / "study").read_text().split(os.linesep)
+    rows = [l for l in lines[1:] if l]
+    assert len(rows) == 2
+    field = rows[-1].split("\t")[2]  # "Average loss"
+    assert np.isfinite(float(field))
+    mantissa = field.split("e")[0].split(".")[1]
+    assert len(mantissa) == fmt_digits
+
+
+def test_cli_mixed_precision_smoke(tmp_path, small_synth):
+    resdir = tmp_path / "mp"
+    rc = main(["--nb-steps", "2", "--batch-size", "8",
+               "--batch-size-test", "32", "--batch-size-test-reps", "1",
+               "--evaluation-delta", "0", "--model", "simples-conv",
+               "--seed", "7", "--gar", "krum", "--nb-workers", "9",
+               "--nb-decl-byz", "2", "--nb-real-byz", "2",
+               "--attack", "little", "--attack-args", "factor:1.5",
+               "--dtype", "float32", "--compute-dtype", "bfloat16",
+               "--nb-for-study", "9", "--nb-for-study-past", "2",
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    lines = (resdir / "study").read_text().split(os.linesep)
+    rows = [l for l in lines[1:] if l]
+    assert all(np.isfinite(float(r.split("\t")[2])) for r in rows)
+
+
+def test_f64_without_x64_refused():
+    """Library callers requesting float64 without x64 mode get a hard error
+    instead of a silently-f32 run mislabeled as f64."""
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 already enabled in this process")
+    with pytest.raises(ValueError, match="x64"):
+        _build(dtype="float64")

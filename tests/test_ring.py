@@ -131,3 +131,38 @@ def test_transformer_engine_step():
     new_state, _ = engine.train_step(state, xs, ys, jnp.float32(0.01))
     assert np.isfinite(np.asarray(new_state.theta)).all()
     assert int(new_state.steps) == 1
+
+
+def test_ring_attention_bf16():
+    """Low-precision inputs must trace (f32 accumulator carry) and match the
+    f32 result to bf16 tolerance, with output dtype following the input."""
+    q, k, v = rand_qkv(4)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=True))
+    mesh = seq_mesh()
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq"))
+    qb, kb, vb = (jnp.asarray(t).astype(jnp.bfloat16) for t in (q, k, v))
+    got = jax.jit(fn)(qb, kb, vb)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got.astype(jnp.float32)), want,
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_transformer_short_sequence_mean_pool():
+    """Dense-path mean pool divides by the actual token count when the input
+    is shorter than the configured seq_len."""
+    model = build_model("transformer-classifier", depth=1, dim=16, heads=2,
+                        input_shape=(28, 28, 1))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x_short = np.random.default_rng(0).normal(
+        size=(2, 14, 28, 1)).astype(np.float32)
+    out_short, _ = model.apply(params, state, jnp.asarray(x_short))
+    # Same tokens fed with seq_len=14 configured: identical pooled logits
+    model14 = build_model("transformer-classifier", depth=1, dim=16, heads=2,
+                          input_shape=(14, 28, 1))
+    out14, _ = model14.apply(params, state, jnp.asarray(x_short))
+    np.testing.assert_allclose(np.asarray(out_short), np.asarray(out14),
+                               rtol=1e-5, atol=1e-6)
