@@ -9,6 +9,12 @@ exactly this cell — f=5 is the largest f for which Bulyan's n >= 4f+3
 constraint holds at n=25, and the grid excludes Bulyan at f=11; reference
 `reproduce.py:165-209`, `aggregators/bulyan.py:102-117`).
 
+Two modes are measured: default f32, and TPU mixed precision
+(`--compute-dtype bfloat16`: bf16 forward/backward on the MXU, f32 master
+weights/momentum/GAR space). The headline `value` is the faster mode;
+per-mode numbers, FLOPs/step (XLA `cost_analysis`) and MFU (vs the chip's
+bf16 peak) ride along in the same JSON line.
+
 Both sides validate the GAR constraint up front and assert a finite defense
 gradient every measured step, so a degenerate (NaN) run cannot be timed.
 
@@ -41,8 +47,25 @@ WARMUP_STEPS = 2
 MIN_MEASURE_S = 5.0
 MAX_MEASURE_STEPS = 200
 
+# Peak bf16 matmul throughput per chip, FLOP/s (public spec sheets). MFU is
+# quoted against the bf16 peak for both modes (conservative for f32, which
+# the MXU runs via multi-pass bf16 decomposition).
+_PEAK_BF16 = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
 
-def main():
+
+def _peak_flops():
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in _PEAK_BF16:
+        if tag in kind:
+            return peak, kind
+    return None, kind
+
+
+def _run_mode(compute_dtype, train_data):
+    """Build + time one precision mode; returns (steps/s, flops/step)."""
     gar = ops.gars["bulyan"]
     message = gar.check(gradients=jnp.zeros((N_WORKERS, 1)), f=F)
     if message is not None:
@@ -51,7 +74,8 @@ def main():
     cfg = EngineConfig(
         nb_workers=N_WORKERS, nb_decl_byz=F, nb_real_byz=F,
         nb_for_study=1, nb_for_study_past=1,
-        momentum=0.99, momentum_at="update", gradient_clip=5.0)
+        momentum=0.99, momentum_at="update", gradient_clip=5.0,
+        compute_dtype=compute_dtype)
     model_def = models.build("empire-cnn")
     engine = build_engine(
         cfg=cfg, model_def=model_def, loss=losses.Loss("nll"),
@@ -60,9 +84,6 @@ def main():
         attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
 
     state = engine.init(jax.random.PRNGKey(0))
-    trainset, _ = data.make_datasets("cifar10", BATCH, BATCH, seed=0)
-    from byzantinemomentum_tpu.data.device import DeviceData
-    train_data = DeviceData(trainset)
     engine.attach_data(train_data)
     S = cfg.nb_sampled
     lr = jnp.float32(0.01)
@@ -70,6 +91,20 @@ def main():
     def batches():
         idx, flips = train_data.sample_indices(S)
         return jnp.asarray(idx), jnp.asarray(flips)
+
+    # FLOPs of the compiled step program, before any donation invalidates
+    # the sample state (lowering only inspects avals)
+    flops = None
+    try:
+        idx0, flips0 = batches()
+        compiled = engine.train_step_indexed.lower(
+            state, idx0, flips0, lr).compile()
+        cost = compiled.cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
 
     for _ in range(WARMUP_STEPS):
         idx, flips = batches()
@@ -98,14 +133,33 @@ def main():
                 break
     jax.block_until_ready(state.theta)
     elapsed = time.monotonic() - start
-    steps_per_sec = steps / elapsed
 
     norms = np.asarray([float(v) for v in defense_norms])
     if not np.isfinite(norms).all():
         bad = int(np.argmax(~np.isfinite(norms)))
         raise SystemExit(
-            f"Non-finite defense gradient at measured step {bad}: the "
-            f"benchmark timed a degenerate run")
+            f"Non-finite defense gradient at measured step {bad} "
+            f"(compute_dtype={compute_dtype}): the benchmark timed a "
+            f"degenerate run")
+    return steps / elapsed, flops
+
+
+def main():
+    trainset, _ = data.make_datasets("cifar10", BATCH, BATCH, seed=0)
+    from byzantinemomentum_tpu.data.device import DeviceData
+    train_data = DeviceData(trainset)
+
+    sps_f32, flops_f32 = _run_mode(None, train_data)
+    sps_bf16, flops_bf16 = _run_mode("bfloat16", train_data)
+
+    if sps_bf16 > sps_f32:
+        headline, mode = sps_bf16, "bf16-mixed"
+        flops = flops_bf16
+    else:
+        headline, mode = sps_f32, "f32"
+        flops = flops_f32
+    peak, device_kind = _peak_flops()
+    mfu = (flops * headline / peak) if (flops and peak) else None
 
     baseline_path = pathlib.Path(__file__).resolve().parent / "BASELINE_MEASURED.json"
     vs_baseline = None
@@ -113,13 +167,19 @@ def main():
         baseline = json.loads(baseline_path.read_text())
         ref = baseline.get("torch_cpu_steps_per_sec")
         if ref:
-            vs_baseline = steps_per_sec / ref
+            vs_baseline = headline / ref
 
     print(json.dumps({
         "metric": "sim_steps_per_sec_cifar10_n25_f5_bulyan",
-        "value": steps_per_sec,
+        "value": headline,
         "unit": "steps/s",
         "vs_baseline": vs_baseline,
+        "mode": mode,
+        "steps_per_sec_f32": sps_f32,
+        "steps_per_sec_bf16_mixed": sps_bf16,
+        "flops_per_step": flops,
+        "mfu": mfu,
+        "device_kind": device_kind,
     }))
 
 
