@@ -39,15 +39,27 @@ def selected_stack(gradients, f, m=None, *, method="dot"):
     dist = pairwise_distances(gradients, method=method)  # diag = +inf
     scores = jnp.sum(jnp.sort(dist, axis=1)[:, :m], axis=1)
     rounds = n - 2 * f - 2
-    selected = []
-    # Static unrolled loop (n <= ~51 at paper scale): each round re-sorts the
-    # live scores, averages the current m best, prunes the arg-minimum.
-    for i in range(rounds):
-        m_i = min(m, m_max - i)
+    # The sequential selection runs entirely on the (n,) score vector,
+    # emitting one averaging-weight row per round; the gradients are touched
+    # once, by a single (rounds, n) @ (n, d) matmul — no per-round row
+    # gathers over the large matrix.
+    m_is = jnp.asarray([min(m, m_max - i) for i in range(rounds)], jnp.int32)
+
+    def body(scores, m_i):
         order = jnp.argsort(scores, stable=True)
-        selected.append(jnp.mean(gradients[order[:m_i]], axis=0))
-        scores = scores.at[order[0]].set(jnp.inf)
-    return jnp.stack(selected)
+        ranks = jnp.zeros((n,), jnp.int32).at[order].set(
+            jnp.arange(n, dtype=jnp.int32))
+        w = jnp.where(ranks < m_i, 1.0 / m_i.astype(jnp.float32), 0.0)
+        return scores.at[order[0]].set(jnp.inf), w
+
+    _, W = jax.lax.scan(body, scores, m_is)
+    # Rows with any non-finite coordinate carry +inf scores and are never
+    # selected (m_i <= n-f-2 < #finite rows under the n >= 4f+3 contract),
+    # but 0-weight * NaN would still poison the matmul — zero them out,
+    # which is exactly "excluded from the average"
+    finite = jnp.where(jnp.isfinite(gradients), gradients, 0.0)
+    return jnp.matmul(W.astype(gradients.dtype), finite,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
