@@ -133,6 +133,10 @@ def process_commandline(argv=None):
         help="Steps between checkpoints, 0 for none")
     add("--user-input-delta", type=int, default=0,
         help="Steps between interactive prompts, 0 for none")
+    add("--steps-per-program", type=int, default=8,
+        help="Training steps fused into one compiled dispatch (lax.scan); "
+             "milestones always force a boundary, so the per-step trajectory "
+             "and CSV output are identical to 1 (which disables fusion)")
     return parser.parse_args(sys.argv[1:] if argv is None else argv)
 
 
@@ -552,42 +556,77 @@ def main(argv=None):
                                                          "engine": engine})
             if steps_limit is not None and steps >= steps_limit:
                 break
-            new_lr = args.compute_new_learning_rate(steps)
-            if new_lr is not None:
-                current_lr = new_lr
+            # How many steps until the next milestone boundary — that many
+            # can fuse into one compiled dispatch (identical trajectory;
+            # `engine.train_multi*` is a lax.scan of the single step)
+            def next_boundary(delta):
+                return (steps // delta + 1) * delta if delta > 0 else None
+            bounds = [next_boundary(args.evaluation_delta),
+                      next_boundary(args.checkpoint_delta),
+                      next_boundary(args.user_input_delta),
+                      steps_limit]
+            horizon = min((b for b in bounds if b is not None),
+                          default=steps + max(args.steps_per_program, 1))
+            M = max(1, min(max(args.steps_per_program, 1), horizon - steps))
+            # Per-step learning rates over the window (reference
+            # `attack.py:748-751` semantics, evaluated per step)
+            lrs = []
+            for s in range(steps, steps + M):
+                new_lr = args.compute_new_learning_rate(s)
+                if new_lr is not None:
+                    current_lr = new_lr
+                lrs.append(current_lr)
             # Sample the per-worker batches (host dataloader boundary,
             # reference `experiments/dataset.py:208-218`)
             S = cfg.nb_sampled
             k = cfg.nb_local_steps
             need = S * k
-            # 'Training point count' is the value at loop entry, BEFORE this
+            # 'Training point count' is the value at loop entry, BEFORE each
             # step's increment (reference `attack.py:696, 844`)
             datapoints = int(state.datapoints)
             if use_device_data:
-                idx, flips = train_data.sample_indices(need)
-                if k > 1:
-                    idx = idx.reshape((S, k) + idx.shape[1:])
-                    flips = flips.reshape((S, k) + flips.shape[1:])
-                state, metrics = engine.train_step_indexed(
-                    state, jnp.asarray(idx), jnp.asarray(flips),
-                    jnp.float32(current_lr))
+                idx, flips = train_data.sample_indices(need * M)
+                idx = idx.reshape((M, S, k) + idx.shape[1:] if k > 1
+                                  else (M, S) + idx.shape[1:])
+                flips = flips.reshape((M, S, k) + flips.shape[1:] if k > 1
+                                      else (M, S) + flips.shape[1:])
+                batch = args.batch_size
+                if M == 1:
+                    state, metrics = engine.train_step_indexed(
+                        state, jnp.asarray(idx[0]), jnp.asarray(flips[0]),
+                        jnp.float32(lrs[0]))
+                else:
+                    state, metrics = engine.train_multi_indexed(
+                        state, jnp.asarray(idx), jnp.asarray(flips),
+                        jnp.asarray(lrs, jnp.float32))
             else:
-                xs, ys = zip(*(trainset.sample() for _ in range(need)))
+                xs, ys = zip(*(trainset.sample() for _ in range(need * M)))
                 xs = np.stack(xs)
                 ys = np.stack(ys)
-                if k > 1:
-                    xs = xs.reshape((S, k) + xs.shape[1:])
-                    ys = ys.reshape((S, k) + ys.shape[1:])
-                state, metrics = engine.train_step(
-                    state, jnp.asarray(xs), jnp.asarray(ys),
-                    jnp.float32(current_lr))
+                batch = xs.shape[1]
+                shape = (M, S, k) if k > 1 else (M, S)
+                xs = xs.reshape(shape + xs.shape[1:])
+                ys = ys.reshape(shape + ys.shape[1:])
+                if M == 1:
+                    state, metrics = engine.train_step(
+                        state, jnp.asarray(xs[0]), jnp.asarray(ys[0]),
+                        jnp.float32(lrs[0]))
+                else:
+                    state, metrics = engine.train_multi(
+                        state, jnp.asarray(xs), jnp.asarray(ys),
+                        jnp.asarray(lrs, jnp.float32))
             if fd_study is not None:
                 metrics = jax.device_get(metrics)
-                row = [steps, datapoints]
-                for column in STUDY_COLUMNS[2:-1]:
-                    row.append(float_format % float(metrics[column]))
-                row.append(float(metrics["Attack acceptation ratio"]))
-                results.store(fd_study, *row)
+                inc = batch * cfg.nb_honests * k
+                for i in range(M):
+                    row = [steps + i, datapoints + i * inc]
+                    for column in STUDY_COLUMNS[2:-1]:
+                        value = metrics[column]
+                        value = value[i] if M > 1 else value
+                        row.append(float_format % float(value))
+                    ar = metrics["Attack acceptation ratio"]
+                    row.append(float(ar[i] if M > 1 else ar))
+                    results.store(fd_study, *row)
 
         if results is not None:
             results.close()

@@ -119,3 +119,22 @@ def test_local_steps_capability(tmp_path):
     rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
     # datapoints advance by batch * honests * local steps per step
     assert int(rows[1].split("\t")[1]) == 8 * 11 * 2
+
+
+def test_steps_per_program_trajectory_identical(tmp_path):
+    """Fusing M steps into one dispatch (lax.scan) must not change the
+    trajectory: study/eval CSVs byte-identical to single-step dispatch."""
+    outs = []
+    for spp in ("1", "4"):
+        resdir = tmp_path / f"spp{spp}"
+        rc = main(BASE + ["--nb-steps", "7", "--gar", "krum",
+                          "--attack", "empire", "--attack-args", "factor:1.1",
+                          "--nb-real-byz", "3", "--evaluation-delta", "3",
+                          "--nb-for-study", "11", "--nb-for-study-past", "2",
+                          "--steps-per-program", spp,
+                          "--result-directory", str(resdir)])
+        assert rc == 0
+        outs.append(((resdir / "study").read_text(),
+                     (resdir / "eval").read_text()))
+    assert outs[0][0] == outs[1][0]
+    assert outs[0][1] == outs[1][1]
