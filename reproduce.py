@@ -118,27 +118,261 @@ def submit_cifar(jobs):
 
 
 def submit_smoke(jobs):
-    """Tiny sanity grid (non-paper) to validate the pipeline end-to-end."""
+    """Tiny sanity grid (non-paper) to validate the pipeline end-to-end,
+    incl. the analysis: names follow the full-grid convention so the bucket
+    statistics and comparison plots exercise on it."""
     base = {
         "batch-size": 16, "model": "simples-full", "loss": "nll",
         "momentum": 0.9, "evaluation-delta": 2, "nb-steps": 4,
-        "nb-for-study": 11, "nb-for-study-past": 3, "nb-workers": 11,
+        "nb-for-study": 9, "nb-for-study-past": 3, "nb-workers": 9,
         "batch-size-test": 32, "batch-size-test-reps": 2,
+        "learning-rate": 0.5,
     }
-    for gar, f in (("median", 4), ("krum", 3)):
-        params = dict(base, gar=gar)
-        params["nb-decl-byz"] = f
-        params["nb-real-byz"] = f
-        params["attack"] = "empire"
-        params["attack-args"] = "factor:1.1"
-        jobs.submit(f"smoke-{gar}-f_{f}", make_command(params))
+    f = 2
+    params = dict(base)
+    params["nb-workers"] = base["nb-workers"] - f
+    params["nb-for-study"] = params["nb-workers"]
+    jobs.submit(f"mnist-average-n_{params['nb-workers']}-lr_0.5",
+                make_command(dict(params, dataset="mnist")))
+    for gar in ("median", "krum"):
+        for momentum in ("update", "worker"):
+            params = dict(base, dataset="mnist", gar=gar)
+            params["nb-decl-byz"] = f
+            params["nb-real-byz"] = f
+            params["attack"] = "empire"
+            params["attack-args"] = "factor:1.1"
+            params["momentum-at"] = momentum
+            jobs.submit(f"mnist-empire-{gar}-f_{f}-lr_0.5-at_{momentum}",
+                        make_command(params))
+
+
+def _avg_err(paths, *cols):
+    """Mean and population-std of the selected columns across seed runs —
+    one DataFrame per column with `<col>` and `<col>-err`
+    (reference `reproduce.py:383-407` `compute_avg_err`)."""
+    import pandas
+
+    import study
+
+    frames = []
+    for p in paths:
+        sess = study.Session(p)
+        if sess.data is None:
+            continue
+        sess.compute_ratio(nowarn=True)
+        frames.append(sess.data)
+    out = {}
+    for col in cols:
+        subs = [f[col].dropna() for f in frames if col in f.columns]
+        subs = [s for s in subs if len(s)]
+        if not subs:
+            continue
+        joined = pandas.concat(subs, axis=1)
+        out[col] = pandas.DataFrame({
+            col: joined.mean(axis=1),
+            col + "-err": joined.std(axis=1, ddof=0).fillna(0.0)})
+    return out
+
+
+def _select_ymax(*ratio_frames):
+    """Bucketed y-limit for ratio plots (reference `reproduce.py:445-456`)."""
+    vmax = 0.0
+    for frame, col in ratio_frames:
+        if frame is not None and col in frame.columns:
+            m = frame[col].max()
+            if m == m:
+                vmax = max(vmax, float(m))
+    for ymax in (1., 2., 6., 12.):
+        if vmax < ymax:
+            return ymax
+    return 20.
+
+
+def _run_info(sess):
+    """(dataset, attack, gar, f, lr-token, momentum_at, nesterov, seed) of an
+    attacked run, or None — read from config.json rather than re-parsing the
+    name (more robust than the reference's `get_reference_accuracy` split,
+    reference `reproduce.py:229-255`)."""
+    j = sess.json
+    if not j or j.get("nb_real_byz", 0) <= 0:
+        return None
+    seed = sess.name.rsplit("-", 1)[-1]
+    return {
+        "dataset": j["dataset"], "attack": j["attack"], "gar": j["gar"],
+        "f": j["nb_real_byz"], "lr": j["learning_rate"],
+        "at": j["momentum_at"], "nesterov": bool(j.get("momentum_nesterov")),
+        "honests": j["nb_workers"] - j["nb_real_byz"], "seed": seed,
+        "steps": j.get("nb_steps"),
+    }
+
+
+def _baseline_name(info):
+    """Result-dir name of the matching unattacked run
+    (reference `reproduce.py:244-250`)."""
+    suffix = "-nesterov" if info["nesterov"] else ""
+    return (f"{info['dataset']}-average-n_{info['honests']}"
+            f"-lr_{info['lr']}{suffix}-{info['seed']}")
+
+
+# Bucket subsets (reference `reproduce.py:293`; 'cifar10-' keeps the dash so
+# it does not match cifar100 names)
+BUCKET_SUBSETS = (None, "mnist", "cifar", "fashion", "f_24", "f_12",
+                  "cifar10-", "cifar100", "f_11", "f_5")
+
+
+def _bucket_stats(maxaccs, infos):
+    """Attack-effectiveness / defense-gain buckets over max accuracies
+    (reference `reproduce.py:293-366`): for every at_worker run with an
+    at_update sibling and an unattacked baseline, classify the attack's
+    effectiveness (baseline - at_update) and the momentum-at-worker gain
+    (at_worker - at_update) at the 10/20/40% thresholds."""
+    for subset in BUCKET_SUBSETS:
+        with utils.Context("everything" if subset is None else subset, None):
+            total = 0
+            effect = {10: 0, 20: 0, 40: 0}
+            above = {10: 0, 20: 0, 40: 0}
+            bad0 = bad02 = bad05 = loss05 = loss10 = 0
+            for name, info in infos.items():
+                if info is None or info["at"] != "worker":
+                    continue
+                if subset is not None and subset not in name:
+                    continue
+                update_name = name.replace("at_worker", "at_update")
+                ref_name = _baseline_name(info)
+                if update_name not in maxaccs or ref_name not in maxaccs:
+                    continue
+                ref = maxaccs[ref_name]
+                ats = maxaccs[update_name]
+                atw = maxaccs[name]
+                total += 1
+                loss = ref - ats
+                gain = atw - ats
+                if gain < 0:
+                    bad0 += 1
+                    if gain < -0.02:
+                        bad02 += 1
+                    if gain < -0.05:
+                        bad05 += 1
+                    if ref - atw > 0.05:
+                        loss05 += 1
+                    if ref - atw > 0.1:
+                        loss10 += 1
+                for pct in (10, 20, 40):
+                    if loss > pct / 100.:
+                        effect[pct] += 1
+                        if gain > pct / 100.:
+                            above[pct] += 1
+            if total == 0:
+                utils.info("<no data>")
+                continue
+            for pct in (10, 20, 40):
+                utils.info(f"#experiments with effective attack ({pct}%): "
+                           f"{effect[pct]:4d}/{total:4d} "
+                           f"({effect[pct] / total * 100.:.2f}%)")
+            for pct in (10, 20, 40):
+                if effect[pct] > 0:
+                    utils.info(
+                        f"#experiments with defense gain above {pct}%: "
+                        f"{above[pct]:4d}/{effect[pct]:4d} "
+                        f"({above[pct] / effect[pct] * 100.:.2f}%)")
+                else:
+                    utils.info(f"#experiments with defense gain above {pct}%:"
+                               f"    N/A")
+            utils.info(f"#experiments with >0% performance loss:   "
+                       f"{bad0:4d}/{total:4d} ({bad0 / total * 100.:.2f}%)")
+            utils.info(f"#experiments with >2% performance loss:   "
+                       f"{bad02:4d}/{total:4d} ({bad02 / total * 100.:.2f}%)")
+            utils.info(f"#experiments with >5% performance loss:   "
+                       f"{bad05:4d}/{total:4d} ({bad05 / total * 100.:.2f}%)")
+            utils.info(f"#experiments with >5% \"optimality\" loss:  "
+                       f"{loss05:4d}/{total:4d} ({loss05 / total * 100.:.2f}%)")
+            utils.info(f"#experiments with >10% \"optimality\" loss: "
+                       f"{loss10:4d}/{total:4d} ({loss10 / total * 100.:.2f}%)")
+
+
+def _comparison_plots(paths, infos, plot_dir):
+    """Baseline-vs-attacked comparison plots per (dataset, attack, f, lr,
+    momentum-at, nesterov): accuracy and loss curves with per-GAR mean±std
+    bands plus the unattacked baseline, and per-GAR sampled/honest
+    variance-norm-ratio curves for the at_worker runs
+    (reference `reproduce.py:459-635`). Groups are derived from the result
+    dirs present rather than re-enumerating the grid, so partial grids (and
+    the smoke subset) plot whatever completed."""
+    import study
+
+    by_name = {p.name: p for p in paths}
+    # group key -> gar -> [paths over seeds]
+    groups = {}
+    for p in paths:
+        info = infos.get(p.name)
+        if info is None:
+            continue
+        key = (info["dataset"], info["attack"], info["f"], info["lr"],
+               info["at"], info["nesterov"])
+        groups.setdefault(key, {}).setdefault(info["gar"], []).append(p)
+    for (ds, attack, f, lr, at, nesterov), by_gar in sorted(groups.items()):
+        suffix = "-nesterov" if nesterov else ""
+        any_info = infos[next(iter(by_gar.values()))[0].name]
+        baseline_paths = []
+        for gar_paths in by_gar.values():
+            for p in gar_paths:
+                ref = by_name.get(_baseline_name(infos[p.name]))
+                if ref is not None and ref not in baseline_paths:
+                    baseline_paths.append(ref)
+        noattack = _avg_err(baseline_paths, "Cross-accuracy", "Average loss")
+        xmax = any_info.get("steps")
+        ymax_acc = 0.9 if ds.startswith("cifar") else 1.0
+        # Top-1 cross-accuracy and average-loss comparison plots
+        for col, kind, ylabel, ymin, ymax in (
+                ("Cross-accuracy", "", "Top-1 cross-accuracy", 0, ymax_acc),
+                ("Average loss", "-loss", "Average loss", 0, None)):
+            plot = study.LinePlot()
+            legend = []
+            if col in noattack:
+                plot.include(noattack[col], col, errs="-err", lalp=0.8,
+                             label="No attack")
+                legend.append("No attack")
+            for gar in sorted(by_gar):
+                data = _avg_err(by_gar[gar], col)
+                if col not in data:
+                    continue
+                plot.include(data[col], col, errs="-err", lalp=0.8,
+                             label=gar.capitalize())
+                legend.append(gar.capitalize())
+            if not legend:
+                plot.close()
+                continue
+            plot.finalize(None, "Step number", ylabel, xmin=0, xmax=xmax,
+                          ymin=ymin, ymax=ymax)
+            plot.save(plot_dir / f"{ds}-{attack}-f_{f}-lr_{lr}-at_{at}"
+                                 f"{suffix}{kind}.png", xsize=3, ysize=1.5)
+            plot.close()
+        # Variance-norm ratio plots (submit vs sample, at_worker runs only,
+        # reference `reproduce.py:509-518`)
+        if at != "worker":
+            continue
+        for gar in sorted(by_gar):
+            data = _avg_err(by_gar[gar], "Sampled ratio", "Honest ratio")
+            if "Sampled ratio" not in data or "Honest ratio" not in data:
+                continue
+            plot = study.LinePlot()
+            plot.include(data["Sampled ratio"], "Sampled ratio", errs="-err",
+                         lalp=0.5, ccnt=0, label=f"{gar.capitalize()} \"sample\"")
+            plot.include(data["Honest ratio"], "Honest ratio", errs="-err",
+                         lalp=0.5, ccnt=4, label=f"{gar.capitalize()} \"submit\"")
+            plot.finalize(None, "Step number", "Variance-norm ratio",
+                          xmin=0, xmax=xmax, ymin=0,
+                          ymax=_select_ymax(
+                              (data["Sampled ratio"], "Sampled ratio"),
+                              (data["Honest ratio"], "Honest ratio")))
+            plot.save(plot_dir / f"{ds}-{attack}-{gar}-f_{f}-lr_{lr}"
+                                 f"{suffix}-ratio.png", xsize=3, ysize=1.5)
+            plot.close()
 
 
 def analyze(data_dir, plot_dir):
     """Summary statistics + plots over completed result directories
     (reference `reproduce.py:258-366`, `459-635`)."""
-    import numpy as np
-
     import study
 
     paths = sorted(p for p in data_dir.iterdir() if p.is_dir()
@@ -148,7 +382,11 @@ def analyze(data_dir, plot_dir):
         return
     plot_dir.mkdir(parents=True, exist_ok=True)
 
-    # Per-run max accuracy + ratio-condition counting
+    # Per-run max accuracy + ratio-condition counting (reference
+    # `reproduce.py:264-291`; the reference's summary line reuses loop-leaked
+    # variables — documented bug, fixed here by printing the stored best)
+    maxaccs = {}
+    infos = {}
     expwith = expzero = 0
     best_ratio = None
     with utils.Context("analysis", "info"):
@@ -158,13 +396,21 @@ def analyze(data_dir, plot_dir):
                 continue
             acc = (sess.data["Cross-accuracy"].max()
                    if "Cross-accuracy" in sess.data.columns else float("nan"))
+            maxaccs[path.name] = float(acc)
+            infos[path.name] = _run_info(sess)
             line = f"{path.name}: max accuracy {acc:.4f}"
-            if sess.has_known_ratio():
+            if sess.has_known_ratio() and "Average loss" in sess.data.columns:
                 expwith += 1
                 data = sess.compute_ratio(nowarn=True).data
-                valid = data["Ratio enough for GAR?"].fillna(False)
-                nbvalid = int(valid.sum())
-                nbtotal = max(int(data["Ratio enough for GAR?"].notna().sum()), 1)
+                # Count steps where the ratio condition held AND the model
+                # was not already "killed" (loss above its initial value) —
+                # reference `reproduce.py:277-281`, incl. its nbtotal
+                # convention of excluding the final eval-only row
+                minloss = data["Average loss"].dropna().iloc[0]
+                nbtotal = max(len(data) - 1, 1)
+                ratio_ok = data["Ratio enough for GAR?"].fillna(False)
+                nbvalid = int(((data["Average loss"] <= minloss)
+                               & ratio_ok).sum())
                 pct = nbvalid / nbtotal * 100.0
                 if nbvalid == 0:
                     expzero += 1
@@ -179,29 +425,26 @@ def analyze(data_dir, plot_dir):
             utils.info(f"Maximum #steps with ratio validated: "
                        f"{best_ratio[0]}/{best_ratio[1]} ({best_ratio[2]:.2f}%)")
 
-    # Accuracy curves with mean±std bands across seeds
-    groups = {}
-    for path in paths:
-        stem = path.name.rsplit("-", 1)[0]  # strip the -<seed> suffix
-        groups.setdefault(stem, []).append(path)
+    # Attack-effectiveness / defense-gain buckets
+    with utils.Context("buckets", "info"):
+        _bucket_stats(maxaccs, infos)
+
     with utils.Context("plotting", "info"):
+        # Baseline-vs-attacked comparison plots (the paper's figures)
+        _comparison_plots(paths, infos, plot_dir)
+        # Per-experiment accuracy curves with mean±std bands across seeds
+        import pandas
+        groups = {}
+        for path in paths:
+            stem = path.name.rsplit("-", 1)[0]  # strip the -<seed> suffix
+            groups.setdefault(stem, []).append(path)
         for stem, members in groups.items():
-            frames = []
-            for path in members:
-                sess = study.Session(path)
-                if sess.data is not None and "Cross-accuracy" in sess.data.columns:
-                    frames.append(sess.data["Cross-accuracy"].dropna())
-            if not frames:
+            data = _avg_err(members, "Cross-accuracy")
+            if "Cross-accuracy" not in data:
                 continue
-            import pandas
-            joined = pandas.concat(frames, axis=1)
-            mean = joined.mean(axis=1)
-            std = joined.std(axis=1)
-            frame = pandas.DataFrame({
-                "Cross-accuracy": mean, "Cross-accuracy (std)": std})
             plot = study.LinePlot()
-            plot.include(frame, "Cross-accuracy", errs=" (std)",
-                         label=stem)
+            plot.include(data["Cross-accuracy"], "Cross-accuracy",
+                         errs="-err", label=stem)
             plot.finalize(stem, "Step number", "Cross-accuracy", ymin=0.0,
                           ymax=1.0)
             plot.save(plot_dir / f"{stem}.png", xsize=4, ysize=3)

@@ -109,3 +109,47 @@ def test_jobs_run_skip_and_fail(tmp_path):
     jobs2.submit("good", ok)
     jobs2.wait()
     assert marker.read_text() == "untouched"
+
+
+@pytest.fixture(scope="module")
+def analysis_grid(tmp_path_factory):
+    """A tiny grid following the reproduce.py naming convention: unattacked
+    baseline + (at_update, at_worker) pair for one GAR, single seed."""
+    data_dir = tmp_path_factory.mktemp("grid")
+    base = ["--nb-steps", "4", "--batch-size", "8", "--batch-size-test", "32",
+            "--batch-size-test-reps", "2", "--evaluation-delta", "2",
+            "--model", "simples-full", "--seed", "5",
+            "--nb-for-study-past", "2", "--learning-rate", "0.5"]
+    main(base + ["--nb-workers", "7", "--nb-for-study", "7",
+                 "--result-directory",
+                 str(data_dir / "mnist-average-n_7-lr_0.5-1")])
+    for at in ("update", "worker"):
+        main(base + ["--nb-workers", "9", "--nb-for-study", "9",
+                     "--nb-decl-byz", "2", "--nb-real-byz", "2",
+                     "--gar", "median", "--attack", "empire",
+                     "--attack-args", "factor:1.1", "--momentum-at", at,
+                     "--result-directory",
+                     str(data_dir / f"mnist-empire-median-f_2-lr_0.5-at_{at}-1")])
+    return data_dir
+
+
+def test_reproduce_analysis_buckets_and_plots(analysis_grid, tmp_path, capsys):
+    """The ported reference analysis (reproduce.py:258-366, :459-635):
+    bucket statistics printed per subset, comparison + ratio plots saved."""
+    import reproduce
+    plot_dir = tmp_path / "plots"
+    reproduce.analyze(analysis_grid, plot_dir)
+    out = capsys.readouterr().out
+    assert "#experiments with effective attack (10%):" in out
+    assert "#experiments with defense gain above 40%:" in out
+    assert '#experiments with >10% "optimality" loss:' in out
+    assert "/   1 (" in out  # one at_worker experiment classified
+    # Comparison plots: accuracy + loss per momentum placement, per-GAR ratio
+    for name in ("mnist-empire-f_2-lr_0.5-at_update.png",
+                 "mnist-empire-f_2-lr_0.5-at_update-loss.png",
+                 "mnist-empire-f_2-lr_0.5-at_worker.png",
+                 "mnist-empire-f_2-lr_0.5-at_worker-loss.png",
+                 "mnist-empire-median-f_2-lr_0.5-ratio.png"):
+        assert (plot_dir / name).is_file(), name
+    # Per-run ratio-condition counting on the analysis output
+    assert "ratio ok" in out
