@@ -146,21 +146,40 @@ def submit_smoke(jobs):
                         make_command(params))
 
 
-def _avg_err(paths, *cols):
+def _session(cache, path):
+    """Load (or fetch the cached) Session for a result dir, with per-run
+    error isolation: a corrupt directory warns and yields None instead of
+    aborting the whole analysis (the reference wraps every experiment in
+    try/except, reference `reproduce.py:469-483`)."""
+    import study
+
+    if path not in cache:
+        try:
+            sess = study.Session(path)
+            if sess.data is not None:
+                try:
+                    sess.compute_ratio(nowarn=True)
+                except Exception as err:
+                    utils.warning(f"Unable to compute ratios for "
+                                  f"{path.name!r}: {err}")
+            cache[path] = sess
+        except Exception as err:
+            utils.warning(f"Unable to process {path.name!r}: {err}")
+            cache[path] = None
+    return cache[path]
+
+
+def _avg_err(paths, *cols, cache):
     """Mean and population-std of the selected columns across seed runs —
     one DataFrame per column with `<col>` and `<col>-err`
     (reference `reproduce.py:383-407` `compute_avg_err`)."""
     import pandas
 
-    import study
-
     frames = []
     for p in paths:
-        sess = study.Session(p)
-        if sess.data is None:
-            continue
-        sess.compute_ratio(nowarn=True)
-        frames.append(sess.data)
+        sess = _session(cache, p)
+        if sess is not None and sess.data is not None:
+            frames.append(sess.data)
     out = {}
     for col in cols:
         subs = [f[col].dropna() for f in frames if col in f.columns]
@@ -290,83 +309,123 @@ def _bucket_stats(maxaccs, infos):
                        f"{loss10:4d}/{total:4d} ({loss10 / total * 100.:.2f}%)")
 
 
-def _comparison_plots(paths, infos, plot_dir):
+# Overview plot x-labels (reference `reproduce.py:380-382`)
+OVERVIEW_NAMES = {"update": "Standard\nformulation", "worker": "Our\nformulation"}
+
+
+def _comparison_plots(paths, infos, maxaccs, plot_dir, cache):
     """Baseline-vs-attacked comparison plots per (dataset, attack, f, lr,
-    momentum-at, nesterov): accuracy and loss curves with per-GAR mean±std
-    bands plus the unattacked baseline, and per-GAR sampled/honest
-    variance-norm-ratio curves for the at_worker runs
-    (reference `reproduce.py:459-635`). Groups are derived from the result
-    dirs present rather than re-enumerating the grid, so partial grids (and
-    the smoke subset) plot whatever completed."""
+    nesterov): per-momentum accuracy and loss curves with per-GAR mean±std
+    bands plus the unattacked baseline, per-GAR sampled/honest
+    variance-norm-ratio curves for the at_worker runs, and the
+    update-vs-worker max-accuracy overview box plots
+    (reference `reproduce.py:459-635` — line, ratio and overview plots; the
+    reference re-enumerates the grid, here the groups derive from the result
+    dirs present, so partial grids and the smoke subset plot whatever
+    completed)."""
+    import statistics
+
     import study
 
     by_name = {p.name: p for p in paths}
-    # group key -> gar -> [paths over seeds]
+    # (ds, attack, f, lr, nesterov) -> momentum-at -> gar -> [seed paths]
     groups = {}
     for p in paths:
         info = infos.get(p.name)
         if info is None:
             continue
         key = (info["dataset"], info["attack"], info["f"], info["lr"],
-               info["at"], info["nesterov"])
-        groups.setdefault(key, {}).setdefault(info["gar"], []).append(p)
-    for (ds, attack, f, lr, at, nesterov), by_gar in sorted(groups.items()):
+               info["nesterov"])
+        groups.setdefault(key, {}).setdefault(info["at"], {}) \
+              .setdefault(info["gar"], []).append(p)
+    for (ds, attack, f, lr, nesterov), by_at in sorted(groups.items()):
         suffix = "-nesterov" if nesterov else ""
-        any_info = infos[next(iter(by_gar.values()))[0].name]
         baseline_paths = []
-        for gar_paths in by_gar.values():
-            for p in gar_paths:
-                ref = by_name.get(_baseline_name(infos[p.name]))
-                if ref is not None and ref not in baseline_paths:
-                    baseline_paths.append(ref)
-        noattack = _avg_err(baseline_paths, "Cross-accuracy", "Average loss")
-        xmax = any_info.get("steps")
+        for by_gar in by_at.values():
+            for gar_paths in by_gar.values():
+                for p in gar_paths:
+                    ref = by_name.get(_baseline_name(infos[p.name]))
+                    if ref is not None and ref not in baseline_paths:
+                        baseline_paths.append(ref)
+        noattack = _avg_err(baseline_paths, "Cross-accuracy", "Average loss",
+                            cache=cache)
+        any_gar = next(iter(by_at.values()))
+        xmax = infos[next(iter(any_gar.values()))[0].name].get("steps")
         ymax_acc = 0.9 if ds.startswith("cifar") else 1.0
-        # Top-1 cross-accuracy and average-loss comparison plots
-        for col, kind, ylabel, ymin, ymax in (
-                ("Cross-accuracy", "", "Top-1 cross-accuracy", 0, ymax_acc),
-                ("Average loss", "-loss", "Average loss", 0, None)):
-            plot = study.LinePlot()
-            legend = []
-            if col in noattack:
-                plot.include(noattack[col], col, errs="-err", lalp=0.8,
-                             label="No attack")
-                legend.append("No attack")
-            for gar in sorted(by_gar):
-                data = _avg_err(by_gar[gar], col)
-                if col not in data:
+        for at, by_gar in sorted(by_at.items()):
+            # One pass per GAR fetches every plotted column
+            per_gar = {gar: _avg_err(by_gar[gar], "Cross-accuracy",
+                                     "Average loss", "Sampled ratio",
+                                     "Honest ratio", cache=cache)
+                       for gar in sorted(by_gar)}
+            # Top-1 cross-accuracy and average-loss comparison plots
+            for col, kind, ylabel, ymin, ymax in (
+                    ("Cross-accuracy", "", "Top-1 cross-accuracy", 0, ymax_acc),
+                    ("Average loss", "-loss", "Average loss", 0, None)):
+                plot = study.LinePlot()
+                legend = []
+                if col in noattack:
+                    plot.include(noattack[col], col, errs="-err", lalp=0.8,
+                                 label="No attack")
+                    legend.append("No attack")
+                for gar, data in per_gar.items():
+                    if col not in data:
+                        continue
+                    plot.include(data[col], col, errs="-err", lalp=0.8,
+                                 label=gar.capitalize())
+                    legend.append(gar.capitalize())
+                if not legend:
+                    plot.close()
                     continue
-                plot.include(data[col], col, errs="-err", lalp=0.8,
-                             label=gar.capitalize())
-                legend.append(gar.capitalize())
-            if not legend:
+                plot.finalize(None, "Step number", ylabel, xmin=0, xmax=xmax,
+                              ymin=ymin, ymax=ymax)
+                plot.save(plot_dir / f"{ds}-{attack}-f_{f}-lr_{lr}-at_{at}"
+                                     f"{suffix}{kind}.png", xsize=3, ysize=1.5)
                 plot.close()
+            # Variance-norm ratio plots (submit vs sample, at_worker runs
+            # only, reference `reproduce.py:509-518`) — both curves share
+            # ONE y-axis (axkey), as in the reference
+            if at != "worker":
                 continue
-            plot.finalize(None, "Step number", ylabel, xmin=0, xmax=xmax,
-                          ymin=ymin, ymax=ymax)
-            plot.save(plot_dir / f"{ds}-{attack}-f_{f}-lr_{lr}-at_{at}"
-                                 f"{suffix}{kind}.png", xsize=3, ysize=1.5)
-            plot.close()
-        # Variance-norm ratio plots (submit vs sample, at_worker runs only,
-        # reference `reproduce.py:509-518`)
-        if at != "worker":
-            continue
-        for gar in sorted(by_gar):
-            data = _avg_err(by_gar[gar], "Sampled ratio", "Honest ratio")
-            if "Sampled ratio" not in data or "Honest ratio" not in data:
-                continue
-            plot = study.LinePlot()
-            plot.include(data["Sampled ratio"], "Sampled ratio", errs="-err",
-                         lalp=0.5, ccnt=0, label=f"{gar.capitalize()} \"sample\"")
-            plot.include(data["Honest ratio"], "Honest ratio", errs="-err",
-                         lalp=0.5, ccnt=4, label=f"{gar.capitalize()} \"submit\"")
-            plot.finalize(None, "Step number", "Variance-norm ratio",
-                          xmin=0, xmax=xmax, ymin=0,
-                          ymax=_select_ymax(
-                              (data["Sampled ratio"], "Sampled ratio"),
-                              (data["Honest ratio"], "Honest ratio")))
-            plot.save(plot_dir / f"{ds}-{attack}-{gar}-f_{f}-lr_{lr}"
-                                 f"{suffix}-ratio.png", xsize=3, ysize=1.5)
+            for gar, data in per_gar.items():
+                if "Sampled ratio" not in data or "Honest ratio" not in data:
+                    continue
+                plot = study.LinePlot()
+                plot.include(data["Sampled ratio"], "Sampled ratio",
+                             errs="-err", lalp=0.5, ccnt=0, axkey="ratio",
+                             label=f"{gar.capitalize()} \"sample\"")
+                plot.include(data["Honest ratio"], "Honest ratio",
+                             errs="-err", lalp=0.5, ccnt=4, axkey="ratio",
+                             label=f"{gar.capitalize()} \"submit\"")
+                plot.finalize(None, "Step number", "Variance-norm ratio",
+                              xmin=0, xmax=xmax, ymin=0,
+                              ymax=_select_ymax(
+                                  (data["Sampled ratio"], "Sampled ratio"),
+                                  (data["Honest ratio"], "Honest ratio")))
+                plot.save(plot_dir / f"{ds}-{attack}-{gar}-f_{f}-lr_{lr}"
+                                     f"{suffix}-ratio.png", xsize=3, ysize=1.5)
+                plot.close()
+        # Overview box plots: max top-1 cross-accuracy pooled over GARs and
+        # seeds, one box per momentum placement, hline at the median
+        # unattacked max accuracy (reference `reproduce.py:599-635`)
+        pooled = {}
+        for at, by_gar in sorted(by_at.items()):
+            accs = [maxaccs[p.name] for gar_paths in by_gar.values()
+                    for p in gar_paths
+                    if p.name in maxaccs and maxaccs[p.name] == maxaccs[p.name]]
+            if accs:
+                pooled[at] = accs
+        base_accs = [maxaccs[p.name] for p in baseline_paths
+                     if p.name in maxaccs and maxaccs[p.name] == maxaccs[p.name]]
+        if pooled:
+            plot = study.BoxPlot()
+            for at, accs in sorted(pooled.items()):
+                plot.include(accs, OVERVIEW_NAMES.get(at, f"At {at}"))
+            if base_accs:
+                plot.hline(statistics.median(base_accs))
+            plot.finalize(None, "Max. top-1 cross-accuracy", ymin=0, ymax=1)
+            plot.save(plot_dir / f"overview-{ds}-{attack}-f_{f}-lr_{lr}"
+                                 f"{suffix}.png", xsize=1.5, ysize=1.5)
             plot.close()
 
 
@@ -385,23 +444,26 @@ def analyze(data_dir, plot_dir):
     # Per-run max accuracy + ratio-condition counting (reference
     # `reproduce.py:264-291`; the reference's summary line reuses loop-leaked
     # variables — documented bug, fixed here by printing the stored best)
+    cache = {}  # path -> Session (each run's CSVs parsed once)
     maxaccs = {}
     infos = {}
     expwith = expzero = 0
     best_ratio = None
     with utils.Context("analysis", "info"):
         for path in paths:
-            sess = study.Session(path)
-            if sess.data is None:
+            sess = _session(cache, path)
+            if sess is None or sess.data is None:
                 continue
             acc = (sess.data["Cross-accuracy"].max()
                    if "Cross-accuracy" in sess.data.columns else float("nan"))
             maxaccs[path.name] = float(acc)
             infos[path.name] = _run_info(sess)
             line = f"{path.name}: max accuracy {acc:.4f}"
-            if sess.has_known_ratio() and "Average loss" in sess.data.columns:
+            if (sess.has_known_ratio()
+                    and "Average loss" in sess.data.columns
+                    and "Ratio enough for GAR?" in sess.data.columns):
                 expwith += 1
-                data = sess.compute_ratio(nowarn=True).data
+                data = sess.data
                 # Count steps where the ratio condition held AND the model
                 # was not already "killed" (loss above its initial value) —
                 # reference `reproduce.py:277-281`, incl. its nbtotal
@@ -431,15 +493,14 @@ def analyze(data_dir, plot_dir):
 
     with utils.Context("plotting", "info"):
         # Baseline-vs-attacked comparison plots (the paper's figures)
-        _comparison_plots(paths, infos, plot_dir)
+        _comparison_plots(paths, infos, maxaccs, plot_dir, cache)
         # Per-experiment accuracy curves with mean±std bands across seeds
-        import pandas
         groups = {}
         for path in paths:
             stem = path.name.rsplit("-", 1)[0]  # strip the -<seed> suffix
             groups.setdefault(stem, []).append(path)
         for stem, members in groups.items():
-            data = _avg_err(members, "Cross-accuracy")
+            data = _avg_err(members, "Cross-accuracy", cache=cache)
             if "Cross-accuracy" not in data:
                 continue
             plot = study.LinePlot()

@@ -21,7 +21,7 @@ import pandas
 
 from byzantinemomentum_tpu import models, ops, utils
 
-__all__ = ["Session", "LinePlot", "BoxPlot"]
+__all__ = ["Session", "LinePlot", "BoxPlot", "display"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
 TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000,
@@ -194,6 +194,82 @@ class Session:
 
 
 # --------------------------------------------------------------------------- #
+# Interactive DataFrame viewer (reference `study.py:44-78`, `:129-180`:
+# a GTK3 TreeView window, degrading to a warning when GTK is unavailable)
+
+def _to_string(x):
+    """Float-aware cell formatting (reference `study.py:133-143`)."""
+    if type(x) is float:
+        return f"{x:e}"
+    return str(x).strip()
+
+
+try:
+    import gi
+    gi.require_version("Gtk", "3.0")
+    from gi.repository import Gtk, GLib  # noqa: F401
+
+    import atexit
+    import threading
+
+    _gtk_lock = threading.Lock()
+    _gtk_main = None
+
+    def _gtk_run(closure):
+        """Run a closure in the (lazily started) GTK main loop
+        (reference `study.py:52-71`)."""
+        global _gtk_main
+        with _gtk_lock:
+            if _gtk_main is None:
+                def gtk_main():
+                    atexit.register(Gtk.main_quit)
+                    Gtk.main()
+                _gtk_main = threading.Thread(
+                    target=gtk_main, name="gtk_main", daemon=True)
+                _gtk_main.start()
+        GLib.idle_add(closure)
+
+    class _DataFrameDisplayWindow(Gtk.Window):
+        """Scrollable TreeView of a DataFrame (reference `study.py:130-175`)."""
+
+        def __init__(self, data, title="Display data"):
+            super().__init__(title=title)
+            store = Gtk.ListStore(*([str] * (len(data.columns) + 1)))
+            for row in data.itertuples():
+                store.append([_to_string(x) for x in row])
+            view = Gtk.TreeView(store)
+            columns = [data.index.name] + list(data.columns)
+            for i, cname in enumerate(columns):
+                view.append_column(Gtk.TreeViewColumn(
+                    cname, Gtk.CellRendererText(), text=i))
+            scrolled = Gtk.ScrolledWindow()
+            scrolled.set_hexpand(True)
+            scrolled.set_vexpand(True)
+            scrolled.add(view)
+            self.add(scrolled)
+            self.set_default_size(800, 600)
+
+    def display(data, **kwargs):
+        """Window-based display of a DataFrame (reference `study.py:177-184`)."""
+        if isinstance(data, Session):
+            data = data.data
+        _gtk_run(lambda: _DataFrameDisplayWindow(data, **kwargs).show_all())
+
+except Exception as _gtk_err:  # GTK unavailable: degrade exactly like the
+    _gtk_reason = _gtk_err     # reference (warning, no viewer)
+
+    def display(data, **kwargs):
+        """Fallback when GTK 3.0 is unavailable: print a text rendering
+        instead of opening a window (the reference only warns,
+        reference `study.py:72-78`)."""
+        utils.warning(f"GTK 3.0 is unavailable: {_gtk_reason}")
+        if isinstance(data, Session):
+            data = data.data
+        if data is not None:
+            print(data.to_string(max_rows=40))
+
+
+# --------------------------------------------------------------------------- #
 # Plotting
 
 def _plt():
@@ -230,9 +306,13 @@ class LinePlot:
         self._axs[key] = ax
         return ax
 
-    def include(self, data, *cols, errs=None, lalp=1.0, label=None, ccnt=None):
+    def include(self, data, *cols, errs=None, lalp=1.0, label=None, ccnt=None,
+                axkey=None):
         """Plot the given column(s) of a Session/DataFrame; a column named
-        `<col><errs>` provides the ± band (reference `study.py:465-524`)."""
+        `<col><errs>` provides the ± band (reference `study.py:465-524`).
+        `axkey` pins the y-axis: calls sharing an axkey share one axis even
+        when their column names differ (the reference keys the axis by the
+        column *query*, so e.g. both ratio curves land on one axis)."""
         if isinstance(data, Session):
             data = data.data
         x = data.index if self._idx is None else data[self._idx]
@@ -240,7 +320,7 @@ class LinePlot:
             ln = self._cnt if ccnt is None else ccnt
             style = LINESTYLES[ln % len(LINESTYLES)]
             color = f"C{ln}"
-            ax = self._get_ax(cols[0])
+            ax = self._get_ax(axkey if axkey is not None else cols[0])
             y = data[col]
             ax.plot(x, y, style, color=color, alpha=lalp,
                     label=label or col)

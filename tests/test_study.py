@@ -149,7 +149,21 @@ def test_reproduce_analysis_buckets_and_plots(analysis_grid, tmp_path, capsys):
                  "mnist-empire-f_2-lr_0.5-at_update-loss.png",
                  "mnist-empire-f_2-lr_0.5-at_worker.png",
                  "mnist-empire-f_2-lr_0.5-at_worker-loss.png",
-                 "mnist-empire-median-f_2-lr_0.5-ratio.png"):
+                 "mnist-empire-median-f_2-lr_0.5-ratio.png",
+                 "overview-mnist-empire-f_2-lr_0.5.png"):
         assert (plot_dir / name).is_file(), name
     # Per-run ratio-condition counting on the analysis output
     assert "ratio ok" in out
+
+
+def test_display_fallback(result_dir, capsys):
+    """`study.display` degrades gracefully without GTK: warning + text
+    rendering (reference `study.py:72-78`)."""
+    if not hasattr(study, "_gtk_reason"):
+        pytest.skip("GTK 3.0 available: display opens a real window")
+    sess = study.Session(result_dir)
+    study.display(sess)
+    out = capsys.readouterr()
+    text = out.out + out.err
+    assert "GTK 3.0 is unavailable" in text
+    assert "Average loss" in text
