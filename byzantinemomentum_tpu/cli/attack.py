@@ -137,6 +137,13 @@ def process_commandline(argv=None):
         help="Training steps fused into one compiled dispatch (lax.scan); "
              "milestones always force a boundary, so the per-step trajectory "
              "and CSV output are identical to 1 (which disables fusion)")
+    add("--mesh", type=str, default=None,
+        help="Multi-chip (workers, model) mesh: 'auto' (all devices on the "
+             "worker axis), 'W' or 'WxM' (e.g. '4x2' = 4-way worker data "
+             "parallelism x 2-way parameter sharding). Batches shard along "
+             "workers, parameters/momentum along model; XLA inserts the ICI "
+             "collectives (all-gather of gradient rows into the GAR, psum'd "
+             "distance Grams)")
     return parser.parse_args(sys.argv[1:] if argv is None else argv)
 
 
@@ -395,11 +402,35 @@ def main(argv=None):
             cfg=cfg, model_def=model_def, loss=loss, criterion=criterion,
             defenses=defenses, attack=attack, attack_kwargs=args.attack_args,
             optimizer=optimizer)
+        # Multi-chip mesh: shard the step over a (workers, model) device grid
+        mesh = None
+        if args.mesh is not None:
+            from byzantinemomentum_tpu.parallel import make_mesh
+            spec = args.mesh.strip().lower()
+            try:
+                if spec == "auto":
+                    mesh = make_mesh()
+                else:
+                    w, _, m = spec.partition("x")
+                    m = int(m) if m else 1
+                    mesh = make_mesh(int(w) * m, model_parallel=m)
+            except ValueError as err:
+                utils.fatal(f"Invalid '--mesh {args.mesh}': {err}")
+            workers_ax = mesh.shape["workers"]
+            S_check = max(args.nb_workers - args.nb_real_byz,
+                          args.nb_for_study if args.result_directory else 0)
+            if S_check % workers_ax != 0:
+                utils.fatal(
+                    f"Invalid '--mesh {args.mesh}': the {S_check} sampled "
+                    f"gradients per step must divide evenly over the "
+                    f"{workers_ax}-way worker axis")
         # Device-resident input fast path: stage the datasets in device
         # memory once; per step only (S, B) index/flip arrays cross the host
-        # boundary (see `data/device.py`)
+        # boundary (see `data/device.py`). Under a mesh the batches are
+        # host-staged instead so they shard along the worker axis.
         from byzantinemomentum_tpu.data.device import DeviceData
-        use_device_data = (DeviceData.supports(trainset)
+        use_device_data = (mesh is None
+                           and DeviceData.supports(trainset)
                            and DeviceData.supports(testset))
         if use_device_data:
             train_data, test_data = DeviceData.pair(trainset, testset)
@@ -488,6 +519,17 @@ def main(argv=None):
                     utils.warning(
                         "Checkpoint carries no sampler state; resumed batch "
                         "order will differ from the uninterrupted run")
+
+    # Compile the (possibly mesh-sharded) step programs
+    if mesh is not None:
+        from byzantinemomentum_tpu.parallel import (
+            sharded_train_multi, sharded_train_step)
+        step_fn = sharded_train_step(engine, mesh, state)
+        multi_fn = sharded_train_multi(engine, mesh, state)
+        utils.info(f"Sharded over mesh {dict(mesh.shape)}")
+    else:
+        step_fn = engine.train_step
+        multi_fn = engine.train_multi
 
     # Opt-in profiler trace of the early steps (TPU counterpart of the
     # reference's opt-in timing scopes, reference `tools/misc.py:307-343`)
@@ -608,11 +650,11 @@ def main(argv=None):
                 xs = xs.reshape(shape + xs.shape[1:])
                 ys = ys.reshape(shape + ys.shape[1:])
                 if M == 1:
-                    state, metrics = engine.train_step(
+                    state, metrics = step_fn(
                         state, jnp.asarray(xs[0]), jnp.asarray(ys[0]),
                         jnp.float32(lrs[0]))
                 else:
-                    state, metrics = engine.train_multi(
+                    state, metrics = multi_fn(
                         state, jnp.asarray(xs), jnp.asarray(ys),
                         jnp.asarray(lrs, jnp.float32))
             if fd_study is not None:

@@ -25,7 +25,7 @@ from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS
 
 __all__ = ["pairwise_distances_sharded", "shard_gar", "sharded_state_spec",
-           "sharded_train_step", "COORDINATE_WISE"]
+           "sharded_train_step", "sharded_train_multi", "COORDINATE_WISE"]
 
 # GARs that act independently per coordinate: they shard over `d` with zero
 # communication (SURVEY.md §5.7: "coordinate-wise GARs shard trivially over
@@ -141,4 +141,26 @@ def sharded_train_step(engine, mesh, state_example):
         in_shardings=(state_shardings, batch_sharding, batch_sharding,
                       lr_sharding),
         out_shardings=(state_shardings, metrics_sharding),
+        donate_argnums=(0,))
+
+
+def sharded_train_multi(engine, mesh, state_example):
+    """Multi-chip version of `engine.train_multi`: M fused steps per
+    dispatch (`lax.scan`) with the same shardings as `sharded_train_step` —
+    batches `xs: [M, S, B, ...]` shard along "workers" on their S axis.
+
+    Returns `step(state, xs, ys, lrs) -> (state, stacked metrics)`.
+    """
+    spec = sharded_state_spec(state_example)
+    state_shardings = jax.tree.map(
+        lambda p: NamedSharding(mesh, p), spec,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_sharding = NamedSharding(mesh, P(None, WORKERS))
+    lr_sharding = NamedSharding(mesh, P())
+
+    return jax.jit(
+        engine._train_multi,
+        in_shardings=(state_shardings, batch_sharding, batch_sharding,
+                      lr_sharding),
+        out_shardings=(state_shardings, None),
         donate_argnums=(0,))

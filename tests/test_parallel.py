@@ -107,3 +107,44 @@ def test_graft_entry_and_dryrun():
     out = jax.jit(fn)(*args)
     assert out.shape == (8, 10)
     graft.dryrun_multichip(8)
+
+
+def test_cli_mesh_flag_matches_unsharded(tmp_path, monkeypatch):
+    """`--mesh 4x2` runs the driver's sharded path on the virtual 8-device
+    mesh; the trajectory matches the unsharded run up to collective
+    reduction-order rounding."""
+    import os
+    import numpy as np
+    from byzantinemomentum_tpu.cli.attack import main
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+    base = ["--nb-steps", "3", "--batch-size", "8", "--batch-size-test", "32",
+            "--batch-size-test-reps", "1", "--evaluation-delta", "3",
+            "--model", "simples-full", "--seed", "9", "--gar", "krum",
+            "--attack", "empire", "--attack-args", "factor:1.1",
+            "--nb-workers", "11", "--nb-decl-byz", "3", "--nb-real-byz", "3",
+            "--nb-for-study", "8", "--nb-for-study-past", "2"]
+    rows = {}
+    for name, extra in (("plain", []), ("mesh", ["--mesh", "4x2"])):
+        resdir = tmp_path / name
+        rc = main(base + extra + ["--result-directory", str(resdir)])
+        assert rc == 0
+        lines = (resdir / "study").read_text().split(os.linesep)
+        rows[name] = [l.split("\t") for l in lines[1:] if l]
+    assert len(rows["mesh"]) == len(rows["plain"]) == 3
+    for rp, rm in zip(rows["plain"], rows["mesh"]):
+        assert rp[0] == rm[0]
+        a = np.array([float(x) for x in rp[2:]])
+        b = np.array([float(x) for x in rm[2:]])
+        np.testing.assert_allclose(b, a, rtol=2e-3, atol=1e-5)
+
+
+def test_cli_mesh_flag_rejects_indivisible(tmp_path, monkeypatch, capsys):
+    from byzantinemomentum_tpu import utils
+    from byzantinemomentum_tpu.cli.attack import main
+    import pytest
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+    with pytest.raises(utils.UserException, match="divide evenly"):
+        main(["--nb-steps", "1", "--model", "simples-full",
+              "--nb-workers", "11", "--mesh", "4"])
