@@ -412,8 +412,12 @@ def main(argv=None):
                     mesh = make_mesh()
                 else:
                     w, _, m = spec.partition("x")
+                    w = int(w)
                     m = int(m) if m else 1
-                    mesh = make_mesh(int(w) * m, model_parallel=m)
+                    if w < 1 or m < 1:
+                        raise ValueError(
+                            f"mesh axes must be positive, got {w}x{m}")
+                    mesh = make_mesh(w * m, model_parallel=m)
             except ValueError as err:
                 utils.fatal(f"Invalid '--mesh {args.mesh}': {err}")
             workers_ax = mesh.shape["workers"]

@@ -16,6 +16,8 @@ Semantics pinned against the reference implementation (PyTorch, circa 1.x):
 import jax
 import jax.numpy as jnp
 
+from byzantinemomentum_tpu.ops import pallas_sort
+
 __all__ = [
     "lower_median",
     "pairwise_distances",
@@ -47,6 +49,8 @@ def lower_median(g):
     `f32[n, d] -> f32[d]`; equals torch's `median(dim=0)` index convention
     (`sorted[(n-1)//2]`) and is NaN-resilient for < n/2 NaN rows.
     """
+    if pallas_sort.supported(g):
+        return pallas_sort.lower_median(g)  # fused single-pass TPU kernel
     n = g.shape[0]
     return jnp.sort(g, axis=0)[(n - 1) // 2]
 
@@ -103,6 +107,8 @@ def closest_mean(g, c, m):
     NaN deviations sort last, so NaN rows are excluded whenever m <= number
     of finite values per coordinate.
     """
+    if pallas_sort.supported(g) and c.ndim == 1 and c.dtype == g.dtype:
+        return pallas_sort.closest_mean(g, c, m)  # fused TPU kernel
     dev = jnp.abs(g - c[None, :])
     # Selection WITHOUT the (n, d) argsort + gather (which costs ~8x the
     # rest of Bulyan on TPU): per coordinate, take everything strictly below

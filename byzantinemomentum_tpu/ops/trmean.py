@@ -11,7 +11,7 @@ All three are coordinate-wise rules over the stacked `(n, d)` matrix:
 
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops import pallas_sort, register
 from byzantinemomentum_tpu.ops._common import closest_mean, lower_median
 
 __all__ = ["trmean", "aggregate_trmean", "aggregate_phocas", "aggregate_meamed"]
@@ -21,6 +21,8 @@ def trmean(g, f):
     """Coordinate-wise mean of sorted ranks [f, n-f)
     (reference `aggregators/trmean.py:24-33`). NaN sorts last, so up to f NaN
     rows are trimmed away."""
+    if pallas_sort.supported(g):
+        return pallas_sort.trimmed_mean(g, f)  # fused single-pass TPU kernel
     n = g.shape[0]
     return jnp.mean(jnp.sort(g, axis=0)[f:n - f], axis=0)
 

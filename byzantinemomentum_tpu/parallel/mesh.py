@@ -31,7 +31,12 @@ def make_mesh(n_devices=None, *, model_parallel=1, devices=None):
       `jax.sharding.Mesh` with axes ("workers", "model").
     """
     devices = list(jax.devices()) if devices is None else list(devices)
+    if model_parallel < 1:
+        raise ValueError(
+            f"Non-positive model-parallel size {model_parallel}")
     if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(f"Non-positive device count {n_devices}")
         if n_devices > len(devices):
             raise ValueError(
                 f"Requested {n_devices} devices but only {len(devices)} are "

@@ -116,6 +116,34 @@ def sharded_state_spec(state):
     )
 
 
+def _sharded_step_builder(step_fn, mesh, state_example, batch_spec):
+    """Shared sharding setup for the single- and multi-step programs.
+
+    The traced function is wrapped in `pallas_sort.disabled()`: Mosaic
+    kernels cannot be auto-partitioned by the jit sharding propagator, so a
+    multi-device trace must take the coordinate-wise GARs' jnp fallbacks.
+    """
+    from byzantinemomentum_tpu.ops import pallas_sort
+
+    spec = sharded_state_spec(state_example)
+    state_shardings = jax.tree.map(
+        lambda p: NamedSharding(mesh, p), spec,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    lr_sharding = NamedSharding(mesh, P())
+
+    def traced(*args):
+        with pallas_sort.disabled():
+            return step_fn(*args)
+
+    return jax.jit(
+        traced,
+        in_shardings=(state_shardings, batch_sharding, batch_sharding,
+                      lr_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,))
+
+
 def sharded_train_step(engine, mesh, state_example):
     """Compile the engine's training step for a multi-chip mesh.
 
@@ -128,20 +156,8 @@ def sharded_train_step(engine, mesh, state_example):
     Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
     `engine.train_step`.
     """
-    spec = sharded_state_spec(state_example)
-    state_shardings = jax.tree.map(
-        lambda p: NamedSharding(mesh, p), spec,
-        is_leaf=lambda x: isinstance(x, P))
-    batch_sharding = NamedSharding(mesh, P(WORKERS))
-    lr_sharding = NamedSharding(mesh, P())
-    metrics_sharding = None  # replicated scalars; let XLA choose
-
-    return jax.jit(
-        engine._train_step,
-        in_shardings=(state_shardings, batch_sharding, batch_sharding,
-                      lr_sharding),
-        out_shardings=(state_shardings, metrics_sharding),
-        donate_argnums=(0,))
+    return _sharded_step_builder(engine._train_step, mesh, state_example,
+                                 P(WORKERS))
 
 
 def sharded_train_multi(engine, mesh, state_example):
@@ -151,16 +167,5 @@ def sharded_train_multi(engine, mesh, state_example):
 
     Returns `step(state, xs, ys, lrs) -> (state, stacked metrics)`.
     """
-    spec = sharded_state_spec(state_example)
-    state_shardings = jax.tree.map(
-        lambda p: NamedSharding(mesh, p), spec,
-        is_leaf=lambda x: isinstance(x, P))
-    batch_sharding = NamedSharding(mesh, P(None, WORKERS))
-    lr_sharding = NamedSharding(mesh, P())
-
-    return jax.jit(
-        engine._train_multi,
-        in_shardings=(state_shardings, batch_sharding, batch_sharding,
-                      lr_sharding),
-        out_shardings=(state_shardings, None),
-        donate_argnums=(0,))
+    return _sharded_step_builder(engine._train_multi, mesh, state_example,
+                                 P(None, WORKERS))

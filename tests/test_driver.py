@@ -138,3 +138,39 @@ def test_steps_per_program_trajectory_identical(tmp_path):
                      (resdir / "eval").read_text()))
     assert outs[0][0] == outs[1][0]
     assert outs[0][1] == outs[1][1]
+
+
+def test_transformer_model_via_cli(tmp_path):
+    """The sequence-model family trains through the standard driver: MNIST
+    rows tokenize as a length-28 sequence (models/transformer.py)."""
+    resdir = tmp_path / "tr"
+    rc = main(BASE + ["--model", "transformer-classifier",
+                      "--model-args", "depth:1", "dim:32", "heads:2",
+                      "--gar", "median", "--nb-real-byz", "2",
+                      "--attack", "little", "--attack-args", "factor:1.5",
+                      "--nb-for-study", "11", "--nb-for-study-past", "2",
+                      "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
+    assert len(rows) == 3
+    assert all(np.isfinite(float(r.split("\t")[2])) for r in rows)
+
+
+def test_phishing_logit_sigmoid_via_cli(tmp_path):
+    """The LIBSVM binary-classification path: phishing dataset, logit model,
+    bce loss, sigmoid criterion (reference `reproduce.py` uses top-k/nll;
+    the binary path mirrors reference `loss.py:236-252`)."""
+    resdir = tmp_path / "ph"
+    rc = main(["--nb-steps", "3", "--batch-size", "16",
+               "--batch-size-test", "50", "--batch-size-test-reps", "2",
+               "--evaluation-delta", "3", "--seed", "2",
+               "--dataset", "phishing", "--model", "simples-logit",
+               "--model-args", "din:68", "--loss", "bce",
+               "--criterion", "sigmoid", "--gar", "trmean",
+               "--nb-workers", "9", "--nb-decl-byz", "2", "--nb-real-byz", "2",
+               "--attack", "empire-strict", "--attack-args", "factor:1.1",
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    lines = [l for l in (resdir / "eval").read_text().split(os.linesep)[1:] if l]
+    accs = [float(l.split("\t")[1]) for l in lines]
+    assert all(0.0 <= a <= 1.0 for a in accs)

@@ -1,0 +1,100 @@
+"""Pallas TPU kernel tests (`ops/pallas_sort.py`): the sorting-network
+kernels behind median/trmean/phocas/meamed/Bulyan-stage-2 must reproduce the
+jnp oracles EXACTLY — NaN placement (NaN-last, the median GAR's resilience
+contract) and index-order tie selection included. Off-TPU the kernels run in
+interpret mode; on TPU the dispatch in `ops/_common.py` / `ops/trmean.py`
+routes through them automatically (kill-switch: BMT_NO_PALLAS=1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu.ops import pallas_sort
+
+
+def _mat(n, d, seed=0, nan_frac=0.0, dup_frac=0.0):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((n, d)).astype(np.float32)
+    if dup_frac:
+        # Duplicate values across rows to exercise tie-breaking
+        mask = rng.random((n, d)) < dup_frac
+        g = np.where(mask, np.round(g), g).astype(np.float32)
+    if nan_frac:
+        g[rng.random((n, d)) < nan_frac] = np.nan
+    return g
+
+
+NS = (1, 2, 3, 13, 25, 51)
+
+
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("nan_frac", (0.0, 0.05, 0.6))
+def test_colsort_matches_jnp_sort(n, nan_frac):
+    g = jnp.asarray(_mat(n, 1000, seed=n, nan_frac=nan_frac))
+    want = np.asarray(jnp.sort(g, axis=0))
+    got = np.asarray(pallas_sort.colsort(g, interpret=True))
+    np.testing.assert_array_equal(np.nan_to_num(got, nan=7e9),
+                                  np.nan_to_num(want, nan=7e9))
+
+
+@pytest.mark.parametrize("n", NS)
+def test_lower_median_matches(n):
+    g = jnp.asarray(_mat(n, 1000, seed=n + 10, nan_frac=0.1))
+    want = np.asarray(jnp.sort(g, axis=0)[(n - 1) // 2])
+    got = np.asarray(pallas_sort.lower_median(g, interpret=True))
+    np.testing.assert_array_equal(np.nan_to_num(got, nan=7e9),
+                                  np.nan_to_num(want, nan=7e9))
+
+
+@pytest.mark.parametrize("n,f", ((5, 1), (13, 4), (25, 5), (51, 12)))
+def test_trimmed_mean_matches(n, f):
+    g = jnp.asarray(_mat(n, 1000, seed=n, nan_frac=0.02))
+    want = np.asarray(jnp.mean(jnp.sort(g, axis=0)[f:n - f], axis=0))
+    got = np.asarray(pallas_sort.trimmed_mean(g, f, interpret=True))
+    np.testing.assert_allclose(np.nan_to_num(got, nan=7e9),
+                               np.nan_to_num(want, nan=7e9),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,m", ((5, 3), (13, 9), (25, 20)))
+@pytest.mark.parametrize("dup", (0.0, 0.5))
+def test_closest_mean_matches_oracle(n, m, dup):
+    """Against the stable-argsort oracle (the reference's selection,
+    `aggregators/trmean.py:35-50`), with heavy ties."""
+    g = jnp.asarray(_mat(n, 500, seed=n + m, dup_frac=dup))
+    c = jnp.asarray(_mat(1, 500, seed=99)[0])
+    dev = jnp.abs(g - c[None, :])
+    order = jnp.argsort(dev, axis=0, stable=True)[:m]
+    want = np.asarray(jnp.mean(jnp.take_along_axis(g, order, axis=0), axis=0))
+    got = np.asarray(pallas_sort.closest_mean(g, c, m, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_closest_mean_nan_overflow():
+    """More NaN rows than n - m: the stable argsort would select a NaN, so
+    the kernel must yield NaN for those coordinates."""
+    g = _mat(7, 100, seed=3)
+    g[3:, :50] = np.nan  # 4 NaN rows in the first 50 coords; m=5 > 3 finite
+    g = jnp.asarray(g)
+    c = jnp.zeros((100,), jnp.float32)
+    got = np.asarray(pallas_sort.closest_mean(g, c, 5, interpret=True))
+    assert np.isnan(got[:50]).all()
+    assert np.isfinite(got[50:]).all()
+
+
+def test_supported_gate(monkeypatch):
+    g32 = jnp.zeros((8, 64), jnp.float32)
+    assert pallas_sort.supported(g32, interpret=True)
+    assert not pallas_sort.supported(jnp.zeros((80, 64)), interpret=True)
+    assert not pallas_sort.supported(jnp.zeros((8, 64), jnp.int32),
+                                     interpret=True)
+    monkeypatch.setenv("BMT_NO_PALLAS", "1")
+    assert not pallas_sort.supported(g32, interpret=True)
+
+
+def test_bf16_kernels():
+    g = jnp.asarray(_mat(9, 400, seed=5)).astype(jnp.bfloat16)
+    want = np.asarray(jnp.sort(g, axis=0)[(9 - 1) // 2].astype(jnp.float32))
+    got = np.asarray(pallas_sort.lower_median(g, interpret=True)
+                     .astype(jnp.float32))
+    np.testing.assert_array_equal(got, want)

@@ -148,3 +148,43 @@ def test_cli_mesh_flag_rejects_indivisible(tmp_path, monkeypatch, capsys):
     with pytest.raises(utils.UserException, match="divide evenly"):
         main(["--nb-steps", "1", "--model", "simples-full",
               "--nb-workers", "11", "--mesh", "4"])
+
+
+def test_cli_mesh_flag_rejects_nonpositive(tmp_path, monkeypatch):
+    from byzantinemomentum_tpu import utils
+    from byzantinemomentum_tpu.cli.attack import main
+    import pytest
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+    for spec in ("0", "-4", "2x0"):
+        with pytest.raises(utils.UserException, match="Invalid '--mesh"):
+            main(["--nb-steps", "1", "--model", "simples-full",
+                  "--nb-workers", "8", "--mesh", spec])
+
+
+def test_cli_mesh_with_coordinatewise_gar(tmp_path, monkeypatch):
+    """Coordinate-wise GARs under --mesh trace the jnp fallback (Mosaic
+    kernels cannot be auto-partitioned); the run must complete."""
+    import os
+    from byzantinemomentum_tpu.cli.attack import main
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+    resdir = tmp_path / "m"
+    rc = main(["--nb-steps", "2", "--batch-size", "8",
+               "--batch-size-test", "32", "--batch-size-test-reps", "1",
+               "--evaluation-delta", "2", "--model", "simples-full",
+               "--seed", "3", "--gar", "median", "--nb-workers", "8",
+               "--nb-decl-byz", "2", "--mesh", "4x2", "--nb-for-study", "8",
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    assert (resdir / "eval").is_file()
+
+
+def test_pallas_disabled_context():
+    from byzantinemomentum_tpu.ops import pallas_sort
+    import jax.numpy as jnp
+    g = jnp.zeros((8, 64), jnp.float32)
+    assert pallas_sort.supported(g, interpret=True)
+    with pallas_sort.disabled():
+        assert not pallas_sort.supported(g, interpret=True)
+    assert pallas_sort.supported(g, interpret=True)
