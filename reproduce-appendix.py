@@ -66,6 +66,8 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--data-directory", type=str,
                         default="results-data-appendix")
+    parser.add_argument("--plot-directory", type=str,
+                        default="results-plot-appendix")
     parser.add_argument("--devices", type=str, default="auto")
     parser.add_argument("--supercharge", type=int, default=1)
     args = parser.parse_args()
@@ -80,6 +82,15 @@ def main():
     with utils.Context("experiments", "info"):
         submit(jobs)
         jobs.wait(exit_is_requested)
+
+    # Same data-driven analysis/plots as the main grid (the reference's
+    # appendix plotting loops, `reproduce-appendix.py:160-354`, are the
+    # reproduce.py ones with 'lr_pow' name tokens — `analyze` derives its
+    # groups from the result dirs, so it covers both)
+    if not exit_is_requested():
+        from reproduce import analyze
+        analyze(pathlib.Path(args.data_directory),
+                pathlib.Path(args.plot_directory))
 
 
 if __name__ == "__main__":

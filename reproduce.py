@@ -211,14 +211,20 @@ def _run_info(sess):
     """(dataset, attack, gar, f, lr-token, momentum_at, nesterov, seed) of an
     attacked run, or None — read from config.json rather than re-parsing the
     name (more robust than the reference's `get_reference_accuracy` split,
-    reference `reproduce.py:229-255`)."""
+    reference `reproduce.py:229-255`). The lr token comes from the run NAME
+    (`lr_0.01`, or `lr_pow` for the appendix's schedule runs) so grouping and
+    baseline lookup follow the grid's naming."""
+    import re
+
     j = sess.json
     if not j or j.get("nb_real_byz", 0) <= 0:
         return None
     seed = sess.name.rsplit("-", 1)[-1]
+    m = re.search(r"-lr_([^-]+)", sess.name)
+    lr = m.group(1) if m else str(j["learning_rate"])
     return {
         "dataset": j["dataset"], "attack": j["attack"], "gar": j["gar"],
-        "f": j["nb_real_byz"], "lr": j["learning_rate"],
+        "f": j["nb_real_byz"], "lr": lr,
         "at": j["momentum_at"], "nesterov": bool(j.get("momentum_nesterov")),
         "honests": j["nb_workers"] - j["nb_real_byz"], "seed": seed,
         "steps": j.get("nb_steps"),
