@@ -1,0 +1,208 @@
+#!/usr/bin/env python3
+"""Statistical accuracy-parity experiment: torch reference-style loop vs
+this framework on the SAME data (BASELINE.md: "reproduce accuracy curve
+within noise").
+
+Config = BASELINE.json config 2 shape: MNIST, n=11 workers, f=4 real
+Byzantine, GAR=median, attack=empire(1.1), momentum 0.9 at update, clip 2,
+constant lr. Both sides train `simples-full` (784-100-10 MLP) on the same
+deterministic synthetic MNIST (no data egress in this environment), for
+`--steps` steps and `--seeds` seeds each, evaluating top-1 accuracy on the
+same test split. True RNG-level trajectory matching is impossible across
+frameworks (different PRNGs and batch orders — SURVEY.md §7 hard part 1);
+the parity claim is STATISTICAL: the two mean final accuracies must agree
+within the combined across-seed noise.
+
+Two statistics, both across seeds:
+* final top-1 accuracy (synthetic MNIST saturates, so this mostly checks
+  that neither side diverges under attack), and
+* the AVERAGE LOSS trajectory at early checkpoints (steps 5/10/20/40),
+  where the optimization is still in flight — the discriminative part: a
+  momentum/clip/aggregation semantics mismatch shows up here.
+
+Writes ACCURACY_PARITY.json at the repo root:
+  {"accuracy": {"torch": {...}, "jax": {...}, "diff", "noise", "parity"},
+   "loss_at": {"5": {...}, ...}, "parity": true|false}
+with noise = 2 * sqrt(std_t² + std_j²) (a ~95% band on the difference of
+means for these sample sizes).
+
+Usage: python scripts/accuracy_parity.py [--steps 60] [--seeds 5]
+"""
+
+import argparse
+import json
+import math
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("BMT_SYNTH_TRAIN", "4096")
+os.environ.setdefault("BMT_SYNTH_TEST", "512")
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from byzantinemomentum_tpu.data import sources  # noqa: E402
+
+N_WORKERS = 11
+F_REAL = 4
+N_HONEST = N_WORKERS - F_REAL
+BATCH = 83
+MOMENTUM = 0.9
+CLIP = 2.0
+LR = 0.1  # gentle enough that the loss decay is smooth (lr 0.5 with
+# momentum 0.9 overshoots chaotically on the easy synthetic task, making
+# transient checkpoints bimodal across seeds)
+MNIST_NORM = (0.1307, 0.3081)
+
+
+class SimplesFull(nn.Module):
+    """Torch twin of `simples-full` (reference
+    `experiments/models/simples.py:23-55`: 784-100-10, log-softmax)."""
+
+    def __init__(self):
+        super().__init__()
+        self.f1 = nn.Linear(784, 100)
+        self.f2 = nn.Linear(100, 10)
+
+    def forward(self, x):
+        x = F.relu(self.f1(x.flatten(1)))
+        return F.log_softmax(self.f2(x), dim=1)
+
+
+def _data():
+    raw = sources.load_mnist("mnist")
+    def prep(x):
+        x = x.astype(np.float32) / 255.0
+        return (x - MNIST_NORM[0]) / MNIST_NORM[1]
+    return (prep(raw["train_x"]), raw["train_y"].astype(np.int64),
+            prep(raw["test_x"]), raw["test_y"].astype(np.int64))
+
+
+def run_torch(seed, steps):
+    """Reference-style loop: sequential backprops, per-grad clip, empire
+    attack, coordinate-wise lower median, momentum at update
+    (reference `attack.py:752-839`)."""
+    train_x, train_y, test_x, test_y = _data()
+    torch.manual_seed(seed)
+    rng = np.random.default_rng(seed)
+    model = SimplesFull()
+    model.train()
+    loss_fn = nn.NLLLoss()
+    momentum_buf = None
+    loss_curve = []
+    for _ in range(steps):
+        grads = []
+        losses = []
+        for _ in range(N_HONEST):
+            sel = rng.integers(0, len(train_x), BATCH)
+            model.zero_grad()
+            loss = loss_fn(model(torch.from_numpy(train_x[sel])),
+                           torch.from_numpy(train_y[sel]))
+            loss.backward()
+            g = torch.cat([p.grad.flatten() for p in model.parameters()])
+            norm = g.norm().item()
+            if norm > CLIP:
+                g = g * (CLIP / norm)
+            grads.append(g.detach().clone())
+            losses.append(loss.item())
+        loss_curve.append(float(np.mean(losses)))
+        avg = torch.stack(grads).mean(dim=0)
+        byz = avg + 1.1 * (-avg)  # empire, factor 1.1
+        stack = torch.stack(grads + [byz] * F_REAL)
+        n = stack.shape[0]
+        agg = stack.sort(dim=0).values[(n - 1) // 2]  # lower median
+        momentum_buf = (agg if momentum_buf is None
+                        else MOMENTUM * momentum_buf + agg)
+        with torch.no_grad():
+            offset = 0
+            for p in model.parameters():
+                num = p.numel()
+                p -= LR * momentum_buf[offset:offset + num].view_as(p)
+                offset += num
+    model.eval()
+    with torch.no_grad():
+        pred = model(torch.from_numpy(test_x)).argmax(dim=1).numpy()
+    return float((pred == test_y).mean()), loss_curve
+
+
+def run_jax(seed, steps, tmp):
+    """The framework, through the standard driver CLI."""
+    from byzantinemomentum_tpu.cli.attack import main
+    resdir = pathlib.Path(tmp) / f"jax-{seed}"
+    rc = main(["--dataset", "mnist", "--model", "simples-full",
+               "--nb-workers", str(N_WORKERS),
+               "--nb-decl-byz", str(F_REAL), "--nb-real-byz", str(F_REAL),
+               "--gar", "median", "--attack", "empire",
+               "--attack-args", "factor:1.1",
+               "--momentum", str(MOMENTUM), "--momentum-at", "update",
+               "--gradient-clip", str(CLIP),
+               "--batch-size", str(BATCH),
+               "--learning-rate", str(LR), "--learning-rate-decay", "-1",
+               "--nb-steps", str(steps),
+               "--evaluation-delta", str(steps),
+               "--nb-for-study", str(N_HONEST), "--nb-for-study-past", "1",
+               "--batch-size-test", "128", "--batch-size-test-reps", "4",
+               "--seed", str(seed),
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = [l for l in (resdir / "eval").read_text().splitlines()[1:] if l]
+    acc = float(rows[-1].split("\t")[1])
+    study = [l for l in (resdir / "study").read_text().splitlines()[1:] if l]
+    loss_curve = [float(l.split("\t")[2]) for l in study]
+    return acc, loss_curve
+
+
+def _compare(t_vals, j_vals, floor):
+    t = {"mean": float(np.mean(t_vals)),
+         "std": float(np.std(t_vals, ddof=1)) if len(t_vals) > 1 else 0.0,
+         "values": [float(v) for v in t_vals]}
+    j = {"mean": float(np.mean(j_vals)),
+         "std": float(np.std(j_vals, ddof=1)) if len(j_vals) > 1 else 0.0,
+         "values": [float(v) for v in j_vals]}
+    diff = abs(t["mean"] - j["mean"])
+    noise = 2.0 * math.sqrt(t["std"] ** 2 + j["std"] ** 2)
+    return {"torch": t, "jax": j, "diff": diff, "noise": noise,
+            "parity": bool(diff <= max(noise, floor))}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--seeds", type=int, default=5)
+    parser.add_argument("--tmp", type=str, default="/tmp/accuracy_parity")
+    args = parser.parse_args()
+
+    seeds = list(range(1, args.seeds + 1))
+    torch_runs = [run_torch(s, args.steps) for s in seeds]
+    jax_runs = [run_jax(s, args.steps, args.tmp) for s in seeds]
+
+    accuracy = _compare([r[0] for r in torch_runs],
+                        [r[0] for r in jax_runs], floor=0.02)
+    checkpoints = [k for k in (5, 10, 20, 40) if k < args.steps]
+    loss_at = {}
+    for k in checkpoints:
+        loss_at[str(k)] = _compare([r[1][k] for r in torch_runs],
+                                   [r[1][k] for r in jax_runs],
+                                   floor=0.05)  # 5% absolute on NLL scale
+    out = {
+        "config": f"MNIST simples-full, n={N_WORKERS} f={F_REAL}, median vs "
+                  f"empire(1.1), momentum {MOMENTUM} at update, clip {CLIP}, "
+                  f"lr {LR}, {args.steps} steps, {args.seeds} seeds, "
+                  f"synthetic MNIST (deterministic, shared by both sides)",
+        "accuracy": accuracy,
+        "loss_at": loss_at,
+        "parity": bool(accuracy["parity"]
+                       and all(v["parity"] for v in loss_at.values())),
+    }
+    path = pathlib.Path(__file__).resolve().parent.parent / "ACCURACY_PARITY.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
