@@ -21,7 +21,7 @@ import pandas
 
 from byzantinemomentum_tpu import models, ops, utils
 
-__all__ = ["Session", "LinePlot", "BoxPlot", "display"]
+__all__ = ["Session", "LinePlot", "BoxPlot", "display", "select", "discard"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
 TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000,
@@ -191,6 +191,39 @@ class Session:
 
     def __repr__(self):
         return f"Session({self.name!r})"
+
+
+def select(data, *only_columns):
+    """Case-insensitive substring column selection
+    (reference `study.py:83-105`): `select(sess, "ratio")` returns every
+    column whose name contains "ratio"; no arguments returns everything."""
+    if isinstance(data, Session):
+        data = data.data
+    if not only_columns:
+        return data
+    columns = []
+    for only_column in only_columns:
+        only_column = only_column.lower()
+        for column in data.columns:
+            if column not in columns and only_column in column.lower():
+                columns.append(column)
+    return data[columns]
+
+
+def discard(data, *only_columns):
+    """Case-insensitive substring column discarding
+    (reference `study.py:107-126`)."""
+    if isinstance(data, Session):
+        data = data.data
+    if not only_columns:
+        return data
+    data = data[:]
+    for only_column in only_columns:
+        only_column = only_column.lower()
+        for column in list(data.columns):
+            if only_column in column.lower():
+                del data[column]
+    return data
 
 
 # --------------------------------------------------------------------------- #

@@ -167,3 +167,15 @@ def test_display_fallback(result_dir, capsys):
     text = out.out + out.err
     assert "GTK 3.0 is unavailable" in text
     assert "Average loss" in text
+
+
+def test_select_and_discard(result_dir):
+    """Substring column selection helpers (reference `study.py:83-126`)."""
+    sess = study.Session(result_dir).compute_ratio(nowarn=True)
+    ratios = study.select(sess, "ratio")
+    assert all("ratio" in c.lower() for c in ratios.columns)
+    assert "Sampled ratio" in ratios.columns
+    assert study.select(sess).equals(sess.data)
+    rest = study.discard(sess, "ratio")
+    assert not any("ratio" in c.lower() for c in rest.columns)
+    assert "Average loss" in rest.columns
