@@ -174,3 +174,20 @@ def test_phishing_logit_sigmoid_via_cli(tmp_path):
     lines = [l for l in (resdir / "eval").read_text().split(os.linesep)[1:] if l]
     accs = [float(l.split("\t")[1]) for l in lines]
     assert all(0.0 <= a <= 1.0 for a in accs)
+
+
+def test_nan_attack_resilient_gar_via_cli(tmp_path):
+    """The numerical-fault injection path: f_real NaN gradients against the
+    NaN-resilient median — training must stay finite (reference
+    `attacks/nan.py`, `aggregators/median.py:13`)."""
+    resdir = tmp_path / "nan"
+    rc = main(BASE + ["--gar", "median", "--attack", "nan",
+                      "--nb-real-byz", "4", "--nb-for-study", "11",
+                      "--nb-for-study-past", "2",
+                      "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
+    for row in rows:
+        fields = row.split("\t")
+        assert np.isfinite(float(fields[2]))   # Average loss
+        assert np.isfinite(float(fields[12]))  # Defense gradient norm
