@@ -187,7 +187,8 @@ def test_nan_attack_resilient_gar_via_cli(tmp_path):
                       "--result-directory", str(resdir)])
     assert rc == 0
     rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
+    defense_idx = STUDY_COLUMNS.index("Defense gradient norm")
     for row in rows:
         fields = row.split("\t")
-        assert np.isfinite(float(fields[2]))   # Average loss
-        assert np.isfinite(float(fields[12]))  # Defense gradient norm
+        assert np.isfinite(float(fields[2]))            # Average loss
+        assert np.isfinite(float(fields[defense_idx]))  # Defense output

@@ -13,6 +13,17 @@ from byzantinemomentum_tpu.parallel import (
     make_mesh, pairwise_distances_sharded, shard_gar, sharded_train_step)
 
 
+import os
+
+from byzantinemomentum_tpu.cli.attack import main as attack_main
+
+
+@pytest.fixture(autouse=True)
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
 @pytest.fixture(scope="module")
 def mesh2d():
     return make_mesh(8, model_parallel=2)
@@ -109,15 +120,10 @@ def test_graft_entry_and_dryrun():
     graft.dryrun_multichip(8)
 
 
-def test_cli_mesh_flag_matches_unsharded(tmp_path, monkeypatch):
+def test_cli_mesh_flag_matches_unsharded(tmp_path):
     """`--mesh 4x2` runs the driver's sharded path on the virtual 8-device
     mesh; the trajectory matches the unsharded run up to collective
     reduction-order rounding."""
-    import os
-    import numpy as np
-    from byzantinemomentum_tpu.cli.attack import main
-    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
-    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
     base = ["--nb-steps", "3", "--batch-size", "8", "--batch-size-test", "32",
             "--batch-size-test-reps", "1", "--evaluation-delta", "3",
             "--model", "simples-full", "--seed", "9", "--gar", "krum",
@@ -127,7 +133,7 @@ def test_cli_mesh_flag_matches_unsharded(tmp_path, monkeypatch):
     rows = {}
     for name, extra in (("plain", []), ("mesh", ["--mesh", "4x2"])):
         resdir = tmp_path / name
-        rc = main(base + extra + ["--result-directory", str(resdir)])
+        rc = attack_main(base + extra + ["--result-directory", str(resdir)])
         assert rc == 0
         lines = (resdir / "study").read_text().split(os.linesep)
         rows[name] = [l.split("\t") for l in lines[1:] if l]
@@ -139,38 +145,26 @@ def test_cli_mesh_flag_matches_unsharded(tmp_path, monkeypatch):
         np.testing.assert_allclose(b, a, rtol=2e-3, atol=1e-5)
 
 
-def test_cli_mesh_flag_rejects_indivisible(tmp_path, monkeypatch, capsys):
+def test_cli_mesh_flag_rejects_indivisible():
     from byzantinemomentum_tpu import utils
-    from byzantinemomentum_tpu.cli.attack import main
-    import pytest
-    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
-    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
     with pytest.raises(utils.UserException, match="divide evenly"):
-        main(["--nb-steps", "1", "--model", "simples-full",
+        attack_main(["--nb-steps", "1", "--model", "simples-full",
               "--nb-workers", "11", "--mesh", "4"])
 
 
-def test_cli_mesh_flag_rejects_nonpositive(tmp_path, monkeypatch):
+def test_cli_mesh_flag_rejects_nonpositive():
     from byzantinemomentum_tpu import utils
-    from byzantinemomentum_tpu.cli.attack import main
-    import pytest
-    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
-    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
     for spec in ("0", "-4", "2x0"):
         with pytest.raises(utils.UserException, match="Invalid '--mesh"):
-            main(["--nb-steps", "1", "--model", "simples-full",
+            attack_main(["--nb-steps", "1", "--model", "simples-full",
                   "--nb-workers", "8", "--mesh", spec])
 
 
-def test_cli_mesh_with_coordinatewise_gar(tmp_path, monkeypatch):
+def test_cli_mesh_with_coordinatewise_gar(tmp_path):
     """Coordinate-wise GARs under --mesh trace the jnp fallback (Mosaic
     kernels cannot be auto-partitioned); the run must complete."""
-    import os
-    from byzantinemomentum_tpu.cli.attack import main
-    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
-    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
     resdir = tmp_path / "m"
-    rc = main(["--nb-steps", "2", "--batch-size", "8",
+    rc = attack_main(["--nb-steps", "2", "--batch-size", "8",
                "--batch-size-test", "32", "--batch-size-test-reps", "1",
                "--evaluation-delta", "2", "--model", "simples-full",
                "--seed", "3", "--gar", "median", "--nb-workers", "8",
@@ -190,13 +184,11 @@ def test_pallas_disabled_context():
     assert pallas_sort.supported(g, interpret=True)
 
 
-def test_cli_mesh_checkpoint_resume(tmp_path, monkeypatch):
+def test_cli_mesh_checkpoint_resume(tmp_path):
     """Checkpoint + resume through the sharded path: sharded device arrays
-    serialize (gather on save) and the resumed mesh run continues exactly."""
-    import os
-    from byzantinemomentum_tpu.cli.attack import main
-    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
-    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+    serialize (gather on save) and the resumed mesh run continues exactly
+    (study rows AND evaluations - the test-sampler snapshot is the fragile
+    part)."""
     base = ["--batch-size", "8", "--batch-size-test", "32",
             "--batch-size-test-reps", "1", "--evaluation-delta", "2",
             "--model", "simples-full", "--seed", "13", "--gar", "krum",
@@ -204,15 +196,18 @@ def test_cli_mesh_checkpoint_resume(tmp_path, monkeypatch):
             "--nb-for-study", "8", "--nb-for-study-past", "2",
             "--mesh", "4x2"]
     full = tmp_path / "full"
-    assert main(base + ["--nb-steps", "4",
-                        "--result-directory", str(full)]) == 0
+    assert attack_main(base + ["--nb-steps", "4",
+                               "--result-directory", str(full)]) == 0
     part = tmp_path / "part"
-    assert main(base + ["--nb-steps", "2", "--checkpoint-delta", "2",
-                        "--result-directory", str(part)]) == 0
+    assert attack_main(base + ["--nb-steps", "2", "--checkpoint-delta", "2",
+                               "--result-directory", str(part)]) == 0
     resumed = tmp_path / "resumed"
-    assert main(base + ["--nb-steps", "2",
-                        "--load-checkpoint", str(part / "checkpoint-2"),
-                        "--result-directory", str(resumed)]) == 0
+    assert attack_main(base + ["--nb-steps", "2",
+                               "--load-checkpoint", str(part / "checkpoint-2"),
+                               "--result-directory", str(resumed)]) == 0
     full_rows = [l for l in (full / "study").read_text().split(os.linesep)[1:] if l]
     res_rows = [l for l in (resumed / "study").read_text().split(os.linesep)[1:] if l]
     assert res_rows == [r for r in full_rows if int(r.split("\t")[0]) >= 2]
+    full_eval = [l for l in (full / "eval").read_text().split(os.linesep)[1:] if l]
+    res_eval = [l for l in (resumed / "eval").read_text().split(os.linesep)[1:] if l]
+    assert res_eval == [r for r in full_eval if int(r.split("\t")[0]) >= 2]
