@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""On-hardware self-check: run the GAR kernels on the REAL TPU (where the
+Pallas fast paths engage — the pytest suite pins the CPU backend) and
+compare every rule against its jnp fallback and against a torch-CPU oracle
+on the same inputs, NaN rows included.
+
+Tolerances: the selection decisions must agree exactly; the averaged values
+may differ by float reassociation (matmul-formulated means) at ~1e-6.
+
+Usage: python scripts/tpu_selfcheck.py [--n 25] [--d 131072] [--f 5]
+Exits non-zero on any mismatch; prints one summary line per rule.
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from byzantinemomentum_tpu import ops  # noqa: E402
+
+RULES = ("average", "median", "trmean", "phocas", "meamed", "krum",
+         "bulyan", "aksel", "cge")
+
+
+def torch_oracle(name, g, f):
+    """Reference-semantics oracle in torch (mirrors tests/reference_oracles
+    for the subset used here); None if not implemented for `name`."""
+    import torch
+
+    t = torch.from_numpy(np.asarray(g))
+    n = t.shape[0]
+    if name == "average":
+        return t.mean(dim=0).numpy()
+    if name == "median":
+        return t.sort(dim=0).values[(n - 1) // 2].numpy()
+    if name == "trmean":
+        return t.sort(dim=0).values[f:n - f].mean(dim=0).numpy()
+    return None
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=25)
+    parser.add_argument("--d", type=int, default=131072)
+    parser.add_argument("--f", type=int, default=5)
+    parser.add_argument("--nan-frac", type=float, default=0.01)
+    args = parser.parse_args()
+
+    backend = jax.default_backend()
+    print(f"backend: {backend} ({jax.devices()[0].device_kind})")
+
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((args.n, args.d)).astype(np.float32)
+    g[rng.random(g.shape) < args.nan_frac] = np.nan
+    # Keep enough finite rows for every rule's contract
+    g[: args.n - args.f] = np.nan_to_num(g[: args.n - args.f], nan=0.0)
+    gj = jnp.asarray(g)
+
+    failures = 0
+    for name in RULES:
+        gar = ops.gars[name]
+        if gar.check(gradients=gj, f=args.f) is not None:
+            print(f"{name:8s} SKIP (constraint at n={args.n}, f={args.f})")
+            continue
+        fast = np.asarray(jax.jit(
+            lambda G: gar.unchecked(G, f=args.f))(gj))
+        os.environ["BMT_NO_PALLAS"] = "1"
+        slow = np.asarray(jax.jit(
+            lambda G: gar.unchecked(G, f=args.f))(gj))
+        del os.environ["BMT_NO_PALLAS"]
+
+        def norm(x):
+            return np.nan_to_num(x, nan=7e9, posinf=8e9, neginf=-8e9)
+
+        ok_fb = np.allclose(norm(fast), norm(slow), rtol=1e-5, atol=1e-6)
+        oracle = torch_oracle(name, g, args.f)
+        ok_or = (np.allclose(norm(fast), norm(oracle), rtol=1e-5, atol=1e-6)
+                 if oracle is not None else None)
+        status = "OK" if ok_fb and ok_or in (True, None) else "FAIL"
+        failures += status == "FAIL"
+        extra = "" if oracle is None else f" oracle={'OK' if ok_or else 'FAIL'}"
+        print(f"{name:8s} {status}  vs-fallback="
+              f"{'OK' if ok_fb else 'FAIL'}{extra}")
+    if failures:
+        raise SystemExit(f"{failures} rule(s) mismatched on {backend}")
+    print("all rules consistent on", backend)
+
+
+if __name__ == "__main__":
+    main()
