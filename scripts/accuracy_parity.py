@@ -83,27 +83,50 @@ def _data():
             prep(raw["test_x"]), raw["test_y"].astype(np.int64))
 
 
-def run_torch(seed, steps):
+def _set_flat(model, vec):
+    with torch.no_grad():
+        offset = 0
+        for p in model.parameters():
+            num = p.numel()
+            p.copy_(vec[offset:offset + num].view_as(p))
+            offset += num
+
+
+def _get_flat(model):
+    with torch.no_grad():
+        return torch.cat([p.flatten().clone() for p in model.parameters()])
+
+
+def run_torch(seed, steps, momentum_at="update", nesterov=False):
     """Reference-style loop: sequential backprops, per-grad clip, empire
-    attack, coordinate-wise lower median, momentum at update
-    (reference `attack.py:752-839`)."""
+    attack, coordinate-wise lower median; momentum placement 'update'
+    (reference `attack.py:836-838`) or 'worker' with per-worker buffers and
+    the optional Nesterov parameter lookahead (`attack.py:757-783, 800-804`)."""
     train_x, train_y, test_x, test_y = _data()
     torch.manual_seed(seed)
     rng = np.random.default_rng(seed)
     model = SimplesFull()
     model.train()
     loss_fn = nn.NLLLoss()
-    momentum_buf = None
+    momentum_buf = None                     # at-update server buffer
+    worker_bufs = [None] * N_HONEST         # at-worker per-worker buffers
     loss_curve = []
     for _ in range(steps):
         grads = []
         losses = []
-        for _ in range(N_HONEST):
+        theta = _get_flat(model)
+        for i in range(N_HONEST):
+            if nesterov and worker_bufs[i] is not None:
+                # Lookahead: shift params by -mu*lr*m_i before the backprop,
+                # restore after (reference `attack.py:766-775`)
+                _set_flat(model, theta - MOMENTUM * LR * worker_bufs[i])
             sel = rng.integers(0, len(train_x), BATCH)
             model.zero_grad()
             loss = loss_fn(model(torch.from_numpy(train_x[sel])),
                            torch.from_numpy(train_y[sel]))
             loss.backward()
+            if nesterov:
+                _set_flat(model, theta)
             g = torch.cat([p.grad.flatten() for p in model.parameters()])
             norm = g.norm().item()
             if norm > CLIP:
@@ -111,35 +134,44 @@ def run_torch(seed, steps):
             grads.append(g.detach().clone())
             losses.append(loss.item())
         loss_curve.append(float(np.mean(losses)))
-        avg = torch.stack(grads).mean(dim=0)
+        if momentum_at == "worker":
+            # m_i <- mu*m_i + g_i; the buffers are what gets submitted
+            # (reference `attack.py:800-804`)
+            for i in range(N_HONEST):
+                worker_bufs[i] = (grads[i] if worker_bufs[i] is None
+                                  else MOMENTUM * worker_bufs[i] + grads[i])
+            submitted = [b.clone() for b in worker_bufs]
+        else:
+            submitted = grads
+        avg = torch.stack(submitted).mean(dim=0)
         byz = avg + 1.1 * (-avg)  # empire, factor 1.1
-        stack = torch.stack(grads + [byz] * F_REAL)
+        stack = torch.stack(submitted + [byz] * F_REAL)
         n = stack.shape[0]
         agg = stack.sort(dim=0).values[(n - 1) // 2]  # lower median
-        momentum_buf = (agg if momentum_buf is None
-                        else MOMENTUM * momentum_buf + agg)
-        with torch.no_grad():
-            offset = 0
-            for p in model.parameters():
-                num = p.numel()
-                p -= LR * momentum_buf[offset:offset + num].view_as(p)
-                offset += num
+        if momentum_at == "worker":
+            update = agg  # defense output applied directly
+        else:
+            momentum_buf = (agg if momentum_buf is None
+                            else MOMENTUM * momentum_buf + agg)
+            update = momentum_buf
+        _set_flat(model, _get_flat(model) - LR * update)
     model.eval()
     with torch.no_grad():
         pred = model(torch.from_numpy(test_x)).argmax(dim=1).numpy()
     return float((pred == test_y).mean()), loss_curve
 
 
-def run_jax(seed, steps, tmp):
+def run_jax(seed, steps, tmp, momentum_at="update", nesterov=False):
     """The framework, through the standard driver CLI."""
     from byzantinemomentum_tpu.cli.attack import main
-    resdir = pathlib.Path(tmp) / f"jax-{seed}"
-    rc = main(["--dataset", "mnist", "--model", "simples-full",
+    resdir = pathlib.Path(tmp) / f"jax-{momentum_at}-{int(nesterov)}-{seed}"
+    rc = main((["--momentum-nesterov"] if nesterov else []) +
+              ["--dataset", "mnist", "--model", "simples-full",
                "--nb-workers", str(N_WORKERS),
                "--nb-decl-byz", str(F_REAL), "--nb-real-byz", str(F_REAL),
                "--gar", "median", "--attack", "empire",
                "--attack-args", "factor:1.1",
-               "--momentum", str(MOMENTUM), "--momentum-at", "update",
+               "--momentum", str(MOMENTUM), "--momentum-at", momentum_at,
                "--gradient-clip", str(CLIP),
                "--batch-size", str(BATCH),
                "--learning-rate", str(LR), "--learning-rate-decay", "-1",
@@ -178,27 +210,35 @@ def main():
     args = parser.parse_args()
 
     seeds = list(range(1, args.seeds + 1))
-    torch_runs = [run_torch(s, args.steps) for s in seeds]
-    jax_runs = [run_jax(s, args.steps, args.tmp) for s in seeds]
-
-    accuracy = _compare([r[0] for r in torch_runs],
-                        [r[0] for r in jax_runs], floor=0.02)
-    checkpoints = [k for k in (5, 10, 20, 40) if k < args.steps]
-    loss_at = {}
-    for k in checkpoints:
-        loss_at[str(k)] = _compare([r[1][k] for r in torch_runs],
-                                   [r[1][k] for r in jax_runs],
-                                   floor=0.05)  # 5% absolute on NLL scale
-    out = {
-        "config": f"MNIST simples-full, n={N_WORKERS} f={F_REAL}, median vs "
-                  f"empire(1.1), momentum {MOMENTUM} at update, clip {CLIP}, "
-                  f"lr {LR}, {args.steps} steps, {args.seeds} seeds, "
-                  f"synthetic MNIST (deterministic, shared by both sides)",
-        "accuracy": accuracy,
-        "loss_at": loss_at,
-        "parity": bool(accuracy["parity"]
-                       and all(v["parity"] for v in loss_at.values())),
-    }
+    variants = (("update", False), ("worker", True))
+    configs = []
+    for momentum_at, nesterov in variants:
+        torch_runs = [run_torch(s, args.steps, momentum_at, nesterov)
+                      for s in seeds]
+        jax_runs = [run_jax(s, args.steps, args.tmp, momentum_at, nesterov)
+                    for s in seeds]
+        accuracy = _compare([r[0] for r in torch_runs],
+                            [r[0] for r in jax_runs], floor=0.02)
+        checkpoints = [k for k in (5, 10, 20, 40) if k < args.steps]
+        loss_at = {}
+        for k in checkpoints:
+            loss_at[str(k)] = _compare([r[1][k] for r in torch_runs],
+                                       [r[1][k] for r in jax_runs],
+                                       floor=0.05)  # 5% abs on NLL scale
+        configs.append({
+            "config": f"MNIST simples-full, n={N_WORKERS} f={F_REAL}, "
+                      f"median vs empire(1.1), momentum {MOMENTUM} at "
+                      f"{momentum_at}{' +nesterov' if nesterov else ''}, "
+                      f"clip {CLIP}, lr {LR}, {args.steps} steps, "
+                      f"{args.seeds} seeds, synthetic MNIST (deterministic, "
+                      f"shared by both sides)",
+            "accuracy": accuracy,
+            "loss_at": loss_at,
+            "parity": bool(accuracy["parity"]
+                           and all(v["parity"] for v in loss_at.values())),
+        })
+    out = {"configs": configs,
+           "parity": bool(all(c["parity"] for c in configs))}
     path = pathlib.Path(__file__).resolve().parent.parent / "ACCURACY_PARITY.json"
     path.write_text(json.dumps(out, indent=2) + "\n")
     print(json.dumps(out))
