@@ -13,7 +13,6 @@ reference `krum.py:82-96`).
 """
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ driver's `--device` flag.
 import pathlib
 import queue
 import subprocess
-import sys
 import threading
 
 from byzantinemomentum_tpu.utils import logging as _log

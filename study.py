@@ -14,7 +14,6 @@ Capability parity with the reference's `study.py`:
 """
 
 import json
-import math
 import pathlib
 
 import pandas
