@@ -192,3 +192,26 @@ def test_nan_attack_resilient_gar_via_cli(tmp_path):
         fields = row.split("\t")
         assert np.isfinite(float(fields[2]))            # Average loss
         assert np.isfinite(float(fields[defense_idx]))  # Defense output
+
+
+def test_trace_dir_writes_profile(tmp_path):
+    """--trace-dir captures a jax.profiler trace of the run (the opt-in
+    tracing subsystem, SURVEY §5.1)."""
+    trace = tmp_path / "trace"
+    rc = main(BASE + ["--gar", "average", "--trace-dir", str(trace)])
+    assert rc == 0
+    assert any(trace.rglob("*.xplane.pb")) or any(trace.rglob("*.json.gz"))
+
+
+def test_anticge_vs_cge_via_cli(tmp_path):
+    """The CGE-specific adaptive attack through the driver (reference
+    `attacks/anticge.py`): runs and reports a finite influence."""
+    resdir = tmp_path / "acge"
+    rc = main(BASE + ["--gar", "cge", "--attack", "anticge",
+                      "--nb-real-byz", "4", "--nb-for-study", "11",
+                      "--nb-for-study-past", "2",
+                      "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
+    ratios = [float(r.split("\t")[-1]) for r in rows]
+    assert all(np.isfinite(v) and 0.0 <= v <= 1.0 for v in ratios)
