@@ -176,3 +176,29 @@ def test_f64_without_x64_refused():
     with pytest.raises(ValueError, match="x64"):
         _build(dtype="float64")
 
+
+
+def test_cross_dtype_checkpoint_resume(tmp_path, small_synth):
+    """A checkpoint written at one dtype loads into a run configured at
+    another: stored arrays are cast to the new state template's dtypes
+    (checkpoint.py casts to the template), and training continues finitely."""
+    base = ["--batch-size", "8", "--batch-size-test", "32",
+            "--batch-size-test-reps", "1", "--evaluation-delta", "2",
+            "--model", "simples-full", "--seed", "21", "--gar", "median",
+            "--nb-workers", "7", "--nb-decl-byz", "2",
+            "--nb-for-study", "7", "--nb-for-study-past", "2"]
+    part = tmp_path / "bf16"
+    rc = main(base + ["--nb-steps", "2", "--checkpoint-delta", "2",
+                      "--dtype", "bfloat16",
+                      "--result-directory", str(part)])
+    assert rc == 0
+    resumed = tmp_path / "f32"
+    rc = main(base + ["--nb-steps", "2", "--dtype", "float32",
+                      "--load-checkpoint", str(part / "checkpoint-2"),
+                      "--result-directory", str(resumed)])
+    assert rc == 0
+    rows = [l for l in (resumed / "study").read_text().split(os.linesep)[1:] if l]
+    assert [r.split("\t")[0] for r in rows] == ["2", "3"]
+    # f32 precision restored in the CSV format, values finite
+    assert all(np.isfinite(float(r.split("\t")[2])) for r in rows)
+    assert len(rows[0].split("\t")[2].split("e")[0].split(".")[1]) == 8
