@@ -215,3 +215,20 @@ def test_anticge_vs_cge_via_cli(tmp_path):
     rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
     ratios = [float(r.split("\t")[-1]) for r in rows]
     assert all(np.isfinite(v) and 0.0 <= v <= 1.0 for v in ratios)
+
+
+def test_bulyan_attack_adaptive_via_cli(tmp_path):
+    """The 'Hidden Vulnerability' attack with an adaptive (negative) factor
+    against the Bulyan defense: the in-graph line search evaluates the live
+    GAR inside the step (reference `attacks/identical.py:66-77, 114-127`)."""
+    resdir = tmp_path / "bul"
+    rc = main(BASE + ["--gar", "bulyan", "--attack", "bulyan",
+                      "--attack-args", "factor:-8", "negative:True",
+                      "--nb-workers", "11", "--nb-decl-byz", "2",
+                      "--nb-real-byz", "2", "--nb-for-study", "11",
+                      "--nb-for-study-past", "2",
+                      "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
+    defense_idx = STUDY_COLUMNS.index("Defense gradient norm")
+    assert all(np.isfinite(float(r.split("\t")[defense_idx])) for r in rows)
