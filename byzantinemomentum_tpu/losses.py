@@ -192,6 +192,158 @@ def _hingeembedding(margin=1.0, **kw):
     return loss
 
 
+def _bcewithlogits(**kw):
+    """Torch `BCEWithLogitsLoss`: numerically-stable sigmoid + BCE."""
+    def loss(output, target, params):
+        t = target.reshape(output.shape)
+        return jnp.mean(jnp.maximum(output, 0.0) - output * t
+                        + jnp.log1p(jnp.exp(-jnp.abs(output))))
+    return loss
+
+
+def _poissonnll(log_input=True, full=False, eps=1e-8, **kw):
+    """Torch `PoissonNLLLoss` (mean reduction, optional Stirling term)."""
+    import math as _math
+    def loss(output, target, params):
+        t = target.reshape(output.shape)
+        if log_input:
+            out = jnp.exp(output) - t * output
+        else:
+            out = output - t * jnp.log(output + eps)
+        if full:
+            stirling = t * jnp.log(jnp.maximum(t, 1.0)) - t \
+                + 0.5 * jnp.log(2.0 * _math.pi * jnp.maximum(t, 1.0))
+            out = out + jnp.where(t > 1.0, stirling, 0.0)
+        return jnp.mean(out)
+    return loss
+
+
+def _softmargin(**kw):
+    """Torch `SoftMarginLoss`: targets in {1, -1}."""
+    def loss(output, target, params):
+        t = target.reshape(output.shape)
+        return jnp.mean(jnp.log1p(jnp.exp(-t * output)))
+    return loss
+
+
+def _multimargin(p=1, margin=1.0, **kw):
+    """Torch `MultiMarginLoss`: multi-class hinge over (N, C) logits."""
+    def loss(output, target, params):
+        c = output.shape[1]
+        t = target.astype(jnp.int32).reshape(-1)
+        x_y = jnp.take_along_axis(output, t[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - x_y + output)
+        if p != 1:
+            m = m ** p
+        m = jnp.where(jax.nn.one_hot(t, c, dtype=bool), 0.0, m)
+        return jnp.mean(jnp.sum(m, axis=1) / c)
+    return loss
+
+
+def _multilabelmargin(**kw):
+    """Torch `MultiLabelMarginLoss`: target rows hold class indices
+    terminated by -1."""
+    def loss(output, target, params):
+        n, c = output.shape
+        t = target.astype(jnp.int32).reshape(n, -1)
+        valid = jnp.cumprod(t >= 0, axis=1).astype(bool)
+        tc = jnp.clip(t, 0)
+        is_target = jnp.zeros((n, c), bool).at[
+            jnp.arange(n)[:, None], tc].max(valid)
+        x_t = jnp.take_along_axis(output, tc, axis=1)          # (n, k)
+        hinge = jnp.maximum(0.0, 1.0 - x_t[:, :, None] + output[:, None, :])
+        mask = valid[:, :, None] & ~is_target[:, None, :]
+        return jnp.mean(jnp.sum(jnp.where(mask, hinge, 0.0), axis=(1, 2)) / c)
+    return loss
+
+
+def _multilabelsoftmargin(**kw):
+    """Torch `MultiLabelSoftMarginLoss`: per-class BCE over {0,1} targets."""
+    def loss(output, target, params):
+        t = target.reshape(output.shape)
+        per = t * jax.nn.log_sigmoid(output) \
+            + (1.0 - t) * jax.nn.log_sigmoid(-output)
+        return jnp.mean(-jnp.mean(per, axis=1))
+    return loss
+
+
+def _cosineembedding(margin=0.0, **kw):
+    """Torch `CosineEmbeddingLoss`; `output` is the pair (x1, x2) — the
+    reference registers this name but its two-input signature never fit the
+    `(output, target)` call, so the pair-in-output convention is this repo's
+    usable extension."""
+    eps = 1e-8
+    def loss(output, target, params):
+        x1, x2 = output
+        cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), eps)
+        t = target.reshape(cos.shape)
+        return jnp.mean(jnp.where(t > 0, 1.0 - cos,
+                                  jnp.maximum(0.0, cos - margin)))
+    return loss
+
+
+def _marginranking(margin=0.0, **kw):
+    """Torch `MarginRankingLoss`; `output` is the pair (x1, x2)."""
+    def loss(output, target, params):
+        x1, x2 = output
+        t = target.reshape(x1.shape)
+        return jnp.mean(jnp.maximum(0.0, -t * (x1 - x2) + margin))
+    return loss
+
+
+def _tripletmargin(margin=1.0, p=2, eps=1e-6, swap=False, **kw):
+    """Torch `TripletMarginLoss`; `output` is the triple (anchor, pos, neg)."""
+    def _pdist(a, b):
+        return jnp.sum(jnp.abs(a - b + eps) ** p, axis=-1) ** (1.0 / p)
+    def loss(output, target, params):
+        a, pos, neg = output
+        dp, dn = _pdist(a, pos), _pdist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, _pdist(pos, neg))
+        return jnp.mean(jnp.maximum(0.0, dp - dn + margin))
+    return loss
+
+
+def _tripletmarginwithdistance(distance_function=None, margin=1.0,
+                               swap=False, **kw):
+    """Torch `TripletMarginWithDistanceLoss`; `output` is the triple
+    (anchor, pos, neg), default distance = pairwise L2."""
+    if distance_function is None:
+        distance_function = lambda a, b: jnp.linalg.norm(a - b, axis=-1)
+    def loss(output, target, params):
+        a, pos, neg = output
+        dp = distance_function(a, pos)
+        dn = distance_function(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, distance_function(pos, neg))
+        return jnp.mean(jnp.maximum(0.0, dp - dn + margin))
+    return loss
+
+
+def _gaussiannll(full=False, eps=1e-6, **kw):
+    """Torch `GaussianNLLLoss`; `output` is the pair (mean, var)."""
+    import math as _math
+    def loss(output, target, params):
+        mu, var = output
+        t = target.reshape(mu.shape)
+        var = jnp.maximum(var, eps)
+        out = 0.5 * (jnp.log(var) + (t - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * _math.log(2.0 * _math.pi)
+        return jnp.mean(out)
+    return loss
+
+
+# Registered name-for-name with what the reference's auto-registration over
+# `torch.nn.modules.loss` exposes (reference `experiments/loss.py:87-109`),
+# with `l1`/`l2` replaced by the param-norm regularizers exactly as there.
+# `ctc` is deliberately absent: `CTCLoss.forward` takes four arguments
+# (log_probs, targets, input_lengths, target_lengths), so the name never fit
+# the reference's own `(output, target)` wrapper either — it was registered
+# but unusable. The multi-input losses (cosineembedding, marginranking,
+# tripletmargin, gaussiannll) are in the same boat there; here they work by
+# passing the input tuple as `output`.
 register_loss("nll", _nll)
 register_loss("crossentropy", _crossentropy)
 register_loss("mse", _mse)
@@ -201,6 +353,17 @@ register_loss("smoothl1", _smoothl1)
 register_loss("huber", _smoothl1)
 register_loss("kldiv", _kldiv)
 register_loss("hingeembedding", _hingeembedding)
+register_loss("bcewithlogits", _bcewithlogits)
+register_loss("poissonnll", _poissonnll)
+register_loss("softmargin", _softmargin)
+register_loss("multimargin", _multimargin)
+register_loss("multilabelmargin", _multilabelmargin)
+register_loss("multilabelsoftmargin", _multilabelsoftmargin)
+register_loss("cosineembedding", _cosineembedding)
+register_loss("marginranking", _marginranking)
+register_loss("tripletmargin", _tripletmargin)
+register_loss("tripletmarginwithdistance", _tripletmarginwithdistance)
+register_loss("gaussiannll", _gaussiannll)
 register_loss("l1", _l1)
 register_loss("l2", _l2)
 

@@ -213,17 +213,71 @@ def _init_orthogonal(key, shape, gain=1.0, **kw):
     return gain * jax.nn.initializers.orthogonal()(key, shape, jnp.float32)
 
 
+def _init_trunc_normal(key, shape, mean=0.0, std=1.0, a=-2.0, b=2.0, **kw):
+    """torch `trunc_normal_`: N(mean, std) truncated to values in [a, b]."""
+    lo = (a - mean) / std
+    hi = (b - mean) / std
+    return mean + std * jax.random.truncated_normal(
+        key, lo, hi, shape, jnp.float32)
+
+
+def _init_eye(key, shape, **kw):
+    """torch `eye_`: 2D identity (preserves input identity in a Linear)."""
+    if len(shape) != 2:
+        raise ValueError("eye init requires a 2-dimensional parameter")
+    return jnp.eye(shape[0], shape[1], dtype=jnp.float32)
+
+
+def _init_dirac(key, shape, groups=1, **kw):
+    """torch `dirac_` in HWIO layout: the {3,4,5}D conv kernel that preserves
+    channel identity (delta at the spatial center, per group)."""
+    if len(shape) not in (3, 4, 5):
+        raise ValueError("dirac init requires a {3,4,5}-dimensional kernel")
+    spatial, cin, cout = shape[:-2], shape[-2], shape[-1]
+    if cout % groups != 0:
+        raise ValueError("out channels must be divisible by groups")
+    per_group = cout // groups
+    w = jnp.zeros(shape, jnp.float32)
+    center = tuple(s // 2 for s in spatial)
+    for g in range(groups):
+        for d in range(min(per_group, cin)):
+            w = w.at[center + (d, g * per_group + d)].set(1.0)
+    return w
+
+
+def _init_sparse(key, shape, sparsity=0.1, std=0.01, **kw):
+    """torch `sparse_`: N(0, std) 2D matrix with a `sparsity` fraction of
+    each column zeroed (exactly ceil(sparsity*rows) zeros per column)."""
+    if len(shape) != 2:
+        raise ValueError("sparse init requires a 2-dimensional parameter")
+    rows, _ = shape
+    nz = math.ceil(sparsity * rows)
+    kn, kp = jax.random.split(key)
+    w = std * jax.random.normal(kn, shape, jnp.float32)
+    if nz <= 0:
+        return w
+    # Uniform ranks give an independent random permutation per column; keep
+    # entries above each column's nz-th smallest rank
+    u = jax.random.uniform(kp, shape)
+    thresh = jnp.sort(u, axis=0)[nz - 1]
+    return w * (u > thresh)
+
+
 inits = {
     "uniform": _init_uniform,
     "normal": _init_normal,
+    "trunc_normal": _init_trunc_normal,
     "constant": _init_constant,
     "ones": _init_ones,
     "zeros": _init_zeros,
+    "eye": _init_eye,
+    "dirac": _init_dirac,
     "xavier_uniform": _init_xavier_uniform,
     "xavier_normal": _init_xavier_normal,
     "kaiming_uniform": _init_kaiming_uniform,
     "kaiming_normal": _init_kaiming_normal,
     "orthogonal": _init_orthogonal,
+    "sparse": _init_sparse,
 }
 # Accept the torch in-place spellings too ("xavier_uniform_", ...)
 inits.update({k + "_": v for k, v in list(inits.items())})
