@@ -7,13 +7,21 @@ batch 50, momentum 0.99 at update, clip 5, nb-for-study=1, with the full
 24-column study pipeline on (the reference's `reproduce.py` CIFAR grid runs
 exactly this cell — f=5 is the largest f for which Bulyan's n >= 4f+3
 constraint holds at n=25, and the grid excludes Bulyan at f=11; reference
-`reproduce.py:165-209`, `aggregators/bulyan.py:102-117`).
+`reproduce.py:165-209`, `aggregators/bulyan.py:102-117`; see BASELINE.md's
+correction note for why the r01 metric name said f=11).
 
 Two modes are measured: default f32, and TPU mixed precision
 (`--compute-dtype bfloat16`: bf16 forward/backward on the MXU, f32 master
 weights/momentum/GAR space). The headline `value` is the faster mode;
 per-mode numbers, FLOPs/step (XLA `cost_analysis`) and MFU (vs the chip's
 bf16 peak) ride along in the same JSON line.
+
+Companion cells (same JSON line, `cells` object):
+- `krum_f11`: n=25, f=11, Krum — the valid carrier of the f=11 column
+  (coordinate-wise/Krum rules only need n >= 2f+3).
+- `wrn28x10`: the appendix model (`reproduce-appendix.py` grid shape:
+  WRN-28-10, n=11, f=2, batch 20, crossentropy, Nesterov momentum), f32 and
+  bf16-mixed.
 
 Both sides validate the GAR constraint up front and assert a finite defense
 gradient every measured step, so a degenerate (NaN) run cannot be timed.
@@ -65,21 +73,23 @@ def _peak_flops():
     return None, kind
 
 
-def _run_mode(compute_dtype, train_data):
-    """Build + time one precision mode; returns (steps/s, flops/step)."""
-    gar = ops.gars["bulyan"]
-    message = gar.check(gradients=jnp.zeros((N_WORKERS, 1)), f=F)
+def _run_mode(compute_dtype, train_data, *, gar_name="bulyan", n=N_WORKERS,
+              f=F, model="empire-cnn", model_args=None, loss="nll",
+              nesterov=False, windows=2, min_measure_s=MIN_MEASURE_S):
+    """Build + time one (cell, precision mode); returns (steps/s, flops/step)."""
+    gar = ops.gars[gar_name]
+    message = gar.check(gradients=jnp.zeros((n, 1)), f=f)
     if message is not None:
         raise SystemExit(f"Invalid benchmark configuration: {message}")
 
     cfg = EngineConfig(
-        nb_workers=N_WORKERS, nb_decl_byz=F, nb_real_byz=F,
+        nb_workers=n, nb_decl_byz=f, nb_real_byz=f,
         nb_for_study=1, nb_for_study_past=1,
-        momentum=0.99, momentum_at="update", gradient_clip=5.0,
-        compute_dtype=compute_dtype)
-    model_def = models.build("empire-cnn")
+        momentum=0.99, momentum_at="update", nesterov=nesterov,
+        gradient_clip=5.0, compute_dtype=compute_dtype)
+    model_def = models.build(model, **(model_args or {}))
     engine = build_engine(
-        cfg=cfg, model_def=model_def, loss=losses.Loss("nll"),
+        cfg=cfg, model_def=model_def, loss=losses.Loss(loss),
         criterion=losses.Criterion("top-k"),
         defenses=[(gar, 1.0, {})],
         attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
@@ -117,11 +127,11 @@ def _run_mode(compute_dtype, train_data):
         state, metrics = engine.train_multi_indexed(state, idx, flips, lrs)
     jax.block_until_ready(state.theta)
 
-    # Two measurement windows, best-of taken: the remote-TPU tunnel's
+    # Multiple measurement windows, best-of taken: the remote-TPU tunnel's
     # throughput varies ±10-30% between windows, and the benchmark's job is
     # to report the hardware's capability, not the tunnel's mood.
     best = 0.0
-    for _ in range(2):
+    for _ in range(windows):
         steps = 0
         # Defense-norm device arrays are collected without syncing (so
         # dispatch stays pipelined) and checked after the timed loop — every
@@ -140,7 +150,7 @@ def _run_mode(compute_dtype, train_data):
             # sees executed (not merely enqueued) steps; dispatch stays
             # pipelined within each chunk
             jax.block_until_ready(defense_norms[-1])
-            if time.monotonic() - start >= MIN_MEASURE_S:
+            if time.monotonic() - start >= min_measure_s:
                 break
         jax.block_until_ready(state.theta)
         elapsed = time.monotonic() - start
@@ -151,8 +161,8 @@ def _run_mode(compute_dtype, train_data):
             bad = int(np.argmax(~np.isfinite(norms)))
             raise SystemExit(
                 f"Non-finite defense gradient at measured step {bad} "
-                f"(compute_dtype={compute_dtype}): the benchmark timed a "
-                f"degenerate run")
+                f"({gar_name}, compute_dtype={compute_dtype}): the benchmark "
+                f"timed a degenerate run")
         best = max(best, steps / elapsed)
     return best, flops
 
@@ -174,6 +184,33 @@ def main():
     peak, device_kind = _peak_flops()
     mfu = (flops * headline / peak) if (flops and peak) else None
 
+    # Companion cells (shorter windows; recorded, not the headline).
+    cells = {}
+    krum_sps, _ = _run_mode("bfloat16", train_data, gar_name="krum", f=11,
+                            windows=1, min_measure_s=2.5)
+    cells["krum_f11"] = {"steps_per_sec_bf16_mixed": krum_sps,
+                         "n": N_WORKERS, "f": 11, "gar": "krum"}
+
+    wrn_train, _ = data.make_datasets("cifar10", 20, 20, seed=0)
+    wrn_data = DeviceData(wrn_train)
+    wrn_kw = dict(gar_name="bulyan", n=11, f=2,
+                  model="wide_resnet-Wide_ResNet",
+                  model_args={"depth": 28, "widen_factor": 10,
+                              "dropout_rate": 0.3, "num_classes": 10},
+                  loss="crossentropy", nesterov=True,
+                  windows=1, min_measure_s=2.5)
+    wrn_f32, wrn_flops32 = _run_mode(None, wrn_data, **wrn_kw)
+    wrn_bf16, wrn_flops16 = _run_mode("bfloat16", wrn_data, **wrn_kw)
+    wrn_best = max(wrn_f32, wrn_bf16)
+    wrn_flops = wrn_flops16 if wrn_bf16 >= wrn_f32 else wrn_flops32
+    cells["wrn28x10"] = {
+        "steps_per_sec_f32": wrn_f32,
+        "steps_per_sec_bf16_mixed": wrn_bf16,
+        "flops_per_step": wrn_flops,
+        "mfu": (wrn_flops * wrn_best / peak) if (wrn_flops and peak) else None,
+        "n": 11, "f": 2, "gar": "bulyan", "batch": 20,
+    }
+
     baseline_path = pathlib.Path(__file__).resolve().parent / "BASELINE_MEASURED.json"
     vs_baseline = None
     if baseline_path.is_file():
@@ -193,6 +230,7 @@ def main():
         "flops_per_step": flops,
         "mfu": mfu,
         "device_kind": device_kind,
+        "cells": cells,
     }))
 
 
