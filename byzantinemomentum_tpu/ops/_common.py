@@ -24,7 +24,30 @@ __all__ = [
     "closest_mean",
     "sanitize_inf",
     "selection_influence",
+    "weighted_rows_mean",
 ]
+
+
+def weighted_rows_mean(w, gradients):
+    """`w @ gradients` with row-selection non-finite semantics.
+
+    `w: f32[n] | f32[r, n]` holds averaging weights (0 on unselected rows).
+    A dynamic row-gather + mean is the slow path on TPU, so selection-based
+    GARs (krum, bulyan stage 1) express their selected-row averages as this
+    matmul instead. Non-finite handling matches the gather-mean it replaces:
+    unselected (zero-weight) non-finite rows are excluded (0 * NaN must not
+    poison the product), while a non-finite entry in a SELECTED row — only
+    possible beyond the f-contract — propagates NaN to exactly its
+    coordinate(s). (The gather-mean would yield NaN or ±inf there depending
+    on the entry; this normalizes to NaN.)
+    """
+    finite = jnp.where(jnp.isfinite(gradients), gradients, 0.0)
+    out = jnp.matmul(w, finite, precision=jax.lax.Precision.HIGHEST)
+    nonfin = (~jnp.isfinite(gradients)).astype(jnp.float32)
+    sel = (w > 0).astype(jnp.float32)
+    bad = jnp.matmul(sel, nonfin,
+                     precision=jax.lax.Precision.HIGHEST) > 0
+    return jnp.where(bad, jnp.nan, out)
 
 
 def selection_influence(selection_fn):

@@ -30,7 +30,7 @@ from jax import lax
 from byzantinemomentum_tpu.ops import register
 from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
 
-__all__ = ["aggregate", "selection"]
+__all__ = ["aggregate", "selection", "best_subset_mask_from_dist"]
 
 # Subsets evaluated per chunk of the streaming enumeration: memory is
 # O(CHUNK * n^2) floats — ~10 MB at n=25 — independent of C(n, n-f)
@@ -73,9 +73,12 @@ def _unrank_masks(ranks, n, k, tbl):
     return jax.vmap(one)(ranks)
 
 
-def _best_subset_mask(gradients, f, *, method="dot"):
-    """bool[n] mask of the minimum-diameter size-(n-f) subset."""
-    n = gradients.shape[0]
+def best_subset_mask_from_dist(dist, f):
+    """bool[n] mask of the minimum-diameter size-(n-f) subset, from the
+    (n, n) distance matrix (+inf diagonal). Shared by the single-chip path
+    and the d-sharded kernel (`parallel/sharded.py`), which feeds a psum'd
+    distance matrix."""
+    n = dist.shape[0]
     k = n - f
     tbl_np = _binom_table(n, k)
     total = int(tbl_np[n, k])
@@ -86,7 +89,6 @@ def _best_subset_mask(gradients, f, *, method="dot"):
             f"infeasible at this scale)")
     tbl = jnp.asarray(np.minimum(tbl_np, np.iinfo(np.int32).max)
                       .astype(np.int32))
-    dist = pairwise_distances(gradients, method=method)
     # Diagonal is +inf by convention (for per-row sorts); the diameter wants
     # it excluded instead
     offdiag = ~jnp.eye(n, dtype=bool)
@@ -112,6 +114,12 @@ def _best_subset_mask(gradients, f, *, method="dot"):
     _, best_rank = lax.fori_loop(
         0, nchunks, chunk_best, (jnp.float32(jnp.inf), jnp.int32(0)))
     return _unrank_masks(best_rank[None], n, k, tbl)[0]
+
+
+def _best_subset_mask(gradients, f, *, method="dot"):
+    """bool[n] mask of the minimum-diameter size-(n-f) subset."""
+    return best_subset_mask_from_dist(
+        pairwise_distances(gradients, method=method), f)
 
 
 def selection(gradients, f, *, method="dot", **kwargs):

@@ -24,25 +24,28 @@ import jax
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import closest_mean, lower_median, pairwise_distances
+from byzantinemomentum_tpu.ops._common import (
+    closest_mean, lower_median, pairwise_distances, weighted_rows_mean)
 
-__all__ = ["aggregate", "selected_stack"]
+__all__ = ["aggregate", "selected_stack", "selection_weights"]
 
 
-def selected_stack(gradients, f, m=None, *, method="dot"):
-    """The (n-2f-2, d) stack of iterative Multi-Krum averages
-    (reference `aggregators/bulyan.py:63-76`, effective behavior)."""
-    n = gradients.shape[0]
+def selection_weights(dist, f, m=None):
+    """Stage-1 averaging weights `(rounds, n)` from the `(n, n)` distance
+    matrix (+inf diagonal).
+
+    The sequential selection runs entirely on the (n,) score vector, emitting
+    one averaging-weight row per round; callers touch the gradients once, by
+    a single `(rounds, n) @ (n, d)` matmul — no per-round row gathers over
+    the large matrix. Shared by the single-chip path below and the d-sharded
+    kernel (`parallel/sharded.py`), which feeds a psum'd distance matrix.
+    """
+    n = dist.shape[0]
     m_max = n - f - 2
     if m is None:
         m = m_max
-    dist = pairwise_distances(gradients, method=method)  # diag = +inf
     scores = jnp.sum(jnp.sort(dist, axis=1)[:, :m], axis=1)
     rounds = n - 2 * f - 2
-    # The sequential selection runs entirely on the (n,) score vector,
-    # emitting one averaging-weight row per round; the gradients are touched
-    # once, by a single (rounds, n) @ (n, d) matmul — no per-round row
-    # gathers over the large matrix.
     m_is = jnp.asarray([min(m, m_max - i) for i in range(rounds)], jnp.int32)
 
     def body(scores, m_i):
@@ -53,13 +56,20 @@ def selected_stack(gradients, f, m=None, *, method="dot"):
         return scores.at[order[0]].set(jnp.inf), w
 
     _, W = jax.lax.scan(body, scores, m_is)
-    # Rows with any non-finite coordinate carry +inf scores and are never
-    # selected (m_i <= n-f-2 < #finite rows under the n >= 4f+3 contract),
-    # but 0-weight * NaN would still poison the matmul — zero them out,
-    # which is exactly "excluded from the average"
-    finite = jnp.where(jnp.isfinite(gradients), gradients, 0.0)
-    return jnp.matmul(W.astype(gradients.dtype), finite,
-                      precision=jax.lax.Precision.HIGHEST)
+    return W
+
+
+def selected_stack(gradients, f, m=None, *, method="dot"):
+    """The (n-2f-2, d) stack of iterative Multi-Krum averages
+    (reference `aggregators/bulyan.py:63-76`, effective behavior).
+
+    Rows with any non-finite coordinate carry +inf scores and are never
+    selected under the n >= 4f+3 contract; beyond it, a selected non-finite
+    entry propagates NaN to its coordinate of that round's average
+    (`ops._common.weighted_rows_mean`)."""
+    dist = pairwise_distances(gradients, method=method)  # diag = +inf
+    W = selection_weights(dist, f, m)
+    return weighted_rows_mean(W.astype(gradients.dtype), gradients)
 
 
 def aggregate(gradients, f, m=None, *, method="dot", **kwargs):

@@ -18,20 +18,40 @@ import jax
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
+from byzantinemomentum_tpu.ops._common import (
+    pairwise_distances, selection_influence, weighted_rows_mean)
 
-__all__ = ["aggregate", "scores", "selection"]
+__all__ = ["aggregate", "scores", "selection", "selection_weights"]
 
 
-def scores(gradients, f, *, method="dot"):
-    """Multi-Krum scores: per row, sum of the n-f-1 smallest distances
-    (reference `aggregators/krum.py:49-60`). `f32[n,d] -> f32[n]`."""
-    n = gradients.shape[0]
-    dist = pairwise_distances(gradients, method=method)  # diag = +inf
+def scores_from_dist(dist, f):
+    """Multi-Krum scores from the (n, n) distance matrix (+inf diagonal):
+    per row, sum of the n-f-1 smallest distances
+    (reference `aggregators/krum.py:49-60`)."""
+    n = dist.shape[0]
     # Each row holds n-1 finite-or-inf off-diagonal distances plus the +inf
     # diagonal; ascending sort puts the diagonal last, so the first n-f-1
     # entries are exactly the smallest n-f-1 neighbor distances.
     return jnp.sum(jnp.sort(dist, axis=1)[:, :n - f - 1], axis=1)
+
+
+def scores(gradients, f, *, method="dot"):
+    """Multi-Krum scores. `f32[n,d] -> f32[n]`."""
+    return scores_from_dist(pairwise_distances(gradients, method=method), f)
+
+
+def selection_weights(dist, f, m=None):
+    """Averaging weights `f32[n]` from the (n, n) distance matrix: 1/m on
+    the m lowest-score rows (stable-tie order), 0 elsewhere. Shared by the
+    single-chip path below and the d-sharded kernel (`parallel/sharded.py`),
+    which feeds a psum'd distance matrix."""
+    n = dist.shape[0]
+    if m is None:
+        m = n - f - 2
+    order = jnp.argsort(scores_from_dist(dist, f), stable=True)
+    ranks = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return jnp.where(ranks < m, 1.0 / m, 0.0)
 
 
 def selection(gradients, f, m=None, *, method="dot", **kwargs):
@@ -49,19 +69,11 @@ def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
 
     The selected-row average is a weight-vector matmul rather than a row
     gather (dynamic gathers over the (n, d) matrix are the slow path on
-    TPU — same reformulation as Bulyan's selection stack)."""
-    n = gradients.shape[0]
-    if m is None:
-        m = n - f - 2
-    order = jnp.argsort(scores(gradients, f, method=method), stable=True)
-    ranks = jnp.zeros((n,), jnp.int32).at[order].set(
-        jnp.arange(n, dtype=jnp.int32))
-    w = jnp.where(ranks < m, 1.0 / m, 0.0).astype(gradients.dtype)
-    # Unselected non-finite rows must not poison the matmul (0 * NaN = NaN);
-    # rows with non-finite coordinates have +inf scores and are never
-    # selected while m <= #finite rows, so zeroing them = exclusion
-    finite = jnp.where(jnp.isfinite(gradients), gradients, 0.0)
-    return jnp.matmul(w, finite, precision=jax.lax.Precision.HIGHEST)
+    TPU — same reformulation as Bulyan's selection stack); non-finite
+    semantics in `ops._common.weighted_rows_mean`."""
+    dist = pairwise_distances(gradients, method=method)
+    w = selection_weights(dist, f, m).astype(gradients.dtype)
+    return weighted_rows_mean(w, gradients)
 
 
 _jitted = jax.jit(aggregate, static_argnames=("f", "m", "method"))

@@ -85,6 +85,41 @@ def test_nan_differential(name):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5, err_msg=name)
 
 
+def test_beyond_contract_nan_propagates():
+    """With more non-finite rows than the selection margin tolerates
+    (nb_real_byz > nb_decl_byz), the weight-matmul selection must surface
+    NaN like the reference's gather-mean would — not a silently finite
+    wrong value (round-2 advisor finding)."""
+    n, f, d = 11, 2, 5
+    m = n - f - 2
+    g = rand_grads(n, d, nan_rows=n - m + 1)  # fewer than m finite rows
+    out = np.asarray(ops.gars["krum"](jnp.asarray(g), f=f))
+    assert np.isnan(out).all(), "krum masked a selected non-finite row"
+    # Bulyan stage 1: round 0 averages m_max rows; with fewer finite rows a
+    # NaN row enters that round's average
+    from byzantinemomentum_tpu.ops import bulyan
+    n2, f2 = 15, 3
+    m_max = n2 - f2 - 2
+    g = rand_grads(n2, d, nan_rows=n2 - m_max + 1)
+    sel = np.asarray(bulyan.selected_stack(jnp.asarray(g), f2))
+    assert np.isnan(sel[0]).all(), "bulyan masked a selected non-finite row"
+
+
+def test_beyond_contract_nan_is_per_coordinate():
+    """The propagation is per coordinate, as a row-gather mean's would be:
+    rows that are NaN only at coordinate 0 poison coordinate 0 of the
+    aggregate and leave the other coordinates finite."""
+    n, f, d = 11, 2, 5
+    m = n - f - 2
+    g = rand_grads(n, d)
+    for i in range(n - m + 1):  # more bad rows than the margin tolerates
+        g[n - 1 - i, 0] = np.nan
+    out = np.asarray(ops.gars["krum"](jnp.asarray(g), f=f))
+    assert np.isnan(out[0]), "NaN coordinate of a selected row was masked"
+    assert np.isfinite(out[1:]).all(), \
+        "NaN propagation poisoned unaffected coordinates"
+
+
 def test_median_hand_values():
     g = jnp.asarray(np.array([[1., 5.], [3., 1.], [2., 9.]], dtype=np.float32))
     np.testing.assert_allclose(np.asarray(ops.gars["median"](g)), [2., 5.])
