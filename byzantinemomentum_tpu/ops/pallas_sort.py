@@ -59,6 +59,30 @@ def disabled():
         _disabled_depth -= 1
 
 
+@contextlib.contextmanager
+def allowed():
+    """Re-allow Pallas inside a `shard_map` body traced under `disabled()`.
+
+    Only AUTO-partitioned traces must not see Mosaic kernels; inside a
+    `shard_map` every operand is a manual per-device shard, so the kernels
+    are legal again — `parallel/sharded.py` wraps its shard-local GAR
+    bodies in this, which is how the sorting networks stay alive under
+    `--mesh`."""
+    global _disabled_depth
+    saved = _disabled_depth
+    _disabled_depth = 0
+    try:
+        yield
+    finally:
+        _disabled_depth = saved
+
+
+def interpret_mode():
+    """Trace-time knob: `BMT_PALLAS_INTERPRET=1` runs every kernel in Pallas
+    interpret mode so off-TPU tests exercise the real kernel bodies."""
+    return bool(os.environ.get("BMT_PALLAS_INTERPRET"))
+
+
 def supported(g, interpret=False):
     """Whether the Pallas path applies to this operand (trace-time check)."""
     if _disabled_depth or os.environ.get("BMT_NO_PALLAS"):
@@ -67,7 +91,7 @@ def supported(g, interpret=False):
         return False
     if g.dtype not in _SUPPORTED_DTYPES:
         return False
-    return interpret or jax.default_backend() == "tpu"
+    return interpret or interpret_mode() or jax.default_backend() == "tpu"
 
 
 def _batcher_pairs(n):
@@ -99,10 +123,11 @@ def _sorted_rows(in_ref):
     return rows
 
 
-def _tile_for(n, buffers):
-    """Column-block width: keep `buffers` live (n, tile) f32 buffers within
-    a ~10 MB VMEM budget (of 16 MB/core), in multiples of 128 lanes."""
-    tile = (10 * 2 ** 20) // (4 * buffers * n)
+def _tile_for(n, buffers, itemsize):
+    """Column-block width: keep `buffers` live (n, tile) buffers of the
+    operand dtype within a ~10 MB VMEM budget (of 16 MB/core), in multiples
+    of 128 lanes."""
+    tile = (10 * 2 ** 20) // (itemsize * buffers * n)
     return max(128, min(16384, tile // 128 * 128))
 
 
@@ -110,7 +135,7 @@ def _grid_call(kernel, out_rows, g, extra_1d=(), *, buffers, interpret):
     """Common pallas_call wrapper: grid over column tiles of `g: (n, d)`,
     optional extra (d,) operands, output (out_rows, d) or (d,)."""
     n, d = g.shape
-    tile = _tile_for(n, buffers)
+    tile = _tile_for(n, buffers, jnp.dtype(g.dtype).itemsize)
     grid = ((d + tile - 1) // tile,)
     in_specs = [pl.BlockSpec((n, tile), lambda i: (0, i),
                              memory_space=pltpu.VMEM)]
@@ -128,7 +153,7 @@ def _grid_call(kernel, out_rows, g, extra_1d=(), *, buffers, interpret):
     return pl.pallas_call(
         kernel, out_shape=out_shape, grid=grid,
         in_specs=in_specs, out_specs=out_spec,
-        interpret=interpret)(g, *extra_1d)
+        interpret=interpret or interpret_mode())(g, *extra_1d)
 
 
 # --------------------------------------------------------------------------- #
