@@ -40,6 +40,13 @@ class EngineConfig:
     nb_local_steps: int = 1       # --nb-local-steps (multi-local-step SGD)
     dtype: str = "float32"        # --dtype: parameter/state/gradient dtype
     #                               (reference `configuration.py:26-101`)
+    gars_per_call: bool = False   # --gars-per-call: re-draw the `--gars`
+    #                               mixture GAR on EVERY defense invocation
+    #                               (incl. inside an adaptive attack's line
+    #                               search), the reference's semantics
+    #                               (`attack.py:504-509`); default draws once
+    #                               per step (documented divergence,
+    #                               `engine/step.py`)
     compute_dtype: str = None     # --compute-dtype: forward/backward dtype;
     #                               None = same as `dtype`. Setting bf16 with
     #                               f32 params = TPU mixed precision (bf16

@@ -257,14 +257,38 @@ class Engine:
     # ----------------------------------------------------------------- #
     # Defense dispatch (single GAR or per-step random mixture)
     #
-    # DELIBERATE DIVERGENCE from the reference: a `--gars` mixture here
-    # draws ONE GAR per step (`mix_u` is shared by the attack's inner
-    # defense evaluations, the outer aggregation and the influence), while
-    # the reference re-draws `random.random()` on every defense call
+    # DELIBERATE DIVERGENCE from the reference (default mode): a `--gars`
+    # mixture here draws ONE GAR per step (`mix_u` is shared by the attack's
+    # inner defense evaluations, the outer aggregation and the influence),
+    # while the reference re-draws `random.random()` on every defense call
     # (reference `attack.py:504-509`), so its adaptive attacks line-search
     # against a per-call random GAR. Per-step drawing makes the attack
     # optimize against the defense actually used that step — deterministic
     # under the step PRNG, and at least as favorable to the attacker.
+    #
+    # `cfg.gars_per_call` restores the reference's per-call semantics: each
+    # defense invocation derives fresh entropy by folding a content hash of
+    # its operand into the step's mixture key (`_per_call_uniform`). Distinct
+    # line-search probes present distinct stacked matrices, so each inner
+    # evaluation re-draws — the traceable counterpart of the reference's
+    # per-call `random.random()` (an impure counter cannot live inside a
+    # `lax.while_loop` body; operand-derived entropy can).
+
+    def _per_call_uniform(self, key, gradients):
+        """Fresh U[0,1) per defense invocation: fold a content hash of the
+        operand into the step's mixture key.
+
+        The hash covers EVERY element and is position-dependent (each bit
+        pattern scaled by a Knuth-constant multiple of its flat index before
+        the wraparound sum), so probes that differ in any single coordinate
+        — e.g. the `bulyan` attack's target-coordinate direction — or only
+        by a permutation still re-draw."""
+        bits = lax.bitcast_convert_type(
+            gradients.astype(jnp.float32), jnp.uint32)
+        mult = (jnp.arange(bits.size, dtype=jnp.uint32).reshape(bits.shape)
+                * jnp.uint32(2654435761) | jnp.uint32(1))
+        h = jnp.sum(bits * mult, dtype=jnp.uint32)
+        return jax.random.uniform(jax.random.fold_in(key, h))
 
     def _run_defense(self, G, mix_u):
         cfg = self.cfg
@@ -359,8 +383,12 @@ class Engine:
             G_honest = G_sampled[:h]
 
         # --- attack phase (`attack.py:818`) --- #
+        per_call = cfg.gars_per_call and len(self.defenses) > 1
+
         def defense_fn(gradients, f):
-            return self._run_defense(gradients, mix_u)
+            u = (self._per_call_uniform(mix_key, gradients)
+                 if per_call else mix_u)
+            return self._run_defense(gradients, u)
 
         if cfg.nb_real_byz > 0:
             G_attack = self.attack.unchecked(
@@ -374,8 +402,16 @@ class Engine:
 
         # --- defense phase (`attack.py:821-822`) --- #
         G_all = jnp.concatenate([G_honest, G_attack])
+        if per_call:
+            # The outer aggregation and the influence each re-draw too, as
+            # the reference's per-call random.random() does
+            mix_u = self._per_call_uniform(mix_key, G_all)
+            infl_u = self._per_call_uniform(
+                jax.random.fold_in(mix_key, 1), G_all)
+        else:
+            infl_u = mix_u
         grad_defense = self._run_defense(G_all, mix_u).astype(G_honest.dtype)
-        accept_ratio = self._run_influence(G_honest, G_attack, mix_u)
+        accept_ratio = self._run_influence(G_honest, G_attack, infl_u)
 
         # --- model update (`attack.py:832-839`) --- #
         if cfg.momentum_at == "worker":
@@ -434,7 +470,9 @@ class Engine:
         if jnp.issubdtype(x.dtype, jnp.inexact):
             x = x.astype(cdtype)
         params = _cast_tree(self.unravel(theta), cdtype)
-        net_state = _cast_tree(net_state, cdtype)
+        # net_state (BN running stats) stays in the parameter dtype, exactly
+        # as the training forward (_worker_grad) sees it — eval must not run
+        # with lower-precision normalization statistics than training
         out, _ = self.model_def.apply(params, net_state, x, train=False,
                                       rng=jax.random.PRNGKey(0))
         return self.criterion(out, y)

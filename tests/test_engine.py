@@ -351,6 +351,45 @@ def test_gar_mixture_draws_all_branches():
     assert 1.0 in deltas and 0.0 in deltas
 
 
+def test_gars_per_call_redraws_inside_line_search():
+    """`--gars-per-call` (reference semantics, `attack.py:504-509`): every
+    defense invocation re-draws the mixture GAR. The traceable mechanism is
+    operand-derived entropy, so the distinct stacked matrices an adaptive
+    attack's line-search probes present must produce independent draws that
+    cover both mixture members, while identical operands draw identically
+    (determinism under the step PRNG)."""
+    from byzantinemomentum_tpu import attacks
+    cfg = EngineConfig(nb_workers=7, nb_decl_byz=2, nb_real_byz=2,
+                       nb_for_study=0, momentum=0.0, momentum_at="update",
+                       gars_per_call=True)
+    engine = build_engine(
+        cfg=cfg, model_def=probe_model(), loss=probe_loss(),
+        criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars["average"], 1.0, {}),
+                  (ops.gars["median"], 2.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": -8})
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(7)
+    G = jnp.asarray(rng.normal(size=(7, D)).astype(np.float32))
+    # Emulate line-search probes: same honest rows, varying Byzantine factor
+    probes = [jnp.concatenate([G, (1.0 + 0.25 * i) * G[:2]]) for i in range(16)]
+    us = [float(engine._per_call_uniform(key, p)) for p in probes]
+    idxs = {int(engine._mixture_index(jnp.float32(u))) for u in us}
+    assert idxs == {0, 1}, f"line-search probes never re-drew: {us}"
+    # Same operand, same draw (deterministic under the step key)
+    assert (float(engine._per_call_uniform(key, G))
+            == float(engine._per_call_uniform(key, G)))
+    # E2E: a full step with the adaptive line-search attack compiles and
+    # stays finite under per-call dispatch
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((D,))}, net_state={},
+                        study=False)
+    xs = jnp.asarray(rng.normal(size=(5, 4, D)).astype(np.float32))
+    state, _ = engine.train_step(state, xs, jnp.zeros((5, 4), jnp.float32),
+                                 jnp.float32(0.05))
+    assert np.isfinite(np.asarray(state.theta)).all()
+
+
 def test_optimizer_registry_adam_roundtrip(tmp_path):
     """Adam via the optimizer registry: trains, and its moment buffers
     survive a checkpoint roundtrip."""
