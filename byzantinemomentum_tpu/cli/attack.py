@@ -78,6 +78,10 @@ def process_commandline(argv=None):
     add("--gar-args", nargs="*", help="key:value args for the GAR")
     add("--gars", type=str, default=None,
         help="Random per-step GAR mixture: 'name[,freq[,json-args]];...'")
+    add("--gars-per-call", action="store_true",
+        help="Re-draw the --gars mixture GAR on every defense invocation "
+             "(incl. inside adaptive attacks' line searches) — the "
+             "reference's semantics; default draws once per step")
     add("--attack", type=str, default="nan", help="Attack to use")
     add("--attack-args", nargs="*", help="key:value args for the attack")
     add("--model", type=str, default="simples-conv", help="Model to train")
@@ -136,7 +140,10 @@ def process_commandline(argv=None):
     add("--steps-per-program", type=int, default=8,
         help="Training steps fused into one compiled dispatch (lax.scan); "
              "milestones always force a boundary, so the per-step trajectory "
-             "and CSV output are identical to 1 (which disables fusion)")
+             "and CSV output are identical to 1 (which disables fusion). "
+             "Each distinct residual window (when a milestone delta is not a "
+             "multiple of this) compiles a separate program — pick a divisor "
+             "of the evaluation/checkpoint deltas to avoid extra compiles")
     add("--mesh", type=str, default=None,
         help="Multi-chip (workers, model) mesh: 'auto' (all devices on the "
              "worker axis), 'W' or 'WxM' (e.g. '4x2' = 4-way worker data "
@@ -393,6 +400,7 @@ def main(argv=None):
             nesterov=args.momentum_nesterov, momentum_at=args.momentum_at,
             weight_decay=args.weight_decay, gradient_clip=args.gradient_clip,
             nb_local_steps=args.nb_local_steps,
+            gars_per_call=args.gars_per_call,
             dtype=args.dtype, compute_dtype=args.compute_dtype)
         from byzantinemomentum_tpu import optim
         optimizer = optim.build(args.optimizer,
@@ -527,13 +535,25 @@ def main(argv=None):
     # Compile the (possibly mesh-sharded) step programs
     if mesh is not None:
         from byzantinemomentum_tpu.parallel import (
-            sharded_train_multi, sharded_train_step)
+            sharded_eval_many, sharded_train_multi, sharded_train_step)
         step_fn = sharded_train_step(engine, mesh, state)
         multi_fn = sharded_train_multi(engine, mesh, state)
+        # Milestone evaluation shards only when the test batch divides the
+        # worker axis; otherwise it stays on the (off-hot-path) replicated
+        # program instead of failing at the first milestone
+        if args.batch_size_test % mesh.shape["workers"] == 0:
+            eval_many_fn = sharded_eval_many(engine, mesh, state)
+        else:
+            eval_many_fn = engine.eval_many
+            utils.info(
+                f"Evaluation stays unsharded: --batch-size-test "
+                f"{args.batch_size_test} does not divide the "
+                f"{mesh.shape['workers']}-way worker axis")
         utils.info(f"Sharded over mesh {dict(mesh.shape)}")
     else:
         step_fn = engine.train_step
         multi_fn = engine.train_multi
+        eval_many_fn = engine.eval_many
 
     # Opt-in profiler trace of the early steps (TPU counterpart of the
     # reference's opt-in timing scopes, reference `tools/misc.py:307-343`)
@@ -581,7 +601,7 @@ def main(argv=None):
                         jnp.asarray(idx), jnp.asarray(flips))
                 else:
                     bxs, bys = zip(*(testset.sample() for _ in range(reps)))
-                    res = engine.eval_many(
+                    res = eval_many_fn(
                         state.theta, state.net_state,
                         jnp.asarray(np.stack(bxs)), jnp.asarray(np.stack(bys)))
                 acc = float(res[0]) / float(res[1])

@@ -29,13 +29,16 @@ from byzantinemomentum_tpu.parallel.ring import (
 )
 from byzantinemomentum_tpu.parallel.sharded import (
     pairwise_distances_sharded,
+    shard_defenses,
     shard_gar,
+    sharded_eval_many,
     sharded_state_spec,
     sharded_train_multi,
     sharded_train_step,
 )
 
 __all__ = ["make_mesh", "mesh_axes", "pairwise_distances_sharded",
-           "shard_gar", "sharded_state_spec", "sharded_train_step",
+           "shard_defenses", "shard_gar", "sharded_eval_many",
+           "sharded_state_spec", "sharded_train_step",
            "sharded_train_multi",
            "dense_attention", "ring_attention", "ulysses_attention"]

@@ -11,10 +11,17 @@ by hand:
   its partial Gram matrix on the MXU and a single `psum` of the tiny (n, n)
   result crosses ICI (instead of all-gathering the (n, d) matrix).
 * `shard_gar` — coordinate-wise GARs (median/trmean/phocas/meamed/average)
-  run on each chip's d-slice with NO communication at all; selection-based
-  GARs (krum) reuse the psum distances, then every chip applies the
-  (replicated, tiny) selection to its local slice.
+  run on each chip's d-slice with NO communication at all (Pallas sorting
+  networks stay alive per shard via `pallas_sort.allowed()`);
+  selection-based GARs (krum/bulyan/brute) reuse the psum distances, then
+  every chip applies the (replicated, tiny) selection to its local slice.
+
+The sharded training step swaps the engine's defenses for these kernels at
+trace time (`shard_defenses`), so `--mesh` runs take the explicit
+distributed path for every registered GAR the kernels cover.
 """
+
+import contextlib
 
 import jax
 import jax.numpy as jnp
@@ -22,10 +29,12 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from byzantinemomentum_tpu.engine.state import TrainState
+from byzantinemomentum_tpu.ops import pallas_sort
 from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS
 
-__all__ = ["pairwise_distances_sharded", "shard_gar", "sharded_state_spec",
-           "sharded_train_step", "sharded_train_multi", "COORDINATE_WISE"]
+__all__ = ["pairwise_distances_sharded", "shard_defenses", "shard_gar",
+           "sharded_eval_many", "sharded_state_spec", "sharded_train_step",
+           "sharded_train_multi", "COORDINATE_WISE"]
 
 # GARs that act independently per coordinate: they shard over `d` with zero
 # communication (SURVEY.md §5.7: "coordinate-wise GARs shard trivially over
@@ -54,7 +63,12 @@ def _psum_pairwise(g_local):
     (Single source of truth — the semantics must match
     `ops._common.pairwise_distances`.)"""
     sq = jax.lax.psum(jnp.sum(g_local * g_local, axis=1), MODEL)
-    gram = jax.lax.psum(g_local @ g_local.T, MODEL)
+    # precision=HIGHEST as in `ops._common.pairwise_distances`: TPU matmuls
+    # default to bf16-decomposed passes, and these distances feed selection
+    # orderings that must match the single-device path
+    gram = jax.lax.psum(
+        jnp.matmul(g_local, g_local.T, precision=jax.lax.Precision.HIGHEST),
+        MODEL)
     d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
     d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
     n = g_local.shape[0]
@@ -65,27 +79,80 @@ def _psum_pairwise(g_local):
 def shard_gar(gar, mesh, *, f, **kwargs):
     """Wrap a registered GAR into a d-sharded callable `(G) -> f32[d]`.
 
-    Coordinate-wise rules run shard-locally. Krum-family rules compute the
-    psum'd distance matrix, derive the (replicated) selection, and average
-    the selected rows locally per shard.
+    Coordinate-wise rules run shard-locally. Selection-based rules
+    (krum/bulyan/brute) compute the psum'd distance matrix, derive the
+    (replicated, tiny) selection, and apply it to the local d-slice — the
+    (n, d) matrix itself never crosses ICI.
+
+    Every shard-local body runs under `pallas_sort.allowed()`: operands
+    inside `shard_map` are manual per-device shards, so the Pallas sorting
+    networks are legal here even while the surrounding multi-device trace
+    holds `pallas_sort.disabled()`.
     """
     if gar.name in COORDINATE_WISE:
         def kernel(g_local):
-            return gar.unchecked(g_local, f=f, **kwargs)
-        return shard_map(kernel, mesh=mesh,
-                         in_specs=P(None, MODEL), out_specs=P(MODEL))
+            with pallas_sort.allowed():
+                return gar.unchecked(g_local, f=f, **kwargs)
+        # check_vma=False: the Pallas out_shapes inside carry no
+        # varying-mesh-axes annotation
+        return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                         out_specs=P(MODEL), check_vma=False)
 
     if gar.name in ("krum", "native-krum"):
+        from byzantinemomentum_tpu.ops import _common, krum as krum_mod
+
         def kernel(g_local):
+            # Global distances via one psum'd Gram; the (replicated) weight
+            # vector then averages the local d-slice — single source of
+            # truth for selection in `ops/krum.py:selection_weights`.
+            # Non-finite propagation is per coordinate, hence d-local.
+            dist = _psum_pairwise(g_local)
+            w = krum_mod.selection_weights(
+                dist, f, kwargs.get("m")).astype(g_local.dtype)
+            return _common.weighted_rows_mean(w, g_local)
+
+        return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                         out_specs=P(MODEL))
+
+    if gar.name in ("bulyan", "native-bulyan"):
+        from byzantinemomentum_tpu.ops import _common, bulyan as bulyan_mod
+
+        def kernel(g_local):
+            # Stage 1 (reference `aggregators/bulyan.py:63-76`): global
+            # distances via one psum'd Gram, replicated score-scan selection
+            # (`ops/bulyan.py:selection_weights`), then one d-local
+            # (rounds, n) @ (n, d_shard) matmul
+            dist = _psum_pairwise(g_local)
+            W = bulyan_mod.selection_weights(dist, f, kwargs.get("m"))
+            sel = _common.weighted_rows_mean(
+                W.astype(g_local.dtype), g_local)
+            # Stage 2 (reference `bulyan.py:77-84`): coordinate-wise averaged
+            # median — d-local, Pallas-fused where supported
+            m2 = sel.shape[0] - 2 * f
+            with pallas_sort.allowed():
+                return _common.closest_mean(sel, _common.lower_median(sel),
+                                            m2)
+
+        # check_vma=False: the Pallas out_shapes inside carry no
+        # varying-mesh-axes annotation
+        return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                         out_specs=P(MODEL), check_vma=False)
+
+    if gar.name in ("brute", "native-brute"):
+        from byzantinemomentum_tpu.ops import brute as brute_mod
+
+        def kernel(g_local):
+            # Streaming subset enumeration runs on the replicated psum'd
+            # (n, n) distances (reference `aggregators/brute.py:32-68`);
+            # only the masked mean touches the local d-slice
             n = g_local.shape[0]
             dist = _psum_pairwise(g_local)
-            scores = jnp.sum(jnp.sort(dist, axis=1)[:, :n - f - 1], axis=1)
-            m = kwargs.get("m") or n - f - 2
-            sel = jnp.argsort(scores, stable=True)[:m]
-            return jnp.mean(g_local[sel], axis=0)
+            mask = brute_mod.best_subset_mask_from_dist(dist, f)
+            kept = jnp.where(mask[:, None], g_local, 0)
+            return jnp.sum(kept, axis=0) / (n - f)
 
-        return shard_map(kernel, mesh=mesh,
-                         in_specs=P(None, MODEL), out_specs=P(MODEL))
+        return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                         out_specs=P(MODEL))
 
     # Fallback: replicate (correct for any GAR; no d-sharding win)
     def kernel_replicated(g):
@@ -116,15 +183,64 @@ def sharded_state_spec(state):
     )
 
 
-def _sharded_step_builder(step_fn, mesh, state_example, batch_spec):
+class _ShardedGar:
+    """Engine-facing facade over a `shard_gar` kernel.
+
+    `.unchecked` ignores the call-site f/kwargs (already bound into the
+    kernel) and pads the d axis up to a multiple of the model-axis size —
+    zero columns leave every distance, score and coordinate-wise reduction
+    of the real columns unchanged, and are sliced back off. Selection
+    metadata (`influence`) stays on the original GAR object.
+    """
+
+    def __init__(self, inner, fn, axis_size):
+        self.name = inner.name
+        self.influence = inner.influence
+        self._fn = fn
+        self._axis_size = axis_size
+
+    def unchecked(self, gradients, **_kwargs):
+        d = gradients.shape[1]
+        pad = (-d) % self._axis_size
+        if pad:
+            gradients = jnp.pad(gradients, ((0, 0), (0, pad)))
+        out = self._fn(gradients)
+        return out[:d] if pad else out
+
+
+def shard_defenses(engine, mesh):
+    """The engine's defense list with every GAR rebuilt as an explicit
+    d-sharded `shard_gar` kernel (krum/bulyan/brute ride the psum'd Gram;
+    coordinate-wise rules keep their Pallas kernels per shard)."""
+    axis_size = mesh.shape[MODEL]
+    return [
+        (_ShardedGar(gar,
+                     shard_gar(gar, mesh, f=engine.cfg.nb_decl_byz, **kw),
+                     axis_size), fc, kw)
+        for gar, fc, kw in engine.defenses
+    ]
+
+
+@contextlib.contextmanager
+def _defenses_overridden(engine, defenses):
+    saved = engine.defenses
+    engine.defenses = defenses
+    try:
+        yield
+    finally:
+        engine.defenses = saved
+
+
+def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
+                          engine=None):
     """Shared sharding setup for the single- and multi-step programs.
 
     The traced function is wrapped in `pallas_sort.disabled()`: Mosaic
-    kernels cannot be auto-partitioned by the jit sharding propagator, so a
-    multi-device trace must take the coordinate-wise GARs' jnp fallbacks.
+    kernels cannot be auto-partitioned by the jit sharding propagator. The
+    defense calls are the exception — when `engine` is given they are
+    swapped for explicit `shard_gar` kernels, whose `shard_map` bodies are
+    manual partitions where Pallas is legal again (`pallas_sort.allowed()`).
     """
-    from byzantinemomentum_tpu.ops import pallas_sort
-
     spec = sharded_state_spec(state_example)
     state_shardings = jax.tree.map(
         lambda p: NamedSharding(mesh, p), spec,
@@ -132,8 +248,12 @@ def _sharded_step_builder(step_fn, mesh, state_example, batch_spec):
     batch_sharding = NamedSharding(mesh, batch_spec)
     lr_sharding = NamedSharding(mesh, P())
 
+    wrapped = shard_defenses(engine, mesh) if engine is not None else None
+
     def traced(*args):
-        with pallas_sort.disabled():
+        ctx = (_defenses_overridden(engine, wrapped) if wrapped is not None
+               else contextlib.nullcontext())
+        with ctx, pallas_sort.disabled():
             return step_fn(*args)
 
     return jax.jit(
@@ -149,15 +269,45 @@ def sharded_train_step(engine, mesh, state_example):
 
     Batches shard along "workers" (each chip computes its workers' gradients
     — the reference's sequential honest phase, now data-parallel across
-    chips); parameters and momentum shard along "model". XLA inserts the
-    all-gather of gradient rows feeding the GAR and the collectives for the
-    d-sharded update.
+    chips); parameters and momentum shard along "model". The GAR runs as an
+    explicit `shard_gar` kernel (psum'd Gram for selection rules, shard-local
+    Pallas for coordinate-wise rules); XLA inserts the all-gather of gradient
+    rows feeding it and the collectives for the d-sharded update.
 
     Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
     `engine.train_step`.
     """
     return _sharded_step_builder(engine._train_step, mesh, state_example,
-                                 P(WORKERS))
+                                 P(WORKERS), engine=engine)
+
+
+def sharded_eval_many(engine, mesh, state_example):
+    """Milestone evaluation over the mesh: test batches shard along
+    "workers" on their batch axis (each chip scores its slice of every
+    rep; the tiny `[#correct, #samples]` accumulator is psum'd by XLA), and
+    theta stays in its d-sharded layout instead of gathering onto one
+    device. Drop-in for `engine.eval_many`.
+    """
+    spec = sharded_state_spec(state_example)
+    theta_sh = NamedSharding(mesh, P(MODEL))
+    ns_sh = jax.tree.map(lambda p: NamedSharding(mesh, p), spec.net_state,
+                         is_leaf=lambda x: isinstance(x, P))
+    batch_sh = NamedSharding(mesh, P(None, WORKERS))
+    jitted = jax.jit(
+        engine._eval_many,
+        in_shardings=(theta_sh, ns_sh, batch_sh, batch_sh),
+        out_shardings=NamedSharding(mesh, P()))
+    workers_ax = mesh.shape[WORKERS]
+
+    def call(theta, net_state, xs, ys):
+        if xs.shape[1] % workers_ax:
+            raise ValueError(
+                f"Sharded evaluation requires the test batch size "
+                f"({xs.shape[1]}) to divide evenly over the {workers_ax}-way "
+                f"worker axis; use engine.eval_many instead")
+        return jitted(theta, net_state, xs, ys)
+
+    return call
 
 
 def sharded_train_multi(engine, mesh, state_example):
@@ -168,4 +318,4 @@ def sharded_train_multi(engine, mesh, state_example):
     Returns `step(state, xs, ys, lrs) -> (state, stacked metrics)`.
     """
     return _sharded_step_builder(engine._train_multi, mesh, state_example,
-                                 P(None, WORKERS))
+                                 P(None, WORKERS), engine=engine)

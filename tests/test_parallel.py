@@ -55,11 +55,11 @@ def test_pairwise_distances_sharded_matches_local(mesh1d):
 
 @pytest.mark.parametrize("name,kwargs", [
     ("median", {}), ("trmean", {}), ("phocas", {}), ("meamed", {}),
-    ("average", {}), ("krum", {}),
+    ("average", {}), ("krum", {}), ("bulyan", {}), ("brute", {}),
 ])
 def test_shard_gar_matches_single_device(mesh1d, name, kwargs):
     rng = np.random.default_rng(1)
-    n, f, d = 9, 2, 96  # d divisible by 8 shards
+    n, f, d = 11, 2, 96  # d divisible by 8 shards; bulyan needs n >= 4f+3
     g = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
     gar = ops.gars[name]
     expected = gar.unchecked(g, f=f, **kwargs)
@@ -67,6 +67,57 @@ def test_shard_gar_matches_single_device(mesh1d, name, kwargs):
     got = sharded(g)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["median", "krum", "bulyan", "brute"])
+def test_shard_gar_nan_rows_match_single_device(mesh1d, name):
+    """f NaN rows: the d-sharded kernels reproduce the single-device result
+    (the psum'd distances carry the +inf convention across shards)."""
+    rng = np.random.default_rng(4)
+    n, f, d = 11, 2, 96
+    g = rng.normal(size=(n, d)).astype(np.float32)
+    g[-f:] = np.nan
+    g = jnp.asarray(g)
+    gar = ops.gars[name]
+    expected = gar.unchecked(g, f=f)
+    got = shard_gar(gar, mesh1d, f=f)(g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["median", "trmean", "bulyan"])
+def test_shard_gar_pallas_engaged_matches(mesh1d, name, monkeypatch):
+    """With `BMT_PALLAS_INTERPRET=1` the shard-local bodies run the REAL
+    Pallas sorting-network kernels (interpret mode off-TPU) inside
+    `shard_map` — `pallas_sort.allowed()` must re-enable them even while the
+    surrounding trace holds `disabled()` — and match the jnp result."""
+    from byzantinemomentum_tpu.ops import pallas_sort
+    rng = np.random.default_rng(5)
+    n, f, d = 11, 2, 96
+    g = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    gar = ops.gars[name]
+    expected = gar.unchecked(g, f=f)  # jnp path (no env var yet)
+    monkeypatch.setenv("BMT_PALLAS_INTERPRET", "1")
+    with pallas_sort.disabled():  # what the sharded step trace holds
+        got = shard_gar(gar, mesh1d, f=f)(g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_shard_gar_pads_indivisible_d(mesh1d):
+    """The engine-facing facade pads d up to the model-axis size and slices
+    back — results match on a d that does NOT divide the 8 shards."""
+    from byzantinemomentum_tpu.parallel.sharded import _ShardedGar
+    rng = np.random.default_rng(6)
+    n, f, d = 11, 2, 83  # prime-ish, not divisible by 8
+    g = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    for name in ("median", "krum", "bulyan"):
+        gar = ops.gars[name]
+        facade = _ShardedGar(gar, shard_gar(gar, mesh1d, f=f), 8)
+        got = facade.unchecked(g, f=f)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(gar.unchecked(g, f=f)),
+            rtol=1e-4, atol=1e-5, err_msg=name)
 
 
 def test_sharded_train_step_executes(mesh2d):
@@ -110,6 +161,66 @@ def test_sharded_step_matches_unsharded():
 
     np.testing.assert_allclose(np.asarray(s1.theta), np.asarray(s2.theta),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_step_matches_unsharded_bulyan():
+    """The explicit distributed bulyan kernel inside the sharded step (the
+    headline GAR) matches the single-device trajectory."""
+    cfg = EngineConfig(nb_workers=12, nb_decl_byz=2, nb_real_byz=0,
+                       nb_for_study=0, momentum=0.9, momentum_at="update")
+    engine = build_engine(
+        cfg=cfg, model_def=models.build("simples-full"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["bulyan"], 1.0, {})])
+    rng = np.random.default_rng(8)
+    xs = jnp.asarray(rng.normal(size=(12, 4, 28, 28, 1)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(12, 4)).astype(np.int32))
+
+    s1 = engine.init(jax.random.PRNGKey(5))
+    s1, _ = engine.train_step(s1, xs, ys, jnp.float32(0.1))
+
+    mesh = make_mesh(8, model_parallel=2)
+    s2 = engine.init(jax.random.PRNGKey(5))
+    step = sharded_train_step(engine, mesh, s2)
+    s2, _ = step(s2, xs, ys, jnp.float32(0.1))
+
+    np.testing.assert_allclose(np.asarray(s1.theta), np.asarray(s2.theta),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_eval_matches_unsharded(mesh2d):
+    """`sharded_eval_many` (batches sharded along "workers", theta d-sharded)
+    returns exactly the unsharded criterion sums."""
+    from byzantinemomentum_tpu.parallel import sharded_eval_many
+    cfg = EngineConfig(nb_workers=8, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=0)
+    engine = build_engine(
+        cfg=cfg, model_def=models.build("simples-full"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["median"], 1.0, {})])
+    state = engine.init(jax.random.PRNGKey(1))
+    rng = np.random.default_rng(9)
+    xs = jnp.asarray(rng.normal(size=(3, 16, 28, 28, 1)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(3, 16)).astype(np.int32))
+    want = np.asarray(engine.eval_many(state.theta, state.net_state, xs, ys))
+    got = np.asarray(sharded_eval_many(engine, mesh2d, state)(
+        state.theta, state.net_state, xs, ys))
+    np.testing.assert_allclose(got, want)
+
+
+def test_cli_mesh_indivisible_test_batch_falls_back(tmp_path):
+    """`--batch-size-test` not dividing the worker axis must not crash the
+    run at the first milestone — eval falls back to the replicated program
+    (the train-side divisibility check does not cover the eval batch)."""
+    resdir = tmp_path / "m"
+    rc = attack_main(["--nb-steps", "2", "--batch-size", "8",
+               "--batch-size-test", "100", "--batch-size-test-reps", "1",
+               "--evaluation-delta", "2", "--model", "simples-full",
+               "--seed", "3", "--gar", "median", "--nb-workers", "8",
+               "--nb-decl-byz", "2", "--mesh", "4x2", "--nb-for-study", "8",
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    assert (resdir / "eval").is_file()
 
 
 def test_graft_entry_and_dryrun():
@@ -161,8 +272,9 @@ def test_cli_mesh_flag_rejects_nonpositive():
 
 
 def test_cli_mesh_with_coordinatewise_gar(tmp_path):
-    """Coordinate-wise GARs under --mesh trace the jnp fallback (Mosaic
-    kernels cannot be auto-partitioned); the run must complete."""
+    """Coordinate-wise GARs under --mesh run as shard-local `shard_gar`
+    kernels (Pallas-capable on TPU; jnp bodies on the CPU test mesh); the
+    run must complete."""
     resdir = tmp_path / "m"
     rc = attack_main(["--nb-steps", "2", "--batch-size", "8",
                "--batch-size-test", "32", "--batch-size-test-reps", "1",
