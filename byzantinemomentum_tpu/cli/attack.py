@@ -45,9 +45,11 @@ def process_commandline(argv=None):
     add("--device", type=str, default="auto",
         help="JAX device/platform to run on ('auto', 'tpu', 'cpu', ...)")
     add("--device-gar", type=str, default="same",
-        help="Device on which to run the GAR, 'same' for no change (on TPU "
-             "the GAR fuses into the training program; this seam is kept "
-             "for config parity)")
+        help="Device/platform on which to run the defense phase (attack + "
+             "GAR), 'same' to fuse it into the training program (the fast "
+             "default). E.g. 'cpu': the honest gradients hop to the CPU "
+             "every step and the defense gradient hops back — the "
+             "reference's heterogeneous placement")
     add("--dtype", type=str, default="float32",
         help="Parameter/gradient dtype: float32, bfloat16, float16, float64 "
              "(the reference Configuration's dtype, configuration.py:26-101)")
@@ -352,10 +354,21 @@ def main(argv=None):
         if jnp.float64 in (DTYPES[args.dtype],
                            DTYPES[args.compute_dtype or args.dtype]):
             jax.config.update("jax_enable_x64", True)
-        if args.device_gar.lower() != "same":
-            utils.warning(
-                "'--device-gar' is kept for config parity only: on TPU the "
-                "GAR fuses into the training program (no device hop)")
+        device_gar = (args.device_gar or "same").lower()
+        device_gar_active = device_gar not in ("same", "")
+        if device_gar_active:
+            if args.mesh is not None:
+                utils.fatal("'--device-gar' and '--mesh' are mutually "
+                            "exclusive (a mesh shards the fused step)")
+            try:
+                jax.devices(device_gar)
+            except RuntimeError as err:
+                utils.fatal(
+                    f"Invalid '--device-gar {args.device_gar}': {err}")
+            if args.steps_per_program > 1:
+                utils.info("'--device-gar' hops devices every step; "
+                           "multi-step fusion disabled")
+                args.steps_per_program = 1
         # Seeding (reference `attack.py:453-459`; JAX PRNG is explicit)
         reproducible = args.seed >= 0
         seed = args.seed if reproducible else int.from_bytes(os.urandom(4), "little")
@@ -441,7 +454,9 @@ def main(argv=None):
         # boundary (see `data/device.py`). Under a mesh the batches are
         # host-staged instead so they shard along the worker axis.
         from byzantinemomentum_tpu.data.device import DeviceData
-        use_device_data = (mesh is None
+        # The indexed fast path bypasses `step_fn`, so it is incompatible
+        # with heterogeneous GAR placement (and with a mesh, see above)
+        use_device_data = (mesh is None and not device_gar_active
                            and DeviceData.supports(trainset)
                            and DeviceData.supports(testset))
         if use_device_data:
@@ -550,6 +565,13 @@ def main(argv=None):
                 f"{args.batch_size_test} does not divide the "
                 f"{mesh.shape['workers']}-way worker axis")
         utils.info(f"Sharded over mesh {dict(mesh.shape)}")
+    elif device_gar_active:
+        from byzantinemomentum_tpu.engine.step import make_device_gar_step
+        step_fn = make_device_gar_step(engine, device_gar)
+        multi_fn = engine.train_multi  # unreachable: fusion forced to 1
+        eval_many_fn = engine.eval_many
+        utils.info(f"Defense phase placed on '{device_gar}' "
+                   f"(per-step gradient hop)")
     else:
         step_fn = engine.train_step
         multi_fn = engine.train_multi

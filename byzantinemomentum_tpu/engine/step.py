@@ -328,8 +328,12 @@ class Engine:
     # ----------------------------------------------------------------- #
     # The step
 
-    def _train_step(self, state: TrainState, xs, ys, lr):
-        """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
+    def _phase_honest(self, state: TrainState, xs, ys, lr):
+        """Honest phase + momentum placement on honest rows: everything up
+        to (and excluding) the attack (reference `attack.py:752-810`).
+        Split out so `--device-gar` can run the defense phase on another
+        device (`make_device_gar_step`); the fused `_train_step` inlines all
+        three phases into one program."""
         cfg = self.cfg
         S, h = cfg.nb_sampled, cfg.nb_honests
         mu, damp = cfg.momentum, cfg.dampening
@@ -339,7 +343,6 @@ class Engine:
 
         rng, mix_key, *wkeys = jax.random.split(state.rng, S + 2)
         wkeys = jnp.stack(wkeys)
-        mix_u = jax.random.uniform(mix_key)
 
         # --- honest phase (vmapped; reference `attack.py:752-795`) --- #
         if cfg.nesterov:
@@ -382,7 +385,15 @@ class Engine:
             new_mw = state.momentum_workers
             G_honest = G_sampled[:h]
 
-        # --- attack phase (`attack.py:818`) --- #
+        return (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
+                G_honest)
+
+    def _phase_defense(self, G_honest, mix_key):
+        """Attack synthesis + aggregation + influence (reference
+        `attack.py:818-822`). Pure in (G_honest, mix_key) given the static
+        config, so it compiles for whatever device its inputs live on."""
+        cfg = self.cfg
+        mix_u = jax.random.uniform(mix_key)
         per_call = cfg.gars_per_call and len(self.defenses) > 1
 
         def defense_fn(gradients, f):
@@ -400,7 +411,6 @@ class Engine:
         else:
             G_attack = jnp.zeros((0, self.d), G_honest.dtype)
 
-        # --- defense phase (`attack.py:821-822`) --- #
         G_all = jnp.concatenate([G_honest, G_attack])
         if per_call:
             # The outer aggregation and the influence each re-draw too, as
@@ -412,6 +422,26 @@ class Engine:
             infl_u = mix_u
         grad_defense = self._run_defense(G_all, mix_u).astype(G_honest.dtype)
         accept_ratio = self._run_influence(G_honest, G_attack, infl_u)
+        return G_attack, grad_defense, accept_ratio
+
+    def _train_step(self, state: TrainState, xs, ys, lr):
+        """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
+        (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
+         G_honest) = self._phase_honest(state, xs, ys, lr)
+        G_attack, grad_defense, accept_ratio = self._phase_defense(
+            G_honest, mix_key)
+        return self._phase_update(
+            state, rng, G_sampled, loss_avg, net_state, new_mw, G_honest,
+            G_attack, grad_defense, accept_ratio, lr, self._batch_of(xs))
+
+    def _phase_update(self, state, rng, G_sampled, loss_avg, net_state,
+                      new_mw, G_honest, G_attack, grad_defense, accept_ratio,
+                      lr, batch):
+        """Model update + study metrics (reference `attack.py:832-878`)."""
+        cfg = self.cfg
+        h = cfg.nb_honests
+        mu, damp = cfg.momentum, cfg.dampening
+        lr = jnp.asarray(lr).astype(state.theta.dtype)
 
         # --- model update (`attack.py:832-839`) --- #
         if cfg.momentum_at == "worker":
@@ -451,8 +481,7 @@ class Engine:
             origin=state.origin,
             past_grads=pg, past_norms=pn, past_count=pc,
             steps=state.steps + 1,
-            datapoints=state.datapoints
-            + self._batch_of(xs) * h * cfg.nb_local_steps,
+            datapoints=state.datapoints + batch * h * cfg.nb_local_steps,
             rng=rng,
         )
         return new_state, metrics
@@ -498,6 +527,57 @@ class Engine:
             return acc + self._eval_step(theta, net_state, x, y), None
         acc, _ = lax.scan(body, jnp.zeros((2,), jnp.float32), (idx, flips))
         return acc
+
+
+def make_device_gar_step(engine, gar_device):
+    """Heterogeneous GAR placement — the reference's `--device-gar`
+    (reference `attack.py:461-465`, `:811-827`): the defense phase (attack
+    synthesis + aggregation + influence) runs on a different device, with
+    the honest gradient matrix hopping there and the Byzantine rows +
+    defense gradient hopping back EVERY step — three separately-compiled
+    programs instead of one fused one.
+
+    The whole defense phase hops, so an adaptive attack's line search runs
+    entirely on the GAR device (the reference instead moved the stack on
+    every inner defense call, `attack.py:505-510` — one hop per step is the
+    faithful-but-not-pathological placement; the arithmetic is identical).
+
+    Note: this path uses plain cross-device `device_put` transfers, NOT host
+    callbacks, so it works on backends without send/recv callback support.
+
+    Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
+    `engine.train_step`.
+    """
+    from byzantinemomentum_tpu.ops import pallas_sort
+
+    dev = jax.devices(gar_device)[0]
+    pre = jax.jit(engine._phase_honest)
+    post = jax.jit(engine._phase_update, static_argnums=(11,))
+
+    def mid_traced(G_honest, mix_key):
+        if dev.platform != "tpu":
+            # The GAR device cannot run Mosaic kernels
+            with pallas_sort.disabled():
+                return engine._phase_defense(G_honest, mix_key)
+        return engine._phase_defense(G_honest, mix_key)
+
+    mid = jax.jit(mid_traced)
+
+    def step(state, xs, ys, lr):
+        (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
+         G_honest) = pre(state, xs, ys, lr)
+        main_dev = list(G_honest.devices())[0]
+        # --- the hop (reference `attack.py:811-815`) --- #
+        out = mid(jax.device_put(G_honest, dev),
+                  jax.device_put(mix_key, dev))
+        G_attack, grad_defense, accept_ratio = jax.device_put(out, main_dev)
+        batch = (xs.shape[2] if engine.cfg.nb_local_steps > 1
+                 else xs.shape[1])
+        return post(state, rng, G_sampled, loss_avg, net_state, new_mw,
+                    G_honest, G_attack, grad_defense, accept_ratio, lr,
+                    batch)
+
+    return step
 
 
 def build_engine(*, cfg, model_def, loss, criterion, defenses, attack=None,

@@ -232,3 +232,23 @@ def test_bulyan_attack_adaptive_via_cli(tmp_path):
     rows = [l for l in (resdir / "study").read_text().split(os.linesep)[1:] if l]
     defense_idx = STUDY_COLUMNS.index("Defense gradient norm")
     assert all(np.isfinite(float(r.split("\t")[defense_idx])) for r in rows)
+
+
+def test_device_gar_cpu_matches_fused(tmp_path):
+    """`--device-gar cpu` (reference heterogeneous placement,
+    `attack.py:811-827`): the defense phase runs as a separate program on
+    the GAR device with per-step gradient hops — and the trajectory matches
+    the fused path exactly, including through an adaptive line search."""
+    out = {}
+    for name, extra in (("fused", []), ("hop", ["--device-gar", "cpu"])):
+        resdir = tmp_path / name
+        rc = main(BASE + ["--gar", "median", "--attack", "empire",
+                          "--attack-args", "factor:-8",
+                          "--nb-real-byz", "4", "--nb-for-study", "11",
+                          "--nb-for-study-past", "2",
+                          "--result-directory", str(resdir)])
+        assert rc == 0
+        out[name] = (resdir / "study").read_text(), \
+            (resdir / "eval").read_text()
+    assert out["hop"][0] == out["fused"][0]
+    assert out["hop"][1] == out["fused"][1]
