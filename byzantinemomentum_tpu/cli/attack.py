@@ -342,9 +342,17 @@ def main(argv=None):
         args = _postprocess(process_commandline(argv))
 
     with utils.Context("setup", "info"):
-        # Device selection: 'auto' = JAX default platform
+        # Device selection: 'auto' = JAX default platform. An explicit
+        # --device pins jax_platforms to that backend alone, which would
+        # make a different --device-gar platform unreachable — include it
+        # in the (priority-ordered) platform list so both backends load.
+        device_gar = (args.device_gar or "same").lower()
+        device_gar_active = device_gar not in ("same", "")
         if args.device.lower() not in ("auto", ""):
-            jax.config.update("jax_platforms", args.device.lower())
+            platforms = args.device.lower()
+            if device_gar_active and device_gar != platforms:
+                platforms = f"{platforms},{device_gar}"
+            jax.config.update("jax_platforms", platforms)
         # Dtype selection (reference `attack.py:461`, Configuration dtype)
         from byzantinemomentum_tpu.engine.config import DTYPES
         for name in (args.dtype, args.compute_dtype):
@@ -354,8 +362,6 @@ def main(argv=None):
         if jnp.float64 in (DTYPES[args.dtype],
                            DTYPES[args.compute_dtype or args.dtype]):
             jax.config.update("jax_enable_x64", True)
-        device_gar = (args.device_gar or "same").lower()
-        device_gar_active = device_gar not in ("same", "")
         if device_gar_active:
             if args.mesh is not None:
                 utils.fatal("'--device-gar' and '--mesh' are mutually "

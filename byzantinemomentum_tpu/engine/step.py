@@ -552,7 +552,10 @@ def make_device_gar_step(engine, gar_device):
 
     dev = jax.devices(gar_device)[0]
     pre = jax.jit(engine._phase_honest)
-    post = jax.jit(engine._phase_update, static_argnums=(11,))
+    # `state` is dead after the post call, so donate it as the fused
+    # train_step does — otherwise the hop path doubles peak state memory
+    post = jax.jit(engine._phase_update, static_argnums=(11,),
+                   donate_argnums=(0,))
 
     def mid_traced(G_honest, mix_key):
         if dev.platform != "tpu":
@@ -571,8 +574,7 @@ def make_device_gar_step(engine, gar_device):
         out = mid(jax.device_put(G_honest, dev),
                   jax.device_put(mix_key, dev))
         G_attack, grad_defense, accept_ratio = jax.device_put(out, main_dev)
-        batch = (xs.shape[2] if engine.cfg.nb_local_steps > 1
-                 else xs.shape[1])
+        batch = engine._batch_of(xs)
         return post(state, rng, G_sampled, loss_avg, net_state, new_mw,
                     G_honest, G_attack, grad_defense, accept_ratio, lr,
                     batch)
