@@ -84,6 +84,10 @@ def process_commandline(argv=None):
         help="Re-draw the --gars mixture GAR on every defense invocation "
              "(incl. inside adaptive attacks' line searches) — the "
              "reference's semantics; default draws once per step")
+    add("--no-grouped-workers", action="store_true",
+        help="Disable the merged-batch grouped honest phase (always use the "
+             "vmapped per-worker path, even for models that provide the "
+             "faster grouped execution)")
     add("--attack", type=str, default="nan", help="Attack to use")
     add("--attack-args", nargs="*", help="key:value args for the attack")
     add("--model", type=str, default="simples-conv", help="Model to train")
@@ -420,6 +424,7 @@ def main(argv=None):
             weight_decay=args.weight_decay, gradient_clip=args.gradient_clip,
             nb_local_steps=args.nb_local_steps,
             gars_per_call=args.gars_per_call,
+            grouped_workers=not args.no_grouped_workers,
             dtype=args.dtype, compute_dtype=args.compute_dtype)
         from byzantinemomentum_tpu import optim
         optimizer = optim.build(args.optimizer,

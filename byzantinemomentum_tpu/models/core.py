@@ -28,6 +28,8 @@ __all__ = [
     "batchnorm_init", "batchnorm_apply",
     "dropout_apply",
     "log_softmax",
+    "grouped_conv_apply", "grouped_dense_apply",
+    "grouped_batchnorm_apply", "grouped_dropout_apply",
     "inits", "apply_named_init",
 ]
 
@@ -72,11 +74,15 @@ def conv_apply(params, x, *, padding="VALID", stride=1):
 
 
 def max_pool(x, window=2, stride=None):
+    """Spatial max pool over axes (1, 2) of (B, H, W, ...channel axes) —
+    rank-agnostic so the worker-expanded (B, H, W, S, C) grouped layout
+    pools with the same call."""
     stride = window if stride is None else stride
+    tail = (1,) * (x.ndim - 3)
     return lax.reduce_window(
         x, -jnp.inf, lax.max,
-        window_dimensions=(1, window, window, 1),
-        window_strides=(1, stride, stride, 1),
+        window_dimensions=(1, window, window) + tail,
+        window_strides=(1, stride, stride) + tail,
         padding="VALID")
 
 
@@ -121,14 +127,126 @@ def batchnorm_apply(params, state, x, *, train):
 
 
 # --------------------------------------------------------------------------- #
+# Worker-grouped layers (merged-batch execution of S per-worker networks)
+#
+# The simulation computes S independent per-worker gradients per step
+# (reference `attack.py:786-795`). `jax.vmap` of the backward pass turns
+# every conv weight-gradient into a batch-grouped convolution wrapped in
+# XLA layout transposes — measurably slower than expressing the worker
+# axis as CHANNEL GROUPS up front. These helpers run all S workers in one
+# merged program: activations are worker-expanded `(B, H, W, S, C)` (the
+# worker axis next-to-minor, so BatchNorm/dropout parameters broadcast
+# naturally and no layout churn is introduced between layers), convolutions
+# view them merged `(B, H, W, S*C)` for one `feature_group_count=S` conv
+# (same FLOPs as a shared-weight conv over the S*B merged batch — groups
+# partition, they do not duplicate), dense layers are per-worker einsums,
+# and the per-worker weight gradients fall out of one backward pass with
+# respect to the stacked parameters. Numerics match the vmapped path
+# op-for-op (same batch-stat BatchNorm, same per-worker-key dropout draws).
+
+
+def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
+    """Per-worker convolution on a worker-expanded activation.
+
+    params_s: stacked conv params {"w": (S, kh, kw, cin, cout),
+    "b": (S, cout)}; x: (B, H, W, S, cin) — the worker axis lives
+    NEXT-TO-MINOR throughout the grouped network (so BatchNorm/dropout
+    broadcast naturally); only this helper views it merged as (B, H, W,
+    S*cin) for one `feature_group_count=S` convolution on the MXU, and
+    splits the result back — both reshapes are layout-preserving views.
+    Returns (B, H', W', S, cout).
+    """
+    S, kh, kw_, cin, cout = params_s["w"].shape
+    B, H, W = x.shape[0], x.shape[1], x.shape[2]
+    w = params_s["w"].transpose(1, 2, 3, 0, 4).reshape(kh, kw_, cin, S * cout)
+    stride = (stride, stride) if isinstance(stride, int) else stride
+    out = lax.conv_general_dilated(
+        x.reshape(B, H, W, S * cin), w, window_strides=stride,
+        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=S)
+    out = out.reshape(out.shape[:3] + (S, cout))
+    return out + params_s["b"]
+
+
+def grouped_dense_apply(params_s, x):
+    """Per-worker dense layer: params_s {"w": (S, din, dout),
+    "b": (S, dout)}; x: (B, S, din) -> (B, S, dout) (batched matmul over the
+    worker axis)."""
+    return jnp.einsum("bsi,sio->bso", x, params_s["w"]) + params_s["b"]
+
+
+def grouped_batchnorm_apply(params_s, state, x, *, train):
+    """Per-worker BatchNorm on a worker-expanded activation.
+
+    params_s: {"gamma", "beta"} each (S, C); state: the SHARED running stats
+    {"mean", "var"} each (C,) (every vmapped worker normalizes from the same
+    pre-step state — see `engine/step.py:compose_bn_updates`);
+    x: (..., S, C). Train mode computes each worker's batch statistics (the
+    moments over all leading axes — identical to the vmapped per-worker
+    `batchnorm_apply`) and returns `new_state` leaves of shape (S, C), the
+    per-worker running-stat updates the step composer expects.
+    """
+    axes = tuple(range(x.ndim - 2))
+    if train:
+        mean = jnp.mean(x, axis=axes)                          # (S, C)
+        var = jnp.mean(jnp.square(x - mean), axis=axes)        # biased
+        count = x.size // (x.shape[-1] * x.shape[-2])
+        unbiased = var * (count / max(count - 1, 1))
+        new_state = {
+            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
+            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    inv = lax.rsqrt(var + BN_EPS)
+    out = (x - mean) * inv * params_s["gamma"] + params_s["beta"]
+    return out, new_state
+
+
+def grouped_dropout_apply(rngs, x, rate, *, train, axis=-2):
+    """Per-worker dropout on a worker-expanded activation.
+
+    rngs: (S,) stacked per-worker keys; `axis` is the worker axis of `x`
+    (next-to-minor in the grouped convention, e.g. (B, H, W, S, C) or
+    (B, S, F)). Draws EXACTLY the masks the vmapped path draws — one
+    `_dropout_mask(key_s, shape-without-worker-axis)` per worker — so the
+    two execution paths produce identical trajectories.
+    """
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    ax = axis % x.ndim
+    per_shape = x.shape[:ax] + x.shape[ax + 1:]
+    masks = jax.vmap(lambda k: _dropout_mask(k, keep, per_shape))(rngs)
+    masks = jnp.moveaxis(masks, 0, ax)
+    return jnp.where(masks, x / keep, 0.0)
+
+
+# --------------------------------------------------------------------------- #
 # Dropout
+
+def _dropout_mask(rng, keep, shape):
+    """Bernoulli(keep) mask for dropout.
+
+    When `keep` is exactly representable on 8 bits (keep*256 integer — true
+    for the reference models' 0.25/0.5 rates), draw uint8 random bits and
+    threshold: identical distribution, 4x fewer random bits than the f32
+    uniform behind `jax.random.bernoulli`, measurably faster on TPU (mask
+    generation is a per-step cost on ~25M activations in the CIFAR bench).
+    """
+    t = keep * 256.0
+    if t == int(t) and 0 < t < 256:
+        return jax.random.bits(rng, shape, jnp.uint8) < jnp.uint8(int(t))
+    return jax.random.bernoulli(rng, keep, shape)
+
 
 def dropout_apply(rng, x, rate, *, train):
     """Inverted dropout (torch semantics: scale by 1/(1-p) at train time)."""
     if not train or rate <= 0.0:
         return x
     keep = 1.0 - rate
-    mask = jax.random.bernoulli(rng, keep, x.shape)
+    mask = _dropout_mask(rng, keep, x.shape)
     return jnp.where(mask, x / keep, 0.0)
 
 

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from byzantinemomentum_tpu.models import ModelDef, register
 from byzantinemomentum_tpu.models.core import (
     batchnorm_apply, batchnorm_init, conv_apply, conv_init, dense_apply,
-    dense_init, dropout_apply, log_softmax, max_pool)
+    dense_init, dropout_apply, grouped_batchnorm_apply, grouped_conv_apply,
+    grouped_dense_apply, grouped_dropout_apply, log_softmax, max_pool)
 
 __all__ = []
 
@@ -68,7 +69,48 @@ def make_cnn(cifar100=False, **kwargs):
         x = dense_apply(params["f2"], x)
         return log_softmax(x), new_state
 
-    return ModelDef("empire-cnn", init, apply, (32, 32, 3))
+    def apply_grouped(params_s, state, xs, train=False, rng=None):
+        """Merged-batch execution of all S per-worker forwards — same math
+        as `vmap(apply)` (incl. identical per-worker dropout draws and
+        batch-stat BN), with the worker axis carried as channel groups so
+        the per-worker conv weight gradients compile to clean grouped
+        convolutions instead of vmap's transposed batch-group ones."""
+        if train and rng is None:
+            raise ValueError("empire-cnn needs PRNG keys in train mode (dropout)")
+        S, B = xs.shape[0], xs.shape[1]
+        dks = (jax.vmap(lambda k: jax.random.split(k, 3))(rng)
+               if train else (None, None, None))
+        new_state = dict(state)
+        x = xs.transpose(1, 2, 3, 0, 4)  # worker-expanded (B, 32, 32, S, 3)
+        x = jax.nn.relu(grouped_conv_apply(params_s["c1"], x, padding="SAME"))
+        x, new_state["b1"] = grouped_batchnorm_apply(
+            params_s["b1"], state["b1"], x, train=train)
+        x = jax.nn.relu(grouped_conv_apply(params_s["c2"], x, padding="SAME"))
+        x, new_state["b2"] = grouped_batchnorm_apply(
+            params_s["b2"], state["b2"], x, train=train)
+        x = max_pool(x, 2)
+        x = grouped_dropout_apply(
+            dks[:, 0] if train else None, x, 0.25, train=train)
+        x = jax.nn.relu(grouped_conv_apply(params_s["c3"], x, padding="SAME"))
+        x, new_state["b3"] = grouped_batchnorm_apply(
+            params_s["b3"], state["b3"], x, train=train)
+        x = jax.nn.relu(grouped_conv_apply(params_s["c4"], x, padding="SAME"))
+        x, new_state["b4"] = grouped_batchnorm_apply(
+            params_s["b4"], state["b4"], x, train=train)
+        x = max_pool(x, 2)
+        x = grouped_dropout_apply(
+            dks[:, 1] if train else None, x, 0.25, train=train)
+        # (B, 8, 8, S, 128) -> per-worker flat (h, w, c) rows, matching the
+        # vmapped path's x.reshape(B, -1)
+        x = x.transpose(0, 3, 1, 2, 4).reshape(B, S, 8192)
+        x = jax.nn.relu(grouped_dense_apply(params_s["f1"], x))
+        x = grouped_dropout_apply(
+            dks[:, 2] if train else None, x, 0.25, train=train)
+        x = grouped_dense_apply(params_s["f2"], x)
+        return log_softmax(x).transpose(1, 0, 2), new_state
+
+    return ModelDef("empire-cnn", init, apply, (32, 32, 3),
+                    apply_grouped=apply_grouped)
 
 
 register("empire-cnn", make_cnn)

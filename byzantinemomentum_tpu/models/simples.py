@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.models import ModelDef, register
 from byzantinemomentum_tpu.models.core import (
-    conv_apply, conv_init, dense_apply, dense_init, log_softmax, max_pool)
+    conv_apply, conv_init, dense_apply, dense_init, grouped_conv_apply,
+    grouped_dense_apply, log_softmax, max_pool)
 
 __all__ = []
 
@@ -33,7 +34,17 @@ def make_full(**kwargs):
         x = jax.nn.relu(dense_apply(params["f1"], x))
         return log_softmax(dense_apply(params["f2"], x)), state
 
-    return ModelDef("simples-full", init, apply, (28, 28, 1))
+    def apply_grouped(params_s, state, xs, train=False, rng=None):
+        """All S per-worker MLPs as two batched einsums over the worker
+        axis (same math as `vmap(apply)`)."""
+        S, B = xs.shape[0], xs.shape[1]
+        x = jnp.moveaxis(xs, 0, 1).reshape(B, S, 28 * 28)
+        x = jax.nn.relu(grouped_dense_apply(params_s["f1"], x))
+        x = log_softmax(grouped_dense_apply(params_s["f2"], x))
+        return x.transpose(1, 0, 2), state
+
+    return ModelDef("simples-full", init, apply, (28, 28, 1),
+                    apply_grouped=apply_grouped)
 
 
 def make_conv(**kwargs):
@@ -56,7 +67,23 @@ def make_conv(**kwargs):
         x = jax.nn.relu(dense_apply(params["f1"], x))
         return log_softmax(dense_apply(params["f2"], x)), state
 
-    return ModelDef("simples-conv", init, apply, (28, 28, 1))
+    def apply_grouped(params_s, state, xs, train=False, rng=None):
+        """All S per-worker LeNets in one merged program: worker axis as
+        channel groups for the convs, batched einsums for the fcs."""
+        S, B = xs.shape[0], xs.shape[1]
+        x = xs.transpose(1, 2, 3, 0, 4)  # worker-expanded (B, 28, 28, S, 1)
+        x = jax.nn.relu(grouped_conv_apply(params_s["c1"], x, padding="VALID"))
+        x = max_pool(x, 2)
+        x = jax.nn.relu(grouped_conv_apply(params_s["c2"], x, padding="VALID"))
+        x = max_pool(x, 2)
+        # (B, 4, 4, S, 50) -> per-worker flat (h, w, c) rows
+        x = x.transpose(0, 3, 1, 2, 4).reshape(B, S, 800)
+        x = jax.nn.relu(grouped_dense_apply(params_s["f1"], x))
+        x = log_softmax(grouped_dense_apply(params_s["f2"], x))
+        return x.transpose(1, 0, 2), state
+
+    return ModelDef("simples-conv", init, apply, (28, 28, 1),
+                    apply_grouped=apply_grouped)
 
 
 def make_logit(din=68, dout=1, **kwargs):
@@ -67,7 +94,13 @@ def make_logit(din=68, dout=1, **kwargs):
         x = x.reshape((x.shape[0], din))
         return jax.nn.sigmoid(dense_apply(params["linear"], x)), state
 
-    return ModelDef("simples-logit", init, apply, (din,))
+    def apply_grouped(params_s, state, xs, train=False, rng=None):
+        x = jnp.moveaxis(xs, 0, 1).reshape(xs.shape[1], xs.shape[0], din)
+        out = jax.nn.sigmoid(grouped_dense_apply(params_s["linear"], x))
+        return out.transpose(1, 0, 2), state
+
+    return ModelDef("simples-logit", init, apply, (din,),
+                    apply_grouped=apply_grouped)
 
 
 def make_linear(din=68, dout=1, **kwargs):
@@ -78,7 +111,12 @@ def make_linear(din=68, dout=1, **kwargs):
         x = x.reshape((x.shape[0], din))
         return dense_apply(params["linear"], x), state
 
-    return ModelDef("simples-linear", init, apply, (din,))
+    def apply_grouped(params_s, state, xs, train=False, rng=None):
+        x = jnp.moveaxis(xs, 0, 1).reshape(xs.shape[1], xs.shape[0], din)
+        return grouped_dense_apply(params_s["linear"], x).transpose(1, 0, 2), state
+
+    return ModelDef("simples-linear", init, apply, (din,),
+                    apply_grouped=apply_grouped)
 
 
 register("simples-full", make_full)

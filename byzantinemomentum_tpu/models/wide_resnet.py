@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from byzantinemomentum_tpu.models import ModelDef, register
 from byzantinemomentum_tpu.models.core import (
     batchnorm_apply, batchnorm_init, conv_apply, conv_init, dense_apply,
-    dense_init, dropout_apply, log_softmax)
+    dense_init, dropout_apply, grouped_batchnorm_apply, grouped_conv_apply,
+    grouped_dense_apply, grouped_dropout_apply, log_softmax)
 
 __all__ = []
 
@@ -46,6 +47,26 @@ def _block_apply(params, state, x, stride, dropout_rate, train, rng):
     out, new_state["bn2"] = batchnorm_apply(params["bn2"], state["bn2"], out, train=train)
     out = jax.nn.relu(out)
     out = conv_apply(params["conv2"], out, padding="SAME")
+    return out + shortcut, new_state
+
+
+def _block_apply_grouped(params_s, state, x, stride, dropout_rate, train,
+                         rngs):
+    new_state = dict(state)
+    out, new_state["bn1"] = grouped_batchnorm_apply(
+        params_s["bn1"], state["bn1"], x, train=train)
+    out = jax.nn.relu(out)
+    shortcut = x
+    if "shortcut" in params_s:
+        shortcut = grouped_conv_apply(params_s["shortcut"], out,
+                                      padding="VALID", stride=stride)
+    out = grouped_conv_apply(params_s["conv1"], out, padding="SAME",
+                             stride=stride)
+    out = grouped_dropout_apply(rngs, out, dropout_rate, train=train)
+    out, new_state["bn2"] = grouped_batchnorm_apply(
+        params_s["bn2"], state["bn2"], out, train=train)
+    out = jax.nn.relu(out)
+    out = grouped_conv_apply(params_s["conv2"], out, padding="SAME")
     return out + shortcut, new_state
 
 
@@ -93,7 +114,37 @@ def make_wide_resnet(depth=28, widen_factor=10, dropout_rate=0.3, num_classes=10
         out = dense_apply(params["fc"], out)
         return log_softmax(out), new_state
 
-    return ModelDef("wide_resnet-Wide_ResNet", init, apply, (32, 32, 3))
+    def apply_grouped(params_s, state, xs, train=False, rng=None):
+        """All S per-worker WRNs in one merged program (worker axis as
+        channel groups) — same math as `vmap(apply)`, incl. identical
+        per-worker dropout draws and batch-stat BN."""
+        if train and rng is None:
+            raise ValueError("wide_resnet needs PRNG keys in train mode (dropout)")
+        S, B = xs.shape[0], xs.shape[1]
+        n_drop = 3 * n_blocks
+        dks = (jax.vmap(lambda k: jax.random.split(k, n_drop))(rng)
+               if train else None)
+        new_state = dict(state)
+        x = xs.transpose(1, 2, 3, 0, 4)  # worker-expanded (B, 32, 32, S, 3)
+        out = grouped_conv_apply(params_s["conv0"], x, padding="SAME")
+        ki = 0
+        for gi in range(3):
+            for bi in range(n_blocks):
+                stride = strides[gi] if bi == 0 else 1
+                name = f"g{gi}b{bi}"
+                out, new_state[name] = _block_apply_grouped(
+                    params_s[name], state[name], out, stride, dropout_rate,
+                    train, dks[:, ki] if train else None)
+                ki += 1
+        out, new_state["bn_out"] = grouped_batchnorm_apply(
+            params_s["bn_out"], state["bn_out"], out, train=train)
+        out = jax.nn.relu(out)
+        out = jnp.mean(out, axis=(1, 2))                 # (B, S, 64k)
+        out = grouped_dense_apply(params_s["fc"], out)
+        return log_softmax(out).transpose(1, 0, 2), new_state
+
+    return ModelDef("wide_resnet-Wide_ResNet", init, apply, (32, 32, 3),
+                    apply_grouped=apply_grouped)
 
 
 register("wide_resnet-Wide_ResNet", make_wide_resnet)

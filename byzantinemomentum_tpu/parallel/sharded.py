@@ -241,6 +241,10 @@ def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
     swapped for explicit `shard_gar` kernels, whose `shard_map` bodies are
     manual partitions where Pallas is legal again (`pallas_sort.allowed()`).
     """
+    # Function-level import: engine.step pulls in the model registry, whose
+    # transformer module imports this package (circular at module scope)
+    from byzantinemomentum_tpu.engine.step import grouped_disabled
+
     spec = sharded_state_spec(state_example)
     state_shardings = jax.tree.map(
         lambda p: NamedSharding(mesh, p), spec,
@@ -253,7 +257,10 @@ def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
     def traced(*args):
         ctx = (_defenses_overridden(engine, wrapped) if wrapped is not None
                else contextlib.nullcontext())
-        with ctx, pallas_sort.disabled():
+        # grouped_disabled: the merged-batch honest phase would carry the
+        # worker axis as channel groups, defeating the P(WORKERS) batch
+        # sharding this builder pins — the mesh path keeps the vmap form
+        with ctx, pallas_sort.disabled(), grouped_disabled():
             return step_fn(*args)
 
     return jax.jit(

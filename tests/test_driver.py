@@ -262,6 +262,7 @@ def test_device_gar_cpu_matches_fused(tmp_path):
         np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-6)
     erows = {k: [l.split("\t") for l in v[1].split(os.linesep)[1:] if l]
              for k, v in out.items()}
+    assert len(erows["hop"]) == len(erows["fused"]) > 0
     for rf, rh in zip(erows["fused"], erows["hop"]):
         assert rf[0] == rh[0]
         # 64 evaluation samples; tolerate a single borderline flip

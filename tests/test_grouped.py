@@ -1,0 +1,171 @@
+"""Grouped (merged-batch) honest phase: equivalence with the vmapped path.
+
+The grouped execution (`models/core.py` grouped helpers,
+`engine/step.py:_workers_grad_grouped`) is a pure re-expression of
+`vmap(apply)` — per-worker BN batch statistics and the per-worker-key
+dropout draws are bit-identical by construction, so entire training
+trajectories must agree to float tolerance.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzantinemomentum_tpu import attacks, losses, models, ops
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+
+
+def stacked(params, S):
+    return jax.tree.map(lambda p: jnp.broadcast_to(p, (S,) + p.shape), params)
+
+
+@pytest.mark.parametrize("name,shape", [
+    ("empire-cnn", (32, 32, 3)),
+    ("simples-conv", (28, 28, 1)),
+    ("simples-full", (28, 28, 1)),
+    ("simples-logit", (68,)),
+    ("simples-linear", (68,)),
+])
+def test_apply_grouped_matches_vmap(name, shape):
+    S, B = 3, 4
+    model = models.build(name)
+    params, state = model.init(jax.random.PRNGKey(0))
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B) + shape, jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(2), S)
+
+    out_v, ns_v = jax.vmap(
+        lambda x, k: model.apply(params, state, x, train=True, rng=k))(
+            xs, keys)
+    out_g, ns_g = model.apply_grouped(
+        stacked(params, S), state, xs, train=True, rng=keys)
+
+    np.testing.assert_allclose(np.asarray(out_g, np.float32),
+                               np.asarray(out_v, np.float32),
+                               rtol=2e-5, atol=2e-5)
+    for a, b in zip(jax.tree.leaves(ns_g), jax.tree.leaves(ns_v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_apply_grouped_matches_vmap_wrn():
+    """Tiny WRN (depth 10, widen 2): blocks with strided + shortcut convs,
+    BN everywhere, per-block dropout."""
+    S, B = 2, 3
+    model = models.build("wide_resnet-Wide_ResNet", depth=10, widen_factor=2,
+                         dropout_rate=0.3)
+    params, state = model.init(jax.random.PRNGKey(0))
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, 32, 32, 3))
+    keys = jax.random.split(jax.random.PRNGKey(2), S)
+    out_v, ns_v = jax.vmap(
+        lambda x, k: model.apply(params, state, x, train=True, rng=k))(
+            xs, keys)
+    out_g, ns_g = model.apply_grouped(stacked(params, S), state, xs,
+                                      train=True, rng=keys)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_v),
+                               rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree.leaves(ns_g), jax.tree.leaves(ns_v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_apply_grouped_eval_mode_uses_running_stats():
+    S, B = 2, 3
+    model = models.build("empire-cnn")
+    params, state = model.init(jax.random.PRNGKey(0))
+    # Perturb the running stats away from init so eval actually reads them
+    state = jax.tree.map(lambda x: x + 0.25, state)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, 32, 32, 3))
+    out_v, _ = jax.vmap(
+        lambda x: model.apply(params, state, x, train=False,
+                              rng=jax.random.PRNGKey(0)))(xs)
+    out_g, ns_g = model.apply_grouped(stacked(params, S), state, xs,
+                                      train=False)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_v),
+                               rtol=2e-5, atol=2e-5)
+    # Eval must not touch the running stats
+    for a, b in zip(jax.tree.leaves(ns_g), jax.tree.leaves(state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def _build(grouped, momentum_at="update", nesterov=False):
+    cfg = EngineConfig(
+        nb_workers=5, nb_decl_byz=1, nb_real_byz=1,
+        nb_for_study=4, nb_for_study_past=2,
+        momentum=0.9, momentum_at=momentum_at, nesterov=nesterov,
+        gradient_clip=2.0, grouped_workers=grouped)
+    engine = build_engine(
+        cfg=cfg, model_def=models.build("empire-cnn"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["median"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+    return cfg, engine
+
+
+@pytest.mark.parametrize("momentum_at,nesterov",
+                         [("update", False), ("worker", True)])
+def test_engine_trajectory_grouped_vs_vmap(momentum_at, nesterov):
+    """Whole-step trajectories (theta, BN state, study metrics) agree
+    between the grouped and vmapped phases — same PRNG stream, so the
+    dropout masks and attack/defense inputs are identical."""
+    cfg_g, eng_g = _build(True, momentum_at, nesterov)
+    cfg_v, eng_v = _build(False, momentum_at, nesterov)
+    assert eng_g.model_def.apply_grouped is not None
+
+    S, B = cfg_g.nb_sampled, 3
+    key = jax.random.PRNGKey(3)
+    state_g = eng_g.init(jax.random.PRNGKey(0))
+    state_v = eng_v.init(jax.random.PRNGKey(0))
+
+    for step in range(2):
+        xs = jax.random.normal(jax.random.fold_in(key, step),
+                               (S, B, 32, 32, 3), jnp.float32)
+        ys = jax.random.randint(jax.random.fold_in(key, 100 + step),
+                                (S, B), 0, 10)
+        state_g, met_g = eng_g.train_step(state_g, xs, ys, jnp.float32(0.05))
+        state_v, met_v = eng_v.train_step(state_v, xs, ys, jnp.float32(0.05))
+
+    # Two steps of conv backward accumulate different summation orders
+    # (grouped conv vs vmap's batch-group conv): pure float noise, bounded
+    # in absolute terms but large relatively on near-zero coordinates
+    np.testing.assert_allclose(np.asarray(state_g.theta),
+                               np.asarray(state_v.theta),
+                               rtol=1e-3, atol=2e-4)
+    for a, b in zip(jax.tree.leaves(state_g.net_state),
+                    jax.tree.leaves(state_v.net_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+    for name in ("Average loss", "Defense gradient norm",
+                 "Attack acceptation ratio"):
+        np.testing.assert_allclose(np.asarray(met_g[name]),
+                                   np.asarray(met_v[name]),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_grouped_respects_config_off():
+    """grouped_workers=False traces the vmapped phase even when the model
+    provides apply_grouped (the --no-grouped-workers escape hatch)."""
+    from byzantinemomentum_tpu.engine import step as step_mod
+
+    calls = []
+    cfg, engine = _build(False)
+    orig = engine._workers_grad_grouped
+    engine._workers_grad_grouped = (
+        lambda *a, **k: calls.append(1) or orig(*a, **k))
+    state = engine.init(jax.random.PRNGKey(0))
+    xs = jnp.zeros((cfg.nb_sampled, 2, 32, 32, 3), jnp.float32)
+    ys = jnp.zeros((cfg.nb_sampled, 2), jnp.int32)
+    engine.train_step(state, xs, ys, jnp.float32(0.01))
+    assert not calls
+
+    # And the module-level context disables it for a grouped-enabled engine
+    cfg2, engine2 = _build(True)
+    orig2 = engine2._workers_grad_grouped
+    engine2._workers_grad_grouped = (
+        lambda *a, **k: calls.append(1) or orig2(*a, **k))
+    state2 = engine2.init(jax.random.PRNGKey(0))
+    with step_mod.grouped_disabled():
+        engine2._train_step(state2, xs, ys, jnp.float32(0.01))
+    assert not calls
+    engine2._train_step(state2, xs, ys, jnp.float32(0.01))
+    assert calls
