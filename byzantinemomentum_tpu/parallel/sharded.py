@@ -58,17 +58,17 @@ def pairwise_distances_sharded(g, mesh):
 
 
 def _psum_pairwise(g_local):
-    """Shard-local body of the distributed pairwise-distance kernel: partial
-    row-norms + partial Gram on this d-slice, psum over the model axis.
-    (Single source of truth — the semantics must match
-    `ops._common.pairwise_distances`.)"""
-    sq = jax.lax.psum(jnp.sum(g_local * g_local, axis=1), MODEL)
+    """Shard-local body of the distributed pairwise-distance kernel: the
+    partial Gram on this d-slice (one MXU matmul), psum over the model axis;
+    row norms read off the summed Gram's diagonal. (Single source of truth —
+    the semantics must match `ops._common.pairwise_distances`.)"""
     # precision=HIGHEST as in `ops._common.pairwise_distances`: TPU matmuls
     # default to bf16-decomposed passes, and these distances feed selection
     # orderings that must match the single-device path
     gram = jax.lax.psum(
         jnp.matmul(g_local, g_local.T, precision=jax.lax.Precision.HIGHEST),
         MODEL)
+    sq = jnp.diagonal(gram)
     d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
     d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
     n = g_local.shape[0]
