@@ -243,7 +243,7 @@ def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
     """
     # Function-level import: engine.step pulls in the model registry, whose
     # transformer module imports this package (circular at module scope)
-    from byzantinemomentum_tpu.engine.step import grouped_disabled
+    from byzantinemomentum_tpu.engine.step import grouped_sharded
 
     spec = sharded_state_spec(state_example)
     state_shardings = jax.tree.map(
@@ -257,10 +257,12 @@ def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
     def traced(*args):
         ctx = (_defenses_overridden(engine, wrapped) if wrapped is not None
                else contextlib.nullcontext())
-        # grouped_disabled: the merged-batch honest phase would carry the
-        # worker axis as channel groups, defeating the P(WORKERS) batch
-        # sharding this builder pins — the mesh path keeps the vmap form
-        with ctx, pallas_sort.disabled(), grouped_disabled():
+        # grouped_sharded: the jit propagator cannot batch-shard the
+        # channel-group honest phase on its own, so the engine traces it as
+        # an explicit `shard_map` over the workers axis — each shard runs
+        # the merged grouped program on its local workers (vmap fallback
+        # for models without `apply_grouped` or non-dividing worker axes)
+        with ctx, pallas_sort.disabled(), grouped_sharded(mesh):
             return step_fn(*args)
 
     return jax.jit(
