@@ -340,8 +340,7 @@ class Engine:
         ns_spec = jax.tree.map(lambda _: P(), net_state)
         states_spec = jax.tree.map(lambda _: P(WORKERS), net_state)
         return jax.shard_map(
-            lambda th_l, ns, xs_l, ys_l, keys_l:
-                self._grouped_local(th_l, ns, xs_l, ys_l, keys_l),
+            self._grouped_local,
             mesh=mesh,
             in_specs=(P(WORKERS), ns_spec, P(WORKERS), P(WORKERS),
                       P(WORKERS)),

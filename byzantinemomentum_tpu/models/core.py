@@ -122,7 +122,11 @@ def batchnorm_apply(params, state, x, *, train):
         mean, var = state["mean"], state["var"]
         new_state = state
     inv = lax.rsqrt(var + BN_EPS)
-    out = (x - mean) * inv * params["gamma"] + params["beta"]
+    # Eval under mixed precision normalizes with the f32 running stats (the
+    # arithmetic promotes), but the activation stream must come back in
+    # x.dtype — the next conv requires matching operand dtypes. No-op in
+    # train mode (batch stats share x's dtype).
+    out = ((x - mean) * inv * params["gamma"] + params["beta"]).astype(x.dtype)
     return out, new_state
 
 
@@ -200,7 +204,10 @@ def grouped_batchnorm_apply(params_s, state, x, *, train):
         mean, var = state["mean"], state["var"]
         new_state = state
     inv = lax.rsqrt(var + BN_EPS)
-    out = (x - mean) * inv * params_s["gamma"] + params_s["beta"]
+    # Same mixed-precision note as `batchnorm_apply`: keep the activation
+    # stream in x.dtype after normalizing with (possibly f32) stats
+    out = ((x - mean) * inv * params_s["gamma"]
+           + params_s["beta"]).astype(x.dtype)
     return out, new_state
 
 

@@ -128,6 +128,28 @@ def small_synth(monkeypatch):
     monkeypatch.setenv("BMT_SYNTH_TEST", "128")
 
 
+def test_mixed_precision_eval_on_bn_model():
+    """Regression: under `--compute-dtype bfloat16`, evaluation on a
+    BatchNorm model normalizes with the f32 running stats but must keep the
+    activation stream in bf16 — the f32 promotion used to reach the next
+    conv as a dtype mismatch (caught on a real-TPU driver run; CPU suites
+    only evaluated BN-free models in mixed precision)."""
+    from byzantinemomentum_tpu import attacks
+    cfg = EngineConfig(nb_workers=5, nb_decl_byz=1, nb_real_byz=1,
+                       momentum=0.9, momentum_at="update",
+                       compute_dtype="bfloat16")
+    engine = build_engine(
+        cfg=cfg, model_def=models_mod.build("empire-cnn"),
+        loss=losses_mod.Loss("nll"), criterion=losses_mod.Criterion("top-k"),
+        defenses=[(ops_mod.gars["median"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+    state = engine.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(_rand(4, 32 * 32 * 3, seed=3).reshape(4, 32, 32, 3))
+    y = jnp.zeros((4,), jnp.int32)
+    res = np.asarray(engine.eval_step(state.theta, state.net_state, x, y))
+    assert res.shape == (2,) and res[1] == 4
+
+
 @pytest.mark.parametrize("dtype,fmt_digits",
                          [("bfloat16", 4), ("float32", 8), ("float16", 4)])
 def test_cli_dtype_smoke(tmp_path, small_synth, dtype, fmt_digits):
