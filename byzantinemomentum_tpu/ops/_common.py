@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from byzantinemomentum_tpu.ops import pallas_sort
 
 __all__ = [
+    "all_finite_from_dist",
+    "averaged_median",
     "lower_median",
     "pairwise_distances",
     "closest_mean",
@@ -28,7 +30,7 @@ __all__ = [
 ]
 
 
-def weighted_rows_mean(w, gradients):
+def weighted_rows_mean(w, gradients, all_finite=None):
     """`w @ gradients` with row-selection non-finite semantics.
 
     `w: f32[n] | f32[r, n]` holds averaging weights (0 on unselected rows).
@@ -48,6 +50,13 @@ def weighted_rows_mean(w, gradients):
     plain-matmul branch whenever the matrix is all-finite (TPU executes
     only the taken branch), so the masking machinery runs exactly when a
     non-finite value is actually present.
+
+    `all_finite`: optional precomputed bool predicate. Callers that already
+    hold the pairwise-distance matrix derive it for free from its
+    off-diagonal finiteness (`all_finite_from_dist`) instead of this
+    function re-reading the whole (n, d) matrix. A conservative False
+    (e.g. a legitimately huge row whose squared norm overflows) only means
+    taking the exact masked path.
     """
     def fast(g):
         return jnp.matmul(w, g, precision=jax.lax.Precision.HIGHEST)
@@ -61,8 +70,22 @@ def weighted_rows_mean(w, gradients):
                          precision=jax.lax.Precision.HIGHEST) > 0
         return jnp.where(bad, jnp.nan, out)
 
-    return jax.lax.cond(jnp.all(jnp.isfinite(gradients)), fast, masked,
-                        gradients)
+    if all_finite is None:
+        all_finite = jnp.all(jnp.isfinite(gradients))
+    return jax.lax.cond(all_finite, fast, masked, gradients)
+
+
+def all_finite_from_dist(dist):
+    """Whether every gradient row behind a `pairwise_distances` matrix is
+    finite, read off the matrix itself: any non-finite coordinate in row i
+    makes every dist[i, j] (j != i) non-finite-then-+inf (NaN products stay
+    NaN, inf squares stay inf, `sanitize_inf` maps both to +inf), so the
+    off-diagonal being finite certifies the rows are. Overflowing-but-
+    finite rows may report False — conservative (the caller takes its exact
+    masked path). O(n^2), replaces a full (n, d) isfinite reduction."""
+    n = dist.shape[0]
+    offdiag = jnp.where(jnp.eye(n, dtype=bool), 0.0, dist)
+    return jnp.all(jnp.isfinite(offdiag))
 
 
 def selection_influence(selection_fn):
@@ -136,6 +159,21 @@ def pairwise_distances(g, *, squared=False, method="dot"):
     if squared:
         return d2
     return sanitize_inf(jnp.sqrt(d2))
+
+
+def averaged_median(g, m):
+    """Bulyan's stage-2 "averaged median": coordinate-wise mean of the `m`
+    values closest to the coordinate-wise lower median (reference
+    `aggregators/bulyan.py:77-84`). For m == 1 the closest value to the
+    median IS the median (it is a row element, deviation 0; all-NaN columns
+    return NaN either way), so the closest_mean pass is skipped entirely —
+    hit by the appendix grid's n=11, f=2 cell. Shared by the single-device
+    rule (`ops/bulyan.py`) and the d-sharded kernel
+    (`parallel/sharded.py`)."""
+    med = lower_median(g)
+    if m == 1:
+        return med
+    return closest_mean(g, med, m)
 
 
 def closest_mean(g, c, m):

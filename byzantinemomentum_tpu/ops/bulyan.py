@@ -25,7 +25,8 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
 from byzantinemomentum_tpu.ops._common import (
-    closest_mean, lower_median, pairwise_distances, weighted_rows_mean)
+    all_finite_from_dist, averaged_median, pairwise_distances,
+    weighted_rows_mean)
 
 __all__ = ["aggregate", "selected_stack", "selection_weights"]
 
@@ -69,14 +70,14 @@ def selected_stack(gradients, f, m=None, *, method="dot"):
     (`ops._common.weighted_rows_mean`)."""
     dist = pairwise_distances(gradients, method=method)  # diag = +inf
     W = selection_weights(dist, f, m)
-    return weighted_rows_mean(W.astype(gradients.dtype), gradients)
+    return weighted_rows_mean(W.astype(gradients.dtype), gradients,
+                              all_finite=all_finite_from_dist(dist))
 
 
 def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
     """Bulyan over Multi-Krum (reference `aggregators/bulyan.py:31-86`)."""
     sel = selected_stack(gradients, f, m, method=method)
-    m2 = sel.shape[0] - 2 * f
-    return closest_mean(sel, lower_median(sel), m2)
+    return averaged_median(sel, sel.shape[0] - 2 * f)
 
 
 _jitted = jax.jit(aggregate, static_argnames=("f", "m", "method"))

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import register
 from byzantinemomentum_tpu.ops._common import (
-    pairwise_distances, selection_influence, weighted_rows_mean)
+    all_finite_from_dist, pairwise_distances, selection_influence,
+    weighted_rows_mean)
 
 __all__ = ["aggregate", "scores", "selection", "selection_weights"]
 
@@ -73,7 +74,8 @@ def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
     semantics in `ops._common.weighted_rows_mean`."""
     dist = pairwise_distances(gradients, method=method)
     w = selection_weights(dist, f, m).astype(gradients.dtype)
-    return weighted_rows_mean(w, gradients)
+    return weighted_rows_mean(w, gradients,
+                              all_finite=all_finite_from_dist(dist))
 
 
 _jitted = jax.jit(aggregate, static_argnames=("f", "m", "method"))

@@ -126,9 +126,19 @@ def _sorted_rows(in_ref):
 def _tile_for(n, buffers, itemsize):
     """Column-block width: keep `buffers` live (n, tile) buffers of the
     operand dtype within a ~10 MB VMEM budget (of 16 MB/core), in multiples
-    of 128 lanes."""
+    of 128 lanes.
+
+    The cap scales inversely with n so SMALL row counts get proportionally
+    wider tiles: each grid step pays a fixed DMA/iteration latency, and at
+    e.g. (5, 36.5M) a 16K-column cap meant ~2200 grid steps of mostly
+    latency (measured ~12 ms/kernel; wide tiles bring it near the read
+    floor). Tiles are multiples of 4096 columns — Mosaic requires 1-D
+    output blocks divisible by the minor tiling (1024 f32 / 2048 half
+    dtypes) — and the budget guarantees tile >= 6826 for every supported
+    (n <= MAX_ROWS, buffers <= 6, itemsize <= 4) combination, so flooring
+    to 4096 never degenerates."""
     tile = (10 * 2 ** 20) // (itemsize * buffers * n)
-    return max(128, min(16384, tile // 128 * 128))
+    return min(131072, tile // 4096 * 4096)
 
 
 def _grid_call(kernel, out_rows, g, extra_1d=(), *, buffers, interpret):

@@ -109,7 +109,10 @@ def shard_gar(gar, mesh, *, f, **kwargs):
             dist = _psum_pairwise(g_local)
             w = krum_mod.selection_weights(
                 dist, f, kwargs.get("m")).astype(g_local.dtype)
-            return _common.weighted_rows_mean(w, g_local)
+            # The psum'd distances certify WHOLE rows finite, which covers
+            # this shard's columns
+            return _common.weighted_rows_mean(
+                w, g_local, all_finite=_common.all_finite_from_dist(dist))
 
         return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
                          out_specs=P(MODEL))
@@ -125,13 +128,12 @@ def shard_gar(gar, mesh, *, f, **kwargs):
             dist = _psum_pairwise(g_local)
             W = bulyan_mod.selection_weights(dist, f, kwargs.get("m"))
             sel = _common.weighted_rows_mean(
-                W.astype(g_local.dtype), g_local)
+                W.astype(g_local.dtype), g_local,
+                all_finite=_common.all_finite_from_dist(dist))
             # Stage 2 (reference `bulyan.py:77-84`): coordinate-wise averaged
             # median — d-local, Pallas-fused where supported
-            m2 = sel.shape[0] - 2 * f
             with pallas_sort.allowed():
-                return _common.closest_mean(sel, _common.lower_median(sel),
-                                            m2)
+                return _common.averaged_median(sel, sel.shape[0] - 2 * f)
 
         # check_vma=False: the Pallas out_shapes inside carry no
         # varying-mesh-axes annotation
