@@ -138,7 +138,10 @@ def _tile_for(n, buffers, itemsize):
     (n <= MAX_ROWS, buffers <= 6, itemsize <= 4) combination, so flooring
     to 4096 never degenerates."""
     tile = (10 * 2 ** 20) // (itemsize * buffers * n)
-    return min(131072, tile // 4096 * 4096)
+    # The 4096 floor keeps direct entry-point calls outside the
+    # `supported()` domain (n > MAX_ROWS, wide dtypes) well-defined instead
+    # of rounding to a zero-width grid
+    return max(4096, min(131072, tile // 4096 * 4096))
 
 
 def _grid_call(kernel, out_rows, g, extra_1d=(), *, buffers, interpret):
