@@ -608,125 +608,179 @@ def main(argv=None):
         }.get(cfg.jnp_dtype, "%s")
         just_loaded = args.load_checkpoint is not None
 
-        while not exit_is_requested():
-            steps = int(state.steps)
-            milestone_evaluation = (args.evaluation_delta > 0
-                                    and steps % args.evaluation_delta == 0)
-            milestone_checkpoint = (args.checkpoint_delta > 0
-                                    and steps % args.checkpoint_delta == 0)
-            milestone_user_input = (args.user_input_delta > 0
-                                    and steps % args.user_input_delta == 0)
-            # Sampler snapshot BEFORE the evaluation consumes test batches,
-            # so a resumed run replays this step's evaluation exactly
-            data_snapshot = None
-            if milestone_checkpoint and not just_loaded:
-                data_snapshot = {"train": trainset.get_state(),
-                                 "test": testset.get_state()}
-            if milestone_evaluation:
-                # One compiled program + one host transfer per evaluation
-                # (the reference runs batch_size_test_reps separate
-                # synchronous calls, `attack.py:709-715`)
-                reps = args.batch_size_test_reps
-                if use_device_data:
-                    idx, flips = test_data.sample_indices(reps)
-                    res = engine.eval_many_indexed(
-                        state.theta, state.net_state,
-                        jnp.asarray(idx), jnp.asarray(flips))
-                else:
-                    bxs, bys = zip(*(testset.sample() for _ in range(reps)))
-                    res = eval_many_fn(
-                        state.theta, state.net_state,
-                        jnp.asarray(np.stack(bxs)), jnp.asarray(np.stack(bys)))
-                acc = float(res[0]) / float(res[1])
-                utils.info(f"Accuracy (step {steps}): {acc * 100.:.2f}%")
-                if fd_eval is not None:
-                    results.store(fd_eval, steps, acc)
-            if milestone_checkpoint and not just_loaded:
-                filename = args.result_directory / f"checkpoint-{steps}"
-                try:
-                    checkpoint_mod.save(filename, state,
-                                        data_state=data_snapshot)
-                except Exception as err:
-                    utils.warning(f"Checkpoint save failed: {err}")
-            just_loaded = False
-            if milestone_user_input:
-                code.interact(banner=f"Interactive prompt (step {steps}); "
-                              "Ctrl-D to resume", local={"state": state,
-                                                         "engine": engine})
-            if steps_limit is not None and steps >= steps_limit:
-                break
-            # How many steps until the next milestone boundary — that many
-            # can fuse into one compiled dispatch (identical trajectory;
-            # `engine.train_multi*` is a lax.scan of the single step)
-            def next_boundary(delta):
-                return (steps // delta + 1) * delta if delta > 0 else None
-            bounds = [next_boundary(args.evaluation_delta),
-                      next_boundary(args.checkpoint_delta),
-                      next_boundary(args.user_input_delta),
-                      steps_limit]
-            horizon = min((b for b in bounds if b is not None),
-                          default=steps + max(args.steps_per_program, 1))
-            M = max(1, min(max(args.steps_per_program, 1), horizon - steps))
-            # Per-step learning rates over the window (reference
-            # `attack.py:748-751` semantics, evaluated per step)
-            lrs = []
-            for s in range(steps, steps + M):
-                new_lr = args.compute_new_learning_rate(s)
-                if new_lr is not None:
-                    current_lr = new_lr
-                lrs.append(current_lr)
-            # Sample the per-worker batches (host dataloader boundary,
-            # reference `experiments/dataset.py:208-218`)
-            S = cfg.nb_sampled
-            k = cfg.nb_local_steps
-            need = S * k
-            # 'Training point count' is the value at loop entry, BEFORE each
-            # step's increment (reference `attack.py:696, 844`)
-            datapoints = int(state.datapoints)
-            if use_device_data:
-                idx, flips = train_data.sample_indices(need * M)
-                idx = idx.reshape((M, S, k) + idx.shape[1:] if k > 1
-                                  else (M, S) + idx.shape[1:])
-                flips = flips.reshape((M, S, k) + flips.shape[1:] if k > 1
-                                      else (M, S) + flips.shape[1:])
-                batch = args.batch_size
-                if M == 1:
-                    state, metrics = engine.train_step_indexed(
-                        state, jnp.asarray(idx[0]), jnp.asarray(flips[0]),
-                        jnp.float32(lrs[0]))
-                else:
-                    state, metrics = engine.train_multi_indexed(
-                        state, jnp.asarray(idx), jnp.asarray(flips),
-                        jnp.asarray(lrs, jnp.float32))
-            else:
-                xs, ys = zip(*(trainset.sample() for _ in range(need * M)))
-                xs = np.stack(xs)
-                ys = np.stack(ys)
-                batch = xs.shape[1]
-                shape = (M, S, k) if k > 1 else (M, S)
-                xs = xs.reshape(shape + xs.shape[1:])
-                ys = ys.reshape(shape + ys.shape[1:])
-                if M == 1:
-                    state, metrics = step_fn(
-                        state, jnp.asarray(xs[0]), jnp.asarray(ys[0]),
-                        jnp.float32(lrs[0]))
-                else:
-                    state, metrics = multi_fn(
-                        state, jnp.asarray(xs), jnp.asarray(ys),
-                        jnp.asarray(lrs, jnp.float32))
-            if fd_study is not None:
-                metrics = jax.device_get(metrics)
-                inc = batch * cfg.nb_honests * k
-                for i in range(M):
-                    row = [steps + i, datapoints + i * inc]
-                    for column in STUDY_COLUMNS[2:-1]:
-                        value = metrics[column]
-                        value = value[i] if M > 1 else value
-                        row.append(float_format % float(value))
-                    ar = metrics["Attack acceptation ratio"]
-                    row.append(float(ar[i] if M > 1 else ar))
-                    results.store(fd_study, *row)
+        # Host-side mirrors of the step/datapoint counters: they advance
+        # deterministically (+M steps, +M*batch*honests*local_steps points
+        # per dispatched chunk), and reading them off the device state every
+        # iteration would force a full sync per chunk — on tunneled
+        # backends a ~100 ms round trip that idles the chip
+        steps_host = int(state.steps)
+        datapoints_host = int(state.datapoints)
 
+        # Study metrics of the previously dispatched chunk, transferred
+        # AFTER the next chunk is enqueued (depth-2 pipeline, same scheme
+        # as bench.py): (device metrics, steps, datapoints, batch, M)
+        pending_study = []
+        # Depth-2 dispatch throttle for runs WITHOUT a study file: a tiny
+        # device scalar from the previous chunk, transferred after the next
+        # chunk is enqueued — bounds host run-ahead (and the device memory
+        # pinned by in-flight input batches) without stalling the pipeline
+        pending_sync = []
+
+        def flush_study():
+            if not pending_study:
+                return
+            p_metrics, p_steps, p_datapoints, p_batch, p_m = \
+                pending_study.pop()
+            p_metrics = jax.device_get(p_metrics)
+            inc = p_batch * cfg.nb_honests * cfg.nb_local_steps
+            for i in range(p_m):
+                row = [p_steps + i, p_datapoints + i * inc]
+                for column in STUDY_COLUMNS[2:-1]:
+                    value = p_metrics[column]
+                    value = value[i] if p_m > 1 else value
+                    row.append(float_format % float(value))
+                ar = p_metrics["Attack acceptation ratio"]
+                row.append(float(ar[i] if p_m > 1 else ar))
+                results.store(fd_study, *row)
+
+        try:
+            while not exit_is_requested():
+                steps = steps_host
+                milestone_evaluation = (args.evaluation_delta > 0
+                                        and steps % args.evaluation_delta == 0)
+                milestone_checkpoint = (args.checkpoint_delta > 0
+                                        and steps % args.checkpoint_delta == 0)
+                milestone_user_input = (args.user_input_delta > 0
+                                        and steps % args.user_input_delta == 0)
+                # Sampler snapshot BEFORE the evaluation consumes test batches,
+                # so a resumed run replays this step's evaluation exactly
+                # Milestones read/serialize device state (inherent sync) — any
+                # buffered study rows are transferred first so the files stay
+                # strictly ordered on disk
+                if milestone_evaluation or milestone_checkpoint \
+                        or milestone_user_input:
+                    flush_study()
+                data_snapshot = None
+                if milestone_checkpoint and not just_loaded:
+                    data_snapshot = {"train": trainset.get_state(),
+                                     "test": testset.get_state()}
+                if milestone_evaluation:
+                    # One compiled program + one host transfer per evaluation
+                    # (the reference runs batch_size_test_reps separate
+                    # synchronous calls, `attack.py:709-715`)
+                    reps = args.batch_size_test_reps
+                    if use_device_data:
+                        idx, flips = test_data.sample_indices(reps)
+                        res = engine.eval_many_indexed(
+                            state.theta, state.net_state,
+                            jnp.asarray(idx), jnp.asarray(flips))
+                    else:
+                        bxs, bys = zip(*(testset.sample() for _ in range(reps)))
+                        res = eval_many_fn(
+                            state.theta, state.net_state,
+                            jnp.asarray(np.stack(bxs)), jnp.asarray(np.stack(bys)))
+                    acc = float(res[0]) / float(res[1])
+                    utils.info(f"Accuracy (step {steps}): {acc * 100.:.2f}%")
+                    if fd_eval is not None:
+                        results.store(fd_eval, steps, acc)
+                if milestone_checkpoint and not just_loaded:
+                    filename = args.result_directory / f"checkpoint-{steps}"
+                    try:
+                        checkpoint_mod.save(filename, state,
+                                            data_state=data_snapshot)
+                    except Exception as err:
+                        utils.warning(f"Checkpoint save failed: {err}")
+                just_loaded = False
+                if milestone_user_input:
+                    code.interact(banner=f"Interactive prompt (step {steps}); "
+                                  "Ctrl-D to resume", local={"state": state,
+                                                             "engine": engine})
+                if steps_limit is not None and steps >= steps_limit:
+                    break
+                # How many steps until the next milestone boundary — that many
+                # can fuse into one compiled dispatch (identical trajectory;
+                # `engine.train_multi*` is a lax.scan of the single step)
+                def next_boundary(delta):
+                    return (steps // delta + 1) * delta if delta > 0 else None
+                bounds = [next_boundary(args.evaluation_delta),
+                          next_boundary(args.checkpoint_delta),
+                          next_boundary(args.user_input_delta),
+                          steps_limit]
+                horizon = min((b for b in bounds if b is not None),
+                              default=steps + max(args.steps_per_program, 1))
+                M = max(1, min(max(args.steps_per_program, 1), horizon - steps))
+                # Per-step learning rates over the window (reference
+                # `attack.py:748-751` semantics, evaluated per step)
+                lrs = []
+                for s in range(steps, steps + M):
+                    new_lr = args.compute_new_learning_rate(s)
+                    if new_lr is not None:
+                        current_lr = new_lr
+                    lrs.append(current_lr)
+                # Sample the per-worker batches (host dataloader boundary,
+                # reference `experiments/dataset.py:208-218`)
+                S = cfg.nb_sampled
+                k = cfg.nb_local_steps
+                need = S * k
+                # 'Training point count' is the value at loop entry, BEFORE each
+                # step's increment (reference `attack.py:696, 844`)
+                datapoints = datapoints_host
+                if use_device_data:
+                    idx, flips = train_data.sample_indices(need * M)
+                    idx = idx.reshape((M, S, k) + idx.shape[1:] if k > 1
+                                      else (M, S) + idx.shape[1:])
+                    flips = flips.reshape((M, S, k) + flips.shape[1:] if k > 1
+                                          else (M, S) + flips.shape[1:])
+                    batch = args.batch_size
+                    if M == 1:
+                        state, metrics = engine.train_step_indexed(
+                            state, jnp.asarray(idx[0]), jnp.asarray(flips[0]),
+                            jnp.float32(lrs[0]))
+                    else:
+                        state, metrics = engine.train_multi_indexed(
+                            state, jnp.asarray(idx), jnp.asarray(flips),
+                            jnp.asarray(lrs, jnp.float32))
+                else:
+                    xs, ys = zip(*(trainset.sample() for _ in range(need * M)))
+                    xs = np.stack(xs)
+                    ys = np.stack(ys)
+                    batch = xs.shape[1]
+                    shape = (M, S, k) if k > 1 else (M, S)
+                    xs = xs.reshape(shape + xs.shape[1:])
+                    ys = ys.reshape(shape + ys.shape[1:])
+                    if M == 1:
+                        state, metrics = step_fn(
+                            state, jnp.asarray(xs[0]), jnp.asarray(ys[0]),
+                            jnp.float32(lrs[0]))
+                    else:
+                        state, metrics = multi_fn(
+                            state, jnp.asarray(xs), jnp.asarray(ys),
+                            jnp.asarray(lrs, jnp.float32))
+                steps_host += M
+                datapoints_host += M * batch * cfg.nb_honests * k
+                if fd_study is not None:
+                    # Transfer the PREVIOUS chunk's metrics now that this one
+                    # is enqueued (its rows were buffered on device), then
+                    # buffer this chunk's
+                    flush_study()
+                    pending_study.append((metrics, steps, datapoints, batch, M))
+                else:
+                    # No study file: the metrics transfer above would have
+                    # throttled dispatch; transfer the previous chunk's tiny
+                    # step counter instead, bounding host run-ahead (and the
+                    # device memory pinned by in-flight input batches) to
+                    # one chunk. `+ 0` derives a FRESH buffer — state.steps
+                    # itself is donated (and deleted) by the next dispatch
+                    if pending_sync:
+                        np.asarray(pending_sync.pop())
+                    pending_sync.append(state.steps + 0)
+
+        finally:
+            # Buffered study rows must reach disk on EVERY exit
+            # path - normal completion, SIGINT latch, or an
+            # exception escaping the loop (the pre-pipeline code
+            # wrote rows synchronously per chunk)
+            flush_study()
         if results is not None:
             results.close()
     if args.trace_dir is not None:
