@@ -779,10 +779,11 @@ def main(argv=None):
             # Buffered study rows must reach disk on EVERY exit
             # path - normal completion, SIGINT latch, or an
             # exception escaping the loop (the pre-pipeline code
-            # wrote rows synchronously per chunk)
+            # wrote rows synchronously per chunk) - and the result
+            # descriptors must close/flush on those same paths
             flush_study()
-        if results is not None:
-            results.close()
+            if results is not None:
+                results.close()
     if args.trace_dir is not None:
         jax.profiler.stop_trace()
     # A bounded run cut short by SIGINT/SIGTERM must not look successful:

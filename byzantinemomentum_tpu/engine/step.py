@@ -60,7 +60,12 @@ def _grouped_mode_as(mode):
 
 
 def grouped_disabled():
-    """Trace the vmapped (non-grouped) honest phase within this context."""
+    """Trace the vmapped (non-grouped) honest phase within this context.
+
+    Safe with the jitted `Engine.train_*` entry points: they pass the
+    current mode as a static jit argument (`Engine._mode_jit`), so calls
+    inside/outside the context hit different trace-cache entries instead of
+    reusing whichever mode was traced first."""
     return _grouped_mode_as("off")
 
 
@@ -68,7 +73,8 @@ def grouped_sharded(mesh):
     """Trace the honest phase as a `shard_map` over the mesh's workers axis
     with the grouped program on each shard's local workers (falls back to
     the vmapped form for models without `apply_grouped` or when the worker
-    axis does not divide the sampled count)."""
+    axis does not divide the sampled count). Mode caching: see
+    `grouped_disabled`."""
     return _grouped_mode_as(mesh)
 
 
@@ -186,12 +192,31 @@ class Engine:
         self.unravel = unravel
         self._net_state0 = _cast_tree(net_state, cfg.jnp_dtype)
 
-        self.train_step = jax.jit(self._train_step, donate_argnums=(0,))
-        self.train_multi = jax.jit(self._train_multi, donate_argnums=(0,))
+        self.train_step = self._mode_jit(self._train_step)
+        self.train_multi = self._mode_jit(self._train_multi)
         self.eval_step = jax.jit(self._eval_step)
         self.eval_many = jax.jit(self._eval_many)
         self._train_data = None
         self._test_data = None
+
+    def _mode_jit(self, fn):
+        """Jit `fn(state, *args)` with the CURRENT grouped mode as a static
+        argument, read at call time: entering `grouped_disabled()` /
+        `grouped_sharded(mesh)` after a first trace retraces instead of
+        silently reusing the cached trace's old mode (the mode is trace-time
+        state, `_grouped_mode` above)."""
+        @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+        def jitted(mode, state, *args):
+            with _grouped_mode_as(mode):
+                return fn(state, *args)
+
+        def call(state, *args):
+            return jitted(_grouped_mode, state, *args)
+
+        # Keep `.lower()` reachable for FLOP accounting (bench.py)
+        call.lower = lambda state, *args: jitted.lower(
+            _grouped_mode, state, *args)
+        return call
 
     def attach_data(self, train_data, test_data=None):
         """Enable the device-resident input path (`data/device.py`): batches
@@ -199,10 +224,8 @@ class Engine:
         host->device batch transfer from the step critical path."""
         self._train_data = train_data
         self._test_data = test_data
-        self.train_step_indexed = jax.jit(
-            self._train_step_indexed, donate_argnums=(0,))
-        self.train_multi_indexed = jax.jit(
-            self._train_multi_indexed, donate_argnums=(0,))
+        self.train_step_indexed = self._mode_jit(self._train_step_indexed)
+        self.train_multi_indexed = self._mode_jit(self._train_multi_indexed)
         self.eval_step_indexed = jax.jit(self._eval_step_indexed)
         self.eval_many_indexed = jax.jit(self._eval_many_indexed)
         return self
@@ -394,7 +417,17 @@ class Engine:
         pattern scaled by a Knuth-constant multiple of its flat index before
         the wraparound sum), so probes that differ in any single coordinate
         — e.g. the `bulyan` attack's target-coordinate direction — or only
-        by a permutation still re-draw."""
+        by a permutation still re-draw.
+
+        Residual divergence (quantified in
+        `tests/test_engine.py::test_per_call_mixture_draw_counts_one_step`):
+        two invocations on byte-identical operand matrices within one step
+        draw the SAME member, where the reference's impure
+        `random.random()` (reference `attack.py:504-509`) would re-draw
+        independently. Real attacks' line-search probes are never
+        byte-identical (each probe varies the factor), so the divergence is
+        unreachable from the shipped attacks; distinct-operand draws match
+        the configured frequencies."""
         bits = lax.bitcast_convert_type(
             gradients.astype(jnp.float32), jnp.uint32)
         mult = (jnp.arange(bits.size, dtype=jnp.uint32).reshape(bits.shape)

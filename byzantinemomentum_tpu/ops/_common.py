@@ -167,7 +167,17 @@ def averaged_median(g, m):
     `aggregators/bulyan.py:77-84`). For m == 1 the closest value to the
     median IS the median (it is a row element, deviation 0; all-NaN columns
     return NaN either way), so the closest_mean pass is skipped entirely —
-    hit by the appendix grid's n=11, f=2 cell. Shared by the single-device
+    hit by the appendix grid's n=11, f=2 cell.
+
+    Beyond-contract caveat: if a column's lower median is +/-inf (a majority
+    of the selected stack non-finite in that coordinate — only reachable
+    past the f-contract), the shortcut returns that inf, while
+    `closest_mean(g, med, 1)` would return the nearest FINITE row value
+    (|finite - inf| = inf sorts before the inf row's NaN self-deviation).
+    The shortcut's answer is the defensible one (the median of the selected
+    stack), and the input is outside every GAR's guarantee, so the
+    divergence is documented rather than branched on. Shared by the
+    single-device
     rule (`ops/bulyan.py`) and the d-sharded kernel
     (`parallel/sharded.py`)."""
     med = lower_median(g)

@@ -21,7 +21,7 @@ def stacked(params, S):
 
 
 @pytest.mark.parametrize("name,shape", [
-    ("empire-cnn", (32, 32, 3)),
+    pytest.param("empire-cnn", (32, 32, 3), marks=pytest.mark.slow),
     ("simples-conv", (28, 28, 1)),
     ("simples-full", (28, 28, 1)),
     ("simples-logit", (68,)),
@@ -48,6 +48,7 @@ def test_apply_grouped_matches_vmap(name, shape):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_apply_grouped_matches_vmap_wrn():
     """Tiny WRN (depth 10, widen 2): blocks with strided + shortcut convs,
     BN everywhere, per-block dropout."""
@@ -102,6 +103,7 @@ def _build(grouped, momentum_at="update", nesterov=False):
     return cfg, engine
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("momentum_at,nesterov",
                          [("update", False), ("worker", True)])
 def test_engine_trajectory_grouped_vs_vmap(momentum_at, nesterov):
@@ -142,6 +144,7 @@ def test_engine_trajectory_grouped_vs_vmap(momentum_at, nesterov):
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_grouped_respects_config_off():
     """grouped_workers=False traces the vmapped phase even when the model
     provides apply_grouped (the --no-grouped-workers escape hatch)."""
@@ -159,13 +162,17 @@ def test_grouped_respects_config_off():
     assert not calls
 
     # And the module-level context disables it for a grouped-enabled engine
+    # THROUGH THE JITTED PUBLIC ENTRY: the mode is a static jit argument
+    # read at call time, so leaving the context retraces with the grouped
+    # phase back on instead of reusing the disabled trace (ADVICE r3)
     cfg2, engine2 = _build(True)
     orig2 = engine2._workers_grad_grouped
     engine2._workers_grad_grouped = (
         lambda *a, **k: calls.append(1) or orig2(*a, **k))
     state2 = engine2.init(jax.random.PRNGKey(0))
     with step_mod.grouped_disabled():
-        engine2._train_step(state2, xs, ys, jnp.float32(0.01))
+        engine2.train_step(state2, xs, ys, jnp.float32(0.01))
     assert not calls
-    engine2._train_step(state2, xs, ys, jnp.float32(0.01))
+    state2b = engine2.init(jax.random.PRNGKey(0))
+    engine2.train_step(state2b, xs, ys, jnp.float32(0.01))
     assert calls
