@@ -1,9 +1,14 @@
 """Extension skeleton for a new attack (parity with reference
 `attacks/template.py`).
 
-Copy this file, implement the two functions, and uncomment the registration:
-the plugin loader (`attacks/__init__.py`) imports every module in this
-directory at package load.
+Copy this file and implement the two functions: the plugin loader
+(`attacks/__init__.py`) imports every module in this directory at package
+load and the module registers itself at the bottom.
+
+Like the reference (`attacks/template.py:48`), the skeleton itself registers
+a runnable `"template"` entry whose `check` always fails with a template
+message — `--attack template` resolves by name and then reports it is
+template code, exactly as the reference does.
 """
 
 __all__ = []
@@ -21,14 +26,17 @@ def attack(grad_honests, f_decl, f_real, defense, **kwargs):
     Returns:
       f32[f_real, d] Byzantine gradient matrix.
     """
-    raise NotImplementedError
+    raise NotImplementedError(
+        "I am template code, please replace me with useful stuff")
 
 
 def check(grad_honests, f_decl, f_real, defense, **kwargs):
-    """Return None if the arguments are valid, an error message otherwise."""
-    if grad_honests.shape[0] == 0:
-        return "Expected a non-empty list of honest gradients"
+    """Return None if the arguments are valid, an error message otherwise.
+
+    The template always declines (reference `attacks/template.py:33-42`)."""
+    return "I am template code, you should not be using me"
 
 
-# from byzantinemomentum_tpu.attacks import register
-# register("template", attack, check)
+from byzantinemomentum_tpu.attacks import register  # noqa: E402
+
+register("template", attack, check)

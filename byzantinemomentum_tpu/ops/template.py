@@ -6,9 +6,12 @@ Copy this file, rename the functions, and the rule self-registers at
 import through the plugin loader (`ops/__init__.py`). A GAR kernel is a
 pure function over the stacked gradient matrix; keep `f` and any other
 structural arguments static (Python ints/strings) so jit can specialize.
-"""
 
-# To activate, copy this module and uncomment the registration at the bottom.
+Like the reference (`aggregators/template.py:59`), the skeleton itself
+registers a runnable `"template"` entry whose `check` always fails with a
+template message — `--gar template` resolves by name and then reports it is
+template code, exactly as the reference does.
+"""
 
 __all__ = []
 
@@ -23,18 +26,22 @@ def aggregate(gradients, f, **kwargs):
     Returns:
       f32[d] aggregated gradient.
     """
-    raise NotImplementedError
+    raise NotImplementedError(
+        "I am template code, please replace me with useful stuff")
 
 
 def check(gradients, f, **kwargs):
-    """Return None if the arguments are valid, an error message otherwise."""
-    if gradients.shape[0] < 1:
-        return "Expected at least one gradient to aggregate"
+    """Return None if the arguments are valid, an error message otherwise.
+
+    The template always declines (reference `aggregators/template.py:33-42`)."""
+    return "I am template code, you should not be using me"
 
 
 def upper_bound(n, f, d):
     """Optional: the paper's variance-norm ratio bound for this rule."""
-    return None
+    raise NotImplementedError(
+        "I am optional (but still template) code, please replace me with "
+        "useful stuff or delete me")
 
 
 def influence(honests, byzantines, f, **kwargs):
@@ -42,5 +49,6 @@ def influence(honests, byzantines, f, **kwargs):
     return None
 
 
-# from byzantinemomentum_tpu.ops import register
-# register("template", aggregate, check, upper_bound=upper_bound, influence=influence)
+from byzantinemomentum_tpu.ops import register  # noqa: E402
+
+register("template", aggregate, check, upper_bound=upper_bound)

@@ -52,8 +52,26 @@ def test_registry_complete():
     assert expected <= set(ops.gars)
 
 
+def test_template_registered():
+    """The extension skeletons register runnable `"template"` entries whose
+    check always declines, exactly like the reference
+    (`aggregators/template.py:59`, `attacks/template.py:48`): the name
+    resolves, the checked path reports template code."""
+    from byzantinemomentum_tpu import attacks as attacks_mod
+    from byzantinemomentum_tpu.utils import UserException
+
+    g = jnp.zeros((5, 3))
+    assert "template" in ops.gars
+    with pytest.raises(UserException, match="template code"):
+        ops.gars["template"].checked(g, f=1)
+    assert "template" in attacks_mod.attacks
+    with pytest.raises(UserException, match="template code"):
+        attacks_mod.attacks["template"].checked(g, f_decl=1, f_real=1)
+
+
 @pytest.mark.parametrize("name", sorted(ORACLES))
-@pytest.mark.parametrize("n,f,d", [(11, 2, 13), (15, 3, 7), (25, 5, 4)])
+@pytest.mark.parametrize("n,f,d", [(11, 2, 13), (15, 3, 7),
+                                   pytest.param(25, 5, 4, marks=pytest.mark.slow)])
 def test_differential_vs_torch(name, n, f, d):
     fn, kw = ORACLES[name]
     g = rand_grads(n, d)
@@ -243,6 +261,7 @@ def test_brute_tie_break_first_minimum():
     assert sel == sorted(best_set)
 
 
+@pytest.mark.slow
 def test_brute_paper_scale_streams():
     """n=25, f=11 — C(25,14) = 4,457,400 subsets, the config the reference
     grid actually runs brute-class diameters at. The streaming enumeration
