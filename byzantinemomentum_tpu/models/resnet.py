@@ -1,0 +1,126 @@
+"""`resnet18` — a standard torchvision model, as a pure-pytree ModelDef.
+
+The reference exposes every `torchvision.models` entry point by name
+(reference `experiments/model.py:40-90`); this repo's registry is the
+grid-parity set (see PARITY.md "registry scoping"), and this module shows
+the registry extending to the torchvision zoo the same way: torchvision's
+`resnet18` architecture and initialization, NHWC/HWIO, no module framework.
+
+Architecture (torchvision `resnet.py` BasicBlock [2, 2, 2, 2]):
+  conv7x7(3,64,s2,p3,nobias) bn relu maxpool3x3(s2,p1),
+  4 stages of 2 BasicBlocks (64, 128, 256, 512; first block of stages 2-4
+  downsamples with stride 2 + 1x1 projection), global average pool,
+  fc(512, num_classes).
+BasicBlock: conv3x3 bn relu conv3x3 bn, + identity/projection, relu.
+
+Initialization parity with torchvision: kaiming-normal(fan_out, relu) conv
+kernels (no biases), BN gamma=1/beta=0, torch-default fc init. On CIFAR
+shapes (32x32) the stem reduces to 8x8 before the stages, exactly as torch
+would compute it.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import (
+    batchnorm_apply, batchnorm_init, dense_apply, dense_init)
+
+__all__ = []
+
+_STAGES = (64, 128, 256, 512)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    """torchvision resnet conv init: kaiming_normal_(fan_out, relu), bias-free
+    (`torchvision/models/resnet.py` `_resnet` init loop)."""
+    fan_out = kh * kw * cout
+    std = math.sqrt(2.0 / fan_out)
+    return {"w": std * jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)}
+
+
+def _conv(params, x, *, stride=1, pad=1):
+    return lax.conv_general_dilated(
+        x, params["w"], window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _max_pool_3x3s2p1(x):
+    """torch `MaxPool2d(3, stride=2, padding=1)` (pads with -inf)."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, window_dimensions=(1, 3, 3, 1),
+        window_strides=(1, 2, 2, 1), padding=((0, 0), (1, 1), (1, 1), (0, 0)))
+
+
+def _block_init(key, cin, cout, downsample):
+    keys = jax.random.split(key, 3)
+    params, state = {}, {}
+    params["conv1"] = _conv_init(keys[0], 3, 3, cin, cout)
+    params["bn1"], state["bn1"] = batchnorm_init(cout)
+    params["conv2"] = _conv_init(keys[1], 3, 3, cout, cout)
+    params["bn2"], state["bn2"] = batchnorm_init(cout)
+    if downsample:
+        params["down"] = _conv_init(keys[2], 1, 1, cin, cout)
+        params["dbn"], state["dbn"] = batchnorm_init(cout)
+    return params, state
+
+
+def _block_apply(params, state, x, *, stride, train):
+    new_state = dict(state)
+    out = _conv(params["conv1"], x, stride=stride, pad=1)
+    out, new_state["bn1"] = batchnorm_apply(params["bn1"], state["bn1"], out,
+                                            train=train)
+    out = jax.nn.relu(out)
+    out = _conv(params["conv2"], out, stride=1, pad=1)
+    out, new_state["bn2"] = batchnorm_apply(params["bn2"], state["bn2"], out,
+                                            train=train)
+    if "down" in params:
+        x = _conv(params["down"], x, stride=stride, pad=0)
+        x, new_state["dbn"] = batchnorm_apply(params["dbn"], state["dbn"], x,
+                                              train=train)
+    return jax.nn.relu(out + x), new_state
+
+
+def make_resnet18(num_classes=10, **kwargs):
+    def init(key):
+        keys = jax.random.split(key, 10)
+        params, state = {}, {}
+        params["stem"] = _conv_init(keys[0], 7, 7, 3, 64)
+        params["bn"], state["bn"] = batchnorm_init(64)
+        cin = 64
+        k = 1
+        for s, cout in enumerate(_STAGES):
+            for b in range(2):
+                downsample = b == 0 and (s > 0 or cin != cout)
+                name = f"s{s}b{b}"
+                params[name], state[name] = _block_init(
+                    keys[k], cin, cout, downsample)
+                k += 1
+                cin = cout
+        params["fc"] = dense_init(keys[9], 512, num_classes)
+        return params, state
+
+    def apply(params, state, x, train=False, rng=None):
+        new_state = dict(state)
+        x = _conv(params["stem"], x, stride=2, pad=3)
+        x, new_state["bn"] = batchnorm_apply(params["bn"], state["bn"], x,
+                                             train=train)
+        x = jax.nn.relu(x)
+        x = _max_pool_3x3s2p1(x)
+        for s in range(len(_STAGES)):
+            for b in range(2):
+                name = f"s{s}b{b}"
+                stride = 2 if (s > 0 and b == 0) else 1
+                x, new_state[name] = _block_apply(
+                    params[name], state[name], x, stride=stride, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # adaptive avg pool to 1x1
+        return dense_apply(params["fc"], x), new_state
+
+    return ModelDef("resnet18", init, apply, (32, 32, 3))
+
+
+register("resnet18", make_resnet18)

@@ -1,0 +1,54 @@
+"""`resnet18` registry extension: torchvision architecture parity (param
+count) and a training-step smoke (reference exposes every torchvision model
+by name, `experiments/model.py:40-90`; this pins the registry extending the
+same way)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import attacks, losses, models, ops
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+
+
+def test_resnet18_param_count_matches_torchvision():
+    # torchvision resnet18 has 11,689,512 parameters with the 1000-class fc
+    # (BN running stats are buffers, not parameters — same split here)
+    model_def = models.build("resnet18", num_classes=1000)
+    assert model_def.param_count() == 11_689_512
+    assert models.build("resnet18").param_count() == 11_181_642  # 10-class
+
+
+@pytest.mark.slow
+def test_resnet18_forward_and_step():
+    model_def = models.build("resnet18")
+    params, state = model_def.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                    jnp.float32)
+    out, _ = model_def.apply(params, state, x, train=False,
+                             rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 10)
+    out_t, new_state = model_def.apply(params, state, x, train=True,
+                                       rng=jax.random.PRNGKey(1))
+    assert out_t.shape == (2, 10)
+    # Train mode updates every BN running stat
+    changed = jax.tree.map(
+        lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+        state, new_state)
+    assert any(jax.tree.leaves(changed))
+
+    cfg = EngineConfig(nb_workers=5, nb_decl_byz=1, nb_real_byz=1,
+                       nb_for_study=1, nb_for_study_past=1,
+                       momentum=0.9, momentum_at="update", gradient_clip=2.0)
+    engine = build_engine(
+        cfg=cfg, model_def=model_def, loss=losses.Loss("crossentropy"),
+        criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["median"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+    st = engine.init(jax.random.PRNGKey(0))
+    xs = jnp.zeros((cfg.nb_sampled, 2, 32, 32, 3), jnp.float32)
+    ys = jnp.zeros((cfg.nb_sampled, 2), jnp.int32)
+    st, metrics = engine.train_step(st, xs, ys, jnp.float32(0.01))
+    assert int(st.steps) == 1
+    assert np.isfinite(float(metrics["Defense gradient norm"]))
