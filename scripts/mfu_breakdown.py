@@ -1,0 +1,216 @@
+#!/usr/bin/env python3
+"""Attribute the headline benchmark's step time to its phases (VERDICT r3
+weak #1: "no published breakdown shows what bounds the remaining MFU").
+
+Times, on the real TPU, separately-compiled slices of the n=25 f=5 CIFAR-10
+bulyan cell at the benchmark's own settings (bf16-mixed, M=20 steps per
+dispatch, device-resident data):
+
+  full        — the exact benchmark program (honest + attack + bulyan +
+                update + 24-column study metrics)
+  no_study    — same minus the study-metric computation
+  cheap_agg   — honest + update only (average GAR, no attack): the floor of
+                the honest phase + momentum/update algebra
+  honest_only — just `_phase_honest` (vmapped/grouped fwd+bwd + clip +
+                momentum rows), M dispatches pipelined
+  bulyan_only — the bulyan kernel alone on a live (25, d) matrix
+  empire_only — the empire attack synthesis alone (incl. its defense call)
+
+and derives per-step milliseconds for each attributed term. Writes
+MFU_BREAKDOWN.json at the repo root and prints one JSON line.
+
+Caveat: the `*_only` solo cells carry the per-dispatch host round-trip
+(~2.5 ms/program idle, much more when the host is busy) spread over their
+M=20 in-program iterations; their in-program cost is far smaller. The
+trustworthy attribution is the DELTAS between the full-engine rows
+(`full`, `no_study`, `cheap_agg`, `honest_only`), whose device time
+dominates the dispatch floor.
+
+Usage: python scripts/mfu_breakdown.py [--min-measure-s 4]
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+os.environ.setdefault("BMT_SYNTH_TRAIN", "5000")
+os.environ.setdefault("BMT_SYNTH_TEST", "500")
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from byzantinemomentum_tpu import attacks, data, losses, models, ops  # noqa: E402
+from byzantinemomentum_tpu.data.device import DeviceData  # noqa: E402
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine  # noqa: E402
+
+N, F, BATCH, M = 25, 5, 50, 20
+
+
+def build(nb_for_study, gar_name="bulyan", attack_name="empire"):
+    cfg = EngineConfig(
+        nb_workers=N, nb_decl_byz=F, nb_real_byz=F,
+        nb_for_study=nb_for_study, nb_for_study_past=1,
+        momentum=0.99, momentum_at="update", gradient_clip=5.0,
+        compute_dtype="bfloat16")
+    engine = build_engine(
+        cfg=cfg, model_def=models.build("empire-cnn"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars[gar_name], 1.0, {})],
+        attack=attacks.attacks[attack_name], attack_kwargs={"factor": 1.1})
+    return cfg, engine
+
+
+def timed(dispatch, sync, *, min_s, warmup=2):
+    """steps/s of `dispatch()` (returns a sync handle consumed by `sync`),
+    depth-2 pipelined like bench.py."""
+    for _ in range(warmup):
+        h = dispatch()
+    sync(h)
+    steps = 0
+    pending = []
+    start = time.monotonic()
+    while True:
+        pending.append(dispatch())
+        steps += M
+        if steps >= 400:
+            break
+        if len(pending) >= 2:
+            sync(pending.pop(0))
+            if time.monotonic() - start >= min_s:
+                break
+    for p in pending:
+        sync(p)
+    elapsed = time.monotonic() - start
+    return steps / elapsed
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--min-measure-s", type=float, default=4.0)
+    args = parser.parse_args()
+    min_s = args.min_measure_s
+
+    trainset, _ = data.make_datasets("cifar10", BATCH, BATCH, seed=0)
+    train_data = DeviceData(trainset)
+    lrs = jnp.full((M,), 0.01, jnp.float32)
+    rates = {}
+
+    # --- full benchmark program and ablations --- #
+    for name, nb_study, gar, atk in (
+            ("full", 1, "bulyan", "empire"),
+            ("no_study", 0, "bulyan", "empire"),
+            ("cheap_agg", 0, "average", "empire")):
+        cfg, engine = build(nb_study, gar, atk)
+        engine.attach_data(train_data)
+        state = engine.init(jax.random.PRNGKey(0))
+        S = cfg.nb_sampled
+
+        def dispatch():
+            idx, flips = train_data.sample_indices(S * M)
+            nonlocal state
+            state, metrics = engine.train_multi_indexed(
+                state,
+                jnp.asarray(idx.reshape((M, S) + idx.shape[1:])),
+                jnp.asarray(flips.reshape((M, S) + flips.shape[1:])), lrs)
+            return metrics.get("Defense gradient norm", state.steps + 0)
+
+        rates[name] = timed(dispatch, lambda h: np.asarray(h), min_s=min_s)
+
+    # --- honest phase only (M pipelined dispatches of _phase_honest) --- #
+    cfg, engine = build(0)
+    engine.attach_data(train_data)
+    state = engine.init(jax.random.PRNGKey(0))
+    S = cfg.nb_sampled
+
+    def honest_multi(state, idx, flips, lr):
+        def body(st, inp):
+            i, fl = inp
+            xs, ys = train_data.gather(i, fl)
+            out = engine._phase_honest(st, xs, ys, lr)
+            # Thread rng through so the M iterations are sequential like the
+            # real program; consume the WHOLE honest matrix (a row-0-only
+            # payload would let XLA dead-code-eliminate the other rows'
+            # clip scaling)
+            st = st._replace(rng=out[0])
+            return st, jnp.sum(out[6])
+        return jax.lax.scan(body, state, (idx, flips))
+
+    honest_jit = jax.jit(honest_multi)
+
+    def dispatch_honest():
+        idx, flips = train_data.sample_indices(S * M)
+        nonlocal_state = dispatch_honest.state
+        st, payload = honest_jit(
+            nonlocal_state,
+            jnp.asarray(idx.reshape((M, S) + idx.shape[1:])),
+            jnp.asarray(flips.reshape((M, S) + flips.shape[1:])),
+            jnp.float32(0.01))
+        dispatch_honest.state = st
+        return payload
+
+    dispatch_honest.state = state
+    rates["honest_only"] = timed(dispatch_honest, lambda h: np.asarray(h),
+                                 min_s=min_s)
+
+    # --- bulyan kernel alone on a live (N, d) matrix --- #
+    d = engine.d
+    G = jax.random.normal(jax.random.PRNGKey(1), (N, d), jnp.float32)
+
+    @jax.jit
+    def bulyan_multi(G):
+        def body(carry, _):
+            out = ops.gars["bulyan"].unchecked(G + carry, f=F)
+            return jnp.sum(out) * 1e-20, out[0]
+        return jax.lax.scan(body, jnp.float32(0.0), None, length=M)
+
+    rates["bulyan_only"] = timed(lambda: bulyan_multi(G)[1],
+                                 lambda h: np.asarray(h), min_s=min_s)
+
+    # --- empire attack synthesis alone (with its one defense call) --- #
+    Gh = jax.random.normal(jax.random.PRNGKey(2), (N - F, d), jnp.float32)
+    defense = lambda gradients, f: ops.gars["bulyan"].unchecked(gradients, f=f)
+
+    @jax.jit
+    def empire_multi(Gh):
+        def body(carry, _):
+            byz = attacks.attacks["empire"].unchecked(
+                Gh + carry, f_decl=F, f_real=F, defense=defense, factor=1.1)
+            return jnp.sum(byz) * 1e-20, byz[0, 0]
+        return jax.lax.scan(body, jnp.float32(0.0), None, length=M)
+
+    rates["empire_only"] = timed(lambda: empire_multi(Gh)[1],
+                                 lambda h: np.asarray(h), min_s=min_s)
+
+    ms = {k: 1000.0 / v for k, v in rates.items()}
+    breakdown = {
+        "study_metrics_ms": ms["full"] - ms["no_study"],
+        "attack_plus_gar_ms": ms["no_study"] - ms["cheap_agg"],
+        "honest_phase_ms": ms["honest_only"],
+        "update_and_rest_ms": ms["cheap_agg"] - ms["honest_only"],
+        "bulyan_kernel_solo_ms": ms["bulyan_only"],
+        "empire_attack_solo_ms": ms["empire_only"],
+        "full_step_ms": ms["full"],
+    }
+    out = {
+        "config": f"CIFAR-10 empire-cnn n={N} f={F} batch {BATCH} "
+                  f"bulyan vs empire(1.1), bf16-mixed, M={M} steps/dispatch, "
+                  "device-resident data (the BENCH_r* headline cell)",
+        "steps_per_sec": rates,
+        "per_step_ms": ms,
+        "attribution_ms": breakdown,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    path = pathlib.Path(__file__).resolve().parent.parent / "MFU_BREAKDOWN.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
