@@ -123,7 +123,12 @@ def avg_dev_max(samples):
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--steps", type=int, default=20,
+                        help="steps per measurement window")
+    parser.add_argument("--windows", type=int, default=3,
+                        help="number of measurement windows; the recorded "
+                             "rate is the mean across windows and the "
+                             "per-window rates carry the spread")
     args = parser.parse_args()
 
     assert N_WORKERS >= 4 * F_DECL + 3, (
@@ -181,11 +186,17 @@ def main():
         agg.norm().item(), agg.abs().max().item()
 
     one_step()  # warmup (allocator, thread pools)
-    start = time.monotonic()
-    for _ in range(args.steps):
-        one_step()
-    elapsed = time.monotonic() - start
-    steps_per_sec = args.steps / elapsed
+    window_rates = []
+    elapsed_total = 0.0
+    for _ in range(args.windows):
+        start = time.monotonic()
+        for _ in range(args.steps):
+            one_step()
+        elapsed = time.monotonic() - start
+        elapsed_total += elapsed
+        window_rates.append(args.steps / elapsed)
+    steps_per_sec = float(np.mean(window_rates))
+    spread = float(np.std(window_rates, ddof=1)) if args.windows > 1 else 0.0
 
     out = {
         "metric": "sim_steps_per_sec",
@@ -194,8 +205,11 @@ def main():
                   "nb-for-study=1 (20 backprops/step), torch-CPU "
                   "reference-style loop",
         "torch_cpu_steps_per_sec": steps_per_sec,
-        "elapsed_s": elapsed,
-        "steps": args.steps,
+        "window_steps_per_sec": window_rates,
+        "window_spread_std": spread,
+        "elapsed_s": elapsed_total,
+        "steps": args.steps * args.windows,
+        "windows": args.windows,
     }
     path = pathlib.Path(__file__).resolve().parent.parent / "BASELINE_MEASURED.json"
     path.write_text(json.dumps(out, indent=2) + "\n")
