@@ -128,6 +128,7 @@ def _cnn_engine(nb_workers=4, nb_real_byz=1, nb_for_study=5, **kw):
     return cfg, engine
 
 
+@pytest.mark.slow
 def test_empire_cnn_step_composes_bn_exactly():
     """One engine step on empire-cnn (with S = nb_for_study > nb_honests
     study extras, all of which update BN stats in the reference,
@@ -159,6 +160,7 @@ def test_empire_cnn_step_composes_bn_exactly():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_empire_cnn_local_steps_compose_bn_exactly():
     """Same oracle with nb_local_steps=2: stats must fold worker-major over
     every local step's batch (the capability the reference gates off,
@@ -194,6 +196,7 @@ def test_empire_cnn_local_steps_compose_bn_exactly():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_empire_cnn_train_eval_smoke():
     """empire-cnn learns the synthetic CIFAR prototypes well above chance,
     and eval consumes the composed running stats without blowing up."""
@@ -221,6 +224,7 @@ def test_empire_cnn_train_eval_smoke():
     assert not np.allclose(np.asarray(state.net_state["b1"]["mean"]), 0.0)
 
 
+@pytest.mark.slow
 def test_wide_resnet_forward_and_step():
     """wide_resnet builds, runs forward with the right output shape, and
     takes one finite training step (small depth/width for CI speed)."""

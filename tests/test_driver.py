@@ -24,6 +24,7 @@ BASE = ["--nb-steps", "3", "--batch-size", "8", "--batch-size-test", "32",
         "--model", "simples-full", "--seed", "11"]
 
 
+@pytest.mark.slow
 def test_smoke_run_with_study(tmp_path):
     resdir = tmp_path / "run"
     rc = main(BASE + ["--gar", "median", "--attack", "empire",
@@ -53,6 +54,7 @@ def test_smoke_run_with_study(tmp_path):
     assert len([l for l in lines[1:] if l]) == 2  # steps 0 and 2
 
 
+@pytest.mark.slow
 def test_seeded_runs_are_reproducible(tmp_path):
     out = []
     for sub in ("a", "b"):
@@ -64,6 +66,7 @@ def test_seeded_runs_are_reproducible(tmp_path):
     assert out[0] == out[1]
 
 
+@pytest.mark.slow
 def test_resume_continues_exactly(tmp_path):
     """A 2-step run checkpointed at step 2 resumes at exactly step 2 and
     reproduces the uninterrupted run's remaining study rows AND evaluations
@@ -121,6 +124,7 @@ def test_local_steps_capability(tmp_path):
     assert int(rows[1].split("\t")[1]) == 8 * 11 * 2
 
 
+@pytest.mark.slow
 def test_steps_per_program_trajectory_identical(tmp_path):
     """Fusing M steps into one dispatch (lax.scan) must not change the
     trajectory: study/eval CSVs byte-identical to single-step dispatch."""
@@ -140,6 +144,7 @@ def test_steps_per_program_trajectory_identical(tmp_path):
     assert outs[0][1] == outs[1][1]
 
 
+@pytest.mark.slow
 def test_transformer_model_via_cli(tmp_path):
     """The sequence-model family trains through the standard driver: MNIST
     rows tokenize as a length-28 sequence (models/transformer.py)."""
@@ -217,6 +222,7 @@ def test_anticge_vs_cge_via_cli(tmp_path):
     assert all(np.isfinite(v) and 0.0 <= v <= 1.0 for v in ratios)
 
 
+@pytest.mark.slow
 def test_bulyan_attack_adaptive_via_cli(tmp_path):
     """The 'Hidden Vulnerability' attack with an adaptive (negative) factor
     against the Bulyan defense: the in-graph line search evaluates the live
@@ -234,6 +240,7 @@ def test_bulyan_attack_adaptive_via_cli(tmp_path):
     assert all(np.isfinite(float(r.split("\t")[defense_idx])) for r in rows)
 
 
+@pytest.mark.slow
 def test_device_gar_cpu_matches_fused(tmp_path):
     """`--device-gar cpu` (reference heterogeneous placement,
     `attack.py:811-827`): the defense phase runs as a separate program on

@@ -173,6 +173,7 @@ def test_cli_dtype_smoke(tmp_path, small_synth, dtype, fmt_digits):
     assert len(mantissa) == fmt_digits
 
 
+@pytest.mark.slow
 def test_cli_mixed_precision_smoke(tmp_path, small_synth):
     resdir = tmp_path / "mp"
     rc = main(["--nb-steps", "2", "--batch-size", "8",

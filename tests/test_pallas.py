@@ -27,7 +27,8 @@ def _mat(n, d, seed=0, nan_frac=0.0, dup_frac=0.0):
 NS = (1, 2, 3, 13, 25, 51)
 
 
-@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("n", NS[:4] + tuple(
+    pytest.param(n, marks=pytest.mark.slow) for n in NS[4:]))
 @pytest.mark.parametrize("nan_frac", (0.0, 0.05, 0.6))
 def test_colsort_matches_jnp_sort(n, nan_frac):
     g = jnp.asarray(_mat(n, 1000, seed=n, nan_frac=nan_frac))

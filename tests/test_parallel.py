@@ -55,7 +55,10 @@ def test_pairwise_distances_sharded_matches_local(mesh1d):
 
 @pytest.mark.parametrize("name,kwargs", [
     ("median", {}), ("trmean", {}), ("phocas", {}), ("meamed", {}),
-    ("average", {}), ("krum", {}), ("bulyan", {}), ("brute", {}),
+    ("average", {}),
+    pytest.param("krum", {}, marks=pytest.mark.slow),
+    pytest.param("bulyan", {}, marks=pytest.mark.slow),
+    pytest.param("brute", {}, marks=pytest.mark.slow),
 ])
 def test_shard_gar_matches_single_device(mesh1d, name, kwargs):
     rng = np.random.default_rng(1)
@@ -69,7 +72,11 @@ def test_shard_gar_matches_single_device(mesh1d, name, kwargs):
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("name", ["median", "krum", "bulyan", "brute"])
+@pytest.mark.parametrize("name", [
+    "median",
+    pytest.param("krum", marks=pytest.mark.slow),
+    pytest.param("bulyan", marks=pytest.mark.slow),
+    pytest.param("brute", marks=pytest.mark.slow)])
 def test_shard_gar_nan_rows_match_single_device(mesh1d, name):
     """f NaN rows: the d-sharded kernels reproduce the single-device result
     (the psum'd distances carry the +inf convention across shards)."""
@@ -85,7 +92,8 @@ def test_shard_gar_nan_rows_match_single_device(mesh1d, name):
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("name", ["median", "trmean", "bulyan"])
+@pytest.mark.parametrize("name", [
+    "median", "trmean", pytest.param("bulyan", marks=pytest.mark.slow)])
 def test_shard_gar_pallas_engaged_matches(mesh1d, name, monkeypatch):
     """With `BMT_PALLAS_INTERPRET=1` the shard-local bodies run the REAL
     Pallas sorting-network kernels (interpret mode off-TPU) inside
@@ -104,6 +112,7 @@ def test_shard_gar_pallas_engaged_matches(mesh1d, name, monkeypatch):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_shard_gar_pads_indivisible_d(mesh1d):
     """The engine-facing facade pads d up to the model-axis size and slices
     back — results match on a d that does NOT divide the 8 shards."""
@@ -188,6 +197,7 @@ def test_sharded_step_matches_unsharded_bulyan():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sharded_step_grouped_cnn_matches_unsharded():
     """The shard-mapped grouped honest phase (`grouped_sharded`): empire-cnn
     (grouped convs + per-worker BN batch stats + per-worker dropout keys)
@@ -287,6 +297,7 @@ def test_cli_mesh_indivisible_test_batch_falls_back(tmp_path):
     assert (resdir / "eval").is_file()
 
 
+@pytest.mark.slow
 def test_graft_entry_and_dryrun():
     import __graft_entry__ as graft
     fn, args = graft.entry()
@@ -295,6 +306,7 @@ def test_graft_entry_and_dryrun():
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_cli_mesh_flag_matches_unsharded(tmp_path):
     """`--mesh 4x2` runs the driver's sharded path on the virtual 8-device
     mesh; the trajectory matches the unsharded run up to collective
@@ -360,6 +372,7 @@ def test_pallas_disabled_context():
     assert pallas_sort.supported(g, interpret=True)
 
 
+@pytest.mark.slow
 def test_cli_mesh_checkpoint_resume(tmp_path):
     """Checkpoint + resume through the sharded path: sharded device arrays
     serialize (gather on save) and the resumed mesh run continues exactly

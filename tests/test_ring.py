@@ -113,6 +113,7 @@ def test_transformer_sequence_sharded_matches_dense(impl):
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_transformer_engine_step():
     """transformer-classifier plugs into the full engine (vmapped workers,
     GAR, momentum) like any registered model."""

@@ -133,6 +133,7 @@ def analysis_grid(tmp_path_factory):
     return data_dir
 
 
+@pytest.mark.slow
 def test_reproduce_analysis_buckets_and_plots(analysis_grid, tmp_path, capsys):
     """The ported reference analysis (reproduce.py:258-366, :459-635):
     bucket statistics printed per subset, comparison + ratio plots saved."""
