@@ -1,16 +1,19 @@
-"""`resnet18` — a standard torchvision model, as a pure-pytree ModelDef.
+"""`resnet18` / `resnet34` — standard torchvision models, as pure-pytree
+ModelDefs.
 
 The reference exposes every `torchvision.models` entry point by name
 (reference `experiments/model.py:40-90`); this repo's registry is the
 grid-parity set (see PARITY.md "registry scoping"), and this module shows
 the registry extending to the torchvision zoo the same way: torchvision's
-`resnet18` architecture and initialization, NHWC/HWIO, no module framework.
+BasicBlock resnets' architecture and initialization, NHWC/HWIO, no module
+framework.
 
-Architecture (torchvision `resnet.py` BasicBlock [2, 2, 2, 2]):
+Architecture (torchvision `resnet.py`; resnet18 = BasicBlock [2, 2, 2, 2],
+resnet34 = [3, 4, 6, 3]):
   conv7x7(3,64,s2,p3,nobias) bn relu maxpool3x3(s2,p1),
-  4 stages of 2 BasicBlocks (64, 128, 256, 512; first block of stages 2-4
-  downsamples with stride 2 + 1x1 projection), global average pool,
-  fc(512, num_classes).
+  4 stages of [depth-dependent] BasicBlocks (64, 128, 256, 512 channels;
+  first block of stages 2-4 downsamples with stride 2 + 1x1 projection),
+  global average pool, fc(512, num_classes).
 BasicBlock: conv3x3 bn relu conv3x3 bn, + identity/projection, relu.
 
 Initialization parity with torchvision: kaiming-normal(fan_out, relu) conv
@@ -85,23 +88,25 @@ def _block_apply(params, state, x, *, stride, train):
     return jax.nn.relu(out + x), new_state
 
 
-def make_resnet18(num_classes=10, **kwargs):
+def _make_resnet(name, blocks, num_classes=10):
+    n_blocks = sum(blocks)
+
     def init(key):
-        keys = jax.random.split(key, 10)
+        keys = jax.random.split(key, n_blocks + 2)
         params, state = {}, {}
         params["stem"] = _conv_init(keys[0], 7, 7, 3, 64)
         params["bn"], state["bn"] = batchnorm_init(64)
         cin = 64
         k = 1
         for s, cout in enumerate(_STAGES):
-            for b in range(2):
+            for b in range(blocks[s]):
                 downsample = b == 0 and (s > 0 or cin != cout)
-                name = f"s{s}b{b}"
-                params[name], state[name] = _block_init(
+                bname = f"s{s}b{b}"
+                params[bname], state[bname] = _block_init(
                     keys[k], cin, cout, downsample)
                 k += 1
                 cin = cout
-        params["fc"] = dense_init(keys[9], 512, num_classes)
+        params["fc"] = dense_init(keys[n_blocks + 1], 512, num_classes)
         return params, state
 
     def apply(params, state, x, train=False, rng=None):
@@ -112,15 +117,24 @@ def make_resnet18(num_classes=10, **kwargs):
         x = jax.nn.relu(x)
         x = _max_pool_3x3s2p1(x)
         for s in range(len(_STAGES)):
-            for b in range(2):
-                name = f"s{s}b{b}"
+            for b in range(blocks[s]):
+                bname = f"s{s}b{b}"
                 stride = 2 if (s > 0 and b == 0) else 1
-                x, new_state[name] = _block_apply(
-                    params[name], state[name], x, stride=stride, train=train)
+                x, new_state[bname] = _block_apply(
+                    params[bname], state[bname], x, stride=stride, train=train)
         x = jnp.mean(x, axis=(1, 2))  # adaptive avg pool to 1x1
         return dense_apply(params["fc"], x), new_state
 
-    return ModelDef("resnet18", init, apply, (32, 32, 3))
+    return ModelDef(name, init, apply, (32, 32, 3))
+
+
+def make_resnet18(num_classes=10, **kwargs):
+    return _make_resnet("resnet18", (2, 2, 2, 2), num_classes)
+
+
+def make_resnet34(num_classes=10, **kwargs):
+    return _make_resnet("resnet34", (3, 4, 6, 3), num_classes)
 
 
 register("resnet18", make_resnet18)
+register("resnet34", make_resnet34)

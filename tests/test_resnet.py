@@ -20,6 +20,11 @@ def test_resnet18_param_count_matches_torchvision():
     assert models.build("resnet18").param_count() == 11_181_642  # 10-class
 
 
+def test_resnet34_param_count_matches_torchvision():
+    assert models.build("resnet34",
+                        num_classes=1000).param_count() == 21_797_672
+
+
 @pytest.mark.slow
 def test_resnet18_forward_and_step():
     model_def = models.build("resnet18")
