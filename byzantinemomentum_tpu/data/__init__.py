@@ -11,10 +11,11 @@ the reference itself uses for tensor-level datasets, `dataset.py:315-328`)
 instead of emitting a short batch.
 
 Transforms follow the reference's defaults (`dataset.py:32-49`): MNIST
-normalization (0.1307, 0.3081); CIFAR normalization (0.4914, 0.4822, 0.4465)
-/ (0.2023, 0.1994, 0.2010) + random horizontal flip; FashionMNIST random
-horizontal flip. Note the reference applies the *same* transform list to the
-test set (flips included) — that quirk is preserved.
+normalization (0.1307, 0.3081); KMNIST normalization (0.1918, 0.3483);
+CIFAR normalization (0.4914, 0.4822, 0.4465) / (0.2023, 0.1994, 0.2010) +
+random horizontal flip; FashionMNIST random horizontal flip. Note the
+reference applies the *same* transform list to the test set (flips
+included) — that quirk is preserved.
 
 Raw data is loaded from disk when present (see `sources.py` for search paths
 and the pure-numpy idx/pickle parsers); otherwise a deterministic synthetic
@@ -41,6 +42,7 @@ datasets = {}
 # applied after scaling to [0, 1] (reference `dataset.py:32-49`).
 normalizations = {
     "mnist": ((0.1307,), (0.3081,)),
+    "kmnist": ((0.1918,), (0.3483,)),
     "cifar10": ((0.4914, 0.4822, 0.4465), (0.2023, 0.1994, 0.2010)),
     "cifar100": ((0.4914, 0.4822, 0.4465), (0.2023, 0.1994, 0.2010)),
 }
@@ -282,6 +284,10 @@ def batch_dataset(inputs, labels, *, train=False, batch_size=None,
 
 register("mnist", lambda **kw: sources.load_mnist("mnist", **kw))
 register("fashionmnist", lambda **kw: sources.load_mnist("fashionmnist", **kw))
+# KMNIST ships in the same idx format under KMNIST/raw/ — the registry
+# extends to further torchvision dataset names with the existing parsers
+# (normalization constants from torchvision's KMNIST docs)
+register("kmnist", lambda **kw: sources.load_mnist("kmnist", **kw))
 register("cifar10", lambda **kw: sources.load_cifar(10, **kw))
 register("cifar100", lambda **kw: sources.load_cifar(100, **kw))
 

@@ -6,7 +6,9 @@ The reference delegates parsing/downloading to torchvision
 environment has no torchvision and no network egress, so the parsers are
 implemented directly against the published file formats:
 
-* MNIST / FashionMNIST — idx ubyte files (optionally gzipped).
+* MNIST family (MNIST / FashionMNIST / KMNIST) — idx ubyte files
+  (optionally gzipped; bare filenames without the dataset subdir are only
+  accepted for plain MNIST, since the family shares filenames).
 * CIFAR-10 / CIFAR-100 — the python-pickle batch files (optionally inside the
   distribution .tar.gz).
 
@@ -79,15 +81,23 @@ _MNIST_FILES = {
 
 
 def load_mnist(name, **unused):
-    """Load MNIST or FashionMNIST from disk, else synthesize.
+    """Load an MNIST-family dataset (mnist, fashionmnist, kmnist) from
+    disk, else synthesize.
 
     Returns dict(train_x u8[N,28,28,1], train_y i32[N], test_x, test_y).
+
+    The three datasets ship IDENTICAL idx filenames, so bare (un-subdired)
+    filenames are only accepted for plain `mnist` — otherwise a cached
+    MNIST tree would silently satisfy a kmnist/fashionmnist request with
+    the wrong images.
     """
     out = {}
-    subdir = {"mnist": "MNIST", "fashionmnist": "FashionMNIST"}[name]
+    subdir = {"mnist": "MNIST", "fashionmnist": "FashionMNIST",
+              "kmnist": "KMNIST"}[name]
     for key, names in _MNIST_FILES.items():
-        cands = tuple(f"{subdir}/raw/{n}" for n in names) + names \
-            + tuple(n + ".gz" for n in names)
+        cands = tuple(f"{subdir}/raw/{n}" for n in names)
+        if name == "mnist":
+            cands += names + tuple(n + ".gz" for n in names)
         path = _find(*cands)
         if path is None:
             utils.trace(f"{name}: raw files not found on disk; using the "

@@ -92,3 +92,19 @@ def test_batch_dataset_split_semantics():
     assert len(tr) == 8
     x, y = tr.sample()
     assert x.shape == (4, 2)
+
+
+def test_kmnist_registered_with_own_normalization():
+    """KMNIST extends the dataset registry through the existing idx parser
+    (the reference exposes every torchvision dataset by name,
+    `dataset.py:100-163`); torchvision's KMNIST normalization constants
+    apply and no flip is in the default transform."""
+    assert "kmnist" in data.datasets
+    assert data.normalizations["kmnist"] == ((0.1918,), (0.3483,))
+    assert "kmnist" not in data.flip_train
+    tr, te = data.make_datasets("kmnist", 16, 16)
+    x, y = tr.sample()
+    assert x.shape == (16, 28, 28, 1)
+    # Normalized around the KMNIST mean, not raw [0, 1]
+    assert float(x.min()) < -0.4
+    assert set(np.unique(y)) <= set(range(10))
