@@ -59,6 +59,19 @@ def _find(*names):
     return None
 
 
+def _find_top(*names):
+    """Like `_find` but base-level only — for bare filenames that would
+    otherwise glob into a SIBLING dataset's subdir (the MNIST family shares
+    idx filenames, so `data/KMNIST/raw/train-images-idx3-ubyte` must not
+    satisfy a plain-mnist request)."""
+    for base in data_dirs():
+        for name in names:
+            cand = base / name
+            if cand.is_file():
+                return cand
+    return None
+
+
 # --------------------------------------------------------------------------- #
 # idx (MNIST family)
 
@@ -87,18 +100,21 @@ def load_mnist(name, **unused):
     Returns dict(train_x u8[N,28,28,1], train_y i32[N], test_x, test_y).
 
     The three datasets ship IDENTICAL idx filenames, so bare (un-subdired)
-    filenames are only accepted for plain `mnist` — otherwise a cached
-    MNIST tree would silently satisfy a kmnist/fashionmnist request with
-    the wrong images.
+    filenames are only accepted for plain `mnist`, and only at the top
+    level of a data dir — otherwise a cached tree of one family member
+    would silently satisfy another member's request with the wrong images.
     """
     out = {}
     subdir = {"mnist": "MNIST", "fashionmnist": "FashionMNIST",
               "kmnist": "KMNIST"}[name]
     for key, names in _MNIST_FILES.items():
-        cands = tuple(f"{subdir}/raw/{n}" for n in names)
-        if name == "mnist":
-            cands += names + tuple(n + ".gz" for n in names)
+        cands = tuple(f"{subdir}/raw/{n}" for n in names) \
+            + tuple(f"{subdir}/raw/{n}.gz" for n in names)
         path = _find(*cands)
+        if path is None and name == "mnist":
+            # Bare filenames: base-level only (a glob would cross-match a
+            # sibling family dataset's raw/ directory)
+            path = _find_top(*names, *(n + ".gz" for n in names))
         if path is None:
             utils.trace(f"{name}: raw files not found on disk; using the "
                         "deterministic synthetic fallback")

@@ -1,20 +1,22 @@
-"""`resnet18` / `resnet34` — standard torchvision models, as pure-pytree
-ModelDefs.
+"""`resnet18` / `resnet34` / `resnet50` — standard torchvision models, as
+pure-pytree ModelDefs.
 
 The reference exposes every `torchvision.models` entry point by name
 (reference `experiments/model.py:40-90`); this repo's registry is the
 grid-parity set (see PARITY.md "registry scoping"), and this module shows
 the registry extending to the torchvision zoo the same way: torchvision's
-BasicBlock resnets' architecture and initialization, NHWC/HWIO, no module
-framework.
+resnets' architecture and initialization, NHWC/HWIO, no module framework.
 
 Architecture (torchvision `resnet.py`; resnet18 = BasicBlock [2, 2, 2, 2],
-resnet34 = [3, 4, 6, 3]):
+resnet34 = BasicBlock [3, 4, 6, 3], resnet50 = Bottleneck [3, 4, 6, 3]):
   conv7x7(3,64,s2,p3,nobias) bn relu maxpool3x3(s2,p1),
-  4 stages of [depth-dependent] BasicBlocks (64, 128, 256, 512 channels;
+  4 stages of [depth-dependent] blocks (64, 128, 256, 512 base channels;
   first block of stages 2-4 downsamples with stride 2 + 1x1 projection),
-  global average pool, fc(512, num_classes).
+  global average pool, fc(512*expansion, num_classes).
 BasicBlock: conv3x3 bn relu conv3x3 bn, + identity/projection, relu.
+Bottleneck (expansion 4, torchvision v1.5: stride on the 3x3 conv):
+  conv1x1 bn relu conv3x3(s) bn relu conv1x1(4w) bn, + identity/projection,
+  relu.
 
 Initialization parity with torchvision: kaiming-normal(fan_out, relu) conv
 kernels (no biases), BN gamma=1/beta=0, torch-default fc init. On CIFAR
@@ -88,8 +90,44 @@ def _block_apply(params, state, x, *, stride, train):
     return jax.nn.relu(out + x), new_state
 
 
-def _make_resnet(name, blocks, num_classes=10):
+def _bottleneck_init(key, cin, width, downsample):
+    keys = jax.random.split(key, 4)
+    params, state = {}, {}
+    params["conv1"] = _conv_init(keys[0], 1, 1, cin, width)
+    params["bn1"], state["bn1"] = batchnorm_init(width)
+    params["conv2"] = _conv_init(keys[1], 3, 3, width, width)
+    params["bn2"], state["bn2"] = batchnorm_init(width)
+    params["conv3"] = _conv_init(keys[2], 1, 1, width, 4 * width)
+    params["bn3"], state["bn3"] = batchnorm_init(4 * width)
+    if downsample:
+        params["down"] = _conv_init(keys[3], 1, 1, cin, 4 * width)
+        params["dbn"], state["dbn"] = batchnorm_init(4 * width)
+    return params, state
+
+
+def _bottleneck_apply(params, state, x, *, stride, train):
+    new_state = dict(state)
+    out = _conv(params["conv1"], x, stride=1, pad=0)
+    out, new_state["bn1"] = batchnorm_apply(params["bn1"], state["bn1"], out,
+                                            train=train)
+    out = jax.nn.relu(out)
+    out = _conv(params["conv2"], out, stride=stride, pad=1)
+    out, new_state["bn2"] = batchnorm_apply(params["bn2"], state["bn2"], out,
+                                            train=train)
+    out = jax.nn.relu(out)
+    out = _conv(params["conv3"], out, stride=1, pad=0)
+    out, new_state["bn3"] = batchnorm_apply(params["bn3"], state["bn3"], out,
+                                            train=train)
+    if "down" in params:
+        x = _conv(params["down"], x, stride=stride, pad=0)
+        x, new_state["dbn"] = batchnorm_apply(params["dbn"], state["dbn"], x,
+                                              train=train)
+    return jax.nn.relu(out + x), new_state
+
+
+def _make_resnet(name, blocks, num_classes=10, bottleneck=False):
     n_blocks = sum(blocks)
+    expansion = 4 if bottleneck else 1
 
     def init(key):
         keys = jax.random.split(key, n_blocks + 2)
@@ -98,16 +136,24 @@ def _make_resnet(name, blocks, num_classes=10):
         params["bn"], state["bn"] = batchnorm_init(64)
         cin = 64
         k = 1
-        for s, cout in enumerate(_STAGES):
+        for s, width in enumerate(_STAGES):
+            cout = width * expansion
             for b in range(blocks[s]):
                 downsample = b == 0 and (s > 0 or cin != cout)
                 bname = f"s{s}b{b}"
-                params[bname], state[bname] = _block_init(
-                    keys[k], cin, cout, downsample)
+                if bottleneck:
+                    params[bname], state[bname] = _bottleneck_init(
+                        keys[k], cin, width, downsample)
+                else:
+                    params[bname], state[bname] = _block_init(
+                        keys[k], cin, cout, downsample)
                 k += 1
                 cin = cout
-        params["fc"] = dense_init(keys[n_blocks + 1], 512, num_classes)
+        params["fc"] = dense_init(keys[n_blocks + 1], 512 * expansion,
+                                  num_classes)
         return params, state
+
+    block_apply = _bottleneck_apply if bottleneck else _block_apply
 
     def apply(params, state, x, train=False, rng=None):
         new_state = dict(state)
@@ -120,7 +166,7 @@ def _make_resnet(name, blocks, num_classes=10):
             for b in range(blocks[s]):
                 bname = f"s{s}b{b}"
                 stride = 2 if (s > 0 and b == 0) else 1
-                x, new_state[bname] = _block_apply(
+                x, new_state[bname] = block_apply(
                     params[bname], state[bname], x, stride=stride, train=train)
         x = jnp.mean(x, axis=(1, 2))  # adaptive avg pool to 1x1
         return dense_apply(params["fc"], x), new_state
@@ -136,5 +182,11 @@ def make_resnet34(num_classes=10, **kwargs):
     return _make_resnet("resnet34", (3, 4, 6, 3), num_classes)
 
 
+def make_resnet50(num_classes=10, **kwargs):
+    return _make_resnet("resnet50", (3, 4, 6, 3), num_classes,
+                        bottleneck=True)
+
+
 register("resnet18", make_resnet18)
 register("resnet34", make_resnet34)
+register("resnet50", make_resnet50)

@@ -23,7 +23,7 @@ from byzantinemomentum_tpu import models, ops, utils
 __all__ = ["Session", "LinePlot", "BoxPlot", "display", "select", "discard"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
-TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000,
+TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000, "kmnist": 60000,
                   "cifar10": 50000, "cifar100": 50000}
 
 

@@ -25,6 +25,18 @@ def test_resnet34_param_count_matches_torchvision():
                         num_classes=1000).param_count() == 21_797_672
 
 
+def test_resnet50_param_count_and_forward():
+    # torchvision resnet50 (Bottleneck [3,4,6,3], expansion 4)
+    assert models.build("resnet50",
+                        num_classes=1000).param_count() == 25_557_032
+    model_def = models.build("resnet50")
+    params, state = model_def.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    out, _ = model_def.apply(params, state, x, train=False,
+                             rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 10)
+
+
 @pytest.mark.slow
 def test_resnet18_forward_and_step():
     model_def = models.build("resnet18")

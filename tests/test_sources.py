@@ -147,3 +147,47 @@ def test_fallback_when_no_files(data_dir, monkeypatch):
     # Deterministic across calls (crc32-seeded, not hash())
     again = sources.load_mnist("mnist")
     np.testing.assert_array_equal(out["train_x"], again["train_x"])
+
+
+def test_mnist_family_does_not_cross_load(data_dir):
+    """The MNIST family shares idx filenames; a cached MNIST tree must NOT
+    satisfy a kmnist request (and vice versa) — each family member loads
+    only from its own subdir, falling back to synthetic otherwise. Gzipped
+    subdir files load for every member."""
+    rng = np.random.default_rng(7)
+    tr_x = rng.integers(0, 256, (12, 28, 28)).astype(np.uint8)
+    tr_y = rng.integers(0, 10, 12).astype(np.uint8)
+    te_x = rng.integers(0, 256, (4, 28, 28)).astype(np.uint8)
+    te_y = rng.integers(0, 10, 4).astype(np.uint8)
+    raw = data_dir / "MNIST" / "raw"
+    raw.mkdir(parents=True)
+    _write_idx_images(raw / "train-images-idx3-ubyte", tr_x)
+    _write_idx_labels(raw / "train-labels-idx1-ubyte", tr_y)
+    _write_idx_images(raw / "t10k-images-idx3-ubyte", te_x)
+    _write_idx_labels(raw / "t10k-labels-idx1-ubyte", te_y)
+    # kmnist must not pick up the MNIST files
+    out = sources.load_mnist("kmnist")
+    assert out.get("synthetic"), "kmnist silently loaded MNIST raw files"
+    # and mnist itself must not pick up a KMNIST-only tree
+    out = sources.load_mnist("mnist")
+    np.testing.assert_array_equal(out["train_x"][..., 0], tr_x)
+
+    kraw = data_dir / "KMNIST" / "raw"
+    kraw.mkdir(parents=True)
+    ktr_x = rng.integers(0, 256, (10, 28, 28)).astype(np.uint8)
+    for name, arr, writer in (
+            ("train-images-idx3-ubyte", ktr_x, _write_idx_images),
+            ("train-labels-idx1-ubyte", tr_y[:10], _write_idx_labels),
+            ("t10k-images-idx3-ubyte", te_x, _write_idx_images),
+            ("t10k-labels-idx1-ubyte", te_y, _write_idx_labels)):
+        # gzipped variant: subdir .gz candidates must load
+        import gzip as _gz
+        buf = io.BytesIO()
+        tmp = kraw / (name + ".tmp")
+        writer(tmp, arr)
+        with open(tmp, "rb") as fd, _gz.open(kraw / (name + ".gz"), "wb") as gz:
+            gz.write(fd.read())
+        tmp.unlink()
+    out = sources.load_mnist("kmnist")
+    assert "synthetic" not in out
+    np.testing.assert_array_equal(out["train_x"][..., 0], ktr_x)
