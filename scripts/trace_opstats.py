@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Aggregate a jax.profiler xplane trace into per-HLO-op device times.
+
+The TPU-side complement of `scripts/mfu_breakdown.py` (see PERF_NOTES.md):
+capture a trace of the program under study, then attribute device time to
+individual fusions/ops —
+
+    import jax
+    jax.profiler.start_trace("/tmp/my_trace")
+    ...run the program a few times...
+    jax.profiler.stop_trace()
+    python scripts/trace_opstats.py /tmp/my_trace --steps 60
+
+`--steps` divides the totals so the numbers read as ms/step (pass the
+number of training steps the traced region executed). The tensorboard
+profile plugin's converter is broken against this image's TF build; the
+xplane proto that TF ships parses fine under the pure-python protobuf
+backend, which this script forces for its own process.
+
+Usage: python scripts/trace_opstats.py <trace_dir> [--steps N] [--top K]
+"""
+
+import argparse
+import collections
+import glob
+import os
+import sys
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("trace_dir", help="directory passed to start_trace")
+    parser.add_argument("--steps", type=int, default=1,
+                        help="training steps executed in the traced region "
+                             "(divides totals into ms/step)")
+    parser.add_argument("--top", type=int, default=30)
+    parser.add_argument("--device", default="/device:TPU:0",
+                        help="plane name (default the first TPU core)")
+    args = parser.parse_args()
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    pattern = os.path.join(args.trace_dir, "plugins/profile/*/*.xplane.pb")
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        sys.exit(f"no xplane.pb under {pattern!r} — did stop_trace() run?")
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as fd:
+        space.ParseFromString(fd.read())
+
+    planes = {p.name: p for p in space.planes}
+    if args.device not in planes:
+        sys.exit(f"plane {args.device!r} not in trace; available: "
+                 f"{sorted(planes)}")
+    plane = planes[args.device]
+    meta = plane.event_metadata
+    lines = {l.name: l for l in plane.lines}
+    if "XLA Ops" not in lines:
+        sys.exit(f"no 'XLA Ops' line; available: {sorted(lines)}")
+
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for e in lines["XLA Ops"].events:
+        name = meta[e.metadata_id].name
+        agg[name] += e.duration_ps / 1e9  # -> ms
+        cnt[name] += 1
+
+    total = sum(agg.values())
+    print(f"total op time {total:.1f} ms "
+          f"({total / args.steps:.3f} ms/step over {args.steps} steps); "
+          f"top {args.top}:")
+    for name, ms in agg.most_common(args.top):
+        print(f"{ms / args.steps:9.4f} ms/step  x{cnt[name]:6d}  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
