@@ -1,5 +1,5 @@
-"""`resnet18` / `resnet34` / `resnet50` — standard torchvision models, as
-pure-pytree ModelDefs.
+"""`resnet18/34/50/101/152` — standard torchvision models, as pure-pytree
+ModelDefs.
 
 The reference exposes every `torchvision.models` entry point by name
 (reference `experiments/model.py:40-90`); this repo's registry is the
@@ -8,7 +8,8 @@ the registry extending to the torchvision zoo the same way: torchvision's
 resnets' architecture and initialization, NHWC/HWIO, no module framework.
 
 Architecture (torchvision `resnet.py`; resnet18 = BasicBlock [2, 2, 2, 2],
-resnet34 = BasicBlock [3, 4, 6, 3], resnet50 = Bottleneck [3, 4, 6, 3]):
+resnet34 = BasicBlock [3, 4, 6, 3]; Bottleneck: resnet50 [3, 4, 6, 3],
+resnet101 [3, 4, 23, 3], resnet152 [3, 8, 36, 3]):
   conv7x7(3,64,s2,p3,nobias) bn relu maxpool3x3(s2,p1),
   4 stages of [depth-dependent] blocks (64, 128, 256, 512 base channels;
   first block of stages 2-4 downsamples with stride 2 + 1x1 projection),
@@ -187,6 +188,18 @@ def make_resnet50(num_classes=10, **kwargs):
                         bottleneck=True)
 
 
+def make_resnet101(num_classes=10, **kwargs):
+    return _make_resnet("resnet101", (3, 4, 23, 3), num_classes,
+                        bottleneck=True)
+
+
+def make_resnet152(num_classes=10, **kwargs):
+    return _make_resnet("resnet152", (3, 8, 36, 3), num_classes,
+                        bottleneck=True)
+
+
 register("resnet18", make_resnet18)
 register("resnet34", make_resnet34)
 register("resnet50", make_resnet50)
+register("resnet101", make_resnet101)
+register("resnet152", make_resnet152)

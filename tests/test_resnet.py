@@ -29,6 +29,11 @@ def test_resnet50_param_count_and_forward():
     # torchvision resnet50 (Bottleneck [3,4,6,3], expansion 4)
     assert models.build("resnet50",
                         num_classes=1000).param_count() == 25_557_032
+    # The deeper Bottleneck variants pin the same way (torchvision counts)
+    assert models.build("resnet101",
+                        num_classes=1000).param_count() == 44_549_160
+    assert models.build("resnet152",
+                        num_classes=1000).param_count() == 60_192_808
     model_def = models.build("resnet50")
     params, state = model_def.init(jax.random.PRNGKey(0))
     x = jnp.zeros((2, 32, 32, 3), jnp.float32)
