@@ -110,22 +110,40 @@ def _bn_train(n_param_dims):
     Two measured wins over the autodiff version on TPU (the BN passes are
     bandwidth-bound on the big worker-expanded activations — see
     PERF_NOTES.md):
-    * one-pass statistics (sum and sum-of-squares in one read of x, f32
-      accumulation) instead of jnp.mean + jnp.var's two passes, and
+    * one-pass statistics (sum and sum-of-squares in one read of x,
+      accumulated at `promote_types(x.dtype, f32)` — so f64 inputs keep f64
+      statistics end-to-end) instead of jnp.mean + jnp.var's two passes, and
     * the closed-form backward (one fused read of (dy, xhat) for both
       reductions and dx) instead of autodiff's chain through the two-pass
       statistics.
-    Returns (out, mean, var) with f32 statistics; the running-stat fold
-    happens in the callers.
+    Returns (out, mean, var) with accumulation-dtype statistics; the
+    running-stat fold happens in the callers (which cast back to the state
+    dtype so scan carries stay dtype-stable).
+
+    Numerical regime: the one-pass E[x^2]-E[x]^2 variance cancels
+    catastrophically when |mean| >> std (the maximum(..., 0) clamp then
+    yields var=0 and inv=rsqrt(eps)). Post-BN+conv activations are
+    well-conditioned (|mean|/std is O(1)), which is the only place this
+    runs; f64 inputs use the centered two-pass form instead, since f64
+    callers are asking for precision, not bandwidth. The closed-form
+    backward also treats the clamp as identity (no zero-gradient at the
+    clamp point through dvar) — exact in the training step, where the
+    mean/var outputs are aux state with zero cotangents.
     """
 
     @jax.custom_vjp
     def bn(gamma, beta, x):
         axes = tuple(range(x.ndim - n_param_dims))
         cnt = x.size // _tail_size(x.shape, n_param_dims)
-        xf = x.astype(jnp.float32)
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc)
         mean = jnp.sum(xf, axis=axes) / cnt
-        var = jnp.maximum(jnp.sum(xf * xf, axis=axes) / cnt - mean * mean, 0.0)
+        if acc == jnp.float64:
+            xc = xf - mean
+            var = jnp.sum(xc * xc, axis=axes) / cnt
+        else:
+            var = jnp.maximum(
+                jnp.sum(xf * xf, axis=axes) / cnt - mean * mean, 0.0)
         inv = lax.rsqrt(var + BN_EPS)
         out = ((x - mean) * inv * gamma + beta).astype(x.dtype)
         return out, mean, var
@@ -139,14 +157,15 @@ def _bn_train(n_param_dims):
         gamma, x, mean, inv = res
         axes = tuple(range(x.ndim - n_param_dims))
         cnt = x.size // _tail_size(x.shape, n_param_dims)
-        dyf = dy.astype(jnp.float32)
-        xc = x.astype(jnp.float32) - mean
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        dyf = dy.astype(acc)
+        xc = x.astype(acc) - mean
         xhat = xc * inv
         sum_dy = jnp.sum(dyf, axis=axes)
         sum_dy_xhat = jnp.sum(dyf * xhat, axis=axes)
         # Batch-stat BN dx, plus the mean/var primal outputs' cotangents
         # (zero in the training step, where new_state is an aux output)
-        dx = ((gamma.astype(jnp.float32) * inv)
+        dx = ((gamma.astype(acc) * inv)
               * (dyf - sum_dy / cnt - xhat * (sum_dy_xhat / cnt))
               + dmean / cnt + xc * (2.0 * dvar / cnt))
         return (sum_dy_xhat.astype(gamma.dtype), sum_dy.astype(gamma.dtype),
@@ -163,6 +182,20 @@ def _tail_size(shape, n):
     return out
 
 
+def _fold_running_stats(state, mean, unbiased):
+    """Fold one batch's statistics into the running stats, casting the
+    (accumulation-dtype) batch stats back to the state dtype so scan carries
+    stay dtype-stable (the --nb-local-steps lax.scan requires an exact
+    carry-type match)."""
+    sdt = state["mean"].dtype
+    return {
+        "mean": ((1 - BN_MOMENTUM) * state["mean"]
+                 + BN_MOMENTUM * mean).astype(sdt),
+        "var": ((1 - BN_MOMENTUM) * state["var"]
+                + BN_MOMENTUM * unbiased).astype(sdt),
+    }
+
+
 def batchnorm_apply(params, state, x, *, train):
     """Normalize over all but the channel axis.
 
@@ -175,11 +208,7 @@ def batchnorm_apply(params, state, x, *, train):
         out, mean, var = _bn_train(1)(params["gamma"], params["beta"], x)
         count = x.size // x.shape[-1]
         unbiased = var * (count / max(count - 1, 1))
-        new_state = {
-            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
-            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
-        }
-        return out, new_state
+        return out, _fold_running_stats(state, mean, unbiased)
     mean, var = state["mean"], state["var"]
     inv = lax.rsqrt(var + BN_EPS)
     # Eval under mixed precision normalizes with the f32 running stats (the
@@ -253,11 +282,7 @@ def grouped_batchnorm_apply(params_s, state, x, *, train):
         out, mean, var = _bn_train(2)(params_s["gamma"], params_s["beta"], x)
         count = x.size // (x.shape[-1] * x.shape[-2])
         unbiased = var * (count / max(count - 1, 1))
-        new_state = {
-            "mean": (1 - BN_MOMENTUM) * state["mean"] + BN_MOMENTUM * mean,
-            "var": (1 - BN_MOMENTUM) * state["var"] + BN_MOMENTUM * unbiased,
-        }
-        return out, new_state
+        return out, _fold_running_stats(state, mean, unbiased)
     mean, var = state["mean"], state["var"]
     inv = lax.rsqrt(var + BN_EPS)
     # Same mixed-precision note as `batchnorm_apply`: keep the activation

@@ -72,7 +72,8 @@ def timed(dispatch, sync, *, min_s, warmup=2):
     depth-2 pipelined like bench.py."""
     for _ in range(warmup):
         h = dispatch()
-    sync(h)
+    if warmup:
+        sync(h)
     steps = 0
     pending = []
     start = time.monotonic()

@@ -255,6 +255,62 @@ def test_wide_resnet_forward_and_step():
     assert int(new_state.steps) == 1
 
 
+def test_bn_new_state_keeps_state_dtype():
+    """ADVICE r4 regression: train-mode BN must return `new_state` leaves in
+    the state dtype (the f32 statistics used to leak through the running-stat
+    fold, breaking the --nb-local-steps lax.scan carry under --dtype bf16)."""
+    from byzantinemomentum_tpu.models.core import grouped_batchnorm_apply
+    rng = np.random.default_rng(11)
+    for dt in (jnp.bfloat16, jnp.float16, jnp.float32):
+        params = {"gamma": jnp.ones((4,), dt), "beta": jnp.zeros((4,), dt)}
+        state = {"mean": jnp.zeros((4,), dt), "var": jnp.ones((4,), dt)}
+        x = jnp.asarray(rng.normal(size=(5, 3, 3, 4)).astype(np.float32), dt)
+        _, new_state = batchnorm_apply(params, state, x, train=True)
+        assert new_state["mean"].dtype == dt and new_state["var"].dtype == dt
+        gp = {"gamma": jnp.ones((2, 4), dt), "beta": jnp.zeros((2, 4), dt)}
+        xg = jnp.asarray(
+            rng.normal(size=(5, 3, 3, 2, 4)).astype(np.float32), dt)
+        _, new_g = grouped_batchnorm_apply(gp, state, xg, train=True)
+        assert new_g["mean"].dtype == dt and new_g["var"].dtype == dt
+
+
+@pytest.mark.slow
+def test_empire_cnn_bf16_local_steps_carry():
+    """ADVICE r4 regression (the reproduced failure): a BN model under
+    --dtype bf16 with --nb-local-steps > 1 must trace — the scan carry's
+    net_state dtype has to survive the per-local-step BN fold."""
+    cfg, engine = _cnn_engine(nb_local_steps=2, dtype="bfloat16")
+    state = engine.init(jax.random.PRNGKey(12))
+    S, K, B = cfg.nb_sampled, 2, 2
+    rng = np.random.default_rng(13)
+    xs = jnp.asarray(rng.normal(size=(S, K, B, 32, 32, 3)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, size=(S, K, B)).astype(np.int32))
+    new_state, _ = engine.train_step(state, xs, ys, jnp.float32(0.01))
+    assert new_state.theta.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(new_state.net_state):
+        assert leaf.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(leaf, np.float32)).all()
+
+
+def test_bn_f64_statistics_stay_f64():
+    """ADVICE r4: under x64, f64 activations get f64 (centered two-pass)
+    batch statistics — not silently-f32 one-pass ones. An f32 run of the
+    same values differs from the f64 oracle by ~1e-8; the f64 run must agree
+    to ~1e-12."""
+    from byzantinemomentum_tpu.models.core import _bn_train
+    with jax.enable_x64(True):
+        rng = np.random.default_rng(14)
+        # Ill-conditioned regime: |mean| >> std, where one-pass f32 cancels
+        x = (1000.0 + rng.normal(size=(64, 4), scale=1e-2)).astype(np.float64)
+        gamma = np.ones((4,), np.float64)
+        beta = np.zeros((4,), np.float64)
+        _, mean, var = _bn_train(1)(jnp.asarray(gamma), jnp.asarray(beta),
+                                    jnp.asarray(x))
+        assert mean.dtype == jnp.float64 and var.dtype == jnp.float64
+        np.testing.assert_allclose(np.asarray(mean), x.mean(axis=0), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(var), x.var(axis=0), rtol=1e-9)
+
+
 @pytest.mark.parametrize("n_param_dims,shape", [
     (1, (6, 5, 5, 7)),        # per-worker BN: x (B, H, W, C)
     (2, (6, 5, 5, 3, 7)),     # grouped BN: x (B, H, W, S, C)
