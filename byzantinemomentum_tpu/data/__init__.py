@@ -76,6 +76,10 @@ class Dataset:
             raise utils.UserException(
                 f"Invalid dataset {name!r}: {len(inputs)} inputs vs {len(labels)} labels")
         self.name = name
+        # Data provenance: True when the loader fell back to the synthetic
+        # generator (set by `make_datasets`; consumed by bench/parity
+        # artifacts so their JSON is self-describing)
+        self.synthetic = False
         self._inputs = inputs
         self._labels = labels
         self._batch = min(batch_size or len(inputs), len(inputs))
@@ -257,6 +261,7 @@ def make_datasets(dataset, train_batch=None, test_batch=None, *,
     testset = Dataset(raw["test_x"], raw["test_y"], test_batch,
                       train=False, transform=transform, seed=seed + 1,
                       name=dataset)
+    trainset.synthetic = testset.synthetic = bool(raw.get("synthetic", False))
     return trainset, testset
 
 
@@ -288,6 +293,12 @@ register("fashionmnist", lambda **kw: sources.load_mnist("fashionmnist", **kw))
 # extends to further torchvision dataset names with the existing parsers
 # (normalization constants from torchvision's KMNIST docs)
 register("kmnist", lambda **kw: sources.load_mnist("kmnist", **kw))
+# EMNIST/QMNIST ride the same idx parsers (QMNIST labels are idx2-int
+# records); like the reference, datasets without a `transforms` entry get
+# plain ToTensor semantics — [0,1] scaling, no normalization, no flips
+# (reference `experiments/dataset.py:115-118`)
+register("emnist", sources.load_emnist)
+register("qmnist", sources.load_qmnist)
 register("cifar10", lambda **kw: sources.load_cifar(10, **kw))
 register("cifar100", lambda **kw: sources.load_cifar(100, **kw))
 

@@ -54,6 +54,7 @@ def _synthetic_phishing():
 
 def load_phishing(**unused):
     path = sources._find("phishing", "phishing.txt", "phishing.libsvm")
+    synthetic = path is None
     if path is not None:
         inputs, labels = _parse_libsvm(path)
         split = min(SPLIT, len(inputs) - 1)
@@ -63,7 +64,7 @@ def load_phishing(**unused):
         inputs, labels, split = _synthetic_phishing()
     return {"train_x": inputs[:split], "train_y": labels[:split],
             "test_x": inputs[split:], "test_y": labels[split:],
-            "kind": "raw"}
+            "kind": "raw", "synthetic": synthetic}
 
 
 _data.register("phishing", load_phishing)

@@ -475,8 +475,14 @@ def main():
         configs.extend(mnist_configs(args))
     if "headline" in which:
         configs.extend(headline_config(args))
+    # The parity harness runs BOTH sides on the shared deterministic
+    # synthetic data by design (no data egress here); say so in the
+    # artifact instead of only in the config strings
+    for c in configs:
+        c.setdefault("synthetic_data", True)
     out = {"configs": configs,
-           "parity": bool(all(c["parity"] for c in configs))}
+           "parity": bool(all(c["parity"] for c in configs)),
+           "synthetic_data": True}
     path.write_text(json.dumps(out, indent=2) + "\n")
     print(json.dumps({k: v for k, v in out.items() if k != "configs"}
                      | {"per_config": [{"config": c["config"],
