@@ -97,6 +97,12 @@ def process_commandline(argv=None):
     add("--criterion", type=str, default="top-k", help="Criterion to use")
     add("--criterion-args", nargs="*", help="key:value args for the criterion")
     add("--dataset", type=str, default="mnist", help="Dataset to use")
+    # Beyond-reference: the reference's make_datasets forwards no custom
+    # kwargs (reference `attack.py:530`), so split-parameterized torchvision
+    # datasets (e.g. EMNIST) are unreachable from its CLI; this extends the
+    # uniform `key:value` mini-language to the dataset loader
+    add("--dataset-args", nargs="*",
+        help="key:value args for the dataset loader (e.g. split:balanced)")
     add("--batch-size", type=int, default=25, help="Training batch size")
     add("--batch-size-test", type=int, default=100, help="Test batch size")
     add("--batch-size-test-reps", type=int, default=100,
@@ -163,7 +169,7 @@ def process_commandline(argv=None):
 def _postprocess(args):
     """Derivations and checks (reference `attack.py:242-313`)."""
     for name in ("init_multi", "init_mono", "gar", "attack", "model", "loss",
-                 "criterion", "optimizer"):
+                 "criterion", "dataset", "optimizer"):
         name = f"{name}_args"
         keyval = getattr(args, name)
         setattr(args, name, utils.parse_keyval(keyval))
@@ -404,7 +410,8 @@ def main(argv=None):
         # Datasets
         trainset, testset = data_mod.make_datasets(
             args.dataset, args.batch_size, args.batch_size_test,
-            no_transform=args.no_transform, seed=seed % 2**32)
+            no_transform=args.no_transform, seed=seed % 2**32,
+            **args.dataset_args)
         # Losses (reference `attack.py:534-541`)
         loss = losses_mod.Loss(args.loss, **args.loss_args)
         if args.l1_regularize is not None:

@@ -32,7 +32,8 @@ import numpy as np
 
 from byzantinemomentum_tpu import utils
 
-__all__ = ["data_dirs", "load_mnist", "load_cifar", "synthetic_images"]
+__all__ = ["data_dirs", "load_mnist", "load_emnist", "load_qmnist",
+           "load_cifar", "synthetic_images"]
 
 
 def data_dirs():
@@ -75,14 +76,26 @@ def _find_top(*names):
 # --------------------------------------------------------------------------- #
 # idx (MNIST family)
 
+# idx type codes (byte 3 of the magic): published MNIST/idx format table
+_IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
+               0x0C: np.dtype(">i4"), 0x0D: np.dtype(">f4"),
+               0x0E: np.dtype(">f8")}
+
+
 def _read_idx(path):
     opener = gzip.open if str(path).endswith(".gz") else open
     with opener(path, "rb") as fd:
         magic, = struct.unpack(">I", fd.read(4))
+        code = (magic >> 8) & 0xFF
         ndim = magic & 0xFF
         dims = struct.unpack(f">{ndim}I", fd.read(4 * ndim))
-        data = np.frombuffer(fd.read(), dtype=np.uint8)
-    return data.reshape(dims)
+        if code not in _IDX_DTYPES:
+            raise utils.UserException(
+                f"Invalid idx file {path}: unknown type code 0x{code:02X}")
+        dtype = _IDX_DTYPES[code]
+        data = np.frombuffer(fd.read(), dtype=dtype)
+    # Native byte order out (QMNIST labels are big-endian int32 on disk)
+    return data.reshape(dims).astype(np.dtype(dtype).newbyteorder("="))
 
 
 _MNIST_FILES = {
@@ -126,6 +139,99 @@ def load_mnist(name, **unused):
     out["train_y"] = out["train_y"].astype(np.int32)
     out["test_y"] = out["test_y"].astype(np.int32)
     return out
+
+
+# EMNIST (torchvision `EMNIST`): per-split idx files under EMNIST/raw/.
+# (name, classes, train size, test size); `letters` labels run 1..26 on disk
+# (torchvision keeps them as-is — so does this loader).
+_EMNIST_SPLITS = {
+    "byclass": (62, 697932, 116323),
+    "bymerge": (47, 697932, 116323),
+    "balanced": (47, 112800, 18800),
+    "letters": (26, 124800, 20800),
+    "digits": (10, 240000, 40000),
+    "mnist": (10, 60000, 10000),
+}
+
+
+def _load_idx_family(name, files, fallback, label_select=None):
+    """Shared idx-family loading: probe ALL four paths before parsing any
+    (a partial tree must not decompress hundreds of MB it then discards),
+    parse, add the channel axis, cast/select labels to int32.
+
+    `files`: {key: (candidate names...)}; `fallback`: () -> synthetic dict;
+    `label_select`: optional fn extracting the class column from a parsed
+    label array."""
+    paths = {}
+    for key, names in files.items():
+        cands = [c for n in names for c in (n, n + ".gz")]
+        paths[key] = _find(*cands)
+        if paths[key] is None:
+            utils.trace(f"{name}: raw files not found on disk; using the "
+                        "deterministic synthetic fallback")
+            return fallback()
+    out = {key: _read_idx(path) for key, path in paths.items()}
+    out["train_x"] = out["train_x"][..., None]
+    out["test_x"] = out["test_x"][..., None]
+    for key in ("train_y", "test_y"):
+        y = out[key]
+        out[key] = (label_select(y) if label_select else y).astype(np.int32)
+    return out
+
+
+def load_emnist(split="balanced"):
+    """Load an EMNIST split (torchvision `datasets.EMNIST(split=...)`,
+    wrapped by the reference's registry like every torchvision dataset,
+    reference `experiments/dataset.py:100-132`; the split arrives through
+    the `--dataset-args split:<name>` mini-language — an unexpected key
+    raises, it is not swallowed). Images are parsed exactly as stored
+    (torchvision applies no re-orientation either). NB `letters` labels run
+    1..26 on disk and torchvision keeps them as-is — so does this loader,
+    and its synthetic fallback matches (a 27-way head or a target shift is
+    the caller's choice, exactly as with torchvision)."""
+    if split not in _EMNIST_SPLITS:
+        raise utils.UserException(
+            f"Unknown EMNIST split {split!r}; expected one of "
+            f"{sorted(_EMNIST_SPLITS)}")
+    classes, n_train, n_test = _EMNIST_SPLITS[split]
+
+    def fallback():
+        out = synthetic_images(f"emnist-{split}", shape=(28, 28, 1),
+                               classes=classes, train=n_train, test=n_test)
+        if split == "letters":
+            # Match the on-disk 1-based labels (class k prototype -> label
+            # k+1; the image-label association is unchanged)
+            out["train_y"] = out["train_y"] + 1
+            out["test_y"] = out["test_y"] + 1
+        return out
+
+    files = {
+        key: (f"EMNIST/raw/emnist-{split}-{role}-{part}",
+              f"emnist-{split}-{role}-{part}")
+        for key, role, part in (("train_x", "train", "images-idx3-ubyte"),
+                                ("train_y", "train", "labels-idx1-ubyte"),
+                                ("test_x", "test", "images-idx3-ubyte"),
+                                ("test_y", "test", "labels-idx1-ubyte"))}
+    return _load_idx_family(f"emnist-{split}", files, fallback)
+
+
+def load_qmnist():
+    """Load QMNIST (torchvision `datasets.QMNIST`): MNIST-format images with
+    extended idx2-int label records — (N, 8) int32 rows whose first column
+    is the class label (the remaining columns are provenance metadata the
+    training pipeline does not consume, matching torchvision's default
+    `compat=True` behavior of exposing only the class)."""
+    files = {
+        key: (f"QMNIST/raw/{name}", name)
+        for key, name in (("train_x", "qmnist-train-images-idx3-ubyte"),
+                          ("train_y", "qmnist-train-labels-idx2-int"),
+                          ("test_x", "qmnist-test-images-idx3-ubyte"),
+                          ("test_y", "qmnist-test-labels-idx2-int"))}
+    return _load_idx_family(
+        "qmnist", files,
+        lambda: synthetic_images("qmnist", shape=(28, 28, 1), classes=10,
+                                 train=60000, test=60000),
+        label_select=lambda y: y[:, 0])
 
 
 # --------------------------------------------------------------------------- #

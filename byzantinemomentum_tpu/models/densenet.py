@@ -1,0 +1,149 @@
+"""`densenet121/169/201` — torchvision DenseNet, as pure-pytree ModelDefs.
+
+Registry-tail extension in the `models/resnet.py` pattern (the reference
+resolves every `torchvision.models` name, reference
+`experiments/model.py:40-90`); parameter counts pinned against torchvision
+in `tests/test_vgg_densenet.py`.
+
+Architecture (torchvision `densenet.py`; growth 32, bn_size 4,
+num_init_features 64): conv7x7(3,64,s2,p3,nobias) BN relu maxpool3x3(s2,p1);
+dense blocks of layers [BN relu conv1x1(c, 4*growth, nobias) BN relu
+conv3x3(4*growth, growth, p1, nobias)] whose outputs concatenate onto the
+running feature map; transitions [BN relu conv1x1(c, c//2, nobias)
+avgpool2x2(s2)] between blocks; final BN relu, global average pool,
+Linear(c, num_classes). Block configs: 121 = (6, 12, 24, 16),
+169 = (6, 12, 32, 32), 201 = (6, 12, 48, 32).
+
+Initialization parity: kaiming-normal conv kernels (torchvision uses
+`kaiming_normal_(m.weight)` — fan_in, relu gain), BN gamma=1/beta=0,
+classifier bias 0 with torch-default weight.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import batchnorm_apply, batchnorm_init
+
+__all__ = []
+
+_GROWTH = 32
+_BN_SIZE = 4
+_BLOCKS = {
+    "densenet121": (6, 12, 24, 16),
+    "densenet169": (6, 12, 32, 32),
+    "densenet201": (6, 12, 48, 32),
+}
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    """torchvision densenet conv init: `kaiming_normal_(m.weight)` —
+    default mode fan_in, relu-family gain sqrt(2), bias-free."""
+    fan_in = kh * kw * cin
+    std = math.sqrt(2.0 / fan_in)
+    return {"w": std * jax.random.normal(key, (kh, kw, cin, cout),
+                                         jnp.float32)}
+
+
+def _conv(params, x, *, stride=1, pad=0):
+    return lax.conv_general_dilated(
+        x, params["w"], window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _fc_init(key, din, dout):
+    """Classifier: torch-default kaiming-uniform weight, zero bias
+    (torchvision zeroes only the bias)."""
+    bound = 1.0 / math.sqrt(din)
+    return {"w": jax.random.uniform(key, (din, dout), jnp.float32,
+                                    -bound, bound),
+            "b": jnp.zeros((dout,), jnp.float32)}
+
+
+def _layer_init(key, cin):
+    k1, k2 = jax.random.split(key)
+    params, state = {}, {}
+    params["bn1"], state["bn1"] = batchnorm_init(cin)
+    params["conv1"] = _conv_init(k1, 1, 1, cin, _BN_SIZE * _GROWTH)
+    params["bn2"], state["bn2"] = batchnorm_init(_BN_SIZE * _GROWTH)
+    params["conv2"] = _conv_init(k2, 3, 3, _BN_SIZE * _GROWTH, _GROWTH)
+    return params, state
+
+
+def _layer_apply(params, state, x, *, train):
+    new_state = dict(state)
+    out, new_state["bn1"] = batchnorm_apply(params["bn1"], state["bn1"], x,
+                                            train=train)
+    out = _conv(params["conv1"], jax.nn.relu(out))
+    out, new_state["bn2"] = batchnorm_apply(params["bn2"], state["bn2"], out,
+                                            train=train)
+    out = _conv(params["conv2"], jax.nn.relu(out), pad=1)
+    return out, new_state
+
+
+def _make_densenet(name, num_classes=10):
+    blocks = _BLOCKS[name]
+
+    def init(key):
+        keys = jax.random.split(key, sum(blocks) + len(blocks) + 2)
+        params, state = {}, {}
+        params["stem"] = _conv_init(keys[0], 7, 7, 3, 64)
+        params["bn0"], state["bn0"] = batchnorm_init(64)
+        c, k = 64, 1
+        for b, n_layers in enumerate(blocks):
+            for i in range(n_layers):
+                lname = f"b{b}l{i}"
+                params[lname], state[lname] = _layer_init(keys[k], c)
+                c, k = c + _GROWTH, k + 1
+            if b < len(blocks) - 1:
+                tname = f"t{b}"
+                tp, ts = {}, {}
+                tp["bn"], ts["bn"] = batchnorm_init(c)
+                tp["conv"] = _conv_init(keys[k], 1, 1, c, c // 2)
+                params[tname], state[tname] = tp, ts
+                c, k = c // 2, k + 1
+        params["bn5"], state["bn5"] = batchnorm_init(c)
+        params["fc"] = _fc_init(keys[k], c, num_classes)
+        return params, state
+
+    def apply(params, state, x, train=False, rng=None):
+        new_state = dict(state)
+        x = _conv(params["stem"], x, stride=2, pad=3)
+        x, new_state["bn0"] = batchnorm_apply(params["bn0"], state["bn0"], x,
+                                              train=train)
+        x = jax.nn.relu(x)
+        x = lax.reduce_window(
+            x, -jnp.inf, lax.max, window_dimensions=(1, 3, 3, 1),
+            window_strides=(1, 2, 2, 1),
+            padding=((0, 0), (1, 1), (1, 1), (0, 0)))
+        for b, n_layers in enumerate(blocks):
+            for i in range(n_layers):
+                lname = f"b{b}l{i}"
+                out, new_state[lname] = _layer_apply(
+                    params[lname], state[lname], x, train=train)
+                x = jnp.concatenate([x, out], axis=-1)
+            if b < len(blocks) - 1:
+                tname = f"t{b}"
+                x, nbn = batchnorm_apply(params[tname]["bn"],
+                                         state[tname]["bn"], x, train=train)
+                new_state[tname] = dict(state[tname], bn=nbn)
+                x = _conv(params[tname]["conv"], jax.nn.relu(x))
+                x = lax.reduce_window(
+                    x, 0.0, lax.add, window_dimensions=(1, 2, 2, 1),
+                    window_strides=(1, 2, 2, 1), padding="VALID") / 4.0
+        x, new_state["bn5"] = batchnorm_apply(params["bn5"], state["bn5"], x,
+                                              train=train)
+        x = jax.nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return x @ params["fc"]["w"] + params["fc"]["b"], new_state
+
+    return ModelDef(name, init, apply, (32, 32, 3))
+
+
+for _name in _BLOCKS:
+    register(_name, (lambda name: lambda num_classes=10, **kw:
+                     _make_densenet(name, num_classes))(_name))

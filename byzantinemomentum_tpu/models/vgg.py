@@ -1,0 +1,132 @@
+"""`vgg11/vgg13/vgg16/vgg19` — torchvision VGG (configs A/B/D/E), as
+pure-pytree ModelDefs.
+
+Registry-tail extension in the `models/resnet.py` pattern: the reference
+resolves every `torchvision.models` name (reference
+`experiments/model.py:40-90`); each variant here is pinned to torchvision's
+exact parameter count in `tests/test_vgg_densenet.py`.
+
+Architecture (torchvision `vgg.py`): stacks of 3x3 pad-1 convs (with bias)
++ ReLU, maxpool2x2/s2 between stages, then AdaptiveAvgPool2d(7) and the
+classifier Linear(512*7*7, 4096) ReLU Dropout(.5) Linear(4096, 4096) ReLU
+Dropout(.5) Linear(4096, num_classes). Initialization parity:
+kaiming-normal(fan_out, relu) conv kernels with zero biases, classifier
+Linear weights ~ N(0, 0.01^2) with zero biases (torchvision
+`VGG._initialize_weights`).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import dropout_apply
+
+__all__ = []
+
+# torchvision `cfgs`: channel per conv, "M" = maxpool
+_CFGS = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+              512, 512, "M", 512, 512, 512, 512, "M"),
+}
+_DROPOUT = 0.5
+
+
+def _conv_init(key, cin, cout):
+    """kaiming_normal_(fan_out, relu) kernel + zero bias (torchvision
+    `VGG._initialize_weights`)."""
+    fan_out = 3 * 3 * cout
+    std = math.sqrt(2.0 / fan_out)
+    return {"w": std * jax.random.normal(key, (3, 3, cin, cout), jnp.float32),
+            "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def _fc_init(key, din, dout):
+    """Classifier Linear: W ~ N(0, 0.01), b = 0 (torchvision)."""
+    return {"w": 0.01 * jax.random.normal(key, (din, dout), jnp.float32),
+            "b": jnp.zeros((dout,), jnp.float32)}
+
+
+def _max_pool_2x2(x):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1), padding="VALID")
+
+
+def adaptive_avg_pool(x, out_hw):
+    """torch `AdaptiveAvgPool2d`: output pixel (i, j) averages the input
+    window [floor(i*H/out), ceil((i+1)*H/out)) x [...]. Static shapes, so
+    the window set unrolls at trace time (49 slices for 7x7); on the 1x1
+    activations a 32x32 input leaves, every window is the single pixel
+    (pure replication), exactly as torch computes it."""
+    H, W = x.shape[1], x.shape[2]
+    oh, ow = out_hw
+    if (H, W) == (oh, ow):
+        return x
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * H) // oh, -((-(i + 1) * H) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * W) // ow, -((-(j + 1) * W) // ow)
+            cols.append(jnp.mean(x[:, h0:h1, w0:w1, :], axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)  # (B, oh, ow, C)
+
+
+def _make_vgg(name, num_classes=10):
+    cfg = _CFGS[name]
+    n_convs = sum(1 for c in cfg if c != "M")
+
+    def init(key):
+        keys = jax.random.split(key, n_convs + 3)
+        params = {}
+        cin, k = 3, 0
+        for c in cfg:
+            if c == "M":
+                continue
+            params[f"conv{k}"] = _conv_init(keys[k], cin, c)
+            cin, k = c, k + 1
+        params["fc0"] = _fc_init(keys[n_convs], 512 * 7 * 7, 4096)
+        params["fc1"] = _fc_init(keys[n_convs + 1], 4096, 4096)
+        params["fc2"] = _fc_init(keys[n_convs + 2], 4096, num_classes)
+        return params, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        if train and rng is None:
+            raise ValueError(f"{name} needs a PRNG key in train mode "
+                             "(classifier dropout)")
+        k = 0
+        for c in cfg:
+            if c == "M":
+                x = _max_pool_2x2(x)
+                continue
+            p = params[f"conv{k}"]
+            x = lax.conv_general_dilated(
+                x, p["w"], window_strides=(1, 1),
+                padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + p["b"]
+            x = jax.nn.relu(x)
+            k += 1
+        x = adaptive_avg_pool(x, (7, 7))
+        x = x.reshape(x.shape[0], -1)
+        rngs = jax.random.split(rng, 2) if train else (None, None)
+        x = jax.nn.relu(x @ params["fc0"]["w"] + params["fc0"]["b"])
+        x = dropout_apply(rngs[0], x, _DROPOUT, train=train)
+        x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+        x = dropout_apply(rngs[1], x, _DROPOUT, train=train)
+        return x @ params["fc2"]["w"] + params["fc2"]["b"], state
+
+    return ModelDef(name, init, apply, (32, 32, 3))
+
+
+for _name in _CFGS:
+    register(_name, (lambda name: lambda num_classes=10, **kw:
+                     _make_vgg(name, num_classes))(_name))

@@ -191,3 +191,101 @@ def test_mnist_family_does_not_cross_load(data_dir):
     out = sources.load_mnist("kmnist")
     assert "synthetic" not in out
     np.testing.assert_array_equal(out["train_x"][..., 0], ktr_x)
+
+
+def _write_idx2_int(path, arr):
+    """QMNIST-style idx2-int label records: big-endian int32, 2 dims."""
+    with open(path, "wb") as fd:
+        fd.write(struct.pack(">I", 0x00000C02))  # int32, 2 dims
+        fd.write(struct.pack(">2I", *arr.shape))
+        fd.write(arr.astype(">i4").tobytes())
+
+
+def test_emnist_split_idx_files(data_dir):
+    rng = np.random.default_rng(21)
+    tr_x = rng.integers(0, 256, (10, 28, 28)).astype(np.uint8)
+    tr_y = rng.integers(0, 47, 10).astype(np.uint8)
+    te_x = rng.integers(0, 256, (4, 28, 28)).astype(np.uint8)
+    te_y = rng.integers(0, 47, 4).astype(np.uint8)
+    raw = data_dir / "EMNIST" / "raw"
+    raw.mkdir(parents=True)
+    _write_idx_images(raw / "emnist-balanced-train-images-idx3-ubyte", tr_x)
+    _write_idx_labels(raw / "emnist-balanced-train-labels-idx1-ubyte", tr_y)
+    _write_idx_images(raw / "emnist-balanced-test-images-idx3-ubyte", te_x)
+    _write_idx_labels(raw / "emnist-balanced-test-labels-idx1-ubyte", te_y)
+    out = sources.load_emnist(split="balanced")
+    assert "synthetic" not in out
+    np.testing.assert_array_equal(out["train_x"][..., 0], tr_x)
+    np.testing.assert_array_equal(out["train_y"], tr_y.astype(np.int32))
+    assert out["test_x"].shape == (4, 28, 28, 1)
+    # A different split must NOT pick up the balanced files
+    other = sources.load_emnist(split="letters")
+    assert other.get("synthetic"), "letters silently loaded balanced files"
+
+
+def test_emnist_unknown_split_rejected(data_dir):
+    from byzantinemomentum_tpu import utils
+    with pytest.raises(utils.UserException, match="split"):
+        sources.load_emnist(split="nope")
+
+
+def test_emnist_fallback_class_counts(data_dir, monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "64")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "16")
+    out = sources.load_emnist(split="byclass")
+    assert out.get("synthetic") is True
+    assert out["train_x"].shape == (64, 28, 28, 1)
+    assert int(out["train_y"].max()) >= 10  # 62-class split, not 10
+
+def test_qmnist_idx2_int_labels(data_dir):
+    rng = np.random.default_rng(22)
+    tr_x = rng.integers(0, 256, (10, 28, 28)).astype(np.uint8)
+    te_x = rng.integers(0, 256, (6, 28, 28)).astype(np.uint8)
+    # 8-column extended label records; class label in column 0
+    tr_rec = np.zeros((10, 8), np.int64)
+    tr_rec[:, 0] = rng.integers(0, 10, 10)
+    tr_rec[:, 1] = 999  # metadata columns must be ignored
+    te_rec = np.zeros((6, 8), np.int64)
+    te_rec[:, 0] = rng.integers(0, 10, 6)
+    raw = data_dir / "QMNIST" / "raw"
+    raw.mkdir(parents=True)
+    _write_idx_images(raw / "qmnist-train-images-idx3-ubyte", tr_x)
+    _write_idx2_int(raw / "qmnist-train-labels-idx2-int", tr_rec)
+    # The test side ships gzipped like torchvision's cache
+    with gzip.open(raw / "qmnist-test-images-idx3-ubyte.gz", "wb") as fd:
+        fd.write(struct.pack(">I", 0x00000803))
+        fd.write(struct.pack(">3I", *te_x.shape))
+        fd.write(te_x.tobytes())
+    buf = io.BytesIO()
+    buf.write(struct.pack(">I", 0x00000C02))
+    buf.write(struct.pack(">2I", *te_rec.shape))
+    buf.write(te_rec.astype(">i4").tobytes())
+    with gzip.open(raw / "qmnist-test-labels-idx2-int.gz", "wb") as fd:
+        fd.write(buf.getvalue())
+    out = sources.load_qmnist()
+    assert "synthetic" not in out
+    np.testing.assert_array_equal(out["train_x"][..., 0], tr_x)
+    np.testing.assert_array_equal(out["train_y"], tr_rec[:, 0].astype(np.int32))
+    np.testing.assert_array_equal(out["test_y"], te_rec[:, 0].astype(np.int32))
+    assert out["train_y"].dtype == np.int32
+
+
+def test_emnist_qmnist_registered_plain_totensor():
+    """Both names resolve through `make_datasets`, and (like the reference's
+    datasets without a `transforms` entry) get plain ToTensor semantics: no
+    normalization, no flips — batches land in [0, 1]."""
+    import os
+    from byzantinemomentum_tpu import data as data_mod
+    os.environ["BMT_SYNTH_TRAIN"] = "32"
+    os.environ["BMT_SYNTH_TEST"] = "16"
+    try:
+        for name, kw in (("emnist", {"split": "digits"}), ("qmnist", {})):
+            tr, te = data_mod.make_datasets(name, 8, 8, **kw)
+            assert tr.synthetic and te.synthetic
+            x, y = tr.sample()
+            assert x.dtype == np.float32
+            assert x.min() >= 0.0 and x.max() <= 1.0  # no normalization
+            assert not tr.sample_flips().any()        # no flips
+    finally:
+        os.environ.pop("BMT_SYNTH_TRAIN", None)
+        os.environ.pop("BMT_SYNTH_TEST", None)

@@ -1,0 +1,102 @@
+"""`vgg*`/`densenet*` registry-tail extensions: torchvision architecture
+parity via exact parameter-count pins plus forward/step smokes (the
+reference exposes every torchvision model by name, reference
+`experiments/model.py:40-90`; these pin the registry extending the same way
+as `tests/test_resnet.py` does for the resnets)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import attacks, losses, models, ops
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+
+
+@pytest.mark.parametrize("name,count1000", [
+    ("vgg11", 132_863_336),
+    ("vgg13", 133_047_848),
+    ("vgg16", 138_357_544),
+    ("vgg19", 143_667_240),
+])
+def test_vgg_param_counts_match_torchvision(name, count1000):
+    assert models.build(name, num_classes=1000).param_count() == count1000
+
+
+@pytest.mark.parametrize("name,count1000", [
+    ("densenet121", 7_978_856),
+    ("densenet169", 14_149_480),
+    ("densenet201", 20_013_928),
+])
+def test_densenet_param_counts_match_torchvision(name, count1000):
+    assert models.build(name, num_classes=1000).param_count() == count1000
+
+
+def test_densenet121_forward_shapes_and_bn_state():
+    model_def = models.build("densenet121")
+    params, state = model_def.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                    jnp.float32)
+    out, _ = model_def.apply(params, state, x, train=False,
+                             rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 10)
+    out_t, new_state = model_def.apply(params, state, x, train=True,
+                                       rng=jax.random.PRNGKey(1))
+    assert np.isfinite(np.asarray(out_t)).all()
+    changed = jax.tree.map(
+        lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+        state, new_state)
+    assert any(jax.tree.leaves(changed))
+
+
+@pytest.mark.slow
+def test_vgg11_forward_and_dropout():
+    model_def = models.build("vgg11")
+    params, state = model_def.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 32, 32, 3)),
+                    jnp.float32)
+    out, _ = model_def.apply(params, state, x, train=False,
+                             rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 10)
+    # Train mode engages the classifier dropout: different keys, different
+    # outputs; eval mode is deterministic
+    a, _ = model_def.apply(params, state, x, train=True,
+                           rng=jax.random.PRNGKey(1))
+    b, _ = model_def.apply(params, state, x, train=True,
+                           rng=jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_densenet121_training_step():
+    model_def = models.build("densenet121")
+    cfg = EngineConfig(nb_workers=3, nb_decl_byz=1, nb_real_byz=1,
+                       nb_for_study=1, nb_for_study_past=1,
+                       momentum=0.9, momentum_at="update", gradient_clip=2.0)
+    engine = build_engine(
+        cfg=cfg, model_def=model_def, loss=losses.Loss("crossentropy"),
+        criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["median"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+    st = engine.init(jax.random.PRNGKey(0))
+    xs = jnp.zeros((cfg.nb_sampled, 2, 32, 32, 3), jnp.float32)
+    ys = jnp.zeros((cfg.nb_sampled, 2), jnp.int32)
+    st, metrics = engine.train_step(st, xs, ys, jnp.float32(0.01))
+    assert int(st.steps) == 1
+    assert np.isfinite(float(metrics["Defense gradient norm"]))
+
+
+def test_vgg_adaptive_avg_pool_matches_torch():
+    """The adaptive pool underpinning the VGG classifier head equals
+    torch.nn.AdaptiveAvgPool2d on both the replicating (input smaller than
+    output) and averaging (larger, non-divisible) regimes."""
+    import torch
+    from byzantinemomentum_tpu.models.vgg import adaptive_avg_pool
+    rng = np.random.default_rng(5)
+    for hw in ((1, 1), (5, 5), (14, 14), (10, 13)):
+        x = rng.normal(size=(2, *hw, 3)).astype(np.float32)
+        got = np.asarray(adaptive_avg_pool(jnp.asarray(x), (7, 7)))
+        ref = torch.nn.functional.adaptive_avg_pool2d(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), (7, 7))
+        np.testing.assert_allclose(
+            got, ref.numpy().transpose(0, 2, 3, 1), rtol=1e-5, atol=1e-6)
