@@ -109,6 +109,11 @@ def process_commandline(argv=None):
         help="Number of test batches per evaluation")
     add("--no-transform", action="store_true", default=False,
         help="Disable dataset transformations (normalization, flips)")
+    add("--download", action="store_true", default=False,
+        help="Allow fetching missing raw datasets from their published "
+             "URLs with checksum verification (reference torchvision "
+             "download=True, `experiments/dataset.py:296`; equivalent to "
+             "BMT_DOWNLOAD=1)")
     add("--learning-rate", type=float, default=0.01, help="Learning rate")
     add("--learning-rate-decay", type=int, default=5000,
         help="Hyperbolic half-decay time, non-positive for no decay")
@@ -408,6 +413,8 @@ def main(argv=None):
         # Model
         model_def = models_mod.build(args.model, **args.model_args)
         # Datasets
+        if args.download:
+            os.environ["BMT_DOWNLOAD"] = "1"
         trainset, testset = data_mod.make_datasets(
             args.dataset, args.batch_size, args.batch_size_test,
             no_transform=args.no_transform, seed=seed % 2**32,

@@ -21,11 +21,13 @@ benchmarks run hermetically. Synthetic sizes can be shrunk via
 """
 
 import gzip
+import hashlib
 import os
 import pathlib
 import pickle
 import struct
 import tarfile
+import urllib.request
 import zlib
 
 import numpy as np
@@ -33,7 +35,8 @@ import numpy as np
 from byzantinemomentum_tpu import utils
 
 __all__ = ["data_dirs", "load_mnist", "load_emnist", "load_qmnist",
-           "load_cifar", "synthetic_images"]
+           "load_cifar", "synthetic_images", "download_enabled",
+           "ensure_downloaded"]
 
 
 def data_dirs():
@@ -46,6 +49,156 @@ def data_dirs():
     dirs.append(pathlib.Path.home() / ".cache" / "byzantinemomentum_tpu")
     dirs.append(pathlib.Path("/root/data"))
     return [d for d in dirs if d.is_dir()]
+
+
+# --------------------------------------------------------------------------- #
+# Opt-in checksummed download path (reference: torchvision `download=True`,
+# reference `experiments/dataset.py:296`, and the LIBSVM URL fetch,
+# `experiments/datasets/svm.py:68-76`). OFF by default: this build
+# environment has no network egress, so the default path stays
+# disk-or-synthetic; outside it, `BMT_DOWNLOAD=1` (or the CLI `--download`)
+# lets the framework self-provision data.
+#
+# Checksums are `md5:<hex>` (the values torchvision pins for these exact
+# files) or `sha256:<hex>`; entries with checksum None have no published
+# digest and are fetched only under `BMT_DOWNLOAD_UNVERIFIED=1`.
+
+_DL_MNIST = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+_DL_FASHION = "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
+_DL_KMNIST = "http://codh.rois.ac.jp/kmnist/dataset/kmnist/"
+_DL_QMNIST = "https://raw.githubusercontent.com/facebookresearch/qmnist/master/"
+
+DOWNLOADS = {
+    "mnist": [
+        (_DL_MNIST + "train-images-idx3-ubyte.gz",
+         "md5:f68b3c2dcbeaaa9fbdd348bbdeb94873",
+         "MNIST/raw/train-images-idx3-ubyte.gz"),
+        (_DL_MNIST + "train-labels-idx1-ubyte.gz",
+         "md5:d53e105ee54ea40749a09fcbcd1e9432",
+         "MNIST/raw/train-labels-idx1-ubyte.gz"),
+        (_DL_MNIST + "t10k-images-idx3-ubyte.gz",
+         "md5:9fb629c4189551a2d022fa330f9573f3",
+         "MNIST/raw/t10k-images-idx3-ubyte.gz"),
+        (_DL_MNIST + "t10k-labels-idx1-ubyte.gz",
+         "md5:ec29112dd5afa0611ce80d1b7f02629c",
+         "MNIST/raw/t10k-labels-idx1-ubyte.gz"),
+    ],
+    "fashionmnist": [
+        (_DL_FASHION + "train-images-idx3-ubyte.gz",
+         "md5:8d4fb7e6c68d591d4c3dfef9ec88bf0d",
+         "FashionMNIST/raw/train-images-idx3-ubyte.gz"),
+        (_DL_FASHION + "train-labels-idx1-ubyte.gz",
+         "md5:25c81989df183df01b3e8a0aad5dffbe",
+         "FashionMNIST/raw/train-labels-idx1-ubyte.gz"),
+        (_DL_FASHION + "t10k-images-idx3-ubyte.gz",
+         "md5:bef4ecab320f06d8554ea6380940ec79",
+         "FashionMNIST/raw/t10k-images-idx3-ubyte.gz"),
+        (_DL_FASHION + "t10k-labels-idx1-ubyte.gz",
+         "md5:bb300cfdad3c16e7a12a480ee83cd310",
+         "FashionMNIST/raw/t10k-labels-idx1-ubyte.gz"),
+    ],
+    "kmnist": [
+        (_DL_KMNIST + f, None, f"KMNIST/raw/{f}")
+        for f in ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+                  "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+    ],
+    "qmnist": [
+        (_DL_QMNIST + f + ".gz", None, f"QMNIST/raw/{f}.gz")
+        for f in ("qmnist-train-images-idx3-ubyte",
+                  "qmnist-train-labels-idx2-int",
+                  "qmnist-test-images-idx3-ubyte",
+                  "qmnist-test-labels-idx2-int")
+    ],
+    "cifar10": [
+        ("https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+         "md5:c58f30108f718f92721af3b95e74349a", "cifar-10-python.tar.gz"),
+    ],
+    "cifar100": [
+        ("https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz",
+         "md5:eb9058c3a382ffc7106e4002c42a8d85", "cifar-100-python.tar.gz"),
+    ],
+    "phishing": [
+        ("https://www.csie.ntu.edu.tw/~cjlin/libsvmtools/datasets/binary"
+         "/phishing", None, "phishing"),
+    ],
+}
+
+
+def download_enabled():
+    return os.environ.get("BMT_DOWNLOAD", "").lower() not in ("", "0",
+                                                              "false", "no")
+
+
+def _download_root():
+    """First writable data dir (created if none exists)."""
+    env = os.environ.get("BMT_DATA_DIR")
+    root = (pathlib.Path(env) if env
+            else pathlib.Path.home() / ".cache" / "byzantinemomentum_tpu")
+    root.mkdir(parents=True, exist_ok=True)
+    return root
+
+
+def _digest(path, checksum):
+    algo, _, want = checksum.partition(":")
+    h = hashlib.new(algo)
+    with open(path, "rb") as fd:
+        for chunk in iter(lambda: fd.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest(), want
+
+
+def _fetch(url, dest, checksum, opener=None):
+    """Stream `url` to `dest` atomically (tmp + rename), verifying
+    `checksum` before the rename so a bad payload never lands under a
+    valid name. `opener` is injectable for tests."""
+    opener = opener or urllib.request.urlopen
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_name(dest.name + ".part")
+    try:
+        with opener(url) as response, open(tmp, "wb") as out:
+            for chunk in iter(lambda: response.read(1 << 20), b""):
+                out.write(chunk)
+        if checksum is not None:
+            got, want = _digest(tmp, checksum)
+            if got != want:
+                raise utils.UserException(
+                    f"Checksum mismatch for {url}: expected {checksum}, "
+                    f"got {got} — refusing to install the file")
+        tmp.replace(dest)
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def ensure_downloaded(name, opener=None):
+    """Fetch `name`'s published files into the download root if downloading
+    is enabled and they are not already present anywhere in the data dirs.
+    Returns True if anything was fetched (callers re-probe the disk)."""
+    if not download_enabled() or name not in DOWNLOADS:
+        return False
+    unverified_ok = os.environ.get(
+        "BMT_DOWNLOAD_UNVERIFIED", "").lower() not in ("", "0", "false", "no")
+    fetched = False
+    for url, checksum, rel in DOWNLOADS[name]:
+        base = pathlib.PurePath(rel).name
+        if _find(rel, base) is not None:
+            continue
+        if checksum is None and not unverified_ok:
+            utils.warning(
+                f"{name}: no published checksum for {url}; set "
+                "BMT_DOWNLOAD_UNVERIFIED=1 to fetch it anyway")
+            continue
+        utils.trace(f"{name}: downloading {url}")
+        try:
+            _fetch(url, _download_root() / rel, checksum, opener=opener)
+        except OSError as err:
+            # Unreachable network degrades to the next source (disk probe,
+            # then the synthetic fallback) — a checksum mismatch does NOT
+            # take this path: a reachable-but-corrupt source must raise
+            utils.warning(f"{name}: download of {url} failed ({err}); "
+                          "continuing without it")
+            continue
+        fetched = True
+    return fetched
 
 
 def _find(*names):
@@ -117,6 +270,7 @@ def load_mnist(name, **unused):
     level of a data dir — otherwise a cached tree of one family member
     would silently satisfy another member's request with the wrong images.
     """
+    ensure_downloaded(name)
     out = {}
     subdir = {"mnist": "MNIST", "fashionmnist": "FashionMNIST",
               "kmnist": "KMNIST"}[name]
@@ -221,6 +375,7 @@ def load_qmnist():
     is the class label (the remaining columns are provenance metadata the
     training pipeline does not consume, matching torchvision's default
     `compat=True` behavior of exposing only the class)."""
+    ensure_downloaded("qmnist")
     files = {
         key: (f"QMNIST/raw/{name}", name)
         for key, name in (("train_x", "qmnist-train-images-idx3-ubyte"),
@@ -251,6 +406,7 @@ def load_cifar(classes, **unused):
     """Load CIFAR-10/100 from extracted batch files or the .tar.gz, else
     synthesize. Returns HWC uint8 images."""
     name = f"cifar{classes}"
+    ensure_downloaded(name)
     if classes == 10:
         train_names = [f"cifar-10-batches-py/data_batch_{i}" for i in range(1, 6)]
         test_names = ["cifar-10-batches-py/test_batch"]

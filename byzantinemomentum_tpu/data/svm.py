@@ -3,10 +3,11 @@
 68 dense features parsed from the LIBSVM sparse text format, labels in
 {0, 1} shaped (N, 1) float32, split 8400/rest into train/test (reference
 `svm.py:126` — 8400 chosen for divisibility). Loads `phishing` /
-`phishing.txt` from the data dirs (no network egress here, so no download
-path — the reference's `download=True` URL fetch is replaced by disk
-discovery); falls back to a deterministic synthetic linearly-separable-ish
-binary problem with identical shapes.
+`phishing.txt` from the data dirs (the reference's `download=True` URL
+fetch maps to the opt-in `BMT_DOWNLOAD=1` path in `data/sources.py` —
+off by default since this build environment has no network egress); falls
+back to a deterministic synthetic linearly-separable-ish binary problem
+with identical shapes.
 """
 
 import os
@@ -53,6 +54,7 @@ def _synthetic_phishing():
 
 
 def load_phishing(**unused):
+    sources.ensure_downloaded("phishing")
     path = sources._find("phishing", "phishing.txt", "phishing.libsvm")
     synthetic = path is None
     if path is not None:

@@ -289,3 +289,145 @@ def test_emnist_qmnist_registered_plain_totensor():
     finally:
         os.environ.pop("BMT_SYNTH_TRAIN", None)
         os.environ.pop("BMT_SYNTH_TEST", None)
+
+
+# --------------------------------------------------------------------------- #
+# Opt-in checksummed download path (reference `dataset.py:296`,
+# `datasets/svm.py:68-76`): mocked fetches only — this environment has no
+# network egress, so the real URLs are exercised outside it.
+
+def _fake_opener(payloads):
+    """opener(url) -> file-like serving payloads[url] (records the calls)."""
+    calls = []
+
+    class _Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def opener(url):
+        calls.append(url)
+        if url not in payloads:
+            raise OSError(f"unexpected URL {url}")
+        return _Resp(payloads[url])
+
+    opener.calls = calls
+    return opener
+
+
+def test_download_disabled_by_default(data_dir, monkeypatch):
+    monkeypatch.delenv("BMT_DOWNLOAD", raising=False)
+    assert not sources.download_enabled()
+    assert sources.ensure_downloaded("mnist") is False
+
+
+def test_download_fetches_verifies_and_installs(data_dir, monkeypatch):
+    import hashlib
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    payload = gzip.compress(b"not really mnist but checksummed")
+    digest = hashlib.md5(payload).hexdigest()
+    url = "https://example.invalid/file.gz"
+    monkeypatch.setitem(
+        sources.DOWNLOADS, "testset",
+        [(url, f"md5:{digest}", "TestSet/raw/file.gz")])
+    opener = _fake_opener({url: payload})
+    assert sources.ensure_downloaded("testset", opener=opener) is True
+    installed = data_dir / "TestSet" / "raw" / "file.gz"
+    assert installed.read_bytes() == payload
+    assert not installed.with_name("file.gz.part").exists()
+    # Second call: already on disk, no re-fetch
+    assert sources.ensure_downloaded("testset", opener=opener) is False
+    assert len(opener.calls) == 1
+
+
+def test_download_checksum_mismatch_refuses_install(data_dir, monkeypatch):
+    from byzantinemomentum_tpu import utils
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    url = "https://example.invalid/bad.gz"
+    monkeypatch.setitem(
+        sources.DOWNLOADS, "testset",
+        [(url, "md5:" + "0" * 32, "TestSet/raw/bad.gz")])
+    with pytest.raises(utils.UserException, match="Checksum mismatch"):
+        sources.ensure_downloaded(
+            "testset", opener=_fake_opener({url: b"corrupted"}))
+    target = data_dir / "TestSet" / "raw"
+    # Neither the file nor the temp partial landed
+    assert not (target / "bad.gz").exists()
+    assert not (target / "bad.gz.part").exists()
+
+
+def test_download_unverified_requires_explicit_optin(data_dir, monkeypatch):
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    monkeypatch.delenv("BMT_DOWNLOAD_UNVERIFIED", raising=False)
+    url = "https://example.invalid/nodigest"
+    monkeypatch.setitem(
+        sources.DOWNLOADS, "testset", [(url, None, "TestSet/raw/nodigest")])
+    opener = _fake_opener({url: b"payload"})
+    # Without the extra opt-in: skipped with a warning, nothing fetched
+    assert sources.ensure_downloaded("testset", opener=opener) is False
+    assert opener.calls == []
+    # With it: fetched
+    monkeypatch.setenv("BMT_DOWNLOAD_UNVERIFIED", "1")
+    assert sources.ensure_downloaded("testset", opener=opener) is True
+    assert (data_dir / "TestSet" / "raw" / "nodigest").read_bytes() == b"payload"
+
+
+def test_download_installs_loadable_mnist(data_dir, monkeypatch):
+    """End-to-end through a loader: a mocked fetch of all four gzipped idx
+    files makes `load_mnist` pick them up instead of the synthetic
+    fallback."""
+    import hashlib
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    rng = np.random.default_rng(33)
+    arrays = {
+        "train-images-idx3-ubyte": rng.integers(0, 256, (6, 28, 28)).astype(np.uint8),
+        "train-labels-idx1-ubyte": rng.integers(0, 10, 6).astype(np.uint8),
+        "t10k-images-idx3-ubyte": rng.integers(0, 256, (3, 28, 28)).astype(np.uint8),
+        "t10k-labels-idx1-ubyte": rng.integers(0, 10, 3).astype(np.uint8),
+    }
+    payloads, entries = {}, []
+    for fname, arr in arrays.items():
+        buf = io.BytesIO()
+        if arr.ndim == 3:
+            buf.write(struct.pack(">I", 0x00000803))
+            buf.write(struct.pack(">3I", *arr.shape))
+        else:
+            buf.write(struct.pack(">I", 0x00000801))
+            buf.write(struct.pack(">I", arr.shape[0]))
+        buf.write(arr.tobytes())
+        payload = gzip.compress(buf.getvalue())
+        url = f"https://example.invalid/{fname}.gz"
+        payloads[url] = payload
+        entries.append((url, "md5:" + hashlib.md5(payload).hexdigest(),
+                        f"MNIST/raw/{fname}.gz"))
+    monkeypatch.setitem(sources.DOWNLOADS, "mnist", entries)
+    orig = sources.ensure_downloaded
+    monkeypatch.setattr(
+        sources, "ensure_downloaded",
+        lambda name, opener=None: orig(name, opener=_fake_opener(payloads)))
+    out = sources.load_mnist("mnist")
+    assert "synthetic" not in out
+    np.testing.assert_array_equal(out["train_x"][..., 0],
+                                  arrays["train-images-idx3-ubyte"])
+    np.testing.assert_array_equal(out["test_y"],
+                                  arrays["t10k-labels-idx1-ubyte"].astype(np.int32))
+
+
+def test_download_network_failure_degrades_to_fallback(data_dir, monkeypatch):
+    """An unreachable source warns and degrades (disk probe -> synthetic);
+    only a reachable-but-corrupt source raises."""
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "16")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "8")
+
+    def opener(url):
+        raise OSError("no route to host")
+
+    orig = sources.ensure_downloaded
+    monkeypatch.setattr(
+        sources, "ensure_downloaded",
+        lambda name, op=None: orig(name, opener=opener))
+    out = sources.load_mnist("mnist")
+    assert out.get("synthetic") is True
