@@ -180,3 +180,85 @@ def test_select_and_discard(result_dir):
     rest = study.discard(sess, "ratio")
     assert not any("ratio" in c.lower() for c in rest.columns)
     assert "Average loss" in rest.columns
+
+
+# --------------------------------------------------------------------------- #
+# reproduce-appendix.py (reference `reproduce-appendix.py:122-158`): grid
+# submission against a stub Jobs — run-name tokens, exclusion logic, flag
+# validity, and compatibility with reproduce.analyze's grouping.
+
+def _load_appendix_module():
+    import importlib.util
+    import pathlib
+    path = pathlib.Path(__file__).resolve().parent.parent / "reproduce-appendix.py"
+    spec = importlib.util.spec_from_file_location("reproduce_appendix", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _StubJobs:
+    def __init__(self):
+        self.submitted = []
+
+    def submit(self, name, command):
+        self.submitted.append((name, command))
+
+
+def test_appendix_grid_names_and_flags():
+    """The appendix grid submits exactly the reference's 22 runs (2
+    unattacked baselines + 8 f=4 runs with bulyan excluded + 12 f=2 runs),
+    every name carries the lr_pow/at_*/nesterov tokens, and every command's
+    flags parse through the real CLI."""
+    from byzantinemomentum_tpu.cli.attack import process_commandline
+    mod = _load_appendix_module()
+    jobs = _StubJobs()
+    mod.submit(jobs)
+    names = [n for n, _ in jobs.submitted]
+    assert len(names) == 22 and len(set(names)) == 22
+    assert "cifar10-average-n_7-lr_pow-nesterov" in names
+    assert "cifar10-average-n_9-lr_pow-nesterov" in names
+    # Bulyan needs n >= 4f+3: excluded at f=4 (n=11), present at f=2
+    assert not any("bulyan-f_4" in n for n in names)
+    assert any("bulyan-f_2" in n for n in names)
+    assert sum("-f_4-" in n for n in names) == 8
+    assert sum("-f_2-" in n for n in names) == 12
+    for name, command in jobs.submitted:
+        if "average" in name:
+            continue
+        assert "-lr_pow-" in name and name.endswith("-nesterov")
+        assert "-at_update-" in name or "-at_worker-" in name
+        # Flags must be acceptable to the driver CLI (catches grid/CLI drift)
+        args = process_commandline(command[2:])
+        assert args.model == "wide_resnet-Wide_ResNet"
+        assert args.nb_workers == 11
+        assert args.nb_decl_byz == args.nb_real_byz
+        assert args.learning_rate_schedule == "0.02,8000,0.004,16000,0.0008"
+        assert args.momentum_nesterov is True
+        assert (f"-at_{args.momentum_at}-" in name
+                and f"-f_{args.nb_real_byz}-" in name
+                and f"-{args.gar}-" in name and f"-{args.attack}-" in name)
+
+
+def test_appendix_names_group_with_reproduce_analyze():
+    """reproduce.analyze groups runs by config.json plus the lr NAME token
+    and looks the unattacked baseline up by `_baseline_name`
+    (reproduce.py:210-239); every attacked appendix run must resolve its
+    baseline to one the appendix grid actually submitted."""
+    import re
+    import reproduce
+    mod = _load_appendix_module()
+    jobs = _StubJobs()
+    mod.submit(jobs)
+    names = [n for n, _ in jobs.submitted]
+    baselines = {n for n in names if "average" in n}
+    for name, command in jobs.submitted:
+        if "average" in name:
+            continue
+        f = int(command[command.index("--nb-real-byz") + 1])
+        lr = re.search(r"-lr_([^-]+)", name).group(1)
+        assert lr == "pow"
+        info = {"dataset": "cifar10", "lr": lr, "nesterov": True,
+                "honests": 11 - f, "seed": "1"}
+        base = reproduce._baseline_name(info)
+        assert base.rsplit("-", 1)[0] in baselines, (name, base)
