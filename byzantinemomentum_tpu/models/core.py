@@ -18,6 +18,7 @@ separately to multi-dim vs mono-dim parameters via `--init-multi` /
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +31,7 @@ __all__ = [
     "dropout_apply",
     "log_softmax",
     "grouped_conv_apply", "grouped_dense_apply",
-    "grouped_batchnorm_apply", "grouped_dropout_apply",
+    "grouped_batchnorm_apply", "grouped_dropout_apply", "grouped_unpack",
     "inits", "apply_named_init",
 ]
 
@@ -235,6 +236,30 @@ def batchnorm_apply(params, state, x, *, train):
 # and the per-worker weight gradients fall out of one backward pass with
 # respect to the stacked parameters. Numerics match the vmapped path
 # op-for-op (same batch-stat BatchNorm, same per-worker-key dropout draws).
+#
+# WORKER PACKING: a `(B, H, W, S, C)` tensor with C < 128 tiles its minor
+# dim into the TPU's 128 lanes padded (C=64 -> 2x physical bytes, and every
+# elementwise/BN/dropout/pool pass pays it — the r5 trace shows these
+# fusions at the padded-bandwidth floor). When a divisor P of S makes
+# (P*C) % 128 == 0, the helpers below carry the activation PACKED as
+# `(B, H, W, S/P, P*C)`: workers pP..pP+P-1 concatenated on the channel
+# axis. With P*C a multiple of 128 the packed form and the conv's merged
+# `(B, H, W, S*C)` view share the same physical bytes (the conv-boundary
+# reshapes are bitcasts), the lane padding disappears, and every per-(s, c)
+# semantic (BN statistics, dropout draws, pooling) is preserved exactly —
+# only the tensor's logical factorization changes. Helpers infer P by
+# comparing `x.shape[-2]` with the parameter stack's true S, so models
+# need no changes; `BMT_NO_WORKER_PACK=1` disables packing (A/B knob).
+
+
+def _worker_packing(S, c):
+    """Smallest P dividing S with (P*c) % 128 == 0, else 1."""
+    if os.environ.get("BMT_NO_WORKER_PACK") or c % 128 == 0:
+        return 1
+    for P in range(2, S + 1):
+        if S % P == 0 and (P * c) % 128 == 0:
+            return P
+    return 1
 
 
 def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
@@ -250,14 +275,55 @@ def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
     """
     S, kh, kw_, cin, cout = params_s["w"].shape
     B, H, W = x.shape[0], x.shape[1], x.shape[2]
-    w = params_s["w"].transpose(1, 2, 3, 0, 4).reshape(kh, kw_, cin, S * cout)
     stride = (stride, stride) if isinstance(stride, int) else stride
+    xm = x.reshape(B, H, W, S * cin)  # the universal interchange form
+    # Worker packing (see the section comment). When the conv's input or
+    # output channel count is lane-misaligned, run it as S/P PAIRED groups
+    # with block-diagonal weights: 2x the MXU work on the packed convs
+    # (the off-diagonal zero blocks), but no (S, C<128) tensor ever exists,
+    # so the elementwise/BN/pool passes around it run unpadded and no
+    # relayout copies appear at the conv boundaries (forcing packed
+    # activations around an S-group conv was measured WORSE — XLA's grouped
+    # conv rewrite pins the split form; see PERF_NOTES.md).
+    P_in = S // x.shape[-2]
+    P_out = _worker_packing(S, cout)
+    P = max(P_in, P_out)
+    if P == 1:
+        w = (params_s["w"].transpose(1, 2, 3, 0, 4)
+             .reshape(kh, kw_, cin, S * cout))
+        out = lax.conv_general_dilated(
+            xm, w, window_strides=stride, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=S)
+        out = out.reshape(out.shape[:3] + (S, cout))
+        return out + params_s["b"]
+    G = S // P
+    # Block-diagonal paired weights: group g holds workers gP..gP+P-1 on
+    # the diagonal (autodiff through the einsum extracts exactly the
+    # diagonal blocks' gradients, so the zeros stay zero-cost in memory)
+    w_pair = params_s["w"].reshape(G, P, kh, kw_, cin, cout)
+    eye = jnp.eye(P, dtype=params_s["w"].dtype)
+    wbd = jnp.einsum("gpklio,pq->klgpiqo", w_pair, eye)
+    wbd = wbd.reshape(kh, kw_, G, P * cin, P * cout)
+    wbd = wbd.transpose(0, 1, 3, 2, 4).reshape(kh, kw_, P * cin,
+                                               G * P * cout)
     out = lax.conv_general_dilated(
-        x.reshape(B, H, W, S * cin), w, window_strides=stride,
-        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        feature_group_count=S)
-    out = out.reshape(out.shape[:3] + (S, cout))
-    return out + params_s["b"]
+        xm, wbd, window_strides=stride, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=G)
+    # Emit (B, H', W', S/P_out, P_out*cout): the group outputs are already
+    # worker-major, so this is a pure refactorization of the merged axis
+    out = out.reshape(out.shape[:3] + (S // P_out, P_out * cout))
+    return out + params_s["b"].reshape(S // P_out, P_out * cout)
+
+
+def grouped_unpack(x, S):
+    """Restore the plain (..., S, C) factorization of a possibly
+    worker-packed activation (no-op when already unpacked) — used before
+    stages that need the true worker axis (global pools, flatten, dense)."""
+    if x.shape[-2] == S:
+        return x
+    return x.reshape(x.shape[:-2] + (S, (x.shape[-2] * x.shape[-1]) // S))
 
 
 def grouped_dense_apply(params_s, x):
@@ -273,22 +339,35 @@ def grouped_batchnorm_apply(params_s, state, x, *, train):
     params_s: {"gamma", "beta"} each (S, C); state: the SHARED running stats
     {"mean", "var"} each (C,) (every vmapped worker normalizes from the same
     pre-step state — see `engine/step.py:compose_bn_updates`);
-    x: (..., S, C). Train mode computes each worker's batch statistics (the
-    moments over all leading axes — identical to the vmapped per-worker
-    `batchnorm_apply`) and returns `new_state` leaves of shape (S, C), the
+    x: (..., S, C), or worker-PACKED (..., S/P, P*C) (see the section
+    comment — P is inferred from the shapes). Train mode computes each
+    worker's batch statistics (the moments over all leading axes —
+    identical to the vmapped per-worker `batchnorm_apply`) and returns
+    `new_state` leaves of shape (S, C) regardless of packing, the
     per-worker running-stat updates the step composer expects.
     """
+    S, C = params_s["gamma"].shape
+    S2 = x.shape[-2]
+    gamma, beta = params_s["gamma"], params_s["beta"]
+    if S2 != S:  # packed: per-(s, c) params follow the same factorization
+        gamma = gamma.reshape(S2, -1)
+        beta = beta.reshape(S2, -1)
     if train:
-        out, mean, var = _bn_train(2)(params_s["gamma"], params_s["beta"], x)
+        out, mean, var = _bn_train(2)(gamma, beta, x)
         count = x.size // (x.shape[-1] * x.shape[-2])
         unbiased = var * (count / max(count - 1, 1))
-        return out, _fold_running_stats(state, mean, unbiased)
+        new_state = _fold_running_stats(
+            state, mean.reshape(S, C), unbiased.reshape(S, C))
+        return out, new_state
     mean, var = state["mean"], state["var"]
+    if S2 != S:  # shared (C,) stats tile across the P packed workers
+        P = S // S2
+        mean = jnp.tile(mean, P)
+        var = jnp.tile(var, P)
     inv = lax.rsqrt(var + BN_EPS)
     # Same mixed-precision note as `batchnorm_apply`: keep the activation
     # stream in x.dtype after normalizing with (possibly f32) stats
-    out = ((x - mean) * inv * params_s["gamma"]
-           + params_s["beta"]).astype(x.dtype)
+    out = ((x - mean) * inv * gamma + beta).astype(x.dtype)
     return out, state
 
 
@@ -297,16 +376,29 @@ def grouped_dropout_apply(rngs, x, rate, *, train, axis=-2):
 
     rngs: (S,) stacked per-worker keys; `axis` is the worker axis of `x`
     (next-to-minor in the grouped convention, e.g. (B, H, W, S, C) or
-    (B, S, F)). Draws EXACTLY the masks the vmapped path draws — one
+    (B, S, F)); `x` may be worker-PACKED (..., S/P, P*C) (see the section
+    comment). Draws EXACTLY the masks the vmapped path draws — one
     `_dropout_mask(key_s, shape-without-worker-axis)` per worker — so the
-    two execution paths produce identical trajectories.
+    two execution paths produce identical trajectories (packing only
+    changes where worker p's mask lands: concatenated on the channel axis).
     """
     if not train or rate <= 0.0:
         return x
     keep = 1.0 - rate
     ax = axis % x.ndim
+    S = rngs.shape[0]
+    S2 = x.shape[ax]
     per_shape = x.shape[:ax] + x.shape[ax + 1:]
-    masks = jax.vmap(lambda k: _dropout_mask(k, keep, per_shape))(rngs)
+    if S2 == S:
+        masks = jax.vmap(lambda k: _dropout_mask(k, keep, per_shape))(rngs)
+    else:  # packed: draw each worker's (..., C) mask, concat P per row
+        P = S // S2
+        per_worker = per_shape[:-1] + (x.shape[-1] // P,)
+        masks = jax.vmap(jax.vmap(
+            lambda k: _dropout_mask(k, keep, per_worker)))(
+                rngs.reshape((S2, P) + rngs.shape[1:]))  # (S2, P, ..., C)
+        masks = jnp.moveaxis(masks, 1, -2)     # (S2, ..., P, C)
+        masks = masks.reshape((S2,) + per_shape)
     masks = jnp.moveaxis(masks, 0, ax)
     return jnp.where(masks, x / keep, 0.0)
 

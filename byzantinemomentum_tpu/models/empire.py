@@ -22,7 +22,8 @@ from byzantinemomentum_tpu.models import ModelDef, register
 from byzantinemomentum_tpu.models.core import (
     batchnorm_apply, batchnorm_init, conv_apply, conv_init, dense_apply,
     dense_init, dropout_apply, grouped_batchnorm_apply, grouped_conv_apply,
-    grouped_dense_apply, grouped_dropout_apply, log_softmax, max_pool)
+    grouped_dense_apply, grouped_dropout_apply, grouped_unpack, log_softmax,
+    max_pool)
 
 __all__ = []
 
@@ -102,6 +103,7 @@ def make_cnn(cifar100=False, **kwargs):
             dks[:, 1] if train else None, x, 0.25, train=train)
         # (B, 8, 8, S, 128) -> per-worker flat (h, w, c) rows, matching the
         # vmapped path's x.reshape(B, -1)
+        x = grouped_unpack(x, S)  # no-op here (C=128 never packs), defensive
         x = x.transpose(0, 3, 1, 2, 4).reshape(B, S, 8192)
         x = jax.nn.relu(grouped_dense_apply(params_s["f1"], x))
         x = grouped_dropout_apply(

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from byzantinemomentum_tpu.models import ModelDef, register
 from byzantinemomentum_tpu.models.core import (
     conv_apply, conv_init, dense_apply, dense_init, grouped_conv_apply,
-    grouped_dense_apply, log_softmax, max_pool)
+    grouped_dense_apply, grouped_unpack, log_softmax, max_pool)
 
 __all__ = []
 
@@ -76,7 +76,9 @@ def make_conv(**kwargs):
         x = max_pool(x, 2)
         x = jax.nn.relu(grouped_conv_apply(params_s["c2"], x, padding="VALID"))
         x = max_pool(x, 2)
-        # (B, 4, 4, S, 50) -> per-worker flat (h, w, c) rows
+        # (B, 4, 4, S, 50) -> per-worker flat (h, w, c) rows (unpack first:
+        # worker packing may have factorized the (S, C) tail)
+        x = grouped_unpack(x, S)
         x = x.transpose(0, 3, 1, 2, 4).reshape(B, S, 800)
         x = jax.nn.relu(grouped_dense_apply(params_s["f1"], x))
         x = log_softmax(grouped_dense_apply(params_s["f2"], x))

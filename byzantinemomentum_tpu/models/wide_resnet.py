@@ -16,7 +16,7 @@ from byzantinemomentum_tpu.models import ModelDef, register
 from byzantinemomentum_tpu.models.core import (
     batchnorm_apply, batchnorm_init, conv_apply, conv_init, dense_apply,
     dense_init, dropout_apply, grouped_batchnorm_apply, grouped_conv_apply,
-    grouped_dense_apply, grouped_dropout_apply, log_softmax)
+    grouped_dense_apply, grouped_dropout_apply, grouped_unpack, log_softmax)
 
 __all__ = []
 
@@ -139,6 +139,7 @@ def make_wide_resnet(depth=28, widen_factor=10, dropout_rate=0.3, num_classes=10
         out, new_state["bn_out"] = grouped_batchnorm_apply(
             params_s["bn_out"], state["bn_out"], out, train=train)
         out = jax.nn.relu(out)
+        out = grouped_unpack(out, S)  # head needs the true worker axis
         out = jnp.mean(out, axis=(1, 2))                 # (B, S, 64k)
         out = grouped_dense_apply(params_s["fc"], out)
         return log_softmax(out).transpose(1, 0, 2), new_state

@@ -176,3 +176,27 @@ def test_grouped_respects_config_off():
     state2b = engine2.init(jax.random.PRNGKey(0))
     engine2.train_step(state2b, xs, ys, jnp.float32(0.01))
     assert calls
+
+
+def test_apply_grouped_matches_vmap_when_packing_engages():
+    """Worker packing for awkward channel counts (simples-conv's C=50 packs
+    only at S % 64 == 0; empire-cnn's C=64 at even S): the packed grouped
+    path must still match vmap exactly — in particular the flatten stages
+    must unpack before building per-worker rows (a missing unpack reshapes
+    other workers' channels into the fc input with NO shape error)."""
+    from byzantinemomentum_tpu.models.core import _worker_packing
+    S, B = 64, 2
+    assert _worker_packing(S, 50) > 1  # the scenario actually packs
+    model = models.build("simples-conv")
+    params, state = model.init(jax.random.PRNGKey(0))
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, 28, 28, 1),
+                           jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(2), S)
+    out_v, _ = jax.vmap(
+        lambda x, k: model.apply(params, state, x, train=True, rng=k))(
+            xs, keys)
+    out_g, _ = model.apply_grouped(
+        stacked(params, S), state, xs, train=True, rng=keys)
+    np.testing.assert_allclose(np.asarray(out_g, np.float32),
+                               np.asarray(out_v, np.float32),
+                               rtol=2e-5, atol=2e-5)
