@@ -30,7 +30,7 @@ __all__ = [
 ]
 
 
-def weighted_rows_mean(w, gradients, all_finite=None):
+def weighted_rows_mean(w, gradients, all_finite=None, then=None):
     """`w @ gradients` with row-selection non-finite semantics.
 
     `w: f32[n] | f32[r, n]` holds averaging weights (0 on unselected rows).
@@ -57,9 +57,18 @@ def weighted_rows_mean(w, gradients, all_finite=None):
     function re-reading the whole (n, d) matrix. A conservative False
     (e.g. a legitimately huge row whose squared norm overflows) only means
     taking the exact masked path.
+
+    `then`: optional continuation applied to the product INSIDE the cond
+    branches, so only its (typically much smaller) result is the
+    conditional's output instead of the (rounds, d) stack. Measured
+    neutral on v5e at WRN scale (XLA already avoids a physical copy at
+    the conditional boundary — a trace's `conditional` row double-counts
+    its branch fusions); kept because it can only shrink the boundary
+    value and reads more directly ("aggregate the selection" as one unit).
     """
     def fast(g):
-        return jnp.matmul(w, g, precision=jax.lax.Precision.HIGHEST)
+        out = jnp.matmul(w, g, precision=jax.lax.Precision.HIGHEST)
+        return then(out) if then is not None else out
 
     def masked(g):
         finite = jnp.where(jnp.isfinite(g), g, 0.0)
@@ -68,7 +77,8 @@ def weighted_rows_mean(w, gradients, all_finite=None):
         sel = (w > 0).astype(jnp.float32)
         bad = jnp.matmul(sel, nonfin,
                          precision=jax.lax.Precision.HIGHEST) > 0
-        return jnp.where(bad, jnp.nan, out)
+        out = jnp.where(bad, jnp.nan, out)
+        return then(out) if then is not None else out
 
     if all_finite is None:
         all_finite = jnp.all(jnp.isfinite(gradients))
