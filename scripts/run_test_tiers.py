@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Run the test suite tier by tier and record a verifiable artifact.
+
+Writes `TESTS_r{N}.json` at the repo root: the default tier in one pytest
+invocation, then the slow tier (`--runslow -m slow`) SHARDED BY FILE with
+per-shard pass counts and wall times — the build host has one CPU core, so
+a single `--runslow` run exceeds any reasonable review window (VERDICT r4
+item 3); per-file shards keep each run bounded and the artifact shows all
+of them green at the recorded HEAD.
+
+Usage: python scripts/run_test_tiers.py --round 5
+"""
+
+import argparse
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+_SUMMARY = re.compile(
+    r"(?:(?P<failed>\d+) failed)?(?:, )?(?P<passed>\d+) passed"
+    r"(?:, (?P<skipped>\d+) skipped)?(?:, \d+ deselected)?"
+    r"(?:, (?P<errors>\d+) errors?)?")
+
+
+def run_pytest(args):
+    start = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "--tb=line", *args],
+        cwd=ROOT, capture_output=True, text=True)
+    elapsed = time.monotonic() - start
+    counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
+    for line in reversed(proc.stdout.splitlines()):
+        m = _SUMMARY.search(line)
+        if m and m.group("passed"):
+            for key in counts:
+                counts[key] = int(m.group(key) or 0)
+            break
+    else:
+        if "no tests ran" not in proc.stdout:
+            counts["errors"] = max(counts["errors"], proc.returncode != 0)
+    counts["seconds"] = round(elapsed, 1)
+    counts["returncode"] = proc.returncode
+    if proc.returncode not in (0, 5):  # 5 = no tests collected (empty shard)
+        counts["tail"] = proc.stdout.splitlines()[-12:]
+    return counts
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--round", type=int, required=True)
+    parser.add_argument("--out", type=str, default=None)
+    args = parser.parse_args()
+
+    head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=ROOT,
+                          capture_output=True, text=True).stdout.strip()
+
+    print("default tier ...", flush=True)
+    default = run_pytest(["tests/"])
+    print(f"  {default}", flush=True)
+
+    shards = {}
+    for path in sorted((ROOT / "tests").glob("test_*.py")):
+        print(f"slow tier: {path.name} ...", flush=True)
+        res = run_pytest([f"tests/{path.name}", "--runslow", "-m", "slow"])
+        if res["returncode"] == 5:  # file has no slow tests
+            continue
+        shards[path.name] = res
+        print(f"  {res}", flush=True)
+
+    slow_total = {
+        "passed": sum(s["passed"] for s in shards.values()),
+        "failed": sum(s["failed"] for s in shards.values()),
+        "skipped": sum(s["skipped"] for s in shards.values()),
+        "seconds": round(sum(s["seconds"] for s in shards.values()), 1),
+    }
+    out = {
+        "round": args.round,
+        "git_head": head,
+        "host": "1-core TPU build host (slow tier sharded by file "
+                "because one --runslow run exceeds a review window)",
+        "default_tier": default,
+        "slow_tier_total": slow_total,
+        "slow_tier_shards": shards,
+        "green": bool(default["failed"] == 0 and default["errors"] == 0
+                      and default["returncode"] == 0
+                      and slow_total["failed"] == 0
+                      and all(s["returncode"] == 0 for s in shards.values())),
+    }
+    path = pathlib.Path(args.out) if args.out else (
+        ROOT / f"TESTS_r{args.round:02d}.json")
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps({k: out[k] for k in
+                      ("round", "git_head", "green")}
+                     | {"default": default["passed"],
+                        "slow": slow_total["passed"]}))
+
+
+if __name__ == "__main__":
+    main()
