@@ -104,3 +104,22 @@ register("adagrad", _from_optax(
     "adagrad", lambda eps=1e-10, **kw:
     optax.chain(optax.scale_by_rss(initial_accumulator_value=0.0, eps=eps),
                 optax.scale(-1.0))))
+# Registry tail (the reference name-resolves every torch.optim subclass,
+# reference `experiments/optimizer.py:32-51`; these cover the remaining
+# commonly-named ones through the same optax pattern)
+register("adamax", _from_optax(
+    "adamax", lambda b1=0.9, b2=0.999, eps=1e-8, **kw:
+    optax.chain(optax.scale_by_adamax(b1=b1, b2=b2, eps=eps),
+                optax.scale(-1.0))))
+register("adadelta", _from_optax(
+    "adadelta", lambda rho=0.9, eps=1e-6, **kw:
+    optax.chain(optax.scale_by_adadelta(rho=rho, eps=eps),
+                optax.scale(-1.0))))
+register("radam", _from_optax(
+    "radam", lambda b1=0.9, b2=0.999, eps=1e-8, **kw:
+    optax.chain(optax.scale_by_radam(b1=b1, b2=b2, eps=eps),
+                optax.scale(-1.0))))
+register("amsgrad", _from_optax(
+    "amsgrad", lambda b1=0.9, b2=0.999, eps=1e-8, **kw:
+    optax.chain(optax.scale_by_amsgrad(b1=b1, b2=b2, eps=eps),
+                optax.scale(-1.0))))
