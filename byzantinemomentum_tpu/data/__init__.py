@@ -299,6 +299,9 @@ register("kmnist", lambda **kw: sources.load_mnist("kmnist", **kw))
 # (reference `experiments/dataset.py:115-118`)
 register("emnist", sources.load_emnist)
 register("qmnist", sources.load_qmnist)
+# SVHN parses torchvision's .mat source files (plain-ToTensor semantics:
+# the reference's transforms dict has no svhn entry either)
+register("svhn", sources.load_svhn)
 register("cifar10", lambda **kw: sources.load_cifar(10, **kw))
 register("cifar100", lambda **kw: sources.load_cifar(100, **kw))
 

@@ -35,8 +35,8 @@ import numpy as np
 from byzantinemomentum_tpu import utils
 
 __all__ = ["data_dirs", "load_mnist", "load_emnist", "load_qmnist",
-           "load_cifar", "synthetic_images", "download_enabled",
-           "ensure_downloaded"]
+           "load_cifar", "load_svhn", "synthetic_images",
+           "download_enabled", "ensure_downloaded"]
 
 
 def data_dirs():
@@ -120,6 +120,12 @@ DOWNLOADS = {
     "phishing": [
         ("https://www.csie.ntu.edu.tw/~cjlin/libsvmtools/datasets/binary"
          "/phishing", None, "phishing"),
+    ],
+    "svhn": [
+        ("http://ufldl.stanford.edu/housenumbers/train_32x32.mat",
+         "md5:e26dedcc434d2e4c54c9b2d4a06d8373", "SVHN/train_32x32.mat"),
+        ("http://ufldl.stanford.edu/housenumbers/test_32x32.mat",
+         "md5:eb5a983be6a315427106f1b164d9cef3", "SVHN/test_32x32.mat"),
     ],
 }
 
@@ -444,6 +450,39 @@ def load_cifar(classes, **unused):
                 "deterministic synthetic fallback")
     return synthetic_images(name, shape=(32, 32, 3), classes=classes,
                             train=50000, test=10000)
+
+
+# --------------------------------------------------------------------------- #
+# SVHN (torchvision `datasets.SVHN`): MATLAB .mat containers
+
+
+def load_svhn(**unused):
+    """Load SVHN from the published `train_32x32.mat` / `test_32x32.mat`
+    (torchvision's exact source files), else synthesize. X arrives
+    (32, 32, 3, N) channel-last sample-minor; labels use 10 for digit '0',
+    which torchvision maps to 0 (`torchvision/datasets/svhn.py`:
+    `np.place(self.labels, self.labels == 10, 0)`) — so do we."""
+    ensure_downloaded("svhn")
+    train_p = _find("SVHN/train_32x32.mat", "train_32x32.mat")
+    test_p = _find("SVHN/test_32x32.mat", "test_32x32.mat")
+    if train_p is None or test_p is None:
+        utils.trace("svhn: raw files not found on disk; using the "
+                    "deterministic synthetic fallback")
+        return synthetic_images("svhn", shape=(32, 32, 3), classes=10,
+                                train=73257, test=26032)
+    from scipy.io import loadmat  # in-image dependency; imported lazily
+
+    def split(path):
+        mat = loadmat(str(path))
+        x = np.ascontiguousarray(np.transpose(mat["X"], (3, 0, 1, 2)))
+        y = mat["y"].reshape(-1).astype(np.int32)
+        y[y == 10] = 0
+        return x.astype(np.uint8), y
+
+    train_x, train_y = split(train_p)
+    test_x, test_y = split(test_p)
+    return {"train_x": train_x, "train_y": train_y,
+            "test_x": test_x, "test_y": test_y}
 
 
 # --------------------------------------------------------------------------- #

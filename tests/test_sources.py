@@ -431,3 +431,39 @@ def test_download_network_failure_degrades_to_fallback(data_dir, monkeypatch):
         lambda name, op=None: orig(name, opener=opener))
     out = sources.load_mnist("mnist")
     assert out.get("synthetic") is True
+
+
+def test_svhn_mat_files(data_dir):
+    """SVHN's .mat containers parse with torchvision's exact semantics:
+    (32,32,3,N) -> NHWC and label 10 -> digit 0."""
+    from scipy.io import savemat
+    rng = np.random.default_rng(41)
+    def make(n):
+        x = rng.integers(0, 256, (32, 32, 3, n)).astype(np.uint8)
+        y = rng.integers(1, 11, (n, 1)).astype(np.uint8)  # 1..10, 10 = '0'
+        return x, y
+    d = data_dir / "SVHN"
+    d.mkdir()
+    tr_x, tr_y = make(6)
+    te_x, te_y = make(3)
+    savemat(d / "train_32x32.mat", {"X": tr_x, "y": tr_y})
+    savemat(d / "test_32x32.mat", {"X": te_x, "y": te_y})
+    out = sources.load_svhn()
+    assert "synthetic" not in out
+    assert out["train_x"].shape == (6, 32, 32, 3)
+    np.testing.assert_array_equal(out["train_x"][0], tr_x[..., 0])
+    expect = tr_y.reshape(-1).astype(np.int32)
+    expect[expect == 10] = 0
+    np.testing.assert_array_equal(out["train_y"], expect)
+    assert out["train_y"].max() < 10
+
+
+def test_svhn_fallback_and_registry(data_dir, monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "16")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "8")
+    from byzantinemomentum_tpu import data as data_mod
+    tr, te = data_mod.make_datasets("svhn", 4, 4)
+    assert tr.synthetic and te.synthetic
+    x, y = tr.sample()
+    assert x.shape == (4, 32, 32, 3) and x.max() <= 1.0  # plain ToTensor
+    assert not tr.sample_flips().any()
