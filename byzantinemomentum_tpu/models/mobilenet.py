@@ -1,0 +1,153 @@
+"""`mobilenet_v2` — torchvision MobileNetV2, as a pure-pytree ModelDef.
+
+Registry-tail extension in the `models/resnet.py` pattern (the reference
+resolves every `torchvision.models` name, reference
+`experiments/model.py:40-90`); the parameter count is pinned against
+torchvision in `tests/test_vgg_densenet.py`.
+
+Architecture (torchvision `mobilenetv2.py`, width_mult 1.0):
+conv3x3(3,32,s2,nobias) BN ReLU6, then inverted residuals
+(expansion t, out c, repeats n, first-stride s):
+(1,16,1,1) (6,24,2,2) (6,32,3,2) (6,64,4,2) (6,96,3,1) (6,160,3,2)
+(6,320,1,1) — each block: [1x1 expand BN ReLU6 (skipped at t=1)],
+3x3 DEPTHWISE(s) BN ReLU6, 1x1 project BN (linear); residual add iff
+stride 1 and cin == cout — then conv1x1(320,1280) BN ReLU6, global
+average pool, Dropout(0.2), Linear(1280, num_classes).
+
+Initialization parity: kaiming-normal(fan_out) conv kernels (bias-free),
+BN gamma=1/beta=0, classifier W ~ N(0, 0.01) with zero bias
+(`MobileNetV2.__init__`'s init loop).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.models import ModelDef, register
+from byzantinemomentum_tpu.models.core import (
+    batchnorm_apply, batchnorm_init, dropout_apply)
+
+__all__ = []
+
+# (expansion, out channels, repeats, first stride)
+_CFG = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1))
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_out = kh * kw * cout
+    std = math.sqrt(2.0 / fan_out)
+    return {"w": std * jax.random.normal(key, (kh, kw, cin, cout),
+                                         jnp.float32)}
+
+
+def _dw_init(key, c):
+    """Depthwise 3x3: torch shape (c, 1, 3, 3); kaiming fan_out counts the
+    per-group output (9 * 1). HWIO for feature_group_count=c is
+    (3, 3, 1, c)."""
+    std = math.sqrt(2.0 / 9.0)
+    return {"w": std * jax.random.normal(key, (3, 3, 1, c), jnp.float32)}
+
+
+def _conv(params, x, *, stride=1, pad=0, groups=1):
+    return lax.conv_general_dilated(
+        x, params["w"], window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups)
+
+
+def _relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def _block_init(key, cin, cout, t):
+    keys = jax.random.split(key, 3)
+    h = cin * t
+    params, state = {}, {}
+    if t != 1:
+        params["expand"] = _conv_init(keys[0], 1, 1, cin, h)
+        params["bn_e"], state["bn_e"] = batchnorm_init(h)
+    params["dw"] = _dw_init(keys[1], h)
+    params["bn_d"], state["bn_d"] = batchnorm_init(h)
+    params["project"] = _conv_init(keys[2], 1, 1, h, cout)
+    params["bn_p"], state["bn_p"] = batchnorm_init(cout)
+    return params, state
+
+
+def _block_apply(params, state, x, *, stride, train):
+    new_state = dict(state)
+    out = x
+    if "expand" in params:
+        out = _conv(params["expand"], out)
+        out, new_state["bn_e"] = batchnorm_apply(params["bn_e"],
+                                                 state["bn_e"], out,
+                                                 train=train)
+        out = _relu6(out)
+    h = out.shape[-1]
+    out = _conv(params["dw"], out, stride=stride, pad=1, groups=h)
+    out, new_state["bn_d"] = batchnorm_apply(params["bn_d"], state["bn_d"],
+                                             out, train=train)
+    out = _relu6(out)
+    out = _conv(params["project"], out)
+    out, new_state["bn_p"] = batchnorm_apply(params["bn_p"], state["bn_p"],
+                                             out, train=train)
+    if stride == 1 and x.shape[-1] == out.shape[-1]:
+        out = out + x
+    return out, new_state
+
+
+def make_mobilenet_v2(num_classes=10, **kwargs):
+    n_blocks = sum(n for _, _, n, _ in _CFG)
+
+    def init(key):
+        keys = jax.random.split(key, n_blocks + 3)
+        params, state = {}, {}
+        params["stem"] = _conv_init(keys[0], 3, 3, 3, 32)
+        params["bn0"], state["bn0"] = batchnorm_init(32)
+        cin, k = 32, 1
+        for t, c, n, _s in _CFG:
+            for i in range(n):
+                name = f"b{k - 1}"
+                params[name], state[name] = _block_init(keys[k], cin, c, t)
+                cin, k = c, k + 1
+        params["head"] = _conv_init(keys[k], 1, 1, cin, 1280)
+        params["bn1"], state["bn1"] = batchnorm_init(1280)
+        kw_, kb = jax.random.split(keys[k + 1])
+        params["fc"] = {
+            "w": 0.01 * jax.random.normal(kw_, (1280, num_classes),
+                                          jnp.float32),
+            "b": jnp.zeros((num_classes,), jnp.float32)}
+        return params, state
+
+    def apply(params, state, x, train=False, rng=None):
+        if train and rng is None:
+            raise ValueError("mobilenet_v2 needs a PRNG key in train mode "
+                             "(classifier dropout)")
+        new_state = dict(state)
+        x = _conv(params["stem"], x, stride=2, pad=1)
+        x, new_state["bn0"] = batchnorm_apply(params["bn0"], state["bn0"], x,
+                                              train=train)
+        x = _relu6(x)
+        k = 0
+        for t, c, n, s in _CFG:
+            for i in range(n):
+                name = f"b{k}"
+                x, new_state[name] = _block_apply(
+                    params[name], state[name], x,
+                    stride=(s if i == 0 else 1), train=train)
+                k += 1
+        x = _conv(params["head"], x)
+        x, new_state["bn1"] = batchnorm_apply(params["bn1"], state["bn1"], x,
+                                              train=train)
+        x = _relu6(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = dropout_apply(rng, x, 0.2, train=train)
+        return x @ params["fc"]["w"] + params["fc"]["b"], new_state
+
+    return ModelDef("mobilenet_v2", init, apply, (32, 32, 3))
+
+
+register("mobilenet_v2", make_mobilenet_v2)

@@ -100,3 +100,25 @@ def test_vgg_adaptive_avg_pool_matches_torch():
             torch.from_numpy(x.transpose(0, 3, 1, 2)), (7, 7))
         np.testing.assert_allclose(
             got, ref.numpy().transpose(0, 2, 3, 1), rtol=1e-5, atol=1e-6)
+
+
+def test_mobilenet_v2_param_count_matches_torchvision():
+    assert models.build("mobilenet_v2",
+                        num_classes=1000).param_count() == 3_504_872
+
+
+def test_mobilenet_v2_forward_and_train_mode():
+    model_def = models.build("mobilenet_v2")
+    params, state = model_def.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 32, 32, 3)),
+                    jnp.float32)
+    out, _ = model_def.apply(params, state, x, train=False,
+                             rng=jax.random.PRNGKey(0))
+    assert out.shape == (2, 10)
+    out_t, new_state = model_def.apply(params, state, x, train=True,
+                                       rng=jax.random.PRNGKey(1))
+    assert np.isfinite(np.asarray(out_t)).all()
+    changed = jax.tree.map(
+        lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+        state, new_state)
+    assert any(jax.tree.leaves(changed))
