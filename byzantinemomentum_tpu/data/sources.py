@@ -185,8 +185,10 @@ def ensure_downloaded(name, opener=None):
         "BMT_DOWNLOAD_UNVERIFIED", "").lower() not in ("", "0", "false", "no")
     fetched = False
     for url, checksum, rel in DOWNLOADS[name]:
-        base = pathlib.PurePath(rel).name
-        if _find(rel, base) is not None:
+        # Probe the subdir-qualified path ONLY: the MNIST family shares
+        # bare idx filenames, so a bare-basename probe would cross-match a
+        # sibling dataset's cached tree and silently skip the fetch
+        if _find(rel) is not None:
             continue
         if checksum is None and not unverified_ok:
             utils.warning(

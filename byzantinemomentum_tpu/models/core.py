@@ -254,7 +254,9 @@ def batchnorm_apply(params, state, x, *, train):
 
 def _worker_packing(S, c):
     """Smallest P dividing S with (P*c) % 128 == 0, else 1."""
-    if os.environ.get("BMT_NO_WORKER_PACK") or c % 128 == 0:
+    no_pack = os.environ.get("BMT_NO_WORKER_PACK", "").lower() not in (
+        "", "0", "false", "no")
+    if no_pack or c % 128 == 0:
         return 1
     for P in range(2, S + 1):
         if S % P == 0 and (P * c) % 128 == 0:

@@ -21,10 +21,10 @@ import time
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-_SUMMARY = re.compile(
-    r"(?:(?P<failed>\d+) failed)?(?:, )?(?P<passed>\d+) passed"
-    r"(?:, (?P<skipped>\d+) skipped)?(?:, \d+ deselected)?"
-    r"(?:, (?P<errors>\d+) errors?)?")
+# Token-wise parse: a summary line may lack any given token (e.g. an
+# all-fail shard prints only "3 failed in ..."), so match each count
+# independently instead of one positional pattern
+_TOKEN = re.compile(r"(\d+) (passed|failed|skipped|error(?:s)?)")
 
 
 def run_pytest(args):
@@ -35,10 +35,11 @@ def run_pytest(args):
     elapsed = time.monotonic() - start
     counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
     for line in reversed(proc.stdout.splitlines()):
-        m = _SUMMARY.search(line)
-        if m and m.group("passed"):
-            for key in counts:
-                counts[key] = int(m.group(key) or 0)
+        tokens = _TOKEN.findall(line)
+        if tokens:
+            for num, kind in tokens:
+                key = "errors" if kind.startswith("error") else kind
+                counts[key] = int(num)
             break
     else:
         if "no tests ran" not in proc.stdout:

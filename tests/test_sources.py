@@ -467,3 +467,41 @@ def test_svhn_fallback_and_registry(data_dir, monkeypatch):
     x, y = tr.sample()
     assert x.shape == (4, 32, 32, 3) and x.max() <= 1.0  # plain ToTensor
     assert not tr.sample_flips().any()
+
+
+def test_download_probe_does_not_cross_match_sibling_family(data_dir,
+                                                            monkeypatch):
+    """Presence probing uses the subdir-qualified path only: a cached MNIST
+    tree must not satisfy a KMNIST download probe (the family shares bare
+    idx filenames)."""
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    raw = data_dir / "MNIST" / "raw"
+    raw.mkdir(parents=True)
+    (raw / "train-images-idx3-ubyte.gz").write_bytes(b"mnist bytes")
+    import hashlib
+    payload = b"kmnist payload"
+    url = "https://example.invalid/k/train-images-idx3-ubyte.gz"
+    monkeypatch.setitem(
+        sources.DOWNLOADS, "kmnist",
+        [(url, "md5:" + hashlib.md5(payload).hexdigest(),
+          "KMNIST/raw/train-images-idx3-ubyte.gz")])
+    opener = _fake_opener({url: payload})
+    assert sources.ensure_downloaded("kmnist", opener=opener) is True
+    assert opener.calls == [url]
+    assert (data_dir / "KMNIST" / "raw"
+            / "train-images-idx3-ubyte.gz").read_bytes() == payload
+
+
+def test_worker_pack_kill_switch_value_semantics(monkeypatch):
+    """BMT_NO_WORKER_PACK parses values like the other env knobs: '0' and
+    'false' keep packing ON (ADVICE-style regression for the A/B
+    workflow)."""
+    from byzantinemomentum_tpu.models.core import _worker_packing
+    monkeypatch.delenv("BMT_NO_WORKER_PACK", raising=False)
+    assert _worker_packing(4, 64) == 2
+    monkeypatch.setenv("BMT_NO_WORKER_PACK", "0")
+    assert _worker_packing(4, 64) == 2
+    monkeypatch.setenv("BMT_NO_WORKER_PACK", "false")
+    assert _worker_packing(4, 64) == 2
+    monkeypatch.setenv("BMT_NO_WORKER_PACK", "1")
+    assert _worker_packing(4, 64) == 1
