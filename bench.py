@@ -214,8 +214,10 @@ def main():
         headline, mode = sps_bf16, "bf16-mixed"
     else:
         headline, mode = sps_f32, "f32"
-    flops = flops_f32  # identical per mode (flops_hint)
-    del flops_bf16
+    # Identical per mode (flops_hint) — but the f32 cost_analysis can fail
+    # (falling back to None) while the bf16 pass succeeds; either count
+    # keeps the MFU headline alive
+    flops = flops_f32 or flops_bf16
     peak, device_kind = _peak_flops()
     mfu = (flops * headline / peak) if (flops and peak) else None
 
@@ -228,9 +230,9 @@ def main():
                                         windows=1, min_measure_s=2.5,
                                         flops_hint=krum_flops32)
     krum_best = max(krum_f32, krum_bf16)
-    # flops_hint makes the per-mode counts identical by construction
-    krum_flops = krum_flops32
-    del krum_flops16
+    # flops_hint makes the per-mode counts identical by construction;
+    # either survives the other's cost_analysis failure
+    krum_flops = krum_flops32 or krum_flops16
     cells["krum_f11"] = {
         "steps_per_sec_f32": krum_f32,
         "steps_per_sec_bf16_mixed": krum_bf16,
@@ -252,8 +254,8 @@ def main():
     wrn_bf16, wrn_flops16 = _run_mode("bfloat16", wrn_data,
                                       flops_hint=wrn_flops32, **wrn_kw)
     wrn_best = max(wrn_f32, wrn_bf16)
-    wrn_flops = wrn_flops32  # identical per mode (flops_hint)
-    del wrn_flops16
+    # identical per mode (flops_hint); either survives a failed analysis
+    wrn_flops = wrn_flops32 or wrn_flops16
     cells["wrn28x10"] = {
         "steps_per_sec_f32": wrn_f32,
         "steps_per_sec_bf16_mixed": wrn_bf16,

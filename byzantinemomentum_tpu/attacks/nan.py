@@ -18,6 +18,15 @@ def attack(grad_honests, f_real, **kwargs):
     return jnp.full((f_real, grad_honests.shape[1]), jnp.nan, dtype=grad_honests.dtype)
 
 
+def detect(gradients):
+    """Rows carrying any non-finite coordinate — the detection counterpart
+    of this attack, generalized to every numerically-corrupt submission
+    (NaN shards, inf blowups). The faults subsystem's NaN-quarantine routes
+    through this single predicate (`faults/sanitize.py`), so what the
+    attack can emit, the sanitizer can flag. `f32[n, d] -> bool[n]`."""
+    return ~jnp.all(jnp.isfinite(gradients), axis=1)
+
+
 def check(grad_honests, f_real, **kwargs):
     if grad_honests.shape[0] == 0:
         return "Expected a non-empty list of honest gradients"

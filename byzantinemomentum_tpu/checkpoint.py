@@ -75,6 +75,12 @@ def load(path, template, *, return_data=False):
     out = {}
     for name, ref in template._asdict().items():
         if name not in stored:
+            if name == "fault_buffer":
+                # Pre-faults checkpoints (same VERSION) lack the straggler
+                # buffer; resuming them under a fresh fault plan starts the
+                # buffer at the template's zeros — the documented cold-start
+                out[name] = jnp.asarray(ref)
+                continue
             raise utils.UserException(
                 f"Unable to load checkpoint {str(path)!r}: missing field {name!r}")
         value = stored[name]

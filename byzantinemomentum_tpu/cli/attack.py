@@ -29,7 +29,8 @@ from byzantinemomentum_tpu import losses as losses_mod
 from byzantinemomentum_tpu import models as models_mod
 from byzantinemomentum_tpu import ops as ops_mod
 from byzantinemomentum_tpu import utils
-from byzantinemomentum_tpu.engine import EngineConfig, STUDY_COLUMNS, build_engine
+from byzantinemomentum_tpu.engine import (
+    EngineConfig, FAULT_COLUMNS, STUDY_COLUMNS, build_engine)
 from byzantinemomentum_tpu.models.core import apply_named_init
 
 __all__ = ["process_commandline", "main"]
@@ -90,6 +91,14 @@ def process_commandline(argv=None):
              "faster grouped execution)")
     add("--attack", type=str, default="nan", help="Attack to use")
     add("--attack-args", nargs="*", help="key:value args for the attack")
+    add("--fault-plan", type=str, default=None,
+        help="JSON fault plan (faults.FaultPlan): deterministic per-step "
+             "system faults — stragglers, dropped workers, corrupted/NaN "
+             "shards, duplicated submissions, device loss — injected into "
+             "the stacked gradient batch before aggregation, with the "
+             "plan's degradation policy (NaN-quarantine, dynamic quorum, "
+             "download retry). Adds the 'Faults injected'/'Workers "
+             "active'/'Quorum f' columns to the study CSV")
     add("--model", type=str, default="simples-conv", help="Model to train")
     add("--model-args", nargs="*", help="key:value args for the model")
     add("--loss", type=str, default="nll", help="Loss to use")
@@ -410,6 +419,34 @@ def main(argv=None):
             utils.fatal_unavailable(attacks_mod.attacks, args.attack,
                                     what="attack")
         attack = attacks_mod.attacks[args.attack]
+        # Fault plan (parsed before the datasets: its policy parameterizes
+        # the download retry/backoff path, `data/sources.py:_fetch`)
+        fault_plan = None
+        fault_schedule = None
+        if args.fault_plan is not None:
+            from byzantinemomentum_tpu import faults as faults_mod
+            try:
+                fault_plan = faults_mod.FaultPlan.load(args.fault_plan)
+            except (OSError, ValueError, TypeError) as err:
+                utils.fatal(f"Unable to load fault plan "
+                            f"{args.fault_plan!r}: {err}")
+            message = fault_plan.validate(args.nb_workers, args.nb_honests)
+            if message is not None:
+                utils.fatal(f"Fault plan {args.fault_plan!r} cannot be "
+                            f"used: {message}")
+            policy = fault_plan.policy
+            os.environ.setdefault("BMT_FETCH_ATTEMPTS",
+                                  str(policy.fetch_attempts))
+            os.environ.setdefault("BMT_FETCH_BACKOFF",
+                                  str(policy.fetch_backoff))
+            os.environ.setdefault("BMT_FETCH_TIMEOUT",
+                                  str(policy.fetch_timeout))
+            fault_schedule = faults_mod.build_schedule(
+                fault_plan, nb_workers=args.nb_workers,
+                nb_honests=args.nb_honests)
+            if fault_schedule is None:
+                utils.info("Fault plan has no events; the fault machinery "
+                           "stays out of the compiled step entirely")
         # Model
         model_def = models_mod.build(args.model, **args.model_args)
         # Datasets
@@ -439,7 +476,11 @@ def main(argv=None):
             nb_local_steps=args.nb_local_steps,
             gars_per_call=args.gars_per_call,
             grouped_workers=not args.no_grouped_workers,
-            dtype=args.dtype, compute_dtype=args.compute_dtype)
+            dtype=args.dtype, compute_dtype=args.compute_dtype,
+            fault_quarantine=(fault_plan.policy.nan_quarantine
+                              if fault_plan is not None else True),
+            fault_dynamic_quorum=(fault_plan.policy.dynamic_quorum
+                                  if fault_plan is not None else True))
         from byzantinemomentum_tpu import optim
         optimizer = optim.build(args.optimizer,
                                 weight_decay=args.weight_decay,
@@ -447,7 +488,7 @@ def main(argv=None):
         engine = build_engine(
             cfg=cfg, model_def=model_def, loss=loss, criterion=criterion,
             defenses=defenses, attack=attack, attack_kwargs=args.attack_args,
-            optimizer=optimizer)
+            optimizer=optimizer, faults=fault_schedule)
         # Multi-chip mesh: shard the step over a (workers, model) device grid
         mesh = None
         if args.mesh is not None:
@@ -522,7 +563,11 @@ def main(argv=None):
                 if args.evaluation_delta > 0:
                     results.make("eval", "Step number", "Cross-accuracy")
                 if args.nb_for_study > 0:
-                    results.make("study", *STUDY_COLUMNS)
+                    # Resilience columns appended only under a fault plan —
+                    # fault-free runs keep the reference's exact CSV schema
+                    study_columns = STUDY_COLUMNS + (
+                        FAULT_COLUMNS if fault_schedule is not None else ())
+                    results.make("study", *study_columns)
                 (resdir / "config").write_text(_config_text(args) + os.linesep)
                 with (resdir / "config.json").open("w") as fd:
                     def jsonable(x):
@@ -655,6 +700,11 @@ def main(argv=None):
                     row.append(float_format % float(value))
                 ar = p_metrics["Attack acceptation ratio"]
                 row.append(float(ar[i] if p_m > 1 else ar))
+                if fault_schedule is not None:
+                    # Integer resilience counters (faults/quorum layer)
+                    for column in FAULT_COLUMNS:
+                        value = p_metrics[column]
+                        row.append(int(value[i] if p_m > 1 else value))
                 results.store(fd_study, *row)
 
         try:

@@ -97,17 +97,31 @@ DOWNLOADS = {
          "md5:bb300cfdad3c16e7a12a480ee83cd310",
          "FashionMNIST/raw/t10k-labels-idx1-ubyte.gz"),
     ],
+    # KMNIST/QMNIST digests are the ones torchvision pins for these exact
+    # files (torchvision `datasets/mnist.py` KMNIST.resources,
+    # `datasets/qmnist.py` QMNIST.resources), so neither dataset needs the
+    # BMT_DOWNLOAD_UNVERIFIED escape hatch
     "kmnist": [
-        (_DL_KMNIST + f, None, f"KMNIST/raw/{f}")
-        for f in ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
-                  "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+        (_DL_KMNIST + f, f"md5:{md5}", f"KMNIST/raw/{f}")
+        for f, md5 in (
+            ("train-images-idx3-ubyte.gz", "bdb82020997e1d708af4cf47b453dcf7"),
+            ("train-labels-idx1-ubyte.gz", "e144d726b3acfaa3e44228e80efcd344"),
+            ("t10k-images-idx3-ubyte.gz", "5c965bf0a639b31b8f53240b1b52f4d7"),
+            ("t10k-labels-idx1-ubyte.gz", "7320c461ea6c1c855c0b718fb2a4b134"),
+        )
     ],
     "qmnist": [
-        (_DL_QMNIST + f + ".gz", None, f"QMNIST/raw/{f}.gz")
-        for f in ("qmnist-train-images-idx3-ubyte",
-                  "qmnist-train-labels-idx2-int",
-                  "qmnist-test-images-idx3-ubyte",
-                  "qmnist-test-labels-idx2-int")
+        (_DL_QMNIST + f + ".gz", f"md5:{md5}", f"QMNIST/raw/{f}.gz")
+        for f, md5 in (
+            ("qmnist-train-images-idx3-ubyte",
+             "ed72d4157d28c017586c42bc6afe6370"),
+            ("qmnist-train-labels-idx2-int",
+             "0058f8dd561b90ffdd0f734c6a30e5e4"),
+            ("qmnist-test-images-idx3-ubyte",
+             "1394631089c404de565df7b7aeaf9412"),
+            ("qmnist-test-labels-idx2-int",
+             "5b5b05890a5e13444e108efe57b788aa"),
+        )
     ],
     "cifar10": [
         ("https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
@@ -153,14 +167,37 @@ def _digest(path, checksum):
     return h.hexdigest(), want
 
 
+def _fetch_env(name, default, cast):
+    raw = os.environ.get(name, "")
+    try:
+        return cast(raw) if raw else default
+    except ValueError:
+        utils.warning(f"Invalid {name}={raw!r}; using {default}")
+        return default
+
+
 def _fetch(url, dest, checksum, opener=None):
     """Stream `url` to `dest` atomically (tmp + rename), verifying
     `checksum` before the rename so a bad payload never lands under a
-    valid name. `opener` is injectable for tests."""
-    opener = opener or urllib.request.urlopen
+    valid name. `opener` is injectable for tests.
+
+    Degradation policy (`faults/retry.py`): the connection carries a stall
+    timeout (a hung socket raises `OSError` and takes the documented
+    disk/synthetic degrade path instead of blocking setup forever), and
+    transient `OSError`s are retried with exponential backoff. Knobs:
+    `BMT_FETCH_TIMEOUT` (seconds, default 60), `BMT_FETCH_ATTEMPTS`
+    (default 3), `BMT_FETCH_BACKOFF` (base seconds, default 1). A checksum
+    mismatch is NOT transient and never retried (same payload would come
+    back; a reachable-but-corrupt source must raise)."""
+    from byzantinemomentum_tpu.faults.retry import with_backoff
+
+    if opener is None:
+        timeout = _fetch_env("BMT_FETCH_TIMEOUT", 60.0, float)
+        opener = lambda u: urllib.request.urlopen(u, timeout=timeout)  # noqa: E731
     dest.parent.mkdir(parents=True, exist_ok=True)
     tmp = dest.with_name(dest.name + ".part")
-    try:
+
+    def attempt():
         with opener(url) as response, open(tmp, "wb") as out:
             for chunk in iter(lambda: response.read(1 << 20), b""):
                 out.write(chunk)
@@ -171,6 +208,14 @@ def _fetch(url, dest, checksum, opener=None):
                     f"Checksum mismatch for {url}: expected {checksum}, "
                     f"got {got} — refusing to install the file")
         tmp.replace(dest)
+
+    try:
+        with_backoff(
+            attempt,
+            attempts=_fetch_env("BMT_FETCH_ATTEMPTS", 3, int),
+            base_delay=_fetch_env("BMT_FETCH_BACKOFF", 1.0, float),
+            on_retry=lambda i, delay, err: utils.warning(
+                f"Fetch of {url} failed ({err}); retry in {delay:.0f}s"))
     finally:
         tmp.unlink(missing_ok=True)
 

@@ -59,6 +59,16 @@ class EngineConfig:
     #                               same math as the vmapped path, ~2x
     #                               faster on TPU. False = always vmap
     #                               (--no-grouped-workers).
+    fault_quarantine: bool = True  # degradation policy when a fault
+    #                               schedule is attached (`faults/`):
+    #                               quarantine non-finite submission rows
+    #                               out of the aggregation and the quorum
+    #                               (no effect without a schedule)
+    fault_dynamic_quorum: bool = True  # recompute the effective (n, f)
+    #                               the GAR runs with when workers are
+    #                               absent (`faults/quorum.py`); False
+    #                               keeps the declared f and only excludes
+    #                               the absent rows
 
     def __post_init__(self):
         if self.momentum_at not in ("update", "server", "worker"):

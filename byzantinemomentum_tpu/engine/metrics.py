@@ -19,8 +19,8 @@ f32 scalars; the host merely formats them (`%.8e`, reference
 
 import jax.numpy as jnp
 
-__all__ = ["STUDY_COLUMNS", "avg_dev_max", "cosine", "study_metrics",
-           "push_past"]
+__all__ = ["STUDY_COLUMNS", "FAULT_COLUMNS", "avg_dev_max", "cosine",
+           "study_metrics", "push_past"]
 
 # CSV header, byte-identical to the reference's (reference `attack.py:564-571`)
 STUDY_COLUMNS = (
@@ -34,6 +34,14 @@ STUDY_COLUMNS = (
     "Sampled-prev cosine", "Sampled composite curvature",
     "Attack acceptation ratio",
 )
+
+# Resilience columns, appended to the study CSV when a fault plan is
+# active (`--fault-plan`): scheduled fault conditions live this step, the
+# effective worker count after drops/quarantine, and the effective
+# Byzantine tolerance the aggregation ran with (`faults/quorum.py`). Kept
+# out of STUDY_COLUMNS so fault-free runs stay byte-identical to the
+# reference's CSV schema.
+FAULT_COLUMNS = ("Faults injected", "Workers active", "Quorum f")
 
 # NaN as a Python float: creating a device array at import time would
 # initialize the JAX backend before the CLI's --device platform selection

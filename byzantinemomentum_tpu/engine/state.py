@@ -38,10 +38,22 @@ class TrainState(typing.NamedTuple):
     rng: jax.Array               # PRNG key (checkpointed — fixes the
     #                              reference's resume nondeterminism,
     #                              reference README.md:105)
+    fault_buffer: jax.Array = () # f32[h, d] last fresh per-worker
+    #                              submissions, feeding straggler faults
+    #                              (shape (0, d) unless the engine carries
+    #                              a fault schedule with stragglers —
+    #                              `faults/inject.py`)
 
 
-def init_state(cfg, theta, net_state, rng, *, study, opt_state=()):
-    """Fresh-run initialization (reference `attack.py:668-681`)."""
+def init_state(cfg, theta, net_state, rng, *, study, opt_state=(),
+               fault_buffer_rows=0):
+    """Fresh-run initialization (reference `attack.py:668-681`).
+
+    `fault_buffer_rows`: honest-worker count when the engine's fault
+    schedule contains stragglers (the stale-submission buffer), else 0 —
+    the buffer starts at zeros, so a straggler window opening at step 0
+    replays a no-progress submission.
+    """
     d = theta.shape[0]
     h = cfg.nb_honests
     past = cfg.nb_for_study_past if study else 0
@@ -61,4 +73,5 @@ def init_state(cfg, theta, net_state, rng, *, study, opt_state=()):
         steps=jnp.int32(0),
         datapoints=jnp.int32(0),
         rng=rng,
+        fault_buffer=jnp.zeros((fault_buffer_rows, d), theta.dtype),
     )

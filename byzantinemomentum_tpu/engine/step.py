@@ -25,6 +25,7 @@ but hard-disabled (`attack.py:796-798`).
 
 import contextlib
 import functools
+import typing
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +37,14 @@ from byzantinemomentum_tpu.models import flatten_params
 from byzantinemomentum_tpu.models.core import BN_MOMENTUM
 
 __all__ = ["Engine", "build_engine", "grouped_disabled", "grouped_sharded"]
+
+
+class _FaultCtx(typing.NamedTuple):
+    """This step's injected-fault context, threaded from the honest phase
+    into the defense (`faults/inject.py` output)."""
+
+    active: jax.Array    # bool[n] — rows present this step (drops excluded)
+    injected: jax.Array  # i32[] — fault conditions live this step
 
 # Trace-time mode for the merged-batch grouped honest phase:
 #   None          — single-device: use the grouped path when available;
@@ -145,7 +154,7 @@ class Engine:
     """Compiled training/eval programs for one experiment configuration."""
 
     def __init__(self, cfg, model_def, loss, criterion, defenses, attack,
-                 attack_kwargs, optimizer=None):
+                 attack_kwargs, optimizer=None, faults=None):
         """Use `build_engine` — this constructor wires the already-resolved
         pieces.
 
@@ -160,8 +169,14 @@ class Engine:
           attack: `attacks.Attack` (or None when f_real == 0 paths are
             exercised with the `nan` default).
           attack_kwargs: plugin args for the attack.
+          faults: optional `faults.FaultSchedule` — per-step fault
+            injection into the stacked gradient batch before aggregation,
+            plus the dynamic-quorum/quarantine degradation policy
+            (`cfg.fault_*`). None (the default, and what an empty plan
+            compiles to) traces the exact fault-free program.
         """
         self.cfg = cfg
+        self.faults = faults
         # f64 without the x64 flag would silently truncate every cast to f32
         # while the run labels itself float64 — refuse upfront (the CLI flips
         # the flag itself; library callers must opt in explicitly)
@@ -269,9 +284,15 @@ class Engine:
         params = _cast_tree(params, self.cfg.jnp_dtype)
         net_state = _cast_tree(net_state, self.cfg.jnp_dtype)
         theta, _ = flatten_params(params)
+        # The straggler stale-submission buffer exists only when the fault
+        # schedule needs it (empty plans pay nothing — `faults/inject.py`)
+        buffer_rows = (self.cfg.nb_honests
+                       if self.faults is not None and self.faults.has_stale
+                       else 0)
         return init_state(self.cfg, theta, net_state,
                           jax.random.fold_in(key, 1), study=study,
-                          opt_state=self.optimizer.init(theta))
+                          opt_state=self.optimizer.init(theta),
+                          fault_buffer_rows=buffer_rows)
 
     # ----------------------------------------------------------------- #
     # Per-worker gradient
@@ -357,12 +378,12 @@ class Engine:
         """
         from jax.sharding import PartitionSpec as P
 
-        from byzantinemomentum_tpu.parallel.mesh import WORKERS
+        from byzantinemomentum_tpu.parallel.mesh import WORKERS, shard_map
 
         th_s, xs = self._grouped_operands(theta_eff, xs, theta_axis)
         ns_spec = jax.tree.map(lambda _: P(), net_state)
         states_spec = jax.tree.map(lambda _: P(WORKERS), net_state)
-        return jax.shard_map(
+        return shard_map(
             self._grouped_local,
             mesh=mesh,
             in_specs=(P(WORKERS), ns_spec, P(WORKERS), P(WORKERS),
@@ -549,13 +570,31 @@ class Engine:
             new_mw = state.momentum_workers
             G_honest = G_sampled[:h]
 
-        return (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
-                G_honest)
+        # --- fault injection on the submitted rows (`faults/inject.py`):
+        # the faults mangle what each worker SHIPS this step (stale copies,
+        # corruption, duplication), never the momentum buffers — a
+        # transient fault must not poison the worker's own future steps
+        if self.faults is not None:
+            from byzantinemomentum_tpu.faults import inject as inject_mod
+            G_honest, new_fb, active, injected = inject_mod.inject(
+                self.faults, state.steps, G_honest, state.fault_buffer)
+            fault = _FaultCtx(active=active, injected=injected)
+        else:
+            new_fb = state.fault_buffer
+            fault = None
 
-    def _phase_defense(self, G_honest, mix_key):
+        return (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
+                G_honest, fault, new_fb)
+
+    def _phase_defense(self, G_honest, mix_key, fault=None):
         """Attack synthesis + aggregation + influence (reference
-        `attack.py:818-822`). Pure in (G_honest, mix_key) given the static
-        config, so it compiles for whatever device its inputs live on."""
+        `attack.py:818-822`). Pure in (G_honest, mix_key, fault) given the
+        static config, so it compiles for whatever device its inputs live
+        on. With a `fault` context the aggregation runs the degradation
+        policy: absent rows masked out, non-finite rows quarantined
+        (`cfg.fault_quarantine`) and the effective quorum recomputed
+        (`cfg.fault_dynamic_quorum`); returns the fault metric dict as the
+        fourth element (None without faults)."""
         cfg = self.cfg
         mix_u = jax.random.uniform(mix_key)
         per_call = cfg.gars_per_call and len(self.defenses) > 1
@@ -563,6 +602,14 @@ class Engine:
         def defense_fn(gradients, f):
             u = (self._per_call_uniform(mix_key, gradients)
                  if per_call else mix_u)
+            # Adaptive attacks line-search against the defense the server
+            # actually runs — including its masked degradation under
+            # faults (probes share the step's active set; a probe of a
+            # different row count falls back to the unmasked kernel)
+            if (fault is not None
+                    and gradients.shape[0] == fault.active.shape[0]):
+                return self._run_defense_masked(
+                    gradients, u, fault.active)[0]
             return self._run_defense(gradients, u)
 
         if cfg.nb_real_byz > 0:
@@ -584,23 +631,63 @@ class Engine:
                 jax.random.fold_in(mix_key, 1), G_all)
         else:
             infl_u = mix_u
-        grad_defense = self._run_defense(G_all, mix_u).astype(G_honest.dtype)
+
+        if fault is None:
+            grad_defense = self._run_defense(G_all, mix_u).astype(
+                G_honest.dtype)
+            accept_ratio = self._run_influence(G_honest, G_attack, infl_u)
+            return G_attack, grad_defense, accept_ratio, None
+
+        active = fault.active
+        if cfg.fault_quarantine:
+            from byzantinemomentum_tpu.faults import sanitize
+            active, _ = sanitize.quarantine(G_all, active)
+        grad_defense, f_eff = self._run_defense_masked(G_all, mix_u, active)
+        grad_defense = grad_defense.astype(G_honest.dtype)
         accept_ratio = self._run_influence(G_honest, G_attack, infl_u)
-        return G_attack, grad_defense, accept_ratio
+        fault_metrics = {
+            "Faults injected": fault.injected,
+            "Workers active": jnp.sum(active.astype(jnp.int32)),
+            "Quorum f": f_eff,
+        }
+        return G_attack, grad_defense, accept_ratio, fault_metrics
+
+    def _run_defense_masked(self, G, mix_u, active):
+        """`_run_defense` under the degradation policy: aggregate the
+        active rows only, with the per-GAR effective quorum
+        (`faults/quorum.py`). Returns (f32[d], i32[] effective f)."""
+        from byzantinemomentum_tpu.faults import quorum as quorum_mod
+
+        cfg = self.cfg
+
+        def one(gar, kwargs, G):
+            return quorum_mod.masked_aggregate(
+                gar, G, active, f_decl=cfg.nb_decl_byz,
+                dynamic=cfg.fault_dynamic_quorum, **kwargs)
+
+        if len(self.defenses) == 1:
+            gar, _, kwargs = self.defenses[0]
+            return one(gar, kwargs, G)
+        branches = [
+            (lambda G, gar=gar, kwargs=kwargs: one(gar, kwargs, G))
+            for gar, _, kwargs in self.defenses
+        ]
+        return lax.switch(self._mixture_index(mix_u), branches, G)
 
     def _train_step(self, state: TrainState, xs, ys, lr):
         """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
         (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
-         G_honest) = self._phase_honest(state, xs, ys, lr)
-        G_attack, grad_defense, accept_ratio = self._phase_defense(
-            G_honest, mix_key)
+         G_honest, fault, new_fb) = self._phase_honest(state, xs, ys, lr)
+        G_attack, grad_defense, accept_ratio, fault_metrics = \
+            self._phase_defense(G_honest, mix_key, fault)
         return self._phase_update(
             state, rng, G_sampled, loss_avg, net_state, new_mw, G_honest,
-            G_attack, grad_defense, accept_ratio, lr, self._batch_of(xs))
+            G_attack, grad_defense, accept_ratio, lr, self._batch_of(xs),
+            fault_metrics, new_fb)
 
     def _phase_update(self, state, rng, G_sampled, loss_avg, net_state,
                       new_mw, G_honest, G_attack, grad_defense, accept_ratio,
-                      lr, batch):
+                      lr, batch, fault_metrics=None, fault_buffer=None):
         """Model update + study metrics (reference `attack.py:832-878`)."""
         cfg = self.cfg
         h = cfg.nb_honests
@@ -638,6 +725,8 @@ class Engine:
         else:
             metrics = {}
             pg, pn, pc = state.past_grads, state.past_norms, state.past_count
+        if cfg.study and fault_metrics is not None:
+            metrics.update(fault_metrics)
 
         new_state = TrainState(
             theta=theta, net_state=net_state, opt_state=opt_state,
@@ -647,6 +736,8 @@ class Engine:
             steps=state.steps + 1,
             datapoints=state.datapoints + batch * h * cfg.nb_local_steps,
             rng=rng,
+            fault_buffer=(state.fault_buffer if fault_buffer is None
+                          else fault_buffer),
         )
         return new_state, metrics
 
@@ -721,34 +812,38 @@ def make_device_gar_step(engine, gar_device):
     post = jax.jit(engine._phase_update, static_argnums=(11,),
                    donate_argnums=(0,))
 
-    def mid_traced(G_honest, mix_key):
+    def mid_traced(G_honest, mix_key, fault):
         if dev.platform != "tpu":
             # The GAR device cannot run Mosaic kernels
             with pallas_sort.disabled():
-                return engine._phase_defense(G_honest, mix_key)
-        return engine._phase_defense(G_honest, mix_key)
+                return engine._phase_defense(G_honest, mix_key, fault)
+        return engine._phase_defense(G_honest, mix_key, fault)
 
     mid = jax.jit(mid_traced)
 
     def step(state, xs, ys, lr):
         (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
-         G_honest) = pre(state, xs, ys, lr)
+         G_honest, fault, new_fb) = pre(state, xs, ys, lr)
         main_dev = list(G_honest.devices())[0]
-        # --- the hop (reference `attack.py:811-815`) --- #
+        # --- the hop (reference `attack.py:811-815`; the tiny fault
+        # context — active mask + counter — hops along with the rows) --- #
         out = mid(jax.device_put(G_honest, dev),
-                  jax.device_put(mix_key, dev))
-        G_attack, grad_defense, accept_ratio = jax.device_put(out, main_dev)
+                  jax.device_put(mix_key, dev),
+                  None if fault is None else jax.device_put(fault, dev))
+        (G_attack, grad_defense, accept_ratio,
+         fault_metrics) = jax.device_put(out, main_dev)
         batch = engine._batch_of(xs)
         return post(state, rng, G_sampled, loss_avg, net_state, new_mw,
                     G_honest, G_attack, grad_defense, accept_ratio, lr,
-                    batch)
+                    batch, fault_metrics, new_fb)
 
     return step
 
 
 def build_engine(*, cfg, model_def, loss, criterion, defenses, attack=None,
-                 attack_kwargs=None, optimizer=None):
+                 attack_kwargs=None, optimizer=None, faults=None):
     """Assemble an `Engine` (the reference's `setup` phase,
-    `attack.py:451-591`, collapsed into one constructor)."""
+    `attack.py:451-591`, collapsed into one constructor). `faults` is an
+    optional compiled `faults.FaultSchedule` (see `faults/__init__.py`)."""
     return Engine(cfg, model_def, loss, criterion, defenses, attack,
-                  attack_kwargs, optimizer=optimizer)
+                  attack_kwargs, optimizer=optimizer, faults=faults)

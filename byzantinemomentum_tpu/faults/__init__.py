@@ -1,0 +1,56 @@
+"""Fault injection & resilience — system faults as first-class, testable
+behavior.
+
+The paper's premise is surviving adversarial workers; this package covers
+the other half of "Byzantine" that real systems meet first: stragglers,
+dropped workers, corrupted/NaN gradient shards, duplicated submissions,
+devices lost mid-run. Three layers:
+
+* **declaration** (`plan.py`) — `FaultPlan`: per-step, per-worker fault
+  events plus a degradation `FaultPolicy`, JSON round-trippable and fully
+  deterministic (seeded generation for randomized chaos runs);
+* **injection** (`schedule.py`, `inject.py`) — the plan compiles to dense
+  per-step masks applied to the stacked gradient batch INSIDE the jitted
+  step, before aggregation: pure `jnp.where` masking, no host round-trips,
+  and a `None` schedule (empty plan) compiles to the exact fault-free
+  program;
+* **degradation policy** (`quorum.py`, `sanitize.py`, `retry.py`) —
+  dynamic quorum (the GAR runs with the effective `(n, f)` of the workers
+  actually present), NaN-quarantine (corrupt rows detected via the
+  generalized `attacks/nan.py` predicate and masked out), and
+  retry/backoff for the host data-fetch path.
+
+Driver surface: `cli/attack.py --fault-plan plan.json`; the study CSV
+gains `Faults injected` / `Workers active` / `Quorum f` columns so
+`study.py` can plot accuracy against fault pressure.
+
+This module keeps its imports host-only (no jax): `FaultPlan` authoring,
+JSON handling and the retry helper work in contexts where the accelerator
+stack must not initialize (dataset download paths, plan tooling). The
+jax-facing halves live in the submodules the engine imports directly.
+"""
+
+from byzantinemomentum_tpu.faults.plan import (
+    FaultEvent,
+    FaultPlan,
+    FaultPolicy,
+    corrupt_gradient,
+    device_loss,
+    drop_worker,
+    duplicate_submission,
+    straggler,
+)
+from byzantinemomentum_tpu.faults.retry import with_backoff
+
+__all__ = ["FaultEvent", "FaultPlan", "FaultPolicy", "build_schedule",
+           "corrupt_gradient", "device_loss", "drop_worker",
+           "duplicate_submission", "straggler", "with_backoff"]
+
+
+def build_schedule(plan, *, nb_workers, nb_honests):
+    """Compile a `FaultPlan` for an (n, h) run — None for an empty plan
+    (the engine's zero-overhead contract). Lazy import: the schedule half
+    touches jax."""
+    from byzantinemomentum_tpu.faults import schedule as _schedule
+    return _schedule.build_schedule(plan, nb_workers=nb_workers,
+                                    nb_honests=nb_honests)

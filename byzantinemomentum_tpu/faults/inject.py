@@ -1,0 +1,77 @@
+"""In-graph fault injection — the hook the training step calls between the
+honest phase and the defense.
+
+Everything here is shape-static `jnp.where` masking over the stacked
+`(h, d)` honest-submission matrix: no host round-trips, no dynamic shapes,
+no gathers beyond one row-indexed `take` for duplications. The per-step
+fault rows come from the compiled schedule (`faults/schedule.py`) indexed
+by the traced step counter, so the same compiled program serves every step
+of the plan.
+
+Application order per worker (matching how the faults compose physically):
+
+  1. duplication  — the worker ships a copy of another worker's *fresh*
+                    gradient (it happens at submission time, before any
+                    transport corruption);
+  2. staleness    — a straggler's submission is its buffered pre-window
+                    gradient (overriding this step's fresh/duplicated row);
+  3. corruption   — scale / zero / NaN mangle whatever was submitted;
+  4. absence      — drop/device-loss rows are reported in the active mask
+                    (the degradation policy excludes them from the quorum;
+                    the row's content no longer matters).
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["inject"]
+
+
+def inject(schedule, step, G_honest, fault_buffer):
+    """Apply `schedule`'s faults for `step` to the honest submissions.
+
+    Args:
+      schedule: `FaultSchedule`.
+      step: traced i32 step counter.
+      G_honest: f32[h, d] — the honest rows about to feed the defense.
+      fault_buffer: f32[h, d] per-worker last fresh submission (shape
+        (0, d) when the plan has no stragglers — then it passes through
+        untouched).
+
+    Returns:
+      (G_faulted, new_buffer, active: bool[n], injected: i32) — the mangled
+      submission stack, the updated stale buffer, the full-n active mask
+      (honest rows then attack rows; absent rows False) and the number of
+      fault conditions live this step (the `Faults injected` metric).
+    """
+    sf = schedule.step_faults(step)
+    h = G_honest.shape[0]
+    G = G_honest
+
+    # 1. duplication: take() needs an in-range index even for the -1
+    # "own row" sentinel — clip, then select on the sentinel mask
+    dup_on = sf.dup >= 0
+    src = jnp.clip(sf.dup, 0, h - 1)
+    G = jnp.where(dup_on[:, None], jnp.take(G_honest, src, axis=0), G)
+
+    # 2. staleness (buffer only exists when the plan has stragglers):
+    # submit the buffered gradient; refresh the buffer from the CLEAN rows
+    # only, so a multi-step window keeps replaying the pre-window gradient
+    if schedule.has_stale:
+        G = jnp.where(sf.stale[:, None], fault_buffer, G)
+        new_buffer = jnp.where(sf.stale[:, None], fault_buffer, G_honest)
+    else:
+        new_buffer = fault_buffer
+
+    # 3. corruption
+    G = G * sf.scale[:, None].astype(G.dtype)
+    G = jnp.where(sf.zero[:, None], jnp.zeros((), G.dtype), G)
+    G = jnp.where(sf.nan[:, None], jnp.asarray(jnp.nan, G.dtype), G)
+
+    # 4. absence — over the full n rows (attack rows can be dropped too)
+    active = ~sf.drop
+
+    injected = (
+        jnp.sum(sf.stale) + jnp.sum(sf.drop) + jnp.sum(sf.nan)
+        + jnp.sum(sf.zero) + jnp.sum(sf.scale != 1.0) + jnp.sum(dup_on)
+    ).astype(jnp.int32)
+    return G, new_buffer, active, injected

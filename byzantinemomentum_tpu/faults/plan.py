@@ -1,0 +1,283 @@
+"""Declarative fault plans — *system* faults as first-class, serializable
+data.
+
+The `attacks/` package models adversarial workers (Byzantine rows
+synthesized in-graph); this module models the faults real deployments
+actually see: stragglers, dropped workers, corrupted/NaN gradient shards,
+duplicated submissions, devices lost mid-run. A `FaultPlan` declares them
+per step and per worker, round-trips through JSON, and compiles
+(`faults/schedule.py`) into dense per-step mask arrays applied inside the
+jitted training step (`faults/inject.py`).
+
+Determinism contract: a plan is data, not a process — the same plan always
+injects the same faults at the same steps into the same workers.
+`FaultPlan.generate` derives a concrete event list from per-kind rates and
+a seed (numpy `RandomState`), so randomized chaos runs are exactly
+reproducible from `(rates, seed)`.
+
+Worker indexing: faults address workers by their row in the stacked
+submission matrix — honest workers are rows `0..h-1`, Byzantine rows (when
+an `--attack` runs alongside the plan) follow. Submission-mutating faults
+(straggler / corruption / duplication) only make sense on honest rows;
+`drop_worker` and `device_loss` may target any row.
+"""
+
+import dataclasses
+import json
+import pathlib
+
+__all__ = ["FaultEvent", "FaultPolicy", "FaultPlan", "KINDS", "MODES",
+           "straggler", "drop_worker", "corrupt_gradient",
+           "duplicate_submission", "device_loss"]
+
+# Fault taxonomy. `device_loss` is the permanent form of `drop_worker`:
+# from its step on, the worker never submits again (no duration).
+KINDS = ("straggler", "drop_worker", "corrupt_gradient",
+         "duplicate_submission", "device_loss")
+
+# corrupt_gradient modes: all-NaN shard, all-zero shard, or a scaled
+# (exploding/vanishing) shard.
+MODES = ("nan", "zero", "scale")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One fault: `kind` hits `worker` for steps `[step, step + duration)`.
+
+    Field use per kind:
+      straggler            — `duration` is the delay window: the worker keeps
+                             resubmitting its last pre-window gradient, so
+                             staleness grows with the window length.
+      drop_worker          — absent for `duration` steps; the degradation
+                             policy shrinks the effective quorum.
+      corrupt_gradient     — submission mangled per `mode` (`scale` uses
+                             `scale`).
+      duplicate_submission — submits a byte-copy of worker `source`'s fresh
+                             gradient instead of its own.
+      device_loss          — permanently gone from `step` on (`duration`
+                             ignored).
+    """
+
+    kind: str
+    worker: int
+    step: int
+    duration: int = 1
+    mode: str = "nan"
+    scale: float = 10.0
+    source: int = 0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"Unknown fault kind {self.kind!r}; expected one of {KINDS}")
+        if self.worker < 0:
+            raise ValueError(f"Negative worker index {self.worker}")
+        if self.step < 0:
+            raise ValueError(f"Negative fault step {self.step}")
+        if self.duration < 1:
+            raise ValueError(f"Non-positive fault duration {self.duration}")
+        if self.kind == "corrupt_gradient" and self.mode not in MODES:
+            raise ValueError(
+                f"Unknown corruption mode {self.mode!r}; expected one of "
+                f"{MODES}")
+        if self.kind == "duplicate_submission" and self.source < 0:
+            raise ValueError(f"Negative source worker {self.source}")
+
+    @property
+    def end(self):
+        """First step no longer affected (device_loss never ends)."""
+        return self.step + (1 if self.kind == "device_loss"
+                            else self.duration)
+
+
+# Constructor helpers — the declarative surface mirroring the fault
+# taxonomy names (`plan = FaultPlan(events=(drop_worker(3, step=10), ...))`).
+
+def straggler(worker, step, delay_steps=1):
+    """Worker resubmits its pre-`step` gradient for `delay_steps` steps."""
+    return FaultEvent("straggler", worker, step, duration=delay_steps)
+
+
+def drop_worker(worker, step, duration=1):
+    """Worker is absent (no submission) for `duration` steps."""
+    return FaultEvent("drop_worker", worker, step, duration=duration)
+
+
+def corrupt_gradient(worker, step, mode="nan", scale=10.0, duration=1):
+    """Worker's submission is corrupted (`nan`, `zero`, or `scale`)."""
+    return FaultEvent("corrupt_gradient", worker, step, duration=duration,
+                      mode=mode, scale=scale)
+
+
+def duplicate_submission(worker, step, source, duration=1):
+    """Worker submits a copy of `source`'s fresh gradient."""
+    return FaultEvent("duplicate_submission", worker, step,
+                      duration=duration, source=source)
+
+
+def device_loss(worker, step):
+    """Worker is permanently lost from `step` on."""
+    return FaultEvent("device_loss", worker, step)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPolicy:
+    """How the engine degrades when faults (or fault-like inputs) appear.
+
+    `nan_quarantine` and `dynamic_quorum` are trace-time switches (they
+    become `EngineConfig.fault_quarantine` / `.fault_dynamic_quorum`); the
+    `fetch_*` knobs parameterize the data-download retry/backoff path
+    (`data/sources.py:_fetch`).
+    """
+
+    nan_quarantine: bool = True   # mask non-finite submission rows out of
+    #                               the aggregation (and out of the quorum)
+    dynamic_quorum: bool = True   # recompute the effective (n, f) the GAR
+    #                               runs with when workers are absent
+    fetch_attempts: int = 3       # data-download attempts before degrading
+    fetch_backoff: float = 1.0    # base backoff seconds (doubles per retry)
+    fetch_timeout: float = 60.0   # per-connection stall timeout seconds
+
+    def __post_init__(self):
+        if self.fetch_attempts < 1:
+            raise ValueError(
+                f"Non-positive fetch attempts {self.fetch_attempts}")
+        if self.fetch_backoff < 0 or self.fetch_timeout <= 0:
+            raise ValueError(
+                f"Invalid fetch backoff/timeout "
+                f"({self.fetch_backoff}, {self.fetch_timeout})")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A complete fault scenario: events + degradation policy + seed."""
+
+    events: tuple = ()
+    policy: FaultPolicy = dataclasses.field(default_factory=FaultPolicy)
+    seed: int = 0
+
+    def __post_init__(self):
+        # Normalize: accept lists/dicts from JSON land, store tuples of
+        # FaultEvent (hashable, so a plan can key caches)
+        events = tuple(
+            e if isinstance(e, FaultEvent) else FaultEvent(**e)
+            for e in self.events)
+        object.__setattr__(self, "events", events)
+        if not isinstance(self.policy, FaultPolicy):
+            object.__setattr__(self, "policy", FaultPolicy(**self.policy))
+
+    @property
+    def horizon(self):
+        """First step with no scheduled (non-permanent) fault activity."""
+        return max((e.end for e in self.events), default=0)
+
+    def validate(self, nb_workers, nb_honests):
+        """None if the plan fits an (n = nb_workers, h = nb_honests) run,
+        else a human-readable refusal (CLI contract, like `GAR.check`)."""
+        for e in self.events:
+            if e.worker >= nb_workers:
+                return (f"fault {e.kind!r} targets worker {e.worker} but the "
+                        f"run has only {nb_workers} workers")
+            mutating = e.kind in ("straggler", "corrupt_gradient",
+                                  "duplicate_submission")
+            if mutating and e.worker >= nb_honests:
+                return (f"fault {e.kind!r} mutates worker {e.worker}'s "
+                        f"submission, but rows >= {nb_honests} are "
+                        f"attack-synthesized (only drop_worker/device_loss "
+                        f"may target them)")
+            if e.kind == "duplicate_submission":
+                if e.source >= nb_honests:
+                    return (f"duplicate_submission copies worker {e.source}, "
+                            f"but only rows < {nb_honests} hold honest "
+                            f"submissions")
+                if e.source == e.worker:
+                    return (f"duplicate_submission on worker {e.worker} "
+                            f"copies itself (a no-op; refusing a plan that "
+                            f"cannot mean what it says)")
+        return None
+
+    # ------------------------------------------------------------------ #
+    # JSON round-trip
+
+    def to_dict(self):
+        return {
+            "events": [dataclasses.asdict(e) for e in self.events],
+            "policy": dataclasses.asdict(self.policy),
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_dict(cls, data):
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown fault-plan fields {sorted(unknown)}; expected a "
+                f"subset of {sorted(known)}")
+        return cls(**data)
+
+    def to_json(self, **kwargs):
+        kwargs.setdefault("indent", "\t")
+        return json.dumps(self.to_dict(), **kwargs)
+
+    @classmethod
+    def from_json(cls, text):
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path):
+        path = pathlib.Path(path)
+        path.write_text(self.to_json() + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path):
+        return cls.from_json(pathlib.Path(path).read_text())
+
+    # ------------------------------------------------------------------ #
+    # Seeded generation (reproducible chaos)
+
+    @classmethod
+    def generate(cls, *, nb_workers, nb_steps, rates, seed=0,
+                 policy=None, nb_honests=None, max_scale=100.0):
+        """Expand per-kind fault `rates` into a concrete, deterministic plan.
+
+        `rates`: dict kind -> per-worker-per-step probability. Every draw
+        comes from `numpy.random.RandomState(seed)` in a fixed iteration
+        order (kind-major, then step, then worker), so `(rates, seed)`
+        fully determines the plan — rerunning yields byte-identical JSON.
+        Submission-mutating kinds only target rows < `nb_honests`
+        (default: all of `nb_workers`).
+        """
+        import numpy as np
+
+        h = nb_workers if nb_honests is None else nb_honests
+        rng = np.random.RandomState(seed)
+        events = []
+        for kind in KINDS:
+            rate = rates.get(kind, 0.0)
+            if not rate:
+                continue
+            rows = nb_workers if kind in ("drop_worker", "device_loss") else h
+            hits = rng.random_sample((nb_steps, rows)) < rate
+            for step, worker in zip(*np.nonzero(hits)):
+                step, worker = int(step), int(worker)
+                if kind == "straggler":
+                    events.append(straggler(
+                        worker, step, delay_steps=int(rng.randint(1, 4))))
+                elif kind == "drop_worker":
+                    events.append(drop_worker(worker, step))
+                elif kind == "corrupt_gradient":
+                    mode = MODES[int(rng.randint(len(MODES)))]
+                    events.append(corrupt_gradient(
+                        worker, step, mode=mode,
+                        scale=float(rng.uniform(0.0, max_scale))))
+                elif kind == "duplicate_submission":
+                    if h < 2:
+                        continue
+                    source = int(rng.randint(h - 1))
+                    events.append(duplicate_submission(
+                        worker, step, source=source + (source >= worker)))
+                else:  # device_loss: first hit wins, later ones are moot
+                    events.append(device_loss(worker, step))
+        return cls(events=tuple(events), policy=policy or FaultPolicy(),
+                   seed=seed)

@@ -1,0 +1,99 @@
+"""Dynamic quorum — the degradation policy that keeps GAR guarantees
+meaningful when workers are absent.
+
+Every GAR kernel is compiled for a static `(n, f)` contract; when the fault
+subsystem drops workers (or quarantines corrupt rows), the *effective*
+row count `n_eff = sum(active)` is a traced value. This module recomputes
+the effective Byzantine tolerance `f_eff` each step — the declared `f`
+clamped to the GAR's own breakdown ceiling at the shrunken `n_eff` — and
+dispatches to masked kernel variants (`ops/_common.py`, `ops/krum.py`)
+that aggregate over the active subset with those traced counts.
+
+GARs without a masked variant degrade gracefully instead of wrongly:
+inactive rows are routed to NaN, which every kernel in this framework
+already treats as worst-case (sort-last values, +inf distances), and the
+static declared `f` keeps absorbing them as long as
+`absent + byzantine <= f` — the documented fallback contract.
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.ops import _common, krum as krum_mod
+
+__all__ = ["effective_f", "masked_aggregate"]
+
+# Breakdown ceilings: the largest f each rule tolerates at a given n
+# (matching the rules' own `check` contracts: krum needs n >= 2f+3, bulyan
+# n >= 4f+3, the trimmed family n >= 2f+1). The default is the generic
+# minority bound.
+_F_CEILING = {
+    "krum": lambda n: (n - 3) // 2,
+    "bulyan": lambda n: (n - 3) // 4,
+    "brute": lambda n: (n - 1) // 2,
+    "trmean": lambda n: (n - 1) // 2,
+    "phocas": lambda n: (n - 1) // 2,
+    "meamed": lambda n: (n - 1) // 2,
+}
+
+
+def _base_name(name):
+    """Strip the compiled-tier prefix: `native-krum` shares krum's math."""
+    return name[len("native-"):] if name.startswith("native-") else name
+
+
+def effective_f(gar_name, n_eff, f_decl):
+    """Traced effective Byzantine tolerance: the declared `f` clamped to
+    the GAR's breakdown ceiling at the (traced) effective row count."""
+    ceiling = _F_CEILING.get(_base_name(gar_name), lambda n: (n - 1) // 2)
+    return jnp.clip(jnp.minimum(f_decl, ceiling(n_eff)), 0, None).astype(
+        jnp.int32)
+
+
+def masked_aggregate(gar, gradients, active, *, f_decl, dynamic=True,
+                     **kwargs):
+    """Aggregate the active rows of `gradients` with `gar`.
+
+    Args:
+      gar: a registered `GAR` (or an engine facade exposing `.name` /
+        `.unchecked`, e.g. the d-sharded wrapper).
+      gradients: f32[n, d] stacked submissions.
+      active: bool[n] — rows present this step.
+      f_decl: static declared Byzantine count.
+      dynamic: recompute the effective quorum (False = keep the declared
+        `f`, only excluding the absent rows from the aggregation).
+      kwargs: the GAR's registered plugin args.
+
+    Returns:
+      (f32[d] aggregate, i32[] effective f actually used) — the latter
+      feeds the `Quorum f` metric column.
+    """
+    name = _base_name(gar.name)
+    n_eff = jnp.sum(active.astype(jnp.int32))
+    f_eff = (effective_f(name, n_eff, f_decl) if dynamic
+             else jnp.asarray(f_decl, jnp.int32))
+
+    if name == "average":
+        return _common.masked_mean(gradients, active, n_eff), f_eff
+    if name == "median":
+        return _common.masked_lower_median(gradients, active, n_eff), f_eff
+    if name == "trmean":
+        return _common.masked_trmean(gradients, active, f_eff, n_eff), f_eff
+    if name == "krum":
+        dist = _common.pairwise_distances(
+            gradients, method=kwargs.get("method", "dot"))
+        w = krum_mod.selection_weights_masked(
+            dist, active, n_eff, f_eff, kwargs.get("m")).astype(
+                gradients.dtype)
+        # Zero the inactive rows so a dropped worker's garbage (NaN row)
+        # cannot poison the weighted average's masked path
+        kept = jnp.where(active[:, None], gradients,
+                         jnp.zeros((), gradients.dtype))
+        return _common.weighted_rows_mean(w, kept), f_eff
+
+    # Fallback: inactive rows become NaN — every kernel's documented
+    # worst-case routing (sort-last, +inf distances) — and the static
+    # declared f absorbs them (correct while absent + byzantine <= f_decl)
+    routed = jnp.where(active[:, None], gradients,
+                       jnp.asarray(jnp.nan, gradients.dtype))
+    return (gar.unchecked(routed, f=f_decl, **kwargs),
+            jnp.asarray(f_decl, jnp.int32))

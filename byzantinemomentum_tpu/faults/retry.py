@@ -1,0 +1,38 @@
+"""Retry with exponential backoff — the host-side degradation primitive.
+
+The in-graph half of the fault subsystem masks bad submissions; this is
+the other half, for the host I/O paths (dataset downloads, and any future
+storage/RPC boundary): bounded retries with exponential backoff, after
+which the caller's own degrade path (disk probe, synthetic fallback)
+takes over. Transient-only by construction — the default `retry_on` is
+`OSError` (network stalls, resets, timeouts), so content errors like a
+checksum mismatch propagate immediately instead of being retried into
+the same failure.
+"""
+
+import time
+
+__all__ = ["with_backoff"]
+
+
+def with_backoff(fn, *, attempts=3, base_delay=1.0, retry_on=(OSError,),
+                 on_retry=None, sleep=time.sleep):
+    """Call `fn()` up to `attempts` times, sleeping `base_delay * 2**i`
+    between tries; re-raises the last error once the budget is spent.
+
+    `on_retry(attempt, delay, error)` observes each retry (logging);
+    `sleep` is injectable for tests.
+    """
+    if attempts < 1:
+        raise ValueError(f"Non-positive attempt count {attempts}")
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as err:
+            if attempt + 1 >= attempts:
+                raise
+            delay = base_delay * (2.0 ** attempt)
+            if on_retry is not None:
+                on_retry(attempt, delay, err)
+            if delay > 0:
+                sleep(delay)

@@ -1,0 +1,29 @@
+"""NaN-quarantine: detection and masking of numerically-corrupt rows.
+
+The GAR kernels are NaN-*resilient* (non-finite coordinates sort last /
+map to +inf distances, `ops/_common.py`) — they survive corrupt rows but
+still count them toward `n`. Quarantine is the stronger degradation-policy
+response: detect the corrupt rows (the `attacks/nan.py` emission pattern,
+generalized to any non-finite shard by `attacks.nan.detect`) and remove
+them from the active set, so the dynamic-quorum layer (`faults/quorum.py`)
+aggregates over genuinely healthy submissions with a matching effective
+`(n, f)`.
+"""
+
+from byzantinemomentum_tpu.attacks.nan import detect as corrupt_rows
+
+__all__ = ["corrupt_rows", "quarantine"]
+
+
+def quarantine(gradients, active):
+    """Mask numerically-corrupt rows out of `active`.
+
+    `gradients: f32[n, d]`, `active: bool[n]` -> `(bool[n], i32[])`: the
+    shrunk active mask and the number of rows newly quarantined (already-
+    inactive corrupt rows — e.g. dropped workers whose row is garbage —
+    are not double-counted).
+    """
+    import jax.numpy as jnp
+
+    bad = corrupt_rows(gradients)
+    return active & ~bad, jnp.sum((active & bad).astype(jnp.int32))

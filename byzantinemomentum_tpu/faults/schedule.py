@@ -1,0 +1,106 @@
+"""Compilation of a `FaultPlan` into dense per-step mask arrays.
+
+The plan is declarative (a list of events); the jitted training step needs
+O(1) lookups by step index. `build_schedule` lowers the events into small
+host-side numpy arrays of shape `(horizon + 1, rows)` — row `horizon` is
+all-neutral, and the in-graph lookup clamps the step index to it, so every
+step beyond the plan's horizon reads "no fault" without a branch. Permanent
+`device_loss` events live in a separate `(n,)` first-lost-step vector
+(compared against the step counter directly) so they persist past the
+horizon.
+
+The arrays enter the XLA program as constants at trace time: a few KB for
+realistic plans, nothing on the hot path but `jnp.take` of one row.
+"""
+
+import typing
+
+import numpy as np
+
+__all__ = ["FaultSchedule", "StepFaults", "build_schedule"]
+
+# "Never lost" sentinel for the device-loss vector: any real step compares
+# strictly below it. int32 to match the step counter's dtype.
+NEVER = np.iinfo(np.int32).max
+
+
+class StepFaults(typing.NamedTuple):
+    """One step's traced fault row set (see `FaultSchedule.step_faults`)."""
+
+    stale: typing.Any      # bool[h] — submit the buffered stale gradient
+    nan: typing.Any        # bool[h] — submission replaced by NaN
+    zero: typing.Any       # bool[h] — submission replaced by zeros
+    scale: typing.Any      # f32[h]  — submission multiplier (1 = clean)
+    dup: typing.Any        # i32[h]  — source row to copy, -1 = own
+    drop: typing.Any       # bool[n] — absent this step (incl. device loss)
+
+
+class FaultSchedule:
+    """Host-side compiled form of a `FaultPlan` (see module docstring)."""
+
+    def __init__(self, plan, nb_workers, nb_honests):
+        message = plan.validate(nb_workers, nb_honests)
+        if message is not None:
+            raise ValueError(f"Invalid fault plan: {message}")
+        n, h = nb_workers, nb_honests
+        T = plan.horizon
+        self.plan = plan
+        self.nb_workers = n
+        self.nb_honests = h
+        self.horizon = T
+        self.stale = np.zeros((T + 1, h), bool)
+        self.nan = np.zeros((T + 1, h), bool)
+        self.zero = np.zeros((T + 1, h), bool)
+        self.scale = np.ones((T + 1, h), np.float32)
+        self.dup = np.full((T + 1, h), -1, np.int32)
+        self.drop = np.zeros((T + 1, n), bool)
+        self.lost_from = np.full((n,), NEVER, np.int32)
+        for e in plan.events:
+            steps = slice(e.step, e.end)  # rows T.. stay neutral by clamp
+            if e.kind == "straggler":
+                self.stale[steps, e.worker] = True
+            elif e.kind == "drop_worker":
+                self.drop[steps, e.worker] = True
+            elif e.kind == "corrupt_gradient":
+                if e.mode == "nan":
+                    self.nan[steps, e.worker] = True
+                elif e.mode == "zero":
+                    self.zero[steps, e.worker] = True
+                else:
+                    self.scale[steps, e.worker] *= e.scale
+            elif e.kind == "duplicate_submission":
+                self.dup[steps, e.worker] = e.source
+            else:  # device_loss
+                self.lost_from[e.worker] = min(
+                    int(self.lost_from[e.worker]), e.step)
+
+    @property
+    def has_stale(self):
+        """Whether the engine must carry the per-worker stale-gradient
+        buffer in `TrainState` (allocated only when a straggler exists)."""
+        return bool(self.stale.any())
+
+    def step_faults(self, step):
+        """The step's fault rows as traced arrays (`step`: traced i32).
+
+        Steps past the horizon read the all-neutral row `horizon`;
+        device loss is folded into `drop` by comparing `step` against the
+        first-lost vector, so it persists beyond the horizon.
+        """
+        import jax.numpy as jnp
+
+        t = jnp.minimum(step, self.horizon)
+        row = lambda a: jnp.take(jnp.asarray(a), t, axis=0)  # noqa: E731
+        drop = row(self.drop) | (step >= jnp.asarray(self.lost_from))
+        return StepFaults(stale=row(self.stale), nan=row(self.nan),
+                          zero=row(self.zero), scale=row(self.scale),
+                          dup=row(self.dup), drop=drop)
+
+
+def build_schedule(plan, *, nb_workers, nb_honests):
+    """Compile `plan`, or return None for a plan with no events — the
+    engine treats None as "no fault machinery at all", so an empty plan
+    compiles to exactly the fault-free program (zero overhead)."""
+    if plan is None or not plan.events:
+        return None
+    return FaultSchedule(plan, nb_workers, nb_honests)

@@ -252,13 +252,24 @@ def batchnorm_apply(params, state, x, *, train):
 # need no changes; `BMT_NO_WORKER_PACK=1` disables packing (A/B knob).
 
 
+# Largest pack factor worth engaging: the paired block-diagonal conv pays
+# P x the MXU FLOPs of the unpacked grouped conv (the off-diagonal zero
+# blocks), against at most a (128 - c)/128 bandwidth saving on the
+# elementwise passes. P <= 4 keeps the measured-win regime (c = 32/64 on
+# the benchmarked CNNs); larger S/c combinations (e.g. S = 64 with c = 2)
+# would otherwise silently auto-engage fully-dense P = 64 packing whose
+# zero-block FLOPs dwarf the padding saved.
+_MAX_WORKER_PACK = 4
+
+
 def _worker_packing(S, c):
-    """Smallest P dividing S with (P*c) % 128 == 0, else 1."""
+    """Smallest P <= _MAX_WORKER_PACK dividing S with (P*c) % 128 == 0,
+    else 1 (no packing)."""
     no_pack = os.environ.get("BMT_NO_WORKER_PACK", "").lower() not in (
         "", "0", "false", "no")
     if no_pack or c % 128 == 0:
         return 1
-    for P in range(2, S + 1):
+    for P in range(2, min(S, _MAX_WORKER_PACK) + 1):
         if S % P == 0 and (P * c) % 128 == 0:
             return P
     return 1

@@ -22,6 +22,9 @@ __all__ = [
     "all_finite_from_dist",
     "averaged_median",
     "lower_median",
+    "masked_lower_median",
+    "masked_mean",
+    "masked_trmean",
     "pairwise_distances",
     "closest_mean",
     "sanitize_inf",
@@ -129,6 +132,62 @@ def lower_median(g):
 def sanitize_inf(x):
     """Replace non-finite entries by +inf (Byzantine-distance convention)."""
     return jnp.where(jnp.isfinite(x), x, jnp.inf)
+
+
+# --------------------------------------------------------------------------- #
+# Masked / dynamic-quorum variants (`faults/quorum.py`)
+#
+# When the fault subsystem drops workers mid-run, the row count the GAR
+# semantically operates on becomes a TRACED value (`n_eff = sum(active)`)
+# while the matrix shape stays static. The variants below reproduce the
+# corresponding static kernels exactly on the active subset: inactive rows
+# are routed to the sort-last/never-selected conventions already used for
+# non-finite values, and every static slice bound becomes a rank predicate
+# against the traced count. (No Pallas tier — the fused kernels bake static
+# indices; fault steps are rare enough that the jnp path is the right cost.)
+
+
+def masked_mean(g, active, n_eff=None):
+    """Arithmetic mean over the active rows only.
+
+    `g: f32[n, d], active: bool[n] -> f32[d]`; equals
+    `jnp.mean(g[active], axis=0)` with a traced mask (returns NaN for an
+    empty active set, as the gather-mean would).
+    """
+    if n_eff is None:
+        n_eff = jnp.sum(active.astype(jnp.int32))
+    kept = jnp.where(active[:, None], g, jnp.zeros((), g.dtype))
+    return jnp.sum(kept, axis=0) / n_eff.astype(g.dtype)
+
+
+def masked_lower_median(g, active, n_eff=None):
+    """Coordinate-wise lower median over the active rows only.
+
+    Inactive rows are sent to NaN — sorting last, exactly the kernel's
+    NaN-resilience convention — and the lower-median index is computed from
+    the traced active count: `sorted[(n_eff - 1) // 2]`. Equals
+    `lower_median(g[active])` for finite active rows.
+    """
+    if n_eff is None:
+        n_eff = jnp.sum(active.astype(jnp.int32))
+    gm = jnp.where(active[:, None], g, jnp.asarray(jnp.nan, g.dtype))
+    idx = jnp.maximum(n_eff - 1, 0) // 2
+    return jnp.take(jnp.sort(gm, axis=0), idx, axis=0)
+
+
+def masked_trmean(g, active, f, n_eff=None):
+    """Coordinate-wise trimmed mean over the active rows only: mean of the
+    sorted active ranks `[f, n_eff - f)` with a traced `f` and count
+    (`ops/trmean.py` semantics on the active subset; callers guarantee
+    `n_eff > 2 f`)."""
+    if n_eff is None:
+        n_eff = jnp.sum(active.astype(jnp.int32))
+    gm = jnp.where(active[:, None], g, jnp.asarray(jnp.nan, g.dtype))
+    srt = jnp.sort(gm, axis=0)
+    ranks = jnp.arange(g.shape[0])[:, None]
+    take = (ranks >= f) & (ranks < n_eff - f)
+    kept = jnp.where(take, srt, jnp.zeros((), g.dtype))
+    return jnp.sum(kept, axis=0) / (n_eff - 2 * f).astype(g.dtype)
 
 
 def pairwise_distances(g, *, squared=False, method="dot"):

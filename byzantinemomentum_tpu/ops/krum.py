@@ -22,7 +22,8 @@ from byzantinemomentum_tpu.ops._common import (
     all_finite_from_dist, pairwise_distances, selection_influence,
     weighted_rows_mean)
 
-__all__ = ["aggregate", "scores", "selection", "selection_weights"]
+__all__ = ["aggregate", "scores", "selection", "selection_weights",
+           "selection_weights_masked"]
 
 
 def scores_from_dist(dist, f):
@@ -53,6 +54,39 @@ def selection_weights(dist, f, m=None):
     ranks = jnp.zeros((n,), jnp.int32).at[order].set(
         jnp.arange(n, dtype=jnp.int32))
     return jnp.where(ranks < m, 1.0 / m, 0.0)
+
+
+def selection_weights_masked(dist, active, n_eff, f_eff, m=None):
+    """Dynamic-quorum `selection_weights`: Multi-Krum over the active rows
+    only, with TRACED effective counts (`faults/quorum.py`).
+
+    Inactive rows ride the non-finite conventions — their distances are
+    forced to +inf, so their scores are +inf and they are never selected —
+    and the static slice bounds become rank predicates: each active row's
+    score sums its `n_eff - f_eff - 1` smallest active-neighbor distances,
+    and the aggregate averages the `m` (default `n_eff - f_eff - 2`)
+    lowest-score rows. Matches `selection_weights(dist[active][:, active],
+    f_eff, m)` re-expanded to the full row set.
+    """
+    n = dist.shape[0]
+    pair = active[:, None] & active[None, :]
+    dist = jnp.where(pair, dist, jnp.inf)
+    # Beyond-quorum degeneracy guard (n_eff too small for the krum
+    # contract): keep at least one neighbor / one selected row
+    keep = jnp.clip(n_eff - f_eff - 1, 1, n)
+    srt = jnp.sort(dist, axis=1)
+    ranks = jnp.arange(n)[None, :]
+    scores = jnp.sum(jnp.where(ranks < keep, srt, 0.0), axis=1)
+    scores = jnp.where(active, scores, jnp.inf)
+    if m is None:
+        m = jnp.clip(n_eff - f_eff - 2, 1, n)
+    else:
+        m = jnp.clip(jnp.minimum(m, n_eff - f_eff - 2), 1, n)
+    order = jnp.argsort(scores, stable=True)
+    score_ranks = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    w = jnp.where((score_ranks < m) & active, 1.0 / m, 0.0)
+    return w.astype(jnp.float32)
 
 
 def selection(gradients, f, m=None, *, method="dot", **kwargs):

@@ -11,9 +11,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "mesh_axes"]
+__all__ = ["make_mesh", "mesh_axes", "shard_map"]
 
 WORKERS, MODEL = "workers", "model"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """`jax.shard_map` across jax versions.
+
+    Recent jax exposes the primitive at the top level with the `check_vma`
+    spelling; the releases this framework must also run on only ship
+    `jax.experimental.shard_map.shard_map`, where the same knob is named
+    `check_rep`. Every shard-mapped kernel in the framework goes through
+    this wrapper so a jax downgrade degrades nothing but the spelling.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
 
 
 def mesh_axes():

@@ -29,11 +29,22 @@ __all__ = ["ring_attention", "ulysses_attention", "dense_attention"]
 _NEG = -1e30  # large-negative mask value (avoids -inf NaN propagation)
 
 
-def dense_attention(q, k, v, *, causal=True, base=0):
+def _axis_size(axis_name):
+    """`lax.axis_size` across jax versions (older releases lack it;
+    `psum(1, axis)` constant-folds to the same concrete int)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def dense_attention(q, k, v, *, causal=True, base=0, key_mask=None):
     """Plain softmax attention `[B, H, L, Dh]` (single-device reference).
 
     `base` offsets the query positions relative to the key positions —
-    used by the ring kernel for cross-block causal masks.
+    used by the ring kernel for cross-block causal masks. `key_mask`
+    (bool[Lk], True = usable) excludes key positions from the softmax —
+    the dense counterpart of the ring kernel's `drop_blocks` peer-loss
+    degradation, and its differential-test oracle.
     """
     dh = q.shape[-1]
     # Softmax statistics in f32 regardless of the input dtype (the usual
@@ -44,11 +55,13 @@ def dense_attention(q, k, v, *, causal=True, base=0):
         qpos = base + jnp.arange(q.shape[2])[:, None]
         kpos = jnp.arange(k.shape[2])[None, :]
         scores = jnp.where(qpos >= kpos, scores, _NEG)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[None, None, None, :], scores, _NEG)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v).astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name, *, causal=True):
+def ring_attention(q, k, v, axis_name, *, causal=True, drop_blocks=None):
     """Exact blockwise attention with the sequence sharded over `axis_name`.
 
     Inputs are the LOCAL chunks `[B, H, Lc, Dh]` of the `[B, H, L, Dh]`
@@ -59,8 +72,17 @@ def ring_attention(q, k, v, axis_name, *, causal=True):
     scores its Q chunk against the currently-held K/V block, rescales its
     running (output, max, normalizer) triple, and forwards the block to the
     next ring neighbor via `ppermute`.
+
+    `drop_blocks` (bool[p], True = lost) is the fault-injection hook
+    (`faults/`, multi-host chaos testing): K/V blocks originating on a
+    "lost" ring participant are excluded from the accumulation — the
+    surviving chips compute exact softmax attention over the remaining
+    positions (the oracle is `dense_attention` with the matching
+    `key_mask`), instead of deadlocking or poisoning the statistics. A
+    query whose every visible block is dropped degrades to a zero output
+    (the normalizer floor below).
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, h, lc, dh = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(dh))
@@ -77,6 +99,8 @@ def ring_attention(q, k, v, axis_name, *, causal=True):
             mask = qpos[:, None] >= kpos[None, :]
         else:
             mask = jnp.ones((lc, lc), bool)
+        if drop_blocks is not None:
+            mask = mask & ~jnp.take(jnp.asarray(drop_blocks), src)
         scores = jnp.where(mask, scores, _NEG)
         block_max = jnp.max(scores, axis=-1)
         m_new = jnp.maximum(m, block_max)
@@ -106,7 +130,7 @@ def ulysses_attention(q, k, v, axis_name, *, causal=True):
     swap back. Inputs/outputs: local `[B, H, Lc, Dh]` chunks inside
     `shard_map`; requires `H % p == 0`.
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     if q.shape[1] % p != 0:
         raise ValueError(
             f"ulysses_attention requires heads ({q.shape[1]}) divisible by "

@@ -11,6 +11,9 @@ keeps backend init off the (possibly absent) TPU tunnel.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Download-retry backoff (`faults/retry.py` via `data/sources.py:_fetch`)
+# must not sleep between mocked-failure attempts in tests
+os.environ.setdefault("BMT_FETCH_BACKOFF", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

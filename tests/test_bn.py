@@ -298,7 +298,12 @@ def test_bn_f64_statistics_stay_f64():
     same values differs from the f64 oracle by ~1e-8; the f64 run must agree
     to ~1e-12."""
     from byzantinemomentum_tpu.models.core import _bn_train
-    with jax.enable_x64(True):
+    # `jax.enable_x64` is top-level only on recent jax; older releases
+    # ship the same context manager under jax.experimental
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+    with enable_x64(True):
         rng = np.random.default_rng(14)
         # Ill-conditioned regime: |mean| >> std, where one-pass f32 cancels
         x = (1000.0 + rng.normal(size=(64, 4), scale=1e-2)).astype(np.float64)

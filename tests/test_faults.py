@@ -1,0 +1,498 @@
+"""Fault-injection subsystem tests (`byzantinemomentum_tpu/faults/`).
+
+Layers under test:
+* plan — JSON round-trip, validation, seeded deterministic generation;
+* schedule — event lowering, horizon clamp, device-loss persistence;
+* quorum — masked dynamic-(n, f) aggregation differentially checked
+  against the static kernels on the compacted active subset;
+* engine — injection exactness (straggler/duplicate/corruption) on the
+  linear probe model, dynamic quorum under drops, NaN-quarantine keeping
+  the step finite (and `average` without it visibly diverging), empty-plan
+  zero-overhead contract;
+* driver — `--fault-plan` end-to-end through `cli/attack.py`, with the
+  `Faults injected` / `Workers active` / `Quorum f` study columns.
+"""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzantinemomentum_tpu import faults, losses, ops
+from byzantinemomentum_tpu.engine import (
+    EngineConfig, FAULT_COLUMNS, STUDY_COLUMNS, build_engine)
+from byzantinemomentum_tpu.models import ModelDef
+
+D = 6
+
+
+# --------------------------------------------------------------------------- #
+# Plan: declaration, JSON, determinism
+
+
+def sample_plan():
+    return faults.FaultPlan(events=(
+        faults.straggler(0, step=2, delay_steps=3),
+        faults.drop_worker(2, step=1, duration=2),
+        faults.corrupt_gradient(4, step=1, mode="scale", scale=0.25),
+        faults.corrupt_gradient(5, step=3, mode="nan"),
+        faults.duplicate_submission(1, step=0, source=3),
+        faults.device_loss(6, step=4),
+    ), policy=faults.FaultPolicy(nan_quarantine=True, fetch_attempts=2),
+        seed=17)
+
+
+def test_plan_json_round_trip(tmp_path):
+    plan = sample_plan()
+    again = faults.FaultPlan.from_json(plan.to_json())
+    assert again == plan
+    path = plan.save(tmp_path / "plan.json")
+    assert faults.FaultPlan.load(path) == plan
+    # The JSON is plain data (hand-editable): a dict per event
+    raw = json.loads(path.read_text())
+    assert {e["kind"] for e in raw["events"]} == {
+        "straggler", "drop_worker", "corrupt_gradient",
+        "duplicate_submission", "device_loss"}
+    assert raw["policy"]["nan_quarantine"] is True
+
+
+def test_plan_validation_contracts():
+    with pytest.raises(ValueError, match="Unknown fault kind"):
+        faults.FaultEvent("meteor_strike", 0, 0)
+    with pytest.raises(ValueError, match="duration"):
+        faults.drop_worker(0, step=0, duration=0)
+    with pytest.raises(ValueError, match="Unknown fault-plan fields"):
+        faults.FaultPlan.from_dict({"event": []})
+    plan = faults.FaultPlan(events=(faults.drop_worker(10, step=0),))
+    assert plan.validate(11, 11) is None
+    assert "only 8 workers" in plan.validate(8, 8)
+    # Mutating faults cannot target attack-synthesized rows
+    plan = faults.FaultPlan(events=(faults.corrupt_gradient(9, step=0),))
+    assert "attack-synthesized" in plan.validate(11, 8)
+    plan = faults.FaultPlan(
+        events=(faults.duplicate_submission(1, step=0, source=1),))
+    assert "copies itself" in plan.validate(4, 4)
+
+
+def test_plan_generation_is_seed_deterministic():
+    kw = dict(nb_workers=11, nb_steps=50,
+              rates={"drop_worker": 0.02, "corrupt_gradient": 0.01,
+                     "straggler": 0.01})
+    a = faults.FaultPlan.generate(seed=5, **kw)
+    b = faults.FaultPlan.generate(seed=5, **kw)
+    c = faults.FaultPlan.generate(seed=6, **kw)
+    assert a.to_json() == b.to_json()
+    assert a.to_json() != c.to_json()
+    assert len(a.events) > 0
+    assert a.validate(11, 11) is None
+
+
+# --------------------------------------------------------------------------- #
+# Schedule: event lowering and in-graph lookup
+
+
+def test_schedule_masks_and_horizon():
+    sched = faults.build_schedule(sample_plan(), nb_workers=8, nb_honests=8)
+    # Same plan -> identical compiled masks (the determinism contract)
+    again = faults.build_schedule(sample_plan(), nb_workers=8, nb_honests=8)
+    for name in ("stale", "nan", "zero", "scale", "dup", "drop",
+                 "lost_from"):
+        np.testing.assert_array_equal(getattr(sched, name),
+                                      getattr(again, name))
+    sf = sched.step_faults(jnp.int32(1))
+    assert bool(sf.drop[2]) and not bool(sf.drop[3])
+    assert float(sf.scale[4]) == 0.25
+    sf = sched.step_faults(jnp.int32(3))
+    assert bool(sf.nan[5]) and not bool(sf.drop[2])  # drop window over
+    # Beyond the horizon: everything neutral EXCEPT the permanent loss
+    sf = sched.step_faults(jnp.int32(1000))
+    assert bool(sf.drop[6])
+    assert not bool(jnp.any(sf.stale)) and not bool(jnp.any(sf.nan))
+    assert float(jnp.sum(sf.drop)) == 1.0
+
+
+def test_empty_plan_compiles_to_none():
+    assert faults.build_schedule(faults.FaultPlan(), nb_workers=4,
+                                 nb_honests=4) is None
+    assert faults.build_schedule(None, nb_workers=4, nb_honests=4) is None
+
+
+# --------------------------------------------------------------------------- #
+# Quorum: masked dynamic-(n, f) kernels vs static kernels on the compacted
+# active subset
+
+
+def test_masked_aggregation_matches_static_compaction():
+    from byzantinemomentum_tpu.faults import quorum
+
+    rng = np.random.default_rng(3)
+    n, f_decl = 11, 4
+    G = jnp.asarray(rng.normal(size=(n, 16)).astype(np.float32))
+    active_np = np.ones(n, bool)
+    active_np[[2, 5, 7]] = False  # 3 absent -> n_eff = 8
+    active = jnp.asarray(active_np)
+    compact = G[active_np]
+
+    cases = {
+        # gar name -> (effective f at n_eff = 8, oracle on the compacted
+        # stack; median/average ignore f)
+        "average": (3, lambda g, f: jnp.mean(g, axis=0)),      # (8-1)//2
+        "median": (3, lambda g, f: ops._common.lower_median(g)),
+        "krum": (2, lambda g, f: ops.krum.aggregate(g, f)),    # (8-3)//2
+        "trmean": (3, lambda g, f: ops.trmean.trmean(g, f)),   # (8-1)//2
+    }
+    for name, (f_eff, oracle) in cases.items():
+        got, f_used = quorum.masked_aggregate(
+            ops.gars[name], G, active, f_decl=f_decl)
+        want = oracle(compact, f_eff)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"masked {name}")
+        assert int(f_used) == f_eff, name
+    # Unsupported GARs degrade via NaN routing with the declared f: the
+    # absent rows count toward f_decl and the result stays finite
+    got, f_used = quorum.masked_aggregate(
+        ops.gars["phocas"], G, active, f_decl=f_decl)
+    assert int(f_used) == f_decl
+    assert bool(jnp.all(jnp.isfinite(got)))
+
+
+def test_masked_krum_never_selects_inactive_or_nan_rows():
+    from byzantinemomentum_tpu.faults import quorum, sanitize
+
+    rng = np.random.default_rng(4)
+    n = 11
+    G = rng.normal(size=(n, 8)).astype(np.float32)
+    G[3] = np.nan                      # corrupt but "present"
+    G[6] += 1000.0                     # outlier, present and finite
+    active = np.ones(n, bool)
+    active[[0, 9]] = False             # dropped
+    act, quarantined = sanitize.quarantine(
+        jnp.asarray(G), jnp.asarray(active))
+    assert int(quarantined) == 1
+    got, f_used = quorum.masked_aggregate(
+        ops.gars["krum"], jnp.asarray(G), act, f_decl=4)
+    assert bool(jnp.all(jnp.isfinite(got)))
+    # n_eff = 8 -> f_eff = 2, m = 8 - 2 - 2 = 4: the far outlier is never
+    # among the 4 selected, so the aggregate stays near the inlier mean
+    inliers = np.delete(G, [0, 3, 6, 9], axis=0)
+    assert np.linalg.norm(np.asarray(got) - inliers.mean(0)) \
+        < np.linalg.norm(np.asarray(got) - G[6])
+
+
+# --------------------------------------------------------------------------- #
+# Engine integration (linear probe model: per-worker gradient == the mean
+# of its batch rows, same technique as tests/test_engine.py)
+
+
+def probe_model():
+    def init(key):
+        return {"w": jnp.zeros((D,), jnp.float32)}, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        return x, state
+
+    return ModelDef("probe", init, apply, (D,))
+
+
+def probe_loss():
+    return losses.Loss(lambda output, target, params:
+                       jnp.dot(params, jnp.mean(output, axis=0)))
+
+
+def make_engine(plan=None, gar="average", n=5, f=1, **cfg_kwargs):
+    cfg_kwargs.setdefault("nb_workers", n)
+    cfg_kwargs.setdefault("nb_decl_byz", f)
+    cfg_kwargs.setdefault("nb_for_study", cfg_kwargs["nb_workers"])
+    cfg = EngineConfig(**cfg_kwargs)
+    sched = faults.build_schedule(
+        plan, nb_workers=cfg.nb_workers, nb_honests=cfg.nb_honests)
+    engine = build_engine(
+        cfg=cfg, model_def=probe_model(), loss=probe_loss(),
+        criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars[gar], 1.0, {})], faults=sched)
+    return cfg, engine
+
+
+def run_steps(engine, grads, lr=0.1):
+    """grads: [steps][n workers] of per-worker gradient vectors; returns
+    (thetas after each step, metrics of each step)."""
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((D,))}, net_state={})
+    thetas, all_metrics = [], []
+    for step_grads in grads:
+        xs = jnp.asarray(np.stack(step_grads)[:, None, :])  # batch of 1 row
+        ys = jnp.zeros(xs.shape[:2], jnp.float32)
+        state, metrics = engine.train_step(state, xs, ys, jnp.float32(lr))
+        thetas.append(np.asarray(state.theta))
+        all_metrics.append(metrics)
+    return thetas, all_metrics
+
+
+def test_straggler_replays_prewindow_gradient():
+    rng = np.random.default_rng(0)
+    grads = rng.normal(size=(4, 5, D)).astype(np.float32)
+    plan = faults.FaultPlan(
+        events=(faults.straggler(0, step=1, delay_steps=2),))
+    _, engine = make_engine(plan, momentum=0.0)
+    thetas, metrics = run_steps(engine, grads)
+    # Steps 1 and 2: worker 0 submits its step-0 gradient; step 3 is fresh
+    submitted = grads.copy()
+    submitted[1, 0] = grads[0, 0]
+    submitted[2, 0] = grads[0, 0]
+    theta = np.zeros(D, np.float32)
+    for t in range(4):
+        theta = theta - 0.1 * submitted[t].mean(0)
+        np.testing.assert_allclose(thetas[t], theta, rtol=1e-5, atol=1e-6)
+    assert [int(m["Faults injected"]) for m in metrics] == [0, 1, 1, 0]
+
+
+def test_duplicate_and_scale_corruption_exact():
+    rng = np.random.default_rng(1)
+    grads = rng.normal(size=(2, 5, D)).astype(np.float32)
+    plan = faults.FaultPlan(events=(
+        faults.duplicate_submission(1, step=1, source=3),
+        faults.corrupt_gradient(4, step=1, mode="scale", scale=0.5),
+    ))
+    _, engine = make_engine(plan, momentum=0.0)
+    thetas, metrics = run_steps(engine, grads)
+    submitted = grads.copy()
+    submitted[1, 1] = grads[1, 3]
+    submitted[1, 4] *= 0.5
+    theta = -0.1 * submitted[0].mean(0)
+    np.testing.assert_allclose(thetas[0], theta, rtol=1e-5, atol=1e-6)
+    theta = theta - 0.1 * submitted[1].mean(0)
+    np.testing.assert_allclose(thetas[1], theta, rtol=1e-5, atol=1e-6)
+    assert int(metrics[1]["Faults injected"]) == 2
+
+
+def test_drop_worker_shrinks_quorum_for_krum_and_median():
+    rng = np.random.default_rng(2)
+    n = 11
+    grads = rng.normal(size=(3, n, D)).astype(np.float32)
+    plan = faults.FaultPlan(events=(
+        faults.drop_worker(2, step=1),
+        faults.drop_worker(8, step=1),
+        faults.corrupt_gradient(5, step=1, mode="nan"),
+    ))
+    for gar, f_eff_faulted in (("krum", 2), ("median", 3)):
+        _, engine = make_engine(plan, gar=gar, n=n, f=4)
+        thetas, metrics = run_steps(engine, grads)
+        assert all(np.isfinite(t).all() for t in thetas), gar
+        assert int(metrics[0]["Workers active"]) == n
+        assert int(metrics[0]["Quorum f"]) == 4
+        # Step 1: 2 dropped + 1 quarantined -> n_eff = 8, f re-clamped
+        assert int(metrics[1]["Workers active"]) == 8, gar
+        assert int(metrics[1]["Quorum f"]) == f_eff_faulted, gar
+        assert int(metrics[1]["Faults injected"]) == 3
+        assert int(metrics[2]["Workers active"]) == n
+
+
+def test_nan_quarantine_keeps_average_finite_and_its_absence_diverges():
+    rng = np.random.default_rng(5)
+    grads = rng.normal(size=(3, 5, D)).astype(np.float32)
+    plan = faults.FaultPlan(
+        events=(faults.corrupt_gradient(1, step=1, mode="nan"),))
+    _, engine = make_engine(plan, momentum=0.0, fault_quarantine=True)
+    thetas, metrics = run_steps(engine, grads)
+    assert np.isfinite(thetas[-1]).all()
+    assert int(metrics[1]["Workers active"]) == 4  # quarantined out
+    # Quarantine is also exact: the step-1 update is the clean-row mean
+    expect = -0.1 * (grads[0].mean(0) + np.delete(grads[1], 1, 0).mean(0))
+    np.testing.assert_allclose(thetas[1], expect, rtol=1e-5, atol=1e-6)
+    # Without quarantine the NaN row poisons the average permanently
+    _, engine = make_engine(plan, momentum=0.0, fault_quarantine=False)
+    thetas, metrics = run_steps(engine, grads)
+    assert np.isnan(thetas[1]).all() and np.isnan(thetas[2]).all()
+    assert int(metrics[1]["Workers active"]) == 5  # nobody masked
+
+
+def test_faulted_run_is_deterministic():
+    rng = np.random.default_rng(6)
+    grads = rng.normal(size=(4, 11, D)).astype(np.float32)
+    plan = faults.FaultPlan.generate(
+        nb_workers=11, nb_steps=4, seed=9,
+        rates={"drop_worker": 0.1, "corrupt_gradient": 0.1,
+               "straggler": 0.1})
+    runs = []
+    for _ in range(2):
+        _, engine = make_engine(plan, gar="krum", n=11, f=4)
+        thetas, _ = run_steps(engine, grads)
+        runs.append(np.stack(thetas))
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+def test_fault_free_engine_state_has_no_buffer_and_same_trajectory():
+    """The zero-overhead contract: no plan (or an empty one) means no
+    fault state and the exact fault-free trajectory; a plan without
+    stragglers carries no stale buffer either."""
+    rng = np.random.default_rng(7)
+    grads = rng.normal(size=(2, 5, D)).astype(np.float32)
+    _, plain = make_engine(None)
+    state = plain.init(jax.random.PRNGKey(0),
+                       params={"w": jnp.zeros((D,))}, net_state={})
+    assert state.fault_buffer.shape == (0, D)
+    base, _ = run_steps(plain, grads)
+    # Plan whose only event lies in the future AND needs no buffer: no
+    # stale state, and the pre-fault trajectory matches the plain engine
+    # to rounding (the masked-mean kernel may associate differently from
+    # jnp.mean; bitwise identity is only claimed for EMPTY plans, whose
+    # schedule is None and whose program is literally the plain one)
+    plan = faults.FaultPlan(events=(faults.drop_worker(0, step=50),))
+    _, faulted = make_engine(plan)
+    fstate = faulted.init(jax.random.PRNGKey(0),
+                          params={"w": jnp.zeros((D,))}, net_state={})
+    assert fstate.fault_buffer.shape == (0, D)
+    got, _ = run_steps(faulted, grads)
+    np.testing.assert_allclose(np.stack(base), np.stack(got),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_checkpoint_without_fault_buffer_loads_with_cold_buffer(tmp_path):
+    """Pre-faults checkpoints lack the `fault_buffer` field; they must
+    load against a faults-era template with the buffer cold-started."""
+    from flax import serialization
+
+    from byzantinemomentum_tpu import checkpoint
+
+    _, engine = make_engine(
+        faults.FaultPlan(events=(faults.straggler(0, step=1),)))
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((D,))}, net_state={})
+    assert state.fault_buffer.shape[0] > 0
+    path = checkpoint.save(tmp_path / "ckpt", state)
+    raw = serialization.msgpack_restore(path.read_bytes())
+    del raw["state"]["fault_buffer"]  # what an old checkpoint looks like
+    path.write_bytes(serialization.msgpack_serialize(raw))
+    loaded = checkpoint.load(path, state)
+    np.testing.assert_array_equal(np.asarray(loaded.theta),
+                                  np.asarray(state.theta))
+    assert loaded.fault_buffer.shape == state.fault_buffer.shape
+    np.testing.assert_array_equal(np.asarray(loaded.fault_buffer), 0.0)
+
+
+# --------------------------------------------------------------------------- #
+# Ring-attention peer loss (`parallel/ring.py:drop_blocks`)
+
+
+def test_ring_attention_survives_dropped_peer_blocks():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from byzantinemomentum_tpu.parallel import dense_attention, ring_attention
+    from byzantinemomentum_tpu.parallel.mesh import shard_map
+
+    p = 8
+    b, h, L, dh = 2, 4, 32, 4
+    lc = L // p
+    rng = np.random.default_rng(8)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, L, dh)).astype(np.float32))
+               for _ in range(3))
+    lost = np.zeros(p, bool)
+    lost[[2, 5]] = True
+    key_mask = jnp.asarray(~np.repeat(lost, lc))
+    mesh = Mesh(np.asarray(jax.devices()[:p]), ("seq",))
+    for causal in (False, True):
+        want = dense_attention(q, k, v, causal=causal, key_mask=key_mask)
+        fn = shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, "seq", causal=causal,
+                drop_blocks=jnp.asarray(lost)),
+            mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+            out_specs=P(None, None, "seq"))
+        got = jax.jit(fn)(q, k, v)
+        # Causal queries inside a lost block still see their own positions
+        # in the dense oracle; compare only queries on surviving chips
+        alive_rows = np.repeat(~lost, lc)
+        np.testing.assert_allclose(
+            np.asarray(got)[:, :, alive_rows],
+            np.asarray(want)[:, :, alive_rows], rtol=2e-5, atol=2e-6)
+
+
+# --------------------------------------------------------------------------- #
+# Driver end-to-end (`--fault-plan` through cli/attack.py)
+
+
+@pytest.fixture
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+CLI_BASE = ["--nb-steps", "4", "--batch-size", "8", "--batch-size-test",
+            "32", "--batch-size-test-reps", "1", "--evaluation-delta", "0",
+            "--model", "simples-full", "--seed", "11", "--nb-workers", "11",
+            "--nb-decl-byz", "4", "--nb-for-study", "11",
+            "--nb-for-study-past", "2"]
+
+DEMO_PLAN = faults.FaultPlan(events=(
+    faults.device_loss(3, step=2),
+    faults.drop_worker(6, step=2, duration=2),
+    faults.corrupt_gradient(9, step=2, mode="nan", duration=2),
+))
+
+
+def _fault_rows(resdir):
+    lines = (resdir / "study").read_text().split(os.linesep)
+    assert lines[0] == "# " + "\t".join(STUDY_COLUMNS + FAULT_COLUMNS)
+    rows = []
+    for line in lines[1:]:
+        if line:
+            f = line.split("\t")
+            assert len(f) == len(STUDY_COLUMNS) + len(FAULT_COLUMNS)
+            rows.append({"loss": float(f[2]), "injected": int(f[-3]),
+                         "active": int(f[-2]), "quorum_f": int(f[-1])})
+    return rows
+
+
+def test_cli_fault_plan_smoke(tmp_path, small_synth):
+    from byzantinemomentum_tpu.cli.attack import main
+
+    plan_path = DEMO_PLAN.save(tmp_path / "plan.json")
+    resdir = tmp_path / "run"
+    rc = main(CLI_BASE + ["--gar", "krum", "--fault-plan", str(plan_path),
+                          "--result-directory", str(resdir)])
+    assert rc == 0
+    cfg = json.loads((resdir / "config.json").read_text())
+    assert cfg["fault_plan"] == str(plan_path)
+    rows = _fault_rows(resdir)
+    assert [r["injected"] for r in rows] == [0, 0, 3, 3]
+    assert [r["active"] for r in rows] == [11, 11, 8, 8]
+    assert [r["quorum_f"] for r in rows] == [4, 4, 2, 2]
+    assert all(np.isfinite(r["loss"]) for r in rows)
+
+
+@pytest.mark.slow
+def test_cli_acceptance_demo_resilient_gars_vs_bare_average(tmp_path,
+                                                            small_synth):
+    """The subsystem's acceptance scenario: 2 dropped workers + 1
+    NaN-corrupting worker out of n = 11. krum and median (quarantine +
+    dynamic quorum) finish with finite loss; `average` with quarantine
+    disabled visibly diverges."""
+    from byzantinemomentum_tpu.cli.attack import main
+
+    plan_path = DEMO_PLAN.save(tmp_path / "plan.json")
+    bare = faults.FaultPlan(
+        events=DEMO_PLAN.events,
+        policy=faults.FaultPolicy(nan_quarantine=False))
+    bare_path = bare.save(tmp_path / "plan_bare.json")
+
+    for gar, path, f_eff in (("krum", plan_path, 2),
+                             ("median", plan_path, 3)):
+        resdir = tmp_path / f"run_{gar}"
+        assert main(CLI_BASE + ["--gar", gar, "--fault-plan", str(path),
+                                "--result-directory", str(resdir)]) == 0
+        rows = _fault_rows(resdir)
+        assert all(np.isfinite(r["loss"]) for r in rows), gar
+        assert rows[-1]["active"] == 8 and rows[-1]["quorum_f"] == f_eff
+
+    resdir = tmp_path / "run_average"
+    assert main(CLI_BASE + ["--gar", "average", "--fault-plan",
+                            str(bare_path),
+                            "--result-directory", str(resdir)]) == 0
+    rows = _fault_rows(resdir)
+    assert np.isfinite(rows[1]["loss"])      # clean until the faults hit
+    assert np.isnan(rows[-1]["loss"])        # then visibly diverged
+    assert rows[-1]["active"] == 9           # drops masked, NaN row not

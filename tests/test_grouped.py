@@ -179,17 +179,21 @@ def test_grouped_respects_config_off():
 
 
 def test_apply_grouped_matches_vmap_when_packing_engages():
-    """Worker packing for awkward channel counts (simples-conv's C=50 packs
-    only at S % 64 == 0; empire-cnn's C=64 at even S): the packed grouped
-    path must still match vmap exactly — in particular the flatten stages
-    must unpack before building per-worker rows (a missing unpack reshapes
-    other workers' channels into the fc input with NO shape error)."""
-    from byzantinemomentum_tpu.models.core import _worker_packing
-    S, B = 64, 2
-    assert _worker_packing(S, 50) > 1  # the scenario actually packs
-    model = models.build("simples-conv")
+    """Worker packing within the P <= 4 cap (empire-cnn's C=64 packs at
+    P=2 for even S): the packed grouped path must still match vmap exactly
+    — in particular the flatten stages must unpack before building
+    per-worker rows (a missing unpack reshapes other workers' channels
+    into the fc input with NO shape error)."""
+    from byzantinemomentum_tpu.models.core import _MAX_WORKER_PACK, _worker_packing
+    S, B = 4, 2
+    assert _worker_packing(S, 64) == 2  # the scenario actually packs
+    # Lane-aligning C=50 would need P=64 — past the cap, so packing (and
+    # its zero-block FLOP blowup) must NOT silently auto-engage there
+    assert _worker_packing(64, 50) == 1
+    assert _worker_packing(8 * _MAX_WORKER_PACK, 32) == _MAX_WORKER_PACK
+    model = models.build("empire-cnn")
     params, state = model.init(jax.random.PRNGKey(0))
-    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, 28, 28, 1),
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, 32, 32, 3),
                            jnp.float32)
     keys = jax.random.split(jax.random.PRNGKey(2), S)
     out_v, _ = jax.vmap(

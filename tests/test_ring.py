@@ -7,8 +7,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from byzantinemomentum_tpu.parallel.mesh import shard_map
 
 from byzantinemomentum_tpu import losses, ops
 from byzantinemomentum_tpu.engine import EngineConfig, build_engine

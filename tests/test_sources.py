@@ -358,6 +358,62 @@ def test_download_checksum_mismatch_refuses_install(data_dir, monkeypatch):
     assert not (target / "bad.gz.part").exists()
 
 
+def test_download_retries_transient_failures_with_backoff(data_dir,
+                                                          monkeypatch):
+    """The fetch path retries transient OSErrors with backoff
+    (`faults/retry.py`): a source that fails once then recovers still
+    installs; the retry observes the configured attempt budget."""
+    import hashlib
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    monkeypatch.setenv("BMT_FETCH_ATTEMPTS", "3")
+    monkeypatch.setenv("BMT_FETCH_BACKOFF", "0")
+    payload = gzip.compress(b"recovers on the second attempt")
+    url = "https://example.invalid/flaky.gz"
+    monkeypatch.setitem(
+        sources.DOWNLOADS, "testset",
+        [(url, "md5:" + hashlib.md5(payload).hexdigest(),
+          "TestSet/raw/flaky.gz")])
+    inner = _fake_opener({url: payload})
+
+    def flaky(u):
+        if len(inner.calls) < 1:
+            inner.calls.append(u)
+            raise OSError("connection reset")
+        return inner(u)
+
+    assert sources.ensure_downloaded("testset", opener=flaky) is True
+    assert len(inner.calls) == 2  # one failure + one success
+    assert (data_dir / "TestSet" / "raw" / "flaky.gz").read_bytes() \
+        == payload
+
+
+def test_download_does_not_retry_checksum_mismatch(data_dir, monkeypatch):
+    """A checksum mismatch is content corruption, not a transient fault:
+    the same payload would come back, so it raises on the FIRST attempt
+    (no retry burns the budget re-downloading garbage)."""
+    from byzantinemomentum_tpu import utils
+    monkeypatch.setenv("BMT_DOWNLOAD", "1")
+    monkeypatch.setenv("BMT_FETCH_ATTEMPTS", "5")
+    monkeypatch.setenv("BMT_FETCH_BACKOFF", "0")
+    url = "https://example.invalid/corrupt.gz"
+    monkeypatch.setitem(
+        sources.DOWNLOADS, "testset",
+        [(url, "md5:" + "0" * 32, "TestSet/raw/corrupt.gz")])
+    opener = _fake_opener({url: b"corrupted"})
+    with pytest.raises(utils.UserException, match="Checksum mismatch"):
+        sources.ensure_downloaded("testset", opener=opener)
+    assert len(opener.calls) == 1
+
+
+def test_kmnist_qmnist_pin_torchvision_digests():
+    """KMNIST/QMNIST carry torchvision's published MD5s, so neither needs
+    the BMT_DOWNLOAD_UNVERIFIED escape hatch anymore."""
+    for name in ("kmnist", "qmnist"):
+        for url, checksum, rel in sources.DOWNLOADS[name]:
+            assert checksum is not None and checksum.startswith("md5:"), url
+            assert len(checksum) == len("md5:") + 32, url
+
+
 def test_download_unverified_requires_explicit_optin(data_dir, monkeypatch):
     monkeypatch.setenv("BMT_DOWNLOAD", "1")
     monkeypatch.delenv("BMT_DOWNLOAD_UNVERIFIED", raising=False)
