@@ -10,9 +10,31 @@ reference's documented limitation that resumed runs are not reproducible
 
 Validation parity on load (reference `attack.py:629-667`): version match,
 non-negative counters, momentum buffer shape/count checks.
+
+Crash safety (preemptible-slice hardening, PR 2):
+
+* `save` is ATOMIC: payload to a same-directory `<name>.tmp`, fsync, then
+  `os.replace` onto the final name (+ a best-effort directory fsync). A
+  SIGKILL at any instant leaves either the previous checkpoint or the new
+  one — never a torn file under the final name.
+* Every file carries an integrity footer — `MAGIC` + CRC32 of the payload —
+  so a file torn by a pre-atomic writer, a bad disk or a partial copy is
+  *detected* instead of poisoning the resume (`verify`).
+* `find_latest_valid(dir)` walks the run's `checkpoint-<step>` files newest
+  first and returns the first one that verifies, skipping torn/corrupt
+  tails — what `--auto-resume` and the `Jobs` supervisor restart from.
+* A per-run manifest (`checkpoints.json`, atomically rewritten) records the
+  saved checkpoints, drives retention GC (`save(..., keep=N)` keeps the
+  newest N) and persists the run's restart counter across preemptions. The
+  manifest is advisory: resume scans the directory, so a kill between the
+  checkpoint rename and the manifest update loses nothing.
 """
 
+import json
+import os
 import pathlib
+import struct
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -22,22 +44,89 @@ from flax import serialization
 from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.engine.state import TrainState
 
-__all__ = ["VERSION", "save", "load"]
+__all__ = ["VERSION", "MAGIC", "MANIFEST_NAME", "save", "load", "seal",
+           "verify", "find_latest_valid", "checkpoint_step",
+           "read_manifest", "bump_restarts"]
 
 # Must be unique and incremented on every incompatible layout change
 # (reference `attack.py:622` — the reference is at version 4; this framework
 # numbers its own lineage).
 VERSION = 2
 
+# Integrity footer: MAGIC + CRC32(payload), little-endian, appended to the
+# serialized payload. Pre-footer checkpoints (same VERSION) remain loadable:
+# a file not ending in MAGIC is treated as a bare legacy payload.
+MAGIC = b"BMTC"
+_FOOTER = struct.Struct("<4sI")
 
-def save(path, state, *, data_state=None):
+# Per-run checkpoint manifest (deliberately NOT `checkpoint-*`: the resume
+# scan keys on that prefix)
+MANIFEST_NAME = "checkpoints.json"
+
+
+def seal(data):
+    """Append the integrity footer to a serialized payload."""
+    return data + _FOOTER.pack(MAGIC, zlib.crc32(data) & 0xFFFFFFFF)
+
+
+def _unseal(path, data):
+    """Strip and check the integrity footer; raises on a CRC mismatch.
+    Footer-less data passes through (legacy pre-footer checkpoints)."""
+    if len(data) >= _FOOTER.size:
+        magic, crc = _FOOTER.unpack(data[-_FOOTER.size:])
+        if magic == MAGIC:
+            payload = data[:-_FOOTER.size]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise utils.UserException(
+                    f"Unable to load checkpoint {str(path)!r}: integrity "
+                    f"footer mismatch (torn or corrupt file)")
+            return payload
+    return data
+
+
+def _fsync_directory(directory):
+    """Durably record the rename in the directory entry (best-effort: not
+    every platform/filesystem exposes directory fds)."""
+    try:
+        fd = os.open(str(directory), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _chaos_torn_write(path, data, step):
+    """Chaos-test instrumentation (`tests/test_chaos.py`): simulate a
+    preemption landing in the middle of a checkpoint write — flush half the
+    bytes to the tmp file, then die the hard way. The atomic-rename protocol
+    must make this indistinguishable from dying just before the save."""
+    target = os.environ.get("BMT_CHAOS_TORN_CHECKPOINT_STEP")
+    if target is None or int(target) != step:
+        return
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("wb") as fd:
+        fd.write(data[:max(1, len(data) // 2)])
+        fd.flush()
+        os.fsync(fd.fileno())
+    os._exit(137)
+
+
+def save(path, state, *, data_state=None, keep=None):
     """Serialize `state` to `path` (reference `Checkpoint.save`,
-    `experiments/checkpoint.py:134-148`).
+    `experiments/checkpoint.py:134-148`) — atomically, with the integrity
+    footer, and registered in the run's manifest.
 
     `data_state` optionally carries the host data-sampler snapshots
     (`Dataset.get_state()` dicts, e.g. {"train": ..., "test": ...}) so a
     resumed run replays the exact same batch sequence — the dataloader-state
     gap the reference documents as unfixed (reference `README.md:105`).
+
+    `keep`: retention — after a successful save, delete this run's oldest
+    checkpoints beyond the newest `keep` (None/0 keeps everything).
     """
     state = jax.device_get(state)
     # to_state_dict converts non-dict containers (e.g. optax opt_state
@@ -47,9 +136,18 @@ def save(path, state, *, data_state=None):
                          for name, value in state._asdict().items()}}
     if data_state is not None:
         payload["data"] = data_state
-    data = serialization.msgpack_serialize(payload)
+    data = seal(serialization.msgpack_serialize(payload))
     path = pathlib.Path(path)
-    path.write_bytes(data)
+    step = int(np.asarray(state.steps))
+    _chaos_torn_write(path, data, step)
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("wb") as fd:
+        fd.write(data)
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    _fsync_directory(path.parent)
+    _manifest_add(path.parent, path.name, step, len(data), keep=keep)
     return path
 
 
@@ -61,7 +159,8 @@ def load(path, template, *, return_data=False):
     With `return_data=True` returns `(state, data_state)` where `data_state`
     is the sampler snapshot stored by `save` (or None for checkpoints
     written without one)."""
-    raw = serialization.msgpack_restore(pathlib.Path(path).read_bytes())
+    path = pathlib.Path(path)
+    raw = serialization.msgpack_restore(_unseal(path, path.read_bytes()))
     version = raw.get("version")
     if version != VERSION:
         raise utils.UserException(
@@ -108,3 +207,110 @@ def load(path, template, *, return_data=False):
     if return_data:
         return state, raw.get("data")
     return state
+
+
+# ------------------------------------------------------------------------- #
+# Resume scanning
+
+def verify(path):
+    """Whether `path` holds a complete, CRC-consistent, version-matching
+    checkpoint. Cheap (no template reconciliation) and never raises — the
+    predicate `find_latest_valid` walks the directory with."""
+    try:
+        path = pathlib.Path(path)
+        raw = serialization.msgpack_restore(_unseal(path, path.read_bytes()))
+    except Exception:
+        return False
+    return (isinstance(raw, dict) and raw.get("version") == VERSION
+            and isinstance(raw.get("state"), dict))
+
+
+def checkpoint_step(path):
+    """The step number encoded in a `checkpoint-<step>` file name (None for
+    names that do not follow the run convention)."""
+    suffix = pathlib.Path(path).name.rsplit("-", 1)[-1]
+    return int(suffix) if suffix.isdigit() else None
+
+
+def find_latest_valid(directory, prefix="checkpoint-"):
+    """The newest valid checkpoint file in a run directory, walking past
+    torn/corrupt tails (a preempted run's last write may be garbage — the
+    trajectory must restart from the newest checkpoint that verifies).
+
+    Returns a `pathlib.Path` or None. Files whose suffix is not a bare step
+    number (`checkpoints.json`, stale `*.tmp` from a mid-write kill) are
+    ignored.
+    """
+    directory = pathlib.Path(directory)
+    if not directory.is_dir():
+        return None
+    candidates = []
+    for entry in directory.iterdir():
+        if not entry.name.startswith(prefix) or not entry.is_file():
+            continue
+        suffix = entry.name[len(prefix):]
+        if not suffix.isdigit():
+            continue
+        candidates.append((int(suffix), entry))
+    for _, entry in sorted(candidates, key=lambda c: c[0], reverse=True):
+        if verify(entry):
+            return entry
+        utils.warning(f"Skipping torn/corrupt checkpoint {entry.name}")
+    return None
+
+
+# ------------------------------------------------------------------------- #
+# Per-run manifest: retention GC + the restart counter
+
+def read_manifest(directory):
+    """The run's checkpoint manifest (a fresh empty one when absent or
+    unreadable — the manifest is advisory, the directory scan is the
+    authority)."""
+    path = pathlib.Path(directory) / MANIFEST_NAME
+    try:
+        manifest = json.loads(path.read_text())
+        if isinstance(manifest, dict):
+            manifest.setdefault("version", 1)
+            manifest.setdefault("checkpoints", [])
+            manifest.setdefault("restarts", 0)
+            return manifest
+    except Exception:
+        pass
+    return {"version": 1, "checkpoints": [], "restarts": 0}
+
+
+def _write_manifest(directory, manifest):
+    path = pathlib.Path(directory) / MANIFEST_NAME
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(manifest, indent="\t"))
+    os.replace(tmp, path)
+
+
+def _manifest_add(directory, name, step, size, keep=None):
+    """Register a freshly saved checkpoint; with `keep`, GC this run's
+    oldest checkpoints beyond the newest `keep` (files + entries)."""
+    directory = pathlib.Path(directory)
+    manifest = read_manifest(directory)
+    entries = [e for e in manifest["checkpoints"]
+               if isinstance(e, dict) and e.get("file") != name
+               and (directory / str(e.get("file"))).exists()]
+    entries.append({"file": name, "step": step, "bytes": size})
+    entries.sort(key=lambda e: e.get("step", -1))
+    if keep is not None and keep > 0:
+        while len(entries) > keep:
+            stale = entries.pop(0)
+            try:
+                (directory / str(stale["file"])).unlink()
+            except OSError:
+                pass
+    manifest["checkpoints"] = entries
+    _write_manifest(directory, manifest)
+
+
+def bump_restarts(directory):
+    """Increment and persist the run's restart counter (the `Restarts`
+    study-CSV column); returns the new count."""
+    manifest = read_manifest(directory)
+    manifest["restarts"] = int(manifest.get("restarts", 0)) + 1
+    _write_manifest(directory, manifest)
+    return manifest["restarts"]

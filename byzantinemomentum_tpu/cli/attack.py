@@ -7,6 +7,14 @@ host batches, runs milestones (eval / checkpoint / user input), formats the
 `eval` and 24-column `study` CSVs (byte-compatible with the reference's
 `study.Session` parser, reference `study.py:216-229`) and handles graceful
 SIGINT/SIGTERM (reference `attack.py:41-45`).
+
+Crash recovery (PR 2, for preemptible slices): `--auto-resume` restarts
+from the result directory's newest VALID checkpoint (atomic writes +
+integrity footers, `checkpoint.py`) and truncates/appends the CSVs so the
+concatenated output of a killed + resumed run is bit-identical to an
+uninterrupted one (`tests/test_chaos.py`); `--rollback-budget` adds an
+in-loop divergence watchdog that restores the last good checkpoint when
+the training state goes non-finite.
 """
 
 import argparse
@@ -30,7 +38,8 @@ from byzantinemomentum_tpu import models as models_mod
 from byzantinemomentum_tpu import ops as ops_mod
 from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.engine import (
-    EngineConfig, FAULT_COLUMNS, STUDY_COLUMNS, build_engine)
+    EngineConfig, FAULT_COLUMNS, RECOVERY_COLUMNS, STUDY_COLUMNS,
+    build_engine)
 from byzantinemomentum_tpu.models.core import apply_named_init
 
 __all__ = ["process_commandline", "main"]
@@ -155,6 +164,30 @@ def process_commandline(argv=None):
              "reference advertises but disables it)")
     add("--load-checkpoint", type=str, default=None,
         help="Checkpoint to resume from")
+    add("--auto-resume", action="store_true", default=False,
+        help="Restart from the newest VALID checkpoint found in the result "
+             "directory (torn/corrupt tails are skipped — checkpoints are "
+             "written atomically with an integrity footer). The study/eval "
+             "CSVs are truncated to the resume step and appended to, so an "
+             "interrupted run's concatenated output equals an "
+             "uninterrupted run's. When a resume actually happens, "
+             "'--nb-steps' counts TOTAL steps from step 0 (supervisors "
+             "re-issue the same command line); cold starts are unaffected")
+    add("--keep-checkpoints", type=int, default=0,
+        help="Retention: keep only this run's newest N checkpoints "
+             "(manifest-driven GC at save time), 0 to keep all")
+    add("--rollback-budget", type=int, default=0,
+        help="Divergence rollback: when the training state goes non-finite "
+             "mid-run, restore the last good checkpoint, re-seed the step "
+             "RNG fold and continue — at most this many times per process "
+             "(0 disables; needs '--checkpoint-delta' with a result "
+             "directory). Exhausting the budget fails the run (exit 1) so "
+             "a supervisor can retry it")
+    add("--rollback-tighten-quorum", action="store_true", default=False,
+        help="After each rollback, also raise the declared Byzantine count "
+             "f by one (only while every defense's contract still holds) "
+             "and rebuild the step program — trades a recompile for a "
+             "stricter quorum on the retried trajectory")
     add("--result-directory", type=str, default=None,
         help="Directory for results (eval/study CSVs, checkpoints)")
     add("--evaluation-delta", type=int, default=100,
@@ -259,10 +292,30 @@ def _postprocess(args):
     if args.nb_local_steps < 1:
         utils.fatal(f"Invalid arguments: non-positive number of local steps "
                     f"{args.nb_local_steps}")
-    if args.seed >= 0 and args.load_checkpoint is not None:
-        utils.warning("Unable to enforce reproducibility when a checkpoint "
-                      "is loaded; ignoring seed")
-        args.seed = -1
+    # A loaded checkpoint carries the full device PRNG state and (normally)
+    # the host sampler snapshots, so a fixed seed no longer has to be
+    # discarded; whether the resume is bit-exact is decided at load time,
+    # where the checkpoint's actual sampler payload is known (see `main`).
+    if args.auto_resume:
+        if args.load_checkpoint is not None:
+            utils.fatal("Invalid arguments: '--auto-resume' and "
+                        "'--load-checkpoint' are mutually exclusive "
+                        "(auto-resume scans the result directory itself)")
+        if args.result_directory is None:
+            utils.fatal("Invalid arguments: '--auto-resume' requires "
+                        "'--result-directory'")
+    if args.keep_checkpoints < 0:
+        utils.fatal(f"Invalid arguments: negative checkpoint retention "
+                    f"{args.keep_checkpoints}")
+    if args.rollback_budget < 0:
+        utils.fatal(f"Invalid arguments: negative rollback budget "
+                    f"{args.rollback_budget}")
+    if args.rollback_budget > 0 and (args.result_directory is None
+                                     or args.checkpoint_delta <= 0):
+        utils.warning("'--rollback-budget' needs periodic checkpoints "
+                      "('--checkpoint-delta' with '--result-directory'); "
+                      "rollback disabled")
+        args.rollback_budget = 0
     # Study coercions (reference `attack.py:301-313`)
     if args.result_directory is None:
         args.nb_for_study = 0
@@ -322,21 +375,81 @@ def _config_text(args):
 class _ResultFiles:
     """`result_make`/`result_get`/`result_store` parity
     (reference `attack.py:403-448`): '# '-prefixed tab-separated header,
-    rows prefixed with the line separator (no trailing newline)."""
+    rows prefixed with the line separator (no trailing newline).
+
+    Crash recovery additions: `make(..., resume_step=s)` keeps an existing
+    file's rows strictly below `s` (the rows a preempted predecessor wrote
+    before its last valid checkpoint) instead of truncating everything, and
+    `truncate(s)` rewinds every open file to below `s` mid-run (divergence
+    rollback) — so the on-disk rows always form one contiguous, duplicate-
+    free trajectory."""
 
     def __init__(self, directory):
         self.directory = directory
         self._fds = {}
+        self._headers = {}
 
-    def make(self, name, *fields):
+    def make(self, name, *fields, resume_step=None):
         if self.directory is None:
             raise RuntimeError("No result is to be output")
         if name in self._fds:
             raise KeyError(f"Name {name!r} is already bound to a result file")
-        fd = (self.directory / name).open("w")
-        fd.write("# " + "\t".join(str(field) for field in fields))
+        header = "# " + "\t".join(str(field) for field in fields)
+        path = self.directory / name
+        kept = ()
+        if resume_step is not None and path.is_file():
+            kept = self._surviving_rows(path, header, resume_step)
+        fd = path.open("w")
+        fd.write(header)
+        for row in kept:
+            fd.write(os.linesep + row)
         fd.flush()
         self._fds[name] = fd
+        self._headers[name] = header
+
+    @staticmethod
+    def _surviving_rows(path, header, limit_step):
+        """Rows of `path` strictly below `limit_step`, dropping rows from a
+        different schema (header mismatch), torn tails (wrong field count —
+        a kill can land mid-row-write) and unparsable step numbers."""
+        try:
+            lines = path.read_text().split(os.linesep)
+        except OSError:
+            return ()
+        if not lines or lines[0] != header:
+            return ()
+        nb_fields = len(header[2:].split("\t"))
+        kept = []
+        for line in lines[1:]:
+            fields = line.split("\t")
+            if len(fields) != nb_fields:
+                continue
+            try:
+                step = int(fields[0])
+            except ValueError:
+                continue
+            if step < limit_step:
+                kept.append(line)
+        return tuple(kept)
+
+    def truncate(self, step):
+        """Rewind every open result file to rows strictly below `step`
+        (divergence rollback: the rows past the restored checkpoint belong
+        to the trajectory being abandoned)."""
+        if self.directory is None:
+            return
+        for name in list(self._fds):
+            self._fds[name].flush()
+            self._fds[name].close()
+            path = self.directory / name
+            header = self._headers[name]
+            kept = self._surviving_rows(path, header, step)
+            fd = path.open("w")
+            fd.write(header)
+            for row in kept:
+                fd.write(os.linesep + row)
+            fd.flush()
+            self._fds[name] = fd
 
     def get(self, name):
         if self.directory is None:
@@ -485,10 +598,19 @@ def main(argv=None):
         optimizer = optim.build(args.optimizer,
                                 weight_decay=args.weight_decay,
                                 **args.optimizer_args)
-        engine = build_engine(
-            cfg=cfg, model_def=model_def, loss=loss, criterion=criterion,
-            defenses=defenses, attack=attack, attack_kwargs=args.attack_args,
-            optimizer=optimizer, faults=fault_schedule)
+
+        def build_engine_with(engine_cfg):
+            """The jitted engine for a config — called once at setup and
+            again when a divergence rollback tightens the quorum (the
+            declared f is a trace-time constant, so a stricter quorum is a
+            program rebuild)."""
+            return build_engine(
+                cfg=engine_cfg, model_def=model_def, loss=loss,
+                criterion=criterion, defenses=defenses, attack=attack,
+                attack_kwargs=args.attack_args, optimizer=optimizer,
+                faults=fault_schedule)
+
+        engine = build_engine_with(cfg)
         # Multi-chip mesh: shard the step over a (workers, model) device grid
         mesh = None
         if args.mesh is not None:
@@ -548,6 +670,11 @@ def main(argv=None):
 
         # Result directory (reference `attack.py:549-591`)
         results = None
+        resume_step = None      # step an --auto-resume actually restarts at
+        restart_count = 0       # times this run was auto-resumed (manifest)
+        # Recovery columns ride the study CSV only when crash recovery is
+        # on, mirroring the FAULT_COLUMNS opt-in schema policy
+        recovery_active = args.auto_resume or args.rollback_budget > 0
         if args.result_directory is not None:
             resdir = pathlib.Path(args.result_directory).resolve()
             try:
@@ -559,15 +686,30 @@ def main(argv=None):
                 args.checkpoint_delta = 0
             else:
                 args.result_directory = resdir
+                if args.auto_resume:
+                    found = checkpoint_mod.find_latest_valid(resdir)
+                    if found is None:
+                        utils.info("Auto-resume: no valid checkpoint in "
+                                   f"{str(resdir)!r}; cold start")
+                    else:
+                        args.load_checkpoint = str(found)
+                        resume_step = checkpoint_mod.checkpoint_step(found)
+                        restart_count = checkpoint_mod.bump_restarts(resdir)
+                        utils.info(f"Auto-resume: restart #{restart_count} "
+                                   f"from {found.name} (step {resume_step})")
                 results = _ResultFiles(resdir)
                 if args.evaluation_delta > 0:
-                    results.make("eval", "Step number", "Cross-accuracy")
+                    results.make("eval", "Step number", "Cross-accuracy",
+                                 resume_step=resume_step)
                 if args.nb_for_study > 0:
                     # Resilience columns appended only under a fault plan —
                     # fault-free runs keep the reference's exact CSV schema
                     study_columns = STUDY_COLUMNS + (
                         FAULT_COLUMNS if fault_schedule is not None else ())
-                    results.make("study", *study_columns)
+                    if recovery_active:
+                        study_columns = study_columns + RECOVERY_COLUMNS
+                    results.make("study", *study_columns,
+                                 resume_step=resume_step)
                 (resdir / "config").write_text(_config_text(args) + os.linesep)
                 with (resdir / "config.json").open("w") as fd:
                     def jsonable(x):
@@ -612,40 +754,54 @@ def main(argv=None):
                             f"Checkpoint sampler state only partially or not "
                             f"restored ({err}); resumed batch order may "
                             f"differ")
+                    else:
+                        # The checkpoint carries the device PRNG state AND
+                        # the host sampler snapshots: the resume is
+                        # bit-exact, and any fixed --seed only governed the
+                        # (now superseded) initialization
+                        if args.seed >= 0:
+                            utils.info(
+                                "Seed argument superseded by the "
+                                "checkpoint's RNG and sampler state "
+                                "(bit-exact resume)")
                 else:
                     utils.warning(
                         "Checkpoint carries no sampler state; resumed batch "
-                        "order will differ from the uninterrupted run")
+                        "order (seeded or not) will differ from the "
+                        "uninterrupted run")
 
     # Compile the (possibly mesh-sharded) step programs
-    if mesh is not None:
-        from byzantinemomentum_tpu.parallel import (
-            sharded_eval_many, sharded_train_multi, sharded_train_step)
-        step_fn = sharded_train_step(engine, mesh, state)
-        multi_fn = sharded_train_multi(engine, mesh, state)
-        # Milestone evaluation shards only when the test batch divides the
-        # worker axis; otherwise it stays on the (off-hot-path) replicated
-        # program instead of failing at the first milestone
-        if args.batch_size_test % mesh.shape["workers"] == 0:
-            eval_many_fn = sharded_eval_many(engine, mesh, state)
-        else:
-            eval_many_fn = engine.eval_many
-            utils.info(
-                f"Evaluation stays unsharded: --batch-size-test "
-                f"{args.batch_size_test} does not divide the "
-                f"{mesh.shape['workers']}-way worker axis")
-        utils.info(f"Sharded over mesh {dict(mesh.shape)}")
-    elif device_gar_active:
-        from byzantinemomentum_tpu.engine.step import make_device_gar_step
-        step_fn = make_device_gar_step(engine, device_gar)
-        multi_fn = engine.train_multi  # unreachable: fusion forced to 1
-        eval_many_fn = engine.eval_many
-        utils.info(f"Defense phase placed on '{device_gar}' "
-                   f"(per-step gradient hop)")
-    else:
-        step_fn = engine.train_step
-        multi_fn = engine.train_multi
-        eval_many_fn = engine.eval_many
+    def make_step_programs(eng, st):
+        """(step_fn, multi_fn, eval_many_fn) for an engine — shared by the
+        initial compile and the rollback quorum-tightening rebuild."""
+        if mesh is not None:
+            from byzantinemomentum_tpu.parallel import (
+                sharded_eval_many, sharded_train_multi, sharded_train_step)
+            step = sharded_train_step(eng, mesh, st)
+            multi = sharded_train_multi(eng, mesh, st)
+            # Milestone evaluation shards only when the test batch divides
+            # the worker axis; otherwise it stays on the (off-hot-path)
+            # replicated program instead of failing at the first milestone
+            if args.batch_size_test % mesh.shape["workers"] == 0:
+                eval_many = sharded_eval_many(eng, mesh, st)
+            else:
+                eval_many = eng.eval_many
+                utils.info(
+                    f"Evaluation stays unsharded: --batch-size-test "
+                    f"{args.batch_size_test} does not divide the "
+                    f"{mesh.shape['workers']}-way worker axis")
+            utils.info(f"Sharded over mesh {dict(mesh.shape)}")
+            return step, multi, eval_many
+        if device_gar_active:
+            from byzantinemomentum_tpu.engine.step import make_device_gar_step
+            utils.info(f"Defense phase placed on '{device_gar}' "
+                       f"(per-step gradient hop)")
+            # multi_fn unreachable: fusion forced to 1
+            return (make_device_gar_step(eng, device_gar),
+                    eng.train_multi, eng.eval_many)
+        return eng.train_step, eng.train_multi, eng.eval_many
+
+    step_fn, multi_fn, eval_many_fn = make_step_programs(engine, state)
 
     # Opt-in profiler trace of the early steps (TPU counterpart of the
     # reference's opt-in timing scopes, reference `tools/misc.py:307-343`)
@@ -654,7 +810,12 @@ def main(argv=None):
 
     # Training (reference `attack.py:685-885`)
     with utils.Context("training", "info"):
+        # An ACTUAL auto-resume interprets --nb-steps as the TOTAL step
+        # count from step 0: a supervisor re-issues the same command line
+        # and the resumed run must stop where the uninterrupted run would
+        # have (explicit --load-checkpoint keeps the additive semantics)
         steps_limit = (None if args.nb_steps < 0
+                       else args.nb_steps if resume_step is not None
                        else int(state.steps) + args.nb_steps)
         fd_eval = results.get("eval") if results else None
         fd_study = results.get("study") if results else None
@@ -688,7 +849,7 @@ def main(argv=None):
         def flush_study():
             if not pending_study:
                 return
-            p_metrics, p_steps, p_datapoints, p_batch, p_m = \
+            p_metrics, p_steps, p_datapoints, p_batch, p_m, p_rollbacks = \
                 pending_study.pop()
             p_metrics = jax.device_get(p_metrics)
             inc = p_batch * cfg.nb_honests * cfg.nb_local_steps
@@ -705,10 +866,122 @@ def main(argv=None):
                     for column in FAULT_COLUMNS:
                         value = p_metrics[column]
                         row.append(int(value[i] if p_m > 1 else value))
+                if recovery_active:
+                    # Host-side crash-recovery counters (RECOVERY_COLUMNS):
+                    # rollbacks as of the chunk's dispatch, restarts from
+                    # the run manifest
+                    row.append(p_rollbacks)
+                    row.append(restart_count)
                 results.store(fd_study, *row)
+
+        # --- divergence rollback (`--rollback-budget`): a depth-2 pipelined
+        # health flag per dispatched chunk; a non-finite training state
+        # restores the newest valid checkpoint, truncates the result CSVs
+        # back to it, re-seeds the step RNG fold (so the retried trajectory
+        # draws differently) and optionally tightens the quorum
+        rollbacks = 0
+        diverged = False
+        pending_health = []
+        health_enabled = args.rollback_budget > 0
+
+        def tighten_quorum():
+            nonlocal engine, cfg, step_fn, multi_fn, eval_many_fn
+            new_f = cfg.nb_decl_byz + 1
+            if new_f > args.nb_workers:
+                utils.info("Quorum not tightened: f already equals n")
+                return
+            dummy = jnp.zeros((args.nb_workers, 2), jnp.float32)
+            for gar, _, kwargs in defenses:
+                message = gar.check(gradients=dummy, f=new_f, **kwargs)
+                if message is not None:
+                    utils.info(f"Quorum not tightened: {gar.name!r} cannot "
+                               f"run with f={new_f} ({message})")
+                    return
+            import dataclasses
+            cfg = dataclasses.replace(cfg, nb_decl_byz=new_f)
+            engine = build_engine_with(cfg)
+            if use_device_data:
+                engine.attach_data(train_data, test_data)
+            step_fn, multi_fn, eval_many_fn = make_step_programs(engine, state)
+            utils.warning(f"Rollback: declared Byzantine count tightened "
+                          f"to f={new_f} (step program rebuilt)")
+
+        def roll_back():
+            """Restore the last good checkpoint after a non-finite state;
+            False when the run must give up (budget spent / nothing valid
+            to restore)."""
+            nonlocal state, steps_host, datapoints_host, current_lr, \
+                just_loaded, rollbacks, fd_eval, fd_study
+            rollbacks += 1
+            if rollbacks > args.rollback_budget:
+                utils.error(f"Non-finite training state at step {steps_host} "
+                            f"and the rollback budget "
+                            f"({args.rollback_budget}) is exhausted; "
+                            f"giving up")
+                return False
+            found = checkpoint_mod.find_latest_valid(args.result_directory)
+            if found is None:
+                utils.error("Non-finite training state and no valid "
+                            "checkpoint to roll back to; giving up")
+                return False
+            try:
+                restored, data_state = checkpoint_mod.load(
+                    found, state, return_data=True)
+            except Exception as err:
+                utils.error(f"Rollback reload of {found.name} failed "
+                            f"({err}); giving up")
+                return False
+            if data_state is not None:
+                try:
+                    trainset.set_state(data_state["train"])
+                    testset.set_state(data_state["test"])
+                except Exception as err:
+                    utils.warning(f"Rollback sampler state only partially "
+                                  f"restored ({err})")
+            # Re-seed the step RNG fold: replaying the exact trajectory
+            # that just diverged would diverge again
+            state = restored._replace(
+                rng=jax.random.fold_in(restored.rng, 0x5EED + rollbacks))
+            pending_study.clear()
+            pending_sync.clear()
+            pending_health.clear()
+            steps_host = int(state.steps)
+            datapoints_host = int(state.datapoints)
+            current_lr = args.initial_lr(steps_host)
+            just_loaded = True
+            if results is not None:
+                # truncate() reopens the files — refresh the loop's handles
+                results.truncate(steps_host)
+                fd_eval = results.get("eval")
+                fd_study = results.get("study")
+            utils.warning(f"Rollback #{rollbacks}/{args.rollback_budget}: "
+                          f"non-finite training state; restored "
+                          f"{found.name} (step {steps_host})")
+            if args.rollback_tighten_quorum:
+                tighten_quorum()
+            return True
+
+        # Chaos-test instrumentation (`tests/test_chaos.py`): die the hard
+        # way at a step (preemption stand-in), or poison the parameters to
+        # exercise the rollback path deterministically
+        chaos_kill = os.environ.get("BMT_CHAOS_KILL_AT_STEP")
+        chaos_kill = int(chaos_kill) if chaos_kill else None
+        chaos_nan = os.environ.get("BMT_CHAOS_NAN_AT_STEP")
+        chaos_nan = int(chaos_nan) if chaos_nan else None
+        chaos_nan_repeat = os.environ.get("BMT_CHAOS_NAN_REPEAT") == "1"
 
         try:
             while not exit_is_requested():
+                if chaos_kill is not None and steps_host >= chaos_kill:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                # Health verdict of the previous chunk, BEFORE any milestone
+                # can evaluate/checkpoint (never snapshots a poisoned state)
+                if pending_health:
+                    if not bool(np.asarray(pending_health.pop())):
+                        if not roll_back():
+                            diverged = True
+                            break
+                        continue
                 steps = steps_host
                 milestone_evaluation = (args.evaluation_delta > 0
                                         and steps % args.evaluation_delta == 0)
@@ -751,7 +1024,8 @@ def main(argv=None):
                     filename = args.result_directory / f"checkpoint-{steps}"
                     try:
                         checkpoint_mod.save(filename, state,
-                                            data_state=data_snapshot)
+                                            data_state=data_snapshot,
+                                            keep=args.keep_checkpoints or None)
                     except Exception as err:
                         utils.warning(f"Checkpoint save failed: {err}")
                 just_loaded = False
@@ -822,12 +1096,27 @@ def main(argv=None):
                             jnp.asarray(lrs, jnp.float32))
                 steps_host += M
                 datapoints_host += M * batch * cfg.nb_honests * k
+                if chaos_nan is not None and steps_host > chaos_nan:
+                    # Poison the freshly dispatched state (chaos hook): the
+                    # health flag below must flip and trigger the rollback
+                    if not chaos_nan_repeat:
+                        chaos_nan = None
+                    state = state._replace(theta=state.theta * jnp.asarray(
+                        jnp.nan, state.theta.dtype))
+                if health_enabled:
+                    # max|theta| is +inf/NaN iff any coordinate is — a tiny
+                    # derived scalar whose transfer rides the depth-2
+                    # pipeline (checked at the NEXT loop top), so the
+                    # divergence watchdog never stalls dispatch
+                    pending_health.append(
+                        jnp.isfinite(jnp.max(jnp.abs(state.theta))))
                 if fd_study is not None:
                     # Transfer the PREVIOUS chunk's metrics now that this one
                     # is enqueued (its rows were buffered on device), then
                     # buffer this chunk's
                     flush_study()
-                    pending_study.append((metrics, steps, datapoints, batch, M))
+                    pending_study.append(
+                        (metrics, steps, datapoints, batch, M, rollbacks))
                 else:
                     # No study file: the metrics transfer above would have
                     # throttled dispatch; transfer the previous chunk's tiny
@@ -850,6 +1139,11 @@ def main(argv=None):
                 results.close()
     if args.trace_dir is not None:
         jax.profiler.stop_trace()
+    # A diverged run that spent its rollback budget is a failure: the Jobs
+    # supervisor retries it (resuming from the last good checkpoint with a
+    # fresh budget) instead of marking the directory done
+    if diverged:
+        return 1
     # A bounded run cut short by SIGINT/SIGTERM must not look successful:
     # the Jobs scheduler treats exit 0 as "complete" and would permanently
     # mark a truncated result directory as done (`utils/jobs.py`). Unlimited

@@ -13,7 +13,8 @@ division of labor with the device.
 from byzantinemomentum_tpu.engine.config import EngineConfig
 from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.engine.step import Engine, build_engine
-from byzantinemomentum_tpu.engine.metrics import FAULT_COLUMNS, STUDY_COLUMNS
+from byzantinemomentum_tpu.engine.metrics import (
+    FAULT_COLUMNS, RECOVERY_COLUMNS, STUDY_COLUMNS)
 
 __all__ = ["EngineConfig", "TrainState", "Engine", "build_engine",
-           "FAULT_COLUMNS", "STUDY_COLUMNS"]
+           "FAULT_COLUMNS", "RECOVERY_COLUMNS", "STUDY_COLUMNS"]

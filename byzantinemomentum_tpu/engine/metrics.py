@@ -19,7 +19,8 @@ f32 scalars; the host merely formats them (`%.8e`, reference
 
 import jax.numpy as jnp
 
-__all__ = ["STUDY_COLUMNS", "FAULT_COLUMNS", "avg_dev_max", "cosine",
+__all__ = ["STUDY_COLUMNS", "FAULT_COLUMNS", "RECOVERY_COLUMNS",
+           "avg_dev_max", "cosine",
            "study_metrics", "push_past"]
 
 # CSV header, byte-identical to the reference's (reference `attack.py:564-571`)
@@ -42,6 +43,14 @@ STUDY_COLUMNS = (
 # out of STUDY_COLUMNS so fault-free runs stay byte-identical to the
 # reference's CSV schema.
 FAULT_COLUMNS = ("Faults injected", "Workers active", "Quorum f")
+
+# Crash-recovery columns, appended when the driver runs with crash recovery
+# enabled (`--auto-resume` or a `--rollback-budget`): divergence rollbacks
+# performed by this process, and how many times the run was auto-resumed
+# after a kill (persisted in the run's checkpoint manifest). Host-side
+# counters — not in-graph metrics — and, like FAULT_COLUMNS, kept out of
+# STUDY_COLUMNS so default runs keep the reference's exact CSV schema.
+RECOVERY_COLUMNS = ("Rollbacks", "Restarts")
 
 # NaN as a Python float: creating a device array at import time would
 # initialize the JAX backend before the CLI's --device platform selection

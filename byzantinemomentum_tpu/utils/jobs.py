@@ -1,5 +1,6 @@
-"""Experiment scheduler: a thread pool dispatching driver subprocesses over
-devices (reference `tools/jobs.py:27-248`).
+"""Experiment supervisor: a thread pool dispatching driver subprocesses over
+devices (reference `tools/jobs.py:27-248`), hardened for preemptible
+machines.
 
 Capability parity:
 * one worker thread per (device × supercharge) slot
@@ -9,9 +10,27 @@ Capability parity:
 * idempotency — a job whose final result directory already exists is
   skipped, so interrupted grids resume for free (reference `jobs.py:126-129`);
 * failure containment — a failed run's pending directory is renamed
-  `<name>.failed` and preserved for inspection (reference `jobs.py:140-144`);
+  `<name>.failed` and preserved for inspection (reference `jobs.py:140-144`).
 * per-seed expansion with the reference's default seeds 1..5
   (reference `jobs.py:169`).
+
+Beyond the reference (PR 2 — the reference gives a crashed run exactly one
+attempt before parking it in `.failed` forever):
+
+* **retry with backoff** — a failed attempt is retried in-place up to
+  `max_retries` times with exponential backoff, in the SAME pending
+  directory, so the driver's `--auto-resume` (appended to every dispatched
+  command via `resume_flag`) continues from the attempt's newest valid
+  checkpoint instead of cold-starting;
+* **adoption** — a stale `.pending` (a previous scheduler was killed) or a
+  previous `.failed` directory holding a valid checkpoint is adopted as the
+  new pending directory and resumed, rather than rotated away/ignored;
+* **heartbeat watchdog** — with `heartbeat_timeout`, a subprocess whose
+  study CSV stops advancing for that long is SIGKILLed and retried (hung
+  collective, wedged remote device, ...);
+* the `.pending`/`.failed` version rotation is race-free under concurrent
+  worker threads (the rename itself is the existence test, serialized by a
+  per-results-dir lock).
 
 On TPU, "devices" are whole accelerator slices/processes rather than the
 reference's per-GPU `--device cuda:N`: each slot exports its device string
@@ -19,10 +38,12 @@ through the `BMT_JOB_DEVICE` environment variable and passes it to the
 driver's `--device` flag.
 """
 
+import os
 import pathlib
 import queue
 import subprocess
 import threading
+import time
 
 from byzantinemomentum_tpu.utils import logging as _log
 
@@ -50,21 +71,42 @@ def dict_to_cmdlist(options):
 
 
 class Jobs:
-    """Thread-pool scheduler of driver subprocesses."""
+    """Thread-pool supervisor of driver subprocesses."""
 
     def __init__(self, results_dir, devices=("auto",), supercharge=1,
-                 seeds=DEFAULT_SEEDS):
+                 seeds=DEFAULT_SEEDS, max_retries=1, retry_backoff=1.0,
+                 heartbeat_timeout=None, resume_flag="--auto-resume"):
         """Args mirror the reference's (`tools/jobs.py:107-124`,
         `--supercharge` from `reproduce.py:62-65`): one worker per device
-        repeated `supercharge` times."""
+        repeated `supercharge` times.
+
+        Supervisor knobs:
+          max_retries: extra attempts a failing run gets (0 = the
+            reference's single-shot behavior); attempt k waits
+            `retry_backoff * 2**(k-1)` seconds first.
+          heartbeat_timeout: seconds without the run's study CSV advancing
+            before the subprocess is killed and the attempt counted failed
+            (None disables the watchdog).
+          resume_flag: appended to every dispatched command so retried or
+            adopted runs continue from their newest valid checkpoint (the
+            driver's `--auto-resume`); None disables both the flag and the
+            checkpoint-based adoption of stale directories.
+        """
         if supercharge < 1:
             raise ValueError(f"Expected a positive supercharge, got {supercharge}")
+        if max_retries < 0:
+            raise ValueError(f"Expected a non-negative retry count, got {max_retries}")
         self.results_dir = pathlib.Path(results_dir)
         self.results_dir.mkdir(parents=True, exist_ok=True)
         self.seeds = tuple(seeds)
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self.heartbeat_timeout = heartbeat_timeout
+        self.resume_flag = resume_flag
         self._queue = queue.Queue()
         self._threads = []
         self._started = False
+        self._rotate_lock = threading.Lock()
         self._devices = tuple(devices) * supercharge
 
     def submit(self, name, command):
@@ -74,48 +116,144 @@ class Jobs:
         for seed in self.seeds:
             self._queue.put((f"{name}-{seed}", seed, list(command)))
 
+    # ------------------------------------------------------------------ #
+    # Crash-recovery helpers
+
+    @staticmethod
+    def _has_valid_checkpoint(directory):
+        """Whether `directory` holds a checkpoint a retry can resume from
+        (never raises: the supervisor must not die on a mangled dir)."""
+        try:
+            from byzantinemomentum_tpu import checkpoint
+            return checkpoint.find_latest_valid(directory) is not None
+        except Exception:
+            return False
+
+    def _rotate_away(self, path):
+        """Version-rotate `path` out of the way (`<name>.0`, `<name>.1`, …)
+        race-free under concurrent workers: the rename itself is the
+        existence test — renaming onto a non-empty directory fails — and
+        the scan-and-rename is serialized by the per-results-dir lock
+        (the previous exists-then-rename could race two threads onto the
+        same version)."""
+        with self._rotate_lock:
+            version = 0
+            while True:
+                target = path.with_name(f"{path.name}.{version}")
+                try:
+                    path.rename(target)
+                    return target
+                except OSError:
+                    if not target.exists():
+                        raise
+                    version += 1
+
+    def _prepare_pending(self, run_name):
+        """The pending directory one run's attempts all share — adopting a
+        resumable previous attempt (stale `.pending` from a killed
+        scheduler, or `.failed` from an exhausted one) when possible."""
+        pending = self.results_dir / f"{run_name}.pending"
+        failed = self.results_dir / f"{run_name}.failed"
+        if pending.exists():
+            if self.resume_flag and self._has_valid_checkpoint(pending):
+                _log.info(f"{run_name}: adopting stale pending directory "
+                          f"(valid checkpoint found; resuming)")
+                return pending
+            # Rotate a non-resumable stale pending dir out of the way
+            self._rotate_away(pending)
+        elif (failed.exists() and self.resume_flag
+                and self._has_valid_checkpoint(failed)):
+            failed.rename(pending)
+            _log.info(f"{run_name}: adopting previous failed attempt "
+                      f"(valid checkpoint found; resuming)")
+            return pending
+        pending.mkdir(parents=True)
+        return pending
+
+    # ------------------------------------------------------------------ #
+    # One run = up to 1 + max_retries attempts over one pending directory
+
     def _run_one(self, slot_device, run_name, seed, command):
         final_dir = self.results_dir / run_name
         if final_dir.exists():
             _log.trace(f"{run_name}: already done, skipping")
             return
-        pending = self.results_dir / f"{run_name}.pending"
-        if pending.exists():
-            # Rotate a stale pending dir out of the way
-            # (reference `tools/jobs.py:27-46` version rotation)
-            version = 0
-            while (self.results_dir / f"{run_name}.pending.{version}").exists():
-                version += 1
-            pending.rename(self.results_dir / f"{run_name}.pending.{version}")
-        pending.mkdir(parents=True)
+        pending = self._prepare_pending(run_name)
         cmd = command + ["--seed", str(seed),
                          "--device", slot_device,
                          "--result-directory", str(pending)]
+        if self.resume_flag and self.resume_flag not in cmd:
+            # Retries/adoptions resume from the pending dir's newest valid
+            # checkpoint; on a fresh dir the flag is a no-op cold start
+            cmd = cmd + [self.resume_flag]
         _log.info(f"{run_name}: starting on {slot_device!r}")
-        with (pending / "stdout.log").open("wb") as out, \
-                (pending / "stderr.log").open("wb") as err:
-            result = subprocess.run(cmd, stdout=out, stderr=err,
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                delay = self.retry_backoff * (2 ** (attempt - 1))
+                resumes = self._has_valid_checkpoint(pending)
+                _log.info(f"{run_name}: retry {attempt}/{self.max_retries} "
+                          f"in {delay:.1f}s"
+                          + (" (resuming from checkpoint)" if resumes
+                             else " (cold start)"))
+                time.sleep(delay)
+            returncode = self._spawn(run_name, pending, cmd, slot_device)
+            if returncode == 0:
+                pending.rename(final_dir)
+                _log.success(f"{run_name}: done")
+                return
+            _log.error(f"{run_name}: attempt {attempt + 1} failed with "
+                       f"code {returncode}")
+        failed = self.results_dir / f"{run_name}.failed"
+        if failed.exists():
+            # Rotate the previous failure out of the way (os.rename
+            # cannot replace a non-empty directory)
+            self._rotate_away(failed)
+        pending.rename(failed)
+        _log.error(f"{run_name}: failed after {self.max_retries + 1} "
+                   f"attempt(s) (logs kept in {run_name}.failed)")
+
+    def _spawn(self, run_name, pending, cmd, slot_device):
+        """Launch one attempt; with a heartbeat timeout, watchdog the study
+        CSV and SIGKILL the subprocess when it stalls. Logs are opened in
+        append mode so every attempt's output is preserved."""
+        with (pending / "stdout.log").open("ab") as out, \
+                (pending / "stderr.log").open("ab") as err:
+            proc = subprocess.Popen(cmd, stdout=out, stderr=err,
                                     env=self._env(slot_device))
-        if result.returncode == 0:
-            pending.rename(final_dir)
-            _log.success(f"{run_name}: done")
-        else:
-            failed = self.results_dir / f"{run_name}.failed"
-            if failed.exists():
-                # Rotate the previous failure out of the way (os.rename
-                # cannot replace a non-empty directory)
-                version = 0
-                while (self.results_dir
-                       / f"{run_name}.failed.{version}").exists():
-                    version += 1
-                failed.rename(self.results_dir / f"{run_name}.failed.{version}")
-            pending.rename(failed)
-            _log.error(f"{run_name}: failed with code {result.returncode} "
-                       f"(logs kept in {run_name}.failed)")
+            if self.heartbeat_timeout is None:
+                return proc.wait()
+            study = pending / "study"
+            poll = max(0.05, min(0.5, self.heartbeat_timeout / 4))
+            last_beat = time.monotonic()
+            last_sig = self._heartbeat(study)
+            while True:
+                try:
+                    return proc.wait(timeout=poll)
+                except subprocess.TimeoutExpired:
+                    pass
+                sig = self._heartbeat(study)
+                now = time.monotonic()
+                if sig != last_sig:
+                    last_sig, last_beat = sig, now
+                elif now - last_beat > self.heartbeat_timeout:
+                    _log.error(f"{run_name}: heartbeat lost (study CSV "
+                               f"stalled > {self.heartbeat_timeout}s); "
+                               f"killing the subprocess")
+                    proc.kill()
+                    return proc.wait()
+
+    @staticmethod
+    def _heartbeat(study):
+        """Progress signature of the run's study CSV (None before the
+        driver creates it — process start then counts as the last beat)."""
+        try:
+            stat = study.stat()
+            return (stat.st_size, stat.st_mtime_ns)
+        except OSError:
+            return None
 
     @staticmethod
     def _env(device):
-        import os
         env = dict(os.environ)
         env["BMT_JOB_DEVICE"] = device
         return env

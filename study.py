@@ -20,7 +20,8 @@ import pandas
 
 from byzantinemomentum_tpu import models, ops, utils
 
-__all__ = ["Session", "LinePlot", "BoxPlot", "display", "select", "discard"]
+__all__ = ["Session", "LinePlot", "BoxPlot", "display", "select", "discard",
+           "fault_timeline", "fault_rate_sweep"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
 TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000, "kmnist": 60000,
@@ -223,6 +224,77 @@ def discard(data, *only_columns):
             if only_column in column.lower():
                 del data[column]
     return data
+
+
+# --------------------------------------------------------------------------- #
+# Fault-resilience analysis (ROADMAP open item: sweep plots off the
+# `Faults injected` / `Workers active` / `Quorum f` columns the study CSV
+# gains under `--fault-plan`). Stubs of the multi-host chaos dashboards:
+# one run's degradation timeline, and the cross-run fault-rate sweep.
+
+def _as_frame(data):
+    return data.data if isinstance(data, Session) else data
+
+
+def fault_timeline(session):
+    """LinePlot of one faulted run's resilience counters over steps:
+    `Workers active` on the left axis against `Faults injected` on the
+    right — the shape of the run's degradation under its fault plan."""
+    data = _as_frame(session)
+    missing = [c for c in ("Faults injected", "Workers active")
+               if c not in data.columns]
+    if missing:
+        raise utils.UserException(
+            f"No fault columns {missing} in the study data; the run must "
+            f"be recorded with --fault-plan")
+    sub = data.dropna(subset=["Workers active"])
+    plot = LinePlot()
+    plot.include(sub, "Workers active")
+    plot.include(sub, "Faults injected")
+    plot.finalize("Fault timeline", "Step number", "Workers active",
+                  zlabel="Faults injected")
+    return plot
+
+
+def fault_rate_sweep(sessions, metric="Average loss", reducer="last"):
+    """One point per run: the observed fault rate (mean `Faults injected`
+    per recorded step; 0 for fault-free baselines) against the run's final
+    (`reducer="last"`) or mean (`reducer="mean"`) `metric` value.
+
+    `sessions`: an iterable of `Session`s (or raw DataFrames). Returns
+    `(frame, plot)` — the rate-indexed DataFrame and a ready LinePlot —
+    so grids can be compared without re-deriving the reduction.
+    """
+    if reducer not in ("last", "mean"):
+        raise utils.UserException(
+            f"Unknown reducer {reducer!r}, expected 'last' or 'mean'")
+    points = []
+    for session in sessions:
+        data = _as_frame(session)
+        if metric not in data.columns:
+            utils.warning(f"{session}: no {metric!r} column; skipped")
+            continue
+        series = data[metric].dropna()
+        if not len(series):
+            utils.warning(f"{session}: no {metric!r} values; skipped")
+            continue
+        rate = 0.0
+        if "Faults injected" in data.columns:
+            faults = data["Faults injected"].dropna()
+            if len(faults):
+                rate = float(faults.mean())
+        value = float(series.iloc[-1]) if reducer == "last" \
+            else float(series.mean())
+        points.append((rate, value))
+    points.sort(key=lambda p: p[0])
+    frame = pandas.DataFrame(
+        {metric: [v for _, v in points]},
+        index=pandas.Index([r for r, _ in points], name="Fault rate"))
+    plot = LinePlot()
+    plot.include(frame, metric)
+    plot.finalize(f"{metric} vs fault rate", "Faults injected per step",
+                  metric)
+    return frame, plot
 
 
 # --------------------------------------------------------------------------- #

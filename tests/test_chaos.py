@@ -1,0 +1,263 @@
+"""Chaos E2E: SIGKILL a live training run (including mid-checkpoint-write),
+auto-resume it, and require the concatenated study/eval CSVs to be
+bit-identical to an uninterrupted run — closing the reference's documented
+"resumed runs are not reproducible" limitation (reference `README.md:105`)
+end to end. Plus the in-process divergence-rollback loop
+(`--rollback-budget`): non-finite state detection, restore from the last
+good checkpoint, CSV truncation, budget exhaustion."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import checkpoint
+from byzantinemomentum_tpu.cli.attack import main
+from byzantinemomentum_tpu.engine import RECOVERY_COLUMNS, STUDY_COLUMNS
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+BASE = ["--seed", "11", "--model", "simples-full",
+        "--batch-size", "8", "--batch-size-test", "32",
+        "--batch-size-test-reps", "2", "--evaluation-delta", "2",
+        "--checkpoint-delta", "2", "--nb-for-study", "11",
+        "--nb-for-study-past", "2", "--gar", "median", "--attack", "empire",
+        "--attack-args", "factor:1.1", "--nb-real-byz", "4",
+        "--nb-steps", "8", "--auto-resume"]
+
+
+@pytest.fixture(autouse=True)
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+def _rows(path):
+    return [line for line in path.read_text().split(os.linesep)[1:] if line]
+
+
+def _strip_recovery(rows):
+    """Drop the RECOVERY_COLUMNS tail: the Restarts counter legitimately
+    differs between an interrupted and an uninterrupted run — everything
+    else must match bit-for-bit."""
+    return [row.rsplit("\t", len(RECOVERY_COLUMNS))[0] for row in rows]
+
+
+# --------------------------------------------------------------------------- #
+# Subprocess chaos: real SIGKILL semantics (cannot run in-process)
+
+def _spawn(resdir, **extra_env):
+    """One driver subprocess (`--device cpu`: the subprocess does not
+    inherit conftest's in-process platform pin)."""
+    env = dict(os.environ)
+    env.update(BMT_SYNTH_TRAIN="512", BMT_SYNTH_TEST="128",
+               JAX_PLATFORMS="cpu")
+    env.update({key: str(value) for key, value in extra_env.items()})
+    cmd = ([sys.executable, str(ROOT / "attack.py"), "--device", "cpu"]
+           + BASE + ["--result-directory", str(resdir)])
+    return subprocess.run(cmd, env=env, cwd=str(ROOT), capture_output=True)
+
+
+@pytest.mark.slow
+def test_sigkill_autoresume_is_bit_identical(tmp_path):
+    """Kill a run mid-training (SIGKILL — no cleanup, no flush), corrupt
+    the newest surviving checkpoint for good measure, auto-resume with the
+    SAME command line: the concatenated study/eval output must equal an
+    uninterrupted run's, bit for bit (modulo the Restarts counter)."""
+    full = tmp_path / "full"
+    proc = _spawn(full)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+    part = tmp_path / "part"
+    proc = _spawn(part, BMT_CHAOS_KILL_AT_STEP=5)
+    assert proc.returncode != 0  # died by SIGKILL
+    newest = checkpoint.find_latest_valid(part)
+    assert newest is not None  # the torn run left checkpoints behind
+    # Corrupt the newest valid checkpoint: resume must walk past it to the
+    # previous one, not crash on it
+    raw = newest.read_bytes()
+    newest.write_bytes(raw[:len(raw) // 2])
+    survivor = checkpoint.find_latest_valid(part)
+    assert survivor is not None and survivor.name != newest.name
+
+    proc = _spawn(part)
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert _rows(part / "eval") == _rows(full / "eval")
+    part_rows = _rows(part / "study")
+    assert _strip_recovery(part_rows) == _strip_recovery(_rows(full / "study"))
+    # Rows before the resume keep Restarts=0, rows after carry the bump
+    restarts = [row.split("\t")[-1] for row in part_rows]
+    assert restarts[0] == "0" and restarts[-1] == "1"
+    assert set(restarts) == {"0", "1"}
+
+
+@pytest.mark.slow
+def test_sigkill_mid_checkpoint_write_is_bit_identical(tmp_path):
+    """Die IN THE MIDDLE of a checkpoint write (half the bytes flushed to
+    the tmp file): the atomic-rename protocol must leave only intact
+    checkpoints under final names, and the resumed output must still be
+    bit-identical to the uninterrupted run's."""
+    full = tmp_path / "full"
+    proc = _spawn(full)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+    part = tmp_path / "part"
+    proc = _spawn(part, BMT_CHAOS_TORN_CHECKPOINT_STEP=6)
+    assert proc.returncode != 0
+    # The torn write stayed under the .tmp name; final names all verify
+    assert (part / "checkpoint-6.tmp").is_file()
+    assert not (part / "checkpoint-6").exists()
+    assert checkpoint.find_latest_valid(part).name == "checkpoint-4"
+
+    proc = _spawn(part)
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert _rows(part / "eval") == _rows(full / "eval")
+    assert (_strip_recovery(_rows(part / "study"))
+            == _strip_recovery(_rows(full / "study")))
+
+
+@pytest.mark.slow
+def test_jobs_supervisor_resumes_killed_run(tmp_path):
+    """The acceptance loop end to end: `Jobs` dispatches a run that gets
+    SIGKILLed mid-training, retries it with backoff, and the retry resumes
+    from the pending dir's newest valid checkpoint — the final directory
+    holds one contiguous bit-exact trajectory."""
+    from byzantinemomentum_tpu.utils.jobs import Jobs
+
+    full = tmp_path / "full"
+    proc = _spawn(full)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+    grid = tmp_path / "grid"
+    env_backup = os.environ.get("BMT_CHAOS_KILL_AT_STEP")
+    # The kill hook must only fire on the FIRST attempt: arm it through a
+    # file the subprocess consumes (env would re-kill every retry)
+    os.environ["BMT_CHAOS_KILL_AT_STEP"] = ""
+    try:
+        script = (
+            "import os, pathlib, runpy, sys\n"
+            "fuse = pathlib.Path(sys.argv[sys.argv.index("
+            "'--result-directory') + 1]).parent / 'fuse'\n"
+            "if not fuse.exists():\n"
+            "    fuse.write_text('blown')\n"
+            "    os.environ['BMT_CHAOS_KILL_AT_STEP'] = '5'\n"
+            "else:\n"
+            "    os.environ.pop('BMT_CHAOS_KILL_AT_STEP', None)\n"
+            "sys.argv = ['attack.py'] + sys.argv[1:]\n"
+            f"sys.path.insert(0, {str(ROOT)!r})\n"
+            f"runpy.run_path({str(ROOT / 'attack.py')!r}, "
+            "run_name='__main__')\n")
+        command = [sys.executable, "-c", script, "--device", "cpu"] + BASE[:-1]
+        # BASE[:-1] drops --auto-resume: the supervisor appends it itself
+        assert command[-1] != "--auto-resume"
+        jobs = Jobs(grid, seeds=(11,), max_retries=1, retry_backoff=0)
+        # The driver overrides --seed via BASE's "--seed 11"; the Jobs seed
+        # suffix only names the run directory
+        env = dict(BMT_SYNTH_TRAIN="512", BMT_SYNTH_TEST="128",
+                   JAX_PLATFORMS="cpu")
+        for key, value in env.items():
+            os.environ[key] = value
+        jobs.submit("cell", command)
+        jobs.wait()
+    finally:
+        if env_backup is None:
+            os.environ.pop("BMT_CHAOS_KILL_AT_STEP", None)
+        else:
+            os.environ["BMT_CHAOS_KILL_AT_STEP"] = env_backup
+    done = grid / "cell-11"
+    assert done.is_dir(), list(grid.iterdir())
+    assert (grid / "fuse").exists()  # first attempt really was killed
+    assert _rows(done / "eval") == _rows(full / "eval")
+    assert (_strip_recovery(_rows(done / "study"))
+            == _strip_recovery(_rows(full / "study")))
+
+
+# --------------------------------------------------------------------------- #
+# In-process divergence rollback (`--rollback-budget`)
+
+ROLL_BASE = ["--nb-steps", "6", "--batch-size", "8",
+             "--batch-size-test", "32", "--batch-size-test-reps", "2",
+             "--evaluation-delta", "2", "--checkpoint-delta", "2",
+             "--model", "simples-full", "--seed", "11", "--gar", "median",
+             "--nb-for-study", "11", "--nb-for-study-past", "2"]
+
+
+def test_divergence_rollback_recovers(tmp_path, monkeypatch):
+    """Parameters poisoned to NaN mid-run (chaos hook): the watchdog rolls
+    back to the last good checkpoint, truncates the CSVs, and the run
+    completes with one contiguous, finite trajectory; the Rollbacks column
+    records the event."""
+    monkeypatch.setenv("BMT_CHAOS_NAN_AT_STEP", "3")
+    resdir = tmp_path / "roll"
+    rc = main(ROLL_BASE + ["--rollback-budget", "2",
+                           "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = _rows(resdir / "study")
+    header = (resdir / "study").read_text().split(os.linesep)[0]
+    assert header == "# " + "\t".join(STUDY_COLUMNS + RECOVERY_COLUMNS)
+    # One contiguous duplicate-free trajectory with finite losses
+    assert [row.split("\t")[0] for row in rows] == [str(i) for i in range(6)]
+    assert all(np.isfinite(float(row.split("\t")[2])) for row in rows)
+    rollbacks = [row.split("\t")[-2] for row in rows]
+    assert rollbacks[0] == "0" and rollbacks[-1] == "1"
+
+
+def test_divergence_rollback_tighten_quorum(tmp_path, monkeypatch):
+    """The optional quorum tightening: the rebuild path (f+1, recompiled
+    step program) completes the run after a rollback."""
+    monkeypatch.setenv("BMT_CHAOS_NAN_AT_STEP", "3")
+    resdir = tmp_path / "tight"
+    rc = main(ROLL_BASE + ["--rollback-budget", "2",
+                           "--rollback-tighten-quorum",
+                           "--result-directory", str(resdir)])
+    assert rc == 0
+    rows = _rows(resdir / "study")
+    assert [row.split("\t")[0] for row in rows] == [str(i) for i in range(6)]
+    assert all(np.isfinite(float(row.split("\t")[2])) for row in rows)
+
+
+def test_rollback_budget_exhaustion_fails_the_run(tmp_path, monkeypatch):
+    """A run that re-diverges after every rollback gives up once the budget
+    is spent, with a FAILING exit code (so a supervisor retries it) — it
+    must not spin forever or exit 0 with garbage."""
+    monkeypatch.setenv("BMT_CHAOS_NAN_AT_STEP", "1")
+    monkeypatch.setenv("BMT_CHAOS_NAN_REPEAT", "1")
+    rc = main(ROLL_BASE + ["--rollback-budget", "1",
+                           "--result-directory", str(tmp_path / "doom")])
+    assert rc == 1
+
+
+def test_rollback_budget_requires_checkpoints():
+    from byzantinemomentum_tpu.cli.attack import (
+        _postprocess, process_commandline)
+    args = _postprocess(process_commandline(
+        ["--rollback-budget", "2", "--nb-steps", "1"]))
+    assert args.rollback_budget == 0  # warned + disabled, not fatal
+
+
+def test_auto_resume_flag_validation(tmp_path):
+    from byzantinemomentum_tpu import utils
+    with pytest.raises(utils.UserException, match="auto-resume"):
+        main(["--auto-resume", "--nb-steps", "1"])
+    with pytest.raises(utils.UserException, match="mutually exclusive"):
+        main(["--auto-resume", "--load-checkpoint", "x", "--nb-steps", "1",
+              "--result-directory", str(tmp_path / "r")])
+
+
+def test_auto_resume_completed_run_is_idempotent(tmp_path):
+    """Re-issuing the same command line over a COMPLETED run resumes at the
+    final checkpoint, re-runs only the final milestone, and leaves every
+    result file byte-identical — the supervisor can always re-dispatch."""
+    resdir = tmp_path / "run"
+    argv = ROLL_BASE + ["--nb-steps", "4", "--auto-resume",
+                        "--result-directory", str(resdir)]
+    assert main(argv) == 0
+    before = {name: (resdir / name).read_bytes()
+              for name in ("study", "eval")}
+    assert main(argv) == 0
+    after = {name: (resdir / name).read_bytes()
+             for name in ("study", "eval")}
+    assert after == before

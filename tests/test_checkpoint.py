@@ -1,0 +1,217 @@
+"""Checkpoint durability tests: atomic writes with the CRC integrity
+footer, `find_latest_valid` walking past torn/corrupt tails, the retention
+GC + restart-counter manifest, and every `load` validation branch (version
+mismatch, missing field, shape mismatch, negative counters) plus the
+`fault_buffer` cold-start path — none of which were exercised before."""
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import serialization
+
+from byzantinemomentum_tpu import checkpoint, utils
+from byzantinemomentum_tpu.engine.state import TrainState
+
+
+def tiny_state(d=4, steps=0, fault_rows=0, past=2):
+    """A hand-built TrainState small enough to checkpoint in microseconds."""
+    return TrainState(
+        theta=jnp.arange(d, dtype=jnp.float32),
+        net_state={"bn": {"mean": jnp.zeros((2,), jnp.float32)}},
+        opt_state=(),
+        momentum_server=jnp.zeros((d,), jnp.float32),
+        momentum_workers=jnp.zeros((0, d), jnp.float32),
+        origin=jnp.zeros((d,), jnp.float32),
+        past_grads=jnp.zeros((past, d), jnp.float32),
+        past_norms=jnp.zeros((past,), jnp.float32),
+        past_count=jnp.int32(0),
+        steps=jnp.int32(steps),
+        datapoints=jnp.int32(steps * 10),
+        rng=jax.random.PRNGKey(7),
+        fault_buffer=jnp.zeros((fault_rows, d), jnp.float32),
+    )
+
+
+def write_mutated(path, state, mutate, seal=True):
+    """Serialize `state` the way `save` does, apply `mutate` to the payload
+    dict, and write it (with or without the integrity footer)."""
+    state = jax.device_get(state)
+    payload = {"version": checkpoint.VERSION,
+               "state": {name: serialization.to_state_dict(value)
+                         for name, value in state._asdict().items()}}
+    mutate(payload)
+    data = serialization.msgpack_serialize(payload)
+    if seal:
+        data = checkpoint.seal(data)
+    pathlib.Path(path).write_bytes(data)
+    return pathlib.Path(path)
+
+
+# --------------------------------------------------------------------------- #
+# Round trip, footer, atomicity artifacts
+
+
+def test_roundtrip_footer_and_no_tmp_left(tmp_path):
+    state = tiny_state(steps=3)
+    path = checkpoint.save(tmp_path / "checkpoint-3", state,
+                           data_state={"train": {"pos": 1}, "test": {"pos": 2}})
+    raw = path.read_bytes()
+    assert raw[-8:-4] == checkpoint.MAGIC
+    assert not list(tmp_path.glob("*.tmp"))  # atomic: tmp renamed away
+    loaded, data = checkpoint.load(path, tiny_state(), return_data=True)
+    assert int(loaded.steps) == 3
+    np.testing.assert_array_equal(np.asarray(loaded.theta),
+                                  np.asarray(state.theta))
+    np.testing.assert_array_equal(np.asarray(loaded.rng),
+                                  np.asarray(state.rng))
+    assert data == {"train": {"pos": 1}, "test": {"pos": 2}}
+    assert checkpoint.verify(path)
+
+
+def test_legacy_footerless_checkpoint_still_loads(tmp_path):
+    path = write_mutated(tmp_path / "checkpoint-0", tiny_state(),
+                         lambda p: None, seal=False)
+    assert checkpoint.verify(path)
+    loaded = checkpoint.load(path, tiny_state())
+    assert int(loaded.steps) == 0
+
+
+# --------------------------------------------------------------------------- #
+# load() validation branches
+
+
+def test_load_version_mismatch(tmp_path):
+    def bump(payload):
+        payload["version"] = checkpoint.VERSION + 1
+    path = write_mutated(tmp_path / "checkpoint-0", tiny_state(), bump)
+    with pytest.raises(utils.UserException, match="version"):
+        checkpoint.load(path, tiny_state())
+
+
+def test_load_missing_state_payload(tmp_path):
+    def drop(payload):
+        del payload["state"]
+    path = write_mutated(tmp_path / "checkpoint-0", tiny_state(), drop)
+    with pytest.raises(utils.UserException, match="missing state payload"):
+        checkpoint.load(path, tiny_state())
+
+
+def test_load_missing_field(tmp_path):
+    def drop(payload):
+        del payload["state"]["theta"]
+    path = write_mutated(tmp_path / "checkpoint-0", tiny_state(), drop)
+    with pytest.raises(utils.UserException, match="missing field 'theta'"):
+        checkpoint.load(path, tiny_state())
+
+
+def test_load_shape_mismatch(tmp_path):
+    path = checkpoint.save(tmp_path / "checkpoint-0", tiny_state(d=4))
+    with pytest.raises(utils.UserException, match="shape"):
+        checkpoint.load(path, tiny_state(d=5))
+
+
+def test_load_negative_counters(tmp_path):
+    for field in ("steps", "datapoints"):
+        def corrupt(payload, field=field):
+            payload["state"][field] = -3
+        path = write_mutated(tmp_path / f"checkpoint-{field}-0",
+                             tiny_state(), corrupt)
+        with pytest.raises(utils.UserException,
+                           match=f"invalid {field} counter"):
+            checkpoint.load(path, tiny_state())
+
+
+def test_load_fault_buffer_cold_start(tmp_path):
+    """A pre-faults checkpoint (no `fault_buffer` field, same VERSION)
+    resumed under a fresh fault plan starts the straggler buffer at the
+    template's zeros (`checkpoint.load`'s documented cold-start)."""
+    def drop(payload):
+        del payload["state"]["fault_buffer"]
+    path = write_mutated(tmp_path / "checkpoint-0", tiny_state(), drop)
+    loaded = checkpoint.load(path, tiny_state(fault_rows=3))
+    assert loaded.fault_buffer.shape == (3, 4)
+    assert not np.asarray(loaded.fault_buffer).any()
+
+
+# --------------------------------------------------------------------------- #
+# Integrity detection + resume scanning
+
+
+def test_crc_detects_corruption(tmp_path):
+    path = checkpoint.save(tmp_path / "checkpoint-0", tiny_state())
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    assert not checkpoint.verify(path)
+    with pytest.raises(utils.UserException, match="integrity"):
+        checkpoint.load(path, tiny_state())
+
+
+def test_find_latest_valid_skips_truncated_tail(tmp_path):
+    """The tier-1 chaos check: a checkpoint truncated mid-byte (torn
+    non-atomic write, bad copy) is skipped, not crashed on — resume walks
+    back to the newest intact file."""
+    checkpoint.save(tmp_path / "checkpoint-2", tiny_state(steps=2))
+    torn = checkpoint.save(tmp_path / "checkpoint-4", tiny_state(steps=4))
+    raw = torn.read_bytes()
+    torn.write_bytes(raw[:len(raw) // 2])
+    found = checkpoint.find_latest_valid(tmp_path)
+    assert found is not None and found.name == "checkpoint-2"
+    # ... and the survivor actually loads
+    assert int(checkpoint.load(found, tiny_state()).steps) == 2
+    # Garbage under a checkpoint name must not shadow the valid tail either
+    (tmp_path / "checkpoint-9").write_bytes(b"\x00" * 64)
+    assert checkpoint.find_latest_valid(tmp_path).name == "checkpoint-2"
+
+
+def test_find_latest_valid_ignores_noise(tmp_path):
+    assert checkpoint.find_latest_valid(tmp_path / "absent") is None
+    assert checkpoint.find_latest_valid(tmp_path) is None
+    checkpoint.save(tmp_path / "checkpoint-6", tiny_state(steps=6))
+    (tmp_path / "checkpoint-8.tmp").write_bytes(b"torn mid-write")
+    (tmp_path / "checkpoint-7").mkdir()  # a directory, not a file
+    (tmp_path / "checkpoint-notastep").write_bytes(b"nope")
+    assert checkpoint.find_latest_valid(tmp_path).name == "checkpoint-6"
+
+
+def test_checkpoint_step_parsing():
+    assert checkpoint.checkpoint_step("results/run/checkpoint-1200") == 1200
+    assert checkpoint.checkpoint_step("checkpoint-0") == 0
+    assert checkpoint.checkpoint_step("checkpoints.json") is None
+    assert checkpoint.checkpoint_step("checkpoint-4.tmp") is None
+
+
+# --------------------------------------------------------------------------- #
+# Manifest: retention GC + restart counter
+
+
+def test_retention_gc_keeps_newest(tmp_path):
+    for step in (0, 2, 4, 6):
+        checkpoint.save(tmp_path / f"checkpoint-{step}",
+                        tiny_state(steps=step), keep=2)
+    names = sorted(p.name for p in tmp_path.glob("checkpoint-*"))
+    assert names == ["checkpoint-4", "checkpoint-6"]
+    manifest = checkpoint.read_manifest(tmp_path)
+    assert [e["step"] for e in manifest["checkpoints"]] == [4, 6]
+    assert checkpoint.find_latest_valid(tmp_path).name == "checkpoint-6"
+
+
+def test_restart_counter_survives_saves(tmp_path):
+    checkpoint.save(tmp_path / "checkpoint-0", tiny_state())
+    assert checkpoint.read_manifest(tmp_path)["restarts"] == 0
+    assert checkpoint.bump_restarts(tmp_path) == 1
+    assert checkpoint.bump_restarts(tmp_path) == 2
+    checkpoint.save(tmp_path / "checkpoint-2", tiny_state(steps=2))
+    assert checkpoint.read_manifest(tmp_path)["restarts"] == 2
+
+
+def test_manifest_tolerates_garbage(tmp_path):
+    (tmp_path / checkpoint.MANIFEST_NAME).write_text("{not json")
+    manifest = checkpoint.read_manifest(tmp_path)
+    assert manifest["checkpoints"] == [] and manifest["restarts"] == 0
+    # and a save over the garbage repairs it
+    checkpoint.save(tmp_path / "checkpoint-0", tiny_state())
+    assert checkpoint.read_manifest(tmp_path)["checkpoints"]

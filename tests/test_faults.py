@@ -364,9 +364,12 @@ def test_checkpoint_without_fault_buffer_loads_with_cold_buffer(tmp_path):
                         params={"w": jnp.zeros((D,))}, net_state={})
     assert state.fault_buffer.shape[0] > 0
     path = checkpoint.save(tmp_path / "ckpt", state)
-    raw = serialization.msgpack_restore(path.read_bytes())
+    data = path.read_bytes()
+    if data[-8:-4] == checkpoint.MAGIC:  # strip the PR 2 integrity footer
+        data = data[:-8]
+    raw = serialization.msgpack_restore(data)
     del raw["state"]["fault_buffer"]  # what an old checkpoint looks like
-    path.write_bytes(serialization.msgpack_serialize(raw))
+    path.write_bytes(serialization.msgpack_serialize(raw))  # footer-less too
     loaded = checkpoint.load(path, state)
     np.testing.assert_array_equal(np.asarray(loaded.theta),
                                   np.asarray(state.theta))

@@ -1,0 +1,146 @@
+"""Jobs supervisor tests: retry with backoff, adoption of stale
+`.pending`/`.failed` directories holding valid checkpoints (resume instead
+of cold-start), the heartbeat watchdog, and race-free version rotation."""
+
+import sys
+import time
+
+from byzantinemomentum_tpu import checkpoint
+from byzantinemomentum_tpu.utils.jobs import Jobs
+
+from tests.test_checkpoint import tiny_state
+
+# Attempt counting lives OUTSIDE the pending dir (it is renamed on
+# success/failure); `--result-directory` locates it through the parent.
+_COUNTING = (
+    "import sys, pathlib\n"
+    "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+    "m = d.parent / 'attempts.txt'\n"
+    "n = int(m.read_text()) if m.exists() else 0\n"
+    "m.write_text(str(n + 1))\n")
+
+
+def test_retry_until_success(tmp_path):
+    """A run failing its first attempt is retried in the SAME pending
+    directory and can complete on the second attempt."""
+    script = _COUNTING + (
+        "if n == 0:\n"
+        "    sys.exit(7)\n"
+        "(d / 'out.txt').write_text('done')\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=2, retry_backoff=0)
+    jobs.submit("flaky", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "flaky-1" / "out.txt").read_text() == "done"
+    assert (tmp_path / "attempts.txt").read_text() == "2"
+    assert not (tmp_path / "flaky-1.failed").exists()
+
+
+def test_gives_up_after_max_retries(tmp_path):
+    script = _COUNTING + "sys.exit(3)\n"
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=1, retry_backoff=0)
+    jobs.submit("doomed", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "doomed-1.failed" / "stderr.log").exists()
+    assert (tmp_path / "attempts.txt").read_text() == "2"  # 1 + max_retries
+
+
+def test_adopts_failed_attempt_with_checkpoint(tmp_path):
+    """A previous scheduler's `.failed` directory holding a valid
+    checkpoint is adopted and resumed (the reference parks it forever): the
+    dispatched command sees the resume flag AND the old checkpoint."""
+    failed = tmp_path / "run-1.failed"
+    failed.mkdir(parents=True)
+    checkpoint.save(failed / "checkpoint-3", tiny_state(steps=3))
+    script = (
+        "import sys, pathlib\n"
+        "assert '--auto-resume' in sys.argv\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "assert (d / 'checkpoint-3').is_file()\n"
+        "(d / 'out.txt').write_text('resumed')\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0)
+    jobs.submit("run", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "run-1" / "out.txt").read_text() == "resumed"
+    assert (tmp_path / "run-1" / "checkpoint-3").is_file()
+    assert not failed.exists()
+
+
+def test_adopts_stale_pending_with_checkpoint(tmp_path):
+    """A stale `.pending` left by a killed scheduler is reused in place
+    when it holds a valid checkpoint (instead of being rotated away)."""
+    pending = tmp_path / "run-1.pending"
+    pending.mkdir(parents=True)
+    checkpoint.save(pending / "checkpoint-5", tiny_state(steps=5))
+    script = (
+        "import sys, pathlib\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "assert (d / 'checkpoint-5').is_file()\n"
+        "(d / 'out.txt').write_text('adopted')\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0)
+    jobs.submit("run", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "run-1" / "out.txt").read_text() == "adopted"
+    assert not list(tmp_path.glob("run-1.pending*"))
+
+
+def test_stale_pending_without_checkpoint_is_rotated(tmp_path):
+    pending = tmp_path / "run-1.pending"
+    pending.mkdir(parents=True)
+    (pending / "junk.txt").write_text("stale")
+    script = (
+        "import sys, pathlib\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "assert not (d / 'junk.txt').exists()\n"
+        "(d / 'out.txt').write_text('fresh')\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0)
+    jobs.submit("run", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "run-1" / "out.txt").read_text() == "fresh"
+    assert (tmp_path / "run-1.pending.0" / "junk.txt").read_text() == "stale"
+
+
+def test_heartbeat_watchdog_kills_stalled_run(tmp_path):
+    """A subprocess whose study CSV never advances is SIGKILLed after the
+    heartbeat timeout instead of blocking its device slot forever."""
+    script = "import time; time.sleep(60)"
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0,
+                heartbeat_timeout=0.5)
+    jobs.submit("hung", [sys.executable, "-c", script])
+    start = time.monotonic()
+    jobs.wait()
+    assert time.monotonic() - start < 30
+    assert (tmp_path / "hung-1.failed").is_dir()
+
+
+def test_heartbeat_watchdog_spares_advancing_run(tmp_path):
+    """A run that keeps writing its study CSV is NOT killed even when it
+    takes several heartbeat windows to finish."""
+    script = (
+        "import sys, time, pathlib\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "study = d / 'study'\n"
+        "for i in range(8):\n"
+        "    study.open('a').write(f'row {i}\\n')\n"
+        "    time.sleep(0.25)\n"
+        "(d / 'out.txt').write_text('done')\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0,
+                heartbeat_timeout=1.0)
+    jobs.submit("steady", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "steady-1" / "out.txt").read_text() == "done"
+
+
+def test_rotation_skips_existing_versions(tmp_path):
+    """`_rotate_away` never clobbers previous rotations: with `.0`/`.1`
+    already present (each non-empty), the next rotation lands on `.2`."""
+    jobs = Jobs(tmp_path, seeds=(1,))
+    target = tmp_path / "run-1.failed"
+    for name in ("run-1.failed", "run-1.failed.0", "run-1.failed.1"):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "keep.txt").write_text(name)
+    rotated = jobs._rotate_away(target)
+    assert rotated.name == "run-1.failed.2"
+    assert (rotated / "keep.txt").read_text() == "run-1.failed"
+    for name in ("run-1.failed.0", "run-1.failed.1"):
+        assert (tmp_path / name / "keep.txt").read_text() == name

@@ -157,6 +157,70 @@ def test_reproduce_analysis_buckets_and_plots(analysis_grid, tmp_path, capsys):
     assert "ratio ok" in out
 
 
+def _write_faulted_run(directory, rate, nb_steps=4):
+    """Handcraft one result directory in the driver's exact file format,
+    with the `--fault-plan` study schema (FAULT_COLUMNS appended) — no
+    training needed to exercise the analysis layer."""
+    from byzantinemomentum_tpu.engine import FAULT_COLUMNS, STUDY_COLUMNS
+    directory.mkdir(parents=True)
+    columns = STUDY_COLUMNS + FAULT_COLUMNS
+    lines = ["# " + "\t".join(columns)]
+    for step in range(nb_steps):
+        row = [str(step), str(step * 88)]
+        row += ["%.8e" % (1.0 / (step + 1 + rate))] * (len(STUDY_COLUMNS) - 3)
+        row.append("0.5")                      # Attack acceptation ratio
+        row += [str(int(rate)), str(11 - int(rate)), "2"]  # fault columns
+        lines.append("\t".join(row))
+    (directory / "study").write_text(os.linesep.join(lines))
+    (directory / "eval").write_text(os.linesep.join(
+        ["# Step number\tCross-accuracy", "0\t0.1",
+         f"{nb_steps - 1}\t{0.9 - 0.1 * rate}"]))
+    (directory / "config").write_text("Configuration:")
+    import json
+    (directory / "config.json").write_text(json.dumps(
+        {"gar": "median", "dataset": "mnist", "nb_workers": 11,
+         "nb_decl_byz": 2, "learning_rate": 0.01}))
+
+
+def test_fault_timeline_plot(tmp_path):
+    """`study.fault_timeline`: degradation timeline off the PR 1 fault
+    columns (ROADMAP open item), refusing fault-free sessions."""
+    from byzantinemomentum_tpu import utils
+    _write_faulted_run(tmp_path / "faulted", rate=2)
+    sess = study.Session(tmp_path / "faulted")
+    plot = study.fault_timeline(sess)
+    plot.save(tmp_path / "timeline.png")
+    plot.close()
+    assert (tmp_path / "timeline.png").stat().st_size > 0
+    _write_faultless = tmp_path / "clean"
+    _write_faulted_run(_write_faultless, rate=0)
+    clean = study.Session(_write_faultless)
+    clean.data = clean.data.drop(columns=["Faults injected", "Workers active"])
+    with pytest.raises(utils.UserException, match="fault columns"):
+        study.fault_timeline(clean)
+
+
+def test_fault_rate_sweep_plot(tmp_path):
+    """`study.fault_rate_sweep`: one (rate, metric) point per run, sorted
+    by observed rate, for both reducers; returns frame + saveable plot."""
+    sessions = []
+    for rate in (2, 0, 1):
+        _write_faulted_run(tmp_path / f"rate{rate}", rate=rate)
+        sessions.append(study.Session(tmp_path / f"rate{rate}"))
+    frame, plot = study.fault_rate_sweep(sessions, metric="Average loss")
+    assert list(frame.index) == sorted(frame.index)
+    assert len(frame) == 3
+    plot.save(tmp_path / "sweep.png")
+    plot.close()
+    assert (tmp_path / "sweep.png").stat().st_size > 0
+    frame_mean, plot_mean = study.fault_rate_sweep(
+        sessions, metric="Cross-accuracy", reducer="mean")
+    plot_mean.close()
+    # higher fault rate -> lower final accuracy in the synthetic fixtures
+    accs = list(frame_mean["Cross-accuracy"])
+    assert accs == sorted(accs, reverse=True)
+
+
 def test_display_fallback(result_dir, capsys):
     """`study.display` degrades gracefully without GTK: warning + text
     rendering (reference `study.py:72-78`)."""
