@@ -30,6 +30,11 @@ gradient every measured step, so a degenerate (NaN) run cannot be timed.
 loop measured by `scripts/measure_torch_baseline.py` (recorded in
 `BASELINE_MEASURED.json`; the reference itself cannot run here — it imports
 torchvision, which is absent).
+
+A failed accelerator-backend init (down TPU tunnel: "Unable to initialize
+backend ... UNAVAILABLE", the BENCH_r05.json crash) falls back to the CPU
+backend with a `"backend": "cpu-fallback"` marker in the JSON, so the
+artifact stays parseable instead of the run exiting 1.
 """
 
 import json
@@ -47,6 +52,9 @@ import numpy as np  # noqa: E402
 
 from byzantinemomentum_tpu import attacks, data, losses, models, ops  # noqa: E402
 from byzantinemomentum_tpu.engine import EngineConfig, build_engine  # noqa: E402
+# Peak-FLOPs table and cost_analysis extraction live in obs/perf.py now
+# (shared with the driver's telemetry MFU gauge)
+from byzantinemomentum_tpu.obs.perf import flops_of_compiled, peak_flops  # noqa: E402
 
 N_WORKERS = 25
 F = 5
@@ -56,21 +64,39 @@ MIN_MEASURE_S = 5.0
 MAX_MEASURE_STEPS = 400
 STEPS_PER_PROGRAM = 20  # the driver's fused-dispatch path (lax.scan of steps)
 
-# Peak bf16 matmul throughput per chip, FLOP/s (public spec sheets). MFU is
-# quoted against the bf16 peak for both modes (conservative for f32, which
-# the MXU runs via multi-pass bf16 decomposition).
-_PEAK_BF16 = (
-    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-)
-
 
 def _peak_flops():
     kind = jax.devices()[0].device_kind.lower()
-    for tag, peak in _PEAK_BF16:
-        if tag in kind:
-            return peak, kind
-    return None, kind
+    return peak_flops(kind), kind
+
+
+def _ensure_backend():
+    """Probe the configured backend; on an init failure (e.g. the
+    "Unable to initialize backend ... UNAVAILABLE" crash a down TPU tunnel
+    produces — see BENCH_r05.json) fall back to the CPU backend so the
+    benchmark still yields a parseable JSON line with a
+    `"backend": "cpu-fallback"` marker instead of exiting 1.
+
+    Returns "default" or "cpu-fallback"; re-raises when even the CPU
+    fallback cannot initialize (nothing left to measure on)."""
+    try:
+        jax.devices()
+        return "default"
+    except RuntimeError as err:
+        message = str(err)
+        if "nitialize backend" not in message and "UNAVAILABLE" not in message:
+            raise
+        print(f"bench: backend init failed ({message.splitlines()[0]}); "
+              f"falling back to CPU", flush=True)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        # jax_platforms carries an update hook that clears cached backends,
+        # so flipping it after a failed init retries cleanly
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    jax.devices()  # still broken -> raise: there is nothing to measure on
+    return "cpu-fallback"
 
 
 def _run_mode(compute_dtype, train_data, *, gar_name="bulyan", n=N_WORKERS,
@@ -135,13 +161,10 @@ def _run_mode(compute_dtype, train_data, *, gar_name="bulyan", n=N_WORKERS,
                     os.environ.pop("BMT_NO_WORKER_PACK", None)
                 else:
                     os.environ["BMT_NO_WORKER_PACK"] = prior
-            cost = compiled.cost_analysis()
-            if cost:
-                cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-                # XLA cost_analysis counts a lax.scan body ONCE (verified:
-                # the M-step program reports the same flops as the
-                # single-step one), so this is already per-step
-                flops = float(cost.get("flops", 0.0)) or None
+            # XLA cost_analysis counts a lax.scan body ONCE (verified:
+            # the M-step program reports the same flops as the
+            # single-step one), so this is already per-step
+            flops = flops_of_compiled(compiled)
         except Exception:
             pass
 
@@ -199,6 +222,7 @@ def _run_mode(compute_dtype, train_data, *, gar_name="bulyan", n=N_WORKERS,
 
 
 def main():
+    backend = _ensure_backend()
     trainset, _ = data.make_datasets("cifar10", BATCH, BATCH, seed=0)
     from byzantinemomentum_tpu.data.device import DeviceData
     train_data = DeviceData(trainset)
@@ -283,6 +307,7 @@ def main():
         "steps_per_sec_bf16_mixed": sps_bf16,
         "flops_per_step": flops,
         "mfu": mfu,
+        "backend": backend,
         "device_kind": device_kind,
         "synthetic_data": synthetic,
         "cells": cells,

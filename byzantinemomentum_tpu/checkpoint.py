@@ -43,6 +43,10 @@ from flax import serialization
 
 from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.engine.state import TrainState
+# Telemetry hooks (`obs.span`/`obs.emit` are no-ops when no recorder is
+# active): checkpoint write/load cost and torn-file skips belong on the
+# run's system timeline
+from byzantinemomentum_tpu.obs import recorder as obs
 
 __all__ = ["VERSION", "MAGIC", "MANIFEST_NAME", "save", "load", "seal",
            "verify", "find_latest_valid", "checkpoint_step",
@@ -129,25 +133,26 @@ def save(path, state, *, data_state=None, keep=None):
     checkpoints beyond the newest `keep` (None/0 keeps everything).
     """
     state = jax.device_get(state)
-    # to_state_dict converts non-dict containers (e.g. optax opt_state
-    # tuples) into msgpack-serializable nested dicts
-    payload = {"version": VERSION,
-               "state": {name: serialization.to_state_dict(value)
-                         for name, value in state._asdict().items()}}
-    if data_state is not None:
-        payload["data"] = data_state
-    data = seal(serialization.msgpack_serialize(payload))
     path = pathlib.Path(path)
     step = int(np.asarray(state.steps))
-    _chaos_torn_write(path, data, step)
-    tmp = path.with_name(path.name + ".tmp")
-    with tmp.open("wb") as fd:
-        fd.write(data)
-        fd.flush()
-        os.fsync(fd.fileno())
-    os.replace(tmp, path)
-    _fsync_directory(path.parent)
-    _manifest_add(path.parent, path.name, step, len(data), keep=keep)
+    with obs.span("checkpoint_save", step=step):
+        # to_state_dict converts non-dict containers (e.g. optax opt_state
+        # tuples) into msgpack-serializable nested dicts
+        payload = {"version": VERSION,
+                   "state": {name: serialization.to_state_dict(value)
+                             for name, value in state._asdict().items()}}
+        if data_state is not None:
+            payload["data"] = data_state
+        data = seal(serialization.msgpack_serialize(payload))
+        _chaos_torn_write(path, data, step)
+        tmp = path.with_name(path.name + ".tmp")
+        with tmp.open("wb") as fd:
+            fd.write(data)
+            fd.flush()
+            os.fsync(fd.fileno())
+        os.replace(tmp, path)
+        _fsync_directory(path.parent)
+        _manifest_add(path.parent, path.name, step, len(data), keep=keep)
     return path
 
 
@@ -160,7 +165,8 @@ def load(path, template, *, return_data=False):
     is the sampler snapshot stored by `save` (or None for checkpoints
     written without one)."""
     path = pathlib.Path(path)
-    raw = serialization.msgpack_restore(_unseal(path, path.read_bytes()))
+    with obs.span("checkpoint_load", file=path.name):
+        raw = serialization.msgpack_restore(_unseal(path, path.read_bytes()))
     version = raw.get("version")
     if version != VERSION:
         raise utils.UserException(
@@ -256,6 +262,7 @@ def find_latest_valid(directory, prefix="checkpoint-"):
         if verify(entry):
             return entry
         utils.warning(f"Skipping torn/corrupt checkpoint {entry.name}")
+        obs.emit("checkpoint_invalid", file=entry.name)
     return None
 
 

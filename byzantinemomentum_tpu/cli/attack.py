@@ -15,6 +15,14 @@ concatenated output of a killed + resumed run is bit-identical to an
 uninterrupted one (`tests/test_chaos.py`); `--rollback-budget` adds an
 in-loop divergence watchdog that restores the last good checkpoint when
 the training state goes non-finite.
+
+Telemetry (PR 3, `byzantinemomentum_tpu/obs/`): every run with a result
+directory records a machine-readable system timeline — `telemetry.jsonl`
+(spans, events, counters, gauges) plus an atomically-replaced
+`heartbeat.json` the `Jobs` supervisor's watchdog consumes. Sampling is
+interval-based (`--telemetry-interval`) so the depth-2 dispatch pipeline
+stays intact between samples; SIGUSR1 captures an on-demand one-chunk
+`jax.profiler` window on a live run.
 """
 
 import argparse
@@ -25,6 +33,7 @@ import os
 import pathlib
 import signal
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +44,7 @@ from byzantinemomentum_tpu import checkpoint as checkpoint_mod
 from byzantinemomentum_tpu import data as data_mod
 from byzantinemomentum_tpu import losses as losses_mod
 from byzantinemomentum_tpu import models as models_mod
+from byzantinemomentum_tpu import obs as obs_mod
 from byzantinemomentum_tpu import ops as ops_mod
 from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.engine import (
@@ -153,6 +163,23 @@ def process_commandline(argv=None):
     add("--trace-dir", type=str, default=None,
         help="Capture a jax.profiler trace of the first steps into this "
              "directory (opt-in, like the reference's TimedContext tools)")
+    add("--telemetry", action="store_true", default=False,
+        help="Record run telemetry — 'telemetry.jsonl' (spans/events/"
+             "counters/gauges) and an atomic 'heartbeat.json' in the result "
+             "directory. Default: ON whenever '--result-directory' is set "
+             "(there is nowhere to write otherwise); this flag only makes "
+             "the intent explicit")
+    add("--no-telemetry", action="store_true", default=False,
+        help="Disable run telemetry (no telemetry.jsonl, no heartbeat)")
+    add("--telemetry-interval", type=int, default=50,
+        help="Steps between telemetry samples: each sample drains the "
+             "dispatch pipeline once for a device-honest step time, then "
+             "records steps/s, host RSS and refreshes the heartbeat")
+    add("--telemetry-mfu", action="store_true", default=False,
+        help="Also estimate MFU: count the step program's logical FLOPs "
+             "once (one throwaway compile at the first dispatch, bench.py's "
+             "recipe) and add an 'mfu' gauge where the chip's bf16 peak is "
+             "known")
     add("--l1-regularize", type=float, default=None,
         help="L1 loss regularization factor")
     add("--l2-regularize", type=float, default=None,
@@ -307,6 +334,15 @@ def _postprocess(args):
     if args.keep_checkpoints < 0:
         utils.fatal(f"Invalid arguments: negative checkpoint retention "
                     f"{args.keep_checkpoints}")
+    if args.telemetry and args.no_telemetry:
+        utils.fatal("Invalid arguments: '--telemetry' and '--no-telemetry' "
+                    "are mutually exclusive")
+    if args.telemetry_interval < 1:
+        utils.fatal(f"Invalid arguments: non-positive telemetry interval "
+                    f"{args.telemetry_interval}")
+    if args.telemetry and args.result_directory is None:
+        utils.warning("'--telemetry' needs '--result-directory' (there is "
+                      "nowhere to write the timeline); telemetry disabled")
     if args.rollback_budget < 0:
         utils.fatal(f"Invalid arguments: negative rollback budget "
                     f"{args.rollback_budget}")
@@ -469,11 +505,16 @@ def main(argv=None):
     """Run one experiment (the reference's whole `attack.py` flow)."""
     # Graceful exit latch (reference `attack.py:41-45`)
     exit_trigger, exit_is_requested = utils.onetime(None)
+    # SIGUSR1 arms an on-demand one-chunk jax.profiler window on a LIVE run
+    # (serviced at the next loop iteration; see the training loop)
+    profile_request = [False]
     try:
         signal.signal(signal.SIGINT, lambda *_: exit_trigger())
         signal.signal(signal.SIGTERM, lambda *_: exit_trigger())
-    except ValueError:
-        pass  # Not in the main thread
+        signal.signal(signal.SIGUSR1,
+                      lambda *_: profile_request.__setitem__(0, True))
+    except (ValueError, AttributeError):
+        pass  # Not in the main thread, or a platform without SIGUSR1
 
     with utils.Context("cmdline", "info"):
         args = _postprocess(process_commandline(argv))
@@ -565,10 +606,14 @@ def main(argv=None):
         # Datasets
         if args.download:
             os.environ["BMT_DOWNLOAD"] = "1"
+        data_setup_t0 = time.monotonic()
         trainset, testset = data_mod.make_datasets(
             args.dataset, args.batch_size, args.batch_size_test,
             no_transform=args.no_transform, seed=seed % 2**32,
             **args.dataset_args)
+        # Emitted as a telemetry event once the recorder exists (the result
+        # directory — where the recorder writes — is established later)
+        data_setup_s = time.monotonic() - data_setup_t0
         # Losses (reference `attack.py:534-541`)
         loss = losses_mod.Loss(args.loss, **args.loss_args)
         if args.l1_regularize is not None:
@@ -724,6 +769,35 @@ def main(argv=None):
             utils.warning("Argument '--checkpoint-delta' ignored as no "
                           "'--result-directory' was specified")
 
+        # Telemetry recorder: default-on for every run with a result
+        # directory (the system timeline is as much a run artifact as the
+        # study CSV); '--no-telemetry' opts out. Activated as the process's
+        # recorder so deep layers (checkpoint.py, faults/) land on the
+        # timeline too. Deactivate any recorder a previous in-process run
+        # (tests call `main` repeatedly) left behind on an error path.
+        obs_mod.deactivate()
+        telem = None
+        if args.result_directory is not None and not args.no_telemetry:
+            try:
+                telem = obs_mod.Telemetry(args.result_directory,
+                                          interval=args.telemetry_interval)
+            except OSError as err:
+                utils.warning(f"Telemetry disabled: cannot open the "
+                              f"timeline file ({err})")
+            else:
+                obs_mod.activate(telem)
+                obs_mod.install_compile_listener(telem)
+                telem.event("run_start", seed=seed,
+                            restarts=restart_count,
+                            resume_step=resume_step)
+                telem.event("data_setup", seconds=round(data_setup_s, 3),
+                            dataset=args.dataset)
+                if resume_step is not None:
+                    # The acceptance signal for supervised chaos runs: the
+                    # resumed process stamps WHERE it restarted from
+                    telem.event("restart", step=resume_step,
+                                count=restart_count)
+
     # Load/initialize state (reference `attack.py:621-682`)
     with utils.Context("load", "info"):
         params, net_state = model_def.init(root_key)
@@ -807,6 +881,7 @@ def main(argv=None):
     # reference's opt-in timing scopes, reference `tools/misc.py:307-343`)
     if args.trace_dir is not None:
         jax.profiler.start_trace(args.trace_dir)
+        obs_mod.emit("profiler_trace_start", directory=str(args.trace_dir))
 
     # Training (reference `attack.py:685-885`)
     with utils.Context("training", "info"):
@@ -835,6 +910,26 @@ def main(argv=None):
         # backends a ~100 ms round trip that idles the chip
         steps_host = int(state.steps)
         datapoints_host = int(state.datapoints)
+
+        # Telemetry sampling state: a sample drains the pipeline once (the
+        # StepTimer's device->host barrier) for a device-honest chunk time,
+        # then records throughput/RSS gauges and refreshes the heartbeat.
+        # Between samples the only telemetry cost is a deque append.
+        rate_window = obs_mod.SlidingRate()
+        step_timer = obs_mod.StepTimer()
+        next_sample_step = steps_host
+        mfu_flops = None   # logical FLOPs/step: lazy, False = gave up
+        mfu_peak = None
+        if telem is not None:
+            try:
+                mfu_peak = obs_mod.peak_flops(jax.devices()[0].device_kind)
+            except Exception:
+                mfu_peak = None
+            # First heartbeat before the first (slow: compile) dispatch, so
+            # a supervisor watchdog sees a live signal immediately
+            telem.heartbeat(step=steps_host, status="running")
+        # (directory, from_step) of a live SIGUSR1 profiler window
+        profile_active = None
 
         # Study metrics of the previously dispatched chunk, transferred
         # AFTER the next chunk is enqueued (depth-2 pipeline, same scheme
@@ -873,6 +968,13 @@ def main(argv=None):
                     row.append(p_rollbacks)
                     row.append(restart_count)
                 results.store(fd_study, *row)
+            if fault_schedule is not None and telem is not None:
+                # The chunk's scheduled-fault total lands on the system
+                # timeline too (the study CSV has the per-step values)
+                injected = int(np.sum(np.asarray(
+                    p_metrics["Faults injected"])))
+                if injected:
+                    telem.counter("faults_injected", injected)
 
         # --- divergence rollback (`--rollback-budget`): a depth-2 pipelined
         # health flag per dispatched chunk; a non-finite training state
@@ -957,6 +1059,12 @@ def main(argv=None):
             utils.warning(f"Rollback #{rollbacks}/{args.rollback_budget}: "
                           f"non-finite training state; restored "
                           f"{found.name} (step {steps_host})")
+            if telem is not None:
+                telem.counter("rollbacks")
+                telem.event("rollback", step=steps_host,
+                            restored=found.name,
+                            budget_left=args.rollback_budget - rollbacks)
+                telem.heartbeat(step=steps_host, status="rolled-back")
             if args.rollback_tighten_quorum:
                 tighten_quorum()
             return True
@@ -979,6 +1087,9 @@ def main(argv=None):
                 if pending_health:
                     if not bool(np.asarray(pending_health.pop())):
                         if not roll_back():
+                            if telem is not None:
+                                telem.event("divergence_giveup",
+                                            step=steps_host)
                             diverged = True
                             break
                         continue
@@ -1004,19 +1115,24 @@ def main(argv=None):
                 if milestone_evaluation:
                     # One compiled program + one host transfer per evaluation
                     # (the reference runs batch_size_test_reps separate
-                    # synchronous calls, `attack.py:709-715`)
-                    reps = args.batch_size_test_reps
-                    if use_device_data:
-                        idx, flips = test_data.sample_indices(reps)
-                        res = engine.eval_many_indexed(
-                            state.theta, state.net_state,
-                            jnp.asarray(idx), jnp.asarray(flips))
-                    else:
-                        bxs, bys = zip(*(testset.sample() for _ in range(reps)))
-                        res = eval_many_fn(
-                            state.theta, state.net_state,
-                            jnp.asarray(np.stack(bxs)), jnp.asarray(np.stack(bys)))
-                    acc = float(res[0]) / float(res[1])
+                    # synchronous calls, `attack.py:709-715`). The float()
+                    # reads make the whole span device-synchronous, so its
+                    # duration is honest.
+                    with obs_mod.span("eval", step=steps):
+                        reps = args.batch_size_test_reps
+                        if use_device_data:
+                            idx, flips = test_data.sample_indices(reps)
+                            res = engine.eval_many_indexed(
+                                state.theta, state.net_state,
+                                jnp.asarray(idx), jnp.asarray(flips))
+                        else:
+                            bxs, bys = zip(*(testset.sample()
+                                             for _ in range(reps)))
+                            res = eval_many_fn(
+                                state.theta, state.net_state,
+                                jnp.asarray(np.stack(bxs)),
+                                jnp.asarray(np.stack(bys)))
+                        acc = float(res[0]) / float(res[1])
                     utils.info(f"Accuracy (step {steps}): {acc * 100.:.2f}%")
                     if fd_eval is not None:
                         results.store(fd_eval, steps, acc)
@@ -1029,12 +1145,37 @@ def main(argv=None):
                     except Exception as err:
                         utils.warning(f"Checkpoint save failed: {err}")
                 just_loaded = False
+                if telem is not None and (milestone_evaluation
+                                          or milestone_checkpoint):
+                    # Milestones already synced the device; refresh the
+                    # heartbeat for free
+                    telem.heartbeat(step=steps, status="running",
+                                    steps_per_sec=rate_window.rate())
                 if milestone_user_input:
                     code.interact(banner=f"Interactive prompt (step {steps}); "
                                   "Ctrl-D to resume", local={"state": state,
                                                              "engine": engine})
                 if steps_limit is not None and steps >= steps_limit:
                     break
+                # SIGUSR1: open a one-chunk jax.profiler window (live-run
+                # debugging without restarting under --trace-dir); closed
+                # right after the chunk it covers is drained below
+                if profile_request[0] and profile_active is None:
+                    profile_request[0] = False
+                    if args.result_directory is None:
+                        utils.warning("SIGUSR1 profiling needs "
+                                      "'--result-directory'; ignored")
+                    else:
+                        pdir = args.result_directory / f"profile-{steps}"
+                        try:
+                            jax.profiler.start_trace(str(pdir))
+                        except Exception as err:
+                            utils.warning(f"SIGUSR1 profiler window failed "
+                                          f"to start ({err})")
+                        else:
+                            profile_active = (pdir, steps)
+                            utils.info(f"SIGUSR1: profiling one chunk into "
+                                       f"{str(pdir)!r}")
                 # How many steps until the next milestone boundary — that many
                 # can fuse into one compiled dispatch (identical trajectory;
                 # `engine.train_multi*` is a lax.scan of the single step)
@@ -1063,6 +1204,9 @@ def main(argv=None):
                 # 'Training point count' is the value at loop entry, BEFORE each
                 # step's increment (reference `attack.py:696, 844`)
                 datapoints = datapoints_host
+                # The four dispatch variants (indexed/host-staged × single/
+                # fused) funnel into ONE call site so the telemetry timer
+                # and the lazy FLOP counter bracket exactly what executes
                 if use_device_data:
                     idx, flips = train_data.sample_indices(need * M)
                     idx = idx.reshape((M, S, k) + idx.shape[1:] if k > 1
@@ -1071,13 +1215,15 @@ def main(argv=None):
                                           else (M, S) + flips.shape[1:])
                     batch = args.batch_size
                     if M == 1:
-                        state, metrics = engine.train_step_indexed(
-                            state, jnp.asarray(idx[0]), jnp.asarray(flips[0]),
-                            jnp.float32(lrs[0]))
+                        dispatch_fn = engine.train_step_indexed
+                        dispatch_args = (state, jnp.asarray(idx[0]),
+                                         jnp.asarray(flips[0]),
+                                         jnp.float32(lrs[0]))
                     else:
-                        state, metrics = engine.train_multi_indexed(
-                            state, jnp.asarray(idx), jnp.asarray(flips),
-                            jnp.asarray(lrs, jnp.float32))
+                        dispatch_fn = engine.train_multi_indexed
+                        dispatch_args = (state, jnp.asarray(idx),
+                                         jnp.asarray(flips),
+                                         jnp.asarray(lrs, jnp.float32))
                 else:
                     xs, ys = zip(*(trainset.sample() for _ in range(need * M)))
                     xs = np.stack(xs)
@@ -1087,15 +1233,68 @@ def main(argv=None):
                     xs = xs.reshape(shape + xs.shape[1:])
                     ys = ys.reshape(shape + ys.shape[1:])
                     if M == 1:
-                        state, metrics = step_fn(
-                            state, jnp.asarray(xs[0]), jnp.asarray(ys[0]),
-                            jnp.float32(lrs[0]))
+                        dispatch_fn = step_fn
+                        dispatch_args = (state, jnp.asarray(xs[0]),
+                                         jnp.asarray(ys[0]),
+                                         jnp.float32(lrs[0]))
                     else:
-                        state, metrics = multi_fn(
-                            state, jnp.asarray(xs), jnp.asarray(ys),
-                            jnp.asarray(lrs, jnp.float32))
+                        dispatch_fn = multi_fn
+                        dispatch_args = (state, jnp.asarray(xs),
+                                         jnp.asarray(ys),
+                                         jnp.asarray(lrs, jnp.float32))
+                if (telem is not None and args.telemetry_mfu
+                        and mfu_flops is None):
+                    # One throwaway compile of the program about to run
+                    # (lowering only inspects avals — donation untouched);
+                    # False = tried and failed, never retried
+                    mfu_flops = obs_mod.logical_flops(
+                        dispatch_fn, *dispatch_args) or False
+                    if mfu_flops:
+                        telem.event("flops_per_step", flops=mfu_flops)
+                # Telemetry sample: drain the pipeline (device->host barrier
+                # on the pre-dispatch step counter), time this chunk's
+                # dispatch-to-completion, then record gauges below
+                measure = telem is not None and steps_host >= next_sample_step
+                if measure:
+                    step_timer.start(state.steps)
+                state, metrics = dispatch_fn(*dispatch_args)
                 steps_host += M
                 datapoints_host += M * batch * cfg.nb_honests * k
+                if telem is not None:
+                    rate_window.update(steps_host)
+                if measure:
+                    device_s = step_timer.stop(state.steps)
+                    device_ms = device_s * 1000.0 / M
+                    rate = rate_window.rate()
+                    rss = obs_mod.host_rss_mb()
+                    telem.gauge("device_step_ms", device_ms, step=steps_host)
+                    if rate is not None:
+                        telem.gauge("steps_per_sec", rate, step=steps_host)
+                    if rss is not None:
+                        telem.gauge("host_rss_mb", rss, step=steps_host)
+                    mfu_now = obs_mod.mfu(mfu_flops or None, rate, mfu_peak)
+                    if mfu_now is not None:
+                        telem.gauge("mfu", mfu_now, step=steps_host)
+                    telem.heartbeat(step=steps_host, status="running",
+                                    steps_per_sec=rate,
+                                    device_step_ms=device_ms, rss_mb=rss,
+                                    mfu=mfu_now)
+                    next_sample_step = steps_host + telem.interval
+                if profile_active is not None:
+                    # Close the SIGUSR1 window on the chunk it covered
+                    np.asarray(state.steps + 0)  # drain the traced chunk
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception as err:
+                        utils.warning(f"SIGUSR1 profiler window failed to "
+                                      f"stop ({err})")
+                    pdir, pstep = profile_active
+                    profile_active = None
+                    if telem is not None:
+                        telem.event("profiler_window", directory=str(pdir),
+                                    from_step=pstep, to_step=steps_host)
+                    utils.info(f"SIGUSR1: profiler window saved to "
+                               f"{str(pdir)!r}")
                 if chaos_nan is not None and steps_host > chaos_nan:
                     # Poison the freshly dispatched state (chaos hook): the
                     # health flag below must flip and trigger the rollback
@@ -1138,7 +1337,18 @@ def main(argv=None):
             if results is not None:
                 results.close()
     if args.trace_dir is not None:
+        obs_mod.emit("profiler_trace_stop", directory=str(args.trace_dir))
         jax.profiler.stop_trace()
+    if telem is not None:
+        status = ("diverged" if diverged
+                  else "interrupted" if exit_is_requested()
+                  else "completed")
+        telem.event("run_end", step=steps_host, status=status,
+                    rollbacks=rollbacks, restarts=restart_count)
+        telem.heartbeat(step=steps_host, status=status,
+                        steps_per_sec=rate_window.rate())
+        telem.close()
+        obs_mod.deactivate()
     # A diverged run that spent its rollback budget is a failure: the Jobs
     # supervisor retries it (resuming from the last good checkpoint with a
     # fresh budget) instead of marking the directory done

@@ -12,6 +12,10 @@ the same failure.
 
 import time
 
+# Host-only telemetry hooks (obs.recorder imports no jax): every retry is
+# a resilience event worth a spot on the run's timeline
+from byzantinemomentum_tpu.obs import recorder as _obs
+
 __all__ = ["with_backoff"]
 
 
@@ -21,7 +25,9 @@ def with_backoff(fn, *, attempts=3, base_delay=1.0, retry_on=(OSError,),
     between tries; re-raises the last error once the budget is spent.
 
     `on_retry(attempt, delay, error)` observes each retry (logging);
-    `sleep` is injectable for tests.
+    `sleep` is injectable for tests. Each retry also bumps the active
+    telemetry recorder's `retry_attempts` counter and records a `retry`
+    event (no-ops outside an instrumented run).
     """
     if attempts < 1:
         raise ValueError(f"Non-positive attempt count {attempts}")
@@ -32,6 +38,9 @@ def with_backoff(fn, *, attempts=3, base_delay=1.0, retry_on=(OSError,),
             if attempt + 1 >= attempts:
                 raise
             delay = base_delay * (2.0 ** attempt)
+            _obs.counter("retry_attempts")
+            _obs.emit("retry", attempt=attempt + 1, delay=delay,
+                      error=str(err))
             if on_retry is not None:
                 on_retry(attempt, delay, err)
             if delay > 0:

@@ -1,0 +1,73 @@
+"""Runtime telemetry — structured spans/events, live perf counters and
+supervisor-grade heartbeats.
+
+The study CSV (`engine/metrics.py::STUDY_COLUMNS`) observes the *science*
+(gradient norms, cosines, acceptation ratios); this package observes the
+*system*: step latency, throughput, recompiles, checkpoint-write cost and
+the resilience events (faults injected, rollbacks, restarts) that were
+previously invisible or inferred indirectly (the `utils/jobs.py` watchdog
+used to guess liveness from study-CSV mtime). Three pieces:
+
+* **recorder** (`recorder.py`) — `Telemetry`: an append-only
+  `telemetry.jsonl` per run holding spans (nested, wall-clock durations),
+  events (point-in-time facts), monotonic counters and gauges, flushed per
+  record so a SIGKILL loses at most the record being written. A
+  module-level *active recorder* (`activate`/`emit`/`span`/`counter`) lets
+  deep layers (`checkpoint.py`, `faults/`) land on the timeline without
+  plumbing a handle through every call chain — all no-ops when inactive.
+* **heartbeat** (`heartbeat.py`) — a single `heartbeat.json`, atomically
+  replaced (tmp + fsync + `os.replace`, same discipline as
+  `checkpoint.py`), with step, throughput, last-event summary and counter
+  snapshot. The `Jobs` supervisor's watchdog consumes it instead of
+  CSV-mtime guessing, making the kill decision signal-based.
+* **perf** (`perf.py`) — sliding-window steps/s, device-honest chunk
+  timing (an `AccumulatedTimedContext` whose sync barrier is a tiny
+  device→host transfer), host RSS, the TPU bf16 peak-FLOPs table shared
+  with `bench.py` and the logical-FLOP counter behind the MFU gauge.
+
+Driver surface: `cli/attack.py --telemetry[-interval]` (on by default when
+a `--result-directory` exists), SIGUSR1 for an on-demand one-chunk
+`jax.profiler` window on a live run. `scripts/obs_report.py` (and
+`python -m byzantinemomentum_tpu.obs <run_dir>`) renders a one-page text
+summary of any run directory; `python -m byzantinemomentum_tpu.obs
+--selfcheck` is the CI smoke entry point.
+
+Import discipline: nothing in this package imports jax at module scope
+(`perf.logical_flops` imports it lazily), so host-only consumers — the
+`Jobs` supervisor, report tooling, test harnesses — never initialize an
+accelerator backend.
+"""
+
+from byzantinemomentum_tpu.obs.recorder import (  # noqa: F401
+    TELEMETRY_NAME,
+    Telemetry,
+    activate,
+    active,
+    counter,
+    deactivate,
+    emit,
+    install_compile_listener,
+    load_records,
+    span,
+)
+from byzantinemomentum_tpu.obs.heartbeat import (  # noqa: F401
+    HEARTBEAT_NAME,
+    read_heartbeat,
+    write_heartbeat,
+)
+from byzantinemomentum_tpu.obs.perf import (  # noqa: F401
+    SlidingRate,
+    StepTimer,
+    host_rss_mb,
+    logical_flops,
+    mfu,
+    peak_flops,
+)
+
+__all__ = [
+    "TELEMETRY_NAME", "Telemetry", "activate", "active", "counter",
+    "deactivate", "emit", "install_compile_listener", "load_records", "span",
+    "HEARTBEAT_NAME", "read_heartbeat", "write_heartbeat",
+    "SlidingRate", "StepTimer", "host_rss_mb", "logical_flops", "mfu",
+    "peak_flops",
+]

@@ -1,0 +1,76 @@
+"""`python -m byzantinemomentum_tpu.obs` — telemetry tooling entry point.
+
+Two modes:
+
+* `--selfcheck`: exercise the whole recorder/heartbeat/report stack in a
+  temporary directory and exit 0 iff every invariant holds — the CI smoke
+  hook (`scripts/run_test_tiers.py` and ad-hoc container checks) that
+  proves the observability layer works without running a training step.
+* `<run_dir>`: render the one-page report (same as `scripts/obs_report.py`).
+"""
+
+import sys
+import tempfile
+
+
+def selfcheck():
+    """End-to-end smoke of the obs stack; returns 0 on success, raising
+    AssertionError (non-zero exit) on any broken invariant."""
+    import pathlib
+
+    from byzantinemomentum_tpu import obs
+
+    with tempfile.TemporaryDirectory(prefix="bmt-obs-selfcheck-") as tmp:
+        tmp = pathlib.Path(tmp)
+        telemetry = obs.Telemetry(tmp, interval=5)
+        obs.activate(telemetry)
+        try:
+            telemetry.event("run_start", argv=["selfcheck"])
+            with telemetry.span("outer"):
+                with telemetry.span("inner", step=1):
+                    pass
+            assert telemetry.counter("recompiles") == 1
+            assert telemetry.counter("recompiles", 2) == 3
+            telemetry.gauge("steps_per_sec", 123.0, step=5)
+            obs.emit("rollback", step=5)       # module-level path
+            with obs.span("module_span"):
+                pass
+            telemetry.event("run_end", status="completed")
+            telemetry.heartbeat(step=5, steps_per_sec=123.0,
+                                rss_mb=obs.host_rss_mb())
+        finally:
+            obs.deactivate()
+            telemetry.close()
+
+        records = obs.load_records(tmp)
+        kinds = {r["kind"] for r in records}
+        assert kinds == {"event", "span", "counter", "gauge"}, kinds
+        spans = {r["name"]: r for r in records if r["kind"] == "span"}
+        assert spans["inner"]["parent"] == spans["outer"]["id"]
+        assert spans["outer"]["parent"] is None
+        counters = [r["value"] for r in records if r["kind"] == "counter"]
+        assert counters == sorted(counters), "counter went backwards"
+        heartbeat = obs.read_heartbeat(tmp)
+        assert heartbeat is not None and heartbeat["step"] == 5
+        assert heartbeat["counters"]["recompiles"] == 3
+        assert heartbeat["last_event"]["name"] == "run_end"
+        assert not (tmp / (obs.HEARTBEAT_NAME + ".tmp")).exists()
+
+        from byzantinemomentum_tpu.obs.report import render_report
+        report = render_report(tmp)
+        assert "recompiles=3" in report and "run_end" in report
+
+    print("obs selfcheck: OK")
+    return 0
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if "--selfcheck" in argv:
+        return selfcheck()
+    from byzantinemomentum_tpu.obs.report import main as report_main
+    return report_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""The run's `heartbeat.json` — one small, atomically-replaced file the
+supervisor's watchdog reads instead of inferring liveness from study-CSV
+mtime.
+
+Write discipline mirrors `checkpoint.py`: payload to a same-directory
+`.tmp`, fsync, `os.replace` onto the final name — a reader never sees a
+torn file, and a SIGKILL at any instant leaves either the previous
+heartbeat or the new one. The payload always carries:
+
+  version   int    heartbeat schema version (1)
+  pid       int    writer process id
+  updated   float  wall-clock unix seconds of the write
+  step      int    training step as of the write
+
+plus whatever the writer knows: `steps_per_sec`, `device_step_ms`,
+`rss_mb`, `mfu`, `status`, the recorder's counter totals under `counters`
+and its `last_event` summary.
+
+Host-only on purpose (no jax import): `utils/jobs.py` reads heartbeats
+from supervisor threads that must never initialize a backend.
+"""
+
+import json
+import os
+import pathlib
+import time
+
+__all__ = ["HEARTBEAT_NAME", "write_heartbeat", "read_heartbeat"]
+
+HEARTBEAT_NAME = "heartbeat.json"
+VERSION = 1
+
+
+def write_heartbeat(directory, payload):
+    """Atomically write `heartbeat.json` under `directory`; `payload` keys
+    override nothing — `version`/`pid`/`updated` are stamped here so every
+    heartbeat is self-describing and freshness-comparable."""
+    directory = pathlib.Path(directory)
+    record = {"version": VERSION, "pid": os.getpid(), "updated": time.time()}
+    record.update(payload)
+    path = directory / HEARTBEAT_NAME
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("w", encoding="utf-8") as fd:
+        fd.write(json.dumps(record, ensure_ascii=False, indent="\t"))
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_heartbeat(directory):
+    """The parsed heartbeat of a run directory, or None when absent or
+    unreadable (never raises: the watchdog must not die on a mangled
+    file, and a missing heartbeat just means the fallback signal rules)."""
+    path = pathlib.Path(directory) / HEARTBEAT_NAME
+    try:
+        record = json.loads(path.read_text(encoding="utf-8"))
+    except Exception:
+        return None
+    return record if isinstance(record, dict) else None

@@ -1,0 +1,169 @@
+"""Live performance measurement: sliding-window throughput, device-honest
+chunk timing, host RSS, and the FLOP/MFU helpers shared with `bench.py`.
+
+Only `StepTimer` (numpy) and `logical_flops` (jax, imported lazily) touch
+array libraries; everything else is stdlib so the supervisor and report
+tooling can import this module without initializing a backend.
+"""
+
+import collections
+import time
+
+from byzantinemomentum_tpu.utils.misc import AccumulatedTimedContext
+
+__all__ = ["SlidingRate", "StepTimer", "host_rss_mb", "peak_flops", "mfu",
+           "logical_flops", "PEAK_BF16_FLOPS"]
+
+
+class SlidingRate:
+    """Steps/s over a sliding wall-clock window.
+
+    Fed (time, step) pairs every dispatch (cheap: no device sync), read at
+    telemetry sample points. The window makes the gauge reflect *current*
+    throughput — a mid-run slowdown (thermal, neighbor, tunnel) shows up
+    within `window_s` instead of being averaged into the whole run.
+    """
+
+    def __init__(self, window_s=30.0):
+        self.window_s = float(window_s)
+        self._points = collections.deque()
+
+    def update(self, steps, now=None):
+        now = time.monotonic() if now is None else now
+        self._points.append((now, int(steps)))
+        floor = now - self.window_s
+        while len(self._points) > 2 and self._points[0][0] < floor:
+            self._points.popleft()
+
+    def rate(self):
+        """Current steps/s, or None before two points span the window."""
+        if len(self._points) < 2:
+            return None
+        (t0, s0), (t1, s1) = self._points[0], self._points[-1]
+        if t1 <= t0:
+            return None
+        return (s1 - s0) / (t1 - t0)
+
+
+class StepTimer:
+    """Device-honest timing of one dispatched chunk, built on
+    `AccumulatedTimedContext`'s sync-barrier protocol: the barrier is a
+    tiny device→host transfer of a token array (the state's step counter),
+    which cannot complete before the device has executed everything
+    enqueued — `block_until_ready` can lie on tunneled backends, a host
+    copy cannot (see `bench.py`'s measurement notes).
+
+    Usage per measured chunk:
+        timer.start(pre_dispatch_token)   # drains the pipeline, starts
+        ... dispatch the chunk ...
+        dt = timer.stop(post_dispatch_token)  # waits for it, stops
+    """
+
+    def __init__(self, label="device chunk"):
+        self._token = None
+        self._ctx = AccumulatedTimedContext(label=label, sync=self._sync)
+        self._last_total = 0.0
+
+    def _sync(self):
+        if self._token is not None:
+            import numpy as np
+            np.asarray(self._token)
+
+    def start(self, token):
+        self._token = token
+        self._ctx.__enter__()
+
+    def stop(self, token):
+        """Seconds the chunk took on-device (wall time between the two
+        drained barriers)."""
+        self._token = token
+        self._ctx.__exit__(None, None, None)
+        self._token = None
+        elapsed = self._ctx.total - self._last_total
+        self._last_total = self._ctx.total
+        return elapsed
+
+    @property
+    def total(self):
+        return self._ctx.total
+
+
+def host_rss_mb():
+    """Resident-set size of this process in MiB (Linux `/proc` fast path,
+    `resource` fallback), or None when neither source is readable."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fd:
+            for line in fd:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0  # kB -> MiB
+    except OSError:
+        pass
+    try:
+        import resource
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return float(rss_kb) / 1024.0
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------------------- #
+# FLOPs / MFU — the single source of truth bench.py quotes
+
+# Peak bf16 matmul throughput per chip, FLOP/s (public spec sheets). MFU is
+# quoted against the bf16 peak for every mode (conservative for f32, which
+# the MXU runs via multi-pass bf16 decomposition).
+PEAK_BF16_FLOPS = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def peak_flops(device_kind):
+    """Peak bf16 FLOP/s for a `jax.Device.device_kind` string (None for
+    chips not in the table — e.g. the CPU backend, where MFU is not a
+    meaningful quote)."""
+    kind = str(device_kind).lower()
+    for tag, peak in PEAK_BF16_FLOPS:
+        if tag in kind:
+            return peak
+    return None
+
+
+def mfu(flops_per_step, steps_per_sec, peak):
+    """Model FLOPs utilization in [0, 1] (None when any input is unknown)."""
+    if not flops_per_step or not steps_per_sec or not peak:
+        return None
+    return float(flops_per_step) * float(steps_per_sec) / float(peak)
+
+
+def flops_of_compiled(compiled):
+    """Per-step logical FLOPs out of a compiled program's
+    `cost_analysis()` (None when the backend reports nothing). XLA counts
+    a `lax.scan` body ONCE, so multi-step fused programs already report
+    per-step FLOPs (verified in bench.py: the M-step program reports the
+    same count as the single-step one)."""
+    try:
+        cost = compiled.cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    return None
+
+
+def logical_flops(fn, *args):
+    """Logical FLOPs per step of jit-compilable `fn(*args)` — the count
+    behind the telemetry MFU gauge, same recipe as `bench.py`'s headline.
+    Lowers and compiles a THROWAWAY copy of the program (lowering only
+    inspects avals, so donated buffers are untouched); returns None on any
+    failure — flop counting is an estimate, never worth crashing a run.
+    """
+    try:
+        import jax
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            lower = jax.jit(fn).lower
+        return flops_of_compiled(lower(*args).compile())
+    except Exception:
+        return None

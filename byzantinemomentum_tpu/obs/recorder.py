@@ -1,0 +1,284 @@
+"""Span/event/counter/gauge recorder writing an append-only
+`telemetry.jsonl` per run.
+
+Record schema — one JSON object per line, every record carries:
+
+  t     float   wall-clock unix seconds the record was written
+  kind  str     "span" | "event" | "counter" | "gauge"
+  name  str     what the record describes (snake_case)
+
+plus per-kind fields:
+
+  span     id (int), parent (int | None), dur (float seconds); the record
+           is written at span EXIT, so `t - dur` is the start time and
+           nesting is reconstructed through `parent`
+  event    data (dict, optional) — arbitrary JSON-safe facts
+  counter  value (int, the monotonic running total), inc (int)
+  gauge    value (float), plus optional data (e.g. the step sampled at)
+
+Writes are line-buffered and flushed per record: a SIGKILL mid-run loses
+at most the line being written, and a torn final line is skipped by
+`load_records` (the reader) instead of poisoning analysis — the same
+"walk past the torn tail" stance as `checkpoint.find_latest_valid`.
+
+The module-level *active recorder* (`activate`/`deactivate` + the free
+functions `emit`/`span`/`counter`) is how layers without a handle —
+`checkpoint.py`, the faults retry path — land on the run's timeline.
+Every free function is a cheap no-op when no recorder is active, so
+library code can instrument unconditionally.
+"""
+
+import contextlib
+import itertools
+import json
+import os
+import pathlib
+import threading
+import time
+
+__all__ = ["TELEMETRY_NAME", "Telemetry", "activate", "deactivate", "active",
+           "emit", "span", "counter", "install_compile_listener",
+           "load_records"]
+
+TELEMETRY_NAME = "telemetry.jsonl"
+
+
+def _jsonable(value):
+    """Coerce a record field to something json.dumps accepts (numpy scalars
+    and paths arrive from the driver; a repr beats a crashed recorder)."""
+    if isinstance(value, (str, int, float, bool, type(None))):
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    item = getattr(value, "item", None)  # numpy scalars
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(value)
+
+
+class Telemetry:
+    """One run's telemetry recorder (thread-safe; the driver's main loop
+    and the jax.monitoring compile listener may both write)."""
+
+    def __init__(self, directory, interval=50, filename=TELEMETRY_NAME):
+        self.directory = pathlib.Path(directory)
+        self.interval = max(1, int(interval))
+        self.path = self.directory / filename
+        self._fd = self.path.open("a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._stack = []           # open span ids, innermost last
+        self._counters = {}
+        self._last_event = None    # {"name": ..., "t": ...}
+
+    # -------------------------------------------------------------- #
+    # Record writers
+
+    def _write(self, record):
+        with self._lock:
+            if self._fd is None:
+                return  # closed recorders drop silently (listener races)
+            self._fd.write(json.dumps(record, ensure_ascii=False,
+                                      separators=(",", ":")) + "\n")
+            self._fd.flush()
+
+    def event(self, name, **data):
+        """Point-in-time fact; `data` lands under the record's `data` key."""
+        record = {"t": time.time(), "kind": "event", "name": str(name)}
+        if data:
+            record["data"] = _jsonable(data)
+        self._last_event = {"name": str(name), "t": record["t"]}
+        self._write(record)
+
+    @contextlib.contextmanager
+    def span(self, name, **data):
+        """Timed scope; nesting is recorded through parent span ids. The
+        record is written at exit (`t - dur` recovers the start)."""
+        span_id = next(self._ids)
+        with self._lock:
+            parent = self._stack[-1] if self._stack else None
+            self._stack.append(span_id)
+        start = time.monotonic()
+        try:
+            yield span_id
+        finally:
+            dur = time.monotonic() - start
+            with self._lock:
+                if span_id in self._stack:
+                    self._stack.remove(span_id)
+            record = {"t": time.time(), "kind": "span", "name": str(name),
+                      "id": span_id, "parent": parent, "dur": dur}
+            if data:
+                record["data"] = _jsonable(data)
+            self._write(record)
+
+    def counter(self, name, inc=1):
+        """Monotonic counter; returns the new running total."""
+        inc = int(inc)
+        if inc < 0:
+            raise ValueError(f"Counter increments must be >= 0, got {inc}")
+        with self._lock:
+            total = self._counters.get(name, 0) + inc
+            self._counters[name] = total
+        self._write({"t": time.time(), "kind": "counter", "name": str(name),
+                     "value": total, "inc": inc})
+        return total
+
+    def gauge(self, name, value, **data):
+        """Sampled measurement (steps/s, device step ms, RSS, MFU)."""
+        record = {"t": time.time(), "kind": "gauge", "name": str(name),
+                  "value": float(value)}
+        if data:
+            record["data"] = _jsonable(data)
+        self._write(record)
+
+    # -------------------------------------------------------------- #
+    # State the heartbeat snapshots
+
+    @property
+    def counters(self):
+        with self._lock:
+            return dict(self._counters)
+
+    @property
+    def last_event(self):
+        return self._last_event
+
+    def heartbeat(self, step, **gauges):
+        """Atomically (re)write the run's `heartbeat.json` with the current
+        counter totals and last-event summary (see `heartbeat.py`)."""
+        from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+        payload = {"step": int(step), "counters": self.counters,
+                   "last_event": self._last_event}
+        payload.update({k: _jsonable(v) for k, v in gauges.items()})
+        write_heartbeat(self.directory, payload)
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                self._fd.close()
+                self._fd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ------------------------------------------------------------------------- #
+# Module-level active recorder: how handle-less layers reach the timeline
+
+_ACTIVE = None
+
+
+def activate(telemetry):
+    """Make `telemetry` the process's active recorder (returns it)."""
+    global _ACTIVE
+    _ACTIVE = telemetry
+    return telemetry
+
+
+def deactivate():
+    """Clear the active recorder (does NOT close it)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active():
+    return _ACTIVE
+
+
+def emit(name, **data):
+    """Record an event on the active recorder, if any."""
+    if _ACTIVE is not None:
+        _ACTIVE.event(name, **data)
+
+
+def counter(name, inc=1):
+    """Bump a counter on the active recorder, if any."""
+    if _ACTIVE is not None:
+        return _ACTIVE.counter(name, inc)
+    return None
+
+
+def span(name, **data):
+    """Span context on the active recorder; a no-op scope when inactive."""
+    if _ACTIVE is not None:
+        return _ACTIVE.span(name, **data)
+    return contextlib.nullcontext()
+
+
+# ------------------------------------------------------------------------- #
+# Recompile detection
+
+def install_compile_listener(telemetry):
+    """Count XLA (re)compiles through `jax.monitoring`'s duration events:
+    every `backend_compile` key bumps the `recompiles` counter and records
+    a `compile` event with the backend-reported duration (the broader
+    `/jax/core/compile/...` family also fires per jaxpr TRACE — hundreds
+    per run — so only the actual backend compile counts). After the warmup
+    compiles, a rising counter mid-run is the recompile smell (shape
+    drift, milestone-residual windows, quorum rebuilds).
+
+    Returns True when the listener could be installed (the monitoring API
+    is version-dependent; absence degrades to a zero counter, not a crash).
+    Imports jax lazily — see the package import discipline.
+    """
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+    register = getattr(monitoring, "register_event_duration_secs_listener",
+                       None)
+    if register is None:
+        return False
+
+    def _on_duration(event, duration, **kwargs):
+        try:
+            if "backend_compile" in str(event):
+                telemetry.counter("recompiles")
+                telemetry.event("compile", key=str(event),
+                                seconds=float(duration))
+        except Exception:
+            pass  # a dead recorder must never break compilation
+
+    try:
+        register(_on_duration)
+    except Exception:
+        return False
+    return True
+
+
+# ------------------------------------------------------------------------- #
+# Reader
+
+def load_records(path):
+    """Parse a `telemetry.jsonl` (file path or run directory) into a list
+    of record dicts, skipping unparsable lines (a SIGKILL can tear the last
+    one). Returns [] for a missing file."""
+    path = pathlib.Path(path)
+    if path.is_dir():
+        path = path / TELEMETRY_NAME
+    records = []
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError:
+        return records
+    for line in text.split(os.linesep if os.linesep in text else "\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(record, dict):
+            records.append(record)
+    return records

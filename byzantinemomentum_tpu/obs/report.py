@@ -1,0 +1,156 @@
+"""One-page text summary of a run directory's telemetry.
+
+`render_report(run_dir)` digests `telemetry.jsonl` + `heartbeat.json` (+
+`config.json` when present) into the questions an operator actually asks
+of a run: is it alive, how fast is it going, what did compiles/checkpoints
+cost, and did anything bad (fault, rollback, restart) happen on the
+timeline. Pure stdlib — usable over any run directory, live or dead, with
+no accelerator stack.
+
+Entry points: `scripts/obs_report.py <run_dir>` and
+`python -m byzantinemomentum_tpu.obs <run_dir>`.
+"""
+
+import argparse
+import json
+import pathlib
+import time
+
+from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat
+from byzantinemomentum_tpu.obs.recorder import load_records
+
+__all__ = ["render_report", "main"]
+
+# Events worth listing individually on the one-pager (the resilience
+# timeline); everything else is summarized by count.
+_TIMELINE_EVENTS = ("restart", "rollback", "divergence_giveup", "retry",
+                    "checkpoint_invalid", "profiler_window", "run_start",
+                    "run_end")
+
+
+def _fmt_seconds(seconds):
+    if seconds is None:
+        return "?"
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:.0f}us"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:.1f}ms"
+    if seconds < 120.0:
+        return f"{seconds:.2f}s"
+    return f"{seconds / 60.0:.1f}min"
+
+
+def _stats(values):
+    values = [float(v) for v in values]
+    return (min(values), sum(values) / len(values), max(values))
+
+
+def render_report(run_dir):
+    """The report as one string (trailing newline included)."""
+    run_dir = pathlib.Path(run_dir)
+    records = load_records(run_dir)
+    heartbeat = read_heartbeat(run_dir)
+    lines = [f"== Run report: {run_dir} =="]
+
+    config = None
+    try:
+        config = json.loads((run_dir / "config.json").read_text())
+    except Exception:
+        pass
+    if config:
+        keys = ("model", "dataset", "gar", "attack", "nb_workers",
+                "nb_decl_byz", "nb_real_byz", "nb_steps")
+        summary = ", ".join(f"{k}={config[k]}" for k in keys if k in config)
+        lines.append(f"config: {summary}")
+
+    if heartbeat is None:
+        lines.append("heartbeat: (none)")
+    else:
+        age = time.time() - float(heartbeat.get("updated", 0.0))
+        fields = [f"step {heartbeat.get('step', '?')}",
+                  f"age {_fmt_seconds(age)}",
+                  f"pid {heartbeat.get('pid', '?')}"]
+        for key, unit in (("steps_per_sec", " steps/s"),
+                          ("device_step_ms", " ms/step (device)"),
+                          ("rss_mb", " MiB RSS"), ("mfu", " MFU")):
+            value = heartbeat.get(key)
+            if isinstance(value, (int, float)):
+                fields.append(f"{value:.3g}{unit}")
+        if heartbeat.get("status"):
+            fields.append(f"status={heartbeat['status']}")
+        lines.append("heartbeat: " + ", ".join(fields))
+
+    if not records:
+        lines.append("telemetry: (no telemetry.jsonl)")
+        return "\n".join(lines) + "\n"
+    lines.append(f"telemetry: {len(records)} records")
+
+    counters = {}
+    for record in records:
+        if record.get("kind") == "counter":
+            counters[record.get("name")] = record.get("value")
+    if counters:
+        lines.append("counters: " + ", ".join(
+            f"{name}={value}" for name, value in sorted(counters.items())))
+
+    spans = {}
+    for record in records:
+        if record.get("kind") == "span" and "dur" in record:
+            spans.setdefault(record.get("name"), []).append(record["dur"])
+    if spans:
+        lines.append("spans:")
+        for name, durs in sorted(spans.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            lo, mean, hi = _stats(durs)
+            lines.append(f"  {name:<20} x{len(durs):<4} "
+                         f"total {_fmt_seconds(sum(durs)):<8} "
+                         f"mean {_fmt_seconds(mean):<8} "
+                         f"max {_fmt_seconds(hi)}")
+
+    gauges = {}
+    for record in records:
+        if record.get("kind") == "gauge" and "value" in record:
+            gauges.setdefault(record.get("name"), []).append(record["value"])
+    if gauges:
+        lines.append("gauges:")
+        for name, values in sorted(gauges.items()):
+            lo, mean, hi = _stats(values)
+            lines.append(f"  {name:<20} x{len(values):<4} "
+                         f"min {lo:.4g}  mean {mean:.4g}  max {hi:.4g}")
+
+    timeline = [r for r in records if r.get("kind") == "event"
+                and r.get("name") in _TIMELINE_EVENTS]
+    if timeline:
+        t0 = records[0].get("t", 0.0)
+        lines.append("timeline:")
+        for record in timeline[-20:]:
+            offset = _fmt_seconds(max(0.0, record.get("t", t0) - t0))
+            data = record.get("data") or {}
+            extra = " ".join(f"{k}={v}" for k, v in sorted(data.items()))
+            lines.append(f"  +{offset:<9} {record.get('name')}"
+                         + (f"  {extra}" if extra else ""))
+
+    other = {}
+    for record in records:
+        if (record.get("kind") == "event"
+                and record.get("name") not in _TIMELINE_EVENTS):
+            other[record.get("name")] = other.get(record.get("name"), 0) + 1
+    if other:
+        lines.append("other events: " + ", ".join(
+            f"{name} x{count}" for name, count in sorted(other.items())))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="obs_report",
+        description="Render a one-page text summary of a run directory's "
+                    "telemetry (telemetry.jsonl + heartbeat.json)")
+    parser.add_argument("run_dir", help="result directory of one run")
+    args = parser.parse_args(argv)
+    run_dir = pathlib.Path(args.run_dir)
+    if not run_dir.is_dir():
+        print(f"obs_report: {run_dir} is not a directory")
+        return 1
+    print(render_report(run_dir), end="")
+    return 0

@@ -26,8 +26,13 @@ attempt before parking it in `.failed` forever):
   previous `.failed` directory holding a valid checkpoint is adopted as the
   new pending directory and resumed, rather than rotated away/ignored;
 * **heartbeat watchdog** — with `heartbeat_timeout`, a subprocess whose
-  study CSV stops advancing for that long is SIGKILLed and retried (hung
-  collective, wedged remote device, ...);
+  progress signal stops advancing for that long is SIGKILLed and retried
+  (hung collective, wedged remote device, ...). The signal is the driver's
+  `heartbeat.json` (PR 3, `obs/heartbeat.py` — written atomically with the
+  step and wall time, so the kill decision is signal-based); runs without
+  a heartbeat yet (legacy drivers, cold starts before the first telemetry
+  write) fall back to study-CSV mtime, and the watchdog logs which signal
+  it is tracking;
 * the `.pending`/`.failed` version rotation is race-free under concurrent
   worker threads (the rename itself is the existence test, serialized by a
   per-results-dir lock).
@@ -46,6 +51,8 @@ import threading
 import time
 
 from byzantinemomentum_tpu.utils import logging as _log
+# Host-only (no jax import): safe in supervisor threads
+from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat as _read_heartbeat
 
 __all__ = ["Jobs", "dict_to_cmdlist"]
 
@@ -96,6 +103,9 @@ class Jobs:
             raise ValueError(f"Expected a positive supercharge, got {supercharge}")
         if max_retries < 0:
             raise ValueError(f"Expected a non-negative retry count, got {max_retries}")
+        if heartbeat_timeout is not None and heartbeat_timeout <= 0:
+            raise ValueError(f"Expected a positive heartbeat timeout, got "
+                             f"{heartbeat_timeout}")
         self.results_dir = pathlib.Path(results_dir)
         self.results_dir.mkdir(parents=True, exist_ok=True)
         self.seeds = tuple(seeds)
@@ -213,42 +223,67 @@ class Jobs:
                    f"attempt(s) (logs kept in {run_name}.failed)")
 
     def _spawn(self, run_name, pending, cmd, slot_device):
-        """Launch one attempt; with a heartbeat timeout, watchdog the study
-        CSV and SIGKILL the subprocess when it stalls. Logs are opened in
-        append mode so every attempt's output is preserved."""
+        """Launch one attempt; with a heartbeat timeout, watchdog the run's
+        progress signal and SIGKILL the subprocess when it stalls. Logs are
+        opened in append mode so every attempt's output is preserved."""
         with (pending / "stdout.log").open("ab") as out, \
                 (pending / "stderr.log").open("ab") as err:
             proc = subprocess.Popen(cmd, stdout=out, stderr=err,
                                     env=self._env(slot_device))
             if self.heartbeat_timeout is None:
                 return proc.wait()
-            study = pending / "study"
-            poll = max(0.05, min(0.5, self.heartbeat_timeout / 4))
+            poll = self._poll_interval()
             last_beat = time.monotonic()
-            last_sig = self._heartbeat(study)
+            last_source = None
+            last_sig = self._progress_signature(pending)
             while True:
                 try:
                     return proc.wait(timeout=poll)
                 except subprocess.TimeoutExpired:
                     pass
-                sig = self._heartbeat(study)
+                sig = self._progress_signature(pending)
+                source = sig[0] if sig is not None else None
+                if source is not None and source != last_source:
+                    # Which liveness signal rules: the driver's atomic
+                    # heartbeat.json when present, study-CSV mtime for
+                    # legacy/cold-start runs that have none yet
+                    _log.info(f"{run_name}: watchdog tracking "
+                              + ("heartbeat.json" if source == "heartbeat"
+                                 else "study-CSV mtime (no heartbeat yet)"))
+                    last_source = source
                 now = time.monotonic()
                 if sig != last_sig:
                     last_sig, last_beat = sig, now
                 elif now - last_beat > self.heartbeat_timeout:
-                    _log.error(f"{run_name}: heartbeat lost (study CSV "
+                    _log.error(f"{run_name}: heartbeat lost "
+                               f"({'heartbeat.json' if source == 'heartbeat' else 'study CSV'} "
                                f"stalled > {self.heartbeat_timeout}s); "
                                f"killing the subprocess")
                     proc.kill()
                     return proc.wait()
 
+    def _poll_interval(self):
+        """Seconds between watchdog polls: a quarter of the timeout so a
+        stall is caught promptly, clamped to [0.05, 0.5] — the FLOOR is
+        applied last, so a tiny `heartbeat_timeout` (< 0.2) polls at 20 Hz
+        instead of busy-spinning `proc.wait` at `timeout/4` granularity."""
+        return max(0.05, min(0.5, self.heartbeat_timeout / 4.0))
+
     @staticmethod
-    def _heartbeat(study):
-        """Progress signature of the run's study CSV (None before the
-        driver creates it — process start then counts as the last beat)."""
+    def _progress_signature(pending):
+        """Progress signature of one run attempt, tagged with its source:
+        `("heartbeat", step, updated)` from the run's atomic
+        `heartbeat.json` when one exists (the driver refreshes it every
+        telemetry sample — a signal, not an inference), else
+        `("study", size, mtime)` from the study CSV, else None (process
+        start then counts as the last beat)."""
+        heartbeat = _read_heartbeat(pending)
+        if heartbeat is not None:
+            return ("heartbeat", heartbeat.get("step"),
+                    heartbeat.get("updated"))
         try:
-            stat = study.stat()
-            return (stat.st_size, stat.st_mtime_ns)
+            stat = (pending / "study").stat()
+            return ("study", stat.st_size, stat.st_mtime_ns)
         except OSError:
             return None
 

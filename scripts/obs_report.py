@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""One-page text summary of any run directory's telemetry.
+
+Thin wrapper over `byzantinemomentum_tpu.obs.report` (also reachable as
+`python -m byzantinemomentum_tpu.obs <run_dir>`): heartbeat freshness,
+counters, span cost stats, throughput gauges and the resilience timeline
+(faults / rollbacks / restarts) — pure stdlib, no accelerator init, works
+on live and dead runs alike.
+
+Usage: python scripts/obs_report.py <run_dir>
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from byzantinemomentum_tpu.obs.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,12 @@ a single `--runslow` run exceeds any reasonable review window (VERDICT r4
 item 3); per-file shards keep each run bounded and the artifact shows all
 of them green at the recorded HEAD.
 
+The harness eats its own dog food (PR 3): before anything else it runs the
+`python -m byzantinemomentum_tpu.obs --selfcheck` smoke, and it records its
+own telemetry — one span per tier/shard with the pass counts, the obs
+recorder writing `TESTS_r{N}.telemetry.jsonl` next to the artifact — so a
+CI log reader gets the same timeline format as a training run.
+
 Usage: python scripts/run_test_tiers.py --round 5
 """
 
@@ -20,6 +26,9 @@ import sys
 import time
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from byzantinemomentum_tpu.obs import Telemetry  # noqa: E402
 
 # Token-wise parse: a summary line may lack any given token (e.g. an
 # all-fail shard prints only "3 failed in ..."), so match each count
@@ -60,17 +69,43 @@ def main():
     head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=ROOT,
                           capture_output=True, text=True).stdout.strip()
 
+    telemetry = Telemetry(ROOT, filename=f"TESTS_r{args.round:02d}"
+                                         ".telemetry.jsonl")
+    telemetry.event("run_start", round=args.round, git_head=head)
+
+    # Observability smoke: the obs stack must hold its own invariants
+    # before its telemetry of the tiers below means anything
+    print("obs selfcheck ...", flush=True)
+    selfcheck = subprocess.run(
+        [sys.executable, "-m", "byzantinemomentum_tpu.obs", "--selfcheck"],
+        cwd=ROOT, capture_output=True, text=True)
+    obs_selfcheck = {"returncode": selfcheck.returncode}
+    if selfcheck.returncode != 0:
+        obs_selfcheck["tail"] = (selfcheck.stdout
+                                 + selfcheck.stderr).splitlines()[-12:]
+    telemetry.event("obs_selfcheck", returncode=selfcheck.returncode)
+    print(f"  {obs_selfcheck}", flush=True)
+
     print("default tier ...", flush=True)
-    default = run_pytest(["tests/"])
+    with telemetry.span("tier_default"):
+        default = run_pytest(["tests/"])
+    telemetry.event("tier_result", tier="default", **default)
+    telemetry.counter("tests_passed", default["passed"])
+    telemetry.counter("tests_failed", default["failed"])
     print(f"  {default}", flush=True)
 
     shards = {}
     for path in sorted((ROOT / "tests").glob("test_*.py")):
         print(f"slow tier: {path.name} ...", flush=True)
-        res = run_pytest([f"tests/{path.name}", "--runslow", "-m", "slow"])
+        with telemetry.span("tier_slow", shard=path.name):
+            res = run_pytest([f"tests/{path.name}", "--runslow", "-m", "slow"])
         if res["returncode"] == 5:  # file has no slow tests
             continue
         shards[path.name] = res
+        telemetry.event("tier_result", tier="slow", shard=path.name,
+                        **{k: v for k, v in res.items() if k != "tail"})
+        telemetry.counter("tests_passed", res["passed"])
+        telemetry.counter("tests_failed", res["failed"])
         print(f"  {res}", flush=True)
 
     slow_total = {
@@ -84,14 +119,22 @@ def main():
         "git_head": head,
         "host": "1-core TPU build host (slow tier sharded by file "
                 "because one --runslow run exceeds a review window)",
+        "obs_selfcheck": obs_selfcheck,
         "default_tier": default,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
+        "telemetry": telemetry.path.name,
         "green": bool(default["failed"] == 0 and default["errors"] == 0
                       and default["returncode"] == 0
+                      and obs_selfcheck["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }
+    telemetry.event("run_end", green=out["green"],
+                    passed=default["passed"] + slow_total["passed"],
+                    failed=default["failed"] + slow_total["failed"],
+                    seconds=default["seconds"] + slow_total["seconds"])
+    telemetry.close()
     path = pathlib.Path(args.out) if args.out else (
         ROOT / f"TESTS_r{args.round:02d}.json")
     path.write_text(json.dumps(out, indent=2) + "\n")

@@ -21,7 +21,8 @@ import pandas
 from byzantinemomentum_tpu import models, ops, utils
 
 __all__ = ["Session", "LinePlot", "BoxPlot", "display", "select", "discard",
-           "fault_timeline", "fault_rate_sweep"]
+           "fault_timeline", "fault_rate_sweep",
+           "load_telemetry", "run_health", "throughput_sweep"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
 TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000, "kmnist": 60000,
@@ -298,6 +299,117 @@ def fault_rate_sweep(sessions, metric="Average loss", reducer="last"):
 
 
 # --------------------------------------------------------------------------- #
+# Run-health analysis (PR 3, `byzantinemomentum_tpu/obs/`): the system
+# timeline — telemetry.jsonl's spans/events/counters/gauges — turned into
+# the plots an operator reads first when a run looks sick.
+
+def _session_dir(run):
+    """Result-directory Path of a Session / path-like."""
+    if isinstance(run, Session):
+        return run.path
+    return pathlib.Path(run)
+
+
+def load_telemetry(run):
+    """One run's `telemetry.jsonl` as a DataFrame (one row per record;
+    columns: t, kind, name, value, dur, id, parent, step, data). `step` is
+    lifted out of gauge records' data so timeline plots can index by step
+    like every study plot. Raises when the run has no telemetry."""
+    from byzantinemomentum_tpu.obs import load_records
+    records = load_records(_session_dir(run))
+    if not records:
+        raise utils.UserException(
+            f"No telemetry.jsonl under {str(_session_dir(run))!r}; the run "
+            f"must be recorded with telemetry on (the default with "
+            f"'--result-directory')")
+    rows = []
+    for record in records:
+        row = dict(record)
+        data = row.pop("data", None)
+        if isinstance(data, dict):
+            row["step"] = data.get("step")
+            row["data"] = data
+        else:
+            row["step"] = None
+        rows.append(row)
+    return pandas.DataFrame(rows)
+
+
+def run_health(run):
+    """One run's health timeline: device-honest step time (ms, left axis)
+    and steps/s (right axis) over steps, with the resilience events —
+    rollbacks, restarts, divergence give-ups — marked as vertical lines
+    and the fault counter's running total noted in the title."""
+    frame = load_telemetry(run)
+    gauges = frame[frame["kind"] == "gauge"]
+    plot = LinePlot()
+    plotted = False
+    for name, axkey in (("device_step_ms", "ms"), ("steps_per_sec", "sps")):
+        series = gauges[gauges["name"] == name].dropna(subset=["step"])
+        if not len(series):
+            continue
+        sub = pandas.DataFrame({name: series["value"].values},
+                               index=pandas.Index(series["step"].values,
+                                                  name="Step number"))
+        plot.include(sub, name, axkey=axkey)
+        plotted = True
+    if not plotted:
+        raise utils.UserException(
+            "No step-time/throughput gauges in the telemetry; was the run "
+            "long enough to reach a telemetry sample?")
+    events = frame[frame["kind"] == "event"]
+    for name, color in (("rollback", "red"), ("restart", "orange"),
+                        ("divergence_giveup", "black")):
+        for _, event in events[events["name"] == name].iterrows():
+            data = event.get("data")
+            step = data.get("step") if isinstance(data, dict) else None
+            if step is not None:
+                plot.vline(step, color=color, label=name)
+    counters = frame[frame["kind"] == "counter"]
+    faults = counters[counters["name"] == "faults_injected"]
+    suffix = (f" ({int(faults['value'].iloc[-1])} faults injected)"
+              if len(faults) else "")
+    plot.finalize("Run health" + suffix, "Step number",
+                  "Device step time (ms)", zlabel="Steps/s")
+    return plot
+
+
+def throughput_sweep(sessions, reducer="mean"):
+    """One point per run: the run's steps/s (mean or final telemetry
+    gauge) indexed by run name — the cross-run companion of `run_health`
+    (does a config change cost throughput?). Returns `(frame, plot)` like
+    `fault_rate_sweep`. Runs without telemetry or throughput gauges are
+    skipped with a warning."""
+    if reducer not in ("last", "mean"):
+        raise utils.UserException(
+            f"Unknown reducer {reducer!r}, expected 'last' or 'mean'")
+    names, values = [], []
+    for session in sessions:
+        try:
+            frame = load_telemetry(session)
+        except utils.UserException as err:
+            utils.warning(f"{session}: {err}; skipped")
+            continue
+        gauges = frame[(frame["kind"] == "gauge")
+                       & (frame["name"] == "steps_per_sec")]
+        if not len(gauges):
+            utils.warning(f"{session}: no throughput gauges; skipped")
+            continue
+        series = gauges["value"]
+        values.append(float(series.iloc[-1]) if reducer == "last"
+                      else float(series.mean()))
+        names.append(session.name if isinstance(session, Session)
+                     else pathlib.Path(session).name)
+    frame = pandas.DataFrame(
+        {"Steps/s": values}, index=pandas.Index(names, name="Run"))
+    plot = BoxPlot()
+    for name, value in zip(names, values):
+        plot.include([value], name)
+    plot.finalize("Throughput sweep", "Steps/s")
+    return frame, plot
+
+
+# --------------------------------------------------------------------------- #
 # Interactive DataFrame viewer (reference `study.py:44-78`, `:129-180`:
 # a GTK3 TreeView window, degrading to a warning when GTK is unavailable)
 
@@ -432,6 +544,18 @@ class LinePlot:
                 e = data[col + errs]
                 ax.fill_between(x, y - e, y + e, color=color, alpha=0.2 * lalp)
             self._cnt += 1
+        return self
+
+    def vline(self, x, color="gray", label=None):
+        """Vertical event marker (telemetry overlays: rollbacks, restarts,
+        faults on the `run_health` timeline). Repeated labels are legended
+        once."""
+        seen = getattr(self, "_vline_labels", set())
+        self._vline_labels = seen
+        self._ax.axvline(x, linestyle=":", color=color, linewidth=1,
+                         label=None if label in seen else label)
+        if label is not None:
+            seen.add(label)
         return self
 
     def finalize(self, title, xlabel, ylabel, zlabel=None, xmin=None,

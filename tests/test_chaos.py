@@ -92,6 +92,18 @@ def test_sigkill_autoresume_is_bit_identical(tmp_path):
     restarts = [row.split("\t")[-1] for row in part_rows]
     assert restarts[0] == "0" and restarts[-1] == "1"
     assert set(restarts) == {"0", "1"}
+    # Telemetry acceptance: the per-record-flushed timeline survives the
+    # SIGKILL, and the resumed process stamps the restart event with the
+    # step it restarted from; the heartbeat reflects the completed run
+    from byzantinemomentum_tpu import obs
+    records = obs.load_records(part)
+    restart_events = [r for r in records if r.get("name") == "restart"]
+    assert restart_events, "resumed run must stamp a restart event"
+    resume_step = restart_events[-1]["data"]["step"]
+    assert resume_step == checkpoint.checkpoint_step(survivor)
+    assert sum(1 for r in records if r.get("name") == "run_start") == 2
+    heartbeat = obs.read_heartbeat(part)
+    assert heartbeat["step"] == 8 and heartbeat["status"] == "completed"
 
 
 @pytest.mark.slow

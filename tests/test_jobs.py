@@ -130,6 +130,60 @@ def test_heartbeat_watchdog_spares_advancing_run(tmp_path):
     assert (tmp_path / "steady-1" / "out.txt").read_text() == "done"
 
 
+def test_heartbeat_watchdog_prefers_heartbeat_json(tmp_path):
+    """A run that never writes a study CSV but keeps refreshing its atomic
+    heartbeat.json (the obs telemetry signal) is NOT killed — the watchdog
+    consumes the heartbeat instead of inferring liveness from CSV mtime."""
+    script = (
+        "import sys, time, json, os, pathlib\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "for i in range(8):\n"
+        "    tmp = d / 'heartbeat.json.tmp'\n"
+        "    tmp.write_text(json.dumps({'step': i, 'updated': time.time()}))\n"
+        "    os.replace(tmp, d / 'heartbeat.json')\n"
+        "    time.sleep(0.25)\n"
+        "(d / 'out.txt').write_text('done')\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0,
+                heartbeat_timeout=1.0)
+    jobs.submit("beating", [sys.executable, "-c", script])
+    jobs.wait()
+    assert (tmp_path / "beating-1" / "out.txt").read_text() == "done"
+
+
+def test_heartbeat_watchdog_kills_stale_heartbeat(tmp_path):
+    """A heartbeat.json that stops updating is a stall signal like any
+    other: the subprocess is killed once it goes stale past the timeout."""
+    script = (
+        "import sys, time, json, pathlib\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "(d / 'heartbeat.json').write_text("
+        "json.dumps({'step': 0, 'updated': time.time()}))\n"
+        "time.sleep(60)\n")
+    jobs = Jobs(tmp_path, seeds=(1,), max_retries=0, retry_backoff=0,
+                heartbeat_timeout=0.5)
+    jobs.submit("stale", [sys.executable, "-c", script])
+    start = time.monotonic()
+    jobs.wait()
+    assert time.monotonic() - start < 30
+    assert (tmp_path / "stale-1.failed").is_dir()
+
+
+def test_watchdog_poll_floor(tmp_path):
+    """The poll interval is clamped to [0.05, 0.5]: a tiny
+    `heartbeat_timeout` (< 0.2) must not busy-spin the watchdog, a huge
+    one must not make stall detection lazier than 0.5 s."""
+    def poll(timeout):
+        return Jobs(tmp_path, seeds=(1,),
+                    heartbeat_timeout=timeout)._poll_interval()
+    assert poll(0.01) == 0.05
+    assert poll(0.1) == 0.05
+    assert poll(1.0) == 0.25
+    assert poll(100.0) == 0.5
+    import pytest
+    with pytest.raises(ValueError, match="heartbeat timeout"):
+        Jobs(tmp_path, seeds=(1,), heartbeat_timeout=0)
+
+
 def test_rotation_skips_existing_versions(tmp_path):
     """`_rotate_away` never clobbers previous rotations: with `.0`/`.1`
     already present (each non-empty), the next rotation lands on `.2`."""

@@ -1,0 +1,375 @@
+"""Telemetry subsystem tests (tier-1, no TPU): recorder invariants (span
+nesting, JSONL schema, counter monotonicity), atomic heartbeat replace,
+perf helpers, the report renderer, the `--selfcheck` entry point, the
+bench.py backend fallback, and the driver wiring end to end (telemetry
+files from a real run, restart/rollback events on the timeline)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import obs
+from byzantinemomentum_tpu.obs import recorder as obs_recorder
+from byzantinemomentum_tpu.obs.report import render_report
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+REQUIRED_KEYS = {"t", "kind", "name"}
+PER_KIND_KEYS = {"span": {"id", "parent", "dur"},
+                 "counter": {"value", "inc"},
+                 "gauge": {"value"},
+                 "event": set()}
+
+
+# --------------------------------------------------------------------------- #
+# Recorder
+
+def test_jsonl_schema(tmp_path):
+    """Every record carries t/kind/name plus its kind's fields, and the
+    file is valid JSONL (one object per line)."""
+    with obs.Telemetry(tmp_path) as t:
+        t.event("run_start", seed=3)
+        with t.span("outer"):
+            t.counter("recompiles")
+        t.gauge("steps_per_sec", 12.5, step=10)
+    for line in (tmp_path / obs.TELEMETRY_NAME).read_text().splitlines():
+        record = json.loads(line)
+        assert REQUIRED_KEYS <= set(record), record
+        assert record["kind"] in PER_KIND_KEYS
+        assert PER_KIND_KEYS[record["kind"]] <= set(record), record
+        assert isinstance(record["t"], float)
+
+
+def test_span_nesting(tmp_path):
+    with obs.Telemetry(tmp_path) as t:
+        with t.span("a"):
+            with t.span("b"):
+                with t.span("c"):
+                    pass
+            with t.span("d"):
+                pass
+        with t.span("e"):
+            pass
+    spans = {r["name"]: r for r in obs.load_records(tmp_path)
+             if r["kind"] == "span"}
+    assert spans["a"]["parent"] is None
+    assert spans["b"]["parent"] == spans["a"]["id"]
+    assert spans["c"]["parent"] == spans["b"]["id"]
+    assert spans["d"]["parent"] == spans["a"]["id"]  # sibling of b
+    assert spans["e"]["parent"] is None              # a is closed
+    assert all(s["dur"] >= 0 for s in spans.values())
+    # Exit-ordered: inner spans are written before their parents
+    names = [r["name"] for r in obs.load_records(tmp_path)]
+    assert names.index("c") < names.index("b") < names.index("a")
+
+
+def test_counter_monotonicity(tmp_path):
+    with obs.Telemetry(tmp_path) as t:
+        assert t.counter("x") == 1
+        assert t.counter("x", 4) == 5
+        assert t.counter("x", 0) == 5
+        assert t.counter("y") == 1
+        with pytest.raises(ValueError):
+            t.counter("x", -1)
+    values = [r["value"] for r in obs.load_records(tmp_path)
+              if r["kind"] == "counter" and r["name"] == "x"]
+    assert values == sorted(values) == [1, 5, 5]
+
+
+def test_closed_recorder_drops_silently(tmp_path):
+    t = obs.Telemetry(tmp_path)
+    t.event("before")
+    t.close()
+    t.event("after")          # must not raise (listener races at shutdown)
+    t.counter("after_count")
+    names = [r["name"] for r in obs.load_records(tmp_path)]
+    assert names == ["before"]
+    t.close()                 # idempotent
+
+
+def test_module_level_no_ops_when_inactive(tmp_path):
+    obs.deactivate()
+    obs.emit("nobody_listening")
+    assert obs.counter("nothing") is None
+    with obs.span("still_fine"):
+        pass
+    telem = obs.activate(obs.Telemetry(tmp_path))
+    try:
+        obs.emit("heard", step=1)
+        obs.counter("seen", 2)
+        with obs.span("scoped"):
+            pass
+    finally:
+        obs.deactivate()
+        telem.close()
+    names = {r["name"] for r in obs.load_records(tmp_path)}
+    assert {"heard", "seen", "scoped"} <= names
+
+
+def test_load_records_skips_torn_tail(tmp_path):
+    with obs.Telemetry(tmp_path) as t:
+        t.event("one")
+        t.event("two")
+    path = tmp_path / obs.TELEMETRY_NAME
+    with path.open("a") as fd:
+        fd.write('{"t": 1.0, "kind": "event", "name": "torn by SIGKI')
+    records = obs.load_records(tmp_path)
+    assert [r["name"] for r in records] == ["one", "two"]
+    assert obs.load_records(tmp_path / "missing") == []
+
+
+def test_compile_listener_counts_backend_compiles(tmp_path):
+    monitoring = pytest.importorskip("jax.monitoring")
+    record_fn = getattr(monitoring, "record_event_duration_secs", None)
+    if record_fn is None:
+        pytest.skip("jax.monitoring has no duration-event recording")
+    with obs.Telemetry(tmp_path) as t:
+        if not obs.install_compile_listener(t):
+            pytest.skip("jax.monitoring has no duration listeners")
+        before = t.counters.get("recompiles", 0)
+        record_fn("/test/backend_compile_duration", 0.25)
+        record_fn("/jax/core/compile/jaxpr_trace_duration", 0.01)  # ignored
+        assert t.counters.get("recompiles", 0) == before + 1
+
+
+# --------------------------------------------------------------------------- #
+# Heartbeat
+
+def test_heartbeat_atomic_replace(tmp_path):
+    for step in range(5):
+        obs.write_heartbeat(tmp_path, {"step": step, "status": "running"})
+    heartbeat = obs.read_heartbeat(tmp_path)
+    assert heartbeat["step"] == 4
+    assert heartbeat["version"] == 1
+    assert heartbeat["pid"] == os.getpid()
+    assert heartbeat["updated"] > 0
+    # The tmp staging file never survives a completed write
+    assert not (tmp_path / (obs.HEARTBEAT_NAME + ".tmp")).exists()
+
+
+def test_heartbeat_read_never_raises(tmp_path):
+    assert obs.read_heartbeat(tmp_path) is None              # absent
+    (tmp_path / obs.HEARTBEAT_NAME).write_text("{torn")
+    assert obs.read_heartbeat(tmp_path) is None              # corrupt
+    (tmp_path / obs.HEARTBEAT_NAME).write_text("[1, 2]")
+    assert obs.read_heartbeat(tmp_path) is None              # wrong shape
+
+
+# --------------------------------------------------------------------------- #
+# Perf helpers
+
+def test_sliding_rate_window():
+    rate = obs.SlidingRate(window_s=10.0)
+    assert rate.rate() is None
+    rate.update(0, now=0.0)
+    rate.update(10, now=2.0)
+    assert rate.rate() == pytest.approx(5.0)
+    # Old points age out of the window
+    rate.update(110, now=22.0)
+    assert rate.rate() == pytest.approx((110 - 10) / 20.0)
+
+
+def test_step_timer_measures_between_barriers():
+    timer = obs.StepTimer()
+    token = np.arange(8)
+    timer.start(token)
+    elapsed = timer.stop(token)
+    assert elapsed >= 0.0
+    timer.start(token)
+    second = timer.stop(token)
+    assert second >= 0.0
+    assert timer.total == pytest.approx(elapsed + second)
+
+
+def test_peak_flops_and_mfu():
+    assert obs.peak_flops("TPU v4 chip") == 275e12
+    assert obs.peak_flops("cpu") is None
+    assert obs.mfu(1e12, 100.0, 275e12) == pytest.approx(1e14 / 275e12)
+    assert obs.mfu(None, 100.0, 275e12) is None
+    assert obs.mfu(1e12, 100.0, None) is None
+
+
+def test_logical_flops_counts_a_jitted_program():
+    import jax.numpy as jnp
+    flops = obs.logical_flops(lambda a, b: a @ b,
+                              jnp.ones((64, 64)), jnp.ones((64, 64)))
+    if flops is None:
+        pytest.skip("backend reports no cost analysis")
+    assert flops > 0
+    assert obs.logical_flops(lambda: "not jittable") is None
+
+
+def test_host_rss_mb():
+    rss = obs.host_rss_mb()
+    assert rss is None or rss > 0
+
+
+# --------------------------------------------------------------------------- #
+# Report + selfcheck
+
+def test_render_report(tmp_path):
+    with obs.Telemetry(tmp_path) as t:
+        t.event("run_start", seed=1)
+        t.event("restart", step=4, count=1)
+        t.counter("faults_injected", 3)
+        t.counter("rollbacks")
+        with t.span("checkpoint_save", step=4):
+            pass
+        t.gauge("steps_per_sec", 9.0, step=4)
+        t.event("run_end", status="completed")
+        t.heartbeat(step=4, steps_per_sec=9.0)
+    report = render_report(tmp_path)
+    for needle in ("step 4", "faults_injected=3", "rollbacks=1",
+                   "checkpoint_save", "steps_per_sec", "restart",
+                   "run_end"):
+        assert needle in report, report
+
+
+def test_render_report_empty_dir(tmp_path):
+    report = render_report(tmp_path)
+    assert "(none)" in report and "no telemetry.jsonl" in report
+
+
+def test_selfcheck_entry_point():
+    proc = subprocess.run(
+        [sys.executable, "-m", "byzantinemomentum_tpu.obs", "--selfcheck"],
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "obs selfcheck: OK" in proc.stdout
+
+
+# --------------------------------------------------------------------------- #
+# bench.py backend fallback (satellite: a down TPU tunnel must yield a
+# parseable JSON with a marker, not exit 1)
+
+def test_bench_backend_fallback(monkeypatch):
+    import bench
+    calls = {"n": 0}
+
+    def flaky_devices(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+                "backend setup/compile error (Unavailable).")
+        return ["cpu0"]
+
+    monkeypatch.setattr(bench.jax, "devices", flaky_devices)
+    assert bench._ensure_backend() == "cpu-fallback"
+    assert calls["n"] == 2
+
+
+def test_bench_backend_default(monkeypatch):
+    import bench
+    monkeypatch.setattr(bench.jax, "devices", lambda *a, **k: ["cpu0"])
+    assert bench._ensure_backend() == "default"
+
+
+def test_bench_backend_unrelated_error_propagates(monkeypatch):
+    import bench
+
+    def broken_devices(*args, **kwargs):
+        raise RuntimeError("something else entirely")
+
+    monkeypatch.setattr(bench.jax, "devices", broken_devices)
+    with pytest.raises(RuntimeError, match="something else"):
+        bench._ensure_backend()
+
+
+# --------------------------------------------------------------------------- #
+# Driver wiring end to end (in-process `main`, CPU, synthetic data)
+
+DRIVER_BASE = ["--nb-steps", "6", "--batch-size", "8",
+               "--batch-size-test", "32", "--batch-size-test-reps", "2",
+               "--evaluation-delta", "2", "--checkpoint-delta", "2",
+               "--model", "simples-full", "--seed", "11", "--gar", "median",
+               "--nb-for-study", "11", "--nb-for-study-past", "2",
+               "--telemetry-interval", "2"]
+
+
+@pytest.fixture(autouse=True)
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+def _names(records, kind):
+    return [r["name"] for r in records if r["kind"] == kind]
+
+
+def test_driver_records_telemetry_and_restart(tmp_path):
+    """A run with a result directory records the timeline by default; a
+    second run over the same directory with --auto-resume stamps the
+    restart event with the resume step (the acceptance signal for
+    supervised chaos runs)."""
+    from byzantinemomentum_tpu.cli.attack import main
+    resdir = tmp_path / "run"
+    argv = DRIVER_BASE + ["--result-directory", str(resdir)]
+    assert main(argv) == 0
+    records = obs.load_records(resdir)
+    events = _names(records, "event")
+    assert "run_start" in events and "run_end" in events
+    spans = _names(records, "span")
+    assert "eval" in spans and "checkpoint_save" in spans
+    gauges = _names(records, "gauge")
+    assert "device_step_ms" in gauges
+    end = [r for r in records if r["name"] == "run_end"][-1]
+    assert end["data"]["status"] == "completed"
+    assert end["data"]["step"] == 6
+    heartbeat = obs.read_heartbeat(resdir)
+    assert heartbeat["step"] == 6 and heartbeat["status"] == "completed"
+    assert heartbeat["counters"].get("recompiles", 0) > 0
+
+    # Resume pass: same command line + --auto-resume
+    assert main(argv + ["--auto-resume"]) == 0
+    records = obs.load_records(resdir)
+    restarts = [r for r in records if r["name"] == "restart"]
+    assert restarts, "auto-resume must stamp a restart event"
+    assert restarts[-1]["data"]["step"] == 6
+    assert "checkpoint_load" in _names(records, "span")
+
+
+def test_driver_records_rollback_event(tmp_path, monkeypatch):
+    """The divergence-rollback path lands on the timeline: a rollback
+    event with the restored checkpoint, the rollbacks counter, and a
+    run_end that still says completed."""
+    from byzantinemomentum_tpu.cli.attack import main
+    monkeypatch.setenv("BMT_CHAOS_NAN_AT_STEP", "3")
+    resdir = tmp_path / "roll"
+    rc = main(DRIVER_BASE + ["--rollback-budget", "2",
+                             "--result-directory", str(resdir)])
+    assert rc == 0
+    records = obs.load_records(resdir)
+    rollback = [r for r in records if r["name"] == "rollback"]
+    assert rollback and "restored" in rollback[-1]["data"]
+    counters = [r for r in records if r["kind"] == "counter"
+                and r["name"] == "rollbacks"]
+    assert counters and counters[-1]["value"] == 1
+    end = [r for r in records if r["name"] == "run_end"][-1]
+    assert end["data"]["rollbacks"] == 1
+    report = render_report(resdir)
+    assert "rollbacks=1" in report
+
+
+def test_driver_no_telemetry_flag(tmp_path):
+    from byzantinemomentum_tpu.cli.attack import main
+    resdir = tmp_path / "quiet"
+    assert main(DRIVER_BASE + ["--no-telemetry",
+                               "--result-directory", str(resdir)]) == 0
+    assert not (resdir / obs.TELEMETRY_NAME).exists()
+    assert not (resdir / obs.HEARTBEAT_NAME).exists()
+
+
+def test_driver_telemetry_flag_validation():
+    from byzantinemomentum_tpu import utils
+    from byzantinemomentum_tpu.cli.attack import main
+    with pytest.raises(utils.UserException, match="mutually exclusive"):
+        main(["--telemetry", "--no-telemetry", "--nb-steps", "0"])
+    with pytest.raises(utils.UserException, match="telemetry interval"):
+        main(["--telemetry-interval", "0", "--nb-steps", "0"])

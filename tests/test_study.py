@@ -221,6 +221,67 @@ def test_fault_rate_sweep_plot(tmp_path):
     assert accs == sorted(accs, reverse=True)
 
 
+def _write_telemetry_run(resdir, *, rate=10.0, rollback_at=None):
+    """A synthetic run directory with just enough telemetry for the
+    run-health plot family (no training needed)."""
+    from byzantinemomentum_tpu import obs
+    resdir.mkdir(parents=True, exist_ok=True)
+    with obs.Telemetry(resdir) as telem:
+        telem.event("run_start", seed=1)
+        for step in range(10, 60, 10):
+            telem.gauge("device_step_ms", 1000.0 / rate, step=step)
+            telem.gauge("steps_per_sec", rate, step=step)
+        if rollback_at is not None:
+            telem.counter("rollbacks")
+            telem.event("rollback", step=rollback_at, restored="checkpoint-0")
+        telem.counter("faults_injected", 4)
+        telem.event("run_end", step=50, status="completed")
+        telem.heartbeat(step=50, steps_per_sec=rate)
+    return resdir
+
+
+def test_run_health_plot(tmp_path):
+    """`study.run_health`: step-time/throughput timeline off the obs
+    telemetry, with rollback overlays; refuses telemetry-less runs."""
+    from byzantinemomentum_tpu import utils
+    _write_telemetry_run(tmp_path / "healthy", rollback_at=30)
+    frame = study.load_telemetry(tmp_path / "healthy")
+    assert set(frame["kind"]) == {"event", "gauge", "counter"}
+    assert 30 in list(frame[frame["name"] == "rollback"]["step"].dropna())
+    plot = study.run_health(tmp_path / "healthy")
+    plot.save(tmp_path / "health.png")
+    plot.close()
+    assert (tmp_path / "health.png").stat().st_size > 0
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(utils.UserException, match="telemetry"):
+        study.run_health(tmp_path / "empty")
+
+
+def test_run_health_from_real_run(result_dir):
+    """The plot family works off an actual driver run's telemetry (the
+    default-on recording), not just synthetic fixtures."""
+    plot = study.run_health(study.Session(result_dir))
+    plot.close()
+
+
+def test_throughput_sweep(tmp_path):
+    rates = {"slow": 5.0, "fast": 20.0}
+    sessions = []
+    for name, rate in rates.items():
+        _write_telemetry_run(tmp_path / name, rate=rate)
+        sessions.append(study.Session(tmp_path / name))
+    frame, plot = study.throughput_sweep(sessions)
+    plot.close()
+    assert dict(zip(frame.index, frame["Steps/s"])) == pytest.approx(rates)
+    # Runs without telemetry are skipped, not fatal
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    (bare / "config.json").write_text("{}")
+    frame2, plot2 = study.throughput_sweep(sessions + [study.Session(bare)])
+    plot2.close()
+    assert len(frame2) == 2
+
+
 def test_display_fallback(result_dir, capsys):
     """`study.display` degrades gracefully without GTK: warning + text
     rendering (reference `study.py:72-78`)."""
