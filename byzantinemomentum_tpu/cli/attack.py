@@ -48,8 +48,8 @@ from byzantinemomentum_tpu import obs as obs_mod
 from byzantinemomentum_tpu import ops as ops_mod
 from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.engine import (
-    EngineConfig, FAULT_COLUMNS, RECOVERY_COLUMNS, STUDY_COLUMNS,
-    build_engine)
+    EngineConfig, FAULT_COLUMNS, FORENSIC_COLUMNS, RECOVERY_COLUMNS,
+    STUDY_COLUMNS, build_engine)
 from byzantinemomentum_tpu.models.core import apply_named_init
 
 __all__ = ["process_commandline", "main"]
@@ -108,6 +108,14 @@ def process_commandline(argv=None):
         help="Disable the merged-batch grouped honest phase (always use the "
              "vmapped per-worker path, even for models that provide the "
              "faster grouped execution)")
+    add("--gar-diagnostics", action="store_true", default=False,
+        help="Run the defense through its in-jit diagnostics kernel and "
+             "append the aggregation-forensics columns to the study CSV "
+             "('Sel workers', 'Dist honest med', 'Var/norm ratio', 'Clip "
+             "frac', 'Suspicion max'), feeding the per-worker suspicion "
+             "tracker (obs/forensics.py: suspect_worker telemetry events). "
+             "Off by default: the diagnostic aux rides the compiled step "
+             "as extra outputs (measured overhead documented in README)")
     add("--attack", type=str, default="nan", help="Attack to use")
     add("--attack-args", nargs="*", help="key:value args for the attack")
     add("--fault-plan", type=str, default=None,
@@ -343,6 +351,12 @@ def _postprocess(args):
     if args.telemetry and args.result_directory is None:
         utils.warning("'--telemetry' needs '--result-directory' (there is "
                       "nowhere to write the timeline); telemetry disabled")
+    if args.gar_diagnostics and (args.result_directory is None
+                                 or args.nb_for_study < 1):
+        utils.warning("'--gar-diagnostics' needs the study pipeline "
+                      "('--nb-for-study' with '--result-directory'); "
+                      "diagnostics disabled")
+        args.gar_diagnostics = False
     if args.rollback_budget < 0:
         utils.fatal(f"Invalid arguments: negative rollback budget "
                     f"{args.rollback_budget}")
@@ -638,7 +652,8 @@ def main(argv=None):
             fault_quarantine=(fault_plan.policy.nan_quarantine
                               if fault_plan is not None else True),
             fault_dynamic_quorum=(fault_plan.policy.dynamic_quorum
-                                  if fault_plan is not None else True))
+                                  if fault_plan is not None else True),
+            gar_diagnostics=args.gar_diagnostics)
         from byzantinemomentum_tpu import optim
         optimizer = optim.build(args.optimizer,
                                 weight_decay=args.weight_decay,
@@ -720,6 +735,11 @@ def main(argv=None):
         # Recovery columns ride the study CSV only when crash recovery is
         # on, mirroring the FAULT_COLUMNS opt-in schema policy
         recovery_active = args.auto_resume or args.rollback_budget > 0
+        # Aggregation forensics (--gar-diagnostics): in-jit GAR aux out of
+        # the step, host-side per-worker suspicion EWMA over it
+        forensics_active = cfg.gar_diagnostics and cfg.study
+        suspicion = (obs_mod.SuspicionTracker(args.nb_workers)
+                     if forensics_active else None)
         if args.result_directory is not None:
             resdir = pathlib.Path(args.result_directory).resolve()
             try:
@@ -753,6 +773,8 @@ def main(argv=None):
                         FAULT_COLUMNS if fault_schedule is not None else ())
                     if recovery_active:
                         study_columns = study_columns + RECOVERY_COLUMNS
+                    if forensics_active:
+                        study_columns = study_columns + FORENSIC_COLUMNS
                     results.make("study", *study_columns,
                                  resume_step=resume_step)
                 (resdir / "config").write_text(_config_text(args) + os.linesep)
@@ -967,6 +989,26 @@ def main(argv=None):
                     # the run manifest
                     row.append(p_rollbacks)
                     row.append(restart_count)
+                if forensics_active:
+                    # FORENSIC_COLUMNS: selection indices formatted from
+                    # the in-graph mask, the in-graph scalars verbatim,
+                    # and the suspicion EWMA folded per step (host-side,
+                    # O(n) — the device only shipped the vectors)
+                    def _per_step(key):
+                        value = np.asarray(p_metrics[key])
+                        return value[i] if p_m > 1 else value
+                    sel = _per_step("Sel mask")
+                    selected = np.nonzero(sel > 0)[0]
+                    row.append(";".join(str(w) for w in selected) or "-")
+                    for column in ("Dist honest med", "Var/norm ratio",
+                                   "Clip frac"):
+                        row.append(float_format % float(_per_step(column)))
+                    active = (_per_step("Active mask")
+                              if "Active mask" in p_metrics else None)
+                    suspicion.update(p_steps + i, sel,
+                                     distances=_per_step("Worker dist"),
+                                     active=active)
+                    row.append(float_format % suspicion.max())
                 results.store(fd_study, *row)
             if fault_schedule is not None and telem is not None:
                 # The chunk's scheduled-fault total lands on the system
@@ -1340,6 +1382,10 @@ def main(argv=None):
         obs_mod.emit("profiler_trace_stop", directory=str(args.trace_dir))
         jax.profiler.stop_trace()
     if telem is not None:
+        if suspicion is not None and suspicion.steps > 0:
+            # Final forensics snapshot: who ended the run under suspicion
+            # (the per-event timeline already has the rising/falling edges)
+            telem.event("forensics_summary", **suspicion.summary())
         status = ("diverged" if diverged
                   else "interrupted" if exit_is_requested()
                   else "completed")

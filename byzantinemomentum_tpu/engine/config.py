@@ -69,6 +69,14 @@ class EngineConfig:
     #                               absent (`faults/quorum.py`); False
     #                               keeps the declared f and only excludes
     #                               the absent rows
+    gar_diagnostics: bool = False  # --gar-diagnostics: run the defense
+    #                               through its in-jit diagnostics kernel
+    #                               (`ops/diag.py` aux schema) and emit the
+    #                               forensic study-CSV columns
+    #                               (`engine/metrics.py::FORENSIC_COLUMNS`).
+    #                               Trace-time switch: False compiles the
+    #                               exact pre-diagnostics program (no
+    #                               hot-path cost; `tests/test_diag.py`)
 
     def __post_init__(self):
         if self.momentum_at not in ("update", "server", "worker"):

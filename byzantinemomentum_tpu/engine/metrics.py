@@ -20,8 +20,9 @@ f32 scalars; the host merely formats them (`%.8e`, reference
 import jax.numpy as jnp
 
 __all__ = ["STUDY_COLUMNS", "FAULT_COLUMNS", "RECOVERY_COLUMNS",
+           "FORENSIC_COLUMNS",
            "avg_dev_max", "cosine",
-           "study_metrics", "push_past"]
+           "forensic_metrics", "study_metrics", "push_past"]
 
 # CSV header, byte-identical to the reference's (reference `attack.py:564-571`)
 STUDY_COLUMNS = (
@@ -51,6 +52,17 @@ FAULT_COLUMNS = ("Faults injected", "Workers active", "Quorum f")
 # counters — not in-graph metrics — and, like FAULT_COLUMNS, kept out of
 # STUDY_COLUMNS so default runs keep the reference's exact CSV schema.
 RECOVERY_COLUMNS = ("Rollbacks", "Restarts")
+
+# Aggregation-forensics columns, appended to the study CSV when the
+# defense runs its diagnostics kernel (`--gar-diagnostics`): which workers
+# the GAR selected (';'-joined indices, formatted host-side from the
+# in-graph selection mask), the honest-vs-all pairwise-distance median,
+# the paper's per-step variance-to-norm ratio of the submitted momenta,
+# the coordinate-trim fraction, and the max host-side suspicion score
+# (`obs/forensics.py`). Opt-in like FAULT_COLUMNS/RECOVERY_COLUMNS so
+# default runs keep the reference's exact CSV schema.
+FORENSIC_COLUMNS = ("Sel workers", "Dist honest med", "Var/norm ratio",
+                    "Clip frac", "Suspicion max")
 
 # NaN as a Python float: creating a device array at import time would
 # initialize the JAX backend before the CLI's --device platform selection
@@ -96,6 +108,39 @@ def push_past(past_grads, past_norms, past_count, grad, norm):
     past_norms = jnp.concatenate([norm[None], past_norms[:-1]])
     past_count = jnp.minimum(past_count + 1, past_grads.shape[0])
     return past_grads, past_norms, past_count
+
+
+def forensic_metrics(aux, G_honest):
+    """In-graph forensic values from a GAR diagnostics aux
+    (`ops/diag.py` schema) and the honest submission stack.
+
+    Returns device scalars/vectors keyed for the driver: the scalar keys
+    land in the study CSV verbatim (FORENSIC_COLUMNS), while `Sel mask`
+    and `Worker dist` are per-worker vectors the host formats ('Sel
+    workers') and feeds to the suspicion tracker (`obs/forensics.py`).
+    """
+    import jax.numpy as jnp  # local alias keeps the module top jax-free
+
+    from byzantinemomentum_tpu.ops import diag as diag_mod
+
+    n = aux["selection"].shape[0]
+    dist = aux["dist"]
+    _, dmed, _ = diag_mod.distance_summary(dist, rows=G_honest.shape[0])
+    # Per-worker mean distance to the finite peers (suspicion z-scores);
+    # a row with NO finite peer distance (fully corrupt) reads +inf
+    offdiag = ~jnp.eye(n, dtype=bool)
+    finite = jnp.isfinite(dist) & offdiag
+    cnt = jnp.sum(finite.astype(jnp.float32), axis=1)
+    mean_d = (jnp.sum(jnp.where(finite, dist, 0.0), axis=1)
+              / jnp.maximum(cnt, 1.0))
+    mean_d = jnp.where(cnt > 0, mean_d, jnp.inf)
+    return {
+        "Sel mask": aux["selection"],
+        "Worker dist": mean_d,
+        "Dist honest med": dmed,
+        "Var/norm ratio": diag_mod.var_norm_ratio(G_honest),
+        "Clip frac": jnp.mean(aux["trim_frac"]),
+    }
 
 
 def study_metrics(*, loss_avg, l2_origin, G_sampled, G_honest, G_attack,

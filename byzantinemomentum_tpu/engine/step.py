@@ -468,6 +468,24 @@ class Engine:
         ]
         return lax.switch(self._mixture_index(mix_u), branches, G)
 
+    def _run_defense_diag(self, G, mix_u):
+        """`_run_defense` through the diagnostics kernels: returns
+        `(aggregate, aux)` with the uniform `ops/diag.py` aux schema (the
+        schema uniformity is what lets a `--gars` mixture `lax.switch`
+        over the diagnostic branches). Only traced when
+        `cfg.gar_diagnostics` — the False path compiles the exact
+        pre-diagnostics program."""
+        cfg = self.cfg
+        if len(self.defenses) == 1:
+            gar, _, kwargs = self.defenses[0]
+            return gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs)
+        branches = [
+            (lambda G, gar=gar, kwargs=kwargs:
+             gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs))
+            for gar, _, kwargs in self.defenses
+        ]
+        return lax.switch(self._mixture_index(mix_u), branches, G)
+
     def _mixture_index(self, mix_u):
         cum = jnp.asarray([fc for _, fc, _ in self.defenses], jnp.float32)
         return jnp.searchsorted(cum, mix_u * cum[-1], side="right").astype(
@@ -594,7 +612,12 @@ class Engine:
         policy: absent rows masked out, non-finite rows quarantined
         (`cfg.fault_quarantine`) and the effective quorum recomputed
         (`cfg.fault_dynamic_quorum`); returns the fault metric dict as the
-        fourth element (None without faults)."""
+        fourth element (None without faults). The fifth element is the
+        forensic metric dict when `cfg.gar_diagnostics` is on with the
+        study active (None otherwise): the outer aggregation runs through
+        the GAR's diagnostics kernel and its aux pytree is digested
+        in-graph (`engine/metrics.py::forensic_metrics`) — the attack's
+        line-search probes keep hitting the plain kernels."""
         cfg = self.cfg
         mix_u = jax.random.uniform(mix_key)
         per_call = cfg.gars_per_call and len(self.defenses) > 1
@@ -632,11 +655,22 @@ class Engine:
         else:
             infl_u = mix_u
 
+        diagnostics = cfg.gar_diagnostics and cfg.study
+
         if fault is None:
-            grad_defense = self._run_defense(G_all, mix_u).astype(
-                G_honest.dtype)
+            if diagnostics:
+                # One diagnostics call yields BOTH the aggregate and the
+                # aux (the kernels share their distance matrix / weights
+                # between the two outputs — no double aggregation)
+                grad_defense, aux = self._run_defense_diag(G_all, mix_u)
+                grad_defense = grad_defense.astype(G_honest.dtype)
+                diag_metrics = metrics_mod.forensic_metrics(aux, G_honest)
+            else:
+                grad_defense = self._run_defense(G_all, mix_u).astype(
+                    G_honest.dtype)
+                diag_metrics = None
             accept_ratio = self._run_influence(G_honest, G_attack, infl_u)
-            return G_attack, grad_defense, accept_ratio, None
+            return G_attack, grad_defense, accept_ratio, None, diag_metrics
 
         active = fault.active
         if cfg.fault_quarantine:
@@ -650,7 +684,19 @@ class Engine:
             "Workers active": jnp.sum(active.astype(jnp.int32)),
             "Quorum f": f_eff,
         }
-        return G_attack, grad_defense, accept_ratio, fault_metrics
+        diag_metrics = None
+        if diagnostics:
+            # Under faults the authoritative aggregate stays the masked
+            # degradation kernel above; the diagnostics view re-runs the
+            # plain rule on the full stack (fault steps are rare; the
+            # selection read-out deliberately shows what the UNDEGRADED
+            # rule would have chosen) plus the post-quarantine active mask
+            # so the suspicion tracker sees who was quarantined
+            _, aux = self._run_defense_diag(G_all, mix_u)
+            diag_metrics = metrics_mod.forensic_metrics(aux, G_honest)
+            diag_metrics["Active mask"] = active.astype(jnp.float32)
+        return (G_attack, grad_defense, accept_ratio, fault_metrics,
+                diag_metrics)
 
     def _run_defense_masked(self, G, mix_u, active):
         """`_run_defense` under the degradation policy: aggregate the
@@ -678,16 +724,17 @@ class Engine:
         """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
         (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
          G_honest, fault, new_fb) = self._phase_honest(state, xs, ys, lr)
-        G_attack, grad_defense, accept_ratio, fault_metrics = \
-            self._phase_defense(G_honest, mix_key, fault)
+        (G_attack, grad_defense, accept_ratio, fault_metrics,
+         diag_metrics) = self._phase_defense(G_honest, mix_key, fault)
         return self._phase_update(
             state, rng, G_sampled, loss_avg, net_state, new_mw, G_honest,
             G_attack, grad_defense, accept_ratio, lr, self._batch_of(xs),
-            fault_metrics, new_fb)
+            fault_metrics, new_fb, diag_metrics)
 
     def _phase_update(self, state, rng, G_sampled, loss_avg, net_state,
                       new_mw, G_honest, G_attack, grad_defense, accept_ratio,
-                      lr, batch, fault_metrics=None, fault_buffer=None):
+                      lr, batch, fault_metrics=None, fault_buffer=None,
+                      diag_metrics=None):
         """Model update + study metrics (reference `attack.py:832-878`)."""
         cfg = self.cfg
         h = cfg.nb_honests
@@ -727,6 +774,8 @@ class Engine:
             pg, pn, pc = state.past_grads, state.past_norms, state.past_count
         if cfg.study and fault_metrics is not None:
             metrics.update(fault_metrics)
+        if cfg.study and diag_metrics is not None:
+            metrics.update(diag_metrics)
 
         new_state = TrainState(
             theta=theta, net_state=net_state, opt_state=opt_state,
@@ -830,12 +879,12 @@ def make_device_gar_step(engine, gar_device):
         out = mid(jax.device_put(G_honest, dev),
                   jax.device_put(mix_key, dev),
                   None if fault is None else jax.device_put(fault, dev))
-        (G_attack, grad_defense, accept_ratio,
-         fault_metrics) = jax.device_put(out, main_dev)
+        (G_attack, grad_defense, accept_ratio, fault_metrics,
+         diag_metrics) = jax.device_put(out, main_dev)
         batch = engine._batch_of(xs)
         return post(state, rng, G_sampled, loss_avg, net_state, new_mw,
                     G_honest, G_attack, grad_defense, accept_ratio, lr,
-                    batch, fault_metrics, new_fb)
+                    batch, fault_metrics, new_fb, diag_metrics)
 
     return step
 

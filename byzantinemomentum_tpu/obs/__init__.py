@@ -24,6 +24,12 @@ used to guess liveness from study-CSV mtime). Three pieces:
   timing (an `AccumulatedTimedContext` whose sync barrier is a tiny
   device→host transfer), host RSS, the TPU bf16 peak-FLOPs table shared
   with `bench.py` and the logical-FLOP counter behind the MFU gauge.
+* **forensics** (`forensics.py`) — per-worker EWMA suspicion scores over
+  the in-jit GAR diagnostics stream (`--gar-diagnostics`): selection-
+  frequency deficit, distance z-score and NaN-quarantine history, with
+  `suspect_worker`/`suspect_cleared` events landing on the timeline
+  through the active-recorder API and a forensics section on the
+  one-pager.
 
 Driver surface: `cli/attack.py --telemetry[-interval]` (on by default when
 a `--result-directory` exists), SIGUSR1 for an on-demand one-chunk
@@ -50,6 +56,9 @@ from byzantinemomentum_tpu.obs.recorder import (  # noqa: F401
     load_records,
     span,
 )
+from byzantinemomentum_tpu.obs.forensics import (  # noqa: F401
+    SuspicionTracker,
+)
 from byzantinemomentum_tpu.obs.heartbeat import (  # noqa: F401
     HEARTBEAT_NAME,
     read_heartbeat,
@@ -68,6 +77,6 @@ __all__ = [
     "TELEMETRY_NAME", "Telemetry", "activate", "active", "counter",
     "deactivate", "emit", "install_compile_listener", "load_records", "span",
     "HEARTBEAT_NAME", "read_heartbeat", "write_heartbeat",
-    "SlidingRate", "StepTimer", "host_rss_mb", "logical_flops", "mfu",
-    "peak_flops",
+    "SlidingRate", "StepTimer", "SuspicionTracker", "host_rss_mb",
+    "logical_flops", "mfu", "peak_flops",
 ]

@@ -35,6 +35,17 @@ def selfcheck():
             obs.emit("rollback", step=5)       # module-level path
             with obs.span("module_span"):
                 pass
+            # Forensics path: a synthetic run with one planted Byzantine
+            # worker (index 4: never selected, sitting far from the cloud)
+            # must flag exactly that worker through the active recorder
+            tracker = obs.SuspicionTracker(5, min_steps=5)
+            selection = [1.0, 1.0, 1.0, 1.0, 0.0]
+            distances = [1.0, 1.1, 0.9, 1.0, 9.0]
+            for step in range(40):
+                tracker.update(step, selection, distances=distances)
+            assert tracker.suspects == [4], tracker.suspects
+            assert tracker.max() == tracker.suspicion[4]
+            telemetry.event("forensics_summary", **tracker.summary())
             telemetry.event("run_end", status="completed")
             telemetry.heartbeat(step=5, steps_per_sec=123.0,
                                 rss_mb=obs.host_rss_mb())
@@ -45,6 +56,9 @@ def selfcheck():
         records = obs.load_records(tmp)
         kinds = {r["kind"] for r in records}
         assert kinds == {"event", "span", "counter", "gauge"}, kinds
+        flagged = [r["data"]["worker"] for r in records
+                   if r["kind"] == "event" and r["name"] == "suspect_worker"]
+        assert flagged == [4], flagged
         spans = {r["name"]: r for r in records if r["kind"] == "span"}
         assert spans["inner"]["parent"] == spans["outer"]["id"]
         assert spans["outer"]["parent"] is None
@@ -59,6 +73,7 @@ def selfcheck():
         from byzantinemomentum_tpu.obs.report import render_report
         report = render_report(tmp)
         assert "recompiles=3" in report and "run_end" in report
+        assert "forensics:" in report and "suspects=[4]" in report
 
     print("obs selfcheck: OK")
     return 0

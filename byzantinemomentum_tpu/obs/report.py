@@ -21,11 +21,11 @@ from byzantinemomentum_tpu.obs.recorder import load_records
 
 __all__ = ["render_report", "main"]
 
-# Events worth listing individually on the one-pager (the resilience
-# timeline); everything else is summarized by count.
+# Events worth listing individually on the one-pager (the resilience +
+# forensics timeline); everything else is summarized by count.
 _TIMELINE_EVENTS = ("restart", "rollback", "divergence_giveup", "retry",
                     "checkpoint_invalid", "profiler_window", "run_start",
-                    "run_end")
+                    "run_end", "suspect_worker", "suspect_cleared")
 
 
 def _fmt_seconds(seconds):
@@ -117,6 +117,30 @@ def render_report(run_dir):
             lo, mean, hi = _stats(values)
             lines.append(f"  {name:<20} x{len(values):<4} "
                          f"min {lo:.4g}  mean {mean:.4g}  max {hi:.4g}")
+
+    # Aggregation forensics (obs/forensics.py): the run's standing
+    # suspects and suspicion scores, read from the final summary event,
+    # plus the flag/clear edge counts
+    summary = None
+    edges = {"suspect_worker": 0, "suspect_cleared": 0}
+    for record in records:
+        if record.get("kind") != "event":
+            continue
+        if record.get("name") == "forensics_summary":
+            summary = record.get("data") or {}
+        elif record.get("name") in edges:
+            edges[record["name"]] += 1
+    if summary is not None or any(edges.values()):
+        suspects = (summary or {}).get("suspects") or []
+        parts = [f"suspects={suspects if suspects else 'none'}",
+                 f"flagged x{edges['suspect_worker']}",
+                 f"cleared x{edges['suspect_cleared']}"]
+        scores = (summary or {}).get("suspicion")
+        if scores:
+            worst = max(range(len(scores)), key=lambda w: scores[w])
+            parts.append(f"max suspicion {scores[worst]:.3g} "
+                         f"(worker {worst})")
+        lines.append("forensics: " + ", ".join(parts))
 
     timeline = [r for r in records if r.get("kind") == "event"
                 and r.get("name") in _TIMELINE_EVENTS]

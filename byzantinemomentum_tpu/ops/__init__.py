@@ -52,25 +52,47 @@ class GAR:
     Calling the GAR object runs the checked path; `.unchecked` is the raw
     kernel (mirrors the reference's `__debug__` switch,
     `aggregators/__init__.py:60-61`, without requiring `python -OO`).
+
+    `gar(G, f=..., diagnostics=True)` returns `(aggregate, aux)` instead:
+    the in-jit forensics path (`ops/diag.py` schema — per-worker scores,
+    selection mass, pairwise distances, trim fractions). `diagnostics` is a
+    TRACE-TIME Python switch, never a traced value: the False call routes
+    through the exact pre-diagnostics kernel (`.unchecked`) so the hot path
+    lowers to identical HLO (`tests/test_diag.py`). Rules without a native
+    `diagnose` kernel fall back to `_generic_diagnose` (distance geometry +
+    distance-to-aggregate scores around the unchecked result).
     """
 
-    def __init__(self, name, unchecked, check, upper_bound=None, influence=None):
+    def __init__(self, name, unchecked, check, upper_bound=None,
+                 influence=None, diagnose=None):
         self.name = name
         self.unchecked = unchecked
         self.check = check
         self.upper_bound = upper_bound
         self.influence = influence
+        self.diagnose = diagnose
 
-    def checked(self, gradients, **kwargs):
+    def checked(self, gradients, *, diagnostics=False, **kwargs):
         gradients = as_matrix(gradients)
         message = self.check(gradients=gradients, **kwargs)
         if message is not None:
             raise utils.UserException(f"Aggregation rule {self.name!r} cannot be used: {message}")
-        result = self.unchecked(gradients, **kwargs)
+        if diagnostics:
+            result, aux = self.diagnosed(gradients, **kwargs)
+        else:
+            result = self.unchecked(gradients, **kwargs)
         if result.shape != gradients.shape[1:]:
             raise utils.UserException(
                 f"Aggregation rule {self.name!r} returned shape {result.shape}, expected {gradients.shape[1:]}")
-        return result
+        return (result, aux) if diagnostics else result
+
+    def diagnosed(self, gradients, **kwargs):
+        """The raw diagnostics kernel: `(G, **kwargs) -> (f32[d], aux)`
+        with the uniform `ops/diag.py` aux schema (native per-rule kernel,
+        or the generic geometry fallback)."""
+        if self.diagnose is not None:
+            return self.diagnose(gradients, **kwargs)
+        return _generic_diagnose(self.unchecked, gradients, **kwargs)
 
     def __call__(self, gradients, **kwargs):
         return self.checked(gradients, **kwargs)
@@ -79,7 +101,23 @@ class GAR:
         return f"GAR({self.name!r})"
 
 
-def register(name, unchecked, check, upper_bound=None, influence=None):
+def _generic_diagnose(unchecked, gradients, **kwargs):
+    """Diagnostics for rules without a native kernel: the unchecked
+    aggregate, the pairwise-distance geometry, and distance-to-aggregate
+    as the per-worker score (selection mass unknown -> all ones)."""
+    from byzantinemomentum_tpu.ops import _common, diag
+
+    n = gradients.shape[0]
+    result = unchecked(gradients, **kwargs)
+    dist = _common.pairwise_distances(gradients)
+    dev = gradients - result[None, :]
+    scores = _common.sanitize_inf(jnp.sqrt(jnp.sum(dev * dev, axis=1)))
+    return result, diag.make_aux(
+        n, scores=scores, selection=jnp.ones((n,), jnp.float32), dist=dist)
+
+
+def register(name, unchecked, check, upper_bound=None, influence=None,
+             diagnose=None):
     """Register a GAR under `name` (reference `aggregators/__init__.py:42-86`).
 
     Args:
@@ -89,12 +127,15 @@ def register(name, unchecked, check, upper_bound=None, influence=None):
       upper_bound: optional `(n, f, d) -> float` theoretical ratio bound.
       influence: optional `(honests, byzantines, **kwargs) -> float` attack
         acceptation ratio.
+      diagnose: optional `(G, **kwargs) -> (f32[d], aux)` diagnostics
+        kernel (uniform `ops/diag.py` aux schema).
     Returns:
       The GAR object.
     """
     if name in gars:
         utils.warning(f"Aggregation rule {name!r} registered twice; keeping the last")
-    gar = GAR(name, unchecked, check, upper_bound=upper_bound, influence=influence)
+    gar = GAR(name, unchecked, check, upper_bound=upper_bound,
+              influence=influence, diagnose=diagnose)
     gars[name] = gar
     return gar
 

@@ -7,10 +7,11 @@ average the c closest — c = (n+1)//2 in 'mid' mode, n-f in 'n-f' mode
 
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import lower_median, sanitize_inf, selection_influence
+from byzantinemomentum_tpu.ops import diag, register
+from byzantinemomentum_tpu.ops._common import (
+    lower_median, pairwise_distances, sanitize_inf, selection_influence)
 
-__all__ = ["aggregate", "selection"]
+__all__ = ["aggregate", "diagnose", "selection"]
 
 
 def _count(n, f, mode):
@@ -35,6 +36,21 @@ def aggregate(gradients, f, mode="mid", **kwargs):
     return jnp.mean(gradients[selection(gradients, f, mode)], axis=0)
 
 
+def diagnose(gradients, f, mode="mid", **kwargs):
+    """Diagnostics kernel: the aksel aggregate plus the forensics aux —
+    squared median distances as scores, the c-closest membership as the
+    selection mask (the distance matrix is diagnostics-only here: the rule
+    itself never needs it)."""
+    n = gradients.shape[0]
+    sel = selection(gradients, f, mode)
+    agg = jnp.mean(gradients[sel], axis=0)
+    med = lower_median(gradients)
+    sqd = sanitize_inf(jnp.sum((gradients - med[None, :]) ** 2, axis=1))
+    return agg, diag.make_aux(
+        n, scores=sqd, selection=diag.selection_from_indices(n, sel),
+        dist=pairwise_distances(gradients))
+
+
 def check(gradients, f, mode="mid", **kwargs):
     n = gradients.shape[0]
     if n < 1:
@@ -50,4 +66,4 @@ def check(gradients, f, mode="mid", **kwargs):
 influence = selection_influence(selection)
 
 
-register("aksel", aggregate, check, influence=influence)
+register("aksel", aggregate, check, influence=influence, diagnose=diagnose)

@@ -27,10 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops import diag, register
 from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
 
-__all__ = ["aggregate", "selection", "best_subset_mask_from_dist"]
+__all__ = ["aggregate", "diagnose", "selection", "best_subset_mask_from_dist"]
 
 # Subsets evaluated per chunk of the streaming enumeration: memory is
 # O(CHUNK * n^2) floats — ~80 MB at n=25 — independent of C(n, n-f).
@@ -153,6 +153,23 @@ def aggregate(gradients, f, *, method="dot", **kwargs):
     return jnp.sum(kept, axis=0) / (n - f)
 
 
+def diagnose(gradients, f, *, method="dot", **kwargs):
+    """Diagnostics kernel: the brute aggregate plus the forensics aux.
+    `selection` is the minimum-diameter subset membership; `scores` are
+    each worker's maximal distance TO that winning subset (members of a
+    tight subset score low, the excluded far rows score high) — the
+    per-worker read-off of the diameter objective."""
+    n = gradients.shape[0]
+    dist = pairwise_distances(gradients, method=method)
+    mask = best_subset_mask_from_dist(dist, f)
+    kept = jnp.where(mask[:, None], gradients, 0)
+    agg = jnp.sum(kept, axis=0) / (n - f)
+    in_subset = mask[None, :] & ~jnp.eye(n, dtype=bool)
+    scores = jnp.max(jnp.where(in_subset, dist, -jnp.inf), axis=1)
+    return agg, diag.make_aux(
+        n, scores=scores, selection=mask.astype(jnp.float32), dist=dist)
+
+
 _jitted = jax.jit(aggregate, static_argnames=("f", "method"))
 
 
@@ -179,5 +196,7 @@ def upper_bound(n, f, d):
 influence = selection_influence(selection)
 
 
-register("brute", aggregate, check, upper_bound=upper_bound, influence=influence)
-register("native-brute", aggregate_native, check, upper_bound=upper_bound)
+register("brute", aggregate, check, upper_bound=upper_bound,
+         influence=influence, diagnose=diagnose)
+register("native-brute", aggregate_native, check, upper_bound=upper_bound,
+         diagnose=diagnose)

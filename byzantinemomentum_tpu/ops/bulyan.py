@@ -23,12 +23,12 @@ import math
 import jax
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops import diag, register
 from byzantinemomentum_tpu.ops._common import (
     all_finite_from_dist, averaged_median, pairwise_distances,
     weighted_rows_mean)
 
-__all__ = ["aggregate", "selected_stack", "selection_weights"]
+__all__ = ["aggregate", "diagnose", "selected_stack", "selection_weights"]
 
 
 def selection_weights(dist, f, m=None):
@@ -91,6 +91,26 @@ def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
         then=lambda sel: averaged_median(sel, rounds - 2 * f))
 
 
+def diagnose(gradients, f, m=None, *, method="dot", **kwargs):
+    """Diagnostics kernel: the Bulyan aggregate plus the forensics aux.
+    `selection` is each worker's total stage-1 averaging mass across the
+    n-2f-2 Multi-Krum rounds, normalized by the round count (1.0 = the
+    worker entered every round's average); `scores` are the Bulyan scores
+    (sum of the m smallest neighbor distances) before any pruning."""
+    n = gradients.shape[0]
+    m_scores = n - f - 2 if m is None else m
+    dist = pairwise_distances(gradients, method=method)
+    W = selection_weights(dist, f, m)
+    rounds = W.shape[0]
+    agg = weighted_rows_mean(
+        W.astype(gradients.dtype), gradients,
+        all_finite=all_finite_from_dist(dist),
+        then=lambda sel: averaged_median(sel, rounds - 2 * f))
+    scores = jnp.sum(jnp.sort(dist, axis=1)[:, :m_scores], axis=1)
+    mass = jnp.sum((W > 0).astype(jnp.float32), axis=0) / rounds
+    return agg, diag.make_aux(n, scores=scores, selection=mass, dist=dist)
+
+
 _jitted = jax.jit(aggregate, static_argnames=("f", "m", "method"))
 
 
@@ -114,5 +134,7 @@ def upper_bound(n, f, d):
     return 1 / math.sqrt(2 * (n - f + f * (n + f * (n - f - 2) - 2) / (n - 2 * f - 2)))
 
 
-register("bulyan", aggregate, check, upper_bound=upper_bound)
-register("native-bulyan", aggregate_native, check, upper_bound=upper_bound)
+register("bulyan", aggregate, check, upper_bound=upper_bound,
+         diagnose=diagnose)
+register("native-bulyan", aggregate_native, check, upper_bound=upper_bound,
+         diagnose=diagnose)

@@ -7,10 +7,11 @@ smallest-norm gradients (reference `aggregators/cge.py:28-57`).
 
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import sanitize_inf, selection_influence
+from byzantinemomentum_tpu.ops import diag, register
+from byzantinemomentum_tpu.ops._common import (
+    pairwise_distances, sanitize_inf, selection_influence)
 
-__all__ = ["aggregate", "selection"]
+__all__ = ["aggregate", "diagnose", "selection"]
 
 
 def norms(gradients):
@@ -30,6 +31,19 @@ def aggregate(gradients, f, **kwargs):
     return jnp.mean(gradients[selection(gradients, f)], axis=0)
 
 
+def diagnose(gradients, f, **kwargs):
+    """Diagnostics kernel: the CGE aggregate plus the forensics aux —
+    per-worker norms as scores, the n-f smallest-norm membership as the
+    selection mask."""
+    n = gradients.shape[0]
+    sel = selection(gradients, f)
+    agg = jnp.mean(gradients[sel], axis=0)
+    return agg, diag.make_aux(
+        n, scores=norms(gradients),
+        selection=diag.selection_from_indices(n, sel),
+        dist=pairwise_distances(gradients))
+
+
 def check(gradients, f=None, m=None, **kwargs):
     if gradients.shape[0] < 1:
         return f"Expected at least one gradient to aggregate, got {gradients.shape[0]}"
@@ -40,4 +54,4 @@ def check(gradients, f=None, m=None, **kwargs):
 influence = selection_influence(selection)
 
 
-register("cge", aggregate, check, influence=influence)
+register("cge", aggregate, check, influence=influence, diagnose=diagnose)

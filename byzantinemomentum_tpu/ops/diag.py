@@ -1,0 +1,126 @@
+"""Shared helpers for the GAR diagnostics path (aggregation forensics).
+
+Every GAR can be called with `diagnostics=True` (`ops/__init__.py::GAR`),
+returning `(aggregate, aux)` where `aux` is a pytree with ONE schema across
+all rules — so a `--gars` mixture can `lax.switch` over diagnostic branches
+(identical output structures are a switch requirement) and downstream
+consumers (`engine/step.py`, `obs/forensics.py`, `study.worker_heatmap`)
+never need per-GAR cases:
+
+  scores     f32[n]    per-worker score in the rule's own metric — Krum
+                       scores, CGE norms, aksel squared median distances,
+                       mean deviation for coordinate-wise rules. Lower is
+                       always "more central/trusted" (rules that rank
+                       descending are negated on the way out).
+  selection  f32[n]    how much of the aggregate each worker contributed:
+                       the averaging-weight mass (1/m per selected row for
+                       krum, per-round mass for bulyan stage 1, kept-rank
+                       fraction for trmean), normalized so a fully-selected
+                       worker reads 1.0. Coordinate-wise rules report the
+                       per-worker fraction of coordinates that survived.
+  dist       f32[n,n]  pairwise distance matrix (+inf diagonal, non-finite
+                       -> +inf) — the geometry the selection acted on.
+                       Rules that don't need distances for aggregation
+                       (median/trmean/cge/...) compute it here anyway: the
+                       diagnostics path is opt-in and off the hot path.
+  trim_frac  f32[n]    coordinate-wise rules: fraction of each worker's
+                       coordinates trimmed/ignored by the rule (1 - the
+                       kept fraction); zeros for selection-based rules
+                       (selection already carries the information).
+
+Everything is computed in-jit as extra outputs of the same traced call —
+no host round-trips mid-step. The `diagnostics=False` call never routes
+through this module (the kernels' non-diagnostic code paths are untouched,
+see the HLO-identity test in `tests/test_diag.py`).
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["AUX_KEYS", "make_aux", "distance_summary", "var_norm_ratio",
+           "selection_from_indices", "rank_kept_fraction"]
+
+# The uniform aux schema (dict keys, all always present).
+AUX_KEYS = ("scores", "selection", "dist", "trim_frac")
+
+
+def make_aux(n, *, scores=None, selection=None, dist=None, trim_frac=None):
+    """Fill the uniform aux dict, zeroing whatever a rule has no native
+    notion of (so mixture `lax.switch` branches agree on structure AND
+    shapes)."""
+    aux = {
+        "scores": jnp.zeros((n,), jnp.float32) if scores is None
+        else scores.astype(jnp.float32),
+        "selection": jnp.zeros((n,), jnp.float32) if selection is None
+        else selection.astype(jnp.float32),
+        "dist": jnp.zeros((n, n), jnp.float32) if dist is None
+        else dist.astype(jnp.float32),
+        "trim_frac": jnp.zeros((n,), jnp.float32) if trim_frac is None
+        else trim_frac.astype(jnp.float32),
+    }
+    return aux
+
+
+def selection_from_indices(n, indices):
+    """`i32[m] -> f32[n]` 0/1 selection mask from selected indices (the
+    index-returning rules: aksel, cge)."""
+    return jnp.zeros((n,), jnp.float32).at[indices].set(1.0)
+
+
+def distance_summary(dist, rows=None):
+    """(min, lower-median, max) over the finite off-diagonal distances of
+    `dist[:rows]` — the honest-vs-all summary when `rows` = the honest
+    count (+inf entries — the diagonal and non-finite rows — sort last and
+    are excluded from min/median by construction; max falls back to the
+    overall max so a fully non-finite slice reads +inf, not -inf)."""
+    n = dist.shape[0]
+    sub = dist if rows is None else dist[:rows]
+    offdiag = ~jnp.eye(n, dtype=bool)[: sub.shape[0]]
+    vals = jnp.where(offdiag, sub, jnp.inf).reshape(-1)
+    srt = jnp.sort(vals)  # +inf (diagonal / corrupt) last
+    count = sub.shape[0] * (n - 1)  # static: off-diagonal entry count
+    dmin = srt[0]
+    dmed = srt[(count - 1) // 2]
+    finite = jnp.isfinite(srt)
+    dmax = jnp.max(jnp.where(finite, srt, -jnp.inf))
+    dmax = jnp.where(jnp.any(finite), dmax, jnp.inf)
+    return dmin, dmed, dmax
+
+
+def var_norm_ratio(G):
+    """The paper's headline quantity for a submission stack `f32[m, d]`:
+    (sample std-dev of the per-row deviations / norm of the row average)²
+    — exactly the study pipeline's "(deviation/norm)²" ratio
+    (`engine/metrics.py::avg_dev_max` composition), computed in-jit per
+    step. NaN for m < 2 (no sample deviation), like the CSV columns."""
+    m = G.shape[0]
+    if m < 2:
+        return jnp.float32(jnp.nan)
+    avg = jnp.mean(G, axis=0)
+    norm2 = jnp.sum(avg * avg)
+    dev = G - avg
+    dev2 = jnp.sum(dev * dev) / (m - 1)
+    return (dev2 / norm2).astype(jnp.float32)
+
+
+def rank_kept_fraction(g, f, n_low=None, n_high=None):
+    """Per-worker fraction of coordinates whose value survived a
+    coordinate-wise rank trim: kept iff the value lies within the sorted
+    ranks `[n_low, n_high)` (defaults: trmean's `[f, n-f)`).
+
+    Rank membership is decided by value thresholds (`sorted[n_low]` /
+    `sorted[n_high - 1]` per coordinate) rather than a full (n, d) argsort
+    + scatter: ties at the boundary count every tied worker as kept, which
+    over-reports by at most the tie multiplicity and keeps the pass at one
+    (n, d) sort — the same trick as `_common.closest_mean`. NaN coordinates
+    never count as kept (comparisons with NaN are False).
+    """
+    n = g.shape[0]
+    if n_low is None:
+        n_low = f
+    if n_high is None:
+        n_high = n - f
+    srt = jnp.sort(g, axis=0)  # NaN sorts last
+    lo = srt[n_low]
+    hi = srt[n_high - 1]
+    kept = (g >= lo) & (g <= hi)
+    return jnp.mean(kept.astype(jnp.float32), axis=1)

@@ -17,13 +17,13 @@ import math
 import jax
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import register
+from byzantinemomentum_tpu.ops import diag, register
 from byzantinemomentum_tpu.ops._common import (
     all_finite_from_dist, pairwise_distances, selection_influence,
     weighted_rows_mean)
 
-__all__ = ["aggregate", "scores", "selection", "selection_weights",
-           "selection_weights_masked"]
+__all__ = ["aggregate", "diagnose", "scores", "selection",
+           "selection_weights", "selection_weights_masked"]
 
 
 def scores_from_dist(dist, f):
@@ -112,6 +112,23 @@ def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
                               all_finite=all_finite_from_dist(dist))
 
 
+def diagnose(gradients, f, m=None, *, method="dot", **kwargs):
+    """Diagnostics kernel: the Multi-Krum aggregate plus the forensics aux
+    (`ops/diag.py` schema) — Krum scores, the 1/m selection-weight mass,
+    and the pairwise-distance geometry the selection acted on. Shares the
+    distance matrix and weight vector with the aggregate, so the extra
+    cost over `aggregate` is one O(n²) score read-off."""
+    n = gradients.shape[0]
+    if m is None:
+        m = n - f - 2
+    dist = pairwise_distances(gradients, method=method)
+    w = selection_weights(dist, f, m)
+    agg = weighted_rows_mean(w.astype(gradients.dtype), gradients,
+                             all_finite=all_finite_from_dist(dist))
+    return agg, diag.make_aux(
+        n, scores=scores_from_dist(dist, f), selection=w * m, dist=dist)
+
+
 _jitted = jax.jit(aggregate, static_argnames=("f", "m", "method"))
 
 
@@ -140,5 +157,7 @@ def upper_bound(n, f, d):
 influence = selection_influence(selection)
 
 
-register("krum", aggregate, check, upper_bound=upper_bound, influence=influence)
-register("native-krum", aggregate_native, check, upper_bound=upper_bound)
+register("krum", aggregate, check, upper_bound=upper_bound,
+         influence=influence, diagnose=diagnose)
+register("native-krum", aggregate_native, check, upper_bound=upper_bound,
+         diagnose=diagnose)

@@ -16,11 +16,13 @@ standalone.
 import math
 
 import jax
+import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import register
-from byzantinemomentum_tpu.ops._common import lower_median
+from byzantinemomentum_tpu.ops import diag, register
+from byzantinemomentum_tpu.ops._common import (
+    lower_median, pairwise_distances, sanitize_inf)
 
-__all__ = ["aggregate"]
+__all__ = ["aggregate", "diagnose"]
 
 
 def aggregate(gradients, **kwargs):
@@ -37,6 +39,24 @@ def aggregate_native(gradients, **kwargs):
     return _jitted(gradients)
 
 
+def diagnose(gradients, **kwargs):
+    """Diagnostics kernel: the coordinate-wise median plus the forensics
+    aux. `scores` are the per-worker L2 deviations from the median vector
+    (the rule's natural deviation statistic); `trim_frac` is the fraction
+    of each worker's coordinates that did NOT land on the selected median
+    rank — for distinct values (n-1)/n everywhere, so the informative read
+    is its complement: how often each worker WAS the median."""
+    n = gradients.shape[0]
+    agg = lower_median(gradients)
+    dev = gradients - agg[None, :]
+    scores = sanitize_inf(jnp.sqrt(jnp.sum(dev * dev, axis=1)))
+    was_median = (gradients == agg[None, :]).astype(jnp.float32)
+    return agg, diag.make_aux(
+        n, scores=scores, selection=jnp.ones((n,), jnp.float32),
+        dist=pairwise_distances(gradients),
+        trim_frac=1.0 - jnp.mean(was_median, axis=1))
+
+
 def check(gradients, **kwargs):
     if gradients.shape[0] < 1:
         return f"Expected at least one gradient to aggregate, got {gradients.shape[0]}"
@@ -47,5 +67,7 @@ def upper_bound(n, f, d):
     return 1 / math.sqrt(n - f)
 
 
-register("median", aggregate, check, upper_bound=upper_bound)
-register("native-median", aggregate_native, check, upper_bound=upper_bound)
+register("median", aggregate, check, upper_bound=upper_bound,
+         diagnose=diagnose)
+register("native-median", aggregate_native, check, upper_bound=upper_bound,
+         diagnose=diagnose)

@@ -11,10 +11,12 @@ All three are coordinate-wise rules over the stacked `(n, d)` matrix:
 
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import pallas_sort, register
-from byzantinemomentum_tpu.ops._common import closest_mean, lower_median
+from byzantinemomentum_tpu.ops import diag, pallas_sort, register
+from byzantinemomentum_tpu.ops._common import (
+    closest_mean, lower_median, pairwise_distances, sanitize_inf)
 
-__all__ = ["trmean", "aggregate_trmean", "aggregate_phocas", "aggregate_meamed"]
+__all__ = ["trmean", "aggregate_trmean", "aggregate_phocas",
+           "aggregate_meamed", "diagnose_trmean"]
 
 
 def trmean(g, f):
@@ -41,6 +43,53 @@ def aggregate_meamed(gradients, f, **kwargs):
     return closest_mean(g, lower_median(g), g.shape[0] - f)
 
 
+def _coordinate_aux(g, agg, trim_frac):
+    """Shared coordinate-wise-rule aux: distance-to-aggregate scores (the
+    natural per-worker deviation statistic for rules with no row
+    selection), full-mass selection, the distance geometry, and the rule's
+    per-worker trim fraction."""
+    n = g.shape[0]
+    dev = g - agg[None, :]
+    scores = sanitize_inf(jnp.sqrt(jnp.sum(dev * dev, axis=1)))
+    return diag.make_aux(
+        n, scores=scores, selection=jnp.ones((n,), jnp.float32),
+        dist=pairwise_distances(g), trim_frac=trim_frac)
+
+
+def diagnose_trmean(gradients, f, **kwargs):
+    """Diagnostics kernel: the trimmed mean plus the forensics aux —
+    `trim_frac[i]` is the fraction of worker i's coordinates whose value
+    fell outside the kept ranks [f, n-f) (the per-coordinate clip
+    fraction, read per worker)."""
+    agg = trmean(gradients, f)
+    kept = diag.rank_kept_fraction(gradients, f)
+    return agg, _coordinate_aux(gradients, agg, 1.0 - kept)
+
+
+def diagnose_phocas(gradients, f, **kwargs):
+    """Diagnostics kernel for phocas: trim fraction of the closest-mean
+    stage (n-f values kept per coordinate, measured against the trmean
+    center by deviation threshold — same tie convention as the kernel)."""
+    g = gradients
+    n = g.shape[0]
+    center = trmean(g, f)
+    agg = closest_mean(g, center, n - f)
+    dev = jnp.abs(g - center[None, :])
+    kept = diag.rank_kept_fraction(dev, f, n_low=0, n_high=n - f)
+    return agg, _coordinate_aux(g, agg, 1.0 - kept)
+
+
+def diagnose_meamed(gradients, f, **kwargs):
+    """Diagnostics kernel for meamed (median-centered closest mean)."""
+    g = gradients
+    n = g.shape[0]
+    center = lower_median(g)
+    agg = closest_mean(g, center, n - f)
+    dev = jnp.abs(g - center[None, :])
+    kept = diag.rank_kept_fraction(dev, f, n_low=0, n_high=n - f)
+    return agg, _coordinate_aux(g, agg, 1.0 - kept)
+
+
 def check(gradients, f, **kwargs):
     n = gradients.shape[0]
     if n < 1:
@@ -49,6 +98,6 @@ def check(gradients, f, **kwargs):
         return f"Invalid number of Byzantine gradients to tolerate, got f = {f!r}, expected 1 <= f <= {(n - 1) // 2}"
 
 
-register("trmean", aggregate_trmean, check)
-register("phocas", aggregate_phocas, check)
-register("meamed", aggregate_meamed, check)
+register("trmean", aggregate_trmean, check, diagnose=diagnose_trmean)
+register("phocas", aggregate_phocas, check, diagnose=diagnose_phocas)
+register("meamed", aggregate_meamed, check, diagnose=diagnose_meamed)

@@ -207,7 +207,10 @@ class _ShardedGar:
     kernel) and pads the d axis up to a multiple of the model-axis size —
     zero columns leave every distance, score and coordinate-wise reduction
     of the real columns unchanged, and are sliced back off. Selection
-    metadata (`influence`) stays on the original GAR object.
+    metadata (`influence`) stays on the original GAR object. `.diagnosed`
+    (the `--gar-diagnostics` path) takes the GENERIC geometry fallback
+    around the sharded kernel — the rule-native aux kernels assume the
+    single-device layout; psum'd-Gram diagnostics are a ROADMAP rung.
     """
 
     def __init__(self, inner, fn, axis_size):
@@ -215,6 +218,10 @@ class _ShardedGar:
         self.influence = inner.influence
         self._fn = fn
         self._axis_size = axis_size
+
+    def diagnosed(self, gradients, **kwargs):
+        from byzantinemomentum_tpu.ops import _generic_diagnose
+        return _generic_diagnose(self.unchecked, gradients, **kwargs)
 
     def unchecked(self, gradients, **_kwargs):
         d = gradients.shape[1]

@@ -8,11 +8,14 @@ Usage:
   python3 reproduce.py [--data-directory results-data]
                        [--plot-directory results-plot]
                        [--devices auto[,auto...]] [--supercharge N]
-                       [--subset smoke|mnist|cifar|all]
+                       [--subset smoke|faults|mnist|cifar|all]
 
 The grid is idempotent: completed result directories are skipped, failed
 ones are kept as `<name>.failed` (reference `tools/jobs.py:126-146`).
-`--subset smoke` runs a tiny 2-run sanity grid (not part of the paper).
+`--subset smoke` runs a tiny 2-run sanity grid (not part of the paper);
+`--subset faults` runs the scheduled fault-plan grid (generated
+`FaultPlan`s at increasing rates) and renders the per-run degradation
+timelines plus the cross-run fault-rate sweep.
 """
 
 import argparse
@@ -115,6 +118,45 @@ def submit_cifar(jobs):
                                     f"{ds}-{attack}-{gar}-f_{f}-lr_{lr}"
                                     f"-at_{momentum}{suffix}",
                                     make_command(params))
+
+
+# Scheduled fault-plan grid (ROADMAP open item: wire the PR 2
+# `fault_timeline`/`fault_rate_sweep` study stubs into the pipeline):
+# per-worker-per-step probabilities of the deterministic chaos kinds, one
+# run per rate, plus the rate-0 baseline. Plans are generated once into
+# `<data-dir>/fault-plans/` (seeded: byte-identical JSON per rerun).
+FAULT_RATES = (0.0, 0.005, 0.01, 0.02)
+
+
+def submit_faults(jobs, data_dir):
+    """Fault-resilience grid: the smoke-scale MNIST config under krum with
+    generated fault plans of increasing rate — no Byzantine attack, so
+    what the sweep isolates is the system-fault degradation policy
+    (dynamic quorum, NaN-quarantine). The analysis stage renders each
+    run's `fault_timeline` and the cross-run `fault_rate_sweep`."""
+    from byzantinemomentum_tpu.faults import FaultPlan
+
+    base = {
+        "batch-size": 16, "model": "simples-full", "loss": "nll",
+        "momentum": 0.9, "evaluation-delta": 10, "nb-steps": 30,
+        "nb-for-study": 9, "nb-for-study-past": 3, "nb-workers": 9,
+        "batch-size-test": 32, "batch-size-test-reps": 2,
+        "learning-rate": 0.5, "gar": "krum", "nb-decl-byz": 2,
+    }
+    plan_dir = data_dir / "fault-plans"
+    plan_dir.mkdir(parents=True, exist_ok=True)
+    for rate in FAULT_RATES:
+        params = dict(base)
+        if rate > 0.0:
+            plan = FaultPlan.generate(
+                nb_workers=base["nb-workers"], nb_steps=base["nb-steps"],
+                rates={"straggler": rate, "drop_worker": rate,
+                       "corrupt_gradient": rate / 2},
+                seed=int(rate * 10000))
+            plan_path = plan_dir / f"rate_{rate}.json"
+            plan.save(plan_path)
+            params["fault-plan"] = str(plan_path)
+        jobs.submit(f"mnist-faults-krum-r_{rate}", make_command(params))
 
 
 def submit_smoke(jobs):
@@ -516,6 +558,59 @@ def analyze(data_dir, plot_dir):
                           ymax=1.0)
             plot.save(plot_dir / f"{stem}.png", xsize=4, ysize=3)
             plot.close()
+        # Fault-resilience plots (the '--subset faults' grid; any run that
+        # recorded the --fault-plan study columns participates): one
+        # degradation timeline per faulted run, then the cross-run
+        # fault-rate sweep — the per-rate summary the ROADMAP called for.
+        # Rate-0 baselines join the sweep through their '-faults-' name.
+        sweep = []
+        for path in paths:
+            sess = _session(cache, path)
+            if sess is None or sess.data is None:
+                continue
+            faulted = "Workers active" in sess.data.columns
+            if faulted:
+                try:
+                    plot = study.fault_timeline(sess)
+                    plot.save(plot_dir / f"fault-timeline-{path.name}.png",
+                              xsize=4, ysize=3)
+                    plot.close()
+                except Exception as err:
+                    utils.warning(f"Unable to plot the fault timeline of "
+                                  f"{path.name!r}: {err}")
+            if faulted or "-faults-" in path.name:
+                sweep.append(sess)
+        if len(sweep) >= 2:
+            for metric in ("Average loss", "Cross-accuracy"):
+                try:
+                    frame, plot = study.fault_rate_sweep(sweep, metric=metric)
+                    if len(frame):
+                        slug = metric.lower().replace(" ", "-")
+                        plot.save(plot_dir / f"fault-rate-sweep-{slug}.png",
+                                  xsize=4, ysize=3)
+                    plot.close()
+                except Exception as err:
+                    utils.warning(f"Unable to plot the fault-rate sweep "
+                                  f"for {metric!r}: {err}")
+        # Forensics plots (--gar-diagnostics runs): the paper's mechanism
+        # — who the GAR trusts over time — next to its accuracy curves
+        for path in paths:
+            sess = _session(cache, path)
+            if sess is None or sess.data is None \
+                    or "Sel workers" not in sess.data.columns:
+                continue
+            try:
+                plot = study.worker_heatmap(sess)
+                plot.save(plot_dir / f"worker-heatmap-{path.name}.png",
+                          xsize=5, ysize=3)
+                plot.close()
+                plot = study.suspicion_timeline(sess)
+                plot.save(plot_dir / f"suspicion-{path.name}.png",
+                          xsize=4, ysize=3)
+                plot.close()
+            except Exception as err:
+                utils.warning(f"Unable to plot the forensics of "
+                              f"{path.name!r}: {err}")
         utils.info(f"Plots written to {plot_dir}")
 
 
@@ -528,7 +623,7 @@ def main():
     parser.add_argument("--supercharge", type=int, default=1,
                         help="Concurrent runs per device")
     parser.add_argument("--subset", type=str, default="all",
-                        choices=("smoke", "mnist", "cifar", "all"))
+                        choices=("smoke", "faults", "mnist", "cifar", "all"))
     args = parser.parse_args()
 
     exit_trigger, exit_is_requested = utils.onetime(None)
@@ -538,10 +633,13 @@ def main():
     data_dir = pathlib.Path(args.data_directory)
     jobs = Jobs(data_dir, devices=args.devices.split(","),
                 supercharge=args.supercharge,
-                seeds=(1,) if args.subset == "smoke" else DEFAULT_SEEDS)
+                seeds=(1,) if args.subset in ("smoke", "faults")
+                else DEFAULT_SEEDS)
     with utils.Context("experiments", "info"):
         if args.subset == "smoke":
             submit_smoke(jobs)
+        if args.subset == "faults":
+            submit_faults(jobs, data_dir)
         if args.subset in ("mnist", "all"):
             submit_mnist(jobs)
         if args.subset in ("cifar", "all"):

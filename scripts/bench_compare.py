@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Diff two benchmark artifacts (`BENCH_r*.json`) cell by cell.
+
+Each artifact is the harness wrapper around one `bench.py` run:
+`{"n": round, "rc": exit code, "parsed": <bench.py's JSON line or null>}`
+where the parsed payload carries the headline metric (`metric`/`value`)
+and a `cells` dict of named sub-benchmarks with `steps_per_sec_*` fields.
+Raw `bench.py` output JSON (the payload without the wrapper) is accepted
+too.
+
+Usage:
+  python scripts/bench_compare.py [OLD.json NEW.json] [--tolerance 0.05]
+
+With no files, the two newest `BENCH_r*.json` at the repo root are
+compared (latest vs previous). Prints the per-cell steps/s deltas and
+exits non-zero when any comparable cell regressed by more than
+`--tolerance` (fractional: 0.05 = 5%).
+
+Incomparability beats false alarms: a run that crashed (`rc != 0` /
+`parsed: null`) or fell back to the CPU backend (`"backend":
+"cpu-fallback"` — a down TPU tunnel, not a code regression; see
+`bench.py:_ensure_backend`) makes the pair INCOMPARABLE — reported as
+such, exit 0 — rather than counted as a regression.
+"""
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+__all__ = ["load_artifact", "compare", "main"]
+
+# Fields (headline + per-cell) holding a steps/s figure worth diffing
+_RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
+
+
+def load_artifact(path):
+    """Parse one artifact into `(payload | None, reason | None)`:
+    payload is bench.py's JSON (the wrapper's `parsed`, or the raw dict),
+    None with a human-readable reason when the run is incomparable."""
+    path = pathlib.Path(path)
+    data = json.loads(path.read_text())
+    if "parsed" in data or "rc" in data:  # the BENCH_r*.json wrapper
+        if data.get("rc", 0) != 0 or not data.get("parsed"):
+            return None, f"{path.name}: benchmark run failed " \
+                         f"(rc={data.get('rc')}, no parsed payload)"
+        payload = data["parsed"]
+    else:
+        payload = data
+    if payload.get("backend") == "cpu-fallback":
+        return None, (f"{path.name}: ran on the CPU fallback backend (down "
+                      f"TPU tunnel) — steps/s not comparable to TPU runs")
+    return payload, None
+
+
+def _rates(payload):
+    """Flatten one payload into `{cell.field: steps_per_sec}`."""
+    rates = {}
+    for key, value in payload.items():
+        if _RATE_KEY.match(key) and isinstance(value, (int, float)):
+            name = payload.get("metric", "headline") if key == "value" else key
+            rates[name] = float(value)
+    for cell, fields in (payload.get("cells") or {}).items():
+        if not isinstance(fields, dict):
+            continue
+        for key, value in fields.items():
+            if _RATE_KEY.match(key) and isinstance(value, (int, float)):
+                rates[f"{cell}.{key}"] = float(value)
+    return rates
+
+
+def compare(old_payload, new_payload, tolerance):
+    """`(rows, regressions)`: per-cell `(name, old, new, delta_frac)` for
+    every steps/s field present in BOTH payloads, and the subset whose
+    delta is below `-tolerance`."""
+    old_rates = _rates(old_payload)
+    new_rates = _rates(new_payload)
+    rows = []
+    for name in sorted(old_rates):
+        if name not in new_rates or old_rates[name] <= 0:
+            continue
+        old, new = old_rates[name], new_rates[name]
+        rows.append((name, old, new, new / old - 1.0))
+    regressions = [r for r in rows if r[3] < -tolerance]
+    return rows, regressions
+
+
+def _latest_pair():
+    found = sorted(ROOT.glob("BENCH_r*.json"))
+    if len(found) < 2:
+        return None
+    return found[-2], found[-1]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="Diff two BENCH_r*.json artifacts, printing per-cell "
+                    "steps/s deltas; exits 1 past --tolerance regression")
+    parser.add_argument("files", nargs="*",
+                        help="OLD.json NEW.json (default: the two newest "
+                             "BENCH_r*.json at the repo root)")
+    parser.add_argument("--tolerance", type=float, default=0.05,
+                        help="fractional regression threshold (default "
+                             "0.05 = 5%%)")
+    args = parser.parse_args(argv)
+    if args.tolerance < 0:
+        parser.error(f"negative tolerance {args.tolerance}")
+
+    if len(args.files) == 2:
+        old_path, new_path = args.files
+    elif not args.files:
+        pair = _latest_pair()
+        if pair is None:
+            print("bench_compare: fewer than two BENCH_r*.json artifacts; "
+                  "nothing to compare")
+            return 0
+        old_path, new_path = pair
+    else:
+        parser.error("expected exactly two files (or none for latest pair)")
+
+    payloads = []
+    for path in (old_path, new_path):
+        try:
+            payload, reason = load_artifact(path)
+        except (OSError, json.JSONDecodeError) as err:
+            print(f"bench_compare: cannot read {path}: {err}")
+            return 2
+        if payload is None:
+            print(f"bench_compare: INCOMPARABLE — {reason}")
+            return 0
+        payloads.append(payload)
+
+    old_payload, new_payload = payloads
+    rows, regressions = compare(old_payload, new_payload, args.tolerance)
+    print(f"bench_compare: {pathlib.Path(old_path).name} -> "
+          f"{pathlib.Path(new_path).name} "
+          f"(tolerance {args.tolerance * 100:.1f}%)")
+    if not rows:
+        print("  no common steps/s cells; nothing to compare")
+        return 0
+    width = max(len(name) for name, *_ in rows)
+    for name, old, new, delta in rows:
+        flag = "  REGRESSED" if delta < -args.tolerance else ""
+        print(f"  {name:<{width}}  {old:10.3f} -> {new:10.3f} steps/s  "
+              f"{delta * 100:+7.2f}%{flag}")
+    if regressions:
+        print(f"bench_compare: {len(regressions)} cell(s) regressed past "
+              f"the {args.tolerance * 100:.1f}% tolerance")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

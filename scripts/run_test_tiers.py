@@ -86,6 +86,19 @@ def main():
     telemetry.event("obs_selfcheck", returncode=selfcheck.returncode)
     print(f"  {obs_selfcheck}", flush=True)
 
+    # Bench-regression tooling smoke: the comparator must run over the
+    # repo's latest artifact pair (an enormous tolerance — this smoke
+    # proves the tool, the real threshold is the caller's choice; crashed
+    # or cpu-fallback artifacts must come back INCOMPARABLE, exit 0)
+    print("bench compare smoke ...", flush=True)
+    bench_cmp = subprocess.run(
+        [sys.executable, "scripts/bench_compare.py", "--tolerance", "1e9"],
+        cwd=ROOT, capture_output=True, text=True)
+    bench_compare = {"returncode": bench_cmp.returncode,
+                     "head": bench_cmp.stdout.splitlines()[:2]}
+    telemetry.event("bench_compare_smoke", returncode=bench_cmp.returncode)
+    print(f"  {bench_compare}", flush=True)
+
     print("default tier ...", flush=True)
     with telemetry.span("tier_default"):
         default = run_pytest(["tests/"])
@@ -120,6 +133,7 @@ def main():
         "host": "1-core TPU build host (slow tier sharded by file "
                 "because one --runslow run exceeds a review window)",
         "obs_selfcheck": obs_selfcheck,
+        "bench_compare": bench_compare,
         "default_tier": default,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
@@ -127,6 +141,7 @@ def main():
         "green": bool(default["failed"] == 0 and default["errors"] == 0
                       and default["returncode"] == 0
                       and obs_selfcheck["returncode"] == 0
+                      and bench_compare["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }

@@ -20,9 +20,11 @@ import pandas
 
 from byzantinemomentum_tpu import models, ops, utils
 
-__all__ = ["Session", "LinePlot", "BoxPlot", "display", "select", "discard",
+__all__ = ["Session", "LinePlot", "BoxPlot", "HeatmapPlot", "display",
+           "select", "discard",
            "fault_timeline", "fault_rate_sweep",
-           "load_telemetry", "run_health", "throughput_sweep"]
+           "load_telemetry", "run_health", "throughput_sweep",
+           "selection_matrix", "worker_heatmap", "suspicion_timeline"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
 TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000, "kmnist": 60000,
@@ -410,6 +412,104 @@ def throughput_sweep(sessions, reducer="mean"):
 
 
 # --------------------------------------------------------------------------- #
+# Aggregation forensics (`--gar-diagnostics`): the GAR's per-step worker
+# selection and the host-side suspicion scores, rendered as the paper's
+# MECHANISM — which workers the robust rule trusts over time — rather than
+# its downstream accuracy curves.
+
+def selection_matrix(session):
+    """`(sel, steps, nb_honests)` from a diagnostics run's study CSV:
+    `sel` is a (nb_workers, T) 0/1 float matrix of the GAR's per-step
+    selection (parsed from the ';'-joined 'Sel workers' column), `steps`
+    the T step numbers, and `nb_honests` the honest row count (rows >=
+    nb_honests are the attack-synthesized workers)."""
+    import numpy as np
+
+    data = _as_frame(session)
+    if "Sel workers" not in data.columns:
+        raise utils.UserException(
+            "No 'Sel workers' column in the study data; the run must be "
+            "recorded with --gar-diagnostics")
+    if not isinstance(session, Session) or not session.json:
+        raise utils.UserException(
+            "worker selection needs the run's config.json (worker counts)")
+    n = int(session.json["nb_workers"])
+    honests = n - int(session.json.get("nb_real_byz", 0))
+    rows = data["Sel workers"].dropna()
+    sel = np.zeros((n, len(rows)))
+    for t, cell in enumerate(rows):
+        cell = str(cell).strip()
+        if cell in ("", "-"):
+            continue
+        for token in cell.split(";"):
+            sel[int(token), t] = 1.0
+    return sel, np.asarray(rows.index), honests
+
+
+def worker_heatmap(session, window=None):
+    """Selection frequency × worker × time heatmap of one diagnostics run.
+
+    Each cell is the worker's selection frequency over a sliding `window`
+    of steps (default: ~T/50, min 1 — raw 0/1 selection for short runs);
+    attack workers (rows >= nb_honests) are bannered with a red frame +
+    axis marker so the paper's mechanism — the robust GAR learning to
+    exclude them as worker momentum shrinks the variance ratio — reads
+    directly off the figure. Returns a `HeatmapPlot` (``.save``/
+    ``.close``)."""
+    import numpy as np
+
+    sel, steps, honests = selection_matrix(session)
+    n, T = sel.shape
+    if T == 0:
+        raise utils.UserException("No 'Sel workers' rows to plot")
+    if window is None:
+        window = max(1, T // 50)
+    if window > 1:
+        kernel = np.ones(window) / window
+        freq = np.apply_along_axis(
+            lambda r: np.convolve(r, kernel, mode="same"), 1, sel)
+    else:
+        freq = sel
+    plot = HeatmapPlot()
+    plot.render(freq, x=steps, title="Worker selection frequency",
+                xlabel="Step number", ylabel="Worker",
+                clabel="Selection frequency", banner_from=honests,
+                banner_label="attack workers")
+    return plot
+
+
+def suspicion_timeline(session):
+    """One diagnostics run's forensic timeline: the max per-worker
+    suspicion score (`obs/forensics.py` EWMA, the 'Suspicion max' study
+    column) over steps, with the run's `suspect_worker` /
+    `suspect_cleared` telemetry events marked as vertical lines when a
+    timeline is available."""
+    data = _as_frame(session)
+    if "Suspicion max" not in data.columns:
+        raise utils.UserException(
+            "No 'Suspicion max' column in the study data; the run must be "
+            "recorded with --gar-diagnostics")
+    sub = data.dropna(subset=["Suspicion max"])
+    plot = LinePlot()
+    plot.include(sub, "Suspicion max")
+    try:
+        frame = load_telemetry(session)
+    except utils.UserException:
+        frame = None
+    if frame is not None:
+        events = frame[frame["kind"] == "event"]
+        for name, color in (("suspect_worker", "red"),
+                            ("suspect_cleared", "green")):
+            for _, event in events[events["name"] == name].iterrows():
+                data_ = event.get("data")
+                step = data_.get("step") if isinstance(data_, dict) else None
+                if step is not None:
+                    plot.vline(step, color=color, label=name)
+    plot.finalize("Suspicion timeline", "Step number", "Suspicion max")
+    return plot
+
+
+# --------------------------------------------------------------------------- #
 # Interactive DataFrame viewer (reference `study.py:44-78`, `:129-180`:
 # a GTK3 TreeView window, degrading to a warning when GTK is unavailable)
 
@@ -588,6 +688,71 @@ class LinePlot:
         return self
 
     def save(self, path, dpi=200, xsize=3, ysize=2):
+        self._fig.set_size_inches(xsize, ysize)
+        self._fig.savefig(str(path), dpi=dpi, bbox_inches="tight")
+        return self
+
+    def close(self):
+        import matplotlib.pyplot as plt
+        plt.close(self._fig)
+
+
+class HeatmapPlot:
+    """Matrix heatmap (worker × time grids: `worker_heatmap`) with the
+    same save/close surface as `LinePlot`/`BoxPlot`."""
+
+    def __init__(self):
+        plt = _plt()
+        self._fig, self._ax = plt.subplots()
+
+    def render(self, matrix, x=None, title=None, xlabel=None, ylabel=None,
+               clabel=None, banner_from=None, banner_label=None,
+               cmap="viridis"):
+        """Draw `matrix` (rows × T) with one row per entity; `x` labels the
+        columns (default 0..T-1). `banner_from` frames rows >= that index
+        in red (the attack-worker banner) and tags them on the y-axis."""
+        import numpy as np
+
+        matrix = np.asarray(matrix, dtype=float)
+        rows, T = matrix.shape
+        x = np.arange(T) if x is None else np.asarray(x)
+        extent = (float(x[0]) - 0.5, float(x[-1]) + 0.5, rows - 0.5, -0.5)
+        im = self._ax.imshow(matrix, aspect="auto", interpolation="nearest",
+                             cmap=cmap, vmin=0.0, extent=extent)
+        cbar = self._fig.colorbar(im, ax=self._ax)
+        if clabel is not None:
+            cbar.set_label(clabel)
+        if banner_from is not None and banner_from < rows:
+            # Red frame around the attack-worker rows + a bracketed y-label
+            self._ax.axhline(banner_from - 0.5, color="red", linewidth=1.5)
+            labels = [str(r) if r < banner_from else f"{r}*"
+                      for r in range(rows)]
+            self._ax.set_yticks(range(rows))
+            self._ax.set_yticklabels(labels)
+            for tick, row in zip(self._ax.get_yticklabels(), range(rows)):
+                if row >= banner_from:
+                    tick.set_color("red")
+            if banner_label:
+                self._ax.text(
+                    1.01, (banner_from + rows) / 2 / rows, banner_label,
+                    transform=self._ax.transAxes, color="red", rotation=90,
+                    va="center", ha="left", fontsize=8, clip_on=False)
+        else:
+            self._ax.set_yticks(range(rows))
+        if title:
+            self._ax.set_title(title)
+        if xlabel:
+            self._ax.set_xlabel(xlabel)
+        if ylabel:
+            self._ax.set_ylabel(ylabel)
+        self._fig.tight_layout()
+        return self
+
+    def display(self):
+        self._fig.show()
+        return self
+
+    def save(self, path, dpi=200, xsize=4, ysize=3):
         self._fig.set_size_inches(xsize, ysize)
         self._fig.savefig(str(path), dpi=dpi, bbox_inches="tight")
         return self

@@ -1,0 +1,89 @@
+"""Unit tests for `scripts/bench_compare.py` (benchmark artifact diffing:
+per-cell deltas, the --tolerance regression gate, and the incomparability
+rules for crashed / cpu-fallback runs)."""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+SCRIPT = (pathlib.Path(__file__).resolve().parent.parent
+          / "scripts" / "bench_compare.py")
+_spec = importlib.util.spec_from_file_location("bench_compare", SCRIPT)
+bench_compare = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("bench_compare", bench_compare)
+_spec.loader.exec_module(bench_compare)
+
+
+def _artifact(tmp_path, name, value, cells=None, rc=0, backend=None,
+              parsed=True):
+    payload = {"metric": "sim_steps_per_sec", "value": value,
+               "unit": "steps/s"}
+    if cells is not None:
+        payload["cells"] = cells
+    if backend is not None:
+        payload["backend"] = backend
+    data = {"n": 1, "rc": rc, "parsed": payload if parsed else None}
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return path
+
+
+def test_improvement_and_within_tolerance_pass(tmp_path, capsys):
+    old = _artifact(tmp_path, "old.json", 10.0,
+                    cells={"krum": {"steps_per_sec_bf16_mixed": 50.0}})
+    new = _artifact(tmp_path, "new.json", 11.0,
+                    cells={"krum": {"steps_per_sec_bf16_mixed": 49.0}})
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "+10.00%" in out and "-2.00%" in out
+    assert "REGRESSED" not in out
+
+
+def test_regression_past_tolerance_fails(tmp_path, capsys):
+    old = _artifact(tmp_path, "old.json", 10.0,
+                    cells={"krum": {"steps_per_sec_bf16_mixed": 50.0}})
+    new = _artifact(tmp_path, "new.json", 10.0,
+                    cells={"krum": {"steps_per_sec_bf16_mixed": 40.0}})
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSED" in out and "-20.00%" in out
+
+
+def test_cpu_fallback_is_incomparable_not_regressed(tmp_path, capsys):
+    old = _artifact(tmp_path, "old.json", 50.0)
+    new = _artifact(tmp_path, "new.json", 1.0, backend="cpu-fallback")
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCOMPARABLE" in out and "cpu fallback" in out.lower()
+
+
+def test_crashed_run_is_incomparable(tmp_path, capsys):
+    old = _artifact(tmp_path, "old.json", 50.0)
+    new = _artifact(tmp_path, "new.json", 0.0, rc=1, parsed=False)
+    rc = bench_compare.main([str(old), str(new)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCOMPARABLE" in out
+
+
+def test_raw_payload_accepted(tmp_path):
+    """Raw bench.py output (no harness wrapper) compares too."""
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps({"metric": "m", "value": 20.0}))
+    payload, reason = bench_compare.load_artifact(raw)
+    assert reason is None and payload["value"] == 20.0
+
+
+def test_compare_only_common_cells():
+    rows, regressions = bench_compare.compare(
+        {"metric": "m", "value": 10.0,
+         "cells": {"a": {"steps_per_sec_f32": 1.0}}},
+        {"metric": "m", "value": 10.0,
+         "cells": {"b": {"steps_per_sec_f32": 1.0}}},
+        tolerance=0.05)
+    names = [r[0] for r in rows]
+    assert names == ["m"] and not regressions

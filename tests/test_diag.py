@@ -1,0 +1,429 @@
+"""Aggregation-forensics tests: the in-jit GAR diagnostics path
+(`ops/diag.py` + per-rule kernels), its engine threading
+(`engine/step.py` / `engine/metrics.py::FORENSIC_COLUMNS`), the host-side
+suspicion tracker (`obs/forensics.py`) and the `study.worker_heatmap`
+rendering — including the two hard guarantees: the krum selection mask
+agrees with the brute-force reference oracle, and `diagnostics=False`
+lowers to the identical StableHLO as the pre-diagnostics kernels.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import losses, obs, ops
+from byzantinemomentum_tpu.engine import (
+    EngineConfig, FORENSIC_COLUMNS, STUDY_COLUMNS, build_engine)
+from byzantinemomentum_tpu.ops import diag
+
+from . import reference_oracles as oracle
+
+RNG = np.random.default_rng(7)
+
+# Every registered first-tier rule (the native tiers share the same
+# diagnose kernels; 'template' deliberately declines its check)
+DIAG_GARS = ("average", "median", "trmean", "phocas", "meamed", "krum",
+             "bulyan", "aksel", "cge", "brute")
+
+
+def rand_grads(n, d, outliers=0, shift=25.0):
+    g = RNG.normal(size=(n, d)).astype(np.float32)
+    for i in range(outliers):
+        g[n - 1 - i] += shift
+    return g
+
+
+# --------------------------------------------------------------------------- #
+# ops-level: schema, aggregate equality, oracle parity
+
+
+@pytest.mark.parametrize("name", DIAG_GARS)
+def test_diagnostics_aggregate_matches_plain(name):
+    """`gar(..., diagnostics=True)[0]` computes the same aggregate as the
+    plain call (the diagnostics kernel shares the math, it never forks the
+    rule's semantics)."""
+    G = rand_grads(11, 16, outliers=2)
+    gar = ops.gars[name]
+    agg0 = np.asarray(gar(G, f=2))
+    agg1, _ = gar(G, f=2, diagnostics=True)
+    np.testing.assert_allclose(np.asarray(agg1), agg0, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", DIAG_GARS)
+def test_diagnostics_aux_schema_uniform(name):
+    """One aux schema across every rule — the mixture-`lax.switch`
+    requirement: same keys, shapes and dtypes."""
+    n, d = 11, 8
+    G = rand_grads(n, d)
+    _, aux = ops.gars[name](G, f=2, diagnostics=True)
+    assert set(aux) == set(diag.AUX_KEYS)
+    assert aux["scores"].shape == (n,) and aux["scores"].dtype == jnp.float32
+    assert aux["selection"].shape == (n,)
+    assert aux["dist"].shape == (n, n)
+    assert aux["trim_frac"].shape == (n,)
+    # The distance geometry is real, not zero-filled, for every rule
+    offdiag = ~np.eye(n, dtype=bool)
+    assert np.all(np.asarray(aux["dist"])[offdiag] > 0)
+
+
+@pytest.mark.parametrize("f", (1, 2, 3))
+def test_krum_diag_selection_matches_oracle(f):
+    """The krum diagnostics selection mask equals the brute-force
+    selection from the PyTorch reference oracle: the m = n-f-2
+    lowest-score workers under stable tie order, for f in {1, 2, 3}."""
+    n, d = 11, 12
+    G = rand_grads(n, d, outliers=f)
+    scores = oracle.krum_scores(torch.tensor(G), f)
+    order = sorted(range(n), key=lambda i: scores[i])  # stable
+    expected = set(order[: n - f - 2])
+
+    _, aux = ops.gars["krum"](G, f=f, diagnostics=True)
+    selected = set(np.nonzero(np.asarray(aux["selection"]) > 0)[0].tolist())
+    assert selected == expected
+    # Scores agree with the oracle too (same metric, f32 tolerance)
+    np.testing.assert_allclose(np.asarray(aux["scores"]),
+                               np.asarray(scores, dtype=np.float32),
+                               rtol=1e-4)
+
+
+def test_brute_diag_selection_matches_oracle():
+    """The brute diagnostics selection mask is the oracle's
+    minimum-diameter subset."""
+    import itertools
+    import math
+
+    n, d, f = 9, 6, 2
+    G = rand_grads(n, d, outliers=2)
+    dist = oracle.pairwise_dist_matrix(torch.tensor(G))
+    best, best_diam = None, math.inf
+    for combo in itertools.combinations(range(n), n - f):
+        diam = max(dist[x, y].item()
+                   for x, y in itertools.combinations(combo, 2))
+        if diam < best_diam:
+            best, best_diam = combo, diam
+    _, aux = ops.gars["brute"](G, f=f, diagnostics=True)
+    selected = tuple(np.nonzero(np.asarray(aux["selection"]) > 0)[0].tolist())
+    assert selected == best
+
+
+def test_trmean_trim_frac_flags_outlier():
+    """A planted coordinate-wise outlier is trimmed on (almost) every
+    coordinate; the central workers keep most of theirs."""
+    G = rand_grads(9, 64, outliers=1, shift=50.0)
+    _, aux = ops.gars["trmean"](G, f=2, diagnostics=True)
+    trim = np.asarray(aux["trim_frac"])
+    assert trim[8] > 0.95          # the outlier row: trimmed ~everywhere
+    assert np.all(trim[:8] < 0.9)  # honest rows keep most coordinates
+    # Clip fraction is a mean over bounded per-worker fractions
+    assert 0.0 <= float(np.mean(trim)) <= 1.0
+
+
+def test_distance_summary_and_ratio_helpers():
+    """`diag.distance_summary` matches a numpy median over the
+    honest-vs-all off-diagonal; `diag.var_norm_ratio` matches the study
+    pipeline's (deviation/norm)² composition."""
+    n, h, d = 9, 7, 16
+    G = rand_grads(n, d, outliers=2)
+    dist = np.asarray(ops._common.pairwise_distances(jnp.asarray(G)))
+    vals = [dist[i, j] for i in range(h) for j in range(n) if j != i]
+    vals.sort()
+    dmin, dmed, dmax = diag.distance_summary(jnp.asarray(dist), rows=h)
+    assert float(dmin) == pytest.approx(vals[0], rel=1e-6)
+    assert float(dmed) == pytest.approx(vals[(len(vals) - 1) // 2], rel=1e-6)
+    assert float(dmax) == pytest.approx(vals[-1], rel=1e-6)
+
+    avg = G.mean(axis=0)
+    dev2 = float(((G - avg) ** 2).sum() / (n - 1))
+    expected = dev2 / float((avg ** 2).sum())
+    assert float(diag.var_norm_ratio(jnp.asarray(G))) == pytest.approx(
+        expected, rel=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# HLO identity: diagnostics OFF is byte-identical to the pre-change kernels
+
+
+@pytest.mark.parametrize("name,f", (("krum", 2), ("bulyan", 2),
+                                    ("brute", 2), ("trmean", 2),
+                                    ("median", 2), ("cge", 2), ("aksel", 2)))
+def test_hlo_identity_diagnostics_off_ops(name, f):
+    """A `diagnostics=False` checked call lowers to the same StableHLO
+    text as the raw kernel — the diagnostics machinery cannot perturb the
+    hot path."""
+    gar = ops.gars[name]
+    spec = jax.ShapeDtypeStruct((11, 16), jnp.float32)
+    raw = jax.jit(lambda G: gar.unchecked(G, f=f)).lower(spec).as_text()
+    off = jax.jit(
+        lambda G: gar(G, f=f, diagnostics=False)).lower(spec).as_text()
+    assert raw == off
+
+
+def _probe_engine(gar_diagnostics, defenses=("krum",), strip_diagnose=False):
+    """A tiny 6-d engine over the probe model (same scheme as
+    `test_engine.py`) for step-lowering comparisons."""
+    from byzantinemomentum_tpu.models import ModelDef
+
+    D = 6
+
+    def init(key):
+        return {"w": jnp.zeros((D,), jnp.float32)}, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        return x, state
+
+    loss = losses.Loss(lambda output, target, params:
+                       jnp.dot(params, jnp.mean(output, axis=0)))
+    defense_list = []
+    freq = 0.0
+    for name in defenses:
+        gar = ops.gars[name]
+        if strip_diagnose:
+            gar = ops.GAR(gar.name, gar.unchecked, gar.check,
+                          upper_bound=gar.upper_bound,
+                          influence=gar.influence, diagnose=None)
+        freq += 1.0
+        defense_list.append((gar, freq, {}))
+    cfg = EngineConfig(nb_workers=8, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=8, nb_for_study_past=2,
+                       gar_diagnostics=gar_diagnostics)
+    engine = build_engine(cfg=cfg, model_def=ModelDef("probe", init, apply,
+                                                      (D,)),
+                          loss=loss, criterion=losses.Criterion("sigmoid"),
+                          defenses=defense_list)
+    return cfg, engine
+
+
+def _lower_step_text(engine, cfg):
+    S = cfg.nb_sampled
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((6,))}, net_state={})
+    xs = jnp.zeros((S, 4, 6), jnp.float32)
+    ys = jnp.zeros((S, 4), jnp.float32)
+    return engine.train_step.lower(state, xs, ys,
+                                   jnp.float32(0.1)).as_text()
+
+
+def test_hlo_identity_diagnostics_off_engine_step():
+    """The full train step with `gar_diagnostics=False` lowers to the same
+    StableHLO as an engine whose GARs carry NO diagnose kernels at all
+    (i.e. the pre-change program); turning diagnostics ON changes the
+    lowering (the aux outputs exist)."""
+    cfg_off, engine_off = _probe_engine(False)
+    _, engine_pre = _probe_engine(False, strip_diagnose=True)
+    assert _lower_step_text(engine_off, cfg_off) == \
+        _lower_step_text(engine_pre, cfg_off)
+
+    cfg_on, engine_on = _probe_engine(True)
+    assert _lower_step_text(engine_on, cfg_on) != \
+        _lower_step_text(engine_off, cfg_off)
+
+
+# --------------------------------------------------------------------------- #
+# Engine threading
+
+
+def test_engine_step_emits_forensic_metrics():
+    """With diagnostics on, the step's metric dict carries the forensic
+    keys; the selection mask sums to the selected count and the scalar
+    columns are finite."""
+    cfg, engine = _probe_engine(True)
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((6,))}, net_state={})
+    S = cfg.nb_sampled
+    xs = jnp.asarray(RNG.normal(size=(S, 4, 6)).astype(np.float32))
+    ys = jnp.zeros((S, 4), jnp.float32)
+    _, metrics = engine.train_step(state, xs, ys, jnp.float32(0.1))
+    for key in ("Sel mask", "Worker dist", "Dist honest med",
+                "Var/norm ratio", "Clip frac"):
+        assert key in metrics, key
+    sel = np.asarray(metrics["Sel mask"])
+    assert sel.shape == (cfg.nb_workers,)
+    # krum at n=8, f=1 selects m = n-f-2 = 5 rows
+    assert int((sel > 0).sum()) == 5
+    assert np.isfinite(float(metrics["Dist honest med"]))
+    assert np.isfinite(float(metrics["Var/norm ratio"]))
+
+
+def test_engine_mixture_diagnostics_switch():
+    """A --gars mixture with diagnostics on works through `lax.switch`
+    (uniform aux schema across rules with different native kernels)."""
+    cfg, engine = _probe_engine(True, defenses=("krum", "median"))
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((6,))}, net_state={})
+    S = cfg.nb_sampled
+    xs = jnp.asarray(RNG.normal(size=(S, 4, 6)).astype(np.float32))
+    ys = jnp.zeros((S, 4), jnp.float32)
+    _, metrics = engine.train_step(state, xs, ys, jnp.float32(0.1))
+    assert np.asarray(metrics["Sel mask"]).shape == (cfg.nb_workers,)
+
+
+def test_device_gar_hop_with_diagnostics():
+    """The heterogeneous-placement step (`--device-gar`) threads the
+    5-tuple defense output — diag metrics hop back with the Byzantine
+    rows."""
+    from byzantinemomentum_tpu.engine.step import make_device_gar_step
+
+    cfg, engine = _probe_engine(True)
+    step = make_device_gar_step(engine, "cpu")
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((6,))}, net_state={})
+    S = cfg.nb_sampled
+    xs = jnp.asarray(RNG.normal(size=(S, 4, 6)).astype(np.float32))
+    ys = jnp.zeros((S, 4), jnp.float32)
+    _, metrics = step(state, xs, ys, jnp.float32(0.1))
+    assert np.asarray(metrics["Sel mask"]).shape == (cfg.nb_workers,)
+
+
+def test_mesh_sharded_step_with_diagnostics():
+    """`--mesh` composes with diagnostics: the sharded step (whose GARs
+    are swapped for `_ShardedGar` facades) emits the forensic metrics
+    through the generic geometry fallback."""
+    from byzantinemomentum_tpu.parallel import make_mesh, sharded_train_step
+
+    cfg, engine = _probe_engine(True)
+    mesh = make_mesh(2)
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((6,))}, net_state={})
+    step = sharded_train_step(engine, mesh, state)
+    S = cfg.nb_sampled
+    xs = jnp.asarray(RNG.normal(size=(S, 4, 6)).astype(np.float32))
+    ys = jnp.zeros((S, 4), jnp.float32)
+    _, metrics = step(state, xs, ys, jnp.float32(0.1))
+    sel = np.asarray(metrics["Sel mask"])
+    assert sel.shape == (cfg.nb_workers,)
+    assert np.isfinite(float(metrics["Var/norm ratio"]))
+
+
+# --------------------------------------------------------------------------- #
+# Suspicion tracker (obs/forensics.py)
+
+
+def test_suspicion_tracker_flags_planted_byzantine(tmp_path):
+    """A worker that is never selected and sits far from the cloud crosses
+    the threshold and lands a `suspect_worker` event naming it on the
+    active recorder; nobody else is flagged."""
+    telemetry = obs.Telemetry(tmp_path)
+    obs.activate(telemetry)
+    try:
+        tracker = obs.SuspicionTracker(6, min_steps=5)
+        sel = np.array([1, 1, 1, 1, 1, 0], dtype=float)
+        dist = np.array([1.0, 1.1, 0.9, 1.0, 1.05, 8.0])
+        for step in range(50):
+            tracker.update(step, sel, distances=dist)
+    finally:
+        obs.deactivate()
+        telemetry.close()
+    assert tracker.suspects == [5]
+    assert tracker.max() == pytest.approx(tracker.suspicion[5])
+    events = [r for r in obs.load_records(tmp_path)
+              if r["kind"] == "event" and r["name"] == "suspect_worker"]
+    assert [e["data"]["worker"] for e in events] == [5]
+
+
+def test_suspicion_tracker_clears_on_recovery(tmp_path):
+    """A flagged worker whose behavior normalizes decays below the clear
+    threshold and emits `suspect_cleared` (hysteresis edge)."""
+    telemetry = obs.Telemetry(tmp_path)
+    obs.activate(telemetry)
+    try:
+        tracker = obs.SuspicionTracker(4, min_steps=5, alpha=0.2)
+        bad = np.array([1, 1, 1, 0], dtype=float)
+        good = np.ones(4)
+        dist_bad = np.array([1.0, 1.0, 1.0, 9.0])
+        dist_good = np.ones(4)
+        for step in range(30):
+            tracker.update(step, bad, distances=dist_bad)
+        assert tracker.suspects == [3]
+        for step in range(30, 120):
+            tracker.update(step, good, distances=dist_good)
+    finally:
+        obs.deactivate()
+        telemetry.close()
+    assert tracker.suspects == []
+    names = [r["name"] for r in obs.load_records(tmp_path)
+             if r["kind"] == "event"]
+    assert "suspect_worker" in names and "suspect_cleared" in names
+
+
+def test_suspicion_tracker_quarantine_component():
+    """The quarantine EWMA contributes: a worker repeatedly reported
+    inactive accrues suspicion even while selected and central."""
+    tracker = obs.SuspicionTracker(4, min_steps=1)
+    sel = np.ones(4)
+    active = np.array([1, 1, 1, 0], dtype=float)
+    for step in range(60):
+        tracker.update(step, sel, active=active)
+    assert tracker.suspicion[3] > tracker.suspicion[:3].max()
+
+
+def test_suspicion_tracker_validation():
+    with pytest.raises(ValueError):
+        obs.SuspicionTracker(4, alpha=0.0)
+    with pytest.raises(ValueError):
+        obs.SuspicionTracker(4, threshold=0.3, clear=0.5)
+
+
+# --------------------------------------------------------------------------- #
+# Driver e2e (the ISSUE acceptance criterion) + plots
+
+
+@pytest.fixture
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+def test_driver_forensics_krum_empire_worker_momentum(tmp_path, small_synth):
+    """CPU smoke config, empire attack under krum, momentum at the
+    workers: once the worker momentum has warmed up, 'Sel workers' never
+    includes an attacking worker (the paper's mechanism), the suspicion
+    column is populated, and `worker_heatmap`/`suspicion_timeline` render
+    from the run's output without error."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import pandas
+
+    from byzantinemomentum_tpu.cli.attack import main
+
+    resdir = tmp_path / "run"
+    rc = main(["--nb-steps", "16", "--batch-size", "8",
+               "--batch-size-test", "32", "--batch-size-test-reps", "2",
+               "--evaluation-delta", "0", "--model", "simples-full",
+               "--seed", "11", "--nb-workers", "9", "--nb-decl-byz", "2",
+               "--nb-real-byz", "2", "--gar", "krum",
+               "--attack", "empire", "--attack-args", "factor:1.1",
+               "--momentum-at", "worker", "--nb-for-study", "7",
+               "--nb-for-study-past", "2", "--gar-diagnostics",
+               "--result-directory", str(resdir)])
+    assert rc == 0
+    header = (resdir / "study").read_text().split(os.linesep)[0]
+    assert header == "# " + "\t".join(STUDY_COLUMNS + FORENSIC_COLUMNS)
+
+    data = pandas.read_csv(resdir / "study", sep="\t", index_col=0)
+    attackers = {7, 8}  # rows >= nb_honests = 7
+    warm = [s for s in data.index if s >= 8]  # momentum warmed up
+    assert warm
+    for step in warm:
+        cell = str(data.loc[step, "Sel workers"])
+        selected = {int(t) for t in cell.split(";")} if cell != "-" else set()
+        assert not (selected & attackers), (step, cell)
+    # The headline ratio drops as worker momentum accumulates
+    ratio = data["Var/norm ratio"].astype(float)
+    assert float(ratio.iloc[-1]) < float(ratio.iloc[0])
+    assert data["Suspicion max"].astype(float).between(0, 1).all()
+
+    import study
+    sess = study.Session(resdir)
+    plot = study.worker_heatmap(sess)
+    plot.save(tmp_path / "heatmap.png")
+    plot.close()
+    assert (tmp_path / "heatmap.png").stat().st_size > 0
+    plot = study.suspicion_timeline(sess)
+    plot.save(tmp_path / "suspicion.png")
+    plot.close()
+    assert (tmp_path / "suspicion.png").stat().st_size > 0

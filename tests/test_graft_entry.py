@@ -1,0 +1,71 @@
+"""Regression tests for the driver integration points (`__graft_entry__`).
+
+The MULTICHIP_r05 rc=124 hang: `dryrun_multichip`'s PARENT-side
+`jax.devices()` probe used to initialize whatever accelerator platform the
+environment registers (this container's sitecustomize force-registers the
+axon TPU platform), and a broken TPU tunnel turns that into an indefinite
+backend-setup stall. The fix pins the parent to the CPU backend exactly as
+the re-exec'd child always did; these tests prove the dryrun completes on
+the virtual CPU mesh without the parent touching an accelerator backend.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_dryrun_multichip_completes_on_virtual_cpu_mesh():
+    """End-to-end: a parent WITHOUT the conftest's JAX_PLATFORMS pin (the
+    MULTICHIP harness environment) must finish the 4-device dryrun inside
+    a bounded window — the code-level CPU pin is what keeps the probe off
+    the TPU tunnel. Runs in a subprocess: the probe hazard is the parent
+    process's own backend initialization, which an in-process call from
+    the (already CPU-pinned) test process could never reproduce."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the un-pinned-parent scenario
+    # Drop the conftest's virtual device count: the parent must see fewer
+    # devices than requested and take the re-exec path (the shipped one)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        part for part in flags.split()
+        if "xla_force_host_platform_device_count" not in part)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__\n"
+         "__graft_entry__.dryrun_multichip(4)\n"
+         "print('DRYRUN_OK')\n"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=240)  # far under the harness's ~10 min rc=124 ceiling
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DRYRUN_OK" in proc.stdout
+
+
+def test_dryrun_parent_pins_cpu_platform():
+    """The parent process's probe must run on the CPU backend even when an
+    accelerator platform is importable: after `dryrun_multichip` returns,
+    the parent's own backend is CPU (cheap sub-second check — no training
+    step compiles in the parent when it re-execs)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        part for part in flags.split()
+        if "xla_force_host_platform_device_count" not in part)
+    code = (
+        "import __graft_entry__, jax, os\n"
+        "import unittest.mock as mock\n"
+        "# Stub the subprocess re-exec: this test only certifies the\n"
+        "# PARENT's probe platform, not the child's step (covered above)\n"
+        "with mock.patch.object(__graft_entry__.subprocess, 'run') as run:\n"
+        "    __graft_entry__.dryrun_multichip(64)\n"
+        "assert run.called\n"
+        "assert jax.devices()[0].platform == 'cpu', jax.devices()\n"
+        "print('PARENT_CPU_OK')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "PARENT_CPU_OK" in proc.stdout
