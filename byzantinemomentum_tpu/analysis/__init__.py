@@ -1,0 +1,33 @@
+"""Static analysis & lowering contracts — the compile-time half of the
+correctness story.
+
+PR 3/4 built the *runtime* observability (telemetry, forensics); this
+package checks the invariants that never reach runtime because they are
+properties of the source and of the lowering itself:
+
+  lint       jaxlint — an AST rule engine (rule ids `BMT-Exx`) for the JAX
+             failure modes this codebase actually has: PRNG key reuse,
+             host sync inside traced scopes, jit cache-miss hazards,
+             use-after-donate, broad/bare `except`, wall-clock reads in
+             traced code, redundant array conversions. Pure AST — importing
+             it never touches jax.
+  contracts  Runtime lowering/dispatch contracts: a recompile-budget
+             harness (count backend compiles over a warm loop, assert the
+             declared budget — normally zero) and a transfer-guard wrapper
+             (`jax.transfer_guard("disallow")`) asserting the hot loop
+             performs no implicit device<->host transfers.
+  lowering   Golden StableHLO fingerprints per (GAR x diagnostics x
+             masked-quorum) cell, generalizing `tests/test_diag.py`'s
+             byte-identical assertion into a blessed contract
+             (`tests/goldens/lowerings.json`, `scripts/bless_lowerings.py`)
+             with a CI gate that fails on unexplained lowering drift.
+
+CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints;
+`--check-lowerings` runs the drift gate; `--rules` prints the rule table.
+Suppressions are per-line `# bmt: noqa[BMT-Exx] <reason>` and the reason
+is mandatory (an empty reason is itself a violation, `BMT-E00`).
+"""
+
+from byzantinemomentum_tpu.analysis import lint  # noqa: F401 (jax-free)
+
+__all__ = ["lint"]

@@ -1,0 +1,91 @@
+"""CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints;
+`--check-lowerings` runs the StableHLO drift gate; `--rules` prints the
+registry. Exit 0 = clean (or incomparable goldens), 1 = violations/drift,
+2 = usage error."""
+
+import argparse
+import json
+import sys
+
+from byzantinemomentum_tpu.analysis import lint
+
+
+def _print_rules():
+    width = max(len(r.slug) for r in lint.RULES.values())
+    for rule_id in sorted(lint.RULES):
+        r = lint.RULES[rule_id]
+        print(f"{r.id}  {r.slug:<{width}}  {r.summary}")
+
+
+def _check_lowerings(goldens, as_json):
+    # Pin the CPU backend for deterministic fingerprints (this
+    # environment's sitecustomize may force a TPU platform; see
+    # tests/conftest.py for why the config update is load-bearing)
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from byzantinemomentum_tpu.analysis import lowering
+
+    report = lowering.check(goldens) if goldens else lowering.check()
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(f"lowerings: {report['status']}"
+              + (f" ({report.get('checked', 0)} cells)"
+                 if "checked" in report else ""))
+        for key in ("drifted", "added", "removed"):
+            for cell in report.get(key, ()):
+                print(f"  {key}: {cell}")
+        if report["status"] == "missing":
+            print(f"  no goldens at {report['path']} — run "
+                  f"scripts/bless_lowerings.py")
+        if report["status"] == "incomparable":
+            print(f"  blessed under {report['blessed']}, running "
+                  f"{report['current']} — re-bless, not a drift failure")
+    # missing goldens fail (the gate would silently pass forever);
+    # incomparable does not (toolchain bump, the bench_compare discipline)
+    return 0 if report["status"] in ("ok", "incomparable") else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m byzantinemomentum_tpu.analysis",
+        description="jaxlint + lowering-contract gate")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--rules", action="store_true",
+                        help="print the rule registry and exit")
+    parser.add_argument("--check-lowerings", action="store_true",
+                        help="compare StableHLO fingerprints against the "
+                             "blessed goldens")
+    parser.add_argument("--goldens", default=None,
+                        help="override the goldens path "
+                             "(default tests/goldens/lowerings.json)")
+    args = parser.parse_args(argv)
+
+    if args.rules:
+        _print_rules()
+        return 0
+    if not args.paths and not args.check_lowerings:
+        parser.error("nothing to do: give paths to lint, "
+                     "--check-lowerings, or --rules")
+
+    rc = 0
+    if args.paths:
+        files = list(lint.iter_python_files(args.paths))
+        violations = lint.lint_paths(args.paths)
+        if args.json:
+            print(lint.format_json(violations, files_checked=len(files)))
+        else:
+            print(lint.format_human(violations))
+        rc = 1 if violations else rc
+    if args.check_lowerings:
+        rc = max(rc, _check_lowerings(args.goldens, args.json))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

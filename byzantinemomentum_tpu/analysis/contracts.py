@@ -1,0 +1,137 @@
+"""Runtime compilation/dispatch contracts — the invariants jaxlint cannot
+see from the source.
+
+Two contracts, both cheap enough for tier-1:
+
+  * recompile budget — the warm training loop must not recompile. A
+    `count_compiles()` listener (the same `jax.monitoring`
+    backend-compile signal the obs recorder consumes) counts actual XLA
+    backend compiles over a window; `assert_recompile_budget` runs a warm
+    step function N times under the counter — and under
+    `jax_explain_cache_misses`, so a violation's log says *why* the cache
+    missed — and fails when the count exceeds the declared budget
+    (normally zero: every shape/dtype/static-arg drift is a bug).
+
+  * transfer guard — the hot loop performs no implicit device<->host
+    transfers. `no_implicit_transfers()` wraps
+    `jax.transfer_guard("disallow")`: an un-device_put input, a Python
+    scalar argument, or a stray `np.asarray` inside the window raises
+    instead of silently stalling the pipeline.
+
+jax imports are lazy: importing this module (or the analysis package CLI)
+must work where no backend can initialize.
+"""
+
+import contextlib
+
+__all__ = ["ContractError", "RecompileBudgetError", "count_compiles",
+           "explain_cache_misses", "assert_recompile_budget",
+           "no_implicit_transfers"]
+
+
+class ContractError(AssertionError):
+    """A static/lowering contract did not hold."""
+
+
+class RecompileBudgetError(ContractError):
+    """The warm loop compiled more programs than its declared budget."""
+
+
+class CompileLog:
+    """Backend-compile events observed inside a `count_compiles()` window."""
+
+    def __init__(self):
+        self.events = []
+        self.active = True
+
+    @property
+    def count(self):
+        return len(self.events)
+
+
+@contextlib.contextmanager
+def count_compiles():
+    """Count XLA backend compiles within the context (yields a `CompileLog`).
+
+    Counts the `/jax/core/compile/backend_compile*` duration events — the
+    actual backend compiles, not per-jaxpr traces (same discrimination as
+    `obs/recorder.py`'s recompile counter). Note one user-visible `jit`
+    compile may emit several backend events (subcomputations); a budget of
+    zero is exact either way, nonzero budgets should be measured, not
+    derived.
+    """
+    from jax import monitoring
+
+    log = CompileLog()
+
+    def _listener(event, duration, **kwargs):
+        if log.active and "backend_compile" in str(event):
+            log.events.append(str(event))
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    try:
+        yield log
+    finally:
+        log.active = False  # the unregister below is best-effort
+        try:
+            from jax._src import monitoring as _monitoring_impl
+            _monitoring_impl._unregister_event_duration_listener_by_callback(
+                _listener)
+        except (ImportError, AttributeError, ValueError):
+            pass  # private API drifted: the inert listener stays, harmless
+
+
+@contextlib.contextmanager
+def explain_cache_misses():
+    """Enable `jax_explain_cache_misses` within the context (restores the
+    previous value): every tracing-cache miss logs its reason, which is
+    exactly the diagnostic a tripped recompile budget needs."""
+    import jax
+
+    old = jax.config.jax_explain_cache_misses
+    jax.config.update("jax_explain_cache_misses", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_explain_cache_misses", old)
+
+
+def assert_recompile_budget(step_fn, *, steps=3, budget=0, explain=True,
+                            label="warm loop"):
+    """Run `step_fn()` `steps` times and require at most `budget` backend
+    compiles across the whole window.
+
+    The caller warms the program up FIRST (one untimed call outside):
+    this asserts the steady state, where any compile means shape drift,
+    an unhashable static arg, or a Python-scalar cache key churning.
+    Returns the observed compile count.
+    """
+    import jax
+
+    with contextlib.ExitStack() as stack:
+        if explain:
+            stack.enter_context(explain_cache_misses())
+        log = stack.enter_context(count_compiles())
+        for _ in range(steps):
+            result = step_fn()
+            if result is not None:
+                jax.block_until_ready(result)
+    if log.count > budget:
+        raise RecompileBudgetError(
+            f"{label}: {log.count} backend compile(s) over {steps} warm "
+            f"step(s), budget {budget} — the step is being retraced "
+            f"(events: {log.events[:6]}{'...' if log.count > 6 else ''}); "
+            f"run under explain_cache_misses() logging for the reason")
+    return log.count
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """`jax.transfer_guard("disallow")` with the contract's framing: inside
+    the context any implicit device<->host transfer (un-committed inputs,
+    Python scalar arguments, `np.asarray` on device values) raises.
+    Explicit `jax.device_put`/`jax.device_get` remain allowed."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield

@@ -1,0 +1,757 @@
+"""jaxlint — an AST rule engine for the JAX failure modes this codebase
+actually has.
+
+Each rule has a stable id (`BMT-Exx`), registers itself in `RULES`, and
+yields `Violation`s over a parsed module. Detection is purely syntactic
+(one `ast` pass, no jax import): the traced-scope rules lean on the
+heuristic that a function is traced when it is decorated with / passed to
+a tracing combinator (`jit`, `vmap`, `grad`, `lax.scan`, ...) or reachable
+from one through same-module calls — exactly the discipline this codebase
+follows (`engine/step.py` wires every traced function through
+`_mode_jit`/`jax.jit`/`lax.scan` in the same module).
+
+Suppression is per line and per rule, and the reason is mandatory:
+
+    risky_line()  # bmt: noqa[BMT-E05] watchdog must survive mangled dirs
+
+A `# bmt: noqa[...]` with an empty reason is itself reported (`BMT-E00`):
+an unexplained suppression is technical debt with extra steps.
+
+Output: `lint_paths` -> list of `Violation`; `format_human` /
+`format_json` render them. The module is import-light on purpose — the
+lint tier must run even where jax cannot initialize a backend.
+"""
+
+import ast
+import dataclasses
+import io
+import json
+import pathlib
+import re
+import tokenize
+
+__all__ = ["RULES", "Violation", "lint_source", "lint_paths",
+           "format_human", "format_json", "iter_python_files"]
+
+
+# --------------------------------------------------------------------------- #
+# Registry
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str        # "BMT-E05"
+    slug: str      # "broad-except"
+    summary: str   # one line for the --rules table
+    check: object  # callable(Module) -> iterable[Violation]
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+RULES = {}
+
+
+def rule(rule_id, slug, summary):
+    def wrap(fn):
+        RULES[rule_id] = Rule(rule_id, slug, summary, fn)
+        return fn
+    return wrap
+
+
+# --------------------------------------------------------------------------- #
+# Shared syntactic helpers
+
+def _dotted(node):
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains (None otherwise)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node):
+    """The terminal callable name of an expression: `self._mode_jit` ->
+    "_mode_jit", `functools.partial(f, x)` -> terminal of `f` (partials
+    forward to their wrapped callable)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call) and node.args:
+        if _terminal(node.func) == "partial":
+            return _terminal(node.args[0])
+    return None
+
+
+# Names that mean "the arguments of this call get traced"
+_TRACING_NAMES = frozenset({
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "associated_scan", "shard_map", "remat", "checkpoint",
+    "custom_jvp", "custom_vjp", "linearize", "vjp", "jvp",
+})
+
+
+def _is_tracing_callee(name):
+    return name is not None and (name in _TRACING_NAMES or "jit" in name)
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class Module:
+    """One parsed file plus the shared analyses every rule reads."""
+
+    def __init__(self, path, source):
+        self.path = str(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.path)
+        self.parent = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        # name -> defs: every def in the module, by name (methods included)
+        self.defs = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+        # simple aliases: `worker = self._worker_grad` / `w = partial(f, x)`
+        self.alias = {}
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                term = _terminal(node.value)
+                if term is not None and not isinstance(node.value, ast.Name):
+                    self.alias[node.targets[0].id] = term
+        self.traced = self._traced_functions()
+        self.noqa = self._noqa_lines()
+
+    # -- traced-scope inference ------------------------------------------- #
+
+    def _mark_traced_arg(self, arg, traced):
+        if isinstance(arg, ast.Lambda):
+            traced.add(arg)
+            return
+        term = _terminal(arg)
+        term = self.alias.get(term, term)
+        for d in self.defs.get(term, ()):
+            traced.add(d)
+
+    def _traced_functions(self):
+        traced = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    names = {_terminal(deco)}
+                    if isinstance(deco, ast.Call):
+                        names.add(_terminal(deco.func))
+                        names.update(_terminal(a) for a in deco.args)
+                    if any(_is_tracing_callee(n) for n in names if n):
+                        traced.add(node)
+            if isinstance(node, ast.Call):
+                if _is_tracing_callee(_terminal(node.func)):
+                    for arg in node.args:
+                        self._mark_traced_arg(arg, traced)
+        # Fixpoint: nested defs and same-module callees of traced code are
+        # traced too (the engine's phase helpers, the kernels they call)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                body = fn.body if isinstance(fn.body, list) else [fn.body]
+                for stmt in body:
+                    for node in ast.walk(stmt):
+                        if isinstance(node, _FUNC_NODES) and node not in traced:
+                            traced.add(node)
+                            changed = True
+                        if isinstance(node, ast.Call):
+                            term = _terminal(node.func)
+                            term = self.alias.get(term, term)
+                            for d in self.defs.get(term, ()):
+                                if d not in traced:
+                                    traced.add(d)
+                                    changed = True
+        return traced
+
+    def enclosing_function(self, node):
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            cur = self.parent.get(cur)
+        return cur
+
+    def in_traced(self, node):
+        cur = self.enclosing_function(node)
+        while cur is not None:
+            if cur in self.traced:
+                return True
+            cur = self.enclosing_function(cur)
+        return False
+
+    def function_scopes(self):
+        """Every def/lambda body plus the module body, as (scope_node,
+        statements) pairs — the unit the dataflow-ish rules walk."""
+        yield self.tree, self.tree.body
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node, node.body
+
+    def scope_nodes(self, scope):
+        """All AST nodes belonging to `scope` but not to a nested def/class
+        (so a name in an inner closure does not count as a use in the
+        outer scope's straight line)."""
+        own = []
+        stack = list(scope.body)
+        while stack:
+            node = stack.pop()
+            own.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # its name binds here; its body is another scope
+            stack.extend(ast.iter_child_nodes(node))
+        return own
+
+    # -- suppression ------------------------------------------------------ #
+
+    _NOQA = re.compile(r"#\s*bmt:\s*noqa\[([A-Za-z0-9_\-, ]+)\]\s*(.*\S)?")
+
+    def _noqa_lines(self):
+        """line -> (set of rule ids, reason or None). Real comments only
+        (tokenize): a noqa example quoted in a docstring is prose, not a
+        suppression."""
+        table = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = list(enumerate(self.lines, start=1))
+        for line, text in comments:
+            m = self._NOQA.search(text)
+            if m:
+                ids = {t.strip() for t in m.group(1).split(",") if t.strip()}
+                reason = (m.group(2) or "").strip() or None
+                table[line] = (ids, reason)
+        return table
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E00 — suppressions must explain themselves
+
+@rule("BMT-E00", "noqa-without-reason",
+      "a `# bmt: noqa[...]` suppression carries no reason")
+def _check_noqa_reason(mod):
+    out = []
+    for line, (ids, reason) in sorted(mod.noqa.items()):
+        if reason is None:
+            out.append(Violation(
+                mod.path, line, 0, "BMT-E00",
+                f"suppression of {', '.join(sorted(ids))} without a reason "
+                f"— write `# bmt: noqa[RULE] why this is safe`"))
+        unknown = sorted(i for i in ids if i not in RULES and i != "all")
+        if unknown:
+            out.append(Violation(
+                mod.path, line, 0, "BMT-E00",
+                f"suppression names unknown rule id(s) "
+                f"{', '.join(unknown)}"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E01 — PRNG key reuse
+
+# jax.random calls that DERIVE without consuming; everything else under
+# jax.random consumes its key argument
+_KEY_DERIVERS = frozenset({
+    "split", "fold_in", "PRNGKey", "key", "key_data", "wrap_key_data",
+    "clone", "key_impl",
+})
+_RANDOM_MODULES = frozenset({"random", "jrandom", "jr"})
+
+
+def _random_sampler_call(node):
+    """The (call, key-arg) of a consuming `jax.random.<fn>(key, ...)` call,
+    else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    owner = _terminal(func.value)
+    if owner not in _RANDOM_MODULES or func.attr in _KEY_DERIVERS:
+        return None
+    if not node.args:
+        return None
+    return node.args[0]
+
+
+def _field_of(parent, child):
+    for field, value in ast.iter_fields(parent):
+        if value is child or (isinstance(value, list) and child in value):
+            return field
+    return None
+
+
+def _control_context(mod, node, scope):
+    """(branch_path, exits) of a node inside `scope`: which field of each
+    enclosing If/Try/loop the node sits in (so mutually exclusive branches
+    don't pair), and whether control leaves the function right after the
+    node (a `return`/`raise` use cannot flow into a later one)."""
+    path, exits = {}, False
+    cur = node
+    parent = mod.parent.get(cur)
+    while parent is not None and cur is not scope:
+        if isinstance(parent, (ast.Return, ast.Raise)):
+            exits = True
+        if isinstance(parent, (ast.If, ast.Try, ast.For, ast.While)):
+            path[id(parent)] = _field_of(parent, cur)
+        cur, parent = parent, mod.parent.get(parent)
+    return path, exits
+
+
+def _may_flow_between(ctx_a, ctx_b):
+    """Whether execution can reach use B after use A in one run — False
+    when A exits the function or the two sit in different branches of a
+    shared If/Try."""
+    path_a, exits_a = ctx_a
+    path_b, _ = ctx_b
+    if exits_a:
+        return False
+    return all(path_a[k] == path_b[k] for k in path_a.keys() & path_b.keys())
+
+
+@rule("BMT-E01", "prng-key-reuse",
+      "the same PRNG key is consumed by two sampling calls (split it)")
+def _check_key_reuse(mod):
+    out = []
+    for scope, _ in mod.function_scopes():
+        consumes = {}   # name -> [(lineno, node)...]
+        assigns = {}    # name -> [lineno...]
+        nodes = mod.scope_nodes(scope)
+        for node in nodes:
+            key = _random_sampler_call(node)
+            if key is not None and isinstance(key, ast.Name):
+                consumes.setdefault(key.id, []).append((key.lineno, node))
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store,)):
+                assigns.setdefault(node.id, []).append(node.lineno)
+        for name, uses in consumes.items():
+            uses = sorted(uses, key=lambda u: u[0])
+            marks = sorted(assigns.get(name, ()))
+            ctxs = [_control_context(mod, n, scope) for _, n in uses]
+            # straight-line double consumption without a reassignment
+            for i in range(len(uses) - 1):
+                (a, _), (b, _) = uses[i], uses[i + 1]
+                if any(a < m <= b for m in marks):
+                    continue
+                if not _may_flow_between(ctxs[i], ctxs[i + 1]):
+                    continue
+                out.append(Violation(
+                    mod.path, b, 0, "BMT-E01",
+                    f"PRNG key {name!r} already consumed on line {a}; "
+                    f"derive a fresh key with jax.random.split/fold_in"))
+            # a single consumption inside a loop whose key never rebinds
+            # in the body consumes the same key every iteration
+            for (use, node), (path, exits) in zip(uses, ctxs):
+                if exits:
+                    continue  # returns out of the loop on first draw
+                loop = _enclosing_loop(mod, node, scope)
+                if loop is None:
+                    continue
+                body_lines = {n.lineno for n in ast.walk(loop)
+                              if hasattr(n, "lineno")}
+                if not any(m in body_lines for m in marks):
+                    out.append(Violation(
+                        mod.path, use, 0, "BMT-E01",
+                        f"PRNG key {name!r} consumed inside a loop without "
+                        f"rebinding — every iteration samples identically"))
+    return out
+
+
+def _ancestors(mod, node, scope):
+    cur = mod.parent.get(node)
+    while cur is not None and cur is not scope:
+        yield cur
+        cur = mod.parent.get(cur)
+
+
+def _enclosing_loop(mod, node, scope):
+    for anc in _ancestors(mod, node, scope):
+        if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+            return anc
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E02 — host synchronization inside traced scopes
+
+_NP_SAFE = frozenset({
+    # static/metadata numpy uses that never materialize a tracer
+    "float32", "float64", "float16", "int32", "int64", "uint8", "uint32",
+    "bool_", "dtype", "finfo", "iinfo", "pi", "e", "inf", "nan", "newaxis",
+    "prod", "ndim", "shape", "issubdtype", "promote_types", "result_type",
+})
+
+
+@rule("BMT-E02", "host-sync-in-trace",
+      "host synchronization (.item()/float()/np.*) inside a traced scope")
+def _check_host_sync(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not mod.in_traced(node):
+            continue
+        func = node.func
+        # x.item() — the canonical device sync
+        if (isinstance(func, ast.Attribute) and func.attr == "item"
+                and not node.args):
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E02",
+                ".item() inside a traced function synchronizes the host "
+                "(and fails on tracers) — keep the value on device"))
+            continue
+        # np.<fn>(...) on traced values runs at trace time on the host
+        if isinstance(func, ast.Attribute):
+            owner = _terminal(func.value)
+            if (owner in ("np", "numpy") and func.attr not in _NP_SAFE):
+                out.append(Violation(
+                    mod.path, node.lineno, node.col_offset, "BMT-E02",
+                    f"np.{func.attr}(...) inside a traced function "
+                    f"materializes on the host — use jnp"))
+                continue
+        # float()/int()/bool() on a traced-function parameter or a
+        # jax/jnp-producing call forces concretization
+        if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                      "bool") and node.args:
+            arg = node.args[0]
+            enclosing = mod.enclosing_function(node)
+            params = set()
+            cur = enclosing
+            while cur is not None:
+                if isinstance(cur, _FUNC_NODES):
+                    a = cur.args
+                    for p in (list(a.posonlyargs) + list(a.args)
+                              + list(a.kwonlyargs)):
+                        params.add(p.arg)
+                    if a.vararg:
+                        params.add(a.vararg.arg)
+                cur = mod.enclosing_function(cur)
+            suspect = (isinstance(arg, ast.Name) and arg.id in params
+                       and arg.id != "self")
+            if isinstance(arg, ast.Call):
+                owner = None
+                if isinstance(arg.func, ast.Attribute):
+                    owner = _dotted(arg.func.value)
+                suspect = suspect or (owner or "").split(".")[0] in (
+                    "jnp", "jax", "lax")
+            if suspect:
+                out.append(Violation(
+                    mod.path, node.lineno, node.col_offset, "BMT-E02",
+                    f"{func.id}() on a traced value concretizes at trace "
+                    f"time — pass it as data or use jnp casts"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E03 — jit cache-miss hazards
+
+@rule("BMT-E03", "jit-cache-miss",
+      "re-wrapping jit inside a loop (or jit of a fresh partial/lambda "
+      "per call) defeats the compile cache")
+def _check_cache_miss(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal(node.func)
+        if name not in ("jit", "pjit"):
+            continue
+        # jit(...) syntactically inside a for/while body: a fresh wrapper
+        # (and for lambdas a fresh cache key) every iteration
+        cur = mod.parent.get(node)
+        in_loop = False
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                in_loop = True
+                break
+            if isinstance(cur, _FUNC_NODES):
+                break  # the loop would be outside the enclosing function
+            cur = mod.parent.get(cur)
+        if in_loop:
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E03",
+                "jax.jit(...) inside a loop body builds a fresh wrapper "
+                "every iteration — hoist the jitted function out"))
+            continue
+        # jit(functools.partial(...)): partial objects hash by identity,
+        # so a re-executed construction site recompiles every time
+        wrapped = node.args[0] if node.args else None
+        if (isinstance(wrapped, ast.Call)
+                and _terminal(wrapped.func) == "partial"
+                and mod.enclosing_function(node) is not None):
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E03",
+                "jit(partial(...)) built inside a function keys the "
+                "compile cache on a fresh partial object per call — use "
+                "static_argnums or close over the constant"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E04 — use after donation
+
+def _donated_positions(call):
+    """The donate_argnums literal of a jit call, as a set of ints."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)}
+    return set()
+
+
+@rule("BMT-E04", "use-after-donate",
+      "a buffer passed at a donate_argnums position is read after the call")
+def _check_use_after_donate(mod):
+    out = []
+    for scope, _ in mod.function_scopes():
+        nodes = mod.scope_nodes(scope)
+        donators = {}  # local name -> donated positions
+        for node in nodes:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _terminal(node.value.func) in ("jit", "pjit")):
+                pos = _donated_positions(node.value)
+                if pos:
+                    donators[node.targets[0].id] = pos
+        if not donators:
+            continue
+        donated_uses = []  # (varname, call lineno)
+        for node in nodes:
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donators):
+                for p in donators[node.func.id]:
+                    if p < len(node.args) and isinstance(
+                            node.args[p], ast.Name):
+                        donated_uses.append(
+                            (node.args[p].id, node.lineno))
+        for name, call_line in donated_uses:
+            rebinds = [n.lineno for n in nodes
+                       if isinstance(n, ast.Name) and n.id == name
+                       and isinstance(n.ctx, ast.Store)]
+            for n in nodes:
+                if (isinstance(n, ast.Name) and n.id == name
+                        and isinstance(n.ctx, ast.Load)
+                        and n.lineno > call_line
+                        and not any(call_line < r <= n.lineno
+                                    for r in rebinds)):
+                    out.append(Violation(
+                        mod.path, n.lineno, n.col_offset, "BMT-E04",
+                        f"{name!r} was donated on line {call_line} "
+                        f"(donate_argnums) — its buffer is dead here"))
+                    break  # one report per donation site is enough
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E05 — broad or bare except
+
+def _except_names(handler):
+    t = handler.type
+    if t is None:
+        return {"<bare>"}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return {_terminal(e) for e in elts}
+
+
+@rule("BMT-E05", "broad-except",
+      "bare `except:` / `except Exception` — narrow it or annotate why")
+def _check_broad_except(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _except_names(node)
+        if "<bare>" in names or "BaseException" in names:
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E05",
+                "bare/BaseException except masks KeyboardInterrupt and "
+                "SystemExit — catch Exception at the very most"))
+        elif "Exception" in names:
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E05",
+                "except Exception eats every fault the resilience stack "
+                "should surface — narrow it, or annotate the reason"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E06 — wall clock inside traced scopes
+
+_WALL_CLOCK = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+    "time.perf_counter_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+})
+
+
+@rule("BMT-E06", "wall-clock-in-trace",
+      "time.time()/perf_counter() inside a traced function is a "
+      "trace-time constant, not a per-step clock")
+def _check_wall_clock(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call) and _dotted(node.func) in _WALL_CLOCK
+                and mod.in_traced(node)):
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E06",
+                f"{_dotted(node.func)}() in a traced function freezes to "
+                f"its trace-time value — time on the host, around the "
+                f"dispatch"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-E07 — redundant array conversions
+
+_PRODUCERS = frozenset({
+    "asarray", "array", "stack", "concatenate", "zeros", "ones", "full",
+    "arange", "linspace", "zeros_like", "ones_like", "full_like",
+})
+_STACKERS = frozenset({"stack", "concatenate", "vstack", "hstack"})
+
+
+_ARRAY_FAMILY = {"jnp": "jnp", "jax.numpy": "jnp", "np": "np",
+                 "numpy": "np"}
+
+
+def _array_call(node, names):
+    """The array-library family ("jnp"/"np") of a call `jnp.<fn>`/
+    `np.<fn>` with fn in names, else None. A conversion is only redundant
+    within one family: `jnp.asarray(np.stack(...))` is a host->device
+    move, not a double conversion."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return None
+    if node.func.attr not in names:
+        return None
+    return _ARRAY_FAMILY.get(_dotted(node.func.value))
+
+
+@rule("BMT-E07", "redundant-conversion",
+      "asarray of something already an array of the same library "
+      "(double conversion)")
+def _check_redundant_conversion(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # jnp.asarray(jnp.stack(...)) — the inner call already produced
+        # an array (a dtype= kwarg makes the outer call a cast: fine)
+        fam = _array_call(node, ("asarray", "array"))
+        if (fam is not None and not node.keywords and len(node.args) == 1
+                and _array_call(node.args[0], _PRODUCERS) == fam):
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E07",
+                "asarray of a call that already produced an array — a "
+                "redundant conversion"))
+        # jnp.stack([jnp.asarray(g) for g in ...]) — stack converts its
+        # inputs itself (the `ops/as_matrix` double conversion)
+        fam = _array_call(node, _STACKERS)
+        if fam is not None and node.args:
+            arg = node.args[0]
+            elts = ()
+            if isinstance(arg, (ast.List, ast.Tuple)):
+                elts = arg.elts
+            elif isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+                elts = (arg.elt,)
+            if elts and all(
+                    _array_call(e, ("asarray", "array")) == fam
+                    and not e.keywords for e in elts):
+                out.append(Violation(
+                    mod.path, node.lineno, node.col_offset, "BMT-E07",
+                    f"{node.func.attr} already converts its inputs — the "
+                    f"per-element asarray is a redundant conversion"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Driver
+
+def lint_source(source, path="<string>", rules=None):
+    """Lint one source string; returns the unsuppressed violations plus
+    any BMT-E00 suppression hygiene findings."""
+    try:
+        mod = Module(path, source)
+    except SyntaxError as err:
+        return [Violation(str(path), err.lineno or 0, 0, "BMT-E00",
+                          f"file does not parse: {err.msg}")]
+    out = []
+    selected = RULES if rules is None else {
+        k: v for k, v in RULES.items() if k in rules}
+    for r in selected.values():
+        for v in r.check(mod):
+            ids_reason = mod.noqa.get(v.line)
+            if ids_reason is not None and v.rule != "BMT-E00":
+                ids, reason = ids_reason
+                if (v.rule in ids or "all" in ids) and reason:
+                    continue  # suppressed, with a reason (E00 checks it)
+            out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def iter_python_files(paths):
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            yield from sorted(q for q in p.rglob("*.py")
+                              if "__pycache__" not in q.parts)
+        elif p.suffix == ".py":
+            yield p
+
+
+def lint_paths(paths, rules=None):
+    out = []
+    for f in iter_python_files(paths):
+        out.extend(lint_source(
+            f.read_text(encoding="utf-8"), path=str(f), rules=rules))
+    return out
+
+
+def format_human(violations):
+    lines = [f"{v.path}:{v.line}:{v.col}: {v.rule} {v.message}"
+             for v in violations]
+    lines.append(f"jaxlint: {len(violations)} violation"
+                 f"{'' if len(violations) == 1 else 's'}")
+    return "\n".join(lines)
+
+
+def format_json(violations, files_checked=None):
+    counts = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    payload = {"violations": [v.as_dict() for v in violations],
+               "counts": counts}
+    if files_checked is not None:
+        payload["files"] = files_checked
+    return json.dumps(payload, indent=2, sort_keys=True)

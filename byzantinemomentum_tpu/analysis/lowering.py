@@ -1,0 +1,136 @@
+"""Lowering contracts — golden StableHLO fingerprints per GAR cell.
+
+`tests/test_diag.py` (PR 4) asserts one lowering invariant at one point
+in time: `diagnostics=False` lowers byte-identically to the raw kernels.
+This module generalizes that into a *blessed contract*: every
+(GAR x variant) cell — the plain kernel, the diagnostics kernel, and the
+masked dynamic-quorum degradation path — is lowered on a fixed spec,
+fingerprinted (sha256 of the StableHLO text), and compared against
+`tests/goldens/lowerings.json`. Any drift fails the lint tier until a
+human re-blesses (`scripts/bless_lowerings.py`) — compilation behavior
+becomes a reviewed artifact, not a silent side effect of a refactor.
+
+Fingerprints are only comparable within one (jax version, backend) pair;
+a mismatch there reports `incomparable` (exit 0 with a message), the same
+INCOMPARABLE discipline as `scripts/bench_compare.py` — a toolchain bump
+is not lowering drift, it is a re-bless.
+"""
+
+import hashlib
+import json
+import pathlib
+
+__all__ = ["GOLDENS_PATH", "CELL_GARS", "VARIANTS", "compute_cells",
+           "snapshot", "bless", "check"]
+
+GOLDENS_PATH = (pathlib.Path(__file__).resolve().parents[2]
+                / "tests" / "goldens" / "lowerings.json")
+
+# Every first-tier registered rule with real kernels (the `native-` tier
+# shares these kernels; `template` declines its own check)
+CELL_GARS = ("average", "median", "trmean", "phocas", "meamed", "krum",
+             "bulyan", "aksel", "cge", "brute")
+VARIANTS = ("plain", "diag", "masked")
+
+# The canonical spec: the benchmark's n=11 worker grid, f=2, a d big
+# enough that every kernel takes its vectorized path
+N, D, F = 11, 16, 2
+
+
+def _cell_fn(gar, variant):
+    """The traceable program of one cell (call with aval specs only)."""
+    from byzantinemomentum_tpu.faults import quorum
+
+    if variant == "plain":
+        return lambda G: gar.unchecked(G, f=F)
+    if variant == "diag":
+        return lambda G: gar.diagnosed(G, f=F)
+    if variant == "masked":
+        return lambda G, active: quorum.masked_aggregate(
+            gar, G, active, f_decl=F, dynamic=True)
+    raise ValueError(f"Unknown lowering variant {variant!r}")
+
+
+def _cell_text(gar, variant):
+    import jax
+    import jax.numpy as jnp
+
+    spec = jax.ShapeDtypeStruct((N, D), jnp.float32)
+    mask = jax.ShapeDtypeStruct((N,), jnp.bool_)
+    args = (spec,) if variant != "masked" else (spec, mask)
+    return jax.jit(_cell_fn(gar, variant)).lower(*args).as_text()
+
+
+def fingerprint(text):
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def compute_cells(gars=None, variants=None):
+    """name -> fingerprint over the (GAR x variant) grid (defaults read
+    the module attributes at call time, so tests can shrink the grid)."""
+    from byzantinemomentum_tpu import ops
+
+    gars = CELL_GARS if gars is None else gars
+    variants = VARIANTS if variants is None else variants
+    cells = {}
+    for name in gars:
+        gar = ops.gars[name]
+        for variant in variants:
+            cells[f"{name}/{variant}"] = fingerprint(
+                _cell_text(gar, variant))
+    return cells
+
+
+def snapshot():
+    """The blessable artifact: the cell fingerprints plus the toolchain
+    coordinates they are only comparable under."""
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "spec": {"n": N, "d": D, "f": F},
+        "cells": compute_cells(),
+    }
+
+
+def bless(path=GOLDENS_PATH):
+    """(Re)write the goldens. Deterministic output (sorted keys, no
+    timestamps): blessing twice in one toolchain is byte-idempotent."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(snapshot(), indent=2, sort_keys=True) + "\n")
+    return path
+
+
+def check(path=GOLDENS_PATH):
+    """Compare the current lowerings against the blessed goldens.
+
+    Returns a report dict with `status` one of:
+      "ok"            — every cell fingerprint matches;
+      "drift"         — `drifted`/`added`/`removed` name the cells;
+      "incomparable"  — goldens were blessed under another jax version or
+                        backend (re-bless, do not fail CI on it);
+      "missing"       — no goldens file (run scripts/bless_lowerings.py).
+    """
+    import jax
+
+    path = pathlib.Path(path)
+    if not path.is_file():
+        return {"status": "missing", "path": str(path)}
+    blessed = json.loads(path.read_text())
+    here = {"jax": jax.__version__, "backend": jax.default_backend()}
+    if (blessed.get("jax"), blessed.get("backend")) != (
+            here["jax"], here["backend"]):
+        return {"status": "incomparable", "blessed": {
+            "jax": blessed.get("jax"), "backend": blessed.get("backend")},
+            "current": here}
+    current = compute_cells()
+    golden = blessed.get("cells", {})
+    drifted = sorted(k for k in golden if k in current
+                     and golden[k] != current[k])
+    added = sorted(k for k in current if k not in golden)
+    removed = sorted(k for k in golden if k not in current)
+    status = "ok" if not (drifted or added or removed) else "drift"
+    return {"status": status, "drifted": drifted, "added": added,
+            "removed": removed, "checked": len(current)}

@@ -744,7 +744,7 @@ def main(argv=None):
             resdir = pathlib.Path(args.result_directory).resolve()
             try:
                 resdir.mkdir(mode=0o755, parents=True, exist_ok=True)
-            except Exception as err:
+            except OSError as err:
                 utils.warning(f"Unable to create the result directory "
                               f"{str(resdir)!r} ({err}); no result stored")
                 args.result_directory = None
@@ -836,7 +836,7 @@ def main(argv=None):
                     args.load_checkpoint, state, return_data=True)
             except utils.UserException:
                 raise
-            except Exception as err:
+            except Exception as err:  # bmt: noqa[BMT-E05] load reconciles arbitrary payload trees; any fault becomes one fatal with the file named
                 utils.fatal(f"Unable to load checkpoint "
                             f"{args.load_checkpoint!r}: {err}")
             else:
@@ -845,7 +845,7 @@ def main(argv=None):
                         snaps = (data_state["train"], data_state["test"])
                         trainset.set_state(snaps[0])
                         testset.set_state(snaps[1])
-                    except Exception as err:
+                    except Exception as err:  # bmt: noqa[BMT-E05] sampler snapshots from old checkpoints vary by dataset; degrade to a warned partial restore
                         utils.warning(
                             f"Checkpoint sampler state only partially or not "
                             f"restored ({err}); resumed batch order may "
@@ -945,8 +945,8 @@ def main(argv=None):
         if telem is not None:
             try:
                 mfu_peak = obs_mod.peak_flops(jax.devices()[0].device_kind)
-            except Exception:
-                mfu_peak = None
+            except RuntimeError:
+                mfu_peak = None  # backend probe failed: MFU gauge stays off
             # First heartbeat before the first (slow: compile) dispatch, so
             # a supervisor watchdog sees a live signal immediately
             telem.heartbeat(step=steps_host, status="running")
@@ -1071,7 +1071,7 @@ def main(argv=None):
             try:
                 restored, data_state = checkpoint_mod.load(
                     found, state, return_data=True)
-            except Exception as err:
+            except Exception as err:  # bmt: noqa[BMT-E05] a rollback target that fails to load for ANY reason means give up cleanly, not crash mid-recovery
                 utils.error(f"Rollback reload of {found.name} failed "
                             f"({err}); giving up")
                 return False
@@ -1079,7 +1079,7 @@ def main(argv=None):
                 try:
                     trainset.set_state(data_state["train"])
                     testset.set_state(data_state["test"])
-                except Exception as err:
+                except Exception as err:  # bmt: noqa[BMT-E05] same degrade path as the resume sampler restore above — partial restore is warned, not fatal
                     utils.warning(f"Rollback sampler state only partially "
                                   f"restored ({err})")
             # Re-seed the step RNG fold: replaying the exact trajectory
@@ -1184,7 +1184,7 @@ def main(argv=None):
                         checkpoint_mod.save(filename, state,
                                             data_state=data_snapshot,
                                             keep=args.keep_checkpoints or None)
-                    except Exception as err:
+                    except Exception as err:  # bmt: noqa[BMT-E05] a failed save (disk full, serialization) must not kill training; the next milestone retries
                         utils.warning(f"Checkpoint save failed: {err}")
                 just_loaded = False
                 if telem is not None and (milestone_evaluation
@@ -1211,7 +1211,7 @@ def main(argv=None):
                         pdir = args.result_directory / f"profile-{steps}"
                         try:
                             jax.profiler.start_trace(str(pdir))
-                        except Exception as err:
+                        except Exception as err:  # bmt: noqa[BMT-E05] jax.profiler raises backend-specific errors; a failed live-debug window is a warning
                             utils.warning(f"SIGUSR1 profiler window failed "
                                           f"to start ({err})")
                         else:
@@ -1327,7 +1327,7 @@ def main(argv=None):
                     np.asarray(state.steps + 0)  # drain the traced chunk
                     try:
                         jax.profiler.stop_trace()
-                    except Exception as err:
+                    except Exception as err:  # bmt: noqa[BMT-E05] same contract as start_trace — the run outlives its profiler window
                         utils.warning(f"SIGUSR1 profiler window failed to "
                                       f"stop ({err})")
                     pdir, pstep = profile_active

@@ -55,6 +55,6 @@ def read_heartbeat(directory):
     path = pathlib.Path(directory) / HEARTBEAT_NAME
     try:
         record = json.loads(path.read_text(encoding="utf-8"))
-    except Exception:
-        return None
+    except (OSError, ValueError):
+        return None  # absent/torn/mid-replace file: the fallback signal rules
     return record if isinstance(record, dict) else None

@@ -102,8 +102,8 @@ def host_rss_mb():
         import resource
         rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         return float(rss_kb) / 1024.0
-    except Exception:
-        return None
+    except (ImportError, AttributeError, OSError, ValueError):
+        return None  # no resource module / platform without ru_maxrss
 
 
 # ------------------------------------------------------------------------- #
@@ -147,7 +147,7 @@ def flops_of_compiled(compiled):
         if cost:
             cost = cost[0] if isinstance(cost, (list, tuple)) else cost
             return float(cost.get("flops", 0.0)) or None
-    except Exception:
+    except Exception:  # bmt: noqa[BMT-E05] cost_analysis raises backend-specific types; a missing FLOP estimate must never crash a run
         pass
     return None
 
@@ -165,5 +165,5 @@ def logical_flops(fn, *args):
         if lower is None:
             lower = jax.jit(fn).lower
         return flops_of_compiled(lower(*args).compile())
-    except Exception:
+    except Exception:  # bmt: noqa[BMT-E05] lowering/compiling the throwaway copy fails in backend-specific ways; FLOP counting is an estimate, never worth crashing a run
         return None

@@ -56,7 +56,7 @@ def _jsonable(value):
     if callable(item):
         try:
             return item()
-        except Exception:
+        except Exception:  # bmt: noqa[BMT-E05] arbitrary user payloads ride the emit path; str() below is the serialization contract of last resort
             pass
     return str(value)
 
@@ -233,7 +233,7 @@ def install_compile_listener(telemetry):
     """
     try:
         from jax import monitoring
-    except Exception:
+    except ImportError:
         return False
     register = getattr(monitoring, "register_event_duration_secs_listener",
                        None)
@@ -246,12 +246,12 @@ def install_compile_listener(telemetry):
                 telemetry.counter("recompiles")
                 telemetry.event("compile", key=str(event),
                                 seconds=float(duration))
-        except Exception:
-            pass  # a dead recorder must never break compilation
+        except Exception:  # bmt: noqa[BMT-E05] this callback runs inside jax's compile path; a dead recorder must never break compilation
+            pass
 
     try:
         register(_on_duration)
-    except Exception:
+    except Exception:  # bmt: noqa[BMT-E05] version-dependent monitoring API; registration failure degrades to a zero counter, not a crash
         return False
     return True
 

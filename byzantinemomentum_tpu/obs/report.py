@@ -55,8 +55,8 @@ def render_report(run_dir):
     config = None
     try:
         config = json.loads((run_dir / "config.json").read_text())
-    except Exception:
-        pass
+    except (OSError, ValueError):
+        pass  # absent or torn config.json: report without the summary line
     if config:
         keys = ("model", "dataset", "gar", "attack", "nb_workers",
                 "nb_decl_byz", "nb_real_byz", "nb_steps")

@@ -38,7 +38,9 @@ def as_matrix(gradients):
     """Coerce a list of flat gradients or an (n, d) array into an (n, d) jnp
     matrix (the canonical GAR input)."""
     if isinstance(gradients, (list, tuple)):
-        return jnp.stack([jnp.asarray(g) for g in gradients])
+        # stack converts its inputs itself; a per-element asarray would be
+        # a redundant conversion (jaxlint BMT-E07 keeps it out)
+        return jnp.stack(gradients)
     gradients = jnp.asarray(gradients)
     if gradients.ndim != 2:
         raise utils.UserException(

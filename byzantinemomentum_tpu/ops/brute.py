@@ -44,7 +44,7 @@ CHUNK = 32768
 @functools.lru_cache(maxsize=None)
 def _binom_table(n, k):
     """(n+1, k+1) table of C(m, j) as int64 numpy (host-side)."""
-    tbl = np.zeros((n + 1, k + 1), dtype=np.int64)
+    tbl = np.zeros((n + 1, k + 1), dtype=np.int64)  # bmt: noqa[BMT-E02] static (n, k) table built host-side at trace time, lru_cached — never touches a tracer
     tbl[:, 0] = 1
     for m in range(1, n + 1):
         for j in range(1, min(m, k) + 1):
@@ -101,7 +101,7 @@ def best_subset_mask_from_dist(dist, f):
             f"brute cannot enumerate C({n}, {k}) = {total} subsets (exceeds "
             f"int32 rank space; the reference's Python loop is equally "
             f"infeasible at this scale)")
-    tbl = jnp.asarray(np.minimum(tbl_np, np.iinfo(np.int32).max)
+    tbl = jnp.asarray(np.minimum(tbl_np, np.iinfo(np.int32).max)  # bmt: noqa[BMT-E02] clamps the static host-side binomial table before upload — no tracer involved
                       .astype(np.int32))
     # Diagonal is +inf by convention (for per-row sorts); the diameter wants
     # it excluded instead

@@ -136,7 +136,7 @@ class Jobs:
         try:
             from byzantinemomentum_tpu import checkpoint
             return checkpoint.find_latest_valid(directory) is not None
-        except Exception:
+        except Exception:  # bmt: noqa[BMT-E05] the supervisor must not die on a mangled run dir (or a broken checkpoint import chain); no checkpoint == cold retry
             return False
 
     def _rotate_away(self, path):
@@ -301,7 +301,7 @@ class Jobs:
                 return
             try:
                 self._run_one(slot_device, run_name, seed, command)
-            except Exception as err:
+            except Exception as err:  # bmt: noqa[BMT-E05] one run's scheduler fault must not kill the worker thread draining the queue
                 _log.error(f"{run_name}: scheduler error: {err}")
             finally:
                 self._queue.task_done()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""(Re)bless the StableHLO lowering goldens.
+
+Writes `tests/goldens/lowerings.json`: one sha256 fingerprint of the
+lowered StableHLO text per (GAR x {plain, diag, masked-quorum}) cell,
+plus the (jax version, backend) coordinates the fingerprints are
+comparable under. The lint tier's drift gate
+(`python -m byzantinemomentum_tpu.analysis --check-lowerings`) fails on
+any unexplained change — run THIS script only when a lowering change is
+intentional and reviewed, and commit the diff with the change that
+caused it.
+
+Idempotent: blessing twice under one toolchain is byte-identical
+(sorted keys, no timestamps).
+
+Usage: python scripts/bless_lowerings.py [--out PATH] [--check]
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# Deterministic fingerprints need the CPU backend (this environment's
+# sitecustomize may force a TPU platform; the config update after import
+# is what actually sticks — see tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from byzantinemomentum_tpu.analysis import lowering  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", type=str, default=None,
+                        help="goldens path (default "
+                             "tests/goldens/lowerings.json)")
+    parser.add_argument("--check", action="store_true",
+                        help="only report drift against the existing "
+                             "goldens; do not rewrite")
+    args = parser.parse_args()
+    path = pathlib.Path(args.out) if args.out else lowering.GOLDENS_PATH
+
+    if args.check:
+        report = lowering.check(path)
+        print(report)
+        return 0 if report["status"] in ("ok", "incomparable") else 1
+
+    before = path.read_bytes() if path.is_file() else None
+    out = lowering.bless(path)
+    changed = before != out.read_bytes()
+    cells = len(lowering.CELL_GARS) * len(lowering.VARIANTS)
+    print(f"blessed {cells} cells -> {out}"
+          + (" (changed)" if changed else " (unchanged)"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,20 @@ def main():
     telemetry.event("bench_compare_smoke", returncode=bench_cmp.returncode)
     print(f"  {bench_compare}", flush=True)
 
+    # Lint tier (PR 5): jaxlint over the package + scripts, and the
+    # StableHLO lowering-drift gate against the blessed goldens — the
+    # static half of the correctness story, with its own green bit
+    print("lint tier ...", flush=True)
+    with telemetry.span("tier_lint"):
+        lint_proc = subprocess.run(
+            [sys.executable, "-m", "byzantinemomentum_tpu.analysis",
+             "byzantinemomentum_tpu", "scripts", "--check-lowerings"],
+            cwd=ROOT, capture_output=True, text=True)
+    lint_tier = {"returncode": lint_proc.returncode,
+                 "tail": lint_proc.stdout.splitlines()[-4:]}
+    telemetry.event("lint_tier", returncode=lint_proc.returncode)
+    print(f"  {lint_tier}", flush=True)
+
     print("default tier ...", flush=True)
     with telemetry.span("tier_default"):
         default = run_pytest(["tests/"])
@@ -134,6 +148,7 @@ def main():
                 "because one --runslow run exceeds a review window)",
         "obs_selfcheck": obs_selfcheck,
         "bench_compare": bench_compare,
+        "lint_tier": lint_tier,
         "default_tier": default,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
@@ -142,6 +157,7 @@ def main():
                       and default["returncode"] == 0
                       and obs_selfcheck["returncode"] == 0
                       and bench_compare["returncode"] == 0
+                      and lint_tier["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }

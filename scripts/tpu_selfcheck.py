@@ -69,10 +69,10 @@ def main():
         if gar.check(gradients=gj, f=args.f) is not None:
             print(f"{name:8s} SKIP (constraint at n={args.n}, f={args.f})")
             continue
-        fast = np.asarray(jax.jit(
+        fast = np.asarray(jax.jit(  # bmt: noqa[BMT-E03] fresh wrapper intended: BMT_NO_PALLAS is trace-time state, a cached trace would ignore the toggle below
             lambda G: gar.unchecked(G, f=args.f))(gj))
         os.environ["BMT_NO_PALLAS"] = "1"
-        slow = np.asarray(jax.jit(
+        slow = np.asarray(jax.jit(  # bmt: noqa[BMT-E03] fresh wrapper intended: must retrace with the pallas tier disabled
             lambda G: gar.unchecked(G, f=args.f))(gj))
         del os.environ["BMT_NO_PALLAS"]
 

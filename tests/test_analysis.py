@@ -1,0 +1,442 @@
+"""Static-analysis suite tests: one violating + one clean fixture per
+jaxlint rule, the noqa/reason contract, the repo-wide clean gate, the
+recompile-budget and transfer-guard contracts on the CPU smoke config,
+and the StableHLO golden workflow (bless idempotency + planted drift)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import losses, ops
+from byzantinemomentum_tpu.analysis import contracts, lint, lowering
+from byzantinemomentum_tpu.analysis.__main__ import main as analysis_main
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------------------- #
+# jaxlint: one violating + one clean fixture per rule
+
+# rule id -> (violating source, clean source)
+FIXTURES = {
+    "BMT-E01": (
+        """
+import jax
+def f(key):
+    a = jax.random.uniform(key)
+    b = jax.random.normal(key)
+    return a + b
+""",
+        """
+import jax
+def f(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1)
+    b = jax.random.normal(k2)
+    return a + b
+""",
+    ),
+    "BMT-E02": (
+        """
+import jax
+@jax.jit
+def f(x):
+    return float(x) + x.sum().item()
+""",
+        """
+import jax, jax.numpy as jnp
+@jax.jit
+def f(x):
+    return x.astype(jnp.float32) + jnp.sum(x)
+""",
+    ),
+    "BMT-E03": (
+        """
+import jax
+def f(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v + 1)(x))
+    return out
+""",
+        """
+import jax
+_step = jax.jit(lambda v: v + 1)
+def f(xs):
+    return [_step(x) for x in xs]
+""",
+    ),
+    "BMT-E04": (
+        """
+import jax
+def run(update, state, x):
+    step = jax.jit(update, donate_argnums=(0,))
+    new = step(state, x)
+    return state + new
+""",
+        """
+import jax
+def run(update, state, x):
+    step = jax.jit(update, donate_argnums=(0,))
+    new = step(state, x)
+    return new
+""",
+    ),
+    "BMT-E05": (
+        """
+def f(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None
+""",
+        """
+def f(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None
+""",
+    ),
+    "BMT-E06": (
+        """
+import jax, time
+@jax.jit
+def f(x):
+    return x + time.time()
+""",
+        """
+import jax, time
+def f(step, x):
+    t0 = time.time()
+    y = step(x)
+    return y, time.time() - t0
+""",
+    ),
+    "BMT-E07": (
+        """
+import jax.numpy as jnp
+def f(gs):
+    return jnp.stack([jnp.asarray(g) for g in gs])
+""",
+        """
+import jax.numpy as jnp
+def f(gs):
+    return jnp.stack(gs)
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_fixture_pair(rule_id):
+    """Every rule fires on its violating fixture and stays silent on the
+    clean one (and on the clean one no OTHER rule fires either)."""
+    bad, good = FIXTURES[rule_id]
+    hits = {v.rule for v in lint.lint_source(bad)}
+    assert rule_id in hits, f"{rule_id} missed its violating fixture"
+    clean = lint.lint_source(good)
+    assert clean == [], f"clean fixture not clean: {clean}"
+
+
+def test_rule_registry_complete():
+    """Every registered rule id is BMT-Exx and has a fixture pair (E00,
+    the suppression-hygiene rule, is proven by the noqa tests below)."""
+    assert set(lint.RULES) == set(FIXTURES) | {"BMT-E00"}
+    for rule_id, rule in lint.RULES.items():
+        assert rule_id.startswith("BMT-E") and rule.summary
+
+
+def test_key_reuse_in_loop_and_branches():
+    """The loop form of key reuse fires; mutually exclusive branches and
+    early returns do not (the `models/core.py` dropout idiom)."""
+    loop = """
+import jax
+def g(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key))
+    return out
+"""
+    assert any(v.rule == "BMT-E01" for v in lint.lint_source(loop))
+    branches = """
+import jax
+def f(rng, keep, shape):
+    if keep == 0.5:
+        return jax.random.bits(rng, shape)
+    return jax.random.bernoulli(rng, keep, shape)
+"""
+    assert lint.lint_source(branches) == []
+    rebind = """
+import jax
+def g(key, n):
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub))
+    return out
+"""
+    assert lint.lint_source(rebind) == []
+
+
+def test_e07_cross_family_is_not_redundant():
+    """`jnp.asarray(np.stack(...))` is a host->device move, not a double
+    conversion; dtype= makes the outer call a cast."""
+    src = """
+import numpy as np
+import jax.numpy as jnp
+def f(xs):
+    a = jnp.asarray(np.stack(xs))
+    b = jnp.asarray(jnp.arange(4), dtype=jnp.bfloat16)
+    return a, b
+"""
+    assert lint.lint_source(src) == []
+    nested = "import jax.numpy as jnp\nx = jnp.asarray(jnp.stack([1, 2]))\n"
+    assert any(v.rule == "BMT-E07" for v in lint.lint_source(nested))
+
+
+# --------------------------------------------------------------------------- #
+# noqa: suppression requires a reason
+
+def test_noqa_with_reason_suppresses():
+    src = """
+def f(path):
+    try:
+        return open(path).read()
+    except Exception:  # bmt: noqa[BMT-E05] probe helper must survive anything
+        return None
+"""
+    assert lint.lint_source(src) == []
+
+
+def test_noqa_without_reason_is_a_violation():
+    src = """
+def f(path):
+    try:
+        return open(path).read()
+    except Exception:  # bmt: noqa[BMT-E05]
+        return None
+"""
+    rules = {v.rule for v in lint.lint_source(src)}
+    # The unexplained suppression is flagged AND does not suppress
+    assert rules == {"BMT-E00", "BMT-E05"}
+
+
+def test_noqa_unknown_rule_id_flagged():
+    src = "x = 1  # bmt: noqa[BMT-E99] no such rule\n"
+    violations = lint.lint_source(src)
+    assert [v.rule for v in violations] == ["BMT-E00"]
+    assert "unknown rule" in violations[0].message
+
+
+def test_noqa_in_docstring_is_prose():
+    src = '''
+def f():
+    """Suppress with `# bmt: noqa[BMT-E05]` and a reason."""
+    return 1
+'''
+    assert lint.lint_source(src) == []
+
+
+def test_json_and_human_output():
+    bad, _ = FIXTURES["BMT-E05"]
+    violations = lint.lint_source(bad, path="x.py")
+    human = lint.format_human(violations)
+    assert "x.py:5" in human and "BMT-E05" in human
+    payload = json.loads(lint.format_json(violations, files_checked=1))
+    assert payload["counts"] == {"BMT-E05": 1}
+    assert payload["files"] == 1
+    assert payload["violations"][0]["line"] == 5
+
+
+# --------------------------------------------------------------------------- #
+# The repo itself is the acceptance fixture
+
+def test_repo_is_lint_clean():
+    """`python -m byzantinemomentum_tpu.analysis byzantinemomentum_tpu/
+    scripts/` exits 0: every pre-existing violation is fixed or carries a
+    reasoned annotation."""
+    violations = lint.lint_paths(
+        [ROOT / "byzantinemomentum_tpu", ROOT / "scripts"])
+    assert violations == [], lint.format_human(violations)
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert analysis_main([str(clean)]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(FIXTURES["BMT-E05"][0])
+    assert analysis_main([str(dirty)]) == 1
+    assert analysis_main(["--rules"]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# Runtime contracts on the CPU smoke config
+
+def _probe_engine(**cfg_kwargs):
+    """The tiny 6-d probe engine (same scheme as `test_engine.py` /
+    `test_diag.py`) — the CPU smoke config for the contract tests."""
+    from byzantinemomentum_tpu.models import ModelDef
+
+    D = 6
+
+    def init(key):
+        return {"w": jnp.zeros((D,), jnp.float32)}, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        return x, state
+
+    loss = losses.Loss(lambda output, target, params:
+                       jnp.dot(params, jnp.mean(output, axis=0)))
+    cfg = EngineConfig(nb_workers=8, nb_decl_byz=1, nb_real_byz=0,
+                       nb_for_study=8, nb_for_study_past=2, **cfg_kwargs)
+    engine = build_engine(
+        cfg=cfg, model_def=ModelDef("probe", init, apply, (D,)),
+        loss=loss, criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars["krum"], 1.0, {})])
+    return cfg, engine
+
+
+def _warm_engine():
+    cfg, engine = _probe_engine()
+    S = cfg.nb_sampled
+    state = engine.init(jax.random.PRNGKey(0),
+                        params={"w": jnp.zeros((6,))}, net_state={})
+    xs = jax.device_put(jnp.zeros((S, 4, 6), jnp.float32))
+    ys = jax.device_put(jnp.zeros((S, 4), jnp.float32))
+    lr = jax.device_put(jnp.float32(0.1))
+    state, metrics = engine.train_step(state, xs, ys, lr)  # compile
+    jax.block_until_ready(metrics)
+    return engine, state, xs, ys, lr
+
+
+def test_recompile_budget_warm_loop_is_zero():
+    """The engine's warm training loop compiles nothing: the declared
+    budget of the CPU smoke config is zero, and any retrace (shape drift,
+    scalar cache churn) trips it."""
+    engine, state, xs, ys, lr = _warm_engine()
+    holder = {"state": state}
+
+    def step():
+        holder["state"], metrics = engine.train_step(
+            holder["state"], xs, ys, lr)
+        return metrics
+
+    assert contracts.assert_recompile_budget(step, steps=3, budget=0) == 0
+
+
+def test_recompile_budget_trips_on_retrace():
+    """Shape drift inside the window raises RecompileBudgetError, and the
+    error names the compile events."""
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.zeros((2,)))  # warm one shape
+    shapes = iter([(2,), (3,), (4,)])
+
+    def step():
+        return f(jnp.zeros(next(shapes)))
+
+    with pytest.raises(contracts.RecompileBudgetError) as err:
+        contracts.assert_recompile_budget(step, steps=3, budget=0)
+    assert "backend compile" in str(err.value)
+
+
+def test_count_compiles_window_and_unregister():
+    with contracts.count_compiles() as log:
+        jax.jit(lambda x: x + 3)(jnp.zeros((5,)))
+    inside = log.count
+    assert inside > 0
+    jax.jit(lambda x: x + 4)(jnp.zeros((6,)))  # after the window
+    assert log.count == inside
+
+
+def test_transfer_guard_engine_step():
+    """One warm engine step with device-resident operands performs zero
+    implicit device<->host transfers."""
+    engine, state, xs, ys, lr = _warm_engine()
+    with contracts.no_implicit_transfers():
+        state, metrics = engine.train_step(state, xs, ys, lr)
+    assert jax.block_until_ready(metrics) is not None
+
+
+def test_transfer_guard_catches_scalar_argument():
+    """A Python scalar argument is an implicit host->device transfer —
+    exactly the hot-loop leak the guard exists to catch."""
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.zeros(()))
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with contracts.no_implicit_transfers():
+            f(3.0)
+
+
+# --------------------------------------------------------------------------- #
+# Lowering goldens: bless workflow + drift gate
+
+SMALL_GRID = ("krum", "average")
+
+
+def test_bless_idempotent_and_check_ok(tmp_path, monkeypatch):
+    monkeypatch.setattr(lowering, "CELL_GARS", SMALL_GRID)
+    path = tmp_path / "lowerings.json"
+    lowering.bless(path)
+    first = path.read_bytes()
+    lowering.bless(path)
+    assert path.read_bytes() == first  # byte-idempotent
+    report = lowering.check(path)
+    assert report["status"] == "ok" and report["checked"] == 6
+
+
+def test_planted_gar_edit_trips_drift_gate(tmp_path, monkeypatch):
+    """An (algebraically neutral) edit to a GAR kernel changes its
+    StableHLO and the gate names exactly the drifted cells."""
+    monkeypatch.setattr(lowering, "CELL_GARS", SMALL_GRID)
+    path = tmp_path / "lowerings.json"
+    lowering.bless(path)
+    gar = ops.gars["krum"]
+    orig = gar.unchecked
+    monkeypatch.setattr(gar, "unchecked",
+                        lambda G, **kw: orig(G, **kw) + 0.0)
+    report = lowering.check(path)
+    assert report["status"] == "drift"
+    assert "krum/plain" in report["drifted"]
+    assert not any(c.startswith("average/") for c in report["drifted"])
+
+
+def test_check_incomparable_and_missing(tmp_path):
+    missing = lowering.check(tmp_path / "nope.json")
+    assert missing["status"] == "missing"
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps(
+        {"jax": "0.0.0", "backend": "tpu", "cells": {}}))
+    assert lowering.check(stale)["status"] == "incomparable"
+
+
+def test_repo_goldens_match_current_lowerings():
+    """The committed goldens are current — the lint tier's drift gate is
+    green at HEAD."""
+    report = lowering.check()
+    assert report["status"] == "ok", report
+
+
+@pytest.mark.slow
+def test_bless_script_idempotent_subprocess(tmp_path):
+    """The bless script round-trips through its CLI: second run reports
+    (unchanged), and the module gate accepts the output."""
+    out = tmp_path / "goldens.json"
+    for expect in ("(changed)", "(unchanged)"):
+        proc = subprocess.run(
+            [sys.executable, "scripts/bless_lowerings.py", "--out", str(out)],
+            cwd=ROOT, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert expect in proc.stdout
+    check = subprocess.run(
+        [sys.executable, "scripts/bless_lowerings.py", "--out", str(out),
+         "--check"], cwd=ROOT, capture_output=True, text=True)
+    assert check.returncode == 0, check.stdout + check.stderr
