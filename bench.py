@@ -297,7 +297,7 @@ def main():
         if ref:
             vs_baseline = headline / ref
 
-    print(json.dumps({
+    payload = {
         "metric": "sim_steps_per_sec_cifar10_n25_f5_bulyan",
         "value": headline,
         "unit": "steps/s",
@@ -311,7 +311,13 @@ def main():
         "device_kind": device_kind,
         "synthetic_data": synthetic,
         "cells": cells,
-    }))
+    }
+    # Machine-readable sibling of the harness's stdout-tail BENCH_r*.json
+    # wrapper: the per-cell trajectory tooling (scripts/bench_history.py)
+    # reads this directly instead of re-parsing captured stdout
+    cells_path = pathlib.Path(__file__).resolve().parent / "BENCH_cells.json"
+    cells_path.write_text(json.dumps(payload, indent=2) + "\n")
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

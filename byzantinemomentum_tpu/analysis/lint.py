@@ -696,6 +696,54 @@ def _check_redundant_conversion(mod):
 
 
 # --------------------------------------------------------------------------- #
+# BMT-E08 — dynamic trace-annotation names
+
+_SCOPE_CALLEES = frozenset({"named_scope", "TraceAnnotation",
+                            "StepTraceAnnotation"})
+
+
+def _is_dynamic_string(node):
+    """Whether an expression builds its string per call: an f-string with
+    interpolations, a `.format(...)` call, a `%` format, or a `+`
+    concatenation involving any of those. A constant (or an f-string with
+    no placeholders) is static."""
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        return isinstance(node.left, (ast.Constant, ast.JoinedStr))
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return (_is_dynamic_string(node.left)
+                or _is_dynamic_string(node.right))
+    return False
+
+
+@rule("BMT-E08", "dynamic-scope-name",
+      "a formatted (f-string/.format) jax.named_scope/TraceAnnotation "
+      "name inside a traced scope — per-step name churn pollutes trace "
+      "metadata and hashes a fresh cache key per call")
+def _check_dynamic_scope_name(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if _terminal(node.func) not in _SCOPE_CALLEES:
+            continue
+        if not mod.in_traced(node):
+            continue
+        if _is_dynamic_string(node.args[0]):
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E08",
+                f"{_terminal(node.func)}(...) name is built per call — "
+                f"every trace gets fresh metadata (and the phase "
+                f"attribution in obs/attrib cannot bucket it); use a "
+                f"static name"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # Driver
 
 def lint_source(source, path="<string>", rules=None):

@@ -171,6 +171,18 @@ def process_commandline(argv=None):
     add("--trace-dir", type=str, default=None,
         help="Capture a jax.profiler trace of the first steps into this "
              "directory (opt-in, like the reference's TimedContext tools)")
+    add("--attribution", action="store_true", default=False,
+        help="Phase-attributed device profiling: trace exactly one fused "
+             "chunk — deterministically, the first chunk whose program "
+             "shape has already compiled and run once — and attribute its "
+             "device time to the "
+             "engine's named phases (honest/attack/gar/update/metrics), "
+             "op classes (MXU vs memory-bound vs relayout copies) and the "
+             "host gap — written as 'attribution.json' in the result "
+             "directory with an 'attribution' telemetry event "
+             "(obs/attrib; needs '--result-directory'). The flag only "
+             "adds a one-chunk profiler window plus one throwaway "
+             "compile; the compiled step program itself is unchanged")
     add("--telemetry", action="store_true", default=False,
         help="Record run telemetry — 'telemetry.jsonl' (spans/events/"
              "counters/gauges) and an atomic 'heartbeat.json' in the result "
@@ -351,6 +363,11 @@ def _postprocess(args):
     if args.telemetry and args.result_directory is None:
         utils.warning("'--telemetry' needs '--result-directory' (there is "
                       "nowhere to write the timeline); telemetry disabled")
+    if args.attribution and args.result_directory is None:
+        utils.warning("'--attribution' needs '--result-directory' (there "
+                      "is nowhere to write the trace window and "
+                      "attribution.json); attribution disabled")
+        args.attribution = False
     if args.gar_diagnostics and (args.result_directory is None
                                  or args.nb_for_study < 1):
         utils.warning("'--gar-diagnostics' needs the study pipeline "
@@ -952,6 +969,73 @@ def main(argv=None):
             telem.heartbeat(step=steps_host, status="running")
         # (directory, from_step) of a live SIGUSR1 profiler window
         profile_active = None
+        # --attribution: deterministic phase attribution of one traced
+        # chunk. The window only opens on a chunk whose program shape (the
+        # fused step count M) has ALREADY been dispatched once: the first
+        # chunk of each shape carries its compile, and a compile inside
+        # the window would both smear the forced device_step_ms sample and
+        # balloon the xplane with host compile events (a ~60 s compile
+        # traces to hundreds of MB — unparseable under the pure-python
+        # protobuf backend). Milestone-residual windows (an M smaller than
+        # --steps-per-program) therefore postpone the trace to the first
+        # re-visit of a warm shape — still the same step window every run.
+        attrib_armed = args.attribution
+        attrib_seen_m = set()  # chunk shapes (M) already compiled+run
+        attrib_window = None   # (trace dir, steps, hlo text, flops)
+
+        def lower_hlo_text(dispatch_fn, dispatch_args):
+            """Optimized-HLO text (+ cost-analysis FLOPs/step) of the
+            program about to run, from the SAME jit object (`.lower` on
+            the `_mode_jit` wrappers) so instruction names match the
+            traced execution — the scope join CPU traces need. None-s when
+            the dispatch path has no .lower (device-gar, sharded) or the
+            throwaway compile fails: attribution then degrades to
+            op-class-only buckets instead of crashing the run."""
+            lower = getattr(dispatch_fn, "lower", None)
+            if lower is None:
+                return None, None
+            try:
+                compiled = lower(*dispatch_args).compile()
+                return compiled.as_text(), obs_mod.flops_of_compiled(compiled)
+            except Exception as err:  # bmt: noqa[BMT-E05] the throwaway AOT compile fails in backend-specific ways; attribution must degrade, never kill training
+                utils.warning(f"Attribution HLO lowering failed ({err}); "
+                              f"phase join degraded")
+                return None, None
+
+        def attribute_window(trace_dir, steps, hlo_text, flops, out_dir):
+            """Attribute one CLOSED trace window and write
+            `attribution.json` into `out_dir` (plus an 'attribution'
+            telemetry event). Degrades to a warning when the trace is
+            unreadable (absent xplane proto bindings, torn capture)."""
+            from byzantinemomentum_tpu.obs import attrib
+            try:
+                kind = jax.devices()[0].device_kind
+            except RuntimeError:
+                kind = None
+            try:
+                att = attrib.attribute_trace(
+                    str(trace_dir), steps, hlo_text=hlo_text,
+                    flops_per_step=flops or (mfu_flops or None),
+                    peak_flops=mfu_peak,
+                    backend=jax.default_backend(), device_kind=kind)
+            except (FileNotFoundError, ImportError, ValueError) as err:
+                utils.warning(f"Attribution of {str(trace_dir)!r} failed "
+                              f"({err})")
+                return None
+            path = attrib.write_attribution(out_dir, att)
+            if telem is not None:
+                telem.event(
+                    "attribution", path=str(path), steps=steps,
+                    total_ms=att["total_ms"],
+                    relayout_ms=att["op_classes"]["relayout"],
+                    host_gap_fraction=att["host_gap_fraction"],
+                    mfu=att["mfu"],
+                    phases={k: round(v["ms"], 5)
+                            for k, v in att["phases"].items()
+                            if v["ms"] > 0.0})
+            utils.info(f"Attribution: {att['total_ms']:.3f} ms/step over "
+                       f"{steps} traced steps -> {str(path)!r}")
+            return att
 
         # Study metrics of the previously dispatched chunk, transferred
         # AFTER the next chunk is enqueued (depth-2 pipeline, same scheme
@@ -1293,10 +1377,33 @@ def main(argv=None):
                         dispatch_fn, *dispatch_args) or False
                     if mfu_flops:
                         telem.event("flops_per_step", flops=mfu_flops)
+                # --attribution window: trace exactly this chunk, and only
+                # when its program shape is already warm (see the state
+                # block above) — the window is deterministic: same step
+                # range every run
+                if (attrib_armed and attrib_window is None
+                        and M in attrib_seen_m and profile_active is None):
+                    adir = args.result_directory / "attribution-trace"
+                    hlo_text, attrib_flops = lower_hlo_text(
+                        dispatch_fn, dispatch_args)
+                    try:
+                        jax.profiler.start_trace(str(adir))
+                    except Exception as err:  # bmt: noqa[BMT-E05] jax.profiler raises backend-specific errors; a failed attribution window is a warning
+                        utils.warning(f"--attribution profiler window "
+                                      f"failed to start ({err})")
+                        attrib_armed = False
+                    else:
+                        attrib_window = (adir, M, hlo_text, attrib_flops)
+                        utils.info(f"--attribution: tracing one {M}-step "
+                                   f"chunk into {str(adir)!r}")
                 # Telemetry sample: drain the pipeline (device->host barrier
                 # on the pre-dispatch step counter), time this chunk's
-                # dispatch-to-completion, then record gauges below
-                measure = telem is not None and steps_host >= next_sample_step
+                # dispatch-to-completion, then record gauges below. An
+                # attribution window forces a sample so the device_step_ms
+                # gauge covers the exact chunk the trace attributes.
+                measure = telem is not None and (
+                    steps_host >= next_sample_step
+                    or attrib_window is not None)
                 if measure:
                     step_timer.start(state.steps)
                 state, metrics = dispatch_fn(*dispatch_args)
@@ -1322,6 +1429,24 @@ def main(argv=None):
                                     device_step_ms=device_ms, rss_mb=rss,
                                     mfu=mfu_now)
                     next_sample_step = steps_host + telem.interval
+                attrib_seen_m.add(M)
+                if attrib_window is not None:
+                    # Close the --attribution window on the chunk it
+                    # covered and attribute it right away
+                    adir, a_steps, hlo_text, attrib_flops = attrib_window
+                    attrib_window = None
+                    attrib_armed = False
+                    if not measure:
+                        np.asarray(state.steps + 0)  # drain the chunk
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception as err:  # bmt: noqa[BMT-E05] same contract as the SIGUSR1 window — the run outlives its profiler window
+                        utils.warning(f"--attribution profiler window "
+                                      f"failed to stop ({err})")
+                    else:
+                        attribute_window(adir, a_steps, hlo_text,
+                                         attrib_flops,
+                                         args.result_directory)
                 if profile_active is not None:
                     # Close the SIGUSR1 window on the chunk it covered
                     np.asarray(state.steps + 0)  # drain the traced chunk
@@ -1337,6 +1462,15 @@ def main(argv=None):
                                     from_step=pstep, to_step=steps_host)
                     utils.info(f"SIGUSR1: profiler window saved to "
                                f"{str(pdir)!r}")
+                    # The live window auto-attributes too — the one-off
+                    # `trace_opstats` archaeology becomes an artifact
+                    # inside the window directory (throwaway re-lower of
+                    # the chunk's program for the CPU scope join; on a
+                    # stalled backend this degrades to op classes only)
+                    hlo_text, pflops = lower_hlo_text(
+                        dispatch_fn, dispatch_args)
+                    attribute_window(pdir, steps_host - pstep, hlo_text,
+                                     pflops, pdir)
                 if chaos_nan is not None and steps_host > chaos_nan:
                     # Poison the freshly dispatched state (chaos hook): the
                     # health flag below must flip and trigger the rollback

@@ -21,6 +21,14 @@ reference does per iteration of its hot loop (reference `attack.py:752-882`):
 Multi-local-step SGD (`--nb-local-steps > 1`) is implemented (via
 `lax.scan` over local steps), unlike the reference where it is advertised
 but hard-disabled (`attack.py:796-798`).
+
+Phase attribution (PR 6): every phase is wrapped in a STATIC
+`jax.named_scope` (`honest`, `attack`, `gar`/`gar_masked`/`gar_diag`,
+`update`, `metrics`), so each compiled HLO op carries its phase in its
+metadata `op_name` and `obs/attrib/` can attribute a profiler trace per
+phase without hand archaeology. The names are trace-time metadata only —
+they change no computation, no cache key, and no donation; dynamic
+(formatted) scope names are a lint error (jaxlint BMT-E08).
 """
 
 import contextlib
@@ -458,15 +466,16 @@ class Engine:
 
     def _run_defense(self, G, mix_u):
         cfg = self.cfg
-        if len(self.defenses) == 1:
-            gar, _, kwargs = self.defenses[0]
-            return gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs)
-        branches = [
-            (lambda G, gar=gar, kwargs=kwargs:
-             gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs))
-            for gar, _, kwargs in self.defenses
-        ]
-        return lax.switch(self._mixture_index(mix_u), branches, G)
+        with jax.named_scope("gar"):
+            if len(self.defenses) == 1:
+                gar, _, kwargs = self.defenses[0]
+                return gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs)
+            branches = [
+                (lambda G, gar=gar, kwargs=kwargs:
+                 gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs))
+                for gar, _, kwargs in self.defenses
+            ]
+            return lax.switch(self._mixture_index(mix_u), branches, G)
 
     def _run_defense_diag(self, G, mix_u):
         """`_run_defense` through the diagnostics kernels: returns
@@ -476,15 +485,16 @@ class Engine:
         `cfg.gar_diagnostics` — the False path compiles the exact
         pre-diagnostics program."""
         cfg = self.cfg
-        if len(self.defenses) == 1:
-            gar, _, kwargs = self.defenses[0]
-            return gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs)
-        branches = [
-            (lambda G, gar=gar, kwargs=kwargs:
-             gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs))
-            for gar, _, kwargs in self.defenses
-        ]
-        return lax.switch(self._mixture_index(mix_u), branches, G)
+        with jax.named_scope("gar_diag"):
+            if len(self.defenses) == 1:
+                gar, _, kwargs = self.defenses[0]
+                return gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs)
+            branches = [
+                (lambda G, gar=gar, kwargs=kwargs:
+                 gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs))
+                for gar, _, kwargs in self.defenses
+            ]
+            return lax.switch(self._mixture_index(mix_u), branches, G)
 
     def _mixture_index(self, mix_u):
         cum = jnp.asarray([fc for _, fc, _ in self.defenses], jnp.float32)
@@ -501,13 +511,16 @@ class Engine:
             return jnp.float32(gar.influence(
                 G_honest, G_attack, f=cfg.nb_decl_byz, **kwargs))
 
-        if len(self.defenses) == 1:
-            gar, _, kwargs = self.defenses[0]
-            return one(gar, kwargs)
-        idx = self._mixture_index(mix_u)
-        return lax.switch(
-            idx,
-            [lambda g=gar, k=kwargs: one(g, k) for gar, _, kwargs in self.defenses])
+        # The acceptation-ratio readout is a study metric, not server work
+        with jax.named_scope("metrics"):
+            if len(self.defenses) == 1:
+                gar, _, kwargs = self.defenses[0]
+                return one(gar, kwargs)
+            idx = self._mixture_index(mix_u)
+            return lax.switch(
+                idx,
+                [lambda g=gar, k=kwargs: one(g, k)
+                 for gar, _, kwargs in self.defenses])
 
     # ----------------------------------------------------------------- #
     # The step
@@ -518,6 +531,10 @@ class Engine:
         Split out so `--device-gar` can run the defense phase on another
         device (`make_device_gar_step`); the fused `_train_step` inlines all
         three phases into one program."""
+        with jax.named_scope("honest"):
+            return self._phase_honest_impl(state, xs, ys, lr)
+
+    def _phase_honest_impl(self, state: TrainState, xs, ys, lr):
         cfg = self.cfg
         S, h = cfg.nb_sampled, cfg.nb_honests
         mu, damp = cfg.momentum, cfg.dampening
@@ -636,12 +653,18 @@ class Engine:
             return self._run_defense(gradients, u)
 
         if cfg.nb_real_byz > 0:
-            G_attack = self.attack.unchecked(
-                G_honest, f_decl=cfg.nb_decl_byz, f_real=cfg.nb_real_byz,
-                defense=defense_fn, **self.attack_kwargs)
-            # Attack internals (line-search factors) may promote to f32;
-            # pin the Byzantine rows back to the gradient dtype
-            G_attack = G_attack.astype(G_honest.dtype)
+            # The "attack" scope encloses the adaptive line search's inner
+            # defense calls too: they nest `attack/.../gar/...` and the
+            # attribution's outermost-first precedence charges them to the
+            # attack, matching PERF_NOTES' "attack incl. its defense call"
+            with jax.named_scope("attack"):
+                G_attack = self.attack.unchecked(
+                    G_honest, f_decl=cfg.nb_decl_byz,
+                    f_real=cfg.nb_real_byz,
+                    defense=defense_fn, **self.attack_kwargs)
+                # Attack internals (line-search factors) may promote to
+                # f32; pin the Byzantine rows back to the gradient dtype
+                G_attack = G_attack.astype(G_honest.dtype)
         else:
             G_attack = jnp.zeros((0, self.d), G_honest.dtype)
 
@@ -711,14 +734,15 @@ class Engine:
                 gar, G, active, f_decl=cfg.nb_decl_byz,
                 dynamic=cfg.fault_dynamic_quorum, **kwargs)
 
-        if len(self.defenses) == 1:
-            gar, _, kwargs = self.defenses[0]
-            return one(gar, kwargs, G)
-        branches = [
-            (lambda G, gar=gar, kwargs=kwargs: one(gar, kwargs, G))
-            for gar, _, kwargs in self.defenses
-        ]
-        return lax.switch(self._mixture_index(mix_u), branches, G)
+        with jax.named_scope("gar_masked"):
+            if len(self.defenses) == 1:
+                gar, _, kwargs = self.defenses[0]
+                return one(gar, kwargs, G)
+            branches = [
+                (lambda G, gar=gar, kwargs=kwargs: one(gar, kwargs, G))
+                for gar, _, kwargs in self.defenses
+            ]
+            return lax.switch(self._mixture_index(mix_u), branches, G)
 
     def _train_step(self, state: TrainState, xs, ys, lr):
         """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
@@ -742,33 +766,37 @@ class Engine:
         lr = jnp.asarray(lr).astype(state.theta.dtype)
 
         # --- model update (`attack.py:832-839`) --- #
-        if cfg.momentum_at == "worker":
-            new_ms = state.momentum_server
-            update_grad = grad_defense
-        elif cfg.momentum_at == "server":
-            new_ms = grad_defense
-            update_grad = grad_defense
-        else:
-            new_ms = mu * state.momentum_server + (1.0 - damp) * grad_defense
-            update_grad = new_ms
+        with jax.named_scope("update"):
+            if cfg.momentum_at == "worker":
+                new_ms = state.momentum_server
+                update_grad = grad_defense
+            elif cfg.momentum_at == "server":
+                new_ms = grad_defense
+                update_grad = grad_defense
+            else:
+                new_ms = (mu * state.momentum_server
+                          + (1.0 - damp) * grad_defense)
+                update_grad = new_ms
 
-        if cfg.study:
-            l2_origin = jnp.sqrt(
-                jnp.sum((state.theta - state.origin) ** 2))
-        # The optimizer applies the final update (torch-SGD semantics by
-        # default, incl. --weight-decay; reference `attack.py:543-545`,
-        # `experiments/model.py:368-380`)
-        theta, opt_state = self.optimizer.update(
-            update_grad, state.opt_state, state.theta, lr)
+            # The optimizer applies the final update (torch-SGD semantics
+            # by default, incl. --weight-decay; reference
+            # `attack.py:543-545`, `experiments/model.py:368-380`)
+            theta, opt_state = self.optimizer.update(
+                update_grad, state.opt_state, state.theta, lr)
 
         # --- study metrics (`attack.py:842-878`) --- #
         if cfg.study:
-            metrics, (pg, pn, pc) = metrics_mod.study_metrics(
-                loss_avg=loss_avg, l2_origin=l2_origin,
-                G_sampled=G_sampled, G_honest=G_honest, G_attack=G_attack,
-                grad_defense=grad_defense, accept_ratio=accept_ratio,
-                past_grads=state.past_grads, past_norms=state.past_norms,
-                past_count=state.past_count, momentum=mu)
+            with jax.named_scope("metrics"):
+                l2_origin = jnp.sqrt(
+                    jnp.sum((state.theta - state.origin) ** 2))
+                metrics, (pg, pn, pc) = metrics_mod.study_metrics(
+                    loss_avg=loss_avg, l2_origin=l2_origin,
+                    G_sampled=G_sampled, G_honest=G_honest,
+                    G_attack=G_attack,
+                    grad_defense=grad_defense, accept_ratio=accept_ratio,
+                    past_grads=state.past_grads,
+                    past_norms=state.past_norms,
+                    past_count=state.past_count, momentum=mu)
         else:
             metrics = {}
             pg, pn, pc = state.past_grads, state.past_norms, state.past_count

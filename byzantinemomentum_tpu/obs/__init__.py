@@ -24,6 +24,12 @@ used to guess liveness from study-CSV mtime). Three pieces:
   timing (an `AccumulatedTimedContext` whose sync barrier is a tiny
   device→host transfer), host RSS, the TPU bf16 peak-FLOPs table shared
   with `bench.py` and the logical-FLOP counter behind the MFU gauge.
+* **attrib** (`attrib/`) — phase-attributed device profiling: xplane
+  trace parsing (the `scripts/trace_opstats.py` core, promoted), the
+  `jax.named_scope` phase join against `engine/step.py`'s annotations,
+  MXU/memory/relayout op classes, and the per-run `attribution.json`
+  artifact behind `cli/attack.py --attribution` (the SIGUSR1 live window
+  auto-attributes too).
 * **forensics** (`forensics.py`) — per-worker EWMA suspicion scores over
   the in-jit GAR diagnostics stream (`--gar-diagnostics`): selection-
   frequency deficit, distance z-score and NaN-quarantine history, with
@@ -67,16 +73,19 @@ from byzantinemomentum_tpu.obs.heartbeat import (  # noqa: F401
 from byzantinemomentum_tpu.obs.perf import (  # noqa: F401
     SlidingRate,
     StepTimer,
+    flops_of_compiled,
     host_rss_mb,
     logical_flops,
     mfu,
     peak_flops,
 )
+from byzantinemomentum_tpu.obs import attrib  # noqa: F401
 
 __all__ = [
     "TELEMETRY_NAME", "Telemetry", "activate", "active", "counter",
     "deactivate", "emit", "install_compile_listener", "load_records", "span",
     "HEARTBEAT_NAME", "read_heartbeat", "write_heartbeat",
-    "SlidingRate", "StepTimer", "SuspicionTracker", "host_rss_mb",
-    "logical_flops", "mfu", "peak_flops",
+    "SlidingRate", "StepTimer", "SuspicionTracker", "attrib",
+    "flops_of_compiled", "host_rss_mb", "logical_flops", "mfu",
+    "peak_flops",
 ]

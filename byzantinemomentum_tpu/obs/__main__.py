@@ -6,9 +6,14 @@ Two modes:
   temporary directory and exit 0 iff every invariant holds — the CI smoke
   hook (`scripts/run_test_tiers.py` and ad-hoc container checks) that
   proves the observability layer works without running a training step.
+  Includes the attribution smoke: a tiny named-scope program is traced on
+  the CPU backend, its xplane parsed and attributed (`obs/attrib`), and
+  the resulting artifact is printed as one `attribution: {...}` JSON line
+  for the tier harness to record.
 * `<run_dir>`: render the one-page report (same as `scripts/obs_report.py`).
 """
 
+import json
 import sys
 import tempfile
 
@@ -75,8 +80,82 @@ def selfcheck():
         assert "recompiles=3" in report and "run_end" in report
         assert "forensics:" in report and "suspects=[4]" in report
 
+    attribution_selfcheck()
     print("obs selfcheck: OK")
     return 0
+
+
+def attribution_selfcheck():
+    """Prove the attribution pipeline end to end on the CPU backend: trace
+    a tiny program whose phases are named like the engine's, parse the
+    xplane, join phases through the compiled HLO text, and hold the
+    artifact's invariants (phases tile the window; the engine's scopes are
+    found). Prints one `attribution: {...}` JSON line the tier harness
+    records as its per-tier artifact."""
+    import os
+    import pathlib
+
+    # Deterministic CPU xplanes — and no accidental TPU tunnel dependency
+    # (this environment's sitecustomize can force a TPU platform; the
+    # config update after import is the part that sticks, see
+    # tests/conftest.py)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from byzantinemomentum_tpu import obs
+
+    @jax.jit
+    def step(x):
+        with jax.named_scope("honest"):
+            y = x @ x
+        with jax.named_scope("gar"):
+            z = jnp.sort(y, axis=0)
+        with jax.named_scope("update"):
+            w = z * 2.0 + 1.0
+        return w.sum()
+
+    x = jnp.ones((192, 192), jnp.float32)
+    step(x).block_until_ready()  # compile outside the window
+    hlo_text = step.lower(x).compile().as_text()
+    steps = 4
+    with tempfile.TemporaryDirectory(prefix="bmt-attrib-selfcheck-") as tmp:
+        tmp = pathlib.Path(tmp)
+        trace_dir = tmp / "trace"
+        jax.profiler.start_trace(str(trace_dir))
+        for _ in range(steps):
+            step(x).block_until_ready()
+        jax.profiler.stop_trace()
+
+        att = obs.attrib.attribute_trace(
+            trace_dir, steps, hlo_text=hlo_text, backend="cpu",
+            device_kind=jax.devices()[0].device_kind)
+        phases = att["phases"]
+        assert att["total_ms"] > 0.0, att
+        for name in ("honest", "gar", "update"):
+            assert phases[name]["ms"] > 0.0, (name, phases)
+        # The artifact invariant the acceptance test leans on: the phase
+        # buckets (incl. other + host) tile the traced window exactly
+        total = sum(p["ms"] for p in phases.values())
+        assert abs(total - att["total_ms"]) < 1e-6 * max(1.0, total), att
+        # Round-trip through the artifact file and the one-pager section
+        obs.attrib.write_attribution(tmp, att)
+        assert obs.attrib.load_attribution(tmp)["steps"] == steps
+        from byzantinemomentum_tpu.obs.report import render_report
+        report = render_report(tmp)
+        assert "perf attribution" in report and "honest" in report, report
+        print("attribution: " + json.dumps({
+            "backend": att["backend"],
+            "steps": steps,
+            "total_ms": round(att["total_ms"], 4),
+            "phases_ms": {k: round(v["ms"], 4)
+                          for k, v in sorted(phases.items())
+                          if v["ms"] > 0.0},
+            "op_classes_ms": {k: round(v, 4)
+                              for k, v in sorted(att["op_classes"].items())},
+            "host_gap_fraction": round(att["host_gap_fraction"], 4),
+        }, sort_keys=True))
 
 
 def main(argv=None):

@@ -1,0 +1,50 @@
+"""Phase-attributed device profiling (PR 6).
+
+Turns one-off trace archaeology (`PERF_NOTES.md`'s hand-transcribed
+numbers) into a first-class per-run observability layer:
+
+* **xplane** (`xplane.py`) — the profiler-trace parsing core promoted out
+  of `scripts/trace_opstats.py` (that script is now a thin CLI over it):
+  per-HLO-op events/durations on TPU `"XLA Ops"` lines and CPU
+  `TfrtCpuClient` thread lines alike, with a size cap
+  (`BMT_XPLANE_MAX_MB`) so a mis-captured window degrades to a warning
+  instead of stalling a live run.
+* **phases** (`phases.py`) — scope-path -> engine-phase extraction (the
+  `jax.named_scope` annotations in `engine/step.py`: `honest`, `attack`,
+  `gar`/`gar_masked`/`gar_diag`, `update`, `metrics`), the instruction ->
+  scope join for CPU traces (compiled-module text), and the MXU /
+  relayout / memory op-class bucketer.
+* **attribution** (`attribution.py`) — the per-run `attribution.json`
+  builder: per-phase ms/step, MFU and distance-to-floor (the
+  `obs/perf.py` logical-FLOP recipe), relayout ms and host-gap fraction.
+
+Driver surface: `cli/attack.py --attribution` captures a deterministic
+warm-up-then-one-chunk window and attributes it; the SIGUSR1 live window
+auto-attributes too. `scripts/bench_compare.py` gates attribution
+artifacts so relayout/host-gap regrowth fails CI instead of silently
+eating a packing win.
+
+Import discipline: like the rest of `obs/`, nothing here imports jax (or
+the xplane proto) at module scope.
+"""
+
+from byzantinemomentum_tpu.obs.attrib.attribution import (  # noqa: F401
+    ATTRIBUTION_NAME,
+    attribute_trace,
+    load_attribution,
+    write_attribution,
+)
+from byzantinemomentum_tpu.obs.attrib.phases import (  # noqa: F401
+    OP_CLASSES,
+    PHASES,
+    op_class_of,
+    phase_of,
+    scope_map_from_hlo,
+)
+from byzantinemomentum_tpu.obs.attrib import xplane  # noqa: F401
+
+__all__ = [
+    "ATTRIBUTION_NAME", "attribute_trace", "load_attribution",
+    "write_attribution", "OP_CLASSES", "PHASES", "op_class_of", "phase_of",
+    "scope_map_from_hlo", "xplane",
+]

@@ -1,0 +1,164 @@
+"""Build one run's `attribution.json` out of a profiler trace window.
+
+The artifact answers, per step, the questions PERF_NOTES.md used to answer
+by hand-driving `scripts/trace_opstats.py` and transcribing prose: where
+the device time goes by engine phase (`honest`/`attack`/`gar*`/`update`/
+`metrics`, from the `jax.named_scope` annotations), how much of it is
+relayout data movement (the r5 packing win's regression mode), how long
+the device sat idle on the host inside the window, and how far the step
+is from its MXU floor.
+
+Schema (all times ms/step, every field present — `null` when unknown):
+
+    {"kind": "attribution", "backend": ..., "device_kind": ...,
+     "steps": N, "phases": {phase: {"ms": float, "ops": int}},
+     "op_classes": {"mxu"|"relayout"|"memory": float},
+     "device_ms": float,        # union of device-op intervals
+     "host_gap_ms": float,      # window span - device busy
+     "host_gap_fraction": float,
+     "total_ms": float,         # device_ms + host_gap_ms == span/steps
+     "unattributed_ms": float,  # device ops with no phase identity
+     "flops_per_step": float|null, "peak_flops": float|null,
+     "mfu": float|null, "mxu_floor_ms": float|null,
+     "distance_to_floor": float|null}   # total_ms / mxu_floor_ms
+
+The phase dict always carries every engine phase plus `"other"` (device
+ops outside any named scope) and `"host"` (the gap), so
+`sum(p["ms"]) == total_ms` — the invariant the acceptance test checks
+against the telemetry `device_step_ms` gauge.
+"""
+
+import json
+import pathlib
+
+from byzantinemomentum_tpu.obs.attrib import phases as phases_mod
+from byzantinemomentum_tpu.obs.attrib import xplane
+
+__all__ = ["ATTRIBUTION_NAME", "attribute_trace", "write_attribution",
+           "load_attribution"]
+
+ATTRIBUTION_NAME = "attribution.json"
+
+
+def attribute_trace(trace_dir, steps, *, hlo_text=None, flops_per_step=None,
+                    peak_flops=None, backend=None, device_kind=None,
+                    planes=None):
+    """Attribute one captured trace window to phases and op classes.
+
+    Args:
+      trace_dir: the directory passed to `jax.profiler.start_trace` (or a
+        direct `.xplane.pb` path, or a parsed XSpace).
+      steps: training steps the traced window executed (divides totals).
+      hlo_text: optimized HLO text of the traced program
+        (`compiled.as_text()`) — the instruction->scope join for backends
+        whose traces carry no scope stat (CPU). TPU traces attribute from
+        their own `tf_op` stats and may pass None.
+      flops_per_step / peak_flops: the `obs/perf.py` logical-FLOP recipe
+        and chip peak; both optional (MFU/floor fields go null).
+    """
+    steps = max(1, int(steps))
+    space = (trace_dir if hasattr(trace_dir, "planes")
+             else xplane.load_xspace(trace_dir))
+    events = xplane.op_events(space, planes=planes)
+    scope_map = phases_mod.scope_map_from_hlo(hlo_text) if hlo_text else {}
+    # Fallback join by instruction BASE name (`dot.7` -> `dot`): numeric
+    # suffixes drift between the traced compilation and a re-lowered copy
+    # of the program; a base name maps to a phase only while every
+    # same-base instruction agrees (ambiguity -> unattributed, never a
+    # silent mis-bucket).
+    _AMBIG = object()
+    base_phase = {}
+    for name, scope in scope_map.items():
+        base = name.split(".", 1)[0]
+        phase = phases_mod.phase_of(scope)
+        if base_phase.setdefault(base, phase) != phase:
+            base_phase[base] = _AMBIG
+
+    phase_ms = {name: 0.0 for name in phases_mod.PHASES}
+    phase_ms["other"] = 0.0
+    phase_ops = {name: 0 for name in phase_ms}
+    class_ms = {name: 0.0 for name in phases_mod.OP_CLASSES}
+    unattributed = 0.0
+    for event in events:
+        scope = event.scope or scope_map.get(event.name)
+        phase = phases_mod.phase_of(scope)
+        if phase is None and scope is None:
+            fallback = base_phase.get(event.name.split(".", 1)[0])
+            if fallback is not _AMBIG:
+                phase = fallback
+        if phase is None:
+            phase = "other"
+            unattributed += event.dur_ms
+        phase_ms[phase] += event.dur_ms
+        phase_ops[phase] += 1
+        class_ms[phases_mod.op_class_of(event.name)] += event.dur_ms
+
+    busy_ms, span_ms = xplane.window_span(events)
+    host_gap_ms = max(0.0, span_ms - busy_ms)
+    # The union of intervals (busy) is what the device actually worked;
+    # overlapping executor threads can make the naive duration sum exceed
+    # it — scale the per-phase buckets so they tile the busy time and the
+    # artifact's invariant sum(phases) == total holds exactly.
+    raw_total = sum(phase_ms.values())
+    scale = (busy_ms / raw_total) if raw_total > 0 else 0.0
+    phase_ms = {k: v * scale for k, v in phase_ms.items()}
+    class_ms = {k: v * scale for k, v in class_ms.items()}
+    unattributed *= scale
+
+    per_step = lambda ms: ms / steps  # noqa: E731
+
+    phases_out = {
+        name: {"ms": per_step(ms), "ops": phase_ops[name]}
+        for name, ms in phase_ms.items()
+    }
+    phases_out["host"] = {"ms": per_step(host_gap_ms), "ops": 0}
+    device_ms = per_step(busy_ms)
+    total_ms = per_step(busy_ms + host_gap_ms)
+
+    mfu = None
+    mxu_floor_ms = None
+    distance = None
+    if flops_per_step and peak_flops:
+        mxu_floor_ms = float(flops_per_step) / float(peak_flops) * 1e3
+        if total_ms > 0:
+            mfu = mxu_floor_ms / total_ms
+            distance = total_ms / mxu_floor_ms
+    return {
+        "kind": "attribution",
+        "backend": backend,
+        "device_kind": device_kind,
+        "steps": steps,
+        "phases": phases_out,
+        "op_classes": {k: per_step(v) for k, v in class_ms.items()},
+        "device_ms": device_ms,
+        "host_gap_ms": per_step(host_gap_ms),
+        "host_gap_fraction": (host_gap_ms / (busy_ms + host_gap_ms)
+                              if busy_ms + host_gap_ms > 0 else 0.0),
+        "total_ms": total_ms,
+        "unattributed_ms": per_step(unattributed),
+        "flops_per_step": flops_per_step,
+        "peak_flops": peak_flops,
+        "mfu": mfu,
+        "mxu_floor_ms": mxu_floor_ms,
+        "distance_to_floor": distance,
+    }
+
+
+def write_attribution(directory, attribution, name=ATTRIBUTION_NAME):
+    """Write the artifact (stable key order for diffable artifacts)."""
+    path = pathlib.Path(directory) / name
+    path.write_text(json.dumps(attribution, indent=2, sort_keys=True)
+                    + "\n")
+    return path
+
+
+def load_attribution(directory, name=ATTRIBUTION_NAME):
+    """The run's attribution artifact, or None when absent/torn."""
+    path = pathlib.Path(directory)
+    if path.is_dir():
+        path = path / name
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None

@@ -1,0 +1,103 @@
+"""Phase and op-class bucketing of HLO op executions.
+
+Phase identity comes from the `jax.named_scope` annotations the engine
+wraps its phases in (`engine/step.py`): the compiler threads the scope into
+every instruction's HLO metadata `op_name`, so a path like
+
+    jit(jitted)/jit(main)/while/body/honest/conv_general_dilated
+
+attributes to `honest`. TPU traces carry that path per event (`tf_op`
+stat); CPU traces do not, so `scope_map_from_hlo` rebuilds the
+instruction-name -> scope join from the compiled module's text (the
+optimized HLO keeps per-instruction `metadata={op_name="..."}`).
+
+Attribution precedence is OUTERMOST-first: an adaptive attack's inner
+line-search defense calls nest `attack/.../gar/...` and belong to the
+attack (matching the PERF_NOTES convention "attack incl. its defense
+call"); the server's own aggregation carries `gar` (or its `gar_masked` /
+`gar_diag` variants) without an enclosing `attack`.
+
+Op classes answer the *bandwidth-floor* questions independently of phase:
+MXU work (convs/dots), `copy`/`reshape`/`transpose` relayouts (the r5
+packing win's failure mode — regrowth is a regression), and everything
+else (memory-bound fusions, reductions, RNG).
+"""
+
+import re
+
+__all__ = ["PHASES", "OP_CLASSES", "phase_of", "op_class_of",
+           "scope_map_from_hlo"]
+
+# The engine's named scopes (engine/step.py), most specific first; the
+# order only matters for documentation — matching is per path segment.
+PHASES = ("honest", "attack", "gar_masked", "gar_diag", "gar", "update",
+          "metrics")
+
+OP_CLASSES = ("mxu", "relayout", "memory")
+
+_PHASE_SET = frozenset(PHASES)
+
+# HLO opcodes (and fusion-name stems) that run on the MXU
+_MXU_STEMS = ("convolution", "conv", "dot", "cudnn", "gemm")
+# Pure data-movement ops: the relayout budget (PERF_NOTES r5: conv-boundary
+# copy/reshape chains were the ~5 ms/step failure mode packing removed)
+_RELAYOUT_STEMS = ("copy", "reshape", "transpose", "bitcast")
+
+
+def phase_of(scope):
+    """The phase of one HLO-metadata scope path (None when no engine
+    phase appears in it). Outermost match wins (see module docstring)."""
+    if not scope:
+        return None
+    for segment in scope.split("/"):
+        if segment in _PHASE_SET:
+            return segment
+    return None
+
+
+def _stem(op_name):
+    """`broadcast_add_fusion` -> its last meaningful stem tokens;
+    `dot.7`/`copy.3` -> the opcode."""
+    return re.split(r"[.\d]", op_name, maxsplit=1)[0].lower()
+
+
+def op_class_of(op_name):
+    """Coarse hardware class of one HLO op/fusion name: "mxu" for
+    convs/dots, "relayout" for pure data movement, "memory" otherwise
+    (elementwise/reduction fusions are bandwidth-bound on TPU)."""
+    name = op_name.lower()
+    stem = _stem(name)
+    for needle in _RELAYOUT_STEMS:
+        if stem.startswith(needle):
+            return "relayout"
+    for needle in _MXU_STEMS:
+        if needle in name:
+            return "mxu"
+    return "memory"
+
+
+# One optimized-HLO instruction line:  %copy.3 = f32[...] copy(...),
+# ... metadata={op_name="jit(f)/honest/..." ...}
+_INSTR = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*.*?"
+    r"metadata=\{[^}]*?op_name=\"(?P<op_name>[^\"]*)\"")
+
+
+def scope_map_from_hlo(hlo_text):
+    """{instruction name: scope path} out of a compiled module's text
+    (`compiled.as_text()`), the join CPU traces need (their events are
+    named by HLO instruction with no scope stat).
+
+    A fusion's own metadata carries ONE representative op_name; ops folded
+    into it lose their identity — acceptable, because XLA fuses within a
+    scope far more often than across (and the engine's phases are sized
+    way above fusion granularity).
+    """
+    scopes = {}
+    for line in hlo_text.splitlines():
+        if "op_name=" not in line:
+            continue
+        m = _INSTR.match(line)
+        if m:
+            scopes[m.group("name")] = m.group("op_name")
+    return scopes

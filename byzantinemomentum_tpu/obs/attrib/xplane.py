@@ -1,0 +1,239 @@
+"""xplane trace parsing — the core of `scripts/trace_opstats.py`, promoted
+into a library the attribution pipeline (and that script) share.
+
+A `jax.profiler` trace directory holds one `*.xplane.pb` per capture under
+`plugins/profile/<ts>/`. The proto (`tensorflow.tsl.profiler.protobuf.
+xplane_pb2`) is a forest of *planes* (one per device, plus the host), each
+holding *lines* (threads/streams) of timestamped events whose names and
+stats reference per-plane metadata tables. Two layouts matter here:
+
+* **TPU** — device planes named `/device:TPU:<n>` with an `"XLA Ops"` line;
+  each event is one HLO op execution, and its stats usually carry the HLO
+  metadata scope path (`tf_op`) the compiler recorded.
+* **CPU** — one `/host:CPU` plane whose `tf_XLATfrtCpuClient/...` thread
+  lines carry the HLO op executions (events named by HLO *instruction*,
+  with `hlo_module`/`program_id` stats but no scope path — phase identity
+  comes from joining against the compiled module's text, `phases.py`).
+
+Protobuf backend: this library parses with whatever backend the process
+already has — the default (upb) parses raw xplanes fine and ~35x faster
+than pure python, which matters because the CPU runtime traces every
+intra-op thread-pool sub-task (a conv-heavy chunk reaches hundreds of
+MB). The historic pure-python forcing (the tensorboard profile plugin's
+converter is broken against this image's TF build) lives only in the
+`scripts/trace_opstats.py` CLI, where the original workaround shipped; a
+parse failure here names the env knob.
+"""
+
+import glob
+import os
+import pathlib
+
+__all__ = ["OpEvent", "load_xspace", "find_xplane", "device_planes",
+           "op_events", "aggregate_ops", "window_span"]
+
+# Substrings identifying lines/planes that carry HLO op executions
+_TPU_OPS_LINE = "XLA Ops"
+_CPU_EXEC_LINE_PREFIX = "tf_XLA"
+# Event-stat keys that may carry the HLO-metadata scope path on device
+# traces (tensorboard's converter calls it tf_op)
+_SCOPE_STATS = ("tf_op", "tf_op_name", "hlo_op_name")
+# Thread-line events that are executor bookkeeping, not HLO ops
+_NON_OPS = ("ThreadpoolListener", "ThunkExecutor", "ParseArguments")
+
+
+class OpEvent:
+    """One HLO op execution: name, duration (ms), optional scope path and
+    module, plus the raw [start, end) ps timestamps for span math."""
+
+    __slots__ = ("name", "dur_ms", "scope", "module", "start_ps", "end_ps")
+
+    def __init__(self, name, dur_ms, scope=None, module=None,
+                 start_ps=0, end_ps=0):
+        self.name = name
+        self.dur_ms = dur_ms
+        self.scope = scope
+        self.module = module
+        self.start_ps = start_ps
+        self.end_ps = end_ps
+
+    def __repr__(self):
+        return (f"OpEvent({self.name!r}, {self.dur_ms:.4f}ms, "
+                f"scope={self.scope!r})")
+
+
+def find_xplane(trace_dir):
+    """Newest `*.xplane.pb` under a `start_trace` directory (None when the
+    capture never completed)."""
+    pattern = os.path.join(str(trace_dir), "plugins/profile/*/*.xplane.pb")
+    paths = sorted(glob.glob(pattern))
+    return pathlib.Path(paths[-1]) if paths else None
+
+
+# Refuse to parse captures above this size (override: BMT_XPLANE_MAX_MB).
+# Oversized windows — one that caught an XLA compile, or a CPU capture of
+# a conv-heavy program (the CPU runtime traces every intra-op thread-pool
+# sub-task: one big conv/copy is thousands of events per execution) —
+# would stall the caller for minutes and gigabytes; a live training run
+# must degrade to a warning instead. Raising the cap is an explicit
+# opt-in to that cost.
+_MAX_XPLANE_MB = 128.0
+
+
+def load_xspace(trace_dir):
+    """Parse the trace directory's newest xplane into an `XSpace` proto.
+
+    Raises FileNotFoundError when no capture exists, ImportError when the
+    xplane proto bindings are absent (no TF in the environment), and
+    ValueError for captures past the size cap — all conditions the caller
+    decides how to degrade on.
+    """
+    path = pathlib.Path(trace_dir)
+    if path.is_file():
+        xplane = path
+    else:
+        xplane = find_xplane(path)
+        if xplane is None:
+            raise FileNotFoundError(
+                f"no *.xplane.pb under {str(path)!r} — did stop_trace() "
+                f"run?")
+    size_mb = xplane.stat().st_size / 2**20
+    cap_mb = float(os.environ.get("BMT_XPLANE_MAX_MB", _MAX_XPLANE_MB))
+    if size_mb > cap_mb:
+        raise ValueError(
+            f"{str(xplane)!r} is {size_mb:.0f} MB (cap {cap_mb:.0f} MB, "
+            f"BMT_XPLANE_MAX_MB overrides) — a window this size traced a "
+            f"compile or a while-loop-heavy program (e.g. an adaptive "
+            f"attack's line search on the CPU backend)")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    try:
+        space.ParseFromString(xplane.read_bytes())
+    except Exception as err:  # bmt: noqa[BMT-E05] protobuf backends raise backend-specific decode errors; re-raise with the known workaround named
+        raise ValueError(
+            f"cannot parse {str(xplane)!r} under this protobuf backend "
+            f"({err}); retry with "
+            f"PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python") from err
+    return space
+
+
+def device_planes(space):
+    """The planes carrying HLO op executions, most specific first:
+    `/device:*` planes when present (TPU/GPU), else the `/host:CPU`
+    plane (the CPU backend runs its thunks on host threads)."""
+    planes = [p for p in space.planes if p.name.startswith("/device:")]
+    if planes:
+        return planes
+    return [p for p in space.planes if p.name == "/host:CPU"]
+
+
+def _stat_value(stat, stat_meta):
+    """A stat's value: strings come back as-is; `ref_value` indirects into
+    the plane's stat-metadata table (how the CPU runtime interns HLO op
+    and module names)."""
+    if stat.str_value:
+        return stat.str_value
+    if stat.ref_value:
+        meta = stat_meta.get(stat.ref_value)
+        return meta.name if meta is not None else None
+    for field in ("int64_value", "uint64_value", "double_value"):
+        value = getattr(stat, field)
+        if value:
+            return value
+    return None
+
+
+def _event_stats(event, stat_meta):
+    """{stat name: value} of one event."""
+    out = {}
+    for stat in event.stats:
+        meta = stat_meta.get(stat.metadata_id)
+        if meta is None:
+            continue
+        out[meta.name] = _stat_value(stat, stat_meta)
+    return out
+
+
+def _op_lines(plane):
+    """The plane's lines whose events are HLO op executions."""
+    lines = list(plane.lines)
+    named = {line.name: line for line in lines}
+    if _TPU_OPS_LINE in named:
+        return [named[_TPU_OPS_LINE]]
+    return [line for line in lines
+            if line.name.startswith(_CPU_EXEC_LINE_PREFIX)]
+
+
+def op_events(space, planes=None):
+    """Every HLO op execution in the trace, as `OpEvent`s.
+
+    `planes`: restrict to planes whose name contains this string (e.g.
+    `"/device:TPU:0"`); default = every device plane (`device_planes`).
+    """
+    if planes is not None:
+        selected = [p for p in space.planes if planes in p.name]
+    else:
+        selected = device_planes(space)
+    out = []
+    for plane in selected:
+        event_meta = dict(plane.event_metadata.items())
+        stat_meta = dict(plane.stat_metadata.items())
+        for line in _op_lines(plane):
+            line_start = line.timestamp_ns * 1000  # -> ps
+            for event in line.events:
+                meta = event_meta.get(event.metadata_id)
+                name = meta.name if meta is not None else ""
+                if not name or any(name.startswith(p) for p in _NON_OPS):
+                    continue
+                stats = _event_stats(event, stat_meta)
+                scope = None
+                for key in _SCOPE_STATS:
+                    value = stats.get(key)
+                    if isinstance(value, str) and value:
+                        scope = value
+                        break
+                start = line_start + event.offset_ps
+                out.append(OpEvent(
+                    name=name,
+                    dur_ms=event.duration_ps / 1e9,
+                    scope=scope,
+                    module=stats.get("hlo_module"),
+                    start_ps=start,
+                    end_ps=start + event.duration_ps,
+                ))
+    return out
+
+
+def aggregate_ops(space_or_dir, planes=None):
+    """Per-op totals `{name: (total_ms, count)}` — the
+    `scripts/trace_opstats.py` aggregation, as a library call. Accepts a
+    trace directory/path or an already-parsed XSpace."""
+    space = (space_or_dir if hasattr(space_or_dir, "planes")
+             else load_xspace(space_or_dir))
+    totals = {}
+    for event in op_events(space, planes=planes):
+        ms, count = totals.get(event.name, (0.0, 0))
+        totals[event.name] = (ms + event.dur_ms, count + 1)
+    return totals
+
+
+def window_span(events):
+    """(busy_ms, span_ms) of a list of `OpEvent`s: busy is the union of
+    the event intervals (overlapping executor threads do not double-count),
+    span is last-end minus first-start — their difference is the time the
+    device(s) sat idle waiting on the host inside the traced window."""
+    if not events:
+        return 0.0, 0.0
+    intervals = sorted((e.start_ps, e.end_ps) for e in events)
+    busy_ps = 0
+    cur_start, cur_end = intervals[0]
+    for start, end in intervals[1:]:
+        if start > cur_end:
+            busy_ps += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    busy_ps += cur_end - cur_start
+    span_ps = max(e.end_ps for e in events) - intervals[0][0]
+    return busy_ps / 1e9, span_ps / 1e9

@@ -24,8 +24,9 @@ __all__ = ["render_report", "main"]
 # Events worth listing individually on the one-pager (the resilience +
 # forensics timeline); everything else is summarized by count.
 _TIMELINE_EVENTS = ("restart", "rollback", "divergence_giveup", "retry",
-                    "checkpoint_invalid", "profiler_window", "run_start",
-                    "run_end", "suspect_worker", "suspect_cleared")
+                    "checkpoint_invalid", "profiler_window", "attribution",
+                    "run_start", "run_end", "suspect_worker",
+                    "suspect_cleared")
 
 
 def _fmt_seconds(seconds):
@@ -43,6 +44,53 @@ def _fmt_seconds(seconds):
 def _stats(values):
     values = [float(v) for v in values]
     return (min(values), sum(values) / len(values), max(values))
+
+
+def _load_attribution(run_dir):
+    """The run's `attribution.json` (or None) — duplicated tiny reader so
+    the report stays importable without the obs.attrib package loaded."""
+    try:
+        data = json.loads((pathlib.Path(run_dir)
+                           / "attribution.json").read_text())
+    except (OSError, ValueError):
+        return None
+    return data if (isinstance(data, dict)
+                    and data.get("kind") == "attribution") else None
+
+
+def _attribution_lines(att):
+    """Render the "perf attribution" section: per-phase ms/step ranked by
+    cost, the op-class split, and the floor distance when known."""
+    lines = ["perf attribution: "
+             f"{att.get('total_ms', 0.0):.3f} ms/step over "
+             f"{att.get('steps', '?')} traced steps "
+             f"(backend {att.get('backend', '?')})"]
+    phases = att.get("phases") or {}
+    ranked = sorted(phases.items(),
+                    key=lambda kv: -float(kv[1].get("ms", 0.0)))
+    for name, entry in ranked:
+        ms = float(entry.get("ms", 0.0))
+        if ms <= 0.0:
+            continue
+        total = float(att.get("total_ms") or 0.0)
+        share = f" ({ms / total * 100.0:.1f}%)" if total > 0 else ""
+        lines.append(f"  {name:<12} {ms:9.4f} ms/step{share}"
+                     f"  x{entry.get('ops', 0)}")
+    classes = att.get("op_classes") or {}
+    if classes:
+        lines.append("  op classes: " + ", ".join(
+            f"{k}={float(v):.4f}ms" for k, v in sorted(classes.items())))
+    extras = []
+    if att.get("host_gap_fraction") is not None:
+        extras.append(f"host gap {float(att['host_gap_fraction']) * 100:.1f}%")
+    if att.get("mfu") is not None:
+        extras.append(f"MFU {float(att['mfu']):.3f}")
+    if att.get("distance_to_floor") is not None:
+        extras.append(f"{float(att['distance_to_floor']):.1f}x off the "
+                      f"MXU floor")
+    if extras:
+        lines.append("  " + ", ".join(extras))
+    return lines
 
 
 def render_report(run_dir):
@@ -79,6 +127,13 @@ def render_report(run_dir):
         if heartbeat.get("status"):
             fields.append(f"status={heartbeat['status']}")
         lines.append("heartbeat: " + ", ".join(fields))
+
+    # Perf attribution (obs/attrib): the per-phase view of the traced
+    # chunk, read from the run's attribution.json artifact (rendered even
+    # for telemetry-less directories — the artifact stands on its own)
+    attribution = _load_attribution(run_dir)
+    if attribution is not None:
+        lines.extend(_attribution_lines(attribution))
 
     if not records:
         lines.append("telemetry: (no telemetry.jsonl)")

@@ -1,26 +1,34 @@
 #!/usr/bin/env python3
-"""Diff two benchmark artifacts (`BENCH_r*.json`) cell by cell.
+"""Diff two benchmark artifacts (`BENCH_r*.json`) cell by cell — or two
+phase-attribution artifacts (`attribution.json`, obs/attrib) budget by
+budget.
 
-Each artifact is the harness wrapper around one `bench.py` run:
+Each bench artifact is the harness wrapper around one `bench.py` run:
 `{"n": round, "rc": exit code, "parsed": <bench.py's JSON line or null>}`
 where the parsed payload carries the headline metric (`metric`/`value`)
 and a `cells` dict of named sub-benchmarks with `steps_per_sec_*` fields.
 Raw `bench.py` output JSON (the payload without the wrapper) is accepted
-too.
+too, as is an `attribution.json` (`"kind": "attribution"`): for those the
+diff runs over per-phase ms/step, the relayout-copy budget and the
+host-gap fraction — COST metrics, so the gate fails on *growth* past the
+tolerance. That is the phase-budget gate: a PR that regrows the relayout
+copies or host gaps the r5 packing work removed (PERF_NOTES.md) fails CI
+here instead of silently eating the win inside an unchanged steps/s
+tolerance band.
 
 Usage:
   python scripts/bench_compare.py [OLD.json NEW.json] [--tolerance 0.05]
 
 With no files, the two newest `BENCH_r*.json` at the repo root are
-compared (latest vs previous). Prints the per-cell steps/s deltas and
-exits non-zero when any comparable cell regressed by more than
-`--tolerance` (fractional: 0.05 = 5%).
+compared (latest vs previous). Exits non-zero when any comparable cell
+regressed by more than `--tolerance` (fractional: 0.05 = 5%).
 
 Incomparability beats false alarms: a run that crashed (`rc != 0` /
-`parsed: null`) or fell back to the CPU backend (`"backend":
+`parsed: null`), fell back to the CPU backend (`"backend":
 "cpu-fallback"` — a down TPU tunnel, not a code regression; see
-`bench.py:_ensure_backend`) makes the pair INCOMPARABLE — reported as
-such, exit 0 — rather than counted as a regression.
+`bench.py:_ensure_backend`), or a pair mixing artifact kinds or
+attribution backends makes the pair INCOMPARABLE — reported as such,
+exit 0 — rather than counted as a regression.
 """
 
 import argparse
@@ -31,7 +39,7 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-__all__ = ["load_artifact", "compare", "main"]
+__all__ = ["load_artifact", "compare", "compare_attribution", "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -88,6 +96,54 @@ def compare(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+# Cost cells below this many ms/step are measurement noise on any backend;
+# the phase gate never fails on their relative growth alone
+_BUDGET_FLOOR_MS = 0.05
+
+
+def _budgets(attribution):
+    """Flatten one attribution payload into `{name: cost}` — per-phase
+    ms/step, the op-class budgets (the relayout budget is THE regression
+    the r5 packing win must not silently lose), and the host-gap
+    fraction."""
+    budgets = {}
+    for name, entry in (attribution.get("phases") or {}).items():
+        if isinstance(entry, dict) and isinstance(
+                entry.get("ms"), (int, float)):
+            budgets[f"phase.{name}.ms"] = float(entry["ms"])
+    for name, value in (attribution.get("op_classes") or {}).items():
+        if isinstance(value, (int, float)):
+            budgets[f"class.{name}.ms"] = float(value)
+    for key in ("total_ms", "host_gap_fraction"):
+        value = attribution.get(key)
+        if isinstance(value, (int, float)):
+            budgets[key] = float(value)
+    return budgets
+
+
+def compare_attribution(old_payload, new_payload, tolerance):
+    """The phase-budget gate: `(rows, regressions)` over cost budgets
+    present in BOTH attribution artifacts. Costs regress by GROWING, so a
+    regression is `new > old * (1 + tolerance)` — with an absolute floor
+    (`_BUDGET_FLOOR_MS`, or 0.01 for the dimensionless host-gap fraction)
+    so sub-noise budgets cannot flake the gate."""
+    old_budgets = _budgets(old_payload)
+    new_budgets = _budgets(new_payload)
+    rows = []
+    regressions = []
+    for name in sorted(old_budgets):
+        if name not in new_budgets:
+            continue
+        old, new = old_budgets[name], new_budgets[name]
+        delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                   else float("inf"))
+        rows.append((name, old, new, delta))
+        floor = 0.01 if name == "host_gap_fraction" else _BUDGET_FLOOR_MS
+        if new > old * (1.0 + tolerance) and new - old > floor:
+            regressions.append((name, old, new, delta))
+    return rows, regressions
+
+
 def _latest_pair():
     found = sorted(ROOT.glob("BENCH_r*.json"))
     if len(found) < 2:
@@ -135,10 +191,40 @@ def main(argv=None):
         payloads.append(payload)
 
     old_payload, new_payload = payloads
-    rows, regressions = compare(old_payload, new_payload, args.tolerance)
     print(f"bench_compare: {pathlib.Path(old_path).name} -> "
           f"{pathlib.Path(new_path).name} "
           f"(tolerance {args.tolerance * 100:.1f}%)")
+
+    is_attr = [p.get("kind") == "attribution" for p in payloads]
+    if any(is_attr):
+        # Phase-budget gate over two attribution.json artifacts
+        if not all(is_attr):
+            print("bench_compare: INCOMPARABLE — one artifact is a phase "
+                  "attribution, the other a benchmark payload")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — attributions from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        rows, regressions = compare_attribution(
+            old_payload, new_payload, args.tolerance)
+        if not rows:
+            print("  no common phase budgets; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.4f} -> {new:10.4f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} phase budget(s) grew "
+                  f"past the {args.tolerance * 100:.1f}% tolerance")
+            return 1
+        return 0
+
+    rows, regressions = compare(old_payload, new_payload, args.tolerance)
     if not rows:
         print("  no common steps/s cells; nothing to compare")
         return 0

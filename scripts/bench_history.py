@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Structured bench trajectory: per-cell steps/s across rounds.
+
+`scripts/bench_compare.py` diffs two artifacts; this renders the whole
+sequence — every `BENCH_r*.json` at the repo root (the harness wrapper
+around one `bench.py` run per round), plus the working tree's
+`BENCH_cells.json` (the machine-readable sibling `bench.py` now writes)
+as the `current` row — into one table of steps/s per cell per round, so
+"did the r5 packing win survive r7?" is one command instead of archaeology
+over five JSON tails.
+
+Incomparability discipline (as `bench_compare.py`): a crashed round
+(`rc != 0`, no parsed payload — e.g. the BENCH_r05 down-tunnel crash), a
+`cpu-fallback` round, or a legacy artifact whose payload predates the
+field being asked for is reported as INCOMPARABLE for that row/cell — the
+table shows `-` and the script exits 0. The trajectory is information,
+not a gate; gating lives in `bench_compare.py`.
+
+Usage:
+  python scripts/bench_history.py [--json] [--root DIR]
+"""
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "scripts"))
+
+from bench_compare import load_artifact, _rates  # noqa: E402
+
+__all__ = ["collect_history", "render_table", "main"]
+
+_ROUND = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def collect_history(root=ROOT):
+    """[(label, rates | None, reason | None)] over every round artifact
+    (sorted by round number) plus the working tree's `BENCH_cells.json`
+    as `current` when present. `rates` is `bench_compare._rates`' flat
+    `{cell: steps/s}` view; None marks an INCOMPARABLE round with its
+    human-readable reason."""
+    root = pathlib.Path(root)
+    rows = []
+    rounds = []
+    for path in root.glob("BENCH_r*.json"):
+        m = _ROUND.search(path.name)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    for number, path in sorted(rounds):
+        rows.append((f"r{number:02d}",) + _load_rates(path))
+    current = root / "BENCH_cells.json"
+    if current.is_file():
+        rows.append(("current",) + _load_rates(current))
+    return rows
+
+
+def _load_rates(path):
+    try:
+        payload, reason = load_artifact(path)
+    except (OSError, json.JSONDecodeError) as err:
+        return None, f"{path.name}: unreadable ({err})"
+    if payload is None:
+        return None, reason
+    rates = _rates(payload)
+    if not rates:
+        return None, (f"{path.name}: legacy stdout-tail artifact with no "
+                      f"parseable steps/s cells")
+    return rates, None
+
+
+def render_table(history):
+    """The trajectory as one text table: rounds as rows, every cell name
+    seen in any comparable round as a column (columns a round lacks show
+    `-`, e.g. the pre-`cells` legacy artifacts)."""
+    columns = []
+    for _, rates, _ in history:
+        for name in rates or ():
+            if name not in columns:
+                columns.append(name)
+    if not columns:
+        lines = ["bench_history: no comparable rounds"]
+        for label, _, reason in history:
+            lines.append(f"  {label}: INCOMPARABLE — {reason}")
+        return "\n".join(lines)
+    label_w = max(len("round"), max(len(label) for label, _, _ in history))
+    widths = [max(len(c), 9) for c in columns]
+    header = "  ".join([f"{'round':<{label_w}}"]
+                       + [f"{c:>{w}}" for c, w in zip(columns, widths)])
+    lines = [header]
+    notes = []
+    for label, rates, reason in history:
+        if rates is None:
+            lines.append(f"{label:<{label_w}}  "
+                         + "  ".join(f"{'-':>{w}}" for w in widths))
+            notes.append(f"  {label}: INCOMPARABLE — {reason}")
+            continue
+        cells = [(f"{rates[c]:>{w}.3f}" if c in rates else f"{'-':>{w}}")
+                 for c, w in zip(columns, widths)]
+        lines.append(f"{label:<{label_w}}  " + "  ".join(cells))
+    if notes:
+        lines.append("")
+        lines.extend(notes)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="bench_history",
+        description="Per-cell steps/s trajectory over every BENCH_r*.json "
+                    "round (informational: always exits 0 unless the "
+                    "arguments are wrong)")
+    parser.add_argument("--root", default=str(ROOT),
+                        help="directory holding the BENCH_r*.json "
+                             "artifacts (default: the repo root)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    history = collect_history(pathlib.Path(args.root))
+    if not history:
+        print("bench_history: no BENCH_r*.json artifacts found")
+        return 0
+    if args.json:
+        print(json.dumps([
+            {"round": label, "rates": rates, "reason": reason}
+            for label, rates, reason in history], indent=2))
+        return 0
+    print(render_table(history))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

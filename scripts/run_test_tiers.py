@@ -19,6 +19,7 @@ Usage: python scripts/run_test_tiers.py --round 5
 
 import argparse
 import json
+import os
 import pathlib
 import re
 import subprocess
@@ -74,16 +75,30 @@ def main():
     telemetry.event("run_start", round=args.round, git_head=head)
 
     # Observability smoke: the obs stack must hold its own invariants
-    # before its telemetry of the tiers below means anything
+    # before its telemetry of the tiers below means anything. The
+    # selfcheck includes the attribution pipeline (PR 6) and prints its
+    # artifact as one `attribution: {...}` line — recorded here so the
+    # per-tier telemetry carries the per-phase numbers the smoke measured.
     print("obs selfcheck ...", flush=True)
     selfcheck = subprocess.run(
         [sys.executable, "-m", "byzantinemomentum_tpu.obs", "--selfcheck"],
-        cwd=ROOT, capture_output=True, text=True)
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
     obs_selfcheck = {"returncode": selfcheck.returncode}
+    attribution = None
+    for line in selfcheck.stdout.splitlines():
+        if line.startswith("attribution: "):
+            try:
+                attribution = json.loads(line[len("attribution: "):])
+            except ValueError:
+                pass  # a torn artifact line is a selfcheck bug, not ours
+    if attribution is not None:
+        obs_selfcheck["attribution"] = attribution
     if selfcheck.returncode != 0:
         obs_selfcheck["tail"] = (selfcheck.stdout
                                  + selfcheck.stderr).splitlines()[-12:]
-    telemetry.event("obs_selfcheck", returncode=selfcheck.returncode)
+    telemetry.event("obs_selfcheck", returncode=selfcheck.returncode,
+                    attribution=attribution)
     print(f"  {obs_selfcheck}", flush=True)
 
     # Bench-regression tooling smoke: the comparator must run over the

@@ -12,21 +12,35 @@ individual fusions/ops —
     python scripts/trace_opstats.py /tmp/my_trace --steps 60
 
 `--steps` divides the totals so the numbers read as ms/step (pass the
-number of training steps the traced region executed). The tensorboard
-profile plugin's converter is broken against this image's TF build; the
-xplane proto that TF ships parses fine under the pure-python protobuf
-backend, which this script forces for its own process.
+number of training steps the traced region executed).
+
+This is a thin CLI over `byzantinemomentum_tpu/obs/attrib/xplane.py` —
+the parsing core lives there so the `--attribution` pipeline and this
+script cannot drift apart; the pure-python protobuf forcing (the
+tensorboard profile plugin's converter is broken against this image's TF
+build) stays here, in this CLI's own process, as it always did. CPU
+traces parse too (`--device` defaults to the first TPU plane; pass e.g.
+`--device /host:CPU` or leave it to the library's auto-detection with
+`--device auto`).
 
 Usage: python scripts/trace_opstats.py <trace_dir> [--steps N] [--top K]
 """
 
 import argparse
-import collections
-import glob
 import os
+import pathlib
 import sys
 
+# The original workaround, kept for this CLI's own process: the
+# tensorboard profile plugin's converter is broken against this image's
+# TF build, and the pure-python backend is the known-safe parse path
+# (must be set before any protobuf import; export the var yourself — e.g.
+# to "upb" — to prefer the ~35x faster default backend on big traces)
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from byzantinemomentum_tpu.obs.attrib import xplane  # noqa: E402
 
 
 def main():
@@ -37,42 +51,34 @@ def main():
                              "(divides totals into ms/step)")
     parser.add_argument("--top", type=int, default=30)
     parser.add_argument("--device", default="/device:TPU:0",
-                        help="plane name (default the first TPU core)")
+                        help="plane name (default the first TPU core; "
+                             "'auto' lets the library pick the device "
+                             "planes — the /host:CPU executor lines on the "
+                             "CPU backend)")
     args = parser.parse_args()
 
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    try:
+        space = xplane.load_xspace(args.trace_dir)
+    except FileNotFoundError as err:
+        sys.exit(str(err))
 
-    pattern = os.path.join(args.trace_dir, "plugins/profile/*/*.xplane.pb")
-    paths = sorted(glob.glob(pattern))
-    if not paths:
-        sys.exit(f"no xplane.pb under {pattern!r} — did stop_trace() run?")
-    space = xplane_pb2.XSpace()
-    with open(paths[-1], "rb") as fd:
-        space.ParseFromString(fd.read())
-
-    planes = {p.name: p for p in space.planes}
-    if args.device not in planes:
+    planes = None if args.device == "auto" else args.device
+    if planes is not None and not any(
+            planes in p.name for p in space.planes):
         sys.exit(f"plane {args.device!r} not in trace; available: "
-                 f"{sorted(planes)}")
-    plane = planes[args.device]
-    meta = plane.event_metadata
-    lines = {l.name: l for l in plane.lines}
-    if "XLA Ops" not in lines:
-        sys.exit(f"no 'XLA Ops' line; available: {sorted(lines)}")
+                 f"{sorted(p.name for p in space.planes)}")
+    totals = xplane.aggregate_ops(space, planes=planes)
+    if not totals:
+        sys.exit(f"no HLO op events on plane(s) {args.device!r} — "
+                 f"try '--device auto'")
 
-    agg = collections.Counter()
-    cnt = collections.Counter()
-    for e in lines["XLA Ops"].events:
-        name = meta[e.metadata_id].name
-        agg[name] += e.duration_ps / 1e9  # -> ms
-        cnt[name] += 1
-
-    total = sum(agg.values())
+    total = sum(ms for ms, _ in totals.values())
     print(f"total op time {total:.1f} ms "
           f"({total / args.steps:.3f} ms/step over {args.steps} steps); "
           f"top {args.top}:")
-    for name, ms in agg.most_common(args.top):
-        print(f"{ms / args.steps:9.4f} ms/step  x{cnt[name]:6d}  {name[:110]}")
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])
+    for name, (ms, count) in ranked[:args.top]:
+        print(f"{ms / args.steps:9.4f} ms/step  x{count:6d}  {name[:110]}")
 
 
 if __name__ == "__main__":

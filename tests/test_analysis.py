@@ -132,6 +132,22 @@ def f(gs):
     return jnp.stack(gs)
 """,
     ),
+    "BMT-E08": (
+        """
+import jax
+@jax.jit
+def f(x, step):
+    with jax.named_scope(f"phase_{step}"):
+        return x * 2
+""",
+        """
+import jax
+@jax.jit
+def f(x, step):
+    with jax.named_scope("honest"):
+        return x * 2
+""",
+    ),
 }
 
 

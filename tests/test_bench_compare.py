@@ -87,3 +87,157 @@ def test_compare_only_common_cells():
         tolerance=0.05)
     names = [r[0] for r in rows]
     assert names == ["m"] and not regressions
+
+
+# --------------------------------------------------------------------------- #
+# Phase-budget gating over attribution.json artifacts (obs/attrib, PR 6)
+
+def _attribution(tmp_path, name, honest_ms, gar_ms, relayout_ms,
+                 host_gap_frac, backend="tpu"):
+    phases = {
+        "honest": {"ms": honest_ms, "ops": 100},
+        "gar": {"ms": gar_ms, "ops": 20},
+        "host": {"ms": 0.2, "ops": 0},
+    }
+    device = honest_ms + gar_ms
+    payload = {
+        "kind": "attribution", "backend": backend, "steps": 8,
+        "phases": phases,
+        "op_classes": {"mxu": honest_ms, "relayout": relayout_ms,
+                       "memory": device - honest_ms - relayout_ms},
+        "total_ms": device + 0.2,
+        "host_gap_fraction": host_gap_frac,
+    }
+    path = tmp_path / name
+    path.write_text(json.dumps(payload))
+    return path
+
+
+def test_attribution_within_budget_passes(tmp_path, capsys):
+    old = _attribution(tmp_path, "old.json", 10.0, 2.0, 0.5, 0.05)
+    new = _attribution(tmp_path, "new.json", 10.2, 1.9, 0.51, 0.05)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "phase.honest.ms" in out and "REGRESSED" not in out
+
+
+def test_attribution_relayout_regrowth_fails(tmp_path, capsys):
+    """The gate the tentpole exists for: relayout copies regrowing past
+    the tolerance fail CI even when total steps/s would still pass."""
+    old = _attribution(tmp_path, "old.json", 10.0, 2.0, 0.5, 0.05)
+    new = _attribution(tmp_path, "new.json", 10.0, 2.0, 2.5, 0.05)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "class.relayout.ms" in out and "REGRESSED" in out
+
+
+def test_attribution_host_gap_growth_fails(tmp_path, capsys):
+    old = _attribution(tmp_path, "old.json", 10.0, 2.0, 0.5, 0.02)
+    new = _attribution(tmp_path, "new.json", 10.0, 2.0, 0.5, 0.20)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "host_gap_fraction" in out and "REGRESSED" in out
+
+
+def test_attribution_noise_floor_tolerated(tmp_path, capsys):
+    """Sub-noise budgets (< the absolute floor) cannot flake the gate
+    even at huge relative growth."""
+    old = _attribution(tmp_path, "old.json", 10.0, 2.0, 0.001, 0.05)
+    new = _attribution(tmp_path, "new.json", 10.0, 2.0, 0.04, 0.05)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_attribution_mixed_kinds_incomparable(tmp_path, capsys):
+    bench = _artifact(tmp_path, "bench.json", 50.0)
+    att = _attribution(tmp_path, "att.json", 10.0, 2.0, 0.5, 0.05)
+    rc = bench_compare.main([str(bench), str(att)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCOMPARABLE" in out
+
+
+def test_attribution_backend_mismatch_incomparable(tmp_path, capsys):
+    old = _attribution(tmp_path, "old.json", 10.0, 2.0, 0.5, 0.05,
+                       backend="tpu")
+    new = _attribution(tmp_path, "new.json", 40.0, 8.0, 2.0, 0.05,
+                       backend="cpu")
+    rc = bench_compare.main([str(old), str(new)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCOMPARABLE" in out and "backends" in out
+
+
+# --------------------------------------------------------------------------- #
+# bench_history: the per-cell trajectory over rounds
+
+def _bench_history():
+    import importlib.util
+    import pathlib
+    import sys
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "bench_history.py")
+    spec = importlib.util.spec_from_file_location("bench_history", script)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench_history", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_history_table_and_incomparable_rounds(tmp_path, capsys):
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _artifact(tmp_path, "BENCH_r02.json", 12.0,
+              cells={"krum": {"steps_per_sec_bf16_mixed": 50.0}})
+    _artifact(tmp_path, "BENCH_r03.json", 0.0, rc=1, parsed=False)  # crash
+    _artifact(tmp_path, "BENCH_r04.json", 1.0, backend="cpu-fallback")
+    # The working tree's machine-readable sibling becomes `current`
+    (tmp_path / "BENCH_cells.json").write_text(json.dumps(
+        {"metric": "sim_steps_per_sec", "value": 13.0,
+         "cells": {"krum": {"steps_per_sec_bf16_mixed": 55.0}}}))
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for needle in ("r01", "r02", "r03", "r04", "current",
+                   "krum.steps_per_sec_bf16_mixed", "INCOMPARABLE"):
+        assert needle in out, out
+    # Crashed and cpu-fallback rounds are dashes, not numbers or failures
+    r03_line = [l for l in out.splitlines() if l.startswith("r03")][0]
+    assert set(r03_line.split()[1:]) == {"-"}
+    history = bench_history.collect_history(tmp_path)
+    assert [label for label, _, _ in history] == [
+        "r01", "r02", "r03", "r04", "current"]
+    assert history[2][1] is None and "rc=1" in history[2][2]
+    assert history[3][1] is None and "cpu" in history[3][2].lower()
+    assert history[4][1]["krum.steps_per_sec_bf16_mixed"] == 55.0
+
+
+def test_bench_history_json_mode(tmp_path, capsys):
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    rc = bench_history.main(["--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload[0]["round"] == "r01"
+    assert payload[0]["rates"]["sim_steps_per_sec"] == 10.0
+
+
+def test_bench_history_empty_dir(tmp_path, capsys):
+    bench_history = _bench_history()
+    rc = bench_history.main(["--root", str(tmp_path)])
+    assert rc == 0
+    assert "no BENCH_r*.json" in capsys.readouterr().out
+
+
+def test_bench_history_over_repo_artifacts(capsys):
+    """The committed BENCH_r01..r05 trajectory renders: r05 (the down-
+    tunnel crash) INCOMPARABLE, the r03->r04 packing-era cells present."""
+    bench_history = _bench_history()
+    rc = bench_history.main([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "r05: INCOMPARABLE" in out
+    assert "wrn28x10.steps_per_sec_bf16_mixed" in out
