@@ -70,17 +70,32 @@ def _peak_flops():
     return peak_flops(kind), kind
 
 
+def _probe_backend():
+    """Fail fast on every path a down TPU tunnel can surface on: the
+    device enumeration (`jax.devices()`, the BENCH_r05-era probe) AND the
+    first dispatch. BENCH_r05 proved the probe alone is not enough — its
+    `jax.devices()` answered while the first `device_put` then resolved
+    the default backend via `xla_bridge.local_devices()` and raised the
+    UNAVAILABLE there, exiting 1 anyway. `jax.device_put` walks exactly
+    that `get_default_device -> local_devices` path."""
+    jax.devices()
+    jax.device_put(np.zeros((1,), np.float32))
+
+
 def _ensure_backend():
     """Probe the configured backend; on an init failure (e.g. the
     "Unable to initialize backend ... UNAVAILABLE" crash a down TPU tunnel
     produces — see BENCH_r05.json) fall back to the CPU backend so the
     benchmark still yields a parseable JSON line with a
-    `"backend": "cpu-fallback"` marker instead of exiting 1.
+    `"backend": "cpu-fallback"` marker instead of exiting 1. The probe
+    covers both the enumeration path and the first-dispatch path (the
+    BENCH_r05 crash raised at `device_put`, after `jax.devices()` had
+    already answered).
 
     Returns "default" or "cpu-fallback"; re-raises when even the CPU
     fallback cannot initialize (nothing left to measure on)."""
     try:
-        jax.devices()
+        _probe_backend()
         return "default"
     except RuntimeError as err:
         message = str(err)
@@ -95,7 +110,7 @@ def _ensure_backend():
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    jax.devices()  # still broken -> raise: there is nothing to measure on
+    _probe_backend()  # still broken -> raise: nothing left to measure on
     return "cpu-fallback"
 
 

@@ -33,6 +33,7 @@ they change no computation, no cache key, and no donation; dynamic
 
 import contextlib
 import functools
+import os
 import typing
 
 import jax
@@ -93,6 +94,29 @@ def grouped_sharded(mesh):
     axis does not divide the sampled count). Mode caching: see
     `grouped_disabled`."""
     return _grouped_mode_as(mesh)
+
+
+def _worker_pad_rows(S):
+    """Extra worker rows the grouped honest phase appends at trace time.
+
+    `BMT_WORKER_PAD=<S'>` pads the sampled-worker stack to S' rows so the
+    worker-packing machinery (`models/core.py::_worker_packing`) can
+    engage on counts it otherwise cannot (WRN's S = 9 has no divisor P
+    with P*C lane-aligned; S' = 12 buys P = 4/2 for C = 160/320). Like
+    `BMT_NO_WORKER_PACK`, the knob is read at TRACE time — set it before
+    the engine compiles, not between steps. Targets past 2S are clamped
+    (recycling each real row more than once buys no further packing
+    factor at WRN scale and only multiplies dummy compute)."""
+    raw = os.environ.get("BMT_WORKER_PAD", "")
+    if not raw:
+        return 0
+    try:
+        target = int(raw)
+    except ValueError:
+        from byzantinemomentum_tpu import utils
+        utils.warning(f"BMT_WORKER_PAD={raw!r} is not an integer; ignored")
+        return 0
+    return min(max(0, target - S), S)
 
 
 def _cast_tree(tree, dtype):
@@ -338,7 +362,41 @@ class Engine:
         CIFAR CNN (accelerates reference `attack.py:786-795`).
         """
         th_s, xs = self._grouped_operands(theta_eff, xs, theta_axis)
+        pad = _worker_pad_rows(xs.shape[0])
+        if pad:
+            return self._grouped_padded(th_s, net_state, xs, ys, wkeys, pad)
         return self._grouped_local(th_s, net_state, xs, ys, wkeys)
+
+    def _grouped_padded(self, th_s, net_state, xs, ys, wkeys, pad):
+        """The grouped phase with `pad` recycled worker rows appended —
+        the `BMT_WORKER_PAD` packing escape (PERF_NOTES.md r7): a worker
+        count like WRN's S = 9 admits no divisor P with P*C lane-aligned,
+        so the worker-packing machinery (`models/core.py`) cannot engage;
+        padding the stack to e.g. S' = 12 buys P = 4/2 packings for
+        C = 160/320 at the price of the dummy rows' compute plus the
+        block-diagonal zero FLOPs. Worker rows are independent (the
+        summed grouped loss has block-diagonal structure), so the kept
+        rows' gradients, losses and BatchNorm statistics are STRUCTURALLY
+        the unpadded ones — no dummy-row value ever feeds a kept row;
+        numerically they match to reduction rounding (XLA's grouped-conv
+        codegen varies with the group count, exactly as the packed-vs-
+        unpacked A/B already does). The dummy rows recycle the leading
+        workers' inputs and parameters with derived (discarded) dropout
+        keys, and every output is sliced back before anything downstream
+        sees it."""
+        S = xs.shape[0]
+        idx = jnp.arange(pad) % S
+
+        def recycle(a):
+            return jnp.concatenate([a, a[idx]])
+
+        extra_keys = jax.vmap(
+            lambda k: jax.random.fold_in(k, 0x5AD))(wkeys[idx])
+        losses, grads, states = self._grouped_local(
+            recycle(th_s), net_state, recycle(xs), recycle(ys),
+            jnp.concatenate([wkeys, extra_keys]))
+        return (losses[:S], grads[:S],
+                jax.tree.map(lambda leaf: leaf[:S], states))
 
     def _grouped_operands(self, theta_eff, xs, theta_axis):
         cfg = self.cfg

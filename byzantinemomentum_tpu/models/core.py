@@ -275,6 +275,64 @@ def _worker_packing(S, c):
     return 1
 
 
+# BATCH-SLOT PACKING (the second ROADMAP escape for worker counts that
+# admit no P, e.g. WRN's S = 9 with C in {160, 320}): concatenate Q BATCH
+# items on the channel axis instead — activations carried
+# `(B/Q, H, W, S, Q*C)`, convs run block-diagonal over the Q slots inside
+# each worker group (Q x the MXU FLOPs on those convs, exactly the
+# worker-packing trade), BatchNorm folds its statistics across the slots
+# (same per-(s, c) moments over the whole batch), and dropout draws the
+# vmapped path's per-worker masks and merely re-factorizes them. Opt-in
+# via `BMT_BATCH_PACK` (unset/0 = off; `1`/`auto` = smallest working Q;
+# an integer > 1 forces that Q): unlike worker packing it shrinks the
+# sublane-resident batch axis (B/Q pads up toward the 8/16-row tile), so
+# whether the lane alignment it buys outweighs that is a per-cell
+# device measurement (`scripts/wrn_pack_ab.py`), not a default.
+
+
+def _batch_packing(B, S, c):
+    """Batch-slot pack factor for a conv of channel width `c`: smallest
+    Q <= _MAX_WORKER_PACK dividing B with (Q*c) % 128 == 0, only when the
+    `BMT_BATCH_PACK` knob is on and worker packing found no P (worker
+    packing is the measured-win default; the two never compose)."""
+    raw = os.environ.get("BMT_BATCH_PACK", "").lower()
+    if raw in ("", "0", "false", "no"):
+        return 1
+    if c % 128 == 0 or _worker_packing(S, c) != 1:
+        return 1
+    if raw not in ("1", "auto", "true", "yes"):
+        try:
+            forced = int(raw)
+        except ValueError:
+            return 1
+        return forced if (forced > 1 and B % forced == 0
+                          and (forced * c) % 128 == 0) else 1
+    for Q in range(2, min(B, _MAX_WORKER_PACK) + 1):
+        if B % Q == 0 and (Q * c) % 128 == 0:
+            return Q
+    return 1
+
+
+def _batch_repack(x, q_from, q_to):
+    """Refactor a worker-expanded activation between batch-slot packings:
+    `(B/q_from, ..., S, q_from*C) -> (B/q_to, ..., S, q_to*C)`. A real
+    relayout copy when the factors differ (the one-time transition cost at
+    pack boundaries — same trade as the worker-packing P transition,
+    PERF_NOTES.md r5)."""
+    if q_from == q_to:
+        return x
+    if q_from > 1:  # unpack to the plain batch factorization
+        C = x.shape[-1] // q_from
+        x = x.reshape(x.shape[:-1] + (q_from, C))
+        x = jnp.moveaxis(x, -2, 1)                      # (B/qf, qf, ...)
+        x = x.reshape((x.shape[0] * q_from,) + x.shape[2:])
+    if q_to > 1:
+        x = x.reshape((x.shape[0] // q_to, q_to) + x.shape[1:])
+        x = jnp.moveaxis(x, 1, -2)                      # (..., S, qt, C)
+        x = x.reshape(x.shape[:-2] + (x.shape[-2] * x.shape[-1],))
+    return x
+
+
 def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
     """Per-worker convolution on a worker-expanded activation.
 
@@ -287,9 +345,7 @@ def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
     Returns (B, H', W', S, cout).
     """
     S, kh, kw_, cin, cout = params_s["w"].shape
-    B, H, W = x.shape[0], x.shape[1], x.shape[2]
     stride = (stride, stride) if isinstance(stride, int) else stride
-    xm = x.reshape(B, H, W, S * cin)  # the universal interchange form
     # Worker packing (see the section comment). When the conv's input or
     # output channel count is lane-misaligned, run it as S/P PAIRED groups
     # with block-diagonal weights: 2x the MXU work on the packed convs
@@ -299,7 +355,34 @@ def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
     # activations around an S-group conv was measured WORSE — XLA's grouped
     # conv rewrite pins the split form; see PERF_NOTES.md).
     P_in = S // x.shape[-2]
+    # Batch-slot packing (the BMT_BATCH_PACK escape, section comment): the
+    # carry is (B/Q, H, W, S, Q*cin), so Q is read off the channel width
+    # and the true batch off shape[0] * Q. Never composes with P.
+    Q_in = x.shape[-1] // (P_in * cin)
     P_out = _worker_packing(S, cout)
+    Q_out = 1
+    if P_in == 1 and P_out == 1:
+        Q_out = _batch_packing(x.shape[0] * Q_in, S, cout)
+    if Q_in != Q_out:
+        x = _batch_repack(x, Q_in, Q_out)
+    if Q_out > 1:
+        Q = Q_out
+        Bq, H, W = x.shape[0], x.shape[1], x.shape[2]
+        xm = x.reshape(Bq, H, W, S * Q * cin)
+        # Block-diagonal over the Q batch slots WITHIN each worker group:
+        # group s's filter maps slot q's cin to slot q's cout with worker
+        # s's kernel (autodiff extracts the diagonal blocks' gradients)
+        eye = jnp.eye(Q, dtype=params_s["w"].dtype)
+        wbd = jnp.einsum("sklio,qr->klqisro", params_s["w"], eye)
+        wbd = wbd.reshape(kh, kw_, Q * cin, S * Q * cout)
+        out = lax.conv_general_dilated(
+            xm, wbd, window_strides=stride, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=S)
+        out = out.reshape(out.shape[:3] + (S, Q * cout))
+        return out + jnp.tile(params_s["b"], (1, Q))
+    B, H, W = x.shape[0], x.shape[1], x.shape[2]
+    xm = x.reshape(B, H, W, S * cin)  # the universal interchange form
     P = max(P_in, P_out)
     if P == 1:
         w = (params_s["w"].transpose(1, 2, 3, 0, 4)
@@ -330,13 +413,17 @@ def grouped_conv_apply(params_s, x, *, padding="VALID", stride=1):
     return out + params_s["b"].reshape(S // P_out, P_out * cout)
 
 
-def grouped_unpack(x, S):
-    """Restore the plain (..., S, C) factorization of a possibly
-    worker-packed activation (no-op when already unpacked) — used before
-    stages that need the true worker axis (global pools, flatten, dense)."""
-    if x.shape[-2] == S:
-        return x
-    return x.reshape(x.shape[:-2] + (S, (x.shape[-2] * x.shape[-1]) // S))
+def grouped_unpack(x, S, batch=None):
+    """Restore the plain (B, ..., S, C) factorization of a possibly
+    worker- or batch-slot-packed activation (no-op when already unpacked)
+    — used before stages that need the true worker axis and batch (global
+    pools, flatten, dense). `batch` is the true batch size; callers on a
+    possibly batch-packed carry (BMT_BATCH_PACK) must pass it."""
+    if x.shape[-2] != S:
+        x = x.reshape(x.shape[:-2] + (S, (x.shape[-2] * x.shape[-1]) // S))
+    if batch is not None and x.shape[0] != batch:
+        x = _batch_repack(x, batch // x.shape[0], 1)
+    return x
 
 
 def grouped_dense_apply(params_s, x):
@@ -361,11 +448,18 @@ def grouped_batchnorm_apply(params_s, state, x, *, train):
     """
     S, C = params_s["gamma"].shape
     S2 = x.shape[-2]
+    P = S // S2
+    Q = x.shape[-1] // (P * C)  # batch-slot packing factor (never with P)
     gamma, beta = params_s["gamma"], params_s["beta"]
     if S2 != S:  # packed: per-(s, c) params follow the same factorization
         gamma = gamma.reshape(S2, -1)
         beta = beta.reshape(S2, -1)
+    elif Q > 1:  # batch-packed: per-(s, c) params tile across the Q slots
+        gamma = jnp.tile(gamma, (1, Q))
+        beta = jnp.tile(beta, (1, Q))
     if train:
+        if Q > 1:
+            return _bn_train_batch_packed(gamma, beta, x, state, S, C, Q)
         out, mean, var = _bn_train(2)(gamma, beta, x)
         count = x.size // (x.shape[-1] * x.shape[-2])
         unbiased = var * (count / max(count - 1, 1))
@@ -373,10 +467,10 @@ def grouped_batchnorm_apply(params_s, state, x, *, train):
             state, mean.reshape(S, C), unbiased.reshape(S, C))
         return out, new_state
     mean, var = state["mean"], state["var"]
-    if S2 != S:  # shared (C,) stats tile across the P packed workers
-        P = S // S2
-        mean = jnp.tile(mean, P)
-        var = jnp.tile(var, P)
+    if x.shape[-1] != C:  # shared (C,) stats tile across the packed slots
+        reps = x.shape[-1] // C  # P workers or Q batch slots (never both)
+        mean = jnp.tile(mean, reps)
+        var = jnp.tile(var, reps)
     inv = lax.rsqrt(var + BN_EPS)
     # Same mixed-precision note as `batchnorm_apply`: keep the activation
     # stream in x.dtype after normalizing with (possibly f32) stats
@@ -384,16 +478,46 @@ def grouped_batchnorm_apply(params_s, state, x, *, train):
     return out, state
 
 
-def grouped_dropout_apply(rngs, x, rate, *, train, axis=-2):
+def _bn_train_batch_packed(gamma_t, beta_t, x, state, S, C, Q):
+    """Train-mode BN on a batch-slot-packed activation (..., S, Q*C).
+
+    The Q slots of a packed channel are the SAME worker-channel's data
+    split across the batch, so the statistics must fold across them
+    before normalizing — per-(s, c) moments over the WHOLE batch, exactly
+    the unpacked semantics (the fold reorders the reduction, so equality
+    is to reduction rounding, not bitwise). One-pass sum/sum-of-squares
+    moments in f32 accumulation as `_bn_train`; autodiff backward (the
+    packed path is an opt-in experiment, `BMT_BATCH_PACK` — a closed-form
+    VJP like `_bn_train`'s is a follow-up if the A/B harness lands it)."""
+    axes = tuple(range(x.ndim - 2))
+    cnt = x.size // (S * C)  # true per-(s, c) element count
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(acc)
+    ssum = jnp.sum(xf, axis=axes).reshape(S, Q, C)
+    ssq = jnp.sum(xf * xf, axis=axes).reshape(S, Q, C)
+    mean = jnp.sum(ssum, axis=1) / cnt                       # (S, C)
+    var = jnp.maximum(jnp.sum(ssq, axis=1) / cnt - mean * mean, 0.0)
+    inv = lax.rsqrt(var + BN_EPS)
+    mean_t = jnp.tile(mean, (1, Q))
+    inv_t = jnp.tile(inv, (1, Q))
+    out = ((x - mean_t) * inv_t * gamma_t + beta_t).astype(x.dtype)
+    unbiased = var * (cnt / max(cnt - 1, 1))
+    return out, _fold_running_stats(state, mean, unbiased)
+
+
+def grouped_dropout_apply(rngs, x, rate, *, train, axis=-2, batch=None):
     """Per-worker dropout on a worker-expanded activation.
 
     rngs: (S,) stacked per-worker keys; `axis` is the worker axis of `x`
     (next-to-minor in the grouped convention, e.g. (B, H, W, S, C) or
     (B, S, F)); `x` may be worker-PACKED (..., S/P, P*C) (see the section
-    comment). Draws EXACTLY the masks the vmapped path draws — one
+    comment), or batch-slot-packed (B/Q, ..., S, Q*C) when the caller
+    passes the true `batch` size (the BMT_BATCH_PACK carry cannot be told
+    apart from a wider channel count by shape alone). Draws EXACTLY the
+    masks the vmapped path draws — one
     `_dropout_mask(key_s, shape-without-worker-axis)` per worker — so the
     two execution paths produce identical trajectories (packing only
-    changes where worker p's mask lands: concatenated on the channel axis).
+    changes where a mask element lands on the channel axis).
     """
     if not train or rate <= 0.0:
         return x
@@ -401,6 +525,21 @@ def grouped_dropout_apply(rngs, x, rate, *, train, axis=-2):
     ax = axis % x.ndim
     S = rngs.shape[0]
     S2 = x.shape[ax]
+    if (batch is not None and S2 == S and ax == x.ndim - 2
+            and x.shape[0] != batch):
+        # Batch-slot-packed: draw the per-worker masks in their TRUE
+        # (batch, ..., C) shape — the identical vmapped-path bits — and
+        # re-factorize them into the packed layout (the transpose fuses
+        # into the `where` consumer)
+        Q = batch // x.shape[0]
+        C = x.shape[-1] // Q
+        per_true = (batch,) + x.shape[1:ax] + (C,)
+        masks = jax.vmap(lambda k: _dropout_mask(k, keep, per_true))(rngs)
+        m = masks.reshape((S, x.shape[0], Q) + per_true[1:])
+        perm = (1,) + tuple(range(3, m.ndim - 1)) + (0, 2, m.ndim - 1)
+        m = jnp.transpose(m, perm)              # (B/Q, ..., S, Q, C)
+        m = m.reshape(m.shape[:-2] + (Q * C,))
+        return jnp.where(m, x / keep, 0.0)
     per_shape = x.shape[:ax] + x.shape[ax + 1:]
     if S2 == S:
         masks = jax.vmap(lambda k: _dropout_mask(k, keep, per_shape))(rngs)

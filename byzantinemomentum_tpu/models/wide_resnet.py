@@ -51,7 +51,7 @@ def _block_apply(params, state, x, stride, dropout_rate, train, rng):
 
 
 def _block_apply_grouped(params_s, state, x, stride, dropout_rate, train,
-                         rngs):
+                         rngs, batch):
     new_state = dict(state)
     out, new_state["bn1"] = grouped_batchnorm_apply(
         params_s["bn1"], state["bn1"], x, train=train)
@@ -62,7 +62,9 @@ def _block_apply_grouped(params_s, state, x, stride, dropout_rate, train,
                                       padding="VALID", stride=stride)
     out = grouped_conv_apply(params_s["conv1"], out, padding="SAME",
                              stride=stride)
-    out = grouped_dropout_apply(rngs, out, dropout_rate, train=train)
+    # `batch` disambiguates a batch-slot-packed carry (BMT_BATCH_PACK)
+    out = grouped_dropout_apply(rngs, out, dropout_rate, train=train,
+                                batch=batch)
     out, new_state["bn2"] = grouped_batchnorm_apply(
         params_s["bn2"], state["bn2"], out, train=train)
     out = jax.nn.relu(out)
@@ -134,12 +136,14 @@ def make_wide_resnet(depth=28, widen_factor=10, dropout_rate=0.3, num_classes=10
                 name = f"g{gi}b{bi}"
                 out, new_state[name] = _block_apply_grouped(
                     params_s[name], state[name], out, stride, dropout_rate,
-                    train, dks[:, ki] if train else None)
+                    train, dks[:, ki] if train else None, B)
                 ki += 1
         out, new_state["bn_out"] = grouped_batchnorm_apply(
             params_s["bn_out"], state["bn_out"], out, train=train)
         out = jax.nn.relu(out)
-        out = grouped_unpack(out, S)  # head needs the true worker axis
+        # head needs the true worker axis AND the true batch (the carry
+        # may be batch-slot-packed under BMT_BATCH_PACK)
+        out = grouped_unpack(out, S, batch=B)
         out = jnp.mean(out, axis=(1, 2))                 # (B, S, 64k)
         out = grouped_dense_apply(params_s["fc"], out)
         return log_softmax(out).transpose(1, 0, 2), new_state

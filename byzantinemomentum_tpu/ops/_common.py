@@ -16,11 +16,12 @@ Semantics pinned against the reference implementation (PyTorch, circa 1.x):
 import jax
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import pallas_sort
+from byzantinemomentum_tpu.ops import pallas_gar, pallas_sort
 
 __all__ = [
     "all_finite_from_dist",
     "averaged_median",
+    "distances_from_sq_gram",
     "lower_median",
     "masked_lower_median",
     "masked_mean",
@@ -69,6 +70,15 @@ def weighted_rows_mean(w, gradients, all_finite=None, then=None):
     its branch fusions); kept because it can only shrink the boundary
     value and reads more directly ("aggregate the selection" as one unit).
     """
+    # Fused-kernel tier (`ops/pallas_gar.py`): one streamed read of the
+    # (n, d) matrix, the masked form computed unconditionally in VMEM
+    # (identical to the fast branch when all-finite — see the kernel), so
+    # the `all_finite` predicate and the cond disappear. `then`
+    # continuations keep the jnp path (the only such caller, bulyan,
+    # routes to its own fully-fused kernel in `ops/bulyan.py`).
+    if then is None and pallas_gar.supported(gradients):
+        return pallas_gar.weighted_rows_mean(w, gradients)
+
     def fast(g):
         out = jnp.matmul(w, g, precision=jax.lax.Precision.HIGHEST)
         return then(out) if then is not None else out
@@ -211,18 +221,40 @@ def pairwise_distances(g, *, squared=False, method="dot"):
     """
     n = g.shape[0]
     if method == "dot":
-        # precision=HIGHEST: TPU matmuls default to bf16-decomposed passes;
-        # distance orderings feed selection decisions, so keep full f32.
-        # The row norms are the Gram diagonal — reading them there instead
-        # of a separate sum(g*g) saves one full pass over the (n, d) matrix
-        gram = jnp.matmul(g, g.T, precision=jax.lax.Precision.HIGHEST)
-        sq = jnp.diagonal(gram)
-        d2 = sq[:, None] + sq[None, :] - 2.0 * gram
-        d2 = jnp.maximum(d2, 0.0)
-    elif method == "diff":
-        d2 = jax.vmap(lambda gi: jnp.sum((g - gi[None, :]) ** 2, axis=1))(g)
-    else:
+        if pallas_gar.supported(g):
+            # Fused tier: the Gram accumulates tile by tile in VMEM — one
+            # streamed read of the (n, d) matrix, no padded materialization
+            # (`ops/pallas_gar.py`); the (n, n) post-processing below is
+            # shared, so downstream selection semantics are identical
+            gram = pallas_gar.sq_gram(g)
+        else:
+            # precision=HIGHEST: TPU matmuls default to bf16-decomposed
+            # passes; distance orderings feed selection decisions, so keep
+            # full f32. The row norms are the Gram diagonal — reading them
+            # there instead of a separate sum(g*g) saves one full pass
+            # over the (n, d) matrix
+            gram = jnp.matmul(g, g.T, precision=jax.lax.Precision.HIGHEST)
+        return distances_from_sq_gram(gram, squared=squared)
+    if method != "diff":
         raise ValueError(f"Unknown pairwise distance method {method!r}")
+    d2 = jax.vmap(lambda gi: jnp.sum((g - gi[None, :]) ** 2, axis=1))(g)
+    d2 = sanitize_inf(d2)
+    d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
+    if squared:
+        return d2
+    return sanitize_inf(jnp.sqrt(d2))
+
+
+def distances_from_sq_gram(gram, *, squared=False):
+    """The `(n, n)` distance post-processing shared by the jnp Gram, the
+    fused Pallas Gram (`ops/pallas_gar.py`) and the d-sharded psum'd Gram
+    (`parallel/sharded.py`): row norms read off the diagonal,
+    ||x-y||^2 = ||x||^2 + ||y||^2 - 2 x.y clamped at 0, non-finite -> +inf
+    and a +inf diagonal."""
+    n = gram.shape[0]
+    sq = jnp.diagonal(gram)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * gram
+    d2 = jnp.maximum(d2, 0.0)
     d2 = sanitize_inf(d2)
     d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
     if squared:

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from byzantinemomentum_tpu.ops import diag, register
+from byzantinemomentum_tpu.ops import diag, pallas_gar, register
 from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
 
 __all__ = ["aggregate", "diagnose", "selection", "best_subset_mask_from_dist"]
@@ -148,6 +148,10 @@ def aggregate(gradients, f, *, method="dot", **kwargs):
     """Brute rule (reference `aggregators/brute.py:70-80`)."""
     n = gradients.shape[0]
     mask = _best_subset_mask(gradients, f, method=method)
+    if pallas_gar.supported(gradients):
+        # Fused tier: the distances behind `mask` came from one streamed
+        # Gram pass; the subset mean is the only other read of the matrix
+        return pallas_gar.masked_rows_mean(mask, gradients, n - f)
     # where (not mask @ G): excluded rows may be all-NaN and 0*NaN = NaN
     kept = jnp.where(mask[:, None], gradients, 0)
     return jnp.sum(kept, axis=0) / (n - f)
@@ -162,8 +166,11 @@ def diagnose(gradients, f, *, method="dot", **kwargs):
     n = gradients.shape[0]
     dist = pairwise_distances(gradients, method=method)
     mask = best_subset_mask_from_dist(dist, f)
-    kept = jnp.where(mask[:, None], gradients, 0)
-    agg = jnp.sum(kept, axis=0) / (n - f)
+    if pallas_gar.supported(gradients):
+        agg = pallas_gar.masked_rows_mean(mask, gradients, n - f)
+    else:
+        kept = jnp.where(mask[:, None], gradients, 0)
+        agg = jnp.sum(kept, axis=0) / (n - f)
     in_subset = mask[None, :] & ~jnp.eye(n, dtype=bool)
     scores = jnp.max(jnp.where(in_subset, dist, -jnp.inf), axis=1)
     return agg, diag.make_aux(

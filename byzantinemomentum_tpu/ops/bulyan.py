@@ -23,7 +23,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import diag, register
+from byzantinemomentum_tpu.ops import diag, pallas_gar, register
 from byzantinemomentum_tpu.ops._common import (
     all_finite_from_dist, averaged_median, pairwise_distances,
     weighted_rows_mean)
@@ -85,6 +85,12 @@ def aggregate(gradients, f, m=None, *, method="dot", **kwargs):
     dist = pairwise_distances(gradients, method=method)  # diag = +inf
     W = selection_weights(dist, f, m)
     rounds = W.shape[0]
+    if pallas_gar.supported(gradients):
+        # Fused tier (`ops/pallas_gar.py`): the distances above came from
+        # ONE streamed Gram pass, and this call is the only other touch of
+        # the (n, d) matrix — stage-1 averages and the stage-2 averaged
+        # median in a single read, the (rounds, d) stack never materialized
+        return pallas_gar.selected_median_mean(W, gradients, rounds - 2 * f)
     return weighted_rows_mean(
         W.astype(gradients.dtype), gradients,
         all_finite=all_finite_from_dist(dist),
@@ -102,10 +108,15 @@ def diagnose(gradients, f, m=None, *, method="dot", **kwargs):
     dist = pairwise_distances(gradients, method=method)
     W = selection_weights(dist, f, m)
     rounds = W.shape[0]
-    agg = weighted_rows_mean(
-        W.astype(gradients.dtype), gradients,
-        all_finite=all_finite_from_dist(dist),
-        then=lambda sel: averaged_median(sel, rounds - 2 * f))
+    if pallas_gar.supported(gradients):
+        # Same fused tail as `aggregate`; the aux below reads only the
+        # (n, n) geometry the streamed Gram already produced
+        agg = pallas_gar.selected_median_mean(W, gradients, rounds - 2 * f)
+    else:
+        agg = weighted_rows_mean(
+            W.astype(gradients.dtype), gradients,
+            all_finite=all_finite_from_dist(dist),
+            then=lambda sel: averaged_median(sel, rounds - 2 * f))
     scores = jnp.sum(jnp.sort(dist, axis=1)[:, :m_scores], axis=1)
     mass = jnp.sum((W > 0).astype(jnp.float32), axis=0) / rounds
     return agg, diag.make_aux(n, scores=scores, selection=mass, dist=dist)

@@ -5,11 +5,15 @@ workers (plain Euclidean norms, non-finite -> +inf; reference
 `aggregators/krum.py:42-60`); the aggregate is the average of the m
 lowest-score gradients, default m = n-f-2 (reference `krum.py:65-80`).
 
-TPU design: the pairwise-distance matrix comes from one Gram matmul on the
-MXU (`ops/_common.pairwise_distances`), per-row sorts run on the VPU, and
-the whole kernel inlines into the jitted training step. `native-krum` is the
-standalone-jitted fast tier (stands in for `native.krum.aggregate`,
-reference `krum.py:82-96`).
+TPU design: the pairwise-distance matrix comes from one Gram pass
+(`ops/_common.pairwise_distances` — the fused streamed Pallas kernel of
+`ops/pallas_gar.py` where supported, else one MXU matmul), per-row sorts
+run on the VPU, and the whole kernel inlines into the jitted training
+step. The selected-row average routes through the streamed
+`weighted_rows_mean` kernel on the same gate, so the whole rule touches
+the (n, d) matrix exactly twice with no padded materialization.
+`native-krum` is the standalone-jitted fast tier (stands in for
+`native.krum.aggregate`, reference `krum.py:82-96`).
 """
 
 import math

@@ -1,0 +1,266 @@
+"""Fused Pallas TPU pipeline for the geometry GARs (krum, bulyan, brute).
+
+PERF_NOTES.md r5 attribution of the WRN-28-10 cell: the d-space bulyan at
+d = 36.5M costs ~23 ms/step because the `(n, d)` f32 G matrix is
+materialized with 11 -> 16 sublane row padding (2.3 GB physical, 7.5 ms to
+write) and then READ TWICE MORE at the padded width (the HIGHEST-precision
+Gram, 7 ms; the selection-stack matmul, 5.2 ms). The selection itself acts
+on a tiny `(n, n)` summary — all the big-matrix traffic is streamable.
+
+This module replaces the three padded touches with streamed kernels that
+each read the worker stack EXACTLY ONCE in d-tiles through VMEM and write
+only reduced results:
+
+* `sq_gram`        — the pairwise `g @ g.T` Gram accumulated tile by tile
+                     into a resident `(n, n)` VMEM block (one read of `g`,
+                     one tiny write; the row norms are its diagonal, so no
+                     separate norm pass either).
+* selection        — krum / bulyan stage 1 / brute run UNCHANGED on the
+                     `(n, n)` host-of-the-kernel result
+                     (`ops/krum.py::selection_weights`,
+                     `ops/bulyan.py::selection_weights`,
+                     `ops/brute.py::best_subset_mask_from_dist` — single
+                     source of truth with the jnp and d-sharded paths).
+* `weighted_rows_mean` / `selected_median_mean` / `masked_rows_mean`
+                   — the selected-row average as one more streamed pass:
+                     krum's `w @ G`, bulyan's stage-1 stack FUSED with its
+                     stage-2 averaged median (the `(rounds, d)` stack never
+                     leaves VMEM registers — the kernel writes only the
+                     final `(d,)` row), and brute's masked mean.
+
+No `(n, d)` intermediate is ever materialized, so no 11 -> 16 row padding
+is ever paid; the pipeline touches the stack twice total (Gram pass +
+average pass) instead of one padded write + two padded reads, and its cost
+stays flat in d.
+
+Semantics are pinned to `ops/_common.py` bit for bit on the `(n, n)`
+geometry: non-finite values poison their Gram entries, which the shared
+`sanitize_inf` downstream maps to +inf distances; stable-sort
+tie-breaking lives in the unchanged selection code; the averaging kernels
+reproduce `weighted_rows_mean`'s non-finite contract (unselected
+non-finite rows excluded, selected non-finite entries -> NaN at exactly
+their coordinates) by computing its masked form unconditionally — when
+every value is finite the masked form IS the fast form, operand for
+operand, so no `lax.cond` is needed inside the kernel.
+
+Dispatch mirrors `ops/pallas_sort.py`: automatic on TPU for f32 stacks
+with n <= MAX_ROWS, `BMT_NO_PALLAS=1` kill switch, the
+`pallas_sort.disabled()` trace context honored (auto-partitioned multi
+-device traces and non-TPU `--device-gar` hops must not see Mosaic
+kernels), `BMT_PALLAS_INTERPRET=1` for off-TPU kernel-body testing, and a
+jnp fallback at every call site. `tests/test_pallas.py` pins the kernels
+against the jnp oracles in interpret mode, NaN rows and distance ties
+included.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from byzantinemomentum_tpu.ops import pallas_sort
+
+__all__ = ["supported", "sq_gram", "weighted_rows_mean",
+           "selected_median_mean", "masked_rows_mean"]
+
+# Row-count cap, as `pallas_sort.MAX_ROWS`: beyond it the resident (n, n)
+# Gram block and the per-row unrolled averaging stop being VMEM-friendly,
+# and the jnp path is taken instead
+MAX_ROWS = 64
+
+# f32 only: distance orderings feed selection decisions, and the pinned
+# semantics (`ops/_common.pairwise_distances` uses precision=HIGHEST) are
+# an f32 contract — the engine's GAR space is f32 even under bf16-mixed
+# compute (bf16 stacks take the jnp path)
+_SUPPORTED_DTYPES = (jnp.float32,)
+
+
+def supported(g, interpret=False):
+    """Whether the fused pipeline applies to this operand (trace-time).
+
+    Shares `pallas_sort`'s kill switches: the `BMT_NO_PALLAS=1`
+    environment switch and the `pallas_sort.disabled()` trace context
+    (multi-device auto-partitioned traces, non-TPU `--device-gar` hops),
+    so every existing "no Mosaic here" site disables this module too.
+    """
+    if not pallas_sort.supported(g, interpret=interpret):
+        return False
+    return g.dtype in _SUPPORTED_DTYPES and g.shape[0] <= MAX_ROWS
+
+
+def _tile(n, buffers, d, interp):
+    """Column-tile width (`pallas_sort._tile_for` budget). In interpret
+    mode the tile clamps to d: a padded wider block would reduce over
+    extra zero columns, and the different accumulation-tree shape breaks
+    the bit-equality with the jnp reference that the oracle tests (and
+    the diagnostics aux) are pinned to. Compiled Mosaic keeps the aligned
+    width — the final grid block is partial there, which Mosaic clips —
+    because 1-D output blocks must stay divisible by the minor tiling."""
+    tile = pallas_sort._tile_for(n, buffers, 4)  # f32 itemsize
+    return min(tile, d) if interp else tile
+
+
+# --------------------------------------------------------------------------- #
+# One-pass pairwise Gram
+
+def _gram_kernel(d, tile, in_ref, out_ref):
+    i = pl.program_id(0)
+    x = in_ref[...]
+    if d % tile:
+        # The final block runs past d; Pallas pads the operand with
+        # unspecified bytes, which would corrupt the accumulation (and a
+        # NaN pad would survive a multiply-by-zero) — select them to 0,
+        # which is additive identity for the dot below
+        cols = (jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+                + i * tile)
+        x = jnp.where(cols < d, x, 0.0)
+    # precision=HIGHEST keeps the f32 accumulation of the jnp reference
+    # (`ops._common.pairwise_distances`) — selection orderings and the
+    # diagnostics aux must match it bit for bit
+    part = jax.lax.dot_general(x, x, (((1,), (1,)), ((), ())),
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        out_ref[...] = out_ref[...] + part
+
+
+def sq_gram(g, *, interpret=False):
+    """`g @ g.T` (f32[n, d] -> f32[n, n]) in ONE streamed read of `g`.
+
+    The `(n, n)` output block is grid-resident (constant index map), so
+    each d-tile's partial dot accumulates in VMEM and only the final tiny
+    result reaches HBM. Non-finite rows poison their Gram entries exactly
+    as the jnp matmul does (NaN/inf propagate through the dot), which the
+    shared distance post-processing maps to +inf.
+    """
+    n, d = g.shape
+    interp = interpret or pallas_sort.interpret_mode()
+    tile = _tile(n, 3, d, interp)
+    grid = (pl.cdiv(d, tile),)
+    return pl.pallas_call(
+        functools.partial(_gram_kernel, d, tile),
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+        grid=grid,
+        in_specs=[pl.BlockSpec((n, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((n, n), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interp)(g)
+
+
+# --------------------------------------------------------------------------- #
+# Streamed selected-row averages
+
+def _select_rows(w, g):
+    """`w @ g` with `ops._common.weighted_rows_mean`'s non-finite contract,
+    on in-VMEM blocks: the masked form computed unconditionally (identical
+    to the fast matmul when everything is finite — `where` passes `g`
+    through untouched and no `bad` flag fires)."""
+    finite = jnp.isfinite(g)
+    gz = jnp.where(finite, g, 0.0)
+    out = jax.lax.dot_general(w, gz, (((1,), (0,)), ((), ())),
+                              precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32)
+    sel = (w > 0).astype(jnp.float32)
+    nonfin = (~finite).astype(jnp.float32)
+    bad = jax.lax.dot_general(sel, nonfin, (((1,), (0,)), ((), ())),
+                              precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32) > 0
+    return jnp.where(bad, jnp.nan, out)
+
+
+def _wmean_kernel(in_ref, w_ref, out_ref):
+    out_ref[...] = _select_rows(w_ref[...], in_ref[...])
+
+
+def _call_rowavg(kernel, g, w, out_rows, *, buffers, interpret):
+    """Shared pallas_call wrapper for the averaging kernels: grid over
+    d-tiles of `g: (n, d)`, a tiny resident `(r, n)` weight operand, and a
+    `(out_rows, d)` or `(d,)` output."""
+    n, d = g.shape
+    interp = interpret or pallas_sort.interpret_mode()
+    tile = _tile(n, buffers, d, interp)
+    grid = (pl.cdiv(d, tile),)
+    in_specs = [
+        pl.BlockSpec((n, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec(w.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    if out_rows is None:
+        out_shape = jax.ShapeDtypeStruct((d,), jnp.float32)
+        out_spec = pl.BlockSpec((tile,), lambda i: (i,),
+                                memory_space=pltpu.VMEM)
+    else:
+        out_shape = jax.ShapeDtypeStruct((out_rows, d), jnp.float32)
+        out_spec = pl.BlockSpec((out_rows, tile), lambda i: (0, i),
+                                memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel, out_shape=out_shape, grid=grid,
+        in_specs=in_specs, out_specs=out_spec,
+        interpret=interp)(g, w)
+
+
+def weighted_rows_mean(w, g, *, interpret=False):
+    """`ops._common.weighted_rows_mean(w, g)` as one streamed read of `g`
+    (krum's selected-row average, the masked-quorum krum variant, bulyan
+    callers that need the stage-1 stack itself). `w: f32[n] | f32[r, n]`."""
+    squeeze = w.ndim == 1
+    W = w[None, :] if squeeze else w
+    out = _call_rowavg(_wmean_kernel, g, W.astype(jnp.float32),
+                       W.shape[0], buffers=4, interpret=interpret)
+    return out[0] if squeeze else out
+
+
+def _bulyan_tail_kernel(m2, in_ref, w_ref, out_ref):
+    """Bulyan stages 1+2 fused: the `(rounds, tile)` selection stack is
+    computed in VMEM and consumed by the averaged median immediately —
+    only the final `(tile,)` row is written."""
+    sel = _select_rows(w_ref[...], in_ref[...])
+    rounds = sel.shape[0]
+    rows = [sel[i, :] for i in range(rounds)]
+    med = pallas_sort.sort_values(rows)[(rounds - 1) // 2]
+    if m2 == 1:
+        # `ops._common.averaged_median`'s m == 1 shortcut: the closest
+        # value to the median IS the median
+        out_ref[...] = med
+    else:
+        out_ref[...] = pallas_sort.closest_mean_values(rows, med, m2)
+
+
+def selected_median_mean(W, g, m2, *, interpret=False):
+    """Bulyan over Multi-Krum's d-space tail in ONE streamed read of `g`:
+    the stage-1 averages (`W: f32[rounds, n]` from
+    `ops/bulyan.py::selection_weights`) and the stage-2 averaged median
+    with static `m2 = rounds - 2 f`, without materializing the
+    `(rounds, d)` stack (`ops._common.averaged_median` semantics, NaN
+    overflow included)."""
+    kernel = functools.partial(_bulyan_tail_kernel, m2)
+    # The stack, its deviations and the sorting network live per-tile in
+    # VMEM: ~3 extra row sets beyond the input block
+    return _call_rowavg(kernel, g, W.astype(jnp.float32), None,
+                        buffers=8, interpret=interpret)
+
+
+def _masked_mean_kernel(k, in_ref, m_ref, out_ref):
+    g = in_ref[...]
+    keep = m_ref[...][0] > 0
+    kept = jnp.where(keep[:, None], g, 0.0)
+    out_ref[...] = jnp.sum(kept, axis=0) / k
+
+
+def masked_rows_mean(mask, g, k, *, interpret=False):
+    """Brute's subset mean in one streamed read:
+    `sum(where(mask[:, None], g, 0), axis=0) / k` — the exact
+    `ops/brute.py` contract (excluded non-finite rows zeroed; a selected
+    non-finite entry propagates through the sum as the jnp path does,
+    NOT normalized to NaN)."""
+    w = mask.astype(jnp.float32)[None, :]
+    return _call_rowavg(functools.partial(_masked_mean_kernel, k), g, w,
+                        None, buffers=4, interpret=interpret)

@@ -32,7 +32,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["supported", "disabled", "colsort", "lower_median",
-           "trimmed_mean", "closest_mean"]
+           "trimmed_mean", "closest_mean", "sort_values",
+           "closest_mean_values"]
 
 # Row counts beyond this fall back to XLA sort (network size grows
 # O(n log^2 n) and VMEM holds fewer columns per block)
@@ -110,17 +111,25 @@ def _batcher_pairs(n):
     return tuple(pairs)
 
 
-def _sorted_rows(in_ref):
-    """Load the block's rows and run the sorting network (NaN-last order,
-    matching `jnp.sort`)."""
-    n = in_ref.shape[0]
-    rows = [in_ref[i, :] for i in range(n)]
-    for i, j in _batcher_pairs(n):
+def sort_values(rows):
+    """Run the Batcher network over a list of equal-shape row values
+    (NaN-last order, matching `jnp.sort`); returns the sorted list.
+    Shared with the fused GAR pipeline (`ops/pallas_gar.py`), whose
+    bulyan tail sorts in-VMEM stage-1 averages that never came from a
+    ref."""
+    rows = list(rows)
+    for i, j in _batcher_pairs(len(rows)):
         a, b = rows[i], rows[j]
         swap = (b < a) | (jnp.isnan(a) & ~jnp.isnan(b))
         rows[i] = jnp.where(swap, b, a)
         rows[j] = jnp.where(swap, a, b)
     return rows
+
+
+def _sorted_rows(in_ref):
+    """Load the block's rows and run the sorting network (NaN-last order,
+    matching `jnp.sort`)."""
+    return sort_values([in_ref[i, :] for i in range(in_ref.shape[0])])
 
 
 def _tile_for(n, buffers, itemsize):
@@ -210,19 +219,13 @@ def trimmed_mean(g, f, *, interpret=False):
                       buffers=4, interpret=interpret)
 
 
-def _closest_kernel(m, in_ref, c_ref, out_ref):
-    n = in_ref.shape[0]
-    c = c_ref[:]
-    g_rows = [in_ref[i, :] for i in range(n)]
+def closest_mean_values(g_rows, c, m):
+    """Mean of the `m` row values closest to center `c`, over a list of
+    equal-shape rows (`ops._common.closest_mean` semantics, NaN overflow
+    included). Shared with `ops/pallas_gar.py`'s fused bulyan tail."""
     devs = [jnp.abs(r - c) for r in g_rows]
     # Sort the deviations (values only) to find the m-th smallest
-    rows = list(devs)
-    for i, j in _batcher_pairs(n):
-        a, b = rows[i], rows[j]
-        swap = (b < a) | (jnp.isnan(a) & ~jnp.isnan(b))
-        rows[i] = jnp.where(swap, b, a)
-        rows[j] = jnp.where(swap, a, b)
-    thresh = rows[m - 1]
+    thresh = sort_values(devs)[m - 1]
     # Strictly-below plus index-order ties at the threshold — exactly the
     # stable-argsort selection (see `ops._common.closest_mean`)
     need = jnp.zeros_like(thresh)
@@ -237,7 +240,13 @@ def _closest_kernel(m, in_ref, c_ref, out_ref):
         take = (dev < thresh) | (eq & (cum <= need))
         acc = acc + jnp.where(take, g_r, jnp.zeros_like(g_r))
     out = acc / m
-    out_ref[:] = jnp.where(jnp.isnan(thresh), jnp.nan, out)
+    return jnp.where(jnp.isnan(thresh), jnp.nan, out)
+
+
+def _closest_kernel(m, in_ref, c_ref, out_ref):
+    n = in_ref.shape[0]
+    g_rows = [in_ref[i, :] for i in range(n)]
+    out_ref[:] = closest_mean_values(g_rows, c_ref[:], m)
 
 
 def closest_mean(g, c, m, *, interpret=False):

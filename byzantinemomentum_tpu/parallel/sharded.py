@@ -68,21 +68,28 @@ def pairwise_distances_sharded(g, mesh):
 
 def _psum_pairwise(g_local):
     """Shard-local body of the distributed pairwise-distance kernel: the
-    partial Gram on this d-slice (one MXU matmul), psum over the model axis;
-    row norms read off the summed Gram's diagonal. (Single source of truth —
-    the semantics must match `ops._common.pairwise_distances`.)"""
-    # precision=HIGHEST as in `ops._common.pairwise_distances`: TPU matmuls
-    # default to bf16-decomposed passes, and these distances feed selection
-    # orderings that must match the single-device path
-    gram = jax.lax.psum(
-        jnp.matmul(g_local, g_local.T, precision=jax.lax.Precision.HIGHEST),
-        MODEL)
-    sq = jnp.diagonal(gram)
-    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * gram, 0.0)
-    d2 = jnp.where(jnp.isfinite(d2), d2, jnp.inf)
-    n = g_local.shape[0]
-    d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
-    return jnp.sqrt(d2)
+    partial Gram on this d-slice (one streamed Pallas pass where supported,
+    else one MXU matmul), psum over the model axis; the shared
+    `(n, n)` post-processing (`ops._common.distances_from_sq_gram`) keeps
+    the semantics identical to the single-device path."""
+    from byzantinemomentum_tpu.ops import _common, pallas_gar
+
+    with pallas_sort.allowed():
+        if pallas_gar.supported(g_local):
+            # Fused tier (`ops/pallas_gar.py`): the partial Gram
+            # accumulates in VMEM over d-tiles of this shard's slice —
+            # legal here because shard_map operands are manual per-device
+            # shards even while the outer trace holds `disabled()`
+            part = pallas_gar.sq_gram(g_local)
+        else:
+            # precision=HIGHEST as in `ops._common.pairwise_distances`:
+            # TPU matmuls default to bf16-decomposed passes, and these
+            # distances feed selection orderings that must match the
+            # single-device path
+            part = jnp.matmul(g_local, g_local.T,
+                              precision=jax.lax.Precision.HIGHEST)
+    gram = jax.lax.psum(part, MODEL)
+    return _common.distances_from_sq_gram(gram)
 
 
 def shard_gar(gar, mesh, *, f, **kwargs):
@@ -119,12 +126,17 @@ def shard_gar(gar, mesh, *, f, **kwargs):
             w = krum_mod.selection_weights(
                 dist, f, kwargs.get("m")).astype(g_local.dtype)
             # The psum'd distances certify WHOLE rows finite, which covers
-            # this shard's columns
-            return _common.weighted_rows_mean(
-                w, g_local, all_finite=_common.all_finite_from_dist(dist))
+            # this shard's columns; under `allowed()` the averaging takes
+            # the streamed fused kernel per shard (`ops/pallas_gar.py`)
+            with pallas_sort.allowed():
+                return _common.weighted_rows_mean(
+                    w, g_local,
+                    all_finite=_common.all_finite_from_dist(dist))
 
+        # check_vma=False: the Pallas out_shapes inside carry no
+        # varying-mesh-axes annotation
         return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
-                         out_specs=P(MODEL))
+                         out_specs=P(MODEL), check_vma=False)
 
     if gar.name in ("bulyan", "native-bulyan"):
         from byzantinemomentum_tpu.ops import _common, bulyan as bulyan_mod
@@ -136,12 +148,19 @@ def shard_gar(gar, mesh, *, f, **kwargs):
             # (rounds, n) @ (n, d_shard) matmul
             dist = _psum_pairwise(g_local)
             W = bulyan_mod.selection_weights(dist, f, kwargs.get("m"))
-            sel = _common.weighted_rows_mean(
-                W.astype(g_local.dtype), g_local,
-                all_finite=_common.all_finite_from_dist(dist))
-            # Stage 2 (reference `bulyan.py:77-84`): coordinate-wise averaged
-            # median — d-local, Pallas-fused where supported
             with pallas_sort.allowed():
+                from byzantinemomentum_tpu.ops import pallas_gar
+                if pallas_gar.supported(g_local):
+                    # Fully-fused d-local tail: stage-1 averages and the
+                    # stage-2 averaged median in one streamed read of the
+                    # shard slice (`ops/pallas_gar.py`)
+                    return pallas_gar.selected_median_mean(
+                        W, g_local, W.shape[0] - 2 * f)
+                sel = _common.weighted_rows_mean(
+                    W.astype(g_local.dtype), g_local,
+                    all_finite=_common.all_finite_from_dist(dist))
+                # Stage 2 (reference `bulyan.py:77-84`): coordinate-wise
+                # averaged median — d-local, Pallas-fused where supported
                 return _common.averaged_median(sel, sel.shape[0] - 2 * f)
 
         # check_vma=False: the Pallas out_shapes inside carry no
@@ -159,6 +178,10 @@ def shard_gar(gar, mesh, *, f, **kwargs):
             n = g_local.shape[0]
             dist = _psum_pairwise(g_local)
             mask = brute_mod.best_subset_mask_from_dist(dist, f)
+            with pallas_sort.allowed():
+                from byzantinemomentum_tpu.ops import pallas_gar
+                if pallas_gar.supported(g_local):
+                    return pallas_gar.masked_rows_mean(mask, g_local, n - f)
             kept = jnp.where(mask[:, None], g_local, 0)
             return jnp.sum(kept, axis=0) / (n - f)
 

@@ -9,6 +9,17 @@ as the `current` row — into one table of steps/s per cell per round, so
 "did the r5 packing win survive r7?" is one command instead of archaeology
 over five JSON tails.
 
+Alongside steps/s, the table renders a `gar ms/step` column out of each
+round's phase-attribution artifact (`ATTRIB_r*.json` at the repo root —
+the per-round copy of a run's `attribution.json` (obs/attrib), with the
+working tree's `attribution.json` as `current`): the sum of the
+`gar`/`gar_masked`/`gar_diag` phase budgets, i.e. the quantity the fused
+Pallas GAR pipeline (PR 7) moves and the one a regression would regrow.
+A round without an artifact shows `-`; an artifact from a non-TPU
+backend renders with its backend noted, since phase budgets are only
+comparable within one backend (the `bench_compare.py` attribution-gate
+discipline).
+
 Incomparability discipline (as `bench_compare.py`): a crashed round
 (`rc != 0`, no parsed payload — e.g. the BENCH_r05 down-tunnel crash), a
 `cpu-fallback` round, or a legacy artifact whose payload predates the
@@ -31,29 +42,78 @@ sys.path.insert(0, str(ROOT / "scripts"))
 
 from bench_compare import load_artifact, _rates  # noqa: E402
 
-__all__ = ["collect_history", "render_table", "main"]
+__all__ = ["collect_history", "render_table", "main", "GAR_COLUMN"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
+# The phases whose per-step budgets sum into the `gar ms/step` column —
+# the engine's aggregation scopes (`engine/step.py` named_scope names)
+_GAR_PHASES = ("gar", "gar_masked", "gar_diag")
+GAR_COLUMN = "gar ms/step"
+
+
+def _gar_ms(root, label):
+    """`(ms_per_step | None, backend | None)` for one round's
+    phase-attribution artifact: `ATTRIB_r*.json` per round,
+    `attribution.json` for the working tree's `current` row."""
+    name = ("attribution.json" if label == "current"
+            else f"ATTRIB_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None, None
+    if not isinstance(payload, dict) or payload.get("kind") != "attribution":
+        return None, None
+    phases = payload.get("phases") or {}
+    total, seen = 0.0, False
+    for phase in _GAR_PHASES:
+        entry = phases.get(phase)
+        if isinstance(entry, dict) and isinstance(entry.get("ms"),
+                                                  (int, float)):
+            total += float(entry["ms"])
+            seen = True
+    return (total if seen else None), payload.get("backend")
+
 
 def collect_history(root=ROOT):
-    """[(label, rates | None, reason | None)] over every round artifact
-    (sorted by round number) plus the working tree's `BENCH_cells.json`
-    as `current` when present. `rates` is `bench_compare._rates`' flat
-    `{cell: steps/s}` view; None marks an INCOMPARABLE round with its
-    human-readable reason."""
+    """[(label, rates | None, reason | None, gar)] over every round
+    artifact (sorted by round number) plus the working tree's
+    `BENCH_cells.json` as `current` when present. `rates` is
+    `bench_compare._rates`' flat `{cell: steps/s}` view; None marks an
+    INCOMPARABLE round with its human-readable reason. `gar` is
+    `(ms_per_step, backend) | None` from the round's attribution artifact
+    (present even for INCOMPARABLE steps/s rounds — the instruments are
+    independent)."""
     root = pathlib.Path(root)
     rows = []
-    rounds = []
+    rounds = {}
     for path in root.glob("BENCH_r*.json"):
         m = _ROUND.search(path.name)
         if m:
-            rounds.append((int(m.group(1)), path))
-    for number, path in sorted(rounds):
-        rows.append((f"r{number:02d}",) + _load_rates(path))
+            rounds[int(m.group(1))] = path
+    # Rounds with only an attribution artifact (e.g. a round whose bench
+    # run never happened off-TPU) still get a row: the two instruments
+    # are independent and the gar column must not wait for steps/s
+    for path in root.glob("ATTRIB_r*.json"):
+        m = re.search(r"ATTRIB_r(\d+)\.json$", path.name)
+        if m:
+            rounds.setdefault(int(m.group(1)), None)
+    labels = [f"r{number:02d}" for number in sorted(rounds)]
+    paths = [rounds[number] for number in sorted(rounds)]
     current = root / "BENCH_cells.json"
-    if current.is_file():
-        rows.append(("current",) + _load_rates(current))
+    if current.is_file() or (root / "attribution.json").is_file():
+        labels.append("current")
+        paths.append(current if current.is_file() else None)
+    for label, path in zip(labels, paths):
+        if path is None:
+            rates, reason = None, (f"{label}: no benchmark artifact "
+                                   f"(attribution only)")
+        else:
+            rates, reason = _load_rates(path)
+        ms, backend = _gar_ms(root, label)
+        gar = None if ms is None else (ms, backend)
+        rows.append((label, rates, reason, gar))
     return rows
 
 
@@ -74,32 +134,46 @@ def _load_rates(path):
 def render_table(history):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
-    `-`, e.g. the pre-`cells` legacy artifacts)."""
+    `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
+    attribution column when any round carries an artifact."""
     columns = []
-    for _, rates, _ in history:
+    for _, rates, _, _ in history:
         for name in rates or ():
             if name not in columns:
                 columns.append(name)
-    if not columns:
+    any_gar = any(gar is not None for _, _, _, gar in history)
+    if not columns and not any_gar:
         lines = ["bench_history: no comparable rounds"]
-        for label, _, reason in history:
+        for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
         return "\n".join(lines)
-    label_w = max(len("round"), max(len(label) for label, _, _ in history))
+    if any_gar:
+        columns = columns + [GAR_COLUMN]
+    label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
                        + [f"{c:>{w}}" for c, w in zip(columns, widths)])
     lines = [header]
     notes = []
-    for label, rates, reason in history:
+    for label, rates, reason, gar in history:
         if rates is None:
-            lines.append(f"{label:<{label_w}}  "
-                         + "  ".join(f"{'-':>{w}}" for w in widths))
             notes.append(f"  {label}: INCOMPARABLE — {reason}")
-            continue
-        cells = [(f"{rates[c]:>{w}.3f}" if c in rates else f"{'-':>{w}}")
-                 for c, w in zip(columns, widths)]
-        lines.append(f"{label:<{label_w}}  " + "  ".join(cells))
+        if gar is not None and gar[1] not in (None, "tpu"):
+            # Phase budgets only compare within one backend — flag the
+            # odd ones out instead of letting a CPU artifact masquerade
+            # as a device regression/win
+            notes.append(f"  {label}: gar ms/step from a "
+                         f"backend={gar[1]} attribution artifact")
+
+        def cell(c, w):
+            if c == GAR_COLUMN:
+                return f"{gar[0]:>{w}.3f}" if gar is not None else f"{'-':>{w}}"
+            if rates is not None and c in rates:
+                return f"{rates[c]:>{w}.3f}"
+            return f"{'-':>{w}}"
+
+        lines.append(f"{label:<{label_w}}  "
+                     + "  ".join(cell(c, w) for c, w in zip(columns, widths)))
     if notes:
         lines.append("")
         lines.extend(notes)
@@ -125,8 +199,10 @@ def main(argv=None):
         return 0
     if args.json:
         print(json.dumps([
-            {"round": label, "rates": rates, "reason": reason}
-            for label, rates, reason in history], indent=2))
+            {"round": label, "rates": rates, "reason": reason,
+             "gar_ms_per_step": None if gar is None else gar[0],
+             "gar_backend": None if gar is None else gar[1]}
+            for label, rates, reason, gar in history], indent=2))
         return 0
     print(render_table(history))
     return 0

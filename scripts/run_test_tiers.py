@@ -37,11 +37,12 @@ from byzantinemomentum_tpu.obs import Telemetry  # noqa: E402
 _TOKEN = re.compile(r"(\d+) (passed|failed|skipped|error(?:s)?)")
 
 
-def run_pytest(args):
+def run_pytest(args, env=None):
     start = time.monotonic()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "--tb=line", *args],
-        cwd=ROOT, capture_output=True, text=True)
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, **env} if env else None)
     elapsed = time.monotonic() - start
     counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
     for line in reversed(proc.stdout.splitlines()):
@@ -136,6 +137,22 @@ def main():
     telemetry.counter("tests_failed", default["failed"])
     print(f"  {default}", flush=True)
 
+    # No-Pallas tier (PR 7): the kernel-adjacent files rerun with
+    # `BMT_NO_PALLAS=1`, so CI exercises BOTH the fused kernels (the
+    # interpret-mode tests in the default tier force the kernel paths)
+    # and the jnp fallback paths every run — before this tier the
+    # fallbacks were only covered incidentally off-TPU
+    print("no-pallas tier ...", flush=True)
+    with telemetry.span("tier_nopallas"):
+        nopallas = run_pytest(
+            ["tests/test_pallas.py", "tests/test_gars.py",
+             "tests/test_diag.py", "tests/test_faults.py"],
+            env={"BMT_NO_PALLAS": "1"})
+    telemetry.event("tier_result", tier="nopallas", **nopallas)
+    telemetry.counter("tests_passed", nopallas["passed"])
+    telemetry.counter("tests_failed", nopallas["failed"])
+    print(f"  {nopallas}", flush=True)
+
     shards = {}
     for path in sorted((ROOT / "tests").glob("test_*.py")):
         print(f"slow tier: {path.name} ...", flush=True)
@@ -165,6 +182,7 @@ def main():
         "bench_compare": bench_compare,
         "lint_tier": lint_tier,
         "default_tier": default,
+        "nopallas_tier": nopallas,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
         "telemetry": telemetry.path.name,
@@ -173,6 +191,8 @@ def main():
                       and obs_selfcheck["returncode"] == 0
                       and bench_compare["returncode"] == 0
                       and lint_tier["returncode"] == 0
+                      and nopallas["failed"] == 0
+                      and nopallas["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }

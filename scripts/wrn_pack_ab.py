@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""A/B the WRN-28-10 packing escapes on device — prints ONE JSON line.
+
+PERF_NOTES.md r5: the WRN cell's honest phase sits ~3.7x off its MXU
+floor because S = 9 admits no worker packing (no divisor P of 9 makes
+P*160 or P*320 lane-aligned). Two escapes exist, with opposite trades:
+
+* `worker-pad`  (`BMT_WORKER_PAD=12`, engine-level): pad the sampled
+  stack to S' = 12 so the existing worker packing engages (P = 4/2 for
+  C = 160/320) — pays the 3 dummy workers' compute PLUS the
+  block-diagonal zero FLOPs.
+* `batch-pack`  (`BMT_BATCH_PACK=1`, `models/core.py`): concatenate Q
+  batch items on the channel axis (Q = 4/2) — no dummy compute, the same
+  zero-FLOP trade on the packed convs, but the sublane-resident batch
+  axis shrinks B -> B/Q (pads back up toward the 8/16-row tile).
+
+Whichever the chained device-time harness prefers is the one to set for
+the cell (neither is a default until a device run lands the verdict —
+this script IS that verdict's instrument). Measurement mechanics reuse
+`bench.py::_run_mode` (depth-2 pipelined dispatch, finite-defense
+assertions, logical-FLOP MFU), so steps/s here are directly comparable
+to the BENCH cell numbers.
+
+Usage:
+  python scripts/wrn_pack_ab.py [--modes baseline,worker-pad,batch-pack]
+                                [--dtypes f32,bf16] [--smoke] [--out F]
+
+`--smoke` shrinks the cell (tiny WRN, few steps) so CI can prove the
+harness end to end off-TPU; the JSON carries `"backend"`/`"smoke"`
+markers and the INCOMPARABLE discipline applies downstream.
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# The escape knobs are read at TRACE time — each mode below sets its
+# environment before the engine builds, which is why every measurement
+# constructs a fresh engine via bench._run_mode.
+MODES = {
+    "baseline": {},
+    "worker-pad": {"BMT_WORKER_PAD": "12"},
+    "batch-pack": {"BMT_BATCH_PACK": "1"},
+}
+_KNOBS = ("BMT_WORKER_PAD", "BMT_BATCH_PACK")
+
+
+def _cell_kwargs(smoke):
+    if smoke:
+        return dict(gar_name="bulyan", n=11, f=2,
+                    model="wide_resnet-Wide_ResNet",
+                    model_args={"depth": 10, "widen_factor": 1,
+                                "dropout_rate": 0.3, "num_classes": 10},
+                    loss="crossentropy", nesterov=True,
+                    windows=1, min_measure_s=0.1)
+    return dict(gar_name="bulyan", n=11, f=2,
+                model="wide_resnet-Wide_ResNet",
+                model_args={"depth": 28, "widen_factor": 10,
+                            "dropout_rate": 0.3, "num_classes": 10},
+                loss="crossentropy", nesterov=True,
+                windows=1, min_measure_s=2.5)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="wrn_pack_ab",
+        description="Chained device-time A/B of the WRN packing escapes")
+    parser.add_argument("--modes", default="baseline,worker-pad,batch-pack",
+                        help="comma list from: " + ",".join(MODES))
+    parser.add_argument("--dtypes", default="f32,bf16",
+                        help="comma list from: f32,bf16")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny cell, short windows (CI harness proof)")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON line to this path")
+    args = parser.parse_args(argv)
+
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    unknown = [m for m in modes if m not in MODES]
+    if unknown:
+        parser.error(f"unknown mode(s) {unknown}; choose from {list(MODES)}")
+    dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()]
+    if not set(dtypes) <= {"f32", "bf16"}:
+        parser.error("dtypes must be from: f32,bf16")
+
+    import bench  # noqa: E402  (repo-root module; sys.path above)
+    from byzantinemomentum_tpu import data  # noqa: E402
+    from byzantinemomentum_tpu.data.device import DeviceData  # noqa: E402
+
+    backend = bench._ensure_backend()
+    if args.smoke:
+        # The smoke proves the harness end to end, not the numbers: on a
+        # 1-core CI host the real measurement loop (M=20 programs, 400
+        # steps) would take tens of minutes per mode
+        bench.STEPS_PER_PROGRAM = 2
+        bench.WARMUP_STEPS = 1
+        bench.MAX_MEASURE_STEPS = 4
+    batch = 4 if args.smoke else 20
+    trainset, _ = data.make_datasets("cifar10", batch, batch, seed=0)
+    train_data = DeviceData(trainset)
+    cell = _cell_kwargs(args.smoke)
+
+    results = {}
+    saved = {k: os.environ.get(k) for k in _KNOBS}
+    try:
+        for mode in modes:
+            for knob in _KNOBS:
+                os.environ.pop(knob, None)
+            os.environ.update(MODES[mode])
+            per_dtype = {}
+            for dtype in dtypes:
+                compute = None if dtype == "f32" else "bfloat16"
+                sps, flops = bench._run_mode(compute, train_data, **cell)
+                per_dtype[dtype] = {"steps_per_sec": sps,
+                                    "flops_per_step": flops}
+            results[mode] = per_dtype
+    finally:
+        for knob, value in saved.items():
+            if value is None:
+                os.environ.pop(knob, None)
+            else:
+                os.environ[knob] = value
+
+    best = max(
+        ((mode, dtype, v["steps_per_sec"])
+         for mode, per in results.items() for dtype, v in per.items()),
+        key=lambda t: t[2])
+    payload = {
+        "kind": "wrn_pack_ab",
+        "backend": backend,
+        "smoke": bool(args.smoke),
+        "cell": {k: cell[k] for k in ("gar_name", "n", "f")}
+        | {"batch": batch, "model_args": cell["model_args"]},
+        "results": results,
+        "preferred": {"mode": best[0], "dtype": best[1],
+                      "steps_per_sec": best[2]},
+    }
+    line = json.dumps(payload)
+    if args.out:
+        pathlib.Path(args.out).write_text(line + "\n")
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

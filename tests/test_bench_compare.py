@@ -7,6 +7,8 @@ import json
 import pathlib
 import sys
 
+import pytest
+
 SCRIPT = (pathlib.Path(__file__).resolve().parent.parent
           / "scripts" / "bench_compare.py")
 _spec = importlib.util.spec_from_file_location("bench_compare", SCRIPT)
@@ -208,11 +210,73 @@ def test_bench_history_table_and_incomparable_rounds(tmp_path, capsys):
     r03_line = [l for l in out.splitlines() if l.startswith("r03")][0]
     assert set(r03_line.split()[1:]) == {"-"}
     history = bench_history.collect_history(tmp_path)
-    assert [label for label, _, _ in history] == [
+    assert [label for label, *_ in history] == [
         "r01", "r02", "r03", "r04", "current"]
     assert history[2][1] is None and "rc=1" in history[2][2]
     assert history[3][1] is None and "cpu" in history[3][2].lower()
     assert history[4][1]["krum.steps_per_sec_bf16_mixed"] == 55.0
+
+
+def _attribution_artifact(path, gar_ms, masked_ms=0.0, backend="tpu"):
+    path.write_text(json.dumps({
+        "kind": "attribution", "backend": backend, "steps": 20,
+        "phases": {"honest": {"ms": 10.0, "ops": 5},
+                   "gar": {"ms": gar_ms, "ops": 3},
+                   "gar_masked": {"ms": masked_ms, "ops": 1}},
+    }))
+
+
+def test_bench_history_gar_phase_column(tmp_path, capsys):
+    """The `gar ms/step` column renders from per-round ATTRIB_r*.json
+    artifacts (sum of the gar/gar_masked/gar_diag phase budgets) next to
+    steps/s; rounds without an artifact show `-`, non-TPU artifacts get a
+    backend note, and an attribution next to a CRASHED bench round still
+    renders (independent instruments)."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _artifact(tmp_path, "BENCH_r02.json", 12.0)
+    _artifact(tmp_path, "BENCH_r03.json", 0.0, rc=1, parsed=False)  # crash
+    _attribution_artifact(tmp_path / "ATTRIB_r02.json", 2.25, 0.25)
+    _attribution_artifact(tmp_path / "ATTRIB_r03.json", 3.0, backend="cpu")
+    (tmp_path / "BENCH_cells.json").write_text(json.dumps(
+        {"metric": "sim_steps_per_sec", "value": 13.0}))
+    _attribution_artifact(tmp_path / "attribution.json", 1.5)
+
+    history = bench_history.collect_history(tmp_path)
+    by_label = {label: gar for label, _, _, gar in history}
+    assert by_label["r01"] is None
+    assert by_label["r02"] == (2.5, "tpu")
+    assert by_label["r03"] == (3.0, "cpu")
+    assert by_label["current"] == (1.5, "tpu")
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert bench_history.GAR_COLUMN in out
+    r01 = [l for l in out.splitlines() if l.startswith("r01")][0]
+    assert r01.split()[-1] == "-"
+    r02 = [l for l in out.splitlines() if l.startswith("r02")][0]
+    assert r02.split()[-1] == "2.500"
+    # The crashed round renders its (independent) attribution number and
+    # the backend mismatch is flagged in the notes
+    r03 = [l for l in out.splitlines() if l.startswith("r03")][0]
+    assert r03.split()[-1] == "3.000"
+    assert "backend=cpu attribution" in out
+
+    rc = bench_history.main(["--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload[1]["gar_ms_per_step"] == 2.5
+    assert payload[0]["gar_ms_per_step"] is None
+
+
+def test_bench_history_gar_column_absent_without_artifacts(tmp_path, capsys):
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert bench_history.GAR_COLUMN not in out
 
 
 def test_bench_history_json_mode(tmp_path, capsys):
@@ -241,3 +305,33 @@ def test_bench_history_over_repo_artifacts(capsys):
     assert rc == 0
     assert "r05: INCOMPARABLE" in out
     assert "wrn28x10.steps_per_sec_bf16_mixed" in out
+
+
+# --------------------------------------------------------------------------- #
+# wrn_pack_ab: the packing-escape A/B harness
+
+
+@pytest.mark.slow
+def test_wrn_pack_ab_smoke(tmp_path, capsys):
+    """`--smoke` proves the harness end to end off-TPU: a JSON payload
+    with per-mode steps/s, the preferred pick, and the backend/smoke
+    markers the INCOMPARABLE discipline keys on."""
+    import importlib.util
+    import pathlib
+    import sys
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "wrn_pack_ab.py")
+    spec = importlib.util.spec_from_file_location("wrn_pack_ab", script)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("wrn_pack_ab", mod)
+    spec.loader.exec_module(mod)
+
+    out_path = tmp_path / "ab.json"
+    rc = mod.main(["--smoke", "--modes", "baseline", "--dtypes", "f32",
+                   "--out", str(out_path)])
+    assert rc == 0
+    payload = json.loads(out_path.read_text())
+    assert payload["kind"] == "wrn_pack_ab"
+    assert payload["smoke"] is True
+    assert payload["results"]["baseline"]["f32"]["steps_per_sec"] > 0
+    assert payload["preferred"]["mode"] == "baseline"

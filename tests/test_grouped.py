@@ -7,6 +7,8 @@ dropout draws are bit-identical by construction, so entire training
 trajectories must agree to float tolerance.
 """
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -204,3 +206,131 @@ def test_apply_grouped_matches_vmap_when_packing_engages():
     np.testing.assert_allclose(np.asarray(out_g, np.float32),
                                np.asarray(out_v, np.float32),
                                rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------------------------------------- #
+# WRN packing escapes (PR 7): batch-slot packing (`BMT_BATCH_PACK`,
+# models/core.py) and engine-level worker padding (`BMT_WORKER_PAD`,
+# engine/step.py) — the two ROADMAP escapes for worker counts that admit
+# no packing P (WRN's S = 9).
+
+
+def test_batch_packing_gate():
+    """`_batch_packing` is opt-in, never composes with worker packing,
+    and honors the cap / divisibility like `_worker_packing`."""
+    from byzantinemomentum_tpu.models.core import _batch_packing
+
+    assert _batch_packing(20, 9, 160) == 1  # off by default (env unset)
+    os.environ["BMT_BATCH_PACK"] = "1"
+    try:
+        assert _batch_packing(20, 9, 160) == 4   # 4*160 = 640, 20 % 4 == 0
+        assert _batch_packing(20, 9, 320) == 2   # 2*320 = 640
+        assert _batch_packing(20, 9, 640) == 1   # already lane-aligned
+        assert _batch_packing(20, 4, 64) == 1    # worker packing wins (P=2)
+        assert _batch_packing(6, 9, 160) == 1    # no Q <= 4 divides 6 works
+        os.environ["BMT_BATCH_PACK"] = "2"       # forced Q
+        assert _batch_packing(20, 9, 320) == 2
+        assert _batch_packing(20, 9, 160) == 1   # 2*160 misaligned: refuse
+    finally:
+        os.environ.pop("BMT_BATCH_PACK", None)
+
+
+def test_batch_slot_packing_matches_vmap(monkeypatch):
+    """Tiny WRN with `BMT_BATCH_PACK=1`: C=32 packs at Q=4 and C=64 at
+    Q=2 (with a 4 -> 2 repack transition), dropout draws the vmapped
+    path's exact masks, BN folds statistics across the slots — forward,
+    BN states and parameter gradients all match the unpacked path to
+    reduction rounding."""
+    S, B = 3, 8
+    model = models.build("wide_resnet-Wide_ResNet", depth=10, widen_factor=1,
+                         dropout_rate=0.25)
+    params, state = model.init(jax.random.PRNGKey(0))
+    params_s = stacked(params, S)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, 32, 32, 3))
+    keys = jax.random.split(jax.random.PRNGKey(2), S)
+
+    out_v, ns_v = jax.vmap(
+        lambda x, k: model.apply(params, state, x, train=True, rng=k))(
+            xs, keys)
+
+    def grad_fn(ps):
+        out, _ = model.apply_grouped(ps, state, xs, train=True, rng=keys)
+        return jnp.sum(out * 0.01)
+
+    g_plain = jax.grad(grad_fn)(params_s)
+    monkeypatch.setenv("BMT_BATCH_PACK", "1")
+    out_g, ns_g = model.apply_grouped(params_s, state, xs, train=True,
+                                      rng=keys)
+    g_packed = jax.grad(grad_fn)(params_s)
+
+    np.testing.assert_allclose(np.asarray(out_g, np.float32),
+                               np.asarray(out_v, np.float32),
+                               rtol=2e-5, atol=2e-5)
+    for a, b in zip(jax.tree.leaves(ns_g), jax.tree.leaves(ns_v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_packed), jax.tree.leaves(g_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_worker_pad_rows_parsing(monkeypatch):
+    from byzantinemomentum_tpu.engine.step import _worker_pad_rows
+
+    monkeypatch.delenv("BMT_WORKER_PAD", raising=False)
+    assert _worker_pad_rows(9) == 0
+    monkeypatch.setenv("BMT_WORKER_PAD", "12")
+    assert _worker_pad_rows(9) == 3
+    assert _worker_pad_rows(12) == 0     # already there
+    assert _worker_pad_rows(20) == 0     # target below S: no-op
+    monkeypatch.setenv("BMT_WORKER_PAD", "99")
+    assert _worker_pad_rows(9) == 9      # clamped to 2S
+    monkeypatch.setenv("BMT_WORKER_PAD", "not-a-number")
+    assert _worker_pad_rows(9) == 0
+
+
+@pytest.mark.slow
+def test_worker_pad_trajectory_matches(monkeypatch):
+    """`BMT_WORKER_PAD=12` on a WRN-shaped cell (S = 9): the padded
+    grouped phase engages P = 4/2 worker packing on the dummy-extended
+    stack, and the kept rows' trajectory matches the unpadded run to
+    packing-reduction rounding (no dummy-row value feeds a kept row)."""
+    def build():
+        cfg = EngineConfig(
+            nb_workers=11, nb_decl_byz=2, nb_real_byz=2,
+            nb_for_study=1, nb_for_study_past=1,
+            momentum=0.9, momentum_at="update", nesterov=True,
+            gradient_clip=5.0)
+        model = models.build("wide_resnet-Wide_ResNet", depth=10,
+                             widen_factor=1, dropout_rate=0.3)
+        engine = build_engine(
+            cfg=cfg, model_def=model, loss=losses.Loss("crossentropy"),
+            criterion=losses.Criterion("top-k"),
+            defenses=[(ops.gars["bulyan"], 1.0, {})],
+            attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+        return cfg, engine
+
+    monkeypatch.delenv("BMT_WORKER_PAD", raising=False)
+    cfg, eng0 = build()
+    S = cfg.nb_sampled
+    assert S == 9
+    xs = jax.random.normal(jax.random.PRNGKey(5), (S, 4, 32, 32, 3))
+    ys = jax.random.randint(jax.random.PRNGKey(6), (S, 4), 0, 10)
+    st0, met0 = eng0.train_step(eng0.init(jax.random.PRNGKey(0)), xs, ys,
+                                jnp.float32(0.05))
+
+    monkeypatch.setenv("BMT_WORKER_PAD", "12")
+    _, eng1 = build()
+    st1, met1 = eng1.train_step(eng1.init(jax.random.PRNGKey(0)), xs, ys,
+                                jnp.float32(0.05))
+
+    np.testing.assert_allclose(np.asarray(st0.theta), np.asarray(st1.theta),
+                               rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(st0.net_state),
+                    jax.tree.leaves(st1.net_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    for name in ("Average loss", "Defense gradient norm"):
+        np.testing.assert_allclose(np.asarray(met0[name]),
+                                   np.asarray(met1[name]),
+                                   rtol=1e-4, atol=1e-5)

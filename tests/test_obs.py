@@ -265,6 +265,27 @@ def test_bench_backend_fallback(monkeypatch):
     assert calls["n"] == 2
 
 
+def test_bench_backend_fallback_at_dispatch(monkeypatch):
+    """The BENCH_r05 crash shape: `jax.devices()` answers (the old probe
+    passed) but the first dispatch — `device_put` resolving the default
+    backend via `local_devices()` — raises the UNAVAILABLE. The probe
+    must catch that path too and fall back tagged, not exit 1."""
+    import bench
+    calls = {"n": 0}
+
+    def flaky_device_put(x, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+                "backend setup/compile error (Unavailable).")
+        return x
+
+    monkeypatch.setattr(bench.jax, "device_put", flaky_device_put)
+    assert bench._ensure_backend() == "cpu-fallback"
+    assert calls["n"] == 2  # the retry probe dispatches again on CPU
+
+
 def test_bench_backend_default(monkeypatch):
     import bench
     monkeypatch.setattr(bench.jax, "devices", lambda *a, **k: ["cpu0"])

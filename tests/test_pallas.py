@@ -84,6 +84,9 @@ def test_closest_mean_nan_overflow():
 
 
 def test_supported_gate(monkeypatch):
+    # tier-agnostic: this test asserts both sides of the gate itself, so
+    # an outer BMT_NO_PALLAS tier must not pre-disable it
+    monkeypatch.delenv("BMT_NO_PALLAS", raising=False)
     g32 = jnp.zeros((8, 64), jnp.float32)
     assert pallas_sort.supported(g32, interpret=True)
     assert not pallas_sort.supported(jnp.zeros((80, 64)), interpret=True)
@@ -99,3 +102,217 @@ def test_bf16_kernels():
     got = np.asarray(pallas_sort.lower_median(g, interpret=True)
                      .astype(jnp.float32))
     np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------------------------------- #
+# Fused GAR pipeline (`ops/pallas_gar.py`): one-pass Gram + selection +
+# streamed selected-row averages for krum / bulyan / brute. The (n, n)
+# geometry — and therefore every diagnostics aux — must match the jnp
+# reference BIT FOR BIT on the oracle grid; the averaged outputs match to
+# reduce-fusion rounding with identical NaN/inf placement.
+
+import jax
+
+from byzantinemomentum_tpu import ops
+from byzantinemomentum_tpu.ops import _common, pallas_gar
+
+from . import reference_oracles as oracle
+
+
+def _norm(x):
+    """NaN/inf-comparable view (distinct sentinels so placement is part of
+    the equality)."""
+    return np.nan_to_num(np.asarray(x), nan=7e9, posinf=8e9, neginf=-8e9)
+
+
+@pytest.fixture
+def fused_routing(monkeypatch):
+    """Route the GAR kernels through the fused pipeline in interpret mode
+    (and make sure an outer BMT_NO_PALLAS tier cannot turn it off — the
+    point of these tests is the kernel path itself)."""
+    monkeypatch.delenv("BMT_NO_PALLAS", raising=False)
+    monkeypatch.setenv("BMT_PALLAS_INTERPRET", "1")
+
+
+def _jnp_reference(fn, monkeypatch_env=None):
+    """Run `fn` with the fused tier killed (the jnp fallback paths)."""
+    import os
+    prior = os.environ.get("BMT_NO_PALLAS")
+    os.environ["BMT_NO_PALLAS"] = "1"
+    try:
+        return fn()
+    finally:
+        if prior is None:
+            os.environ.pop("BMT_NO_PALLAS", None)
+        else:
+            os.environ["BMT_NO_PALLAS"] = prior
+
+
+@pytest.mark.parametrize("n", (1, 2, 5, 11, 25))
+@pytest.mark.parametrize("nan_frac", (0.0, 0.1))
+def test_sq_gram_matches_matmul_bitwise(n, nan_frac):
+    """Single-tile streamed Gram == `jnp.matmul(g, g.T, HIGHEST)` bit for
+    bit (the pinned `pairwise_distances` semantics), NaN/inf poisoning
+    included."""
+    g = _mat(n, 1000, seed=n, nan_frac=nan_frac)
+    if n > 7:
+        g[7, 5] = np.inf
+    g = jnp.asarray(g)
+    want = jnp.matmul(g, g.T, precision=jax.lax.Precision.HIGHEST)
+    got = pallas_gar.sq_gram(g, interpret=True)
+    np.testing.assert_array_equal(_norm(got), _norm(want))
+
+
+def test_sq_gram_multi_tile_accumulation(monkeypatch):
+    """Forcing a small tile exercises the grid accumulation and the
+    final-partial-block zero masking (d deliberately not a tile
+    multiple)."""
+    monkeypatch.setattr(pallas_sort, "_tile_for", lambda n, b, i: 192)
+    g = jnp.asarray(_mat(9, 1000, seed=3, nan_frac=0.05))
+    want = np.asarray(jnp.matmul(g, g.T, precision=jax.lax.Precision.HIGHEST))
+    got = np.asarray(pallas_gar.sq_gram(g, interpret=True))
+    assert np.array_equal(np.isnan(got), np.isnan(want))
+    mask = np.isfinite(want)
+    np.testing.assert_allclose(got[mask], want[mask], rtol=1e-5, atol=1e-4)
+
+
+def test_routed_pairwise_distances_bitwise(fused_routing):
+    """`ops._common.pairwise_distances` routed through the streamed Gram
+    equals the jnp path bit for bit (shared (n, n) post-processing)."""
+    g = jnp.asarray(_mat(11, 800, seed=4, nan_frac=0.08))
+    got = _common.pairwise_distances(g)
+    want = _jnp_reference(lambda: _common.pairwise_distances(g))
+    np.testing.assert_array_equal(_norm(got), _norm(want))
+
+
+def test_weighted_rows_mean_kernel_semantics():
+    """The streamed average reproduces `_common.weighted_rows_mean`'s
+    non-finite contract exactly: unselected non-finite rows excluded,
+    selected non-finite entries -> NaN at their coordinates."""
+    g = _mat(7, 500, seed=9)
+    g[6, :] = np.nan           # unselected NaN row: must not poison
+    g[2, 17] = np.inf          # selected inf entry: NaN at column 17
+    g = jnp.asarray(g)
+    w = np.zeros((7,), np.float32)
+    w[[0, 2, 4]] = 1.0 / 3.0
+    w = jnp.asarray(w)
+    want = np.asarray(_common.weighted_rows_mean(w, g))
+    got = np.asarray(pallas_gar.weighted_rows_mean(w, g, interpret=True))
+    np.testing.assert_array_equal(_norm(got), _norm(want))
+    assert np.isnan(got[17]) and np.isfinite(got[:17]).all()
+    # 2-D weight stacks (bulyan stage 1 / masked-quorum rounds)
+    W = jnp.asarray(np.stack([np.asarray(w)] * 3))
+    wantW = np.asarray(_common.weighted_rows_mean(W, g))
+    gotW = np.asarray(pallas_gar.weighted_rows_mean(W, g, interpret=True))
+    np.testing.assert_array_equal(_norm(gotW), _norm(wantW))
+
+
+def test_masked_rows_mean_keeps_brute_inf_contract():
+    """Brute's subset mean is where+sum, NOT the normalized
+    weighted-mean: a selected +inf coordinate stays +inf (only NaN rows
+    among the excluded are zeroed)."""
+    g = _mat(6, 64, seed=2)
+    g[5, :] = np.nan        # excluded row
+    g[1, 3] = np.inf        # selected entry
+    g = jnp.asarray(g)
+    mask = jnp.asarray(np.array([True, True, True, True, False, False]))
+    kept = jnp.where(mask[:, None], g, 0)
+    want = np.asarray(jnp.sum(kept, axis=0) / 4)
+    got = np.asarray(pallas_gar.masked_rows_mean(mask, g, 4, interpret=True))
+    assert np.isposinf(got[3])
+    np.testing.assert_allclose(_norm(got), _norm(want), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("f", (1, 2, 3))
+@pytest.mark.parametrize("gar_name", ("krum", "bulyan", "brute"))
+def test_fused_gar_aux_bit_exact(fused_routing, gar_name, f):
+    """Acceptance: `diagnostics=True` aux from the fused path matches the
+    jnp reference BIT FOR BIT across the oracle grid (f in {1,2,3}),
+    planted-NaN rows and duplicate-row distance ties included; the
+    aggregate matches to reduce-fusion rounding with identical NaN
+    placement."""
+    n = 4 * f + 3  # bulyan's tightest contract; valid for all three
+    g = _mat(n, 700, seed=10 * f, dup_frac=0.3)
+    g[0] = g[1]              # exact duplicate rows: distance ties at 0
+    if n > 4:
+        g[4, :5] = np.nan    # planted NaN row
+    g = jnp.asarray(g)
+    gar = ops.gars[gar_name]
+    agg, aux = gar.diagnosed(g, f=f)
+    agg_ref, aux_ref = _jnp_reference(lambda: gar.diagnosed(g, f=f))
+    for key in aux:
+        np.testing.assert_array_equal(
+            _norm(aux[key]), _norm(aux_ref[key]),
+            err_msg=f"{gar_name} aux[{key!r}] diverged from jnp reference")
+    assert np.array_equal(np.isnan(np.asarray(agg)),
+                          np.isnan(np.asarray(agg_ref)))
+    np.testing.assert_allclose(_norm(agg), _norm(agg_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("f", (1, 2, 3))
+def test_fused_krum_selection_matches_torch_oracle(fused_routing, f):
+    """Fused krum diag vs `tests/reference_oracles.py`: the m = n-f-2
+    lowest-score workers under stable tie order (the reference's Python
+    stable sort)."""
+    torch = pytest.importorskip("torch")
+    n = 11
+    g = _mat(n, 12, seed=f)
+    scores = oracle.krum_scores(torch.tensor(g), f)
+    order = sorted(range(n), key=lambda i: scores[i])  # stable
+    expected = set(order[: n - f - 2])
+    _, aux = ops.gars["krum"](jnp.asarray(g), f=f, diagnostics=True)
+    selected = set(np.nonzero(np.asarray(aux["selection"]) > 0)[0].tolist())
+    assert selected == expected
+    np.testing.assert_allclose(np.asarray(aux["scores"]),
+                               np.asarray(scores, dtype=np.float32),
+                               rtol=1e-4)
+
+
+def test_fused_bulyan_matches_torch_oracle(fused_routing):
+    """Fused bulyan aggregate vs the PyTorch reference oracle (full
+    two-stage rule, f32 tolerance)."""
+    torch = pytest.importorskip("torch")
+    n, f = 11, 2
+    g = _mat(n, 40, seed=21)
+    want = np.asarray(oracle.gar_bulyan(torch.tensor(g), f))
+    got = np.asarray(ops.gars["bulyan"](jnp.asarray(g), f=f))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_supported_gate(monkeypatch):
+    monkeypatch.delenv("BMT_NO_PALLAS", raising=False)
+    g32 = jnp.zeros((8, 64), jnp.float32)
+    assert pallas_gar.supported(g32, interpret=True)
+    # bf16 stacks keep the jnp path (f32 distance-ordering contract)
+    assert not pallas_gar.supported(g32.astype(jnp.bfloat16), interpret=True)
+    assert not pallas_gar.supported(jnp.zeros((80, 64), jnp.float32),
+                                    interpret=True)
+    # shares pallas_sort's kill switches: env var AND the disabled() trace
+    # context (auto-partitioned meshes, non-TPU --device-gar hops)
+    with pallas_sort.disabled():
+        assert not pallas_gar.supported(g32, interpret=True)
+        with pallas_sort.allowed():
+            assert pallas_gar.supported(g32, interpret=True)
+    monkeypatch.setenv("BMT_NO_PALLAS", "1")
+    assert not pallas_gar.supported(g32, interpret=True)
+
+
+def test_masked_quorum_composes_with_fused_kernels(fused_routing):
+    """PR 1 masked-quorum variants ride the fused tier: the streamed Gram
+    feeds `selection_weights_masked` and the streamed average consumes the
+    pre-zeroed rows — results match the jnp path."""
+    from byzantinemomentum_tpu.faults import quorum
+
+    g = jnp.asarray(_mat(13, 900, seed=6))
+    active = jnp.asarray(np.array([True] * 10 + [False] * 3))
+    for name in ("krum", "bulyan", "brute"):
+        gar = ops.gars[name]
+        agg, f_eff = quorum.masked_aggregate(gar, g, active, f_decl=2,
+                                             dynamic=True)
+        agg_ref, f_ref = _jnp_reference(lambda: quorum.masked_aggregate(
+            gar, g, active, f_decl=2, dynamic=True))
+        assert int(f_eff) == int(f_ref)
+        np.testing.assert_allclose(_norm(agg), _norm(agg_ref),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=f"{name} masked aggregate")
