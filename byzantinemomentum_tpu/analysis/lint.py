@@ -166,6 +166,16 @@ class Module:
                 if _is_tracing_callee(_terminal(node.func)):
                     for arg in node.args:
                         self._mark_traced_arg(arg, traced)
+                    # Keyword-passed bodies are traced exactly like
+                    # positional ones: `shard_map(f=kernel, mesh=...)`,
+                    # `while_loop(cond_fun=c, body_fun=b, ...)` — the
+                    # compat-wrapper idiom (`parallel/mesh.py`) takes the
+                    # body positionally, but call sites that name it must
+                    # not hide the scope from BMT-E02/E06. Non-callable
+                    # keywords (mesh=, in_specs=, static_argnums=) have no
+                    # same-module def and mark nothing.
+                    for kw in node.keywords:
+                        self._mark_traced_arg(kw.value, traced)
         # Fixpoint: nested defs and same-module callees of traced code are
         # traced too (the engine's phase helpers, the kernels they call)
         changed = True

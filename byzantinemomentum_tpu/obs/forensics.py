@@ -39,7 +39,7 @@ import numpy as np
 
 from byzantinemomentum_tpu.obs import recorder
 
-__all__ = ["SuspicionTracker", "Z_CLIP"]
+__all__ = ["SuspicionTracker", "ClientSuspicionStore", "Z_CLIP"]
 
 # Distance z-scores are clipped here before normalization: beyond ~4
 # sigma, "farther" carries no additional information, and a single inf
@@ -179,3 +179,151 @@ class SuspicionTracker:
             "suspicion": [round(float(s), 4) for s in self.suspicion],
             "sel_rate": [round(float(r), 4) for r in self._sel_rate],
         }
+
+
+class ClientSuspicionStore:
+    """`SuspicionTracker` promoted to a client-id-keyed map (the
+    aggregation service, `serve/`).
+
+    A training run has a fixed worker roster, so the tracker holds dense
+    `(n,)` arrays; a service sees an OPEN population of client ids, each
+    appearing in some requests and not others. This store keeps the same
+    three EWMA components per client — selection indicator, clipped
+    distance z-score, quarantine/inactive indicator — updated from each
+    request's serve aux (selection mass + per-row mean distances,
+    `ops/diag.py::masked_generic_aux`), with the z-score computed WITHIN
+    the request cohort exactly as the tracker computes it within the
+    worker roster. The selection deficit compares each client's EWMA rate
+    against the mean rate over every currently-known client (the tracker's
+    mean over workers, with the known population as the roster).
+
+    Verdicts ride back on each response: `observe` returns
+    `{client: {"suspicion", "suspect", "observations"}}` for the cohort.
+    Threshold/clear hysteresis and the warm-up gate are per client;
+    rising/falling edges emit `suspect_client` / `suspect_client_cleared`
+    through the active recorder.
+
+    Memory is bounded for millions-of-clients traffic: past `max_clients`
+    the least-recently-observed client state is evicted (its history
+    restarts if it returns — a cold client is warm-up-gated anyway).
+    """
+
+    def __init__(self, *, alpha=0.05, threshold=0.5, clear=0.25,
+                 weights=(0.5, 0.3, 0.2), min_obs=10, max_clients=1_000_000):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"EWMA alpha must be in (0, 1], got {alpha}")
+        if not 0.0 <= clear < threshold:
+            raise ValueError(
+                f"Need 0 <= clear < threshold, got clear={clear} "
+                f"threshold={threshold}")
+        if max_clients < 1:
+            raise ValueError(f"Expected max_clients >= 1, got {max_clients}")
+        self.alpha = float(alpha)
+        self.threshold = float(threshold)
+        self.clear = float(clear)
+        total = float(sum(weights))
+        self.weights = tuple(float(w) / total for w in weights)
+        self.min_obs = int(min_obs)
+        self.max_clients = int(max_clients)
+        self.requests = 0
+        # client -> [sel_rate, dist_z, quarantine, observations, suspect]
+        # (insertion order == recency order: re-observed clients move to
+        # the end, so eviction pops the least recently observed)
+        self._state = {}
+
+    def _ewma(self, state, observation):
+        return (1.0 - self.alpha) * state + self.alpha * observation
+
+    def observe(self, client_ids, selection, distances=None, active=None,
+                step=None):
+        """Fold one request's serve aux into the per-client scores.
+
+        Args:
+          client_ids: cohort client ids, one per row.
+          selection: (n,) selection mass (> 0 = contributed).
+          distances: optional (n,) per-row mean pairwise distance
+            (non-finite = maximally far, clipped to `Z_CLIP` sigma).
+          active: optional (n,) request-level active/quarantine mask.
+          step: optional sequence stamp for emitted events (defaults to
+            the running request count).
+        Returns:
+          {client_id: verdict dict} for the cohort, where a verdict is
+          `{"suspicion": float, "suspect": bool, "observations": int}`.
+        """
+        n = len(client_ids)
+        selected = (np.asarray(selection, dtype=np.float64).reshape(n)
+                    > 0.0).astype(np.float64)
+        if distances is not None:
+            d = np.asarray(distances, dtype=np.float64).reshape(n)
+            finite = np.isfinite(d)
+            z = np.full(n, Z_CLIP)
+            if finite.any():
+                std = float(d[finite].std())
+                if std > 0.0:
+                    z[finite] = np.clip(
+                        (d[finite] - float(d[finite].mean())) / std,
+                        0.0, Z_CLIP)
+                else:
+                    z[finite] = 0.0
+        else:
+            z = None
+        quarantined = (np.zeros(n) if active is None
+                       else 1.0 - (np.asarray(active, dtype=np.float64)
+                                   .reshape(n) > 0.0))
+
+        self.requests += 1
+        step = self.requests if step is None else step
+        for i, client in enumerate(client_ids):
+            state = self._state.pop(client, None)
+            if state is None:
+                state = [0.0, 0.0, 0.0, 0, False]
+            state[0] = self._ewma(state[0], selected[i])
+            if z is not None:
+                state[1] = self._ewma(state[1], z[i])
+            state[2] = self._ewma(state[2], quarantined[i])
+            state[3] += 1
+            self._state[client] = state  # re-insert: most recent last
+
+        mean_rate = (sum(s[0] for s in self._state.values())
+                     / max(len(self._state), 1))
+        verdicts = {}
+        for client in client_ids:
+            state = self._state[client]
+            sel_rate, dist_z, quar, obs, suspect = state
+            deficit = (min(max((mean_rate - sel_rate) / mean_rate, 0.0), 1.0)
+                       if mean_rate > 0.0 else 0.0)
+            w_sel, w_dist, w_quar = self.weights
+            suspicion = (w_sel * deficit + w_dist * dist_z / Z_CLIP
+                         + w_quar * quar)
+            if obs >= self.min_obs:
+                if suspicion >= self.threshold and not suspect:
+                    state[4] = suspect = True
+                    recorder.emit("suspect_client", client=str(client),
+                                  step=step, suspicion=round(suspicion, 4),
+                                  sel_rate=round(sel_rate, 4))
+                elif suspicion <= self.clear and suspect:
+                    state[4] = suspect = False
+                    recorder.emit("suspect_client_cleared",
+                                  client=str(client), step=step,
+                                  suspicion=round(suspicion, 4))
+            verdicts[client] = {"suspicion": round(float(suspicion), 4),
+                                "suspect": bool(state[4]),
+                                "observations": int(obs)}
+        # Evict AFTER the verdicts so a cohort larger than the cap still
+        # answers for every row of the request it just made
+        while len(self._state) > self.max_clients:
+            self._state.pop(next(iter(self._state)))
+        return verdicts
+
+    @property
+    def suspects(self):
+        """Currently-suspect client ids (sorted)."""
+        return sorted(str(c) for c, s in self._state.items() if s[4])
+
+    def __len__(self):
+        return len(self._state)
+
+    def summary(self):
+        """JSON-safe snapshot (heartbeat / stats consumption)."""
+        return {"requests": self.requests, "clients": len(self._state),
+                "suspects": self.suspects}

@@ -37,7 +37,8 @@ see the HLO-identity test in `tests/test_diag.py`).
 import jax.numpy as jnp
 
 __all__ = ["AUX_KEYS", "make_aux", "distance_summary", "var_norm_ratio",
-           "selection_from_indices", "rank_kept_fraction"]
+           "selection_from_indices", "rank_kept_fraction",
+           "masked_generic_aux", "worker_mean_distance"]
 
 # The uniform aux schema (dict keys, all always present).
 AUX_KEYS = ("scores", "selection", "dist", "trim_frac")
@@ -100,6 +101,59 @@ def var_norm_ratio(G):
     dev = G - avg
     dev2 = jnp.sum(dev * dev) / (m - 1)
     return (dev2 / norm2).astype(jnp.float32)
+
+
+def worker_mean_distance(dist):
+    """Per-worker mean pairwise distance to the FINITE peers — the
+    engine's `Worker dist` recipe (`engine/metrics.py`): a row with no
+    finite peer distance (fully corrupt, or a padded/inactive row whose
+    distances are all +inf) reads +inf, so downstream z-scoring treats it
+    as maximally far."""
+    n = dist.shape[0]
+    offdiag = ~jnp.eye(n, dtype=bool)
+    finite = jnp.isfinite(dist) & offdiag
+    count = jnp.sum(finite.astype(jnp.int32), axis=1)
+    mean_d = (jnp.sum(jnp.where(finite, dist, 0.0), axis=1)
+              / jnp.maximum(count, 1).astype(jnp.float32))
+    return jnp.where(count > 0, mean_d, jnp.inf)
+
+
+def masked_generic_aux(G, aggregate, active, f_eff):
+    """Rule-agnostic diagnostics for a MASKED aggregate over the active
+    rows (the aggregation-service path, `serve/programs.py`).
+
+    The rule-native diagnose kernels assume the static single-device
+    layout; a served request is padded up to its shape bucket with
+    inactive rows, so this computes the generic geometry around whatever
+    masked aggregate the quorum layer produced (which stays
+    authoritative — the PR 4 fault-step discipline):
+
+      scores      distance of each row to the aggregate (+inf for
+                  inactive/non-finite rows — `_generic_diagnose`'s score).
+      selection   0/1 mass over the `n_eff - f_eff` most central ACTIVE
+                  rows by that score (value-threshold rank membership, the
+                  `closest_mean` trick, so no argsort+scatter; boundary
+                  ties over-select by their multiplicity).
+      worker_dist per-row mean finite pairwise distance (the engine's
+                  `Worker dist` vector feeding suspicion z-scores).
+
+    Inactive rows are routed to NaN first, so every distance involving
+    them is +inf and they can neither score centrally nor be selected —
+    identical to the kernels' documented worst-case routing.
+    """
+    from byzantinemomentum_tpu.ops import _common
+
+    n = G.shape[0]
+    routed = jnp.where(active[:, None], G, jnp.asarray(jnp.nan, G.dtype))
+    dist = _common.pairwise_distances(routed)
+    dev = routed - aggregate[None, :]
+    scores = _common.sanitize_inf(jnp.sqrt(jnp.sum(dev * dev, axis=1)))
+    n_eff = jnp.sum(active.astype(jnp.int32))
+    keep = jnp.clip(n_eff - f_eff, 1, n)
+    thresh = jnp.take(jnp.sort(scores), keep - 1)
+    selection = (active & (scores <= thresh)).astype(jnp.float32)
+    return {"scores": scores, "selection": selection,
+            "worker_dist": worker_mean_distance(dist), "dist": dist}
 
 
 def rank_kept_fraction(g, f, n_low=None, n_high=None):

@@ -1,0 +1,39 @@
+"""Aggregation-as-a-service: the streaming, batched GAR scoring engine.
+
+The millions-of-users story for this codebase (ROADMAP
+"Aggregation-as-a-service"): clients submit gradient/update cohorts, the
+service returns Byzantine-resilient aggregates plus per-client suspicion
+verdicts. Everything device-side reuses the existing in-jit machinery —
+the masked-quorum GAR variants (PR 1) absorb shape-bucket padding, the
+serve aux rides the PR 4 diagnostics substrate, telemetry and heartbeats
+are the PR 3 obs stack — so there is no forked "serving copy" of any
+kernel to drift (Sculley et al.'s hidden-debt warning, PAPERS.md).
+
+Layers (one module each):
+
+  programs   persistent compiled program cache per
+             `(gar, n-bucket, f, d, diagnostics)` cell; request n rounds
+             up to a shape bucket, padded rows masked out in-jit.
+  batching   microbatch queue (max-batch / max-delay flush) packing
+             concurrent same-cell requests along a leading `vmap` axis;
+             donated inputs, async dispatch, futures on device-ready.
+  service    `AggregationService` — the in-process API tying cache +
+             batcher + the client-keyed suspicion store + heartbeats.
+  frontend   line-JSON TCP front end (stdlib `socketserver`).
+  __main__   CLI: `python -m byzantinemomentum_tpu.serve` serves;
+             `--selfcheck` proves the zero-recompile warm loop, the
+             suspicion path and a socket round-trip (the CI smoke).
+
+Load is measured the production way by `scripts/serve_loadgen.py`
+(open-loop Poisson arrivals, p50/p99 + aggregations/s, machine-readable
+`BENCH_serve.json` gated by `scripts/bench_compare.py`).
+"""
+
+from byzantinemomentum_tpu.serve.programs import (   # noqa: F401
+    Cell, MASKED_GARS, N_BUCKETS, OversizeRequest, ProgramCache)
+from byzantinemomentum_tpu.serve.batching import MicroBatcher  # noqa: F401
+from byzantinemomentum_tpu.serve.service import (    # noqa: F401
+    AggregateResult, AggregationService)
+
+__all__ = ["AggregationService", "AggregateResult", "Cell", "MicroBatcher",
+           "ProgramCache", "OversizeRequest", "MASKED_GARS", "N_BUCKETS"]

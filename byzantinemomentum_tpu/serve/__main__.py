@@ -1,0 +1,191 @@
+"""CLI for the aggregation service.
+
+    python -m byzantinemomentum_tpu.serve --port 7600 \
+        --result-directory results-serve
+    python -m byzantinemomentum_tpu.serve --selfcheck
+
+Serving mode binds the line-JSON front end and blocks; with a result
+directory it writes the same heartbeat/telemetry a training run does, so
+`utils/jobs.py` can supervise the server exactly like a run (watchdog on
+the heartbeat, kill + retry on stall). The Jobs-dispatched flags
+(`--seed`, `--device`) are accepted for that reason: a seed seeds the
+selfcheck's synthetic traffic, the device string is advisory.
+
+`--selfcheck` is the CI smoke (`scripts/run_test_tiers.py` serve tier):
+it proves, in-process and in seconds, that (1) a warm serving loop
+compiles ZERO new programs across 100+ mixed-cell requests
+(`analysis/contracts.py::assert_recompile_budget`), (2) a planted
+outlier client's suspicion rises and its verdict rides the response, and
+(3) the socket front end answers ping/aggregate/stats over a real TCP
+connection.
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+__all__ = ["main", "selfcheck"]
+
+# The selfcheck's mixed-cell traffic: three GARs, mixed row counts
+# (bucketed and exact), mixed f/d, diagnostics on and off.
+SELFCHECK_CELLS = (
+    ("krum", 11, 2, 64, True),
+    ("krum", 7, 1, 64, True),
+    ("median", 5, 1, 32, True),
+    ("trmean", 9, 2, 64, False),
+)
+
+
+def selfcheck(seed=1, requests=120, verbose=True):
+    """Run the three proofs; returns the stats payload (raises on
+    failure). Kept importable so tests can run it in-process."""
+    from byzantinemomentum_tpu.analysis import contracts
+    from byzantinemomentum_tpu.serve import AggregationService
+    from byzantinemomentum_tpu.serve.frontend import AggregationServer
+
+    rng = np.random.default_rng(seed)
+    service = AggregationService(max_batch=8, max_delay_ms=5.0)
+    try:
+        compiled = service.warmup(SELFCHECK_CELLS)
+        if verbose:
+            print(f"serve selfcheck: warmed {compiled} programs over "
+                  f"{len(SELFCHECK_CELLS)} cells", flush=True)
+
+        # (1) the warm loop never recompiles across mixed-cell traffic
+        group = max(1, requests // 10)
+
+        def step():
+            futures = []
+            for k in range(group):
+                gar, n, f, d, diag = SELFCHECK_CELLS[k % len(SELFCHECK_CELLS)]
+                cohort = rng.standard_normal((n, d)).astype(np.float32)
+                clients = ([f"client-{i}" for i in range(n)] if diag
+                           else None)
+                futures.append(service.submit(
+                    cohort, gar=gar, f=f, client_ids=clients,
+                    diagnostics=diag))
+            for fut in futures:
+                fut.result(timeout=30)
+
+        contracts.assert_recompile_budget(
+            step, steps=10, budget=0,
+            label=f"warm serving loop ({10 * group} mixed-cell requests)")
+        if verbose:
+            print(f"serve selfcheck: {10 * group} warm requests, "
+                  f"0 recompiles", flush=True)
+
+        # (2) a planted outlier client gets flagged, verdict on response
+        n, d, f = 11, 64, 2
+        verdict = None
+        for _ in range(30):
+            cohort = rng.standard_normal((n, d)).astype(np.float32)
+            cohort[0] += 40.0  # the outlier every honest row disagrees with
+            clients = ["evil"] + [f"honest-{i}" for i in range(n - 1)]
+            result = service.aggregate(cohort, gar="krum", f=f,
+                                       client_ids=clients, timeout=30)
+            verdict = result.verdicts["evil"]
+        honest = result.verdicts["honest-0"]
+        if not (verdict["suspicion"] > honest["suspicion"]
+                and verdict["suspect"]):
+            raise AssertionError(
+                f"planted outlier not flagged: evil={verdict} "
+                f"honest={honest}")
+        if verbose:
+            print(f"serve selfcheck: outlier flagged "
+                  f"(suspicion {verdict['suspicion']:.2f} vs honest "
+                  f"{honest['suspicion']:.2f})", flush=True)
+
+        # (3) the socket front end round-trips
+        import socket
+        with AggregationServer(("127.0.0.1", 0), service) as server:
+            server.serve_background()
+            with socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=10) as conn:
+                fd = conn.makefile("rwb")
+                cohort = rng.standard_normal((7, 32)).astype(np.float32)
+                for request in (
+                        {"op": "ping"},
+                        {"op": "aggregate", "gar": "median", "f": 1,
+                         "vectors": cohort.tolist(),
+                         "clients": [f"s{i}" for i in range(7)]},
+                        {"op": "stats"}):
+                    fd.write(json.dumps(request).encode() + b"\n")
+                    fd.flush()
+                    response = json.loads(fd.readline())
+                    if not response.get("ok"):
+                        raise AssertionError(
+                            f"socket round-trip failed: {response}")
+            server.shutdown()
+        if verbose:
+            print("serve selfcheck: socket front end ok", flush=True)
+
+        stats = service.stats()
+    finally:
+        service.close()
+    return stats
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m byzantinemomentum_tpu.serve",
+        description="Aggregation-as-a-service: batched Byzantine-resilient "
+                    "aggregation over a line-JSON socket")
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="run the CI smoke (warm-loop recompile budget, "
+                             "suspicion path, socket round-trip) and exit")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7600,
+                        help="TCP port (0 = ephemeral)")
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--max-delay-ms", type=float, default=2.0)
+    parser.add_argument("--no-diagnostics", action="store_true",
+                        help="default new requests to diagnostics=False")
+    parser.add_argument("--heartbeat-interval", type=float, default=2.0)
+    parser.add_argument("--result-directory", default=None,
+                        help="run directory for heartbeat.json + "
+                             "telemetry.jsonl (enables Jobs supervision)")
+    parser.add_argument("--seed", type=int, default=1,
+                        help="selfcheck traffic seed (Jobs-compatible)")
+    parser.add_argument("--device", default=None,
+                        help="advisory device string (Jobs-compatible)")
+    args = parser.parse_args(argv)
+
+    if args.selfcheck:
+        try:
+            stats = selfcheck(seed=args.seed)
+        except Exception as err:  # bmt: noqa[BMT-E05] the smoke's contract is an exit code + one readable line, whatever layer failed
+            print(f"serve selfcheck: FAILED — {type(err).__name__}: {err}")
+            return 1
+        print(f"serve selfcheck: ok {json.dumps(stats)}")
+        return 0
+
+    from byzantinemomentum_tpu.serve import AggregationService
+    from byzantinemomentum_tpu.serve.frontend import AggregationServer
+
+    # Tail-latency knob: the default 5 ms GIL switch interval lets one
+    # packing slice stall the submitter/handler threads for more than the
+    # whole max-delay budget; 1 ms keeps scheduler jitter out of p99
+    sys.setswitchinterval(0.001)
+    service = AggregationService(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        diagnostics=not args.no_diagnostics,
+        directory=args.result_directory,
+        heartbeat_interval=args.heartbeat_interval)
+    try:
+        with AggregationServer((args.host, args.port), service) as server:
+            print(f"serving aggregation on {args.host}:{server.port} "
+                  f"(max_batch={args.max_batch}, "
+                  f"max_delay={args.max_delay_ms}ms)", flush=True)
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+    finally:
+        service.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

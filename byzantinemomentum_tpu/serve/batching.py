@@ -1,0 +1,195 @@
+"""Microbatching queue: pack concurrent same-cell requests into one
+device program.
+
+Requests enqueue per cell; a cell flushes when it holds `max_batch`
+requests (immediately — the submitting thread notifies the flusher) or
+when its oldest request has waited `max_delay` seconds, whichever comes
+first. That is the classic max-batch-size / max-delay policy: an idle
+service adds at most `max_delay` of latency, a saturated one packs full
+batches, and p99 stays bounded by `max_delay` plus one program execution.
+
+Two daemon threads, so the submitting host thread NEVER blocks on device
+completion:
+
+  flusher   picks due cells, hands each packed batch to the service's
+            dispatch callback (which enqueues the device program
+            asynchronously and returns its on-device outputs immediately),
+            then passes the in-flight handle to the resolver.
+  resolver  blocks on device-ready (the only thread that ever does),
+            unpacks per-request results and fulfills the caller futures.
+
+Callers hold `concurrent.futures.Future`s — `submit` returns before any
+device work happens, and a future resolves exactly when its batch leaves
+the device. Failures (a dispatch error, a poisoned batch) resolve the
+affected futures with the exception instead of wedging callers.
+
+Queue depth and batch occupancy land on the active obs recorder
+(`serve_queue_depth` gauge, `serve_batches`/`serve_batched_requests`
+counters) — the telemetry substrate every other subsystem already uses.
+"""
+
+import collections
+import concurrent.futures
+import queue
+import threading
+import time
+
+from byzantinemomentum_tpu.obs import recorder
+
+__all__ = ["ServeRequest", "MicroBatcher"]
+
+
+class ServeRequest:
+    """One enqueued aggregation: the packed payload plus its future."""
+
+    __slots__ = ("cell", "n", "matrix", "client_ids", "future", "t_submit")
+
+    def __init__(self, cell, n, matrix, client_ids):
+        self.cell = cell
+        self.n = int(n)
+        self.matrix = matrix          # np.f32[n, d] (host)
+        self.client_ids = client_ids  # tuple[str] | None
+        self.future = concurrent.futures.Future()
+        self.t_submit = time.monotonic()
+
+
+class MicroBatcher:
+    """Per-cell request queues + the flusher/resolver thread pair.
+
+    Args:
+      dispatch: `(cell, requests) -> handle` — pack and asynchronously
+        dispatch one batch (called on the flusher thread; must not
+        block on device completion).
+      resolve: `(handle, requests) -> None` — block until device-ready
+        and fulfill each request's future (called on the resolver
+        thread).
+      max_batch: flush a cell at this many queued requests.
+      max_delay: seconds the oldest request of a cell may wait before
+        its batch flushes regardless of occupancy.
+    """
+
+    def __init__(self, dispatch, resolve, *, max_batch=8, max_delay=0.002):
+        if max_batch < 1:
+            raise ValueError(f"Expected max_batch >= 1, got {max_batch}")
+        if max_delay < 0:
+            raise ValueError(f"Expected max_delay >= 0, got {max_delay}")
+        self._dispatch = dispatch
+        self._resolve = resolve
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay)
+        self._queues = collections.OrderedDict()  # cell -> deque[request]
+        self._cond = threading.Condition()
+        self._inflight = queue.Queue()
+        self._closed = False
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         name="serve-flusher", daemon=True)
+        self._resolver = threading.Thread(target=self._resolve_loop,
+                                          name="serve-resolver", daemon=True)
+        self._flusher.start()
+        self._resolver.start()
+
+    # ------------------------------------------------------------------ #
+
+    def submit(self, request):
+        """Enqueue one request; returns its future immediately."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queues.setdefault(request.cell, collections.deque()
+                                    ).append(request)
+            self._cond.notify()
+        return request.future
+
+    def depth(self):
+        """Requests currently queued (not yet dispatched)."""
+        with self._cond:
+            return sum(len(q) for q in self._queues.values())
+
+    # ------------------------------------------------------------------ #
+    # Flusher: pick due cells, dispatch, hand off to the resolver
+
+    def _due(self, now):
+        """(requests, depth_after) of the most urgent due cell, or None.
+        A cell is due when full (>= max_batch) or its oldest request aged
+        past max_delay; fullness beats age so a saturated cell drains in
+        whole batches."""
+        due_cell = None
+        for cell, q in self._queues.items():
+            if not q:
+                continue
+            if len(q) >= self.max_batch:
+                due_cell = cell
+                break
+            if now - q[0].t_submit >= self.max_delay and due_cell is None:
+                due_cell = cell
+        if due_cell is None:
+            return None
+        q = self._queues[due_cell]
+        batch = [q.popleft() for _ in range(min(len(q), self.max_batch))]
+        if not q:
+            del self._queues[due_cell]
+        return batch, sum(len(qq) for qq in self._queues.values())
+
+    def _next_deadline(self, now):
+        """Seconds until the earliest max-delay expiry (None = no queue)."""
+        oldest = None
+        for q in self._queues.values():
+            if q and (oldest is None or q[0].t_submit < oldest):
+                oldest = q[0].t_submit
+        if oldest is None:
+            return None
+        return max(0.0, oldest + self.max_delay - now)
+
+    def _flush_loop(self):
+        while True:
+            with self._cond:
+                while True:
+                    if self._closed and not self._queues:
+                        return
+                    picked = self._due(time.monotonic())
+                    if picked is not None:
+                        break
+                    timeout = self._next_deadline(time.monotonic())
+                    self._cond.wait(timeout=timeout)
+                batch, depth_after = picked
+            recorder.counter("serve_batches")
+            recorder.counter("serve_batched_requests", len(batch))
+            if recorder.active() is not None:
+                recorder.active().gauge("serve_queue_depth", depth_after)
+            try:
+                handle = self._dispatch(batch[0].cell, batch)
+            except Exception as err:  # bmt: noqa[BMT-E05] one poisoned batch must fail its own futures, not kill the flusher serving every other caller
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                continue
+            self._inflight.put((handle, batch))
+
+    # ------------------------------------------------------------------ #
+    # Resolver: the only thread that blocks on the device
+
+    def _resolve_loop(self):
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            handle, batch = item
+            try:
+                self._resolve(handle, batch)
+            except Exception as err:  # bmt: noqa[BMT-E05] a failed resolution must fail its own futures, not kill the resolver thread behind every in-flight batch
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+
+    # ------------------------------------------------------------------ #
+
+    def close(self, timeout=5.0):
+        """Drain the queues, stop both threads. Idempotent."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._flusher.join(timeout=timeout)
+        self._inflight.put(None)
+        self._resolver.join(timeout=timeout)

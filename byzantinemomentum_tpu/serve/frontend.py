@@ -1,0 +1,103 @@
+"""Line-JSON socket front end for the aggregation service (stdlib only).
+
+Protocol: newline-delimited JSON over TCP, one object per line, one
+response line per request line, in order:
+
+  {"op": "aggregate", "vectors": [[...], ...], "gar": "krum", "f": 1,
+   "clients": ["c0", ...], "diagnostics": true}
+      -> {"ok": true, "aggregate": [...], "f_eff": 1, "n": 11,
+          "cell": {...}, "verdicts": {...}, "latency_ms": 3.2}
+  {"op": "stats"}   -> {"ok": true, "stats": {...}}
+  {"op": "ping"}    -> {"ok": true, "op": "ping"}
+
+Errors answer `{"ok": false, "error": "..."}` on the same line slot; a
+malformed line never kills the connection, let alone the server. Each
+connection gets its own handler thread (`ThreadingTCPServer`), and the
+handler blocks on ITS request's future only — the service's dispatch
+stays batched and asynchronous underneath, so concurrent connections
+pack into shared device programs.
+"""
+
+import json
+import socketserver
+import threading
+
+from byzantinemomentum_tpu import utils
+
+__all__ = ["AggregationServer", "serve_forever"]
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        service = self.server.service
+        for raw in self.rfile:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                response = self._one(service, json.loads(line))
+            except (ValueError, KeyError, TypeError,
+                    utils.UserException) as err:
+                response = {"ok": False, "error": str(err)}
+            except Exception as err:  # bmt: noqa[BMT-E05] a failed request must answer its line, not sever every client on this connection
+                response = {"ok": False,
+                            "error": f"{type(err).__name__}: {err}"}
+            try:
+                self.wfile.write(json.dumps(response).encode("utf-8")
+                                 + b"\n")
+                self.wfile.flush()
+            except OSError:
+                return  # client hung up mid-response
+
+    @staticmethod
+    def _one(service, request):
+        if not isinstance(request, dict):
+            raise ValueError("expected a JSON object per line")
+        op = request.get("op", "aggregate")
+        if op == "ping":
+            return {"ok": True, "op": "ping"}
+        if op == "stats":
+            return {"ok": True, "stats": service.stats()}
+        if op != "aggregate":
+            raise ValueError(f"unknown op {op!r}")
+        vectors = request["vectors"]
+        future = service.submit(
+            vectors,
+            gar=request.get("gar", "krum"),
+            f=int(request.get("f", 1)),
+            client_ids=request.get("clients"),
+            diagnostics=request.get("diagnostics"))
+        result = future.result()
+        return {"ok": True, **result.as_dict()}
+
+
+class AggregationServer(socketserver.ThreadingTCPServer):
+    """TCP server bound to an `AggregationService`."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, address, service):
+        super().__init__(address, _Handler)
+        self.service = service
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+    def serve_background(self):
+        """Serve on a daemon thread; returns the thread (the caller owns
+        shutdown through `server.shutdown()`)."""
+        thread = threading.Thread(target=self.serve_forever,
+                                  name="serve-frontend", daemon=True)
+        thread.start()
+        return thread
+
+
+def serve_forever(service, host="127.0.0.1", port=0):
+    """Blocking convenience: bind and serve until interrupted. Returns
+    the server (mostly useful when `port=0` picked an ephemeral port —
+    read it back before blocking via `AggregationServer` directly)."""
+    with AggregationServer((host, port), service) as server:
+        server.serve_forever()
+    return server

@@ -1,0 +1,199 @@
+"""Persistent compiled GAR programs for the aggregation service.
+
+A served aggregation is one device program over a *cell*:
+
+    (gar, n-bucket, f, d, diagnostics)
+
+Request row counts are rounded UP to a small set of shape buckets and the
+padding rows are masked out through the PR 1 masked-quorum GAR variants
+(`faults/quorum.py::masked_aggregate` — inactive rows never select, never
+average, and the effective Byzantine tolerance is recomputed from the
+traced active count), so steady-state traffic over mixed n never
+recompiles: every request lands on one of the bucket programs compiled at
+warm-up. Only the GARs with TRUE masked kernels (`average`, `median`,
+`trmean`, `krum` and their `native-` tiers) take padded buckets; the rest
+fall back to the documented NaN-routing contract, which is only correct
+while `absent + byzantine <= f` — more padding than that would break the
+rule's guarantee — so those rules get EXACT cells (`n_bucket == n`: one
+compile per distinct n, still cached and persistent).
+
+The batch axis is bucketed the same way: concurrent same-cell requests
+pack along a leading request axis (`vmap` over the per-request program)
+whose length rounds up to a power of two, padding slots repeating the
+first request's payload (their outputs are dropped — repeating real data
+keeps the padded lanes numerically tame). One compiled program therefore
+serves every (n <= bucket, batch <= bucket) combination of its cell.
+
+Programs donate their big input buffer (`donate_argnums`) on backends
+that support donation, so the packed request matrix is consumed in place;
+dispatch is async — the executable call returns before the device
+finishes, and the service resolves caller futures on device-ready.
+
+Diagnostics cells additionally return the serve aux
+(`ops/diag.py::masked_generic_aux`): per-row scores, selection mass and
+mean finite pairwise distance — the inputs of the per-client suspicion
+store. The masked aggregate stays authoritative either way (the PR 4
+fault-step discipline).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import ops, utils
+from byzantinemomentum_tpu.faults import quorum
+from byzantinemomentum_tpu.obs import recorder
+from byzantinemomentum_tpu.ops import diag
+
+__all__ = ["Cell", "ProgramCache", "OversizeRequest", "N_BUCKETS",
+           "MASKED_GARS", "batch_bucket", "row_bucket"]
+
+# Row-count shape buckets: requests round up to the smallest bucket >= n.
+# The ladder is geometric so at most 2x rows are ever padded, and capped
+# where the fused Pallas pipeline caps (`ops/pallas_gar.py::MAX_ROWS`).
+N_BUCKETS = (4, 8, 16, 32, 64)
+
+# GARs with exact masked-quorum kernels (`faults/quorum.py` dispatch):
+# these aggregate the active subset EXACTLY regardless of how many padded
+# rows ride along, so they are the rules that take padded buckets.
+MASKED_GARS = frozenset({"average", "median", "trmean", "krum"})
+
+
+class OversizeRequest(utils.UserException):
+    """The request's row count exceeds every configured shape bucket."""
+
+
+def _base_name(name):
+    return name[len("native-"):] if name.startswith("native-") else name
+
+
+def row_bucket(gar_name, n, buckets=N_BUCKETS):
+    """The bucketed row count for a request of `n` rows: the smallest
+    bucket >= n for the masked-family GARs, `n` itself (an exact cell)
+    for rules whose padding contract would not hold. Raises
+    `OversizeRequest` beyond the largest bucket."""
+    if n < 1:
+        raise utils.UserException(f"Expected at least one row, got {n}")
+    if n > buckets[-1]:
+        raise OversizeRequest(
+            f"Request of {n} rows exceeds the largest shape bucket "
+            f"({buckets[-1]}); shard the cohort or raise the bucket ladder")
+    if _base_name(gar_name) not in MASKED_GARS:
+        return n
+    for b in buckets:
+        if n <= b:
+            return b
+    raise OversizeRequest(f"No bucket holds {n} rows")  # unreachable
+
+
+def batch_bucket(b, max_batch):
+    """Round a packed batch size up to a power of two <= max_batch."""
+    out = 1
+    while out < b and out < max_batch:
+        out *= 2
+    return out
+
+
+class Cell(tuple):
+    """Hashable program-cache key `(gar, n_bucket, f, d, diagnostics)`."""
+
+    __slots__ = ()
+
+    def __new__(cls, gar, n_bucket, f, d, diagnostics):
+        return tuple.__new__(cls, (str(gar), int(n_bucket), int(f), int(d),
+                                   bool(diagnostics)))
+
+    gar = property(lambda self: self[0])
+    n_bucket = property(lambda self: self[1])
+    f = property(lambda self: self[2])
+    d = property(lambda self: self[3])
+    diagnostics = property(lambda self: self[4])
+
+    def __repr__(self):
+        return (f"Cell({self.gar}, n={self.n_bucket}, f={self.f}, "
+                f"d={self.d}, diag={self.diagnostics})")
+
+
+def _build(cell, donate):
+    """Compile-ready program for one cell: `vmap` of the per-request
+    masked aggregation along the leading request axis. Inputs
+    `(G: f32[B, N, d], active: bool[B, N])`, outputs a dict of stacked
+    per-request results."""
+    gar = ops.gars[cell.gar]
+    f, diagnostics = cell.f, cell.diagnostics
+
+    def one(G, active):
+        agg, f_eff = quorum.masked_aggregate(gar, G, active, f_decl=f)
+        out = {"aggregate": agg, "f_eff": f_eff}
+        if diagnostics:
+            aux = diag.masked_generic_aux(G, agg, active, f_eff)
+            out["scores"] = aux["scores"]
+            out["selection"] = aux["selection"]
+            out["worker_dist"] = aux["worker_dist"]
+        return out
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(jax.vmap(one), **kwargs)
+
+
+class ProgramCache:
+    """The persistent compiled-program store, keyed by cell.
+
+    One jitted callable per cell serves every batch bucket (jit re-lowers
+    per concrete batch shape under the same wrapper); `get` counts
+    hits/misses per `(cell, batch_bucket)` — the unit that actually
+    compiles — through the active obs recorder (`serve_program_hit` /
+    `serve_program_miss` counters), so a warm serving loop's zero-compile
+    claim is observable, and `analysis/contracts.py::
+    assert_recompile_budget` can hold it to zero at the XLA level.
+
+    Thread-safe: the service's caller threads (warm-up) and the
+    microbatch flusher both reach `get`.
+    """
+
+    def __init__(self, buckets=N_BUCKETS, donate=None):
+        self.buckets = tuple(sorted(buckets))
+        if donate is None:
+            # CPU donation is unimplemented (every call would warn and
+            # copy anyway); donate only where the runtime honors it
+            donate = jax.default_backend() != "cpu"
+        self.donate = bool(donate)
+        self._programs = {}
+        self._warm = set()     # (cell, batch_bucket) pairs seen
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def cell(self, gar, n, f, d, diagnostics):
+        """The cell a request of `n` rows lands on (bucketing the rows)."""
+        if gar not in ops.gars:
+            raise utils.UserException(
+                f"Unknown aggregation rule {gar!r}; registered: "
+                f"{', '.join(sorted(ops.gars))}")
+        return Cell(gar, row_bucket(gar, n, self.buckets), f, d, diagnostics)
+
+    def get(self, cell, batch):
+        """The compiled program for `cell`, counting a hit/miss for the
+        `(cell, batch)` shape about to run (`batch` is the already-
+        bucketed leading-axis length the caller packed to)."""
+        with self._lock:
+            program = self._programs.get(cell)
+            if program is None:
+                program = self._programs[cell] = _build(cell, self.donate)
+            key = (cell, int(batch))
+            if key in self._warm:
+                self.hits += 1
+                hit = True
+            else:
+                self._warm.add(key)
+                self.misses += 1
+                hit = False
+        recorder.counter("serve_program_hit" if hit else "serve_program_miss")
+        return program
+
+    def stats(self):
+        with self._lock:
+            return {"cells": len(self._programs), "hits": self.hits,
+                    "misses": self.misses,
+                    "programs": len(self._warm)}

@@ -1,0 +1,320 @@
+"""The aggregation service: batched, cached, asynchronously-dispatched
+Byzantine-resilient aggregation over the existing in-jit GAR machinery.
+
+One `AggregationService` owns the three moving parts and wires them to
+the telemetry substrate every other subsystem already uses:
+
+  * a `ProgramCache` of persistent compiled programs per
+    `(gar, n-bucket, f, d, diagnostics)` cell (`serve/programs.py`) —
+    steady-state traffic never recompiles;
+  * a `MicroBatcher` packing concurrent same-cell requests into one
+    device program along a leading request axis, flushed by
+    max-batch-size / max-delay, with donated input buffers and async
+    dispatch (`serve/batching.py`) — callers get futures resolved on
+    device-ready, the host thread never blocks;
+  * a `ClientSuspicionStore` (`obs/forensics.py`) folding each
+    diagnostics cell's serve aux into client-id-keyed EWMA suspicion,
+    whose verdicts ride back on each response.
+
+Supervision follows the run pattern (`utils/jobs.py`): the service
+writes the same atomic `heartbeat.json` the Jobs watchdog consumes (the
+`step` field counts served requests, so a wedged device stalls the
+signal and the watchdog's kill/retry applies unchanged), and counters /
+gauges land in the run's `telemetry.jsonl` through the obs recorder.
+"""
+
+import pathlib
+import threading
+import time
+
+import numpy as np
+
+from byzantinemomentum_tpu import utils
+from byzantinemomentum_tpu.obs import recorder
+from byzantinemomentum_tpu.obs.forensics import ClientSuspicionStore
+from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+from byzantinemomentum_tpu.serve.batching import MicroBatcher, ServeRequest
+from byzantinemomentum_tpu.serve.programs import (
+    N_BUCKETS, ProgramCache, batch_bucket)
+
+__all__ = ["AggregationService", "AggregateResult"]
+
+
+class AggregateResult:
+    """One resolved aggregation response."""
+
+    __slots__ = ("aggregate", "f_eff", "n", "cell", "verdicts",
+                 "latency_ms")
+
+    def __init__(self, aggregate, f_eff, n, cell, verdicts, latency_ms):
+        self.aggregate = aggregate    # np.f32[d]
+        self.f_eff = f_eff            # effective Byzantine tolerance used
+        self.n = n                    # submitted rows (pre-bucket)
+        self.cell = cell              # the program cell served from
+        self.verdicts = verdicts      # {client_id: verdict} | None
+        self.latency_ms = latency_ms  # submit -> resolve wall time
+
+    def as_dict(self):
+        """JSON-safe view (the line-JSON front end's response body)."""
+        return {
+            "aggregate": [float(x) for x in self.aggregate],
+            "f_eff": int(self.f_eff),
+            "n": self.n,
+            "cell": {"gar": self.cell.gar, "n_bucket": self.cell.n_bucket,
+                     "f": self.cell.f, "d": self.cell.d,
+                     "diagnostics": self.cell.diagnostics},
+            "verdicts": self.verdicts,
+            "latency_ms": round(self.latency_ms, 3),
+        }
+
+
+class AggregationService:
+    """Submit gradient/update cohorts, receive robust aggregates plus
+    per-client suspicion verdicts.
+
+    Args:
+      max_batch: requests packed into one device program (per cell).
+      max_delay_ms: longest a queued request waits for batch-mates.
+      buckets: the row-count shape-bucket ladder (`serve/programs.py`).
+      diagnostics: default for requests that don't say (diagnostics
+        cells compute the serve aux and feed the suspicion store).
+      directory: optional run directory — enables the heartbeat file
+        (and a `Telemetry` recorder when none is active) so the Jobs
+        watchdog can supervise the serving process like any run.
+      heartbeat_interval: seconds between heartbeat writes (with a
+        directory; the writer is a daemon thread).
+      suspicion: kwargs forwarded to `ClientSuspicionStore`.
+    """
+
+    def __init__(self, *, max_batch=8, max_delay_ms=2.0, buckets=N_BUCKETS,
+                 diagnostics=True, directory=None, heartbeat_interval=2.0,
+                 suspicion=None, donate=None):
+        self.cache = ProgramCache(buckets=buckets, donate=donate)
+        self.max_batch = int(max_batch)
+        self.diagnostics = bool(diagnostics)
+        self.suspicion = ClientSuspicionStore(**(suspicion or {}))
+        self._suspicion_lock = threading.Lock()
+        self._requests = 0
+        self._served = 0
+        self._rejected = 0
+        self._closed = False
+        self._telemetry = None
+        self.directory = None
+        if directory is not None:
+            self.directory = pathlib.Path(directory)
+            self.directory.mkdir(parents=True, exist_ok=True)
+            if recorder.active() is None:
+                from byzantinemomentum_tpu.obs.recorder import Telemetry
+                self._telemetry = recorder.activate(Telemetry(self.directory))
+        self.batcher = MicroBatcher(self._dispatch, self._resolve,
+                                    max_batch=max_batch,
+                                    max_delay=max_delay_ms / 1000.0)
+        self._beat_stop = threading.Event()
+        self._beat_thread = None
+        if self.directory is not None and heartbeat_interval:
+            self._beat_thread = threading.Thread(
+                target=self._beat_loop, args=(float(heartbeat_interval),),
+                name="serve-heartbeat", daemon=True)
+            self._beat_thread.start()
+        recorder.emit("serve_start", max_batch=self.max_batch,
+                      max_delay_ms=max_delay_ms,
+                      buckets=list(self.cache.buckets))
+
+    # ------------------------------------------------------------------ #
+    # Submission API
+
+    def submit(self, vectors, *, gar="krum", f=1, client_ids=None,
+               diagnostics=None):
+        """Queue one aggregation; returns a `Future[AggregateResult]`.
+
+        `vectors` is the (n, d) cohort matrix (array-like, one row per
+        client submission); `client_ids` optionally names the rows so
+        suspicion verdicts can ride back (requires a diagnostics cell).
+        Invalid requests raise synchronously (`utils.UserException` /
+        `OversizeRequest`) — the caller never holds a future that was
+        doomed from the start.
+        """
+        if self._closed:
+            raise RuntimeError("AggregationService is closed")
+        try:
+            cell, matrix, client_ids = self._validate(
+                vectors, gar, f, client_ids, diagnostics)
+        except utils.UserException:
+            self._rejected += 1
+            recorder.counter("serve_rejected")
+            raise
+        n = matrix.shape[0]
+        self._requests += 1
+        recorder.counter("serve_requests")
+        return self.batcher.submit(ServeRequest(cell, n, matrix, client_ids))
+
+    def _validate(self, vectors, gar, f, client_ids, diagnostics):
+        """Everything that can reject a request, in one place (every
+        failure counts on the `serve_rejected` telemetry counter)."""
+        matrix = np.asarray(vectors, dtype=np.float32)
+        if matrix.ndim != 2:
+            raise utils.UserException(
+                f"Expected an (n, d) matrix of row submissions, got shape "
+                f"{matrix.shape}")
+        n, d = matrix.shape
+        if diagnostics is None:
+            diagnostics = self.diagnostics
+        if client_ids is not None:
+            client_ids = tuple(str(c) for c in client_ids)
+            if len(client_ids) != n:
+                raise utils.UserException(
+                    f"Got {len(client_ids)} client ids for {n} rows")
+            if not diagnostics:
+                raise utils.UserException(
+                    "Per-client verdicts need a diagnostics cell; pass "
+                    "diagnostics=True (or drop client_ids)")
+        cell = self.cache.cell(gar, n, f, d, bool(diagnostics))
+        # The rule's own contract on the REQUEST rows (bucket padding only
+        # ever relaxes static constraints — n_bucket >= n)
+        from byzantinemomentum_tpu import ops
+        message = ops.gars[gar].check(gradients=matrix, f=f)
+        if message is not None:
+            raise utils.UserException(
+                f"Aggregation rule {gar!r} cannot serve this request: "
+                f"{message}")
+        return cell, matrix, client_ids
+
+    def aggregate(self, vectors, timeout=None, **kwargs):
+        """Synchronous `submit().result()` convenience."""
+        return self.submit(vectors, **kwargs).result(timeout=timeout)
+
+    def warmup(self, cells, batch_sizes=None):
+        """Pre-compile (and pre-execute) the given `(gar, n, f, d,
+        diagnostics)` request shapes at every batch bucket, so steady-state
+        traffic meets a fully warm cache. Drives the program cache
+        directly (not the batcher) so exactly one program runs per
+        `(cell, batch_bucket)` regardless of flush timing. Returns the
+        number of programs executed."""
+        import jax
+
+        if batch_sizes is None:
+            batch_sizes = []
+            b = 1
+            while b <= self.max_batch:
+                batch_sizes.append(b)
+                b *= 2
+        count = 0
+        rng = np.random.default_rng(0)
+        for gar, n, f, d, diagnostics in cells:
+            cell = self.cache.cell(gar, n, f, d, bool(diagnostics))
+            for b in batch_sizes:
+                B = batch_bucket(b, self.max_batch)
+                G = np.zeros((B, cell.n_bucket, d), dtype=np.float32)
+                G[:, :n] = rng.standard_normal((B, n, d))
+                active = np.zeros((B, cell.n_bucket), dtype=bool)
+                active[:, :n] = True
+                program = self.cache.get(cell, B)
+                jax.block_until_ready(
+                    program(jax.device_put(G), jax.device_put(active)))
+                count += 1
+        return count
+
+    # ------------------------------------------------------------------ #
+    # Batch lifecycle (flusher/resolver threads)
+
+    def _dispatch(self, cell, requests):
+        """Pack one cell's batch and dispatch it asynchronously (flusher
+        thread). Padding: rows beyond each request's n are inactive (the
+        masked-quorum variants ignore them); batch slots beyond the real
+        requests repeat the first request's payload and are dropped at
+        resolution."""
+        import jax
+
+        N, d = cell.n_bucket, cell.d
+        B = batch_bucket(len(requests), self.max_batch)
+        G = np.zeros((B, N, d), dtype=np.float32)
+        active = np.zeros((B, N), dtype=bool)
+        for i, r in enumerate(requests):
+            G[i, :r.n] = r.matrix
+            active[i, :r.n] = True
+        for i in range(len(requests), B):
+            G[i], active[i] = G[0], active[0]
+        if recorder.active() is not None:
+            recorder.active().gauge("serve_batch_occupancy",
+                                    len(requests) / B, cell=repr(cell))
+        program = self.cache.get(cell, B)
+        # device_put then call: the jitted program donates the big buffer
+        # where the backend honors donation (`ProgramCache.donate`)
+        out = program(jax.device_put(G), jax.device_put(active))
+        return out
+
+    def _resolve(self, out, requests):
+        """Block until the batch leaves the device, then fulfill futures
+        (resolver thread — the only place the host waits on the device)."""
+        host = {k: np.asarray(v) for k, v in out.items()}
+        now = time.monotonic()
+        for i, r in enumerate(requests):
+            verdicts = None
+            if r.cell.diagnostics and r.client_ids is not None:
+                with self._suspicion_lock:
+                    verdicts = self.suspicion.observe(
+                        r.client_ids,
+                        host["selection"][i, :r.n],
+                        distances=host["worker_dist"][i, :r.n])
+            result = AggregateResult(
+                aggregate=host["aggregate"][i],
+                f_eff=int(host["f_eff"][i]),
+                n=r.n, cell=r.cell, verdicts=verdicts,
+                latency_ms=(now - r.t_submit) * 1000.0)
+            self._served += 1
+            if not r.future.done():
+                r.future.set_result(result)
+
+    # ------------------------------------------------------------------ #
+    # Observability / lifecycle
+
+    def stats(self):
+        """Counter snapshot (the front end's `stats` op, the heartbeat
+        payload, the load generator's occupancy report)."""
+        return {
+            "requests": self._requests,
+            "served": self._served,
+            "rejected": self._rejected,
+            "queue_depth": self.batcher.depth(),
+            "cache": self.cache.stats(),
+            "suspicion": self.suspicion.summary(),
+        }
+
+    def _beat_loop(self, interval):
+        # First beat immediately: a supervisor adopting a fresh server
+        # must see liveness before the first interval elapses
+        self._write_heartbeat()
+        while not self._beat_stop.wait(interval):
+            self._write_heartbeat()
+
+    def _write_heartbeat(self):
+        if self.directory is None:
+            return
+        stats = self.stats()
+        write_heartbeat(self.directory, {
+            "step": stats["served"], "status": "serving", **stats})
+
+    def close(self):
+        """Drain in-flight work and stop the threads. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.close()
+        self._beat_stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2.0)
+            self._write_heartbeat()
+        recorder.emit("serve_stop", **{k: v for k, v in self.stats().items()
+                                       if k in ("requests", "served",
+                                                "rejected")})
+        if self._telemetry is not None:
+            recorder.deactivate()
+            self._telemetry.close()
+            self._telemetry = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -122,9 +122,18 @@ class Jobs:
     def submit(self, name, command):
         """Queue one experiment under `name`; it expands into one run per
         seed, each appending `--seed <s> --result-directory <dir>`
-        (reference `tools/jobs.py:193-217`)."""
+        (reference `tools/jobs.py:193-217`).
+
+        A seed of None queues ONE seedless run under the bare `name` (no
+        `--seed` flag, no name suffix) — the service-job form: long-lived
+        processes like the aggregation server
+        (`python -m byzantinemomentum_tpu.serve --result-directory ...`)
+        write the same `heartbeat.json` a training run does, so
+        `seeds=(None,)` plus `heartbeat_timeout` gives them the exact
+        watchdog/kill/retry supervision runs get."""
         for seed in self.seeds:
-            self._queue.put((f"{name}-{seed}", seed, list(command)))
+            run_name = name if seed is None else f"{name}-{seed}"
+            self._queue.put((run_name, seed, list(command)))
 
     # ------------------------------------------------------------------ #
     # Crash-recovery helpers
@@ -189,9 +198,9 @@ class Jobs:
             _log.trace(f"{run_name}: already done, skipping")
             return
         pending = self._prepare_pending(run_name)
-        cmd = command + ["--seed", str(seed),
-                         "--device", slot_device,
-                         "--result-directory", str(pending)]
+        cmd = command + (["--seed", str(seed)] if seed is not None else [])
+        cmd += ["--device", slot_device,
+                "--result-directory", str(pending)]
         if self.resume_flag and self.resume_flag not in cmd:
             # Retries/adoptions resume from the pending dir's newest valid
             # checkpoint; on a fresh dir the flag is a no-op cold start

@@ -11,7 +11,11 @@ Raw `bench.py` output JSON (the payload without the wrapper) is accepted
 too, as is an `attribution.json` (`"kind": "attribution"`): for those the
 diff runs over per-phase ms/step, the relayout-copy budget and the
 host-gap fraction — COST metrics, so the gate fails on *growth* past the
-tolerance. That is the phase-budget gate: a PR that regrows the relayout
+tolerance. A `BENCH_serve.json` pair (`"kind": "serve"`,
+`scripts/serve_loadgen.py`) gates the aggregation service the same way:
+p50/p99 latencies are costs (growth fails), aggregations/s and the
+batched-vs-sequential speedup are rates (drops fail), and cross-backend
+pairs are INCOMPARABLE. That is the phase-budget gate: a PR that regrows the relayout
 copies or host gaps the r5 packing work removed (PERF_NOTES.md) fails CI
 here instead of silently eating the win inside an unchanged steps/s
 tolerance band.
@@ -39,7 +43,8 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-__all__ = ["load_artifact", "compare", "compare_attribution", "main"]
+__all__ = ["load_artifact", "compare", "compare_attribution",
+           "compare_serve", "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -144,6 +149,55 @@ def compare_attribution(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+# Serve latency cells below this many ms are scheduler noise on any
+# host; the gate never fails on their relative growth alone
+_SERVE_FLOOR_MS = 0.5
+
+
+def _serve_metrics(payload):
+    """Flatten a serve artifact (`scripts/serve_loadgen.py`) into
+    `{(name, is_cost): value}`: per-cell p50/p99 latencies are COSTS
+    (growth regresses), aggregations/s are RATES (drop regresses)."""
+    metrics = {}
+    for cell, fields in (payload.get("cells") or {}).items():
+        if not isinstance(fields, dict):
+            continue
+        for key, cost in (("p50_ms", True), ("p99_ms", True),
+                          ("agg_per_sec", False)):
+            value = fields.get(key)
+            if isinstance(value, (int, float)):
+                metrics[(f"{cell}.{key}", cost)] = float(value)
+    value = payload.get("speedup_batched_vs_sequential")
+    if isinstance(value, (int, float)):
+        metrics[("speedup_batched_vs_sequential", False)] = float(value)
+    return metrics
+
+
+def compare_serve(old_payload, new_payload, tolerance):
+    """The serve-latency gate: `(rows, regressions)` over metrics present
+    in BOTH artifacts. Latency costs regress by GROWING past tolerance
+    (with the `_SERVE_FLOOR_MS` absolute floor, as the phase-budget
+    gate), throughput rates by DROPPING past it."""
+    old_metrics = _serve_metrics(old_payload)
+    new_metrics = _serve_metrics(new_payload)
+    rows = []
+    regressions = []
+    for (name, cost) in sorted(old_metrics, key=lambda k: k[0]):
+        if (name, cost) not in new_metrics:
+            continue
+        old, new = old_metrics[(name, cost)], new_metrics[(name, cost)]
+        delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                   else float("inf"))
+        rows.append((name, old, new, delta))
+        if cost:
+            if (new > old * (1.0 + tolerance)
+                    and new - old > _SERVE_FLOOR_MS):
+                regressions.append((name, old, new, delta))
+        elif delta < -tolerance:
+            regressions.append((name, old, new, delta))
+    return rows, regressions
+
+
 def _latest_pair():
     found = sorted(ROOT.glob("BENCH_r*.json"))
     if len(found) < 2:
@@ -194,6 +248,36 @@ def main(argv=None):
     print(f"bench_compare: {pathlib.Path(old_path).name} -> "
           f"{pathlib.Path(new_path).name} "
           f"(tolerance {args.tolerance * 100:.1f}%)")
+
+    is_serve = [p.get("kind") == "serve" for p in payloads]
+    if any(is_serve):
+        # Serve-latency gate over two BENCH_serve.json artifacts
+        if not all(is_serve):
+            print("bench_compare: INCOMPARABLE — one artifact is a serve "
+                  "load report, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — serve runs from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        rows, regressions = compare_serve(old_payload, new_payload,
+                                          args.tolerance)
+        if not rows:
+            print("  no common serve metrics; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.3f} -> {new:10.3f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} serve metric(s) "
+                  f"regressed past the {args.tolerance * 100:.1f}% "
+                  f"tolerance")
+            return 1
+        return 0
 
     is_attr = [p.get("kind") == "attribution" for p in payloads]
     if any(is_attr):

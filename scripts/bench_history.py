@@ -20,6 +20,13 @@ backend renders with its backend noted, since phase budgets are only
 comparable within one backend (the `bench_compare.py` attribution-gate
 discipline).
 
+The aggregation-service trajectory rides along the same way: per-round
+`BENCH_serve_r*.json` load reports (`scripts/serve_loadgen.py`, with the
+working tree's `BENCH_serve.json` as `current`) render serve p50/p99
+latency and aggregations/s columns — the quantities the batching layer
+moves and a serving regression would regrow; non-TPU load reports are
+backend-noted like the attribution column.
+
 Incomparability discipline (as `bench_compare.py`): a crashed round
 (`rc != 0`, no parsed payload — e.g. the BENCH_r05 down-tunnel crash), a
 `cpu-fallback` round, or a legacy artifact whose payload predates the
@@ -42,7 +49,8 @@ sys.path.insert(0, str(ROOT / "scripts"))
 
 from bench_compare import load_artifact, _rates  # noqa: E402
 
-__all__ = ["collect_history", "render_table", "main", "GAR_COLUMN"]
+__all__ = ["collect_history", "collect_serve", "render_table", "main",
+           "GAR_COLUMN", "SERVE_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -76,6 +84,49 @@ def _gar_ms(root, label):
     return (total if seen else None), payload.get("backend")
 
 
+# Aggregation-service trajectory columns (`scripts/serve_loadgen.py`
+# artifacts): open-loop latency percentiles + saturation throughput
+SERVE_COLUMNS = ("serve p50 ms", "serve p99 ms", "serve agg/s")
+
+
+def _serve_stats(root, label):
+    """`{p50, p99, rate, backend} | None` for one round's serve artifact:
+    `BENCH_serve_r*.json` per round, the working tree's
+    `BENCH_serve.json` for the `current` row."""
+    name = ("BENCH_serve.json" if label == "current"
+            else f"BENCH_serve_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or payload.get("kind") != "serve":
+        return None
+    cells = payload.get("cells") or {}
+    open_loop = cells.get("serve.open_loop") or {}
+    batched = cells.get("serve.batched") or {}
+
+    def num(d, key):
+        v = d.get(key)
+        return float(v) if isinstance(v, (int, float)) else None
+
+    stats = {"p50": num(open_loop, "p50_ms"),
+             "p99": num(open_loop, "p99_ms"),
+             "rate": num(batched, "agg_per_sec"),
+             "backend": payload.get("backend")}
+    if all(stats[k] is None for k in ("p50", "p99", "rate")):
+        return None  # legacy/foreign payload with no renderable cell
+    return stats
+
+
+def collect_serve(root, labels):
+    """{label: serve stats} over the rows `collect_history` produced
+    (absent labels simply have no serve artifact — the instruments stay
+    independent, the bench_compare discipline)."""
+    return {label: stats for label in labels
+            if (stats := _serve_stats(root, label)) is not None}
+
+
 def collect_history(root=ROOT):
     """[(label, rates | None, reason | None, gar)] over every round
     artifact (sorted by round number) plus the working tree's
@@ -92,23 +143,28 @@ def collect_history(root=ROOT):
         m = _ROUND.search(path.name)
         if m:
             rounds[int(m.group(1))] = path
-    # Rounds with only an attribution artifact (e.g. a round whose bench
-    # run never happened off-TPU) still get a row: the two instruments
-    # are independent and the gar column must not wait for steps/s
-    for path in root.glob("ATTRIB_r*.json"):
-        m = re.search(r"ATTRIB_r(\d+)\.json$", path.name)
-        if m:
-            rounds.setdefault(int(m.group(1)), None)
+    # Rounds with only an attribution or serve artifact (e.g. a round
+    # whose bench run never happened off-TPU) still get a row: the
+    # instruments are independent and their columns must not wait for
+    # steps/s
+    for glob, pattern in (("ATTRIB_r*.json", r"ATTRIB_r(\d+)\.json$"),
+                          ("BENCH_serve_r*.json",
+                           r"BENCH_serve_r(\d+)\.json$")):
+        for path in root.glob(glob):
+            m = re.search(pattern, path.name)
+            if m:
+                rounds.setdefault(int(m.group(1)), None)
     labels = [f"r{number:02d}" for number in sorted(rounds)]
     paths = [rounds[number] for number in sorted(rounds)]
     current = root / "BENCH_cells.json"
-    if current.is_file() or (root / "attribution.json").is_file():
+    if (current.is_file() or (root / "attribution.json").is_file()
+            or (root / "BENCH_serve.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
     for label, path in zip(labels, paths):
         if path is None:
             rates, reason = None, (f"{label}: no benchmark artifact "
-                                   f"(attribution only)")
+                                   f"(attribution/serve only)")
         else:
             rates, reason = _load_rates(path)
         ms, backend = _gar_ms(root, label)
@@ -131,24 +187,28 @@ def _load_rates(path):
     return rates, None
 
 
-def render_table(history):
+def render_table(history, serve=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
-    attribution column when any round carries an artifact."""
+    attribution column and the serve p50/p99/throughput columns when any
+    round carries the matching artifact."""
+    serve = serve or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
             if name not in columns:
                 columns.append(name)
     any_gar = any(gar is not None for _, _, _, gar in history)
-    if not columns and not any_gar:
+    if not columns and not any_gar and not serve:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
         return "\n".join(lines)
     if any_gar:
         columns = columns + [GAR_COLUMN]
+    if serve:
+        columns = columns + list(SERVE_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -164,10 +224,21 @@ def render_table(history):
             # as a device regression/win
             notes.append(f"  {label}: gar ms/step from a "
                          f"backend={gar[1]} attribution artifact")
+        row_serve = serve.get(label)
+        if row_serve is not None and row_serve.get("backend") not in (
+                None, "tpu"):
+            notes.append(f"  {label}: serve columns from a "
+                         f"backend={row_serve['backend']} load report")
 
         def cell(c, w):
             if c == GAR_COLUMN:
                 return f"{gar[0]:>{w}.3f}" if gar is not None else f"{'-':>{w}}"
+            if c in SERVE_COLUMNS:
+                key = {"serve p50 ms": "p50", "serve p99 ms": "p99",
+                       "serve agg/s": "rate"}[c]
+                value = None if row_serve is None else row_serve.get(key)
+                return (f"{value:>{w}.3f}" if value is not None
+                        else f"{'-':>{w}}")
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -197,14 +268,17 @@ def main(argv=None):
     if not history:
         print("bench_history: no BENCH_r*.json artifacts found")
         return 0
+    serve = collect_serve(pathlib.Path(args.root),
+                          [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
              "gar_ms_per_step": None if gar is None else gar[0],
-             "gar_backend": None if gar is None else gar[1]}
+             "gar_backend": None if gar is None else gar[1],
+             "serve": serve.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
-    print(render_table(history))
+    print(render_table(history, serve))
     return 0
 
 

@@ -153,6 +153,44 @@ def main():
     telemetry.counter("tests_failed", nopallas["failed"])
     print(f"  {nopallas}", flush=True)
 
+    # Serve tier (PR 8): the aggregation-service selfcheck (warm-loop
+    # zero-recompile budget, suspicion path, socket round-trip) plus the
+    # load generator's smoke path — the serving substrate gets its own
+    # green bit and telemetry span like every other subsystem
+    print("serve tier ...", flush=True)
+    with telemetry.span("tier_serve"):
+        serve_check = subprocess.run(
+            [sys.executable, "-m", "byzantinemomentum_tpu.serve",
+             "--selfcheck"],
+            cwd=ROOT, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        serve_load = subprocess.run(
+            [sys.executable, "scripts/serve_loadgen.py", "--smoke"],
+            cwd=ROOT, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    serve_tier = {"selfcheck": serve_check.returncode,
+                  "loadgen": serve_load.returncode,
+                  "returncode": serve_check.returncode
+                  or serve_load.returncode}
+    for label, proc in (("selfcheck", serve_check), ("loadgen", serve_load)):
+        if proc.returncode != 0:
+            serve_tier[f"{label}_tail"] = (proc.stdout
+                                           + proc.stderr).splitlines()[-12:]
+    smoke_line = None
+    for line in serve_load.stdout.splitlines():
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and parsed.get("kind") == "serve":
+            smoke_line = parsed
+    if smoke_line is not None:
+        serve_tier["speedup"] = smoke_line.get(
+            "speedup_batched_vs_sequential")
+    telemetry.event("serve_tier", **{k: v for k, v in serve_tier.items()
+                                     if not k.endswith("_tail")})
+    print(f"  {serve_tier}", flush=True)
+
     shards = {}
     for path in sorted((ROOT / "tests").glob("test_*.py")):
         print(f"slow tier: {path.name} ...", flush=True)
@@ -183,6 +221,7 @@ def main():
         "lint_tier": lint_tier,
         "default_tier": default,
         "nopallas_tier": nopallas,
+        "serve_tier": serve_tier,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
         "telemetry": telemetry.path.name,
@@ -193,6 +232,7 @@ def main():
                       and lint_tier["returncode"] == 0
                       and nopallas["failed"] == 0
                       and nopallas["returncode"] == 0
+                      and serve_tier["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }

