@@ -1,28 +1,38 @@
 """CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints;
-`--check-lowerings` runs the StableHLO drift gate; `--rules` prints the
-registry. Exit 0 = clean (or incomparable goldens), 1 = violations/drift,
-2 = usage error."""
+`--check-lowerings` runs the lattice drift gate (StableHLO fingerprints
++ BMT-H structural lint over every enumerated cell); `--rules` prints
+both registries (jaxlint BMT-E, hlolint BMT-H). Exit 0 = clean (or
+incomparable goldens), 1 = violations/drift, 2 = usage error."""
 
 import argparse
 import json
 import sys
 
-from byzantinemomentum_tpu.analysis import lint
+from byzantinemomentum_tpu.analysis import hlolint, lint
 
 
 def _print_rules():
-    width = max(len(r.slug) for r in lint.RULES.values())
-    for rule_id in sorted(lint.RULES):
-        r = lint.RULES[rule_id]
+    """Both registries, one table: the AST rules (E) over source and the
+    structural rules (H) over lowered programs."""
+    rules = {**lint.RULES, **hlolint.HLO_RULES}
+    width = max(len(r.slug) for r in rules.values())
+    for rule_id in sorted(rules):
+        r = rules[rule_id]
         print(f"{r.id}  {r.slug:<{width}}  {r.summary}")
 
 
 def _check_lowerings(goldens, as_json):
     # Pin the CPU backend for deterministic fingerprints (this
     # environment's sitecustomize may force a TPU platform; see
-    # tests/conftest.py for why the config update is load-bearing)
+    # tests/conftest.py for why the config update is load-bearing), and
+    # force the virtual host device count the mesh lattice cells need —
+    # both only effective before jax initializes its backend
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
     from byzantinemomentum_tpu.analysis import lowering
@@ -37,6 +47,8 @@ def _check_lowerings(goldens, as_json):
         for key in ("drifted", "added", "removed"):
             for cell in report.get(key, ()):
                 print(f"  {key}: {cell}")
+        for v in report.get("violations", ()):
+            print(f"  {v['path']}:{v['line']}: {v['rule']} {v['message']}")
         if report["status"] == "missing":
             print(f"  no goldens at {report['path']} — run "
                   f"scripts/bless_lowerings.py")

@@ -126,12 +126,32 @@ def assert_recompile_budget(step_fn, *, steps=3, budget=0, explain=True,
 
 
 @contextlib.contextmanager
-def no_implicit_transfers():
+def no_implicit_transfers(scope="thread"):
     """`jax.transfer_guard("disallow")` with the contract's framing: inside
     the context any implicit device<->host transfer (un-committed inputs,
     Python scalar arguments, `np.asarray` on device values) raises.
-    Explicit `jax.device_put`/`jax.device_get` remain allowed."""
+    Explicit `jax.device_put`/`jax.device_get` remain allowed.
+
+    `scope="thread"` (default) uses the thread-local context manager —
+    right for a hot loop that dispatches on the calling thread.
+    `scope="process"` sets the guard through the global config (restoring
+    the previous value on exit), so worker threads are covered too — the
+    serve selfcheck needs this: its dispatch and device-wait happen on
+    the microbatcher's flusher/resolver daemon threads, which a
+    thread-local guard on the submitting thread would never see."""
     import jax
 
-    with jax.transfer_guard("disallow"):
+    if scope == "thread":
+        with jax.transfer_guard("disallow"):
+            yield
+        return
+    if scope != "process":
+        raise ValueError(
+            f"Unknown transfer-guard scope {scope!r}; expected "
+            f"'thread' or 'process'")
+    old = jax.config.jax_transfer_guard
+    jax.config.update("jax_transfer_guard", "disallow")
+    try:
         yield
+    finally:
+        jax.config.update("jax_transfer_guard", old)

@@ -1,0 +1,223 @@
+"""hlolint — a structural linter over LOWERED programs (StableHLO text).
+
+jaxlint (`analysis/lint.py`) sees the source; the lowering goldens
+(`analysis/lowering.py`) see the final bytes. This module checks the
+layer in between: properties of the lowered program that a fingerprint
+cannot *explain* and an AST cannot *see*. Every rule reads the StableHLO
+module text that `jax.jit(...).lower(...).as_text()` produces — no
+execution, no backend beyond what the lowering itself needed.
+
+Rules (`BMT-H01..H05`; listed by `python -m byzantinemomentum_tpu.analysis
+--rules` next to the E-rules):
+
+  BMT-H01  collective-census    a cell's `stablehlo.all_reduce` count must
+                                equal what its builder declares (sharded
+                                selection rules psum exactly one Gram;
+                                coordinate-wise rules psum nothing).
+  BMT-H02  worker-matrix-gather an `stablehlo.all_gather` producing a
+                                tensor at worker-matrix scale — the whole
+                                point of the psum'd-Gram kernels is that
+                                the (n, d) matrix never crosses ICI.
+  BMT-H03  donation-dropped     `donate_argnums` was requested but the
+                                argument carries no `tf.aliasing_output`
+                                attribute — the buffer would be copied,
+                                not consumed in place.
+  BMT-H04  f64-in-program       a `tensor<..xf64>` type anywhere — an
+                                accidental float64 promotion (every hot
+                                path here is f32/bf16 by design).
+  BMT-H05  host-callback        a `stablehlo.custom_call` to a python
+                                callback target in the lowered program —
+                                a host round-trip on the hot path.
+
+H01–H03 are *contract* rules: they only fire against an `Expect`
+declaring what the builder intended (per-cell expectations come from
+`analysis/lattice.py`). H04/H05 are unconditional.
+
+Violations reuse the jaxlint `Violation` shape (path = the cell label,
+line = the offending line of the StableHLO text), so the CLI renders
+both registries uniformly.
+"""
+
+import dataclasses
+import re
+
+from byzantinemomentum_tpu.analysis.lint import Rule, Violation
+
+__all__ = ["HLO_RULES", "Expect", "lint_module"]
+
+# id -> Rule. A separate registry from lint.RULES: these rules take
+# (text, expect, label), not a parsed source module.
+HLO_RULES = {}
+
+
+def _rule(rule_id, slug, summary):
+    def wrap(fn):
+        HLO_RULES[rule_id] = Rule(rule_id, slug, summary, fn)
+        return fn
+    return wrap
+
+
+@dataclasses.dataclass(frozen=True)
+class Expect:
+    """What a cell's builder declares about its lowered program.
+
+    Attributes:
+      psums: exact `stablehlo.all_reduce` count (None = H01 skips).
+      gather_limit: max element count an `stablehlo.all_gather` may
+        produce; the lattice sets `n*d - 1` so gathering the worker
+        matrix (or anything bigger) fails (None = H02 skips).
+      donated: argument indices of `@main` that must carry the
+        `tf.aliasing_output` input/output-aliasing attribute (empty =
+        H03 skips).
+    """
+
+    psums: int = None
+    gather_limit: int = None
+    donated: tuple = ()
+
+
+_TENSOR = re.compile(r"tensor<([0-9x]*)x?(f64|f32|f16|bf16|i\d+|ui\d+|i1)>")
+
+
+def _tensor_elements(type_text):
+    """Element count of the FIRST tensor type in `type_text` (1 for a
+    scalar tensor<f32>), or None."""
+    m = _TENSOR.search(type_text)
+    if m is None:
+        return None
+    dims = m.group(1)
+    count = 1
+    for d in dims.split("x"):
+        if d:
+            count *= int(d)
+    return count
+
+
+def _op_lines(text, op):
+    """(lineno, line) pairs where `op` is applied (generic or pretty MLIR
+    spelling), excluding mentions inside attribute strings."""
+    pat = re.compile(r"(=|^|\s)\"?" + re.escape(op) + r"\"?\s*[(<]")
+    return [(i, line) for i, line in enumerate(text.splitlines(), 1)
+            if pat.search(line)]
+
+
+@_rule("BMT-H01", "collective-census",
+       "the lowered program's all_reduce count differs from what the "
+       "cell's builder declares")
+def _check_collective_census(text, expect, label):
+    if expect is None or expect.psums is None:
+        return []
+    hits = _op_lines(text, "stablehlo.all_reduce")
+    if len(hits) == expect.psums:
+        return []
+    line = hits[0][0] if hits else 0
+    return [Violation(
+        label, line, 0, "BMT-H01",
+        f"expected exactly {expect.psums} all_reduce collective(s), "
+        f"found {len(hits)} — the cell's communication pattern drifted "
+        f"from its builder's declaration")]
+
+
+@_rule("BMT-H02", "worker-matrix-gather",
+       "an all_gather materializes a tensor at worker-matrix scale "
+       "(the (n, d) matrix must never be gathered)")
+def _check_worker_matrix_gather(text, expect, label):
+    if expect is None or expect.gather_limit is None:
+        return []
+    out = []
+    for lineno, line in _op_lines(text, "stablehlo.all_gather"):
+        # The result type is the LAST tensor type on the op line
+        # (`... : (tensor<11x8xf32>) -> tensor<11x16xf32>`)
+        types = _TENSOR.findall(line)
+        result = line[line.rfind("tensor<"):] if types else ""
+        elements = _tensor_elements(result)
+        if elements is not None and elements > expect.gather_limit:
+            out.append(Violation(
+                label, lineno, 0, "BMT-H02",
+                f"all_gather produces {result.split('>')[0]}> "
+                f"({elements} elements > budget {expect.gather_limit}) — "
+                f"the worker matrix is crossing the interconnect; psum "
+                f"the Gram instead"))
+    return out
+
+
+@_rule("BMT-H03", "donation-dropped",
+       "donate_argnums was requested but the lowered argument carries no "
+       "input/output aliasing")
+def _check_donation(text, expect, label):
+    if expect is None or not expect.donated:
+        return []
+    m = re.search(r"func\.func (?:public )?@main\((.*?)\)\s*->", text,
+                  re.DOTALL)
+    if m is None:
+        return [Violation(label, 1, 0, "BMT-H03",
+                          "no @main function found in the lowered module")]
+    signature = m.group(1)
+    lineno = text[:m.start()].count("\n") + 1
+    # Split the signature on top-level argument boundaries (%argN markers)
+    args = re.split(r"(?=%arg\d+\s*:)", signature)
+    args = [a for a in args if a.strip()]
+    out = []
+    for pos in expect.donated:
+        aliased = (pos < len(args)
+                   and "tf.aliasing_output" in args[pos])
+        if not aliased:
+            out.append(Violation(
+                label, lineno, 0, "BMT-H03",
+                f"argument {pos} was declared donated but carries no "
+                f"tf.aliasing_output aliasing — the runtime will copy "
+                f"instead of consuming the buffer in place"))
+    return out
+
+
+@_rule("BMT-H04", "f64-in-program",
+       "a tensor<..xf64> type appears in the lowered program "
+       "(accidental float64 promotion)")
+def _check_f64(text, expect, label):
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if re.search(r"tensor<[0-9x]*f64>", line):
+            out.append(Violation(
+                label, lineno, 0, "BMT-H04",
+                "f64 tensor in the lowered program — every hot path is "
+                "f32/bf16 by design; find the promoting constant or cast"))
+            break  # one report per module is enough
+    return out
+
+
+_CALLBACK = re.compile(
+    r"stablehlo\.custom_call\"?\s*.*@\"?(\w*python\w*callback\w*|"
+    r"xla_ffi_partitioned_python\w*)\"?")
+
+
+@_rule("BMT-H05", "host-callback",
+       "a python host-callback custom_call in the lowered program "
+       "(host round-trip on the hot path)")
+def _check_host_callback(text, expect, label):
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if "custom_call" in line and _CALLBACK.search(line):
+            out.append(Violation(
+                label, lineno, 0, "BMT-H05",
+                "host python callback in the lowered program — the hot "
+                "path must not synchronize with the host (io_callback/"
+                "pure_callback/debug.print leak into the trace)"))
+    return out
+
+
+def lint_module(text, expect=None, label="<lowered>", rules=None):
+    """Run the BMT-H rules over one lowered module's StableHLO text.
+
+    Args:
+      text: `lowered.as_text()` output.
+      expect: optional `Expect` enabling the contract rules (H01-H03).
+      label: cell name for the violation's path field.
+      rules: optional rule-id subset.
+    Returns a sorted list of `Violation`.
+    """
+    selected = HLO_RULES if rules is None else {
+        k: v for k, v in HLO_RULES.items() if k in rules}
+    out = []
+    for r in selected.values():
+        out.extend(r.check(text, expect, label))
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))

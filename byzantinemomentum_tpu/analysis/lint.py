@@ -754,27 +754,66 @@ def _check_dynamic_scope_name(mod):
 
 
 # --------------------------------------------------------------------------- #
+# BMT-E09 — dead suppressions (annotations must not rot)
+
+@rule("BMT-E09", "dead-noqa",
+      "a `# bmt: noqa[RULE]` whose RULE no longer fires on that line "
+      "(the annotation rotted; drop it)")
+def _check_dead_noqa(mod):
+    # Driver-implemented: deciding deadness needs every OTHER rule's
+    # pre-suppression hits for the line, which only `lint_source` holds.
+    return ()
+
+
+def _dead_noqa_violations(mod, selected, fired):
+    """BMT-E09 hits: suppressions naming a rule that was RUN this pass
+    (`selected`) but did not fire on the line (`fired`: line -> rule ids).
+    `all`-suppressions and unknown ids are out of scope (the latter are
+    BMT-E00's)."""
+    checkable = {rid for rid in selected if rid not in
+                 ("BMT-E00", "BMT-E09")}
+    out = []
+    for line, (ids, _reason) in sorted(mod.noqa.items()):
+        for rid in sorted(ids):
+            if rid in checkable and rid not in fired.get(line, ()):
+                out.append(Violation(
+                    mod.path, line, 0, "BMT-E09",
+                    f"dead suppression: {rid} does not fire on this line "
+                    f"anymore — drop the noqa (a rotten annotation hides "
+                    f"the next real violation)"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # Driver
 
 def lint_source(source, path="<string>", rules=None):
     """Lint one source string; returns the unsuppressed violations plus
-    any BMT-E00 suppression hygiene findings."""
+    the suppression-hygiene findings (BMT-E00 reasons, BMT-E09 dead
+    noqas)."""
     try:
         mod = Module(path, source)
     except SyntaxError as err:
         return [Violation(str(path), err.lineno or 0, 0, "BMT-E00",
                           f"file does not parse: {err.msg}")]
-    out = []
     selected = RULES if rules is None else {
         k: v for k, v in RULES.items() if k in rules}
+    raw = []
     for r in selected.values():
-        for v in r.check(mod):
-            ids_reason = mod.noqa.get(v.line)
-            if ids_reason is not None and v.rule != "BMT-E00":
-                ids, reason = ids_reason
-                if (v.rule in ids or "all" in ids) and reason:
-                    continue  # suppressed, with a reason (E00 checks it)
-            out.append(v)
+        raw.extend(r.check(mod))
+    fired = {}  # line -> rule ids that fired there (pre-suppression)
+    for v in raw:
+        fired.setdefault(v.line, set()).add(v.rule)
+    if "BMT-E09" in selected:
+        raw.extend(_dead_noqa_violations(mod, selected, fired))
+    out = []
+    for v in raw:
+        ids_reason = mod.noqa.get(v.line)
+        if ids_reason is not None and v.rule != "BMT-E00":
+            ids, reason = ids_reason
+            if (v.rule in ids or "all" in ids) and reason:
+                continue  # suppressed, with a reason (E00 checks it)
+        out.append(v)
     return sorted(out, key=lambda v: (v.path, v.line, v.rule))
 
 

@@ -1,14 +1,24 @@
-"""Lowering contracts — golden StableHLO fingerprints per GAR cell.
+"""Lowering contracts — golden StableHLO fingerprints over the program
+lattice.
 
 `tests/test_diag.py` (PR 4) asserts one lowering invariant at one point
 in time: `diagnostics=False` lowers byte-identically to the raw kernels.
-This module generalizes that into a *blessed contract*: every
-(GAR x variant) cell — the plain kernel, the diagnostics kernel, and the
-masked dynamic-quorum degradation path — is lowered on a fixed spec,
-fingerprinted (sha256 of the StableHLO text), and compared against
-`tests/goldens/lowerings.json`. Any drift fails the lint tier until a
-human re-blesses (`scripts/bless_lowerings.py`) — compilation behavior
-becomes a reviewed artifact, not a silent side effect of a refactor.
+This module generalizes that into a *blessed contract* over the whole
+program lattice: every cell the builder enumerates
+(`analysis/lattice.py` — the GAR × {plain, diag, masked} kernels, their
+virtual-mesh sharded forms, and the serve-layer cell programs) is
+lowered on fixed abstract specs, fingerprinted (sha256 of the StableHLO
+text), and compared against `tests/goldens/lowerings.json`. Any drift
+fails the lint tier until a human re-blesses
+(`scripts/bless_lowerings.py`) — compilation behavior becomes a reviewed
+artifact, not a silent side effect of a refactor.
+
+The same lowering pass feeds the structural linter
+(`analysis/hlolint.py`): each cell's declared contract — collective
+census, no worker-matrix all-gather, donation honored — is checked
+against the text that was just fingerprinted, so `check()` reports both
+*that* a cell changed (fingerprint) and *what class of change* is
+forbidden outright (structure).
 
 Fingerprints are only comparable within one (jax version, backend) pair;
 a mismatch there reports `incomparable` (exit 0 with a message), the same
@@ -20,65 +30,29 @@ import hashlib
 import json
 import pathlib
 
-__all__ = ["GOLDENS_PATH", "CELL_GARS", "VARIANTS", "compute_cells",
-           "snapshot", "bless", "check"]
+__all__ = ["GOLDENS_PATH", "compute_cells", "snapshot", "bless", "check"]
 
 GOLDENS_PATH = (pathlib.Path(__file__).resolve().parents[2]
                 / "tests" / "goldens" / "lowerings.json")
-
-# Every first-tier registered rule with real kernels (the `native-` tier
-# shares these kernels; `template` declines its own check)
-CELL_GARS = ("average", "median", "trmean", "phocas", "meamed", "krum",
-             "bulyan", "aksel", "cge", "brute")
-VARIANTS = ("plain", "diag", "masked")
-
-# The canonical spec: the benchmark's n=11 worker grid, f=2, a d big
-# enough that every kernel takes its vectorized path
-N, D, F = 11, 16, 2
-
-
-def _cell_fn(gar, variant):
-    """The traceable program of one cell (call with aval specs only)."""
-    from byzantinemomentum_tpu.faults import quorum
-
-    if variant == "plain":
-        return lambda G: gar.unchecked(G, f=F)
-    if variant == "diag":
-        return lambda G: gar.diagnosed(G, f=F)
-    if variant == "masked":
-        return lambda G, active: quorum.masked_aggregate(
-            gar, G, active, f_decl=F, dynamic=True)
-    raise ValueError(f"Unknown lowering variant {variant!r}")
-
-
-def _cell_text(gar, variant):
-    import jax
-    import jax.numpy as jnp
-
-    spec = jax.ShapeDtypeStruct((N, D), jnp.float32)
-    mask = jax.ShapeDtypeStruct((N,), jnp.bool_)
-    args = (spec,) if variant != "masked" else (spec, mask)
-    return jax.jit(_cell_fn(gar, variant)).lower(*args).as_text()
 
 
 def fingerprint(text):
     return hashlib.sha256(text.encode("utf-8")).hexdigest()
 
 
-def compute_cells(gars=None, variants=None):
-    """name -> fingerprint over the (GAR x variant) grid (defaults read
-    the module attributes at call time, so tests can shrink the grid)."""
-    from byzantinemomentum_tpu import ops
+def _lowered(cells=None):
+    """Yield `(key, text, expect)` over the lattice (one lowering pass —
+    fingerprints and structural lint read the same text)."""
+    from byzantinemomentum_tpu.analysis import lattice
 
-    gars = CELL_GARS if gars is None else gars
-    variants = VARIANTS if variants is None else variants
-    cells = {}
-    for name in gars:
-        gar = ops.gars[name]
-        for variant in variants:
-            cells[f"{name}/{variant}"] = fingerprint(
-                _cell_text(gar, variant))
-    return cells
+    cells = lattice.enumerate_cells() if cells is None else cells
+    for cell in cells:
+        yield lattice.lower_cell(cell)
+
+
+def compute_cells(cells=None):
+    """name -> fingerprint over the enumerated lattice."""
+    return {key: fingerprint(text) for key, text, _ in _lowered(cells)}
 
 
 def snapshot():
@@ -86,17 +60,22 @@ def snapshot():
     coordinates they are only comparable under."""
     import jax
 
+    from byzantinemomentum_tpu.analysis import lattice
+
     return {
         "jax": jax.__version__,
         "backend": jax.default_backend(),
-        "spec": {"n": N, "d": D, "f": F},
+        "spec": lattice.spec_info(),
         "cells": compute_cells(),
     }
 
 
 def bless(path=GOLDENS_PATH):
     """(Re)write the goldens. Deterministic output (sorted keys, no
-    timestamps): blessing twice in one toolchain is byte-idempotent."""
+    timestamps): blessing twice in one toolchain is byte-idempotent.
+    Cells the enumerator no longer produces are pruned (the whole file is
+    the enumeration — `scripts/bless_lowerings.py` reports what fell
+    out)."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(snapshot(), indent=2, sort_keys=True) + "\n")
@@ -104,16 +83,22 @@ def bless(path=GOLDENS_PATH):
 
 
 def check(path=GOLDENS_PATH):
-    """Compare the current lowerings against the blessed goldens.
+    """Compare the current lattice against the blessed goldens, and run
+    the structural linter over every lowered cell.
 
     Returns a report dict with `status` one of:
-      "ok"            — every cell fingerprint matches;
+      "ok"            — every fingerprint matches and no structural
+                        violations;
       "drift"         — `drifted`/`added`/`removed` name the cells;
+      "lint"          — fingerprints match but `violations` lists
+                        BMT-H structural findings;
       "incomparable"  — goldens were blessed under another jax version or
                         backend (re-bless, do not fail CI on it);
       "missing"       — no goldens file (run scripts/bless_lowerings.py).
     """
     import jax
+
+    from byzantinemomentum_tpu.analysis import hlolint
 
     path = pathlib.Path(path)
     if not path.is_file():
@@ -125,12 +110,22 @@ def check(path=GOLDENS_PATH):
         return {"status": "incomparable", "blessed": {
             "jax": blessed.get("jax"), "backend": blessed.get("backend")},
             "current": here}
-    current = compute_cells()
+    current = {}
+    violations = []
+    for key, text, expect in _lowered():
+        current[key] = fingerprint(text)
+        violations.extend(hlolint.lint_module(text, expect, label=key))
     golden = blessed.get("cells", {})
     drifted = sorted(k for k in golden if k in current
                      and golden[k] != current[k])
     added = sorted(k for k in current if k not in golden)
     removed = sorted(k for k in golden if k not in current)
-    status = "ok" if not (drifted or added or removed) else "drift"
+    if drifted or added or removed:
+        status = "drift"
+    elif violations:
+        status = "lint"
+    else:
+        status = "ok"
     return {"status": status, "drifted": drifted, "added": added,
-            "removed": removed, "checked": len(current)}
+            "removed": removed, "checked": len(current),
+            "violations": [v.as_dict() for v in violations]}

@@ -10,6 +10,7 @@ handles milestones (eval/checkpoint/SIGINT), mirroring the reference's
 division of labor with the device.
 """
 
+from byzantinemomentum_tpu.engine import program
 from byzantinemomentum_tpu.engine.config import EngineConfig
 from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.engine.step import Engine, build_engine
@@ -17,5 +18,6 @@ from byzantinemomentum_tpu.engine.metrics import (
     FAULT_COLUMNS, FORENSIC_COLUMNS, RECOVERY_COLUMNS, STUDY_COLUMNS)
 
 __all__ = ["EngineConfig", "TrainState", "Engine", "build_engine",
+           "program",
            "FAULT_COLUMNS", "FORENSIC_COLUMNS", "RECOVERY_COLUMNS",
            "STUDY_COLUMNS"]

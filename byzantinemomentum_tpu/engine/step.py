@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from byzantinemomentum_tpu.engine import metrics as metrics_mod
+from byzantinemomentum_tpu.engine import program as program_mod
 from byzantinemomentum_tpu.engine.state import TrainState, init_state
 from byzantinemomentum_tpu.models import flatten_params
 from byzantinemomentum_tpu.models.core import BN_MOMENTUM
@@ -479,6 +480,12 @@ class Engine:
     # ----------------------------------------------------------------- #
     # Defense dispatch (single GAR or per-step random mixture)
     #
+    # The dispatchers below are thin wrappers over the compositional
+    # program builder (`engine/program.py`): each lattice axis — kernel
+    # variant (plain/diag/masked), mixture, sharding, placement — is a
+    # transform over ONE lowering path, and `analysis/lattice.py` lowers
+    # the same `defense_kernel` callables into the golden fingerprints.
+    #
     # DELIBERATE DIVERGENCE from the reference (default mode): a `--gars`
     # mixture here draws ONE GAR per step (`mix_u` is shared by the attack's
     # inner defense evaluations, the outer aggregation and the influence),
@@ -523,41 +530,24 @@ class Engine:
         return jax.random.uniform(jax.random.fold_in(key, h))
 
     def _run_defense(self, G, mix_u):
-        cfg = self.cfg
-        with jax.named_scope("gar"):
-            if len(self.defenses) == 1:
-                gar, _, kwargs = self.defenses[0]
-                return gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs)
-            branches = [
-                (lambda G, gar=gar, kwargs=kwargs:
-                 gar.unchecked(G, f=cfg.nb_decl_byz, **kwargs))
-                for gar, _, kwargs in self.defenses
-            ]
-            return lax.switch(self._mixture_index(mix_u), branches, G)
+        """Thin wrapper over the compositional builder
+        (`engine/program.py`): the plain-variant defense program over this
+        engine's defense list."""
+        return program_mod.defense_program(
+            self.defenses, "plain", f=self.cfg.nb_decl_byz)(G, mix_u)
 
     def _run_defense_diag(self, G, mix_u):
-        """`_run_defense` through the diagnostics kernels: returns
+        """The diag-variant defense program (`engine/program.py`): returns
         `(aggregate, aux)` with the uniform `ops/diag.py` aux schema (the
         schema uniformity is what lets a `--gars` mixture `lax.switch`
         over the diagnostic branches). Only traced when
         `cfg.gar_diagnostics` — the False path compiles the exact
         pre-diagnostics program."""
-        cfg = self.cfg
-        with jax.named_scope("gar_diag"):
-            if len(self.defenses) == 1:
-                gar, _, kwargs = self.defenses[0]
-                return gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs)
-            branches = [
-                (lambda G, gar=gar, kwargs=kwargs:
-                 gar.diagnosed(G, f=cfg.nb_decl_byz, **kwargs))
-                for gar, _, kwargs in self.defenses
-            ]
-            return lax.switch(self._mixture_index(mix_u), branches, G)
+        return program_mod.defense_program(
+            self.defenses, "diag", f=self.cfg.nb_decl_byz)(G, mix_u)
 
     def _mixture_index(self, mix_u):
-        cum = jnp.asarray([fc for _, fc, _ in self.defenses], jnp.float32)
-        return jnp.searchsorted(cum, mix_u * cum[-1], side="right").astype(
-            jnp.int32).clip(0, len(self.defenses) - 1)
+        return program_mod.mixture_index(self.defenses, mix_u)
 
     def _run_influence(self, G_honest, G_attack, mix_u):
         cfg = self.cfg
@@ -780,27 +770,12 @@ class Engine:
                 diag_metrics)
 
     def _run_defense_masked(self, G, mix_u, active):
-        """`_run_defense` under the degradation policy: aggregate the
-        active rows only, with the per-GAR effective quorum
+        """The masked-variant defense program (`engine/program.py`):
+        aggregate the active rows only, with the per-GAR effective quorum
         (`faults/quorum.py`). Returns (f32[d], i32[] effective f)."""
-        from byzantinemomentum_tpu.faults import quorum as quorum_mod
-
-        cfg = self.cfg
-
-        def one(gar, kwargs, G):
-            return quorum_mod.masked_aggregate(
-                gar, G, active, f_decl=cfg.nb_decl_byz,
-                dynamic=cfg.fault_dynamic_quorum, **kwargs)
-
-        with jax.named_scope("gar_masked"):
-            if len(self.defenses) == 1:
-                gar, _, kwargs = self.defenses[0]
-                return one(gar, kwargs, G)
-            branches = [
-                (lambda G, gar=gar, kwargs=kwargs: one(gar, kwargs, G))
-                for gar, _, kwargs in self.defenses
-            ]
-            return lax.switch(self._mixture_index(mix_u), branches, G)
+        return program_mod.defense_program(
+            self.defenses, "masked", f=self.cfg.nb_decl_byz,
+            dynamic=self.cfg.fault_dynamic_quorum)(G, mix_u, active)
 
     def _train_step(self, state: TrainState, xs, ys, lr):
         """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
@@ -920,59 +895,11 @@ class Engine:
 
 
 def make_device_gar_step(engine, gar_device):
-    """Heterogeneous GAR placement — the reference's `--device-gar`
-    (reference `attack.py:461-465`, `:811-827`): the defense phase (attack
-    synthesis + aggregation + influence) runs on a different device, with
-    the honest gradient matrix hopping there and the Byzantine rows +
-    defense gradient hopping back EVERY step — three separately-compiled
-    programs instead of one fused one.
-
-    The whole defense phase hops, so an adaptive attack's line search runs
-    entirely on the GAR device (the reference instead moved the stack on
-    every inner defense call, `attack.py:505-510` — one hop per step is the
-    faithful-but-not-pathological placement; the arithmetic is identical).
-
-    Note: this path uses plain cross-device `device_put` transfers, NOT host
-    callbacks, so it works on backends without send/recv callback support.
-
-    Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
-    `engine.train_step`.
-    """
-    from byzantinemomentum_tpu.ops import pallas_sort
-
-    dev = jax.devices(gar_device)[0]
-    pre = jax.jit(engine._phase_honest)
-    # `state` is dead after the post call, so donate it as the fused
-    # train_step does — otherwise the hop path doubles peak state memory
-    post = jax.jit(engine._phase_update, static_argnums=(11,),
-                   donate_argnums=(0,))
-
-    def mid_traced(G_honest, mix_key, fault):
-        if dev.platform != "tpu":
-            # The GAR device cannot run Mosaic kernels
-            with pallas_sort.disabled():
-                return engine._phase_defense(G_honest, mix_key, fault)
-        return engine._phase_defense(G_honest, mix_key, fault)
-
-    mid = jax.jit(mid_traced)
-
-    def step(state, xs, ys, lr):
-        (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
-         G_honest, fault, new_fb) = pre(state, xs, ys, lr)
-        main_dev = list(G_honest.devices())[0]
-        # --- the hop (reference `attack.py:811-815`; the tiny fault
-        # context — active mask + counter — hops along with the rows) --- #
-        out = mid(jax.device_put(G_honest, dev),
-                  jax.device_put(mix_key, dev),
-                  None if fault is None else jax.device_put(fault, dev))
-        (G_attack, grad_defense, accept_ratio, fault_metrics,
-         diag_metrics) = jax.device_put(out, main_dev)
-        batch = engine._batch_of(xs)
-        return post(state, rng, G_sampled, loss_avg, net_state, new_mw,
-                    G_honest, G_attack, grad_defense, accept_ratio, lr,
-                    batch, fault_metrics, new_fb, diag_metrics)
-
-    return step
+    """Heterogeneous GAR placement — a thin wrapper over the builder's
+    placement axis (`engine/program.py::device_gar_step`): the defense
+    phase runs on `gar_device` with the gradient matrix hopping there and
+    back every step. Returns a drop-in for `engine.train_step`."""
+    return program_mod.device_gar_step(engine, gar_device)
 
 
 def build_engine(*, cfg, model_def, loss, criterion, defenses, attack=None,

@@ -29,8 +29,10 @@ from byzantinemomentum_tpu.parallel.ring import (
 )
 from byzantinemomentum_tpu.parallel.sharded import (
     pairwise_distances_sharded,
+    shard_defense_list,
     shard_defenses,
     shard_gar,
+    shard_gar_diag,
     sharded_eval_many,
     sharded_state_spec,
     sharded_train_multi,
@@ -38,7 +40,8 @@ from byzantinemomentum_tpu.parallel.sharded import (
 )
 
 __all__ = ["make_mesh", "mesh_axes", "pairwise_distances_sharded",
-           "shard_defenses", "shard_gar", "sharded_eval_many",
+           "shard_defense_list", "shard_defenses", "shard_gar",
+           "shard_gar_diag", "sharded_eval_many",
            "sharded_state_spec", "sharded_train_step",
            "sharded_train_multi",
            "dense_attention", "ring_attention", "ulysses_attention"]

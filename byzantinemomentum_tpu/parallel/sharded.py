@@ -41,7 +41,8 @@ from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.ops import pallas_sort
 from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS, shard_map
 
-__all__ = ["pairwise_distances_sharded", "shard_defenses", "shard_gar",
+__all__ = ["pairwise_distances_sharded", "shard_defense_list",
+           "shard_defenses", "shard_gar", "shard_gar_diag",
            "sharded_eval_many", "sharded_state_spec", "sharded_train_step",
            "sharded_train_multi", "COORDINATE_WISE"]
 
@@ -197,6 +198,103 @@ def shard_gar(gar, mesh, *, f, **kwargs):
     return kernel_replicated
 
 
+def shard_gar_diag(gar, mesh, *, f, **kwargs):
+    """d-sharded DIAGNOSTICS kernel `(G) -> (aggregate, aux)` for the
+    psum'd-Gram selection rules (krum/bulyan/brute): the aux psums the
+    SAME distance Gram the aggregate already needs, so diagnostics under
+    `--mesh` cost one (n, n) collective total — exactly like the
+    single-device kernels share their distance matrix between aggregate
+    and aux (`ops/krum.py::diagnose` etc.).
+
+    Every aux component of these rules (scores, selection mass, the
+    (n, n) distance geometry) is a function of the replicated psum'd
+    distances alone — only the aggregate touches the d axis — so the aux
+    leaves the shard_map replicated (`P()` out-specs) and matches the
+    unsharded native aux up to Gram-accumulation rounding (oracle-tested
+    in `tests/test_lattice.py`). Zero-padded d columns (the facade's
+    divisibility padding) contribute nothing to any distance, so the aux
+    is invariant under them.
+
+    Returns None for rules without a native sharded aux (coordinate-wise
+    rules and the replicated fallback keep `_generic_diagnose` — their
+    per-coordinate trim fractions are a ROADMAP rung).
+    """
+    name = gar.name
+
+    if name in ("krum", "native-krum"):
+        from byzantinemomentum_tpu.ops import (
+            _common, diag, krum as krum_mod)
+
+        def kernel(g_local):
+            n = g_local.shape[0]
+            m = kwargs.get("m")
+            m_eff = n - f - 2 if m is None else m
+            dist = _psum_pairwise(g_local)
+            w = krum_mod.selection_weights(dist, f, m)
+            with pallas_sort.allowed():
+                agg = _common.weighted_rows_mean(
+                    w.astype(g_local.dtype), g_local,
+                    all_finite=_common.all_finite_from_dist(dist))
+            return agg, diag.make_aux(
+                n, scores=krum_mod.scores_from_dist(dist, f),
+                selection=w * m_eff, dist=dist)
+
+    elif name in ("bulyan", "native-bulyan"):
+        from byzantinemomentum_tpu.ops import (
+            _common, bulyan as bulyan_mod, diag, pallas_gar)
+
+        def kernel(g_local):
+            n = g_local.shape[0]
+            m = kwargs.get("m")
+            m_scores = n - f - 2 if m is None else m
+            dist = _psum_pairwise(g_local)
+            W = bulyan_mod.selection_weights(dist, f, m)
+            rounds = W.shape[0]
+            with pallas_sort.allowed():
+                if pallas_gar.supported(g_local):
+                    agg = pallas_gar.selected_median_mean(
+                        W, g_local, rounds - 2 * f)
+                else:
+                    sel = _common.weighted_rows_mean(
+                        W.astype(g_local.dtype), g_local,
+                        all_finite=_common.all_finite_from_dist(dist))
+                    agg = _common.averaged_median(sel, rounds - 2 * f)
+            scores = jnp.sum(jnp.sort(dist, axis=1)[:, :m_scores], axis=1)
+            mass = jnp.sum((W > 0).astype(jnp.float32), axis=0) / rounds
+            return agg, diag.make_aux(n, scores=scores, selection=mass,
+                                      dist=dist)
+
+    elif name in ("brute", "native-brute"):
+        from byzantinemomentum_tpu.ops import (
+            brute as brute_mod, diag, pallas_gar)
+
+        def kernel(g_local):
+            n = g_local.shape[0]
+            dist = _psum_pairwise(g_local)
+            mask = brute_mod.best_subset_mask_from_dist(dist, f)
+            with pallas_sort.allowed():
+                if pallas_gar.supported(g_local):
+                    agg = pallas_gar.masked_rows_mean(mask, g_local, n - f)
+                else:
+                    kept = jnp.where(mask[:, None], g_local, 0)
+                    agg = jnp.sum(kept, axis=0) / (n - f)
+            in_subset = mask[None, :] & ~jnp.eye(n, dtype=bool)
+            scores = jnp.max(jnp.where(in_subset, dist, -jnp.inf), axis=1)
+            return agg, diag.make_aux(
+                n, scores=scores, selection=mask.astype(jnp.float32),
+                dist=dist)
+
+    else:
+        return None
+
+    aux_specs = {"scores": P(), "selection": P(), "dist": P(),
+                 "trim_frac": P()}
+    # check_vma=False: the Pallas out_shapes inside carry no varying-
+    # mesh-axes annotation, and the replicated aux rides the psum'd Gram
+    return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                     out_specs=(P(MODEL), aux_specs), check_vma=False)
+
+
 def sharded_state_spec(state):
     """PartitionSpecs for a `TrainState` on a (workers, model) mesh: all
     d-dimensional buffers shard along "model"; scalars/counters/PRNG
@@ -224,48 +322,66 @@ def sharded_state_spec(state):
 
 
 class _ShardedGar:
-    """Engine-facing facade over a `shard_gar` kernel.
+    """Engine-facing facade over `shard_gar`/`shard_gar_diag` kernels.
 
     `.unchecked` ignores the call-site f/kwargs (already bound into the
     kernel) and pads the d axis up to a multiple of the model-axis size —
     zero columns leave every distance, score and coordinate-wise reduction
     of the real columns unchanged, and are sliced back off. Selection
     metadata (`influence`) stays on the original GAR object. `.diagnosed`
-    (the `--gar-diagnostics` path) takes the GENERIC geometry fallback
-    around the sharded kernel — the rule-native aux kernels assume the
-    single-device layout; psum'd-Gram diagnostics are a ROADMAP rung.
+    (the `--gar-diagnostics` path) runs the NATIVE psum'd-Gram diagnostics
+    kernel where one exists (krum/bulyan/brute — the aux psums the same
+    distance Gram as the aggregate and matches the unsharded native aux);
+    other rules take the generic geometry fallback around the sharded
+    kernel.
     """
 
-    def __init__(self, inner, fn, axis_size):
+    def __init__(self, inner, fn, axis_size, diag_fn=None):
         self.name = inner.name
         self.influence = inner.influence
         self._fn = fn
+        self._diag_fn = diag_fn
         self._axis_size = axis_size
 
-    def diagnosed(self, gradients, **kwargs):
-        from byzantinemomentum_tpu.ops import _generic_diagnose
-        return _generic_diagnose(self.unchecked, gradients, **kwargs)
-
-    def unchecked(self, gradients, **_kwargs):
+    def _padded(self, gradients):
         d = gradients.shape[1]
         pad = (-d) % self._axis_size
         if pad:
             gradients = jnp.pad(gradients, ((0, 0), (0, pad)))
+        return gradients, d, pad
+
+    def diagnosed(self, gradients, **kwargs):
+        if self._diag_fn is None:
+            from byzantinemomentum_tpu.ops import _generic_diagnose
+            return _generic_diagnose(self.unchecked, gradients, **kwargs)
+        gradients, d, pad = self._padded(gradients)
+        agg, aux = self._diag_fn(gradients)
+        return (agg[:d] if pad else agg), aux
+
+    def unchecked(self, gradients, **_kwargs):
+        gradients, d, pad = self._padded(gradients)
         out = self._fn(gradients)
         return out[:d] if pad else out
 
 
-def shard_defenses(engine, mesh):
-    """The engine's defense list with every GAR rebuilt as an explicit
-    d-sharded `shard_gar` kernel (krum/bulyan/brute ride the psum'd Gram;
-    coordinate-wise rules keep their Pallas kernels per shard)."""
+def shard_defense_list(defenses, mesh, *, f):
+    """A defense list with every GAR rebuilt as an explicit d-sharded
+    `shard_gar` kernel (krum/bulyan/brute ride the psum'd Gram and carry
+    native psum'd-Gram diagnostics; coordinate-wise rules keep their
+    Pallas kernels per shard) — the sharding axis of the program builder
+    (`engine/program.py::shard_axis`)."""
     axis_size = mesh.shape[MODEL]
     return [
-        (_ShardedGar(gar,
-                     shard_gar(gar, mesh, f=engine.cfg.nb_decl_byz, **kw),
-                     axis_size), fc, kw)
-        for gar, fc, kw in engine.defenses
+        (_ShardedGar(gar, shard_gar(gar, mesh, f=f, **kw), axis_size,
+                     diag_fn=shard_gar_diag(gar, mesh, f=f, **kw)), fc, kw)
+        for gar, fc, kw in defenses
     ]
+
+
+def shard_defenses(engine, mesh):
+    """`shard_defense_list` over the engine's defense list."""
+    return shard_defense_list(engine.defenses, mesh,
+                              f=engine.cfg.nb_decl_byz)
 
 
 @contextlib.contextmanager

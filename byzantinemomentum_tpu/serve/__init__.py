@@ -16,7 +16,7 @@ Layers (one module each):
              up to a shape bucket, padded rows masked out in-jit.
   batching   microbatch queue (max-batch / max-delay flush) packing
              concurrent same-cell requests along a leading `vmap` axis;
-             donated inputs, async dispatch, futures on device-ready.
+             async dispatch, futures on device-ready.
   service    `AggregationService` — the in-process API tying cache +
              batcher + the client-keyed suspicion store + heartbeats.
   frontend   line-JSON TCP front end (stdlib `socketserver`).

@@ -53,7 +53,11 @@ def selfcheck(seed=1, requests=120, verbose=True):
             print(f"serve selfcheck: warmed {compiled} programs over "
                   f"{len(SELFCHECK_CELLS)} cells", flush=True)
 
-        # (1) the warm loop never recompiles across mixed-cell traffic
+        # (1) the warm loop never recompiles across mixed-cell traffic,
+        # and performs no implicit host<->device transfer anywhere — the
+        # guard is PROCESS-scoped because the dispatch (device_put + call)
+        # and the device wait (device_get) happen on the microbatcher's
+        # flusher/resolver daemon threads, not this one
         group = max(1, requests // 10)
 
         def step():
@@ -69,12 +73,14 @@ def selfcheck(seed=1, requests=120, verbose=True):
             for fut in futures:
                 fut.result(timeout=30)
 
-        contracts.assert_recompile_budget(
-            step, steps=10, budget=0,
-            label=f"warm serving loop ({10 * group} mixed-cell requests)")
+        with contracts.no_implicit_transfers(scope="process"):
+            contracts.assert_recompile_budget(
+                step, steps=10, budget=0,
+                label=f"warm serving loop ({10 * group} mixed-cell "
+                      f"requests)")
         if verbose:
             print(f"serve selfcheck: {10 * group} warm requests, "
-                  f"0 recompiles", flush=True)
+                  f"0 recompiles, 0 implicit transfers", flush=True)
 
         # (2) a planted outlier client gets flagged, verdict on response
         n, d, f = 11, 64, 2

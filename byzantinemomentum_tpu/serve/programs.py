@@ -24,10 +24,14 @@ first request's payload (their outputs are dropped — repeating real data
 keeps the padded lanes numerically tame). One compiled program therefore
 serves every (n <= bucket, batch <= bucket) combination of its cell.
 
-Programs donate their big input buffer (`donate_argnums`) on backends
-that support donation, so the packed request matrix is consumed in place;
-dispatch is async — the executable call returns before the device
+Dispatch is async — the executable call returns before the device
 finishes, and the service resolves caller futures on device-ready.
+(PR 8 additionally requested `donate_argnums` on the packed matrix; the
+BMT-H03 structural gate showed the request was inert — no program output
+matches the `(B, N, d)` buffer's shape, so jax drops the aliasing and
+warns on donation-capable backends. The dead request is gone; the
+lattice cell `serve/...` pins the no-aliasing layout, and the engine's
+update cell pins the contract where donation IS honored.)
 
 Diagnostics cells additionally return the serve aux
 (`ops/diag.py::masked_generic_aux`): per-row scores, selection mass and
@@ -115,11 +119,13 @@ class Cell(tuple):
                 f"d={self.d}, diag={self.diagnostics})")
 
 
-def _build(cell, donate):
+def _build(cell):
     """Compile-ready program for one cell: `vmap` of the per-request
     masked aggregation along the leading request axis. Inputs
     `(G: f32[B, N, d], active: bool[B, N])`, outputs a dict of stacked
-    per-request results."""
+    per-request results. No donation: no output matches the packed
+    matrix's shape, so a `donate_argnums` request could never alias
+    (BMT-H03 — the lattice cell pins this layout)."""
     gar = ops.gars[cell.gar]
     f, diagnostics = cell.f, cell.diagnostics
 
@@ -133,8 +139,7 @@ def _build(cell, donate):
             out["worker_dist"] = aux["worker_dist"]
         return out
 
-    kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(jax.vmap(one), **kwargs)
+    return jax.jit(jax.vmap(one))
 
 
 class ProgramCache:
@@ -152,13 +157,8 @@ class ProgramCache:
     microbatch flusher both reach `get`.
     """
 
-    def __init__(self, buckets=N_BUCKETS, donate=None):
+    def __init__(self, buckets=N_BUCKETS):
         self.buckets = tuple(sorted(buckets))
-        if donate is None:
-            # CPU donation is unimplemented (every call would warn and
-            # copy anyway); donate only where the runtime honors it
-            donate = jax.default_backend() != "cpu"
-        self.donate = bool(donate)
         self._programs = {}
         self._warm = set()     # (cell, batch_bucket) pairs seen
         self._lock = threading.Lock()
@@ -180,7 +180,7 @@ class ProgramCache:
         with self._lock:
             program = self._programs.get(cell)
             if program is None:
-                program = self._programs[cell] = _build(cell, self.donate)
+                program = self._programs[cell] = _build(cell)
             key = (cell, int(batch))
             if key in self._warm:
                 self.hits += 1

@@ -9,9 +9,9 @@ the telemetry substrate every other subsystem already uses:
     steady-state traffic never recompiles;
   * a `MicroBatcher` packing concurrent same-cell requests into one
     device program along a leading request axis, flushed by
-    max-batch-size / max-delay, with donated input buffers and async
-    dispatch (`serve/batching.py`) — callers get futures resolved on
-    device-ready, the host thread never blocks;
+    max-batch-size / max-delay, with async dispatch (`serve/batching.py`)
+    — callers get futures resolved on device-ready, the host thread
+    never blocks;
   * a `ClientSuspicionStore` (`obs/forensics.py`) folding each
     diagnostics cell's serve aux into client-id-keyed EWMA suspicion,
     whose verdicts ride back on each response.
@@ -88,8 +88,8 @@ class AggregationService:
 
     def __init__(self, *, max_batch=8, max_delay_ms=2.0, buckets=N_BUCKETS,
                  diagnostics=True, directory=None, heartbeat_interval=2.0,
-                 suspicion=None, donate=None):
-        self.cache = ProgramCache(buckets=buckets, donate=donate)
+                 suspicion=None):
+        self.cache = ProgramCache(buckets=buckets)
         self.max_batch = int(max_batch)
         self.diagnostics = bool(diagnostics)
         self.suspicion = ClientSuspicionStore(**(suspicion or {}))
@@ -238,15 +238,21 @@ class AggregationService:
             recorder.active().gauge("serve_batch_occupancy",
                                     len(requests) / B, cell=repr(cell))
         program = self.cache.get(cell, B)
-        # device_put then call: the jitted program donates the big buffer
-        # where the backend honors donation (`ProgramCache.donate`)
+        # Explicit device_put (the transfer-guard contract: the serving
+        # hot loop performs no implicit host<->device transfers)
         out = program(jax.device_put(G), jax.device_put(active))
         return out
 
     def _resolve(self, out, requests):
         """Block until the batch leaves the device, then fulfill futures
-        (resolver thread — the only place the host waits on the device)."""
-        host = {k: np.asarray(v) for k, v in out.items()}
+        (resolver thread — the only place the host waits on the device).
+        The device->host move is an EXPLICIT `jax.device_get`: the serve
+        loop runs under the same transfer-guard contract as the engine
+        step (`analysis/contracts.py::no_implicit_transfers`, held
+        process-wide by the selfcheck)."""
+        import jax
+
+        host = jax.device_get(out)
         now = time.monotonic()
         for i, r in enumerate(requests):
             verdicts = None

@@ -2,13 +2,19 @@
 """(Re)bless the StableHLO lowering goldens.
 
 Writes `tests/goldens/lowerings.json`: one sha256 fingerprint of the
-lowered StableHLO text per (GAR x {plain, diag, masked-quorum}) cell,
-plus the (jax version, backend) coordinates the fingerprints are
-comparable under. The lint tier's drift gate
+lowered StableHLO text per lattice cell — the enumeration is DERIVED
+from the program builder (`analysis/lattice.py`): every GAR ×
+{plain, diag, masked-quorum} kernel, their virtual-mesh sharded forms
+(`jax.make_mesh` over CPU host devices), the serve-layer cell programs
+and the donated update contract — plus the (jax version, backend)
+coordinates the fingerprints are comparable under. The lint tier's gate
 (`python -m byzantinemomentum_tpu.analysis --check-lowerings`) fails on
 any unexplained change — run THIS script only when a lowering change is
 intentional and reviewed, and commit the diff with the change that
 caused it.
+
+Cells the enumerator no longer produces are PRUNED (the file is the
+enumeration, nothing else) and reported, so stale keys cannot linger.
 
 Idempotent: blessing twice under one toolchain is byte-identical
 (sorted keys, no timestamps).
@@ -17,6 +23,7 @@ Usage: python scripts/bless_lowerings.py [--out PATH] [--check]
 """
 
 import argparse
+import json
 import os
 import pathlib
 import sys
@@ -26,8 +33,13 @@ sys.path.insert(0, str(ROOT))
 
 # Deterministic fingerprints need the CPU backend (this environment's
 # sitecustomize may force a TPU platform; the config update after import
-# is what actually sticks — see tests/conftest.py)
+# is what actually sticks — see tests/conftest.py), and the virtual-mesh
+# cells need multiple host devices — both must be set before backend init
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -51,12 +63,27 @@ def main():
         print(report)
         return 0 if report["status"] in ("ok", "incomparable") else 1
 
-    before = path.read_bytes() if path.is_file() else None
+    before_bytes = path.read_bytes() if path.is_file() else None
+    old_cells = {}
+    if before_bytes is not None:
+        try:
+            old_cells = json.loads(before_bytes).get("cells", {})
+        except ValueError:
+            pass  # a corrupt goldens file is simply replaced
     out = lowering.bless(path)
-    changed = before != out.read_bytes()
-    cells = len(lowering.CELL_GARS) * len(lowering.VARIANTS)
-    print(f"blessed {cells} cells -> {out}"
+    new = json.loads(out.read_text())
+    changed = before_bytes != out.read_bytes()
+    pruned = sorted(k for k in old_cells if k not in new["cells"])
+    added = sorted(k for k in new["cells"] if k not in old_cells)
+    print(f"blessed {len(new['cells'])} cells -> {out}"
           + (" (changed)" if changed else " (unchanged)"))
+    if pruned:
+        print(f"pruned {len(pruned)} stale cell(s) the enumerator no "
+              f"longer produces:")
+        for key in pruned:
+            print(f"  pruned: {key}")
+    if added:
+        print(f"added {len(added)} new cell(s)")
     return 0
 
 

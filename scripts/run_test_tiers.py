@@ -115,19 +115,43 @@ def main():
     telemetry.event("bench_compare_smoke", returncode=bench_cmp.returncode)
     print(f"  {bench_compare}", flush=True)
 
-    # Lint tier (PR 5): jaxlint over the package + scripts, and the
-    # StableHLO lowering-drift gate against the blessed goldens — the
-    # static half of the correctness story, with its own green bit
+    # Lint tier (PR 5, grown in PR 9): jaxlint (BMT-E rules incl. the
+    # dead-noqa detector) over the package + scripts — the source half of
+    # the static gate, with its own green bit
     print("lint tier ...", flush=True)
     with telemetry.span("tier_lint"):
         lint_proc = subprocess.run(
             [sys.executable, "-m", "byzantinemomentum_tpu.analysis",
-             "byzantinemomentum_tpu", "scripts", "--check-lowerings"],
+             "byzantinemomentum_tpu", "scripts"],
             cwd=ROOT, capture_output=True, text=True)
     lint_tier = {"returncode": lint_proc.returncode,
                  "tail": lint_proc.stdout.splitlines()[-4:]}
     telemetry.event("lint_tier", returncode=lint_proc.returncode)
     print(f"  {lint_tier}", flush=True)
+
+    # Lattice tier (PR 9): the builder-derived lowering-contract gate —
+    # StableHLO fingerprints over the whole program lattice (GAR cells,
+    # virtual-mesh sharded cells, serve cells, the donated update) PLUS
+    # the BMT-H structural lint (collective census, no worker-matrix
+    # all-gather, donation honored, no f64, no host callbacks) over every
+    # lowered cell. Own green bit + telemetry span with the cell count.
+    print("lattice tier ...", flush=True)
+    with telemetry.span("tier_lattice"):
+        lattice_proc = subprocess.run(
+            [sys.executable, "-m", "byzantinemomentum_tpu.analysis",
+             "--check-lowerings"],
+            cwd=ROOT, capture_output=True, text=True)
+    cells_checked = None
+    for line in lattice_proc.stdout.splitlines():
+        m = re.search(r"lowerings: \w+ \((\d+) cells\)", line)
+        if m:
+            cells_checked = int(m.group(1))
+    lattice_tier = {"returncode": lattice_proc.returncode,
+                    "cells": cells_checked,
+                    "tail": lattice_proc.stdout.splitlines()[-4:]}
+    telemetry.event("lattice_tier", returncode=lattice_proc.returncode,
+                    cells=cells_checked)
+    print(f"  {lattice_tier}", flush=True)
 
     print("default tier ...", flush=True)
     with telemetry.span("tier_default"):
@@ -219,6 +243,7 @@ def main():
         "obs_selfcheck": obs_selfcheck,
         "bench_compare": bench_compare,
         "lint_tier": lint_tier,
+        "lattice_tier": lattice_tier,
         "default_tier": default,
         "nopallas_tier": nopallas,
         "serve_tier": serve_tier,
@@ -230,6 +255,7 @@ def main():
                       and obs_selfcheck["returncode"] == 0
                       and bench_compare["returncode"] == 0
                       and lint_tier["returncode"] == 0
+                      and lattice_tier["returncode"] == 0
                       and nopallas["failed"] == 0
                       and nopallas["returncode"] == 0
                       and serve_tier["returncode"] == 0

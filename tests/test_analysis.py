@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu import losses, ops
-from byzantinemomentum_tpu.analysis import contracts, lint, lowering
+from byzantinemomentum_tpu.analysis import contracts, lattice, lint, lowering
 from byzantinemomentum_tpu.analysis.__main__ import main as analysis_main
 from byzantinemomentum_tpu.engine import EngineConfig, build_engine
 
@@ -148,6 +148,25 @@ def f(x, step):
         return x * 2
 """,
     ),
+    "BMT-E09": (
+        # The suppression names a rule that does NOT fire on the line —
+        # the annotation rotted (here: the except was narrowed but the
+        # noqa stayed behind)
+        """
+def f(path):
+    try:
+        return open(path).read()
+    except OSError:  # bmt: noqa[BMT-E05] reads may race the GC
+        return None
+""",
+        """
+def f(path):
+    try:
+        return open(path).read()
+    except Exception:  # bmt: noqa[BMT-E05] probe helper must survive anything
+        return None
+""",
+    ),
 }
 
 
@@ -209,6 +228,29 @@ def test_rule_registry_complete():
     assert set(lint.RULES) == set(FIXTURES) | {"BMT-E00"}
     for rule_id, rule in lint.RULES.items():
         assert rule_id.startswith("BMT-E") and rule.summary
+
+
+def test_dead_noqa_details():
+    """BMT-E09 edges: a dead suppression is reported per dead rule id,
+    a LIVE suppression is not dead, and a rule that was not run this
+    pass is never declared dead (subset runs must not cry rot)."""
+    dead, live = FIXTURES["BMT-E09"]
+    hits = lint.lint_source(dead)
+    assert [v.rule for v in hits] == ["BMT-E09"]
+    assert "BMT-E05" in hits[0].message
+    assert lint.lint_source(live) == []
+    # Subset run without E05: its noqa cannot be judged dead
+    assert lint.lint_source(dead, rules={"BMT-E09", "BMT-E01"}) == []
+    # Two ids, one dead one live: only the dead one is reported
+    mixed = """
+import jax, time
+@jax.jit
+def f(x):
+    return x + time.time()  # bmt: noqa[BMT-E06, BMT-E02] trace-time stamp wanted
+"""
+    hits = lint.lint_source(mixed)
+    assert [v.rule for v in hits] == ["BMT-E09"]
+    assert "BMT-E02" in hits[0].message
 
 
 def test_key_reuse_in_loop_and_branches():
@@ -439,8 +481,16 @@ def test_transfer_guard_catches_scalar_argument():
 SMALL_GRID = ("krum", "average")
 
 
+def _small_lattice(monkeypatch, meshes=(), serve=()):
+    """Shrink the enumerated lattice for the workflow tests (the
+    enumerator reads the module attributes at call time)."""
+    monkeypatch.setattr(lattice, "CELL_GARS", SMALL_GRID)
+    monkeypatch.setattr(lattice, "MESH_AXES", meshes)
+    monkeypatch.setattr(lattice, "SERVE_CELLS", serve)
+
+
 def test_bless_idempotent_and_check_ok(tmp_path, monkeypatch):
-    monkeypatch.setattr(lowering, "CELL_GARS", SMALL_GRID)
+    _small_lattice(monkeypatch)
     path = tmp_path / "lowerings.json"
     lowering.bless(path)
     first = path.read_bytes()
@@ -453,7 +503,7 @@ def test_bless_idempotent_and_check_ok(tmp_path, monkeypatch):
 def test_planted_gar_edit_trips_drift_gate(tmp_path, monkeypatch):
     """An (algebraically neutral) edit to a GAR kernel changes its
     StableHLO and the gate names exactly the drifted cells."""
-    monkeypatch.setattr(lowering, "CELL_GARS", SMALL_GRID)
+    _small_lattice(monkeypatch)
     path = tmp_path / "lowerings.json"
     lowering.bless(path)
     gar = ops.gars["krum"]
@@ -485,7 +535,8 @@ def test_repo_goldens_match_current_lowerings():
 @pytest.mark.slow
 def test_bless_script_idempotent_subprocess(tmp_path):
     """The bless script round-trips through its CLI: second run reports
-    (unchanged), and the module gate accepts the output."""
+    (unchanged), a planted stale key is pruned AND named in the output,
+    and the module gate accepts the result."""
     out = tmp_path / "goldens.json"
     for expect in ("(changed)", "(unchanged)"):
         proc = subprocess.run(
@@ -493,6 +544,17 @@ def test_bless_script_idempotent_subprocess(tmp_path):
             cwd=ROOT, capture_output=True, text=True)
         assert proc.returncode == 0, proc.stderr
         assert expect in proc.stdout
+    # Plant a stale cell: re-blessing prunes it and reports the key
+    data = json.loads(out.read_text())
+    data["cells"]["retired/stale"] = "0" * 64
+    out.write_text(json.dumps(data))
+    proc = subprocess.run(
+        [sys.executable, "scripts/bless_lowerings.py", "--out", str(out)],
+        cwd=ROOT, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "pruned 1 stale cell(s)" in proc.stdout
+    assert "pruned: retired/stale" in proc.stdout
+    assert "retired/stale" not in json.loads(out.read_text())["cells"]
     check = subprocess.run(
         [sys.executable, "scripts/bless_lowerings.py", "--out", str(out),
          "--check"], cwd=ROOT, capture_output=True, text=True)

@@ -281,8 +281,9 @@ def test_device_gar_hop_with_diagnostics():
 
 def test_mesh_sharded_step_with_diagnostics():
     """`--mesh` composes with diagnostics: the sharded step (whose GARs
-    are swapped for `_ShardedGar` facades) emits the forensic metrics
-    through the generic geometry fallback."""
+    are swapped for `_ShardedGar` facades) emits the forensic metrics —
+    natively psum'd-Gram aux for the selection rules, the generic
+    geometry fallback otherwise (oracle parity in `tests/test_lattice.py`)."""
     from byzantinemomentum_tpu.parallel import make_mesh, sharded_train_step
 
     cfg, engine = _probe_engine(True)

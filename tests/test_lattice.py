@@ -1,0 +1,362 @@
+"""Lattice-wide lowering contracts: the builder-derived golden
+enumeration (legacy-key reproduction, virtual-mesh + serve coverage),
+the BMT-H structural linter (fixture pair per rule, planted all-gather
+census), the sharded-diagnostics oracle, the virtual-mesh runtime
+contracts (zero-recompile warm loop + transfer guard — the
+`parallel/sharded.py` kernels' first such coverage), and the
+stale-golden prune workflow."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from byzantinemomentum_tpu import ops
+from byzantinemomentum_tpu.analysis import (
+    contracts, hlolint, lattice, lowering)
+from byzantinemomentum_tpu.parallel import make_mesh
+from byzantinemomentum_tpu.parallel.mesh import MODEL, shard_map
+from byzantinemomentum_tpu.parallel.sharded import shard_defense_list
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------------------- #
+# The enumerator vs the retired hand-list
+
+def _legacy_cell_text(gar, variant):
+    """The PR 5 hand-listed cell recipe, inlined as the oracle: the
+    enumerator must reproduce every previously blessed cell key with a
+    byte-identical fingerprint (the program-builder collapse re-blesses
+    NOTHING)."""
+    from byzantinemomentum_tpu.faults import quorum
+
+    N, D, F = lattice.N, lattice.D, lattice.F
+    if variant == "plain":
+        fn = lambda G: gar.unchecked(G, f=F)
+    elif variant == "diag":
+        fn = lambda G: gar.diagnosed(G, f=F)
+    else:
+        fn = lambda G, active: quorum.masked_aggregate(
+            gar, G, active, f_decl=F, dynamic=True)
+    spec = jax.ShapeDtypeStruct((N, D), jnp.float32)
+    mask = jax.ShapeDtypeStruct((N,), jnp.bool_)
+    args = (spec,) if variant != "masked" else (spec, mask)
+    return jax.jit(fn).lower(*args).as_text()
+
+
+def test_enumerator_reproduces_legacy_cells():
+    """Every (GAR x plain/diag/masked) key of the retired hand-list is
+    enumerated, and its fingerprint equals the legacy recipe's — the
+    trace-equivalence proof behind the no-re-bless criterion."""
+    cells = {c.key: c for c in lattice.enumerate_cells(meshes=(), serve=())}
+    for name in lattice.CELL_GARS:
+        for variant in lattice.VARIANTS:
+            key = f"{name}/{variant}"
+            assert key in cells, f"enumerator dropped legacy cell {key}"
+            got = lowering.fingerprint(cells[key].lower())
+            want = lowering.fingerprint(
+                _legacy_cell_text(ops.gars[name], variant))
+            assert got == want, f"{key} fingerprint drifted from legacy"
+
+
+def test_lattice_covers_mesh_serve_and_update_axes():
+    """The full enumeration at least doubles the legacy surface and
+    includes virtual-mesh sharded cells, serve cells and the donated
+    update-contract cell."""
+    keys = [c.key for c in lattice.enumerate_cells()]
+    assert len(keys) == len(set(keys)), "duplicate cell keys"
+    assert len(keys) >= 60
+    legacy = [k for k in keys if "/" in k and "@" not in k
+              and not k.startswith(("serve/", "engine/"))]
+    assert len(legacy) == 30
+    for k in lattice.MESH_AXES:
+        assert f"krum/plain@mesh{k}" in keys
+    assert "krum/diag@mesh2" in keys  # the sharded-diagnostics axis
+    assert any(k.startswith("serve/") for k in keys)
+    assert "engine/sgd-update@donate" in keys
+
+
+def test_committed_goldens_are_the_enumeration():
+    """The committed goldens file holds exactly the enumerated keys (no
+    stale keys can linger: the file IS the enumeration)."""
+    blessed = json.loads(
+        (ROOT / "tests" / "goldens" / "lowerings.json").read_text())
+    assert set(blessed["cells"]) == {
+        c.key for c in lattice.enumerate_cells()}
+    assert blessed["spec"]["meshes"] == list(lattice.MESH_AXES)
+
+
+# --------------------------------------------------------------------------- #
+# hlolint: violating + clean lowered fixture per BMT-H rule
+
+N, D = lattice.N, lattice.D
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    return make_mesh(2, model_parallel=2)
+
+
+def _gram_cell_text(mesh, gathered):
+    """The sharded Gram distance kernel — real (one psum of the tiny
+    (n, n) partial Gram) or the planted all-gather variant (the whole
+    (n, d) worker matrix crosses the interconnect)."""
+    from byzantinemomentum_tpu.ops import _common
+
+    def real(g_local):
+        part = jnp.matmul(g_local, g_local.T,
+                          precision=jax.lax.Precision.HIGHEST)
+        return _common.distances_from_sq_gram(lax.psum(part, MODEL))
+
+    def planted(g_local):
+        g_full = lax.all_gather(g_local, MODEL, axis=1, tiled=True)
+        gram = jnp.matmul(g_full, g_full.T,
+                          precision=jax.lax.Precision.HIGHEST)
+        return _common.distances_from_sq_gram(gram)
+
+    # check_vma=False on BOTH variants: the planted all-gather defeats
+    # the replication checker (that is not the failure mode under test)
+    fn = shard_map(planted if gathered else real, mesh=mesh,
+                   in_specs=P(None, MODEL), out_specs=P(None, None),
+                   check_vma=False)
+    spec = jax.ShapeDtypeStruct((N, D), jnp.float32)
+    return jax.jit(fn).lower(spec).as_text()
+
+
+def test_census_fails_planted_all_gather_and_passes_real_kernel(mesh2):
+    """The acceptance fixture: BMT-H01 (and the worker-matrix-gather
+    rule) fail on an all-gather variant of the sharded Gram and pass the
+    real psum kernel."""
+    expect = hlolint.Expect(psums=1, gather_limit=N * D - 1)
+    assert hlolint.lint_module(
+        _gram_cell_text(mesh2, gathered=False), expect, "real") == []
+    hits = hlolint.lint_module(
+        _gram_cell_text(mesh2, gathered=True), expect, "planted")
+    rules = {v.rule for v in hits}
+    assert "BMT-H01" in rules, hits   # 0 psums where 1 was declared
+    assert "BMT-H02" in rules, hits   # the (n, d) matrix was gathered
+    gather = next(v for v in hits if v.rule == "BMT-H02")
+    assert str(N * D) in gather.message or "176" in gather.message
+
+
+def test_h02_tolerates_small_gathers(mesh2):
+    """An all_gather BELOW the worker-matrix budget (a tiny replicated
+    vector) is legal — the rule targets the (n, d) matrix, not every
+    collective."""
+    def kernel(g_local):
+        norms = jnp.sum(g_local * g_local, axis=0)        # (d_shard,)
+        return lax.all_gather(norms, MODEL, axis=0, tiled=True)
+
+    fn = shard_map(kernel, mesh=mesh2, in_specs=P(None, MODEL),
+                   out_specs=P(MODEL))
+    text = jax.jit(fn).lower(
+        jax.ShapeDtypeStruct((N, D), jnp.float32)).as_text()
+    expect = hlolint.Expect(psums=0, gather_limit=N * D - 1)
+    assert hlolint.lint_module(text, expect, "small-gather") == []
+
+
+def test_h03_donation_fixture_pair():
+    """Honored donation (matching output shape -> aliasing recorded)
+    passes; an unusable donation request (no matching output) fails."""
+    spec = jax.ShapeDtypeStruct((8,), jnp.float32)
+    honored = jax.jit(lambda s, g: s - 0.1 * g,
+                      donate_argnums=(0,)).lower(spec, spec).as_text()
+    expect = hlolint.Expect(donated=(0,))
+    assert hlolint.lint_module(honored, expect, "honored") == []
+    dropped = jax.jit(lambda s: jnp.sum(s),
+                      donate_argnums=(0,)).lower(spec).as_text()
+    hits = hlolint.lint_module(dropped, expect, "dropped")
+    assert [v.rule for v in hits] == ["BMT-H03"]
+
+
+def test_h04_f64_fixture_pair():
+    spec32 = jax.ShapeDtypeStruct((4,), jnp.float32)
+    clean = jax.jit(lambda x: x * 2.5).lower(spec32).as_text()
+    assert hlolint.lint_module(clean, None, "f32") == []
+    from jax.experimental import enable_x64
+    with enable_x64():
+        spec64 = jax.ShapeDtypeStruct((4,), jnp.float64)
+        hot = jax.jit(lambda x: x * 2.5).lower(spec64).as_text()
+    hits = hlolint.lint_module(hot, None, "f64")
+    assert [v.rule for v in hits] == ["BMT-H04"]
+
+
+def test_h05_host_callback_fixture_pair():
+    spec = jax.ShapeDtypeStruct((4,), jnp.float32)
+
+    def chatty(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    hot = jax.jit(chatty).lower(spec).as_text()
+    hits = hlolint.lint_module(hot, None, "chatty")
+    assert [v.rule for v in hits] == ["BMT-H05"]
+    clean = jax.jit(lambda x: x * 2).lower(spec).as_text()
+    assert hlolint.lint_module(clean, None, "quiet") == []
+
+
+def test_check_reports_structural_violations(tmp_path, monkeypatch):
+    """A cell whose declared census stops matching reports status
+    `lint` (fingerprints alone cannot say WHY a program is wrong)."""
+    monkeypatch.setattr(lattice, "CELL_GARS", ("median",))
+    monkeypatch.setattr(lattice, "MESH_AXES", (2,))
+    monkeypatch.setattr(lattice, "SERVE_CELLS", ())
+    lowering.bless(tmp_path / "g.json")
+    # Declare median a Gram rule: its mesh cells now expect 1 psum but
+    # lower with 0 — same fingerprints, broken structure
+    monkeypatch.setattr(lattice, "GRAM_RULES", frozenset({"median"}))
+    report = lowering.check(tmp_path / "g.json")
+    assert report["status"] == "lint"
+    assert any(v["rule"] == "BMT-H01" for v in report["violations"])
+
+
+# --------------------------------------------------------------------------- #
+# Sharded diagnostics oracle (the builder's diag-under-mesh axis)
+
+def _aux_equal(got, want):
+    for key in want:
+        g, w = np.asarray(got[key]), np.asarray(want[key])
+        assert g.shape == w.shape, key
+        assert (np.isfinite(g) == np.isfinite(w)).all(), key
+        np.testing.assert_allclose(
+            np.where(np.isfinite(g), g, 0.0),
+            np.where(np.isfinite(w), w, 0.0),
+            rtol=1e-4, atol=1e-4, err_msg=key)
+
+
+@pytest.mark.parametrize("name", ["krum", "bulyan", "brute"])
+@pytest.mark.parametrize("f", [1, 2, 3])
+def test_sharded_diag_aux_matches_unsharded(name, f):
+    """The d-sharded diagnostics kernels (psum'd-Gram aux) reproduce the
+    single-device native aux — f in {1, 2, 3}, with f planted NaN rows
+    riding the +inf distance convention across shards."""
+    mesh = make_mesh(4, model_parallel=4)
+    n, d = 4 * f + 4, 64  # satisfies every rule's contract up to f=3
+    rng = np.random.default_rng(10 * f + len(name))
+    g = rng.normal(size=(n, d)).astype(np.float32)
+    g[-f:] = np.nan  # planted corrupt rows, within the declared tolerance
+    g = jnp.asarray(g)
+    gar = ops.gars[name]
+    agg_u, aux_u = gar.diagnosed(g, f=f)
+    facade = shard_defense_list([(gar, 1.0, {})], mesh, f=f)[0][0]
+    assert facade._diag_fn is not None  # the native sharded path engaged
+    agg_s, aux_s = facade.diagnosed(g, f=f)
+    np.testing.assert_allclose(np.asarray(agg_s), np.asarray(agg_u),
+                               rtol=1e-4, atol=1e-5)
+    _aux_equal(aux_s, aux_u)
+
+
+def test_sharded_diag_generic_fallback_for_coordinate_rules():
+    """Rules without a native sharded aux keep the generic geometry
+    fallback (their per-coordinate trim fractions are a ROADMAP rung)."""
+    mesh = make_mesh(2, model_parallel=2)
+    facade = shard_defense_list(
+        [(ops.gars["median"], 1.0, {})], mesh, f=2)[0][0]
+    assert facade._diag_fn is None
+    g = jnp.asarray(np.random.default_rng(3).normal(
+        size=(11, 16)).astype(np.float32))
+    agg, aux = facade.diagnosed(g, f=2)
+    assert set(aux) == {"scores", "selection", "dist", "trim_frac"}
+    np.testing.assert_allclose(
+        np.asarray(agg),
+        np.asarray(ops.gars["median"].unchecked(g, f=2)),
+        rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# Virtual-mesh runtime contracts: the sharded kernels' first recompile
+# budget and transfer guard
+
+def test_sharded_kernel_zero_recompile_and_no_transfers(mesh2):
+    """A warm d-sharded GAR kernel compiles nothing and moves nothing
+    implicitly — the same discipline the engine step has had since PR 5,
+    now on the `parallel/sharded.py` surface via a virtual CPU mesh."""
+    from jax.sharding import NamedSharding
+
+    facade = shard_defense_list(
+        [(ops.gars["krum"], 1.0, {})], mesh2, f=2)[0][0]
+    step = jax.jit(lambda G: facade.unchecked(G, f=2))
+    # Commit the operand in the kernel's own layout: an UNcommitted input
+    # would be resharded implicitly — exactly what the guard flags
+    g = jax.device_put(
+        jnp.asarray(np.random.default_rng(7).normal(
+            size=(N, D)).astype(np.float32)),
+        NamedSharding(mesh2, P(None, MODEL)))
+    jax.block_until_ready(step(g))  # warm
+    assert contracts.assert_recompile_budget(
+        lambda: step(g), steps=3, budget=0,
+        label="warm sharded krum kernel") == 0
+    with contracts.no_implicit_transfers():
+        jax.block_until_ready(step(g))
+
+
+def test_sharded_diag_kernel_zero_recompile(mesh2):
+    """The diag-under-mesh axis holds the same budget."""
+    from jax.sharding import NamedSharding
+
+    facade = shard_defense_list(
+        [(ops.gars["bulyan"], 1.0, {})], mesh2, f=2)[0][0]
+    step = jax.jit(lambda G: facade.diagnosed(G, f=2))
+    g = jax.device_put(
+        jnp.asarray(np.random.default_rng(8).normal(
+            size=(N, D)).astype(np.float32)),
+        NamedSharding(mesh2, P(None, MODEL)))
+    jax.block_until_ready(step(g))
+    assert contracts.assert_recompile_budget(
+        lambda: step(g), steps=3, budget=0,
+        label="warm sharded bulyan diag kernel") == 0
+    with contracts.no_implicit_transfers():
+        jax.block_until_ready(step(g))
+
+
+def test_process_scope_transfer_guard_covers_threads():
+    """`no_implicit_transfers(scope="process")` guards OTHER threads (the
+    serve flusher/resolver discipline) and restores the previous config."""
+    import threading
+
+    before = jax.config.jax_transfer_guard
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.zeros(()))  # warm (compilation is not a transfer)
+    caught = []
+
+    def worker():
+        try:
+            f(3.0)  # implicit host->device transfer on another thread
+        except Exception as err:  # bmt: noqa[BMT-E05] the probe wants whatever the guard raises
+            caught.append(err)
+
+    with contracts.no_implicit_transfers(scope="process"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert caught, "the process-scope guard missed a cross-thread transfer"
+    assert jax.config.jax_transfer_guard == before
+    jax.block_until_ready(f(3.0))  # guard is gone
+
+
+# --------------------------------------------------------------------------- #
+# Stale-golden pruning
+
+def test_bless_prunes_stale_cells(tmp_path, monkeypatch):
+    """Keys the enumerator no longer produces disappear on re-bless, and
+    the gate names them as `removed` before the re-bless."""
+    monkeypatch.setattr(lattice, "CELL_GARS", ("average",))
+    monkeypatch.setattr(lattice, "MESH_AXES", ())
+    monkeypatch.setattr(lattice, "SERVE_CELLS", ())
+    path = tmp_path / "g.json"
+    lowering.bless(path)
+    data = json.loads(path.read_text())
+    data["cells"]["retired/stale"] = "0" * 64
+    path.write_text(json.dumps(data))
+    report = lowering.check(path)
+    assert report["status"] == "drift"
+    assert report["removed"] == ["retired/stale"]
+    lowering.bless(path)
+    assert "retired/stale" not in json.loads(path.read_text())["cells"]
