@@ -7,8 +7,13 @@ builder instead of by hand:
 
   unsharded axis   every first-tier GAR × `program.VARIANTS`, lowered
                    through `program.defense_kernel` — the exact callables
-                   the engine dispatches (the legacy 30 cells, same keys,
-                   byte-identical fingerprints).
+                   the engine dispatches (the legacy 30 cells, same
+                   keys), PLUS one `<gar>/masked-bucket` cell per rule:
+                   the traced-count masked kernel at a PADDED serving
+                   shape (`N_BUCKET` rows), the program the aggregation
+                   service's bucket ladder actually compiles — its H02
+                   census proves no worker-matrix gather sneaks into the
+                   scan/enumeration variants.
   mesh axis        the same kernels rebuilt through the builder's
                    sharding axis (`program.shard_axis`) over VIRTUAL
                    meshes — `jax.make_mesh` over CPU host devices
@@ -38,7 +43,8 @@ import dataclasses
 from byzantinemomentum_tpu.analysis import hlolint
 
 __all__ = ["CELL_GARS", "VARIANTS", "MESH_AXES", "MESH_VARIANTS",
-           "SERVE_CELLS", "GRAM_RULES", "N", "D", "F", "LatticeCell",
+           "SERVE_CELLS", "GRAM_RULES", "COORD_DIAG_RULES",
+           "COORD_DIAG_PSUMS", "N", "N_BUCKET", "D", "F", "LatticeCell",
            "enumerate_cells", "lower_cell", "spec_info"]
 
 # Every first-tier registered rule with real kernels (the `native-` tier
@@ -60,19 +66,33 @@ MESH_VARIANTS = {2: ("plain", "diag"), 4: ("plain",)}
 # with zero communication or replicates)
 GRAM_RULES = frozenset({"krum", "bulyan", "brute"})
 
-# Serve-axis cells: (gar, n_bucket, f, d, diagnostics, batch) — one per
-# masked-family rule plus a diagnostics cell, donation always requested
+# Coordinate-wise trim rules with a NATIVE sharded diagnostics kernel
+# (`parallel/sharded.py::_coord_diag_builder`): their diag-under-mesh
+# cells psum ONE tuple — (Gram, dev², kept-counts) — which StableHLO
+# spells as three all_reduce ops (one per tuple leaf); the census pins
+# that the tuple never unfuses into extra collectives
+COORD_DIAG_RULES = frozenset({"trmean", "phocas", "meamed"})
+COORD_DIAG_PSUMS = 3
+
+# Serve-axis cells: (gar, n_bucket, f, d, diagnostics, batch) — masked
+# -family rules incl. the r10 traced-count holdouts (bulyan's inert
+# -round scan, brute's worst-case-sized enumeration), plus diagnostics
+# cells; donation never declared (BMT-H03 pinned inert)
 SERVE_CELLS = (
     ("krum", 16, 2, 32, True, 4),
     ("median", 8, 1, 32, False, 2),
     ("trmean", 8, 2, 32, False, 4),
     ("average", 4, 1, 32, True, 2),
+    ("bulyan", 16, 2, 32, False, 2),
+    ("brute", 8, 2, 32, True, 2),
 )
 
 # The canonical spec: the benchmark's n=11 worker grid, f=2, a d big
 # enough that every kernel takes its vectorized path (and divides every
-# mesh axis)
+# mesh axis). N_BUCKET is the padded row count of the masked-bucket
+# cells (the serve ladder bucket above N, `serve/programs.py`).
 N, D, F = 11, 16, 2
+N_BUCKET = 16
 
 
 @dataclasses.dataclass(frozen=True)
@@ -146,11 +166,42 @@ def _mesh_cell(name, variant, k):
         return (program.defense_kernel(facade, variant, f=F),
                 _avals(variant))
 
+    if name in GRAM_RULES:
+        psums = 1
+    elif variant == "diag" and name in COORD_DIAG_RULES:
+        psums = COORD_DIAG_PSUMS  # the tupled (Gram, dev², kept) psum
+    else:
+        psums = 0
     return LatticeCell(
         key=f"{name}/{variant}@mesh{k}", build=build,
-        expect=hlolint.Expect(
-            psums=1 if name in GRAM_RULES else 0,
-            gather_limit=N * D - 1))
+        expect=hlolint.Expect(psums=psums, gather_limit=N * D - 1))
+
+
+def _masked_bucket_cell(name):
+    """The traced-count masked kernel at a PADDED shape — the exact
+    program the aggregation service's bucket ladder compiles
+    (`serve/programs.py`): `N_BUCKET` rows for an `N`-row request, the
+    surplus masked inactive. Structural contract: no psums, and — the
+    BMT-H02 guarantee the traced-count scan/enumeration variants must
+    keep — no worker-matrix-scale gather (selection stays rank-predicate
+    and one-hot arithmetic, never a dynamic row gather of the padded
+    matrix)."""
+
+    def build():
+        from byzantinemomentum_tpu import ops
+        from byzantinemomentum_tpu.engine import program
+
+        import jax
+        import jax.numpy as jnp
+
+        spec = jax.ShapeDtypeStruct((N_BUCKET, D), jnp.float32)
+        mask = jax.ShapeDtypeStruct((N_BUCKET,), jnp.bool_)
+        return (program.defense_kernel(ops.gars[name], "masked", f=F),
+                (spec, mask))
+
+    return LatticeCell(
+        key=f"{name}/masked-bucket", build=build,
+        expect=hlolint.Expect(psums=0, gather_limit=N_BUCKET * D - 1))
 
 
 def _serve_cell(gar, n_bucket, f, d, diagnostics, batch):
@@ -213,6 +264,11 @@ def enumerate_cells(gars=None, variants=None, meshes=None, serve=None):
     for name in gars:
         for variant in variants:
             cells.append(_plain_cell(name, variant))
+    if "masked" in variants:
+        # The bucket axis: every rule's traced-count masked kernel at a
+        # padded serving shape (H02 census: no worker-matrix gather)
+        for name in gars:
+            cells.append(_masked_bucket_cell(name))
     for k in meshes:
         for name in gars:
             for variant in MESH_VARIANTS.get(k, ("plain",)):
@@ -234,6 +290,6 @@ def lower_cell(cell):
 
 def spec_info():
     """The enumeration coordinates recorded next to the fingerprints."""
-    return {"n": N, "d": D, "f": F,
+    return {"n": N, "n_bucket": N_BUCKET, "d": D, "f": F,
             "meshes": [int(k) for k in MESH_AXES],
             "serve_cells": len(SERVE_CELLS)}

@@ -9,16 +9,28 @@ clamped to the GAR's own breakdown ceiling at the shrunken `n_eff` — and
 dispatches to masked kernel variants (`ops/_common.py`, `ops/krum.py`)
 that aggregate over the active subset with those traced counts.
 
-GARs without a masked variant degrade gracefully instead of wrongly:
-inactive rows are routed to NaN, which every kernel in this framework
-already treats as worst-case (sort-last values, +inf distances), and the
-static declared `f` keeps absorbing them as long as
-`absent + byzantine <= f` — the documented fallback contract.
+Every registered first-tier rule now has a TRACED-COUNT masked kernel
+(average/median/trmean via `ops/_common.py`, krum via `ops/krum.py`,
+bulyan/brute/phocas/meamed/aksel/cge via their own modules) — each static
+slice bound turned into a rank predicate against the traced counts, each
+fixed-length loop run with inert padded iterations — so the aggregation
+service can serve ANY rule from a padded shape bucket
+(`serve/programs.py`) and degraded fault steps recompute the quorum for
+every rule instead of only four. The single exception is brute at an
+infeasible declared rank space (`ops/brute.py::masked_rank_space` — the
+traced-count enumeration must provision the static worst case
+`C(n, f_decl)`), which keeps the historical fallback: inactive rows are
+routed to NaN, which every kernel already treats as worst-case
+(sort-last values, +inf distances), and the static declared `f` absorbs
+them as long as `absent + byzantine <= f` — the documented (weaker)
+contract, now reachable only on that one route.
 """
 
 import jax.numpy as jnp
 
-from byzantinemomentum_tpu.ops import _common, krum as krum_mod
+from byzantinemomentum_tpu.ops import (
+    _common, aksel as aksel_mod, brute as brute_mod, bulyan as bulyan_mod,
+    cge as cge_mod, krum as krum_mod, trmean as trmean_mod)
 
 __all__ = ["effective_f", "masked_aggregate"]
 
@@ -89,10 +101,34 @@ def masked_aggregate(gar, gradients, active, *, f_decl, dynamic=True,
         kept = jnp.where(active[:, None], gradients,
                          jnp.zeros((), gradients.dtype))
         return _common.weighted_rows_mean(w, kept), f_eff
+    if name == "bulyan":
+        return bulyan_mod.aggregate_masked(
+            gradients, active, n_eff, f_eff, kwargs.get("m"),
+            method=kwargs.get("method", "dot")), f_eff
+    if name == "phocas":
+        return trmean_mod.masked_phocas(gradients, active, n_eff,
+                                        f_eff), f_eff
+    if name == "meamed":
+        return trmean_mod.masked_meamed(gradients, active, n_eff,
+                                        f_eff), f_eff
+    if name == "aksel":
+        return aksel_mod.aggregate_masked(
+            gradients, active, n_eff, f_eff,
+            mode=kwargs.get("mode", "mid")), f_eff
+    if name == "cge":
+        return cge_mod.aggregate_masked(gradients, active, n_eff,
+                                        f_eff), f_eff
+    if (name == "brute" and brute_mod.masked_rank_space(
+            gradients.shape[0], f_decl) is not None):
+        return brute_mod.aggregate_masked(
+            gradients, active, n_eff, f_eff, f_decl,
+            method=kwargs.get("method", "dot")), f_eff
 
-    # Fallback: inactive rows become NaN — every kernel's documented
-    # worst-case routing (sort-last, +inf distances) — and the static
-    # declared f absorbs them (correct while absent + byzantine <= f_decl)
+    # Fallback — brute beyond its feasible masked rank space, and any
+    # unregistered/template rule: inactive rows become NaN — every
+    # kernel's documented worst-case routing (sort-last, +inf distances) —
+    # and the static declared f absorbs them (correct while
+    # absent + byzantine <= f_decl)
     routed = jnp.where(active[:, None], gradients,
                        jnp.asarray(jnp.nan, gradients.dtype))
     return (gar.unchecked(routed, f=f_decl, **kwargs),

@@ -23,15 +23,39 @@ __all__ = [
     "averaged_median",
     "distances_from_sq_gram",
     "lower_median",
+    "masked_closest_mean",
     "masked_lower_median",
     "masked_mean",
+    "masked_rank_mean",
     "masked_trmean",
+    "masked_weighted_rows_mean",
     "pairwise_distances",
     "closest_mean",
+    "row_sum_stable",
     "sanitize_inf",
     "selection_influence",
     "weighted_rows_mean",
 ]
+
+
+def row_sum_stable(x):
+    """Row-wise sum over the minor axis, stable under appended zero
+    columns: `f32[n, k] -> f32[n]`.
+
+    XLA lowers `jnp.sum(x, axis=1)` to a reduce whose accumulation
+    grouping depends on the STATIC width (SIMD lane splits), so the same
+    real values summed at width k and at a zero-padded width k' can
+    differ in the last ulp — which breaks the shape-bucket ladder's
+    bit-exactness contract (`serve/programs.py`). A batched dot
+    contraction (`einsum('nk,nk->n')`, precision=HIGHEST) accumulates
+    its K loop sequentially on every backend we pin goldens for, so
+    appended zeros are exact identities. Every traced-count masked
+    kernel whose reduction crosses a PADDABLE axis (the n axis of rank
+    -masked score sums, the d axis of deviation norms) sums through
+    this instead of `jnp.sum`.
+    """
+    return jnp.einsum("nk,nk->n", x, jnp.ones_like(x),
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def weighted_rows_mean(w, gradients, all_finite=None, then=None):
@@ -198,6 +222,78 @@ def masked_trmean(g, active, f, n_eff=None):
     take = (ranks >= f) & (ranks < n_eff - f)
     kept = jnp.where(take, srt, jnp.zeros((), g.dtype))
     return jnp.sum(kept, axis=0) / (n_eff - 2 * f).astype(g.dtype)
+
+
+def masked_closest_mean(g, active, c, m):
+    """Coordinate-wise mean of the `m` active values closest to center `c`,
+    with a TRACED count: `g: f32[n, d], active: bool[n], c: f32[d],
+    m: i32[] -> f32[d]`.
+
+    The traced-count form of `closest_mean`: inactive rows take NaN
+    deviations (sorting last, never below/at the threshold), the m-th
+    smallest deviation is read at a traced rank, and the value-threshold
+    tie-fill runs unchanged — so for finite active rows this equals
+    `closest_mean(g[active], c, m)` bit for bit (the padded rows only
+    append zeros to the kept-sum, and `jnp.cumsum` over their False tie
+    indicators is the identity). Fewer than m finite active values per
+    coordinate yields NaN, exactly like the static kernel.
+    """
+    n = g.shape[0]
+    m = jnp.clip(m, 1, n)
+    dev = jnp.abs(g - c[None, :])
+    dev = jnp.where(active[:, None], dev, jnp.asarray(jnp.nan, dev.dtype))
+    thresh = jnp.take(jnp.sort(dev, axis=0), m - 1, axis=0)
+    lt = dev < thresh
+    eq = dev == thresh
+    need = m - jnp.sum(lt, axis=0)
+    take = lt | (eq & (jnp.cumsum(eq, axis=0) <= need))
+    out = jnp.sum(jnp.where(take, g, 0.0), axis=0) / m.astype(g.dtype)
+    return jnp.where(jnp.isnan(thresh), jnp.nan, out)
+
+
+def masked_rank_mean(g, scores, active, count):
+    """Mean of the `count` lowest-score ACTIVE rows, with a TRACED count:
+    `g: f32[n, d], scores: f32[n], active: bool[n], count: i32[] ->
+    f32[d]`.
+
+    The selection is stable-argsort rank membership (index-order ties,
+    matching the reference kernels' Python `list.sort`); inactive rows are
+    forced to +inf scores and excluded from the membership mask outright.
+    The mean sums the selected rows in INDEX order — a static kernel that
+    gathers rows in score order (`jnp.mean(g[sel])`, aksel/cge) associates
+    its sum differently, so parity with those is exact-value only up to
+    summation order; parity with another call of THIS kernel (the serve
+    exact cell vs its padded bucket) is bit-exact.
+    """
+    n = g.shape[0]
+    count = jnp.clip(count, 1, n)
+    scores = jnp.where(active, scores, jnp.inf)
+    order = jnp.argsort(scores, stable=True)
+    ranks = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    sel = (ranks < count) & active
+    kept = jnp.where(sel[:, None], g, jnp.zeros((), g.dtype))
+    return jnp.sum(kept, axis=0) / count.astype(g.dtype)
+
+
+def masked_weighted_rows_mean(w, g, active):
+    """`w @ g` over the active rows with the `weighted_rows_mean`
+    non-finite semantics computed UNCONDITIONALLY (no all-finite
+    `lax.cond`): inactive rows are zeroed (their garbage/NaN payload must
+    not poison zero-weight products), non-finite entries in selected
+    (w > 0) rows propagate NaN to exactly their coordinates. When every
+    active row is finite this is bit-identical to the plain matmul — the
+    same argument as the fused Pallas kernel's unconditional masked form —
+    so one traced program serves both the healthy and the degraded case,
+    which is what a traced-count kernel needs (a cond on a traced
+    predicate would still lower both branches)."""
+    kept = jnp.where(active[:, None], g, jnp.zeros((), g.dtype))
+    finite = jnp.where(jnp.isfinite(kept), kept, 0.0)
+    out = jnp.matmul(w, finite, precision=jax.lax.Precision.HIGHEST)
+    nonfin = (~jnp.isfinite(kept)).astype(jnp.float32)
+    sel = (w > 0).astype(jnp.float32)
+    bad = jnp.matmul(sel, nonfin, precision=jax.lax.Precision.HIGHEST) > 0
+    return jnp.where(bad, jnp.nan, out)
 
 
 def pairwise_distances(g, *, squared=False, method="dot"):

@@ -9,9 +9,11 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import diag, register
 from byzantinemomentum_tpu.ops._common import (
-    lower_median, pairwise_distances, sanitize_inf, selection_influence)
+    lower_median, masked_lower_median, masked_rank_mean,
+    pairwise_distances, row_sum_stable, sanitize_inf,
+    selection_influence)
 
-__all__ = ["aggregate", "diagnose", "selection"]
+__all__ = ["aggregate", "aggregate_masked", "diagnose", "selection"]
 
 
 def _count(n, f, mode):
@@ -34,6 +36,27 @@ def selection(gradients, f, mode="mid", **kwargs):
 def aggregate(gradients, f, mode="mid", **kwargs):
     """Aksel rule (reference `aggregators/aksel.py:55-64`)."""
     return jnp.mean(gradients[selection(gradients, f, mode)], axis=0)
+
+
+def aggregate_masked(gradients, active, n_eff, f_eff, mode="mid", **kwargs):
+    """Traced-count aksel (`faults/quorum.py` dispatch): the median center
+    over the active rows, squared distances with inactive rows forced to
+    +inf, and the `c` closest active rows averaged with a traced count —
+    `c = (n_eff + 1) // 2` ('mid') or `n_eff - f_eff` ('n-f'). The mean
+    sums selected rows in index order (`_common.masked_rank_mean` note);
+    equal to `aggregate(gradients[active], f_eff, mode)` up to summation
+    order, bit-stable across paddings of the same active set."""
+    n = gradients.shape[0]
+    med = masked_lower_median(gradients, active, n_eff)
+    # row_sum_stable: the d axis is the padded bucket axis in serving
+    sqd = sanitize_inf(row_sum_stable((gradients - med[None, :]) ** 2))
+    if mode == "mid":
+        c = (n_eff + 1) // 2
+    elif mode == "n-f":
+        c = n_eff - f_eff
+    else:
+        raise NotImplementedError(f"Unknown aksel mode {mode!r}")
+    return masked_rank_mean(gradients, sqd, active, jnp.clip(c, 1, n))
 
 
 def diagnose(gradients, f, mode="mid", **kwargs):

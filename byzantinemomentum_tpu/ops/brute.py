@@ -30,7 +30,9 @@ from jax import lax
 from byzantinemomentum_tpu.ops import diag, pallas_gar, register
 from byzantinemomentum_tpu.ops._common import pairwise_distances, selection_influence
 
-__all__ = ["aggregate", "diagnose", "selection", "best_subset_mask_from_dist"]
+__all__ = ["aggregate", "aggregate_masked", "diagnose", "selection",
+           "best_subset_mask_from_dist", "best_subset_mask_masked",
+           "masked_rank_space", "MASKED_MAX_SUBSETS"]
 
 # Subsets evaluated per chunk of the streaming enumeration: memory is
 # O(CHUNK * n^2) floats — ~80 MB at n=25 — independent of C(n, n-f).
@@ -128,6 +130,136 @@ def best_subset_mask_from_dist(dist, f):
     _, best_rank = lax.fori_loop(
         0, nchunks, chunk_best, (jnp.float32(jnp.inf), jnp.int32(0)))
     return _unrank_masks(best_rank[None], n, k, tbl)[0]
+
+
+# Ceiling on the STATIC rank space a traced-count (masked/bucketed) brute
+# program may enumerate: the masked walk cannot know n_eff/f_eff at trace
+# time, so it sizes its chunk loop for the worst case C(n, min(f_decl,
+# (n-1)//2)). Beyond this many subsets the masked kernel is declined
+# (`masked_rank_space` returns None) and callers keep the NaN-routing
+# fallback / an exact serve cell — the same infeasibility discipline as
+# the exact kernel's int32 rank-space check, drawn earlier because every
+# serve warm-up pays the compile. ~61 chunks at the cap.
+MASKED_MAX_SUBSETS = 2_000_000
+
+
+def masked_rank_space(n, f_decl):
+    """The static worst-case subset count a traced-count brute program
+    over `n` rows with declared tolerance `f_decl` must provision for —
+    `C(n, min(f_decl, (n-1)//2))`, the maximum of `C(n_eff, f_eff)` over
+    every reachable `(n_eff <= n, f_eff <= f_decl)` — or None when it
+    exceeds `MASKED_MAX_SUBSETS` (callers must route around the masked
+    kernel)."""
+    k = min(int(f_decl), max((n - 1) // 2, 0))
+    total = math.comb(n, k)
+    return total if total <= MASKED_MAX_SUBSETS else None
+
+
+def _unrank_masks_masked(ranks, active, after, need0, n, tbl):
+    """Traced-count lexicographic unranking over the ACTIVE rows:
+    `i32[c] -> bool[c, n]` membership masks of the rank-th size-`need0`
+    combination of the active indices (lexicographic in the full index
+    order, which is the static kernel's order restricted to the active
+    subset).
+
+    The walk visits all n elements statically; an INACTIVE element is a
+    no-op (no rank consumed, no slot filled). At an active element with
+    `need` slots left there are `C(after[e], need - 1)` completions that
+    include it — `after[e]` is the traced count of active elements past
+    `e`, so BOTH table coordinates are dynamic: the row is resolved by a
+    one-hot contraction over the (n+1) table rows once per element (shared
+    across lanes), the column per lane exactly as the static walk does.
+    """
+    cols = jnp.arange(n + 1, dtype=jnp.int32)
+    rows_hot = jnp.arange(n + 1, dtype=jnp.int32)
+
+    def body(carry, inputs):
+        r, need = carry
+        act_e, a_e = inputs
+        row = jnp.sum(jnp.where((rows_hot == a_e)[:, None], tbl, 0), axis=0)
+        j = jnp.maximum(need - 1, 0)
+        onehot = j[:, None] == cols[None, :]
+        count = jnp.sum(jnp.where(onehot, row[None, :], 0), axis=1)
+        count = jnp.where(need > 0, count, 0)
+        take = act_e & (need > 0) & (r < count)
+        r = jnp.where(take | ~act_e, r, r - count)
+        need = need - take.astype(need.dtype)
+        return (r, need), take
+
+    (_, _), masks = lax.scan(
+        body, (ranks, jnp.zeros(ranks.shape, jnp.int32) + need0),
+        (active, after))
+    return masks.T  # (n, c) -> (c, n)
+
+
+def best_subset_mask_masked(dist, active, n_eff, f_eff, total_max):
+    """Traced-count `best_subset_mask_from_dist`: the minimum-diameter
+    size-(n_eff - f_eff) subset of the ACTIVE rows, enumerated over a
+    chunk loop sized for the STATIC worst case `total_max`
+    (`masked_rank_space`) with the surplus rank lanes clamped to the last
+    real subset — the same tail-duplication trick the static kernel uses,
+    so tie-breaking (first minimum in lexicographic order) is preserved
+    exactly. `dist` must already carry +inf on inactive pairs' entries or
+    not — inactive pairs are forced to +inf here either way."""
+    n = dist.shape[0]
+    pair = active[:, None] & active[None, :]
+    dist = jnp.where(pair, dist, jnp.inf)
+    k_eff = jnp.clip(n_eff - f_eff, 1, n)
+    # C(m, j) for every m <= n, j <= n: entries never consulted may clamp
+    # (consulted counts are completion counts <= total_eff <= total_max)
+    tbl_np = _binom_table(n, n)
+    tbl = jnp.asarray(np.minimum(tbl_np, np.iinfo(np.int32).max)
+                      .astype(np.int32))
+    # after[e] = active rows strictly past e (the dynamic table row)
+    after = (jnp.sum(active.astype(jnp.int32))
+             - jnp.cumsum(active.astype(jnp.int32))).astype(jnp.int32)
+    # total_eff = C(n_eff, k_eff), read off the same table dynamically
+    row_hot = (jnp.arange(n + 1, dtype=jnp.int32) == n_eff)[:, None]
+    col_hot = (jnp.arange(n + 1, dtype=jnp.int32) == k_eff)[None, :]
+    total_eff = jnp.maximum(jnp.sum(jnp.where(row_hot & col_hot, tbl, 0)), 1)
+    offdiag = ~jnp.eye(n, dtype=bool)
+
+    chunk = min(CHUNK, total_max)
+    nchunks = -(-total_max // chunk)
+
+    def chunk_best(i, carry):
+        best_diam, best_rank = carry
+        ranks = jnp.minimum(i * chunk + jnp.arange(chunk, dtype=jnp.int32),
+                            total_eff - 1)
+        masks = _unrank_masks_masked(ranks, active, after, k_eff, n, tbl)
+        pairm = masks[:, :, None] & masks[:, None, :] & offdiag[None]
+        diam = jnp.max(jnp.where(pairm, dist[None], -jnp.inf), axis=(1, 2))
+        cmin = jnp.min(diam)
+        crank = ranks[jnp.argmin(diam)]
+        better = cmin < best_diam  # strict: earlier chunks win ties
+        return (jnp.where(better, cmin, best_diam),
+                jnp.where(better, crank, best_rank))
+
+    _, best_rank = lax.fori_loop(
+        0, nchunks, chunk_best, (jnp.float32(jnp.inf), jnp.int32(0)))
+    return _unrank_masks_masked(
+        best_rank[None], active, after, k_eff, n, tbl)[0]
+
+
+def aggregate_masked(gradients, active, n_eff, f_eff, f_decl, *,
+                     method="dot", **kwargs):
+    """Dynamic-quorum brute: minimum-diameter subset of the active rows,
+    averaged with a traced divisor. `f_decl` (static) sizes the
+    enumeration's worst-case rank space; callers must have verified
+    feasibility via `masked_rank_space` (the quorum layer and the serve
+    bucket policy both do)."""
+    n = gradients.shape[0]
+    total_max = masked_rank_space(n, f_decl)
+    if total_max is None:
+        raise ValueError(
+            f"brute masked kernel over {n} rows at f_decl={f_decl} "
+            f"exceeds MASKED_MAX_SUBSETS; callers must route around it "
+            f"(masked_rank_space)")
+    dist = pairwise_distances(gradients, method=method)
+    mask = best_subset_mask_masked(dist, active, n_eff, f_eff, total_max)
+    k_eff = jnp.clip(n_eff - f_eff, 1, n)
+    kept = jnp.where((mask & active)[:, None], gradients, 0)
+    return jnp.sum(kept, axis=0) / k_eff.astype(gradients.dtype)
 
 
 def _best_subset_mask(gradients, f, *, method="dot"):

@@ -25,10 +25,12 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import diag, pallas_gar, register
 from byzantinemomentum_tpu.ops._common import (
-    all_finite_from_dist, averaged_median, pairwise_distances,
-    weighted_rows_mean)
+    all_finite_from_dist, averaged_median, masked_closest_mean,
+    masked_lower_median, masked_weighted_rows_mean, pairwise_distances,
+    row_sum_stable, weighted_rows_mean)
 
-__all__ = ["aggregate", "diagnose", "selected_stack", "selection_weights"]
+__all__ = ["aggregate", "aggregate_masked", "diagnose", "selected_stack",
+           "selection_weights", "selection_weights_masked"]
 
 
 def selection_weights(dist, f, m=None):
@@ -58,6 +60,86 @@ def selection_weights(dist, f, m=None):
 
     _, W = jax.lax.scan(body, scores, m_is)
     return W
+
+
+def selection_weights_masked(dist, active, n_eff, f_eff, m=None):
+    """Traced-count stage-1 weights: Bulyan's iterative Multi-Krum
+    selection over the ACTIVE rows only, every static bound a traced
+    quantity (`faults/quorum.py` discipline, the bulyan analogue of
+    `ops/krum.py::selection_weights_masked`).
+
+    The scan runs a STATIC `n - 2` rounds (the most any active subset of
+    an n-row matrix can need) with the trailing rounds inert: an inert
+    round emits a zero weight row and carries the score vector through
+    unchanged, so the compiled program is one fixed-shape loop whose
+    effective length `n_eff - 2 f_eff - 2` is data. Inactive rows ride the
+    +inf conventions (masked pairwise distances, +inf scores) and are
+    excluded from every round's averaging mask, exactly like the static
+    kernel never selects a non-finite row.
+
+    Returns `(W: f32[n - 2, n], round_active: bool[n - 2])` — the weight
+    stack plus the mask of real rounds (stage 2 needs it to exclude the
+    inert rows from its median).
+    """
+    n = dist.shape[0]
+    pair = active[:, None] & active[None, :]
+    dist = jnp.where(pair, dist, jnp.inf)
+    m_max = jnp.clip(n_eff - f_eff - 2, 1, n)
+    if m is None:
+        m_sel = m_max
+    else:
+        m_sel = jnp.clip(jnp.minimum(m, m_max), 1, n)
+    # Scores: sum of the m smallest active-neighbor distances, the static
+    # slice bound turned into a rank predicate against the traced count
+    # (row_sum_stable: the summed axis is the padded bucket axis)
+    srt = jnp.sort(dist, axis=1)
+    col = jnp.arange(n)[None, :]
+    scores = row_sum_stable(jnp.where(col < m_sel, srt, 0.0))
+    scores = jnp.where(active, scores, jnp.inf)
+
+    rounds_max = max(n - 2, 1)
+    rounds_eff = jnp.clip(n_eff - 2 * f_eff - 2, 1, rounds_max)
+    i = jnp.arange(rounds_max, dtype=jnp.int32)
+    m_is = jnp.clip(jnp.minimum(m_sel, m_max - i), 1, n)
+    round_active = i < rounds_eff
+
+    def body(scores, inputs):
+        m_i, act_i = inputs
+        order = jnp.argsort(scores, stable=True)
+        ranks = jnp.zeros((n,), jnp.int32).at[order].set(
+            jnp.arange(n, dtype=jnp.int32))
+        w = jnp.where((ranks < m_i) & active & act_i,
+                      1.0 / m_i.astype(jnp.float32), 0.0)
+        pruned = scores.at[order[0]].set(jnp.inf)
+        return jnp.where(act_i, pruned, scores), w
+
+    _, W = jax.lax.scan(body, scores, (m_is, round_active))
+    return W, round_active
+
+
+def aggregate_masked(gradients, active, n_eff, f_eff, m=None, *,
+                     method="dot", **kwargs):
+    """Dynamic-quorum Bulyan: stage-1 traced-count selection over the
+    active rows, stage 2 an averaged median over the REAL rounds only
+    (`masked_lower_median` + `masked_closest_mean` with the traced stack
+    height). Equals `aggregate(gradients[active], f_eff)` for finite
+    active rows; the serve bucket programs rely on the stronger property
+    that two calls of THIS kernel at different paddings of the same
+    active set are bit-identical (`serve/programs.py`)."""
+    dist = pairwise_distances(gradients, method=method)
+    W, round_active = selection_weights_masked(
+        dist, active, n_eff, f_eff, m)
+    stack = masked_weighted_rows_mean(
+        W.astype(gradients.dtype), gradients, active)
+    rounds_eff = jnp.sum(round_active.astype(jnp.int32))
+    med = masked_lower_median(stack, round_active, rounds_eff)
+    m2 = jnp.clip(rounds_eff - 2 * f_eff, 1, stack.shape[0])
+    # The static kernel's m == 1 shortcut (`_common.averaged_median`)
+    # becomes a traced select: the closest value to the median IS the
+    # median, and the select preserves the shortcut's documented
+    # beyond-contract inf behavior
+    closest = masked_closest_mean(stack, round_active, med, m2)
+    return jnp.where(m2 == 1, med, closest)
 
 
 def selected_stack(gradients, f, m=None, *, method="dot"):

@@ -9,9 +9,10 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import diag, register
 from byzantinemomentum_tpu.ops._common import (
-    pairwise_distances, sanitize_inf, selection_influence)
+    masked_rank_mean, pairwise_distances, row_sum_stable, sanitize_inf,
+    selection_influence)
 
-__all__ = ["aggregate", "diagnose", "selection"]
+__all__ = ["aggregate", "aggregate_masked", "diagnose", "selection"]
 
 
 def norms(gradients):
@@ -29,6 +30,21 @@ def selection(gradients, f, **kwargs):
 def aggregate(gradients, f, **kwargs):
     """CGE rule (reference `aggregators/cge.py:42-57`)."""
     return jnp.mean(gradients[selection(gradients, f)], axis=0)
+
+
+def aggregate_masked(gradients, active, n_eff, f_eff, **kwargs):
+    """Traced-count CGE (`faults/quorum.py` dispatch): inactive rows take
+    +inf norms (never among the smallest), and the `n_eff - f_eff`
+    smallest-norm active rows average with a traced count
+    (`_common.masked_rank_mean` — index-order summation, bit-stable
+    across paddings of the same active set)."""
+    n = gradients.shape[0]
+    # The plain kernel's `norms` reduces with jnp.sum, whose grouping
+    # follows the static width; the masked form sums through the
+    # padding-stable contraction so bucketed and exact cells agree bitwise
+    nrm = sanitize_inf(jnp.sqrt(row_sum_stable(gradients * gradients)))
+    return masked_rank_mean(gradients, nrm, active,
+                            jnp.clip(n_eff - f_eff, 1, n))
 
 
 def diagnose(gradients, f, **kwargs):

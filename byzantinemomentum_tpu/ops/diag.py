@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 __all__ = ["AUX_KEYS", "make_aux", "distance_summary", "var_norm_ratio",
            "selection_from_indices", "rank_kept_fraction",
-           "masked_generic_aux", "worker_mean_distance"]
+           "rank_kept_mask", "masked_generic_aux", "worker_mean_distance"]
 
 # The uniform aux schema (dict keys, all always present).
 AUX_KEYS = ("scores", "selection", "dist", "trim_frac")
@@ -108,12 +108,16 @@ def worker_mean_distance(dist):
     engine's `Worker dist` recipe (`engine/metrics.py`): a row with no
     finite peer distance (fully corrupt, or a padded/inactive row whose
     distances are all +inf) reads +inf, so downstream z-scoring treats it
-    as maximally far."""
+    as maximally far. Sums through the padding-stable contraction
+    (`_common.row_sum_stable`) because the serve aux computes this over
+    bucket-padded matrices and must match the exact cell bitwise."""
+    from byzantinemomentum_tpu.ops import _common
+
     n = dist.shape[0]
     offdiag = ~jnp.eye(n, dtype=bool)
     finite = jnp.isfinite(dist) & offdiag
     count = jnp.sum(finite.astype(jnp.int32), axis=1)
-    mean_d = (jnp.sum(jnp.where(finite, dist, 0.0), axis=1)
+    mean_d = (_common.row_sum_stable(jnp.where(finite, dist, 0.0))
               / jnp.maximum(count, 1).astype(jnp.float32))
     return jnp.where(count > 0, mean_d, jnp.inf)
 
@@ -147,7 +151,11 @@ def masked_generic_aux(G, aggregate, active, f_eff):
     routed = jnp.where(active[:, None], G, jnp.asarray(jnp.nan, G.dtype))
     dist = _common.pairwise_distances(routed)
     dev = routed - aggregate[None, :]
-    scores = _common.sanitize_inf(jnp.sqrt(jnp.sum(dev * dev, axis=1)))
+    # row_sum_stable: d is the padded bucket axis (zero-padded columns of
+    # an active row deviate by exactly 0 from the aggregate's zero padded
+    # coordinates, and the stable contraction keeps the sum's bits)
+    scores = _common.sanitize_inf(
+        jnp.sqrt(_common.row_sum_stable(dev * dev)))
     n_eff = jnp.sum(active.astype(jnp.int32))
     keep = jnp.clip(n_eff - f_eff, 1, n)
     thresh = jnp.take(jnp.sort(scores), keep - 1)
@@ -156,17 +164,20 @@ def masked_generic_aux(G, aggregate, active, f_eff):
             "worker_dist": worker_mean_distance(dist), "dist": dist}
 
 
-def rank_kept_fraction(g, f, n_low=None, n_high=None):
-    """Per-worker fraction of coordinates whose value survived a
-    coordinate-wise rank trim: kept iff the value lies within the sorted
-    ranks `[n_low, n_high)` (defaults: trmean's `[f, n-f)`).
+def rank_kept_mask(g, f, n_low=None, n_high=None):
+    """`bool[n, d]` coordinate-survival indicator of a coordinate-wise
+    rank trim: kept iff the value lies within the sorted ranks
+    `[n_low, n_high)` (defaults: trmean's `[f, n-f)`).
 
     Rank membership is decided by value thresholds (`sorted[n_low]` /
     `sorted[n_high - 1]` per coordinate) rather than a full (n, d) argsort
     + scatter: ties at the boundary count every tied worker as kept, which
     over-reports by at most the tie multiplicity and keeps the pass at one
     (n, d) sort — the same trick as `_common.closest_mean`. NaN coordinates
-    never count as kept (comparisons with NaN are False).
+    never count as kept (comparisons with NaN are False). Shared by the
+    single-device aux (`rank_kept_fraction`) and the d-sharded
+    coordinate-wise diagnostics (`parallel/sharded.py` — each shard folds
+    its local mask into width-aware partial counts).
     """
     n = g.shape[0]
     if n_low is None:
@@ -176,5 +187,11 @@ def rank_kept_fraction(g, f, n_low=None, n_high=None):
     srt = jnp.sort(g, axis=0)  # NaN sorts last
     lo = srt[n_low]
     hi = srt[n_high - 1]
-    kept = (g >= lo) & (g <= hi)
+    return (g >= lo) & (g <= hi)
+
+
+def rank_kept_fraction(g, f, n_low=None, n_high=None):
+    """Per-worker fraction of coordinates surviving the rank trim
+    (`rank_kept_mask` averaged over the coordinate axis)."""
+    kept = rank_kept_mask(g, f, n_low=n_low, n_high=n_high)
     return jnp.mean(kept.astype(jnp.float32), axis=1)

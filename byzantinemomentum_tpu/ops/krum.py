@@ -23,8 +23,8 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import diag, register
 from byzantinemomentum_tpu.ops._common import (
-    all_finite_from_dist, pairwise_distances, selection_influence,
-    weighted_rows_mean)
+    all_finite_from_dist, pairwise_distances, row_sum_stable,
+    selection_influence, weighted_rows_mean)
 
 __all__ = ["aggregate", "diagnose", "scores", "selection",
            "selection_weights", "selection_weights_masked"]
@@ -80,7 +80,10 @@ def selection_weights_masked(dist, active, n_eff, f_eff, m=None):
     keep = jnp.clip(n_eff - f_eff - 1, 1, n)
     srt = jnp.sort(dist, axis=1)
     ranks = jnp.arange(n)[None, :]
-    scores = jnp.sum(jnp.where(ranks < keep, srt, 0.0), axis=1)
+    # row_sum_stable: the summed axis is the PADDED row axis when this
+    # kernel serves a shape bucket — a plain reduce would regroup with
+    # the bucket width and break the bucket-vs-exact-cell bit equality
+    scores = row_sum_stable(jnp.where(ranks < keep, srt, 0.0))
     scores = jnp.where(active, scores, jnp.inf)
     if m is None:
         m = jnp.clip(n_eff - f_eff - 2, 1, n)

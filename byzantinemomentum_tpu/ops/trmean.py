@@ -13,10 +13,12 @@ import jax.numpy as jnp
 
 from byzantinemomentum_tpu.ops import diag, pallas_sort, register
 from byzantinemomentum_tpu.ops._common import (
-    closest_mean, lower_median, pairwise_distances, sanitize_inf)
+    closest_mean, lower_median, masked_closest_mean, masked_lower_median,
+    masked_trmean, pairwise_distances, sanitize_inf)
 
 __all__ = ["trmean", "aggregate_trmean", "aggregate_phocas",
-           "aggregate_meamed", "diagnose_trmean"]
+           "aggregate_meamed", "diagnose_trmean", "masked_phocas",
+           "masked_meamed"]
 
 
 def trmean(g, f):
@@ -41,6 +43,27 @@ def aggregate_phocas(gradients, f, **kwargs):
 def aggregate_meamed(gradients, f, **kwargs):
     g = gradients
     return closest_mean(g, lower_median(g), g.shape[0] - f)
+
+
+def masked_phocas(gradients, active, n_eff, f_eff, **kwargs):
+    """Traced-count phocas (`faults/quorum.py` dispatch): the trimmed-mean
+    center and the closest-mean stage both run over the active rows with
+    traced counts — `masked_trmean` then `masked_closest_mean` keeping
+    `n_eff - f_eff` values per coordinate. Equals
+    `aggregate_phocas(gradients[active], f_eff)` for finite active rows."""
+    n = gradients.shape[0]
+    center = masked_trmean(gradients, active, f_eff, n_eff)
+    m = jnp.clip(n_eff - f_eff, 1, n)
+    return masked_closest_mean(gradients, active, center, m)
+
+
+def masked_meamed(gradients, active, n_eff, f_eff, **kwargs):
+    """Traced-count meamed: the median center over the active rows, then
+    the `n_eff - f_eff` coordinate-wise closest active values."""
+    n = gradients.shape[0]
+    center = masked_lower_median(gradients, active, n_eff)
+    m = jnp.clip(n_eff - f_eff, 1, n)
+    return masked_closest_mean(gradients, active, center, m)
 
 
 def _coordinate_aux(g, agg, trim_frac):

@@ -199,27 +199,38 @@ def shard_gar(gar, mesh, *, f, **kwargs):
 
 
 def shard_gar_diag(gar, mesh, *, f, **kwargs):
-    """d-sharded DIAGNOSTICS kernel `(G) -> (aggregate, aux)` for the
-    psum'd-Gram selection rules (krum/bulyan/brute): the aux psums the
-    SAME distance Gram the aggregate already needs, so diagnostics under
-    `--mesh` cost one (n, n) collective total — exactly like the
-    single-device kernels share their distance matrix between aggregate
-    and aux (`ops/krum.py::diagnose` etc.).
+    """d-sharded DIAGNOSTICS kernel builder for rules with a native
+    sharded aux. Returns `fn(G_padded, d_real) -> (aggregate, aux)` —
+    `d_real` is the pre-padding width (static at trace time; the facade
+    threads it) — or None for rules that keep `_generic_diagnose`.
 
-    Every aux component of these rules (scores, selection mass, the
-    (n, n) distance geometry) is a function of the replicated psum'd
-    distances alone — only the aggregate touches the d axis — so the aux
-    leaves the shard_map replicated (`P()` out-specs) and matches the
-    unsharded native aux up to Gram-accumulation rounding (oracle-tested
-    in `tests/test_lattice.py`). Zero-padded d columns (the facade's
-    divisibility padding) contribute nothing to any distance, so the aux
-    is invariant under them.
+    Selection rules (krum/bulyan/brute): the aux psums the SAME distance
+    Gram the aggregate already needs, so diagnostics under `--mesh` cost
+    one (n, n) collective total — exactly like the single-device kernels
+    share their distance matrix between aggregate and aux
+    (`ops/krum.py::diagnose` etc.). Every aux component is a function of
+    the replicated psum'd distances alone — only the aggregate touches
+    the d axis — so the aux leaves the shard_map replicated (`P()`
+    out-specs) and matches the unsharded native aux up to
+    Gram-accumulation rounding (oracle-tested in `tests/test_lattice.py`).
+    Zero-padded d columns (the facade's divisibility padding) contribute
+    nothing to any distance, so these rules ignore `d_real`.
 
-    Returns None for rules without a native sharded aux (coordinate-wise
-    rules and the replicated fallback keep `_generic_diagnose` — their
-    per-coordinate trim fractions are a ROADMAP rung).
+    Coordinate-wise rules (trmean/phocas/meamed — the ROADMAP "lattice
+    rung 1"): trim fractions are per-coordinate MEANS, so the sharded aux
+    sums d-LOCAL partial quantities and psums them with shard widths
+    accounted: each shard counts its kept coordinates and squared
+    deviations over its REAL columns only (a global-column-index mask
+    derived from `d_real` excludes the divisibility padding, whose
+    all-zero columns would otherwise count as universally kept), one
+    tupled psum carries `(Gram, dev², kept-counts)` across ICI, and the
+    replicated totals divide by the true width. Oracle-tested against the
+    unsharded native aux (`tests/test_lattice.py`).
     """
     name = gar.name
+
+    if name in ("trmean", "phocas", "meamed"):
+        return _coord_diag_builder(name, gar, mesh, f=f, **kwargs)
 
     if name in ("krum", "native-krum"):
         from byzantinemomentum_tpu.ops import (
@@ -291,8 +302,71 @@ def shard_gar_diag(gar, mesh, *, f, **kwargs):
                  "trim_frac": P()}
     # check_vma=False: the Pallas out_shapes inside carry no varying-
     # mesh-axes annotation, and the replicated aux rides the psum'd Gram
-    return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
-                     out_specs=(P(MODEL), aux_specs), check_vma=False)
+    mapped = shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                       out_specs=(P(MODEL), aux_specs), check_vma=False)
+    return lambda g, d_real: mapped(g)  # distance aux: padding-invariant
+
+
+def _coord_diag_builder(name, gar, mesh, *, f, **kwargs):
+    """Native d-sharded diagnostics for the coordinate-wise trim rules:
+    shard-local aggregate + kept-mask, width-aware partial sums, ONE
+    tupled psum (`(Gram, dev², kept-counts)` — the collective census the
+    lattice pins), replicated aux. See `shard_gar_diag`."""
+    from byzantinemomentum_tpu.ops import _common, diag, trmean as trmean_mod
+
+    def fn(g, d_real):
+        def kernel(g_local):
+            n = g_local.shape[0]
+            width = g_local.shape[1]
+            with pallas_sort.allowed():
+                if name == "trmean":
+                    agg = trmean_mod.trmean(g_local, f)
+                    kept = diag.rank_kept_mask(g_local, f)
+                elif name == "phocas":
+                    center = trmean_mod.trmean(g_local, f)
+                    agg = _common.closest_mean(g_local, center, n - f)
+                    dev_c = jnp.abs(g_local - center[None, :])
+                    kept = diag.rank_kept_mask(dev_c, f, n_low=0,
+                                               n_high=n - f)
+                else:  # meamed
+                    center = _common.lower_median(g_local)
+                    agg = _common.closest_mean(g_local, center, n - f)
+                    dev_c = jnp.abs(g_local - center[None, :])
+                    kept = diag.rank_kept_mask(dev_c, f, n_low=0,
+                                               n_high=n - f)
+            # Real-column mask: the facade's divisibility padding lives in
+            # the LAST shard's tail; its all-zero columns must not count
+            # toward any per-coordinate mean
+            start = jax.lax.axis_index(MODEL).astype(jnp.int32) * width
+            real = (start + jnp.arange(width, dtype=jnp.int32)) < d_real
+            kept_part = jnp.sum((kept & real[None, :]).astype(jnp.float32),
+                                axis=1)
+            dev = g_local - agg[None, :]
+            # Padded columns deviate by exactly 0 (zero data, zero
+            # aggregate), so the score partials need no real-mask
+            dev2_part = jnp.sum(dev * dev, axis=1)
+            gram_part = jnp.matmul(g_local, g_local.T,
+                                   precision=jax.lax.Precision.HIGHEST)
+            gram, dev2, kept_count = jax.lax.psum(
+                (gram_part, dev2_part, kept_part), MODEL)
+            scores = _common.sanitize_inf(jnp.sqrt(dev2))
+            trim = 1.0 - kept_count / d_real
+            aux = diag.make_aux(
+                n, scores=scores,
+                selection=jnp.ones((n,), jnp.float32),
+                dist=_common.distances_from_sq_gram(gram),
+                trim_frac=trim)
+            return agg, aux
+
+        aux_specs = {"scores": P(), "selection": P(), "dist": P(),
+                     "trim_frac": P()}
+        # check_vma=False: Pallas out_shapes carry no varying-mesh-axes
+        # annotation, and the replicated aux rides the tupled psum
+        return shard_map(kernel, mesh=mesh, in_specs=P(None, MODEL),
+                         out_specs=(P(MODEL), aux_specs),
+                         check_vma=False)(g)
+
+    return fn
 
 
 def sharded_state_spec(state):
@@ -355,7 +429,9 @@ class _ShardedGar:
             from byzantinemomentum_tpu.ops import _generic_diagnose
             return _generic_diagnose(self.unchecked, gradients, **kwargs)
         gradients, d, pad = self._padded(gradients)
-        agg, aux = self._diag_fn(gradients)
+        # The builder gets the PRE-padding width: coordinate-wise aux
+        # normalizes its per-coordinate means by the true d
+        agg, aux = self._diag_fn(gradients, d)
         return (agg[:d] if pad else agg), aux
 
     def unchecked(self, gradients, **_kwargs):

@@ -12,8 +12,10 @@ kernel to drift (Sculley et al.'s hidden-debt warning, PAPERS.md).
 Layers (one module each):
 
   programs   persistent compiled program cache per
-             `(gar, n-bucket, f, d, diagnostics)` cell; request n rounds
-             up to a shape bucket, padded rows masked out in-jit.
+             `(gar, n-bucket, f, d-bucket, diagnostics)` cell; request
+             (n, d) rounds up a two-axis shape-bucket ladder — padded
+             rows masked out in-jit by the traced-count masked kernels,
+             padded columns zero (exact per rule, `D_PAD_EXACT`).
   batching   microbatch queue (max-batch / max-delay flush) packing
              concurrent same-cell requests along a leading `vmap` axis;
              async dispatch, futures on device-ready.
@@ -30,10 +32,12 @@ Load is measured the production way by `scripts/serve_loadgen.py`
 """
 
 from byzantinemomentum_tpu.serve.programs import (   # noqa: F401
-    Cell, MASKED_GARS, N_BUCKETS, OversizeRequest, ProgramCache)
+    Cell, D_BUCKETS, D_PAD_EXACT, MASKED_GARS, N_BUCKETS, OversizeRequest,
+    ProgramCache)
 from byzantinemomentum_tpu.serve.batching import MicroBatcher  # noqa: F401
 from byzantinemomentum_tpu.serve.service import (    # noqa: F401
     AggregateResult, AggregationService)
 
 __all__ = ["AggregationService", "AggregateResult", "Cell", "MicroBatcher",
-           "ProgramCache", "OversizeRequest", "MASKED_GARS", "N_BUCKETS"]
+           "ProgramCache", "OversizeRequest", "MASKED_GARS", "N_BUCKETS",
+           "D_BUCKETS", "D_PAD_EXACT"]

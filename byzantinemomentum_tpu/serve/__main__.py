@@ -14,10 +14,14 @@ selfcheck's synthetic traffic, the device string is advisory.
 `--selfcheck` is the CI smoke (`scripts/run_test_tiers.py` serve tier):
 it proves, in-process and in seconds, that (1) a warm serving loop
 compiles ZERO new programs across 100+ mixed-cell requests
-(`analysis/contracts.py::assert_recompile_budget`), (2) a planted
-outlier client's suspicion rises and its verdict rides the response, and
-(3) the socket front end answers ping/aggregate/stats over a real TCP
-connection.
+(`analysis/contracts.py::assert_recompile_budget`), (2) warm
+HETEROGENEOUS traffic — one rule per kernel family (Gram-selection,
+stage-1 scan, subset enumeration, coordinate-wise), each spanning >= 3
+raw row counts AND >= 3 raw widths — also compiles ZERO programs (the
+two-axis bucket ladder's whole point: novel raw (n, d) shapes land on
+warm bucket programs), (3) a planted outlier client's suspicion rises
+and its verdict rides the response, and (4) the socket front end
+answers ping/aggregate/stats over a real TCP connection.
 """
 
 import argparse
@@ -26,7 +30,7 @@ import sys
 
 import numpy as np
 
-__all__ = ["main", "selfcheck"]
+__all__ = ["main", "selfcheck", "HETERO_FAMILIES"]
 
 # The selfcheck's mixed-cell traffic: three GARs, mixed row counts
 # (bucketed and exact), mixed f/d, diagnostics on and off.
@@ -35,6 +39,18 @@ SELFCHECK_CELLS = (
     ("krum", 7, 1, 64, True),
     ("median", 5, 1, 32, True),
     ("trmean", 9, 2, 64, False),
+)
+
+# Heterogeneous-(n, d) traffic: one rule per kernel FAMILY, each family
+# serving >= 3 distinct raw n and >= 3 distinct raw d values — the raw
+# shapes deliberately share buckets (n -> 16/8, d -> 128) so the whole
+# grid lands on a handful of warm programs.
+HETERO_FAMILIES = (
+    # (gar, f, raw row counts, raw widths)
+    ("krum", 2, (9, 11, 13), (96, 120, 128)),    # Gram-selection family
+    ("bulyan", 1, (9, 11, 13), (96, 120, 128)),  # stage-1 scan family
+    ("brute", 1, (5, 6, 7), (96, 120, 128)),     # subset-enumeration family
+    ("trmean", 2, (9, 11, 13), (96, 120, 128)),  # coordinate-wise family
 )
 
 
@@ -82,7 +98,43 @@ def selfcheck(seed=1, requests=120, verbose=True):
             print(f"serve selfcheck: {10 * group} warm requests, "
                   f"0 recompiles, 0 implicit transfers", flush=True)
 
-        # (2) a planted outlier client gets flagged, verdict on response
+        # (2) heterogeneous-(n, d) traffic: every kernel family, >= 3 raw
+        # n and >= 3 raw d each, ZERO compiles once the bucket programs
+        # are warm — the two-axis ladder acceptance
+        hetero_cells = [(gar, n, f, d, False)
+                        for gar, f, ns, ds in HETERO_FAMILIES
+                        for n in ns for d in ds]
+        compiled = service.warmup(hetero_cells)
+        if verbose:
+            print(f"serve selfcheck: warmed {compiled} hetero bucket "
+                  f"programs for {len(hetero_cells)} raw (n, d) shapes",
+                  flush=True)
+
+        def hetero_step():
+            futures = []
+            for gar, f, ns, ds in HETERO_FAMILIES:
+                for n in ns:
+                    for d in ds:
+                        cohort = rng.standard_normal((n, d)).astype(
+                            np.float32)
+                        futures.append(service.submit(
+                            cohort, gar=gar, f=f, diagnostics=False))
+            for fut in futures:
+                fut.result(timeout=60)
+
+        hetero_requests = 3 * len(hetero_cells)
+        contracts.assert_recompile_budget(
+            hetero_step, steps=3, budget=0,
+            label=f"warm heterogeneous-(n, d) traffic "
+                  f"({hetero_requests} requests over "
+                  f"{len(HETERO_FAMILIES)} rule families)")
+        if verbose:
+            print(f"serve selfcheck: {hetero_requests} warm heterogeneous "
+                  f"requests across {len(HETERO_FAMILIES)} families "
+                  f"(>=3 raw n x >=3 raw d each), 0 recompiles",
+                  flush=True)
+
+        # (3) a planted outlier client gets flagged, verdict on response
         n, d, f = 11, 64, 2
         verdict = None
         for _ in range(30):
@@ -103,7 +155,7 @@ def selfcheck(seed=1, requests=120, verbose=True):
                   f"(suspicion {verdict['suspicion']:.2f} vs honest "
                   f"{honest['suspicion']:.2f})", flush=True)
 
-        # (3) the socket front end round-trips
+        # (4) the socket front end round-trips
         import socket
         with AggregationServer(("127.0.0.1", 0), service) as server:
             server.serve_background()

@@ -40,13 +40,18 @@ __all__ = ["ServeRequest", "MicroBatcher"]
 
 
 class ServeRequest:
-    """One enqueued aggregation: the packed payload plus its future."""
+    """One enqueued aggregation: the packed payload plus its future.
+    `n`/`d` are the RAW request shape (the cell's n_bucket/d_bucket are
+    the compiled sizes); the packer pads up and the resolver slices
+    back."""
 
-    __slots__ = ("cell", "n", "matrix", "client_ids", "future", "t_submit")
+    __slots__ = ("cell", "n", "d", "matrix", "client_ids", "future",
+                 "t_submit")
 
     def __init__(self, cell, n, matrix, client_ids):
         self.cell = cell
         self.n = int(n)
+        self.d = int(matrix.shape[1])
         self.matrix = matrix          # np.f32[n, d] (host)
         self.client_ids = client_ids  # tuple[str] | None
         self.future = concurrent.futures.Future()

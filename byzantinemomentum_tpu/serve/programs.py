@@ -2,33 +2,49 @@
 
 A served aggregation is one device program over a *cell*:
 
-    (gar, n-bucket, f, d, diagnostics)
+    (gar, n-bucket, f, d-bucket, diagnostics)
 
-Request row counts are rounded UP to a small set of shape buckets and the
-padding rows are masked out through the PR 1 masked-quorum GAR variants
-(`faults/quorum.py::masked_aggregate` — inactive rows never select, never
-average, and the effective Byzantine tolerance is recomputed from the
-traced active count), so steady-state traffic over mixed n never
-recompiles: every request lands on one of the bucket programs compiled at
-warm-up. Only the GARs with TRUE masked kernels (`average`, `median`,
-`trmean`, `krum` and their `native-` tiers) take padded buckets; the rest
-fall back to the documented NaN-routing contract, which is only correct
-while `absent + byzantine <= f` — more padding than that would break the
-rule's guarantee — so those rules get EXACT cells (`n_bucket == n`: one
-compile per distinct n, still cached and persistent).
+Shape buckets collapse the compile lattice on BOTH data axes:
+
+* ROWS — request row counts round UP a small geometric ladder and the
+  padding rows are masked out through the traced-count masked-quorum GAR
+  kernels (`faults/quorum.py::masked_aggregate`): inactive rows never
+  select, never average, and the effective Byzantine tolerance is
+  recomputed from the traced active count. Since PR 10 EVERY registered
+  rule has a true traced-count kernel (bulyan's stage-1 scan runs inert
+  padded rounds, brute enumerates over the active subset with a
+  worst-case-sized rank space, phocas/meamed/aksel/cge turn their static
+  slice bounds into rank predicates), so every rule takes padded
+  buckets. The one exception is brute at an infeasible declared rank
+  space (`ops/brute.py::masked_rank_space` — the masked program must
+  provision `C(n_bucket, f)` subsets statically): those requests get an
+  EXACT row cell (`n_bucket == n`), still cached and persistent, with the
+  reason pinned in `row_bucket`.
+
+* COLUMNS — request dimensions round UP the `D_BUCKETS` ladder (then by
+  doubling) with ZERO padding, and the aggregate is sliced back to the
+  request's true width. Zero columns are exact for every registered rule
+  — the per-rule proof lives in `D_PAD_EXACT` below — so heterogeneous
+  model sizes stop compiling per d. A rule whose proof ever fails routes
+  to exact-d (`col_bucket` consults the registry); today none does.
+
+Steady-state traffic over mixed (n, d) therefore never recompiles: every
+request lands on one of the bucket programs compiled at warm-up, and
+requests of DIFFERENT raw shapes that share a cell microbatch together.
 
 The batch axis is bucketed the same way: concurrent same-cell requests
 pack along a leading request axis (`vmap` over the per-request program)
 whose length rounds up to a power of two, padding slots repeating the
 first request's payload (their outputs are dropped — repeating real data
 keeps the padded lanes numerically tame). One compiled program therefore
-serves every (n <= bucket, batch <= bucket) combination of its cell.
+serves every (n <= bucket, d <= bucket, batch <= bucket) combination of
+its cell.
 
 Dispatch is async — the executable call returns before the device
 finishes, and the service resolves caller futures on device-ready.
 (PR 8 additionally requested `donate_argnums` on the packed matrix; the
 BMT-H03 structural gate showed the request was inert — no program output
-matches the `(B, N, d)` buffer's shape, so jax drops the aliasing and
+matches the `(B, N, D)` buffer's shape, so jax drops the aliasing and
 warns on donation-capable backends. The dead request is gone; the
 lattice cell `serve/...` pins the no-aliasing layout, and the engine's
 update cell pins the contract where donation IS honored.)
@@ -48,20 +64,58 @@ import jax.numpy as jnp
 from byzantinemomentum_tpu import ops, utils
 from byzantinemomentum_tpu.faults import quorum
 from byzantinemomentum_tpu.obs import recorder
-from byzantinemomentum_tpu.ops import diag
+from byzantinemomentum_tpu.ops import brute as brute_mod, diag
 
 __all__ = ["Cell", "ProgramCache", "OversizeRequest", "N_BUCKETS",
-           "MASKED_GARS", "batch_bucket", "row_bucket"]
+           "D_BUCKETS", "MASKED_GARS", "D_PAD_EXACT", "batch_bucket",
+           "row_bucket", "col_bucket"]
 
 # Row-count shape buckets: requests round up to the smallest bucket >= n.
 # The ladder is geometric so at most 2x rows are ever padded, and capped
 # where the fused Pallas pipeline caps (`ops/pallas_gar.py::MAX_ROWS`).
 N_BUCKETS = (4, 8, 16, 32, 64)
 
-# GARs with exact masked-quorum kernels (`faults/quorum.py` dispatch):
-# these aggregate the active subset EXACTLY regardless of how many padded
-# rows ride along, so they are the rules that take padded buckets.
-MASKED_GARS = frozenset({"average", "median", "trmean", "krum"})
+# Column (model-dimension) shape buckets: the ladder covers the common
+# request range, then extends by doubling — every d lands on a warm
+# program at the cost of < 2x padded FLOPs. No upper cap: a big model is
+# a legitimate client, it just pays its own (cached) compile.
+D_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+# Every first-tier registered rule has a traced-count masked kernel
+# (`faults/quorum.py` dispatch), so every rule takes padded row buckets.
+# Kept as an explicit registry (not "everything") so a future rule
+# without a masked kernel degrades to exact row cells instead of
+# silently serving a wrong contract.
+MASKED_GARS = frozenset({"average", "median", "trmean", "krum", "bulyan",
+                         "brute", "phocas", "meamed", "aksel", "cge"})
+
+# The per-rule d-padding exactness proof: appending ZERO columns (and
+# slicing the aggregate back) must not change any real coordinate of the
+# output. The shared lemmas:
+#   (L1) squared distances / norms / Gram entries gain only `+ 0` terms,
+#        so every distance-derived ordering, score, selection and f_eff
+#        is unchanged bit for bit;
+#   (L2) coordinate-wise reductions (sort / median / trimmed mean /
+#        closest-mean / row-weighted averages) act per column, so real
+#        columns never see the padded ones;
+#   (L3) the padded columns of the MASKED aggregate are exactly 0 for
+#        every rule (means/medians/trims of all-zero active values, or a
+#        weight vector hitting zero columns), so the serve aux's
+#        distance-to-aggregate scores also gain only `+ 0` terms.
+# Each entry cites the lemmas that close its proof; a rule that cannot
+# be proven must map to False and is routed to exact-d by `col_bucket`.
+D_PAD_EXACT = {
+    "average": True,   # L2: per-column mean
+    "median": True,    # L2: per-column sort + take
+    "trmean": True,    # L2: per-column sort + rank-trimmed mean
+    "phocas": True,    # L2 twice: trmean center, then closest-mean
+    "meamed": True,    # L2 twice: median center, then closest-mean
+    "krum": True,      # L1 scores/selection + L2 weighted row average
+    "bulyan": True,    # L1 stage-1 scan + L2 stage-2 averaged median
+    "aksel": True,     # L2 median center + L1 squared distances + L2 mean
+    "cge": True,       # L1 norms + L2 mean
+    "brute": True,     # L1 diameters (subset unchanged) + L2 mean
+}
 
 
 class OversizeRequest(utils.UserException):
@@ -72,10 +126,15 @@ def _base_name(name):
     return name[len("native-"):] if name.startswith("native-") else name
 
 
-def row_bucket(gar_name, n, buckets=N_BUCKETS):
+def row_bucket(gar_name, n, buckets=N_BUCKETS, f=None):
     """The bucketed row count for a request of `n` rows: the smallest
-    bucket >= n for the masked-family GARs, `n` itself (an exact cell)
-    for rules whose padding contract would not hold. Raises
+    bucket >= n whose masked program is buildable. Every registered rule
+    has a traced-count masked kernel; the only unbuildable case is brute
+    at a bucket whose worst-case subset enumeration `C(bucket, f)`
+    exceeds `ops/brute.py::MASKED_MAX_SUBSETS` — such requests fall back
+    to an EXACT row cell (n_bucket == n, one compile per distinct n,
+    still cached; the exact cell itself may also be infeasible, in which
+    case the quorum layer's NaN-routing fallback serves it). Raises
     `OversizeRequest` beyond the largest bucket."""
     if n < 1:
         raise utils.UserException(f"Expected at least one row, got {n}")
@@ -83,12 +142,35 @@ def row_bucket(gar_name, n, buckets=N_BUCKETS):
         raise OversizeRequest(
             f"Request of {n} rows exceeds the largest shape bucket "
             f"({buckets[-1]}); shard the cohort or raise the bucket ladder")
-    if _base_name(gar_name) not in MASKED_GARS:
+    base = _base_name(gar_name)
+    if base not in MASKED_GARS:
         return n
     for b in buckets:
         if n <= b:
+            if base == "brute" and f is not None and (
+                    brute_mod.masked_rank_space(b, f) is None):
+                # Infeasible masked enumeration at this bucket: exact cell
+                return n
             return b
     raise OversizeRequest(f"No bucket holds {n} rows")  # unreachable
+
+
+def col_bucket(gar_name, d, buckets=D_BUCKETS):
+    """The bucketed column count for a request of width `d`: the smallest
+    ladder bucket >= d (doubling past the ladder top) for rules whose
+    d-padding proof holds (`D_PAD_EXACT`), `d` itself — an exact-d cell —
+    for any rule whose proof fails."""
+    if d < 1:
+        raise utils.UserException(f"Expected at least one column, got {d}")
+    if not D_PAD_EXACT.get(_base_name(gar_name), False):
+        return d
+    for b in buckets:
+        if d <= b:
+            return b
+    b = buckets[-1]
+    while b < d:
+        b *= 2
+    return b
 
 
 def batch_bucket(b, max_batch):
@@ -100,32 +182,37 @@ def batch_bucket(b, max_batch):
 
 
 class Cell(tuple):
-    """Hashable program-cache key `(gar, n_bucket, f, d, diagnostics)`."""
+    """Hashable program-cache key `(gar, n_bucket, f, d_bucket,
+    diagnostics)` — both shape coordinates are the BUCKETED (compiled)
+    sizes; requests carry their raw (n, d) alongside."""
 
     __slots__ = ()
 
-    def __new__(cls, gar, n_bucket, f, d, diagnostics):
-        return tuple.__new__(cls, (str(gar), int(n_bucket), int(f), int(d),
-                                   bool(diagnostics)))
+    def __new__(cls, gar, n_bucket, f, d_bucket, diagnostics):
+        return tuple.__new__(cls, (str(gar), int(n_bucket), int(f),
+                                   int(d_bucket), bool(diagnostics)))
 
     gar = property(lambda self: self[0])
     n_bucket = property(lambda self: self[1])
     f = property(lambda self: self[2])
     d = property(lambda self: self[3])
+    d_bucket = property(lambda self: self[3])
     diagnostics = property(lambda self: self[4])
 
     def __repr__(self):
         return (f"Cell({self.gar}, n={self.n_bucket}, f={self.f}, "
-                f"d={self.d}, diag={self.diagnostics})")
+                f"d={self.d_bucket}, diag={self.diagnostics})")
 
 
 def _build(cell):
     """Compile-ready program for one cell: `vmap` of the per-request
     masked aggregation along the leading request axis. Inputs
-    `(G: f32[B, N, d], active: bool[B, N])`, outputs a dict of stacked
-    per-request results. No donation: no output matches the packed
-    matrix's shape, so a `donate_argnums` request could never alias
-    (BMT-H03 — the lattice cell pins this layout)."""
+    `(G: f32[B, N, D], active: bool[B, N])`, outputs a dict of stacked
+    per-request results (aggregates at the bucketed width D — the
+    resolver slices each back to its request's raw d). No donation: no
+    output matches the packed matrix's shape, so a `donate_argnums`
+    request could never alias (BMT-H03 — the lattice cell pins this
+    layout)."""
     gar = ops.gars[cell.gar]
     f, diagnostics = cell.f, cell.diagnostics
 
@@ -157,8 +244,9 @@ class ProgramCache:
     microbatch flusher both reach `get`.
     """
 
-    def __init__(self, buckets=N_BUCKETS):
+    def __init__(self, buckets=N_BUCKETS, d_buckets=D_BUCKETS):
         self.buckets = tuple(sorted(buckets))
+        self.d_buckets = tuple(sorted(d_buckets))
         self._programs = {}
         self._warm = set()     # (cell, batch_bucket) pairs seen
         self._lock = threading.Lock()
@@ -166,12 +254,14 @@ class ProgramCache:
         self.misses = 0
 
     def cell(self, gar, n, f, d, diagnostics):
-        """The cell a request of `n` rows lands on (bucketing the rows)."""
+        """The cell a request of raw shape `(n, d)` lands on (bucketing
+        both axes)."""
         if gar not in ops.gars:
             raise utils.UserException(
                 f"Unknown aggregation rule {gar!r}; registered: "
                 f"{', '.join(sorted(ops.gars))}")
-        return Cell(gar, row_bucket(gar, n, self.buckets), f, d, diagnostics)
+        return Cell(gar, row_bucket(gar, n, self.buckets, f=f), f,
+                    col_bucket(gar, d, self.d_buckets), diagnostics)
 
     def get(self, cell, batch):
         """The compiled program for `cell`, counting a hit/miss for the

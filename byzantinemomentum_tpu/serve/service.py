@@ -47,7 +47,7 @@ class AggregateResult:
                  "latency_ms")
 
     def __init__(self, aggregate, f_eff, n, cell, verdicts, latency_ms):
-        self.aggregate = aggregate    # np.f32[d]
+        self.aggregate = aggregate    # np.f32[d] (raw request width)
         self.f_eff = f_eff            # effective Byzantine tolerance used
         self.n = n                    # submitted rows (pre-bucket)
         self.cell = cell              # the program cell served from
@@ -61,7 +61,7 @@ class AggregateResult:
             "f_eff": int(self.f_eff),
             "n": self.n,
             "cell": {"gar": self.cell.gar, "n_bucket": self.cell.n_bucket,
-                     "f": self.cell.f, "d": self.cell.d,
+                     "f": self.cell.f, "d_bucket": self.cell.d_bucket,
                      "diagnostics": self.cell.diagnostics},
             "verdicts": self.verdicts,
             "latency_ms": round(self.latency_ms, 3),
@@ -186,10 +186,12 @@ class AggregationService:
     def warmup(self, cells, batch_sizes=None):
         """Pre-compile (and pre-execute) the given `(gar, n, f, d,
         diagnostics)` request shapes at every batch bucket, so steady-state
-        traffic meets a fully warm cache. Drives the program cache
-        directly (not the batcher) so exactly one program runs per
-        `(cell, batch_bucket)` regardless of flush timing. Returns the
-        number of programs executed."""
+        traffic meets a fully warm cache — raw (n, d) shapes are bucketed
+        exactly as live requests are, so distinct raw shapes that share a
+        cell warm it once. Drives the program cache directly (not the
+        batcher) so exactly one program runs per `(cell, batch_bucket)`
+        regardless of flush timing. Returns the number of programs
+        executed."""
         import jax
 
         if batch_sizes is None:
@@ -199,13 +201,18 @@ class AggregationService:
                 batch_sizes.append(b)
                 b *= 2
         count = 0
+        seen = set()
         rng = np.random.default_rng(0)
         for gar, n, f, d, diagnostics in cells:
             cell = self.cache.cell(gar, n, f, d, bool(diagnostics))
             for b in batch_sizes:
                 B = batch_bucket(b, self.max_batch)
-                G = np.zeros((B, cell.n_bucket, d), dtype=np.float32)
-                G[:, :n] = rng.standard_normal((B, n, d))
+                if (cell, B) in seen:
+                    continue
+                seen.add((cell, B))
+                G = np.zeros((B, cell.n_bucket, cell.d_bucket),
+                             dtype=np.float32)
+                G[:, :n, :d] = rng.standard_normal((B, n, d))
                 active = np.zeros((B, cell.n_bucket), dtype=bool)
                 active[:, :n] = True
                 program = self.cache.get(cell, B)
@@ -220,17 +227,20 @@ class AggregationService:
     def _dispatch(self, cell, requests):
         """Pack one cell's batch and dispatch it asynchronously (flusher
         thread). Padding: rows beyond each request's n are inactive (the
-        masked-quorum variants ignore them); batch slots beyond the real
-        requests repeat the first request's payload and are dropped at
-        resolution."""
+        traced-count masked kernels ignore them), columns beyond each
+        request's d are zero (exact for every rule — the
+        `serve/programs.py::D_PAD_EXACT` proof); batch slots beyond the
+        real requests repeat the first request's payload and are dropped
+        at resolution. Requests of DIFFERENT raw (n, d) shapes pack into
+        the same batch whenever they share a cell."""
         import jax
 
-        N, d = cell.n_bucket, cell.d
+        N, D = cell.n_bucket, cell.d_bucket
         B = batch_bucket(len(requests), self.max_batch)
-        G = np.zeros((B, N, d), dtype=np.float32)
+        G = np.zeros((B, N, D), dtype=np.float32)
         active = np.zeros((B, N), dtype=bool)
         for i, r in enumerate(requests):
-            G[i, :r.n] = r.matrix
+            G[i, :r.n, :r.d] = r.matrix
             active[i, :r.n] = True
         for i in range(len(requests), B):
             G[i], active[i] = G[0], active[0]
@@ -263,7 +273,7 @@ class AggregationService:
                         host["selection"][i, :r.n],
                         distances=host["worker_dist"][i, :r.n])
             result = AggregateResult(
-                aggregate=host["aggregate"][i],
+                aggregate=host["aggregate"][i, :r.d],
                 f_eff=int(host["f_eff"][i]),
                 n=r.n, cell=r.cell, verdicts=verdicts,
                 latency_ms=(now - r.t_submit) * 1000.0)

@@ -157,7 +157,10 @@ _SERVE_FLOOR_MS = 0.5
 def _serve_metrics(payload):
     """Flatten a serve artifact (`scripts/serve_loadgen.py`) into
     `{(name, is_cost): value}`: per-cell p50/p99 latencies are COSTS
-    (growth regresses), aggregations/s are RATES (drop regresses)."""
+    (growth regresses), aggregations/s are RATES (drop regresses), and
+    the heterogeneous workload's compile counts are COSTS with no noise
+    floor (a compiled-program count that grows means shapes fell off the
+    bucket ladder — the exact regression the r10 d-bucketing removed)."""
     metrics = {}
     for cell, fields in (payload.get("cells") or {}).items():
         if not isinstance(fields, dict):
@@ -170,6 +173,10 @@ def _serve_metrics(payload):
     value = payload.get("speedup_batched_vs_sequential")
     if isinstance(value, (int, float)):
         metrics[("speedup_batched_vs_sequential", False)] = float(value)
+    for key in ("distinct_cells", "distinct_programs", "warm_compiles"):
+        value = (payload.get("compiles") or {}).get(key)
+        if isinstance(value, (int, float)):
+            metrics[(f"compiles.{key}", True)] = float(value)
     return metrics
 
 
@@ -177,9 +184,21 @@ def compare_serve(old_payload, new_payload, tolerance):
     """The serve-latency gate: `(rows, regressions)` over metrics present
     in BOTH artifacts. Latency costs regress by GROWING past tolerance
     (with the `_SERVE_FLOOR_MS` absolute floor, as the phase-budget
-    gate), throughput rates by DROPPING past it."""
+    gate), throughput rates by DROPPING past it, and `compiles.*` counts
+    regress on ANY growth (they are exact integers — no noise floor, no
+    tolerance: one extra compiled program is a ladder hole)."""
     old_metrics = _serve_metrics(old_payload)
     new_metrics = _serve_metrics(new_payload)
+    # The speedup is a RATIO of two metrics gated on their own (batched
+    # rate: drop fails; sequential rate: a FASTER baseline can never be a
+    # regression). A ratio drop explained entirely by a faster sequential
+    # baseline is therefore not a serving regression — only flag the
+    # speedup when the batched capacity itself also dropped, so the ratio
+    # adds signal instead of double-counting a baseline improvement.
+    batched_key = ("serve.batched.agg_per_sec", False)
+    batched_dropped = (
+        batched_key in old_metrics and batched_key in new_metrics
+        and new_metrics[batched_key] < old_metrics[batched_key])
     rows = []
     regressions = []
     for (name, cost) in sorted(old_metrics, key=lambda k: k[0]):
@@ -189,11 +208,17 @@ def compare_serve(old_payload, new_payload, tolerance):
         delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
                                                    else float("inf"))
         rows.append((name, old, new, delta))
-        if cost:
+        if name.startswith("compiles."):
+            if new > old:
+                regressions.append((name, old, new, delta))
+        elif cost:
             if (new > old * (1.0 + tolerance)
                     and new - old > _SERVE_FLOOR_MS):
                 regressions.append((name, old, new, delta))
         elif delta < -tolerance:
+            if (name == "speedup_batched_vs_sequential"
+                    and not batched_dropped):
+                continue  # baseline-driven ratio drop (see note above)
             regressions.append((name, old, new, delta))
     return rows, regressions
 

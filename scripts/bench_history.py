@@ -85,8 +85,11 @@ def _gar_ms(root, label):
 
 
 # Aggregation-service trajectory columns (`scripts/serve_loadgen.py`
-# artifacts): open-loop latency percentiles + saturation throughput
-SERVE_COLUMNS = ("serve p50 ms", "serve p99 ms", "serve agg/s")
+# artifacts): open-loop latency percentiles + saturation throughput +
+# the heterogeneous workload's distinct-compiled-program count (r10 —
+# rounds before the two-axis ladder show `-`)
+SERVE_COLUMNS = ("serve p50 ms", "serve p99 ms", "serve agg/s",
+                 "serve compiles")
 
 
 def _serve_stats(root, label):
@@ -113,6 +116,8 @@ def _serve_stats(root, label):
     stats = {"p50": num(open_loop, "p50_ms"),
              "p99": num(open_loop, "p99_ms"),
              "rate": num(batched, "agg_per_sec"),
+             "compiles": num(payload.get("compiles") or {},
+                             "distinct_programs"),
              "backend": payload.get("backend")}
     if all(stats[k] is None for k in ("p50", "p99", "rate")):
         return None  # legacy/foreign payload with no renderable cell
@@ -235,10 +240,14 @@ def render_table(history, serve=None):
                 return f"{gar[0]:>{w}.3f}" if gar is not None else f"{'-':>{w}}"
             if c in SERVE_COLUMNS:
                 key = {"serve p50 ms": "p50", "serve p99 ms": "p99",
-                       "serve agg/s": "rate"}[c]
+                       "serve agg/s": "rate",
+                       "serve compiles": "compiles"}[c]
                 value = None if row_serve is None else row_serve.get(key)
-                return (f"{value:>{w}.3f}" if value is not None
-                        else f"{'-':>{w}}")
+                if value is None:
+                    return f"{'-':>{w}}"
+                if key == "compiles":
+                    return f"{int(value):>{w}d}"
+                return f"{value:>{w}.3f}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"

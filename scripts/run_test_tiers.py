@@ -211,6 +211,17 @@ def main():
     if smoke_line is not None:
         serve_tier["speedup"] = smoke_line.get(
             "speedup_batched_vs_sequential")
+        compiles = smoke_line.get("compiles")
+        if isinstance(compiles, dict):
+            # The r10 heterogeneous-(n, d) workload: distinct compiled
+            # programs under the two-axis bucket ladder vs the retired
+            # per-(n, d) policy, and the warm-phase compile count (the
+            # selfcheck separately ASSERTS the zero-recompile budget)
+            serve_tier["hetero_cells"] = compiles.get("distinct_cells")
+            serve_tier["hetero_reduction"] = compiles.get(
+                "reduction_vs_per_nd")
+            serve_tier["hetero_warm_compiles"] = compiles.get(
+                "warm_compiles")
     telemetry.event("serve_tier", **{k: v for k, v in serve_tier.items()
                                      if not k.endswith("_tail")})
     print(f"  {serve_tier}", flush=True)

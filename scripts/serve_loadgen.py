@@ -19,6 +19,17 @@ machine-readable `BENCH_serve.json` (`"kind": "serve"`) that
                     queueing delay is measured honestly rather than
                     hidden by a closed loop's self-throttling
                     (the coordinated-omission trap).
+  serve.hetero      heterogeneous-(n, d) workload (r10): one rule per
+                    kernel family, each spanning >= 3 raw row counts and
+                    >= 3 raw widths. Measures the COLD phase (every
+                    distinct raw shape once, sequentially — the
+                    cold-start tail each novel shape pays) and the WARM
+                    phase (mixed saturation traffic) with XLA compile
+                    counts for both, plus the `compiles` policy
+                    comparison: distinct compiled cells under the
+                    two-axis bucket ladder vs what the per-(n, d) PR 8
+                    policy (exact n for non-masked rules, exact d for
+                    every rule) would have compiled for the same stream.
 
 The p99 contract is also checked: a correctly-batched service bounds
 p99 by `max_delay` (the longest a request waits for batch-mates) plus
@@ -45,7 +56,8 @@ import numpy as np
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
-__all__ = ["run_loadgen", "percentiles", "main"]
+__all__ = ["run_loadgen", "run_hetero", "pr8_policy_cells", "percentiles",
+           "main"]
 
 
 def percentiles(latencies_ms):
@@ -117,11 +129,13 @@ def _open_loop(service, cohorts, gar, f, clients, rate, rng):
 
 def run_loadgen(*, requests=400, n=11, d=128, f=2, gar="krum",
                 max_batch=8, max_delay_ms=5.0, rate=None, seed=1,
-                repeats=2):
-    """The three measurement phases over one cell; returns the artifact
-    payload (no file I/O here — tests call this directly). Throughput
-    phases run `repeats` windows and keep the fastest — the standard
-    damping for scheduler noise on shared/1-core CI hosts."""
+                repeats=2, heterogeneous=True, hetero_repeats=8):
+    """The measurement phases; returns the artifact payload (no file I/O
+    here — tests call this directly). Throughput phases run `repeats`
+    windows and keep the fastest — the standard damping for scheduler
+    noise on shared/1-core CI hosts. `heterogeneous` adds the mixed
+    -(n, d) workload phase (`run_hetero`) and its `compiles` policy
+    comparison to the artifact."""
     import jax
 
     from byzantinemomentum_tpu.serve import AggregationService
@@ -134,15 +148,113 @@ def run_loadgen(*, requests=400, n=11, d=128, f=2, gar="krum",
     old_switch = sys.getswitchinterval()
     sys.setswitchinterval(0.001)
     try:
-        return _run_loadgen(requests, n, d, f, gar, max_batch,
-                            max_delay_ms, rate, seed, repeats,
-                            AggregationService, jax.default_backend())
+        payload = _run_loadgen(requests, n, d, f, gar, max_batch,
+                               max_delay_ms, rate, seed, repeats,
+                               AggregationService, jax.default_backend())
+        if heterogeneous:
+            hetero = run_hetero(repeats_per_shape=hetero_repeats,
+                                max_batch=max_batch,
+                                max_delay_ms=max_delay_ms, seed=seed)
+            payload["cells"]["serve.hetero"] = hetero["hetero_cell"]
+            payload["cold_start"] = hetero["cold"]
+            payload["compiles"] = hetero["compiles"]
+        return payload
     finally:
         sys.setswitchinterval(old_switch)
 
 
 def _best(runs, key="agg_per_sec"):
     return max(runs, key=lambda r: r[key])
+
+
+def pr8_policy_cells(shapes):
+    """Distinct compiled CELLS the retired per-(n, d) PR 8 policy would
+    need for a request stream of `(gar, f, n, d)` shapes: only
+    average/median/trmean/krum rode padded row buckets, everything else
+    compiled per exact n, and EVERY rule compiled per exact d. The
+    counterfactual the r10 two-axis ladder is measured against."""
+    from byzantinemomentum_tpu.serve.programs import N_BUCKETS
+
+    legacy_masked = {"average", "median", "trmean", "krum"}
+    cells = set()
+    for gar, f, n, d in shapes:
+        if gar in legacy_masked:
+            nb = next(b for b in N_BUCKETS if n <= b)
+        else:
+            nb = n
+        cells.add((gar, nb, f, d))
+    return len(cells)
+
+
+def run_hetero(*, repeats_per_shape=8, max_batch=8, max_delay_ms=5.0,
+               seed=1):
+    """The heterogeneous-(n, d) phase: cold-start tail, warm mixed
+    traffic, and the compile-count policy comparison. Returns the
+    artifact fragment (`hetero` cell + `compiles` summary)."""
+    from byzantinemomentum_tpu.analysis import contracts
+    from byzantinemomentum_tpu.serve import AggregationService
+    from byzantinemomentum_tpu.serve.__main__ import HETERO_FAMILIES
+
+    rng = np.random.default_rng(seed)
+    shapes = [(gar, f, n, d) for gar, f, ns, ds in HETERO_FAMILIES
+              for n in ns for d in ds]
+    with AggregationService(max_batch=max_batch,
+                            max_delay_ms=max_delay_ms) as svc:
+        # COLD: every distinct raw shape once, sequentially, against an
+        # entirely unwarmed cache — each novel CELL pays its compile
+        # inside the measured latency, which is exactly the tail the
+        # bucket ladder exists to amortize (novel shapes that share a
+        # cell land warm even here)
+        cold_lat = []
+        with contracts.count_compiles() as cold_log:
+            for gar, f, n, d in shapes:
+                cohort = rng.standard_normal((n, d)).astype(np.float32)
+                result = svc.aggregate(cohort, gar=gar, f=f,
+                                       diagnostics=False, timeout=120)
+                cold_lat.append(result.latency_ms)
+        # Finish warming: the cold pass ran sequential batches of 1, so
+        # the larger batch buckets (which saturation traffic will pack)
+        # still owe their compiles — pre-execute them the way a real
+        # deployment's warmup would
+        svc.warmup([(gar, n, f, d, False) for gar, f, n, d in shapes])
+        # WARM: saturation traffic round-robining every shape — mixed raw
+        # shapes of one cell microbatch together; zero compiles expected
+        warm_payloads = [
+            (gar, f, rng.standard_normal((n, d)).astype(np.float32))
+            for _ in range(repeats_per_shape)
+            for gar, f, n, d in shapes]
+        t0 = time.perf_counter()
+        with contracts.count_compiles() as warm_log:
+            futures = [svc.submit(m, gar=gar, f=f, diagnostics=False)
+                       for gar, f, m in warm_payloads]
+            warm_lat = [fut.result(timeout=120).latency_ms
+                        for fut in futures]
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+
+    distinct_cells = stats["cache"]["cells"]
+    pr8_cells = pr8_policy_cells(shapes)
+    return {
+        "hetero_cell": {
+            "agg_per_sec": round(len(warm_payloads) / wall, 2),
+            **percentiles(warm_lat),
+        },
+        "cold": {"shapes": len(shapes),
+                 "compiles": cold_log.count,
+                 **percentiles(cold_lat),
+                 "max_ms": round(float(np.max(cold_lat)), 3)},
+        "compiles": {
+            "families": len(HETERO_FAMILIES),
+            "shapes": len(shapes),
+            "warm_requests": len(warm_payloads),
+            "warm_compiles": warm_log.count,
+            "distinct_cells": distinct_cells,
+            "distinct_programs": stats["cache"]["programs"],
+            "per_nd_policy_cells": pr8_cells,
+            "reduction_vs_per_nd": round(
+                pr8_cells / max(distinct_cells, 1), 2),
+        },
+    }
 
 
 def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
@@ -241,14 +353,18 @@ def main(argv=None):
                              "measurement); no artifact unless --out-smoke")
     parser.add_argument("--out-smoke", action="store_true",
                         help="write the artifact even under --smoke")
+    parser.add_argument("--no-heterogeneous", action="store_true",
+                        help="skip the mixed-(n, d) workload phase")
     args = parser.parse_args(argv)
 
     kwargs = dict(requests=args.requests, n=args.n, d=args.d, f=args.f,
                   gar=args.gar, max_batch=args.max_batch,
                   max_delay_ms=args.max_delay_ms, rate=args.rate,
-                  seed=args.seed, repeats=args.repeats)
+                  seed=args.seed, repeats=args.repeats,
+                  heterogeneous=not args.no_heterogeneous)
     if args.smoke:
-        kwargs.update(requests=min(args.requests, 80), d=min(args.d, 64))
+        kwargs.update(requests=min(args.requests, 80), d=min(args.d, 64),
+                      hetero_repeats=2)
     payload = run_loadgen(**kwargs)
 
     line = {k: payload[k] for k in ("kind", "backend",
@@ -257,6 +373,8 @@ def main(argv=None):
     line["cells"] = {name: {k: cell[k] for k in ("agg_per_sec", "p50_ms",
                                                  "p99_ms")}
                      for name, cell in payload["cells"].items()}
+    if "compiles" in payload:
+        line["compiles"] = payload["compiles"]
     print(json.dumps(line))
     if not args.smoke or args.out_smoke:
         out = pathlib.Path(args.out)

@@ -341,8 +341,8 @@ def test_wrn_pack_ab_smoke(tmp_path, capsys):
 # serve gate: BENCH_serve.json latency/throughput comparison
 
 def _serve_artifact(path, p99=5.0, p50=2.0, rate=4000.0, speedup=4.0,
-                    backend="cpu"):
-    path.write_text(json.dumps({
+                    backend="cpu", compiles=None):
+    payload = {
         "kind": "serve", "backend": backend,
         "cells": {
             "serve.open_loop": {"p50_ms": p50, "p99_ms": p99,
@@ -353,7 +353,14 @@ def _serve_artifact(path, p99=5.0, p50=2.0, rate=4000.0, speedup=4.0,
                                  "agg_per_sec": rate / speedup},
         },
         "speedup_batched_vs_sequential": speedup,
-    }))
+    }
+    if compiles is not None:
+        payload["compiles"] = {"distinct_cells": compiles,
+                               "distinct_programs": compiles * 4,
+                               "warm_compiles": 0,
+                               "per_nd_policy_cells": compiles * 6,
+                               "reduction_vs_per_nd": 6.0}
+    path.write_text(json.dumps(payload))
     return path
 
 
@@ -386,6 +393,48 @@ def test_serve_gate_throughput_drop_fails(tmp_path, capsys):
     assert rc == 1
     assert "serve.batched.agg_per_sec" in out
     assert "speedup_batched_vs_sequential" in out
+
+
+def test_serve_gate_baseline_driven_speedup_drop_passes(tmp_path, capsys):
+    """A speedup-ratio drop caused by the SEQUENTIAL baseline getting
+    faster (batched capacity improved) is not a serving regression — the
+    ratio's components are gated on their own and a faster baseline can
+    never fail."""
+    old = _serve_artifact(tmp_path / "old.json", rate=4000.0, speedup=4.0)
+    new = _serve_artifact(tmp_path / "new.json", rate=4500.0, speedup=3.0)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REGRESSED" not in out
+    assert "speedup_batched_vs_sequential" in out  # still rendered
+
+
+def test_serve_gate_compiles_growth_fails(tmp_path, capsys):
+    """The r10 `compiles` column: ANY growth in the heterogeneous
+    workload's distinct compiled-program count fails — no tolerance, no
+    floor (a compile is a ladder hole, not noise)."""
+    old = _serve_artifact(tmp_path / "old.json", compiles=4)
+    new = _serve_artifact(tmp_path / "new.json", compiles=5)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.50"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [l for l in out.splitlines() if "compiles.distinct_cells" in l][0]
+    assert "REGRESSED" in line
+
+
+def test_serve_gate_compiles_flat_passes_and_legacy_pair_skips(
+        tmp_path, capsys):
+    """Equal compile counts pass; a legacy (r08) artifact without the
+    field simply has no common compiles metric — the gate skips it
+    rather than failing the pair."""
+    old = _serve_artifact(tmp_path / "old.json", compiles=4)
+    new = _serve_artifact(tmp_path / "new.json", compiles=4)
+    assert bench_compare.main([str(old), str(new)]) == 0
+    capsys.readouterr()
+    legacy = _serve_artifact(tmp_path / "legacy.json")  # no compiles field
+    current = _serve_artifact(tmp_path / "current.json", compiles=4)
+    assert bench_compare.main([str(legacy), str(current)]) == 0
+    assert "compiles" not in capsys.readouterr().out
 
 
 def test_serve_gate_sub_floor_growth_is_noise(tmp_path, capsys):
@@ -430,14 +479,17 @@ def test_bench_history_serve_columns(tmp_path, capsys):
     bench_history = _bench_history()
     _artifact(tmp_path, "BENCH_r01.json", 10.0)
     _serve_artifact(tmp_path / "BENCH_serve_r02.json", p99=6.0, rate=5000.0)
-    _serve_artifact(tmp_path / "BENCH_serve.json", p99=5.5, rate=5200.0)
+    _serve_artifact(tmp_path / "BENCH_serve.json", p99=5.5, rate=5200.0,
+                    compiles=4)
     (tmp_path / "BENCH_cells.json").write_text(json.dumps(
         {"metric": "sim_steps_per_sec", "value": 12.0}))
 
     serve = bench_history.collect_serve(tmp_path, ["r01", "r02", "current"])
     assert "r01" not in serve
     assert serve["r02"]["p99"] == 6.0 and serve["r02"]["rate"] == 5000.0
+    assert serve["r02"]["compiles"] is None  # pre-r10 artifact
     assert serve["current"]["p99"] == 5.5
+    assert serve["current"]["compiles"] == 16  # distinct_programs
 
     rc = bench_history.main(["--root", str(tmp_path)])
     out = capsys.readouterr().out
@@ -447,7 +499,9 @@ def test_bench_history_serve_columns(tmp_path, capsys):
     r01 = [l for l in out.splitlines() if l.startswith("r01")][0]
     assert r01.split()[-1] == "-"
     r02 = [l for l in out.splitlines() if l.startswith("r02")][0]
-    assert r02.split()[-3:] == ["2.000", "6.000", "5000.000"]
+    assert r02.split()[-4:] == ["2.000", "6.000", "5000.000", "-"]
+    current = [l for l in out.splitlines() if l.startswith("current")][0]
+    assert current.split()[-1] == "16"
     assert "backend=cpu load report" in out
 
     rc = bench_history.main(["--root", str(tmp_path), "--json"])

@@ -143,6 +143,13 @@ def test_masked_aggregation_matches_static_compaction():
         "median": (3, lambda g, f: ops._common.lower_median(g)),
         "krum": (2, lambda g, f: ops.krum.aggregate(g, f)),    # (8-3)//2
         "trmean": (3, lambda g, f: ops.trmean.trmean(g, f)),   # (8-1)//2
+        # The r10 traced-count kernels: every remaining first-tier rule
+        "bulyan": (1, lambda g, f: ops.bulyan.aggregate(g, f)),  # (8-3)//4
+        "phocas": (3, lambda g, f: ops.trmean.aggregate_phocas(g, f)),
+        "meamed": (3, lambda g, f: ops.trmean.aggregate_meamed(g, f)),
+        "aksel": (3, lambda g, f: ops.aksel.aggregate(g, f)),
+        "cge": (3, lambda g, f: ops.cge.aggregate(g, f)),
+        "brute": (3, lambda g, f: ops.brute.aggregate(g, f)),
     }
     for name, (f_eff, oracle) in cases.items():
         got, f_used = quorum.masked_aggregate(
@@ -152,12 +159,6 @@ def test_masked_aggregation_matches_static_compaction():
                                    rtol=1e-5, atol=1e-6,
                                    err_msg=f"masked {name}")
         assert int(f_used) == f_eff, name
-    # Unsupported GARs degrade via NaN routing with the declared f: the
-    # absent rows count toward f_decl and the result stays finite
-    got, f_used = quorum.masked_aggregate(
-        ops.gars["phocas"], G, active, f_decl=f_decl)
-    assert int(f_used) == f_decl
-    assert bool(jnp.all(jnp.isfinite(got)))
 
 
 def test_masked_krum_never_selects_inactive_or_nan_rows():

@@ -68,12 +68,13 @@ def test_enumerator_reproduces_legacy_cells():
 
 def test_lattice_covers_mesh_serve_and_update_axes():
     """The full enumeration at least doubles the legacy surface and
-    includes virtual-mesh sharded cells, serve cells and the donated
-    update-contract cell."""
+    includes virtual-mesh sharded cells, masked-bucket cells, serve cells
+    and the donated update-contract cell."""
     keys = [c.key for c in lattice.enumerate_cells()]
     assert len(keys) == len(set(keys)), "duplicate cell keys"
     assert len(keys) >= 60
     legacy = [k for k in keys if "/" in k and "@" not in k
+              and not k.endswith("/masked-bucket")
               and not k.startswith(("serve/", "engine/"))]
     assert len(legacy) == 30
     for k in lattice.MESH_AXES:
@@ -81,6 +82,26 @@ def test_lattice_covers_mesh_serve_and_update_axes():
     assert "krum/diag@mesh2" in keys  # the sharded-diagnostics axis
     assert any(k.startswith("serve/") for k in keys)
     assert "engine/sgd-update@donate" in keys
+    # The r10 bucket axis: every rule's traced-count masked kernel at a
+    # padded serving shape, incl. the scan/enumeration holdouts
+    for name in lattice.CELL_GARS:
+        assert f"{name}/masked-bucket" in keys
+    assert "serve/bulyan/n16f2d32b2" in keys
+    assert "serve/brute/n8f2d32b2+diag" in keys
+
+
+def test_masked_bucket_cells_hold_h01_h02():
+    """The BMT-H census of the traced-count masked kernels: zero
+    collectives AND no worker-matrix-scale gather — bulyan's inert-round
+    scan, brute's one-hot unranking and the rank-predicate rules must
+    never fall back to dynamic row gathers of the padded matrix."""
+    for name in ("bulyan", "brute", "phocas", "meamed", "aksel", "cge"):
+        cell = next(c for c in lattice.enumerate_cells(meshes=(), serve=())
+                    if c.key == f"{name}/masked-bucket")
+        key, text, expect = lattice.lower_cell(cell)
+        assert expect.psums == 0
+        assert expect.gather_limit == lattice.N_BUCKET * lattice.D - 1
+        assert hlolint.lint_module(text, expect, key) == [], key
 
 
 def test_committed_goldens_are_the_enumeration():
@@ -253,9 +274,34 @@ def test_sharded_diag_aux_matches_unsharded(name, f):
     _aux_equal(aux_s, aux_u)
 
 
+@pytest.mark.parametrize("name", ["trmean", "phocas", "meamed"])
+@pytest.mark.parametrize("f", [1, 2, 3])
+def test_sharded_coord_diag_aux_matches_unsharded(name, f):
+    """The r10 coordinate-wise sharded diagnostics (ROADMAP lattice rung
+    1): trmean/phocas/meamed trim fractions and deviation scores from
+    d-local partial sums psum'd with shard widths accounted — oracle
+    -tested against the unsharded NATIVE aux, with planted NaN rows and a
+    non-dividing d (divisibility padding must not dilute the per
+    -coordinate means)."""
+    mesh = make_mesh(4, model_parallel=4)
+    n, d = 4 * f + 4, 66  # 66 % 4 != 0: the facade pads two zero columns
+    rng = np.random.default_rng(20 * f + len(name))
+    g = rng.normal(size=(n, d)).astype(np.float32)
+    g[-f:] = np.nan
+    g = jnp.asarray(g)
+    gar = ops.gars[name]
+    agg_u, aux_u = gar.diagnosed(g, f=f)
+    facade = shard_defense_list([(gar, 1.0, {})], mesh, f=f)[0][0]
+    assert facade._diag_fn is not None  # the native sharded path engaged
+    agg_s, aux_s = facade.diagnosed(g, f=f)
+    np.testing.assert_allclose(np.asarray(agg_s), np.asarray(agg_u),
+                               rtol=1e-4, atol=1e-5)
+    _aux_equal(aux_s, aux_u)
+
+
 def test_sharded_diag_generic_fallback_for_coordinate_rules():
-    """Rules without a native sharded aux keep the generic geometry
-    fallback (their per-coordinate trim fractions are a ROADMAP rung)."""
+    """Rules without a native sharded aux (median's was-median fraction
+    remains one) keep the generic geometry fallback."""
     mesh = make_mesh(2, model_parallel=2)
     facade = shard_defense_list(
         [(ops.gars["median"], 1.0, {})], mesh, f=2)[0][0]

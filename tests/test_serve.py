@@ -1,9 +1,11 @@
-"""Aggregation-service tests (`byzantinemomentum_tpu/serve/`): the shape
--bucket policy, padded-masked correctness against the direct GAR kernels,
-the warm-loop zero-recompile acceptance (100+ mixed-cell requests, zero
-backend compiles), per-client suspicion verdicts, rejection/telemetry
-paths, the line-JSON socket front end, and the load generator's
-machine-readable artifact."""
+"""Aggregation-service tests (`byzantinemomentum_tpu/serve/`): the
+two-axis shape-bucket policy, padded-masked correctness against the
+direct GAR kernels, the per-rule padded-(n, d)-bucket-vs-exact-cell
+bit-equality oracle grid (all 9 first-tier rules, f in {1,2,3}, planted
+NaN rows and duplicate-row ties), the warm-loop zero-recompile
+acceptance (100+ mixed-cell requests, zero backend compiles), per-client
+suspicion verdicts, rejection/telemetry paths, the line-JSON socket
+front end, and the load generator's machine-readable artifact."""
 
 import json
 import socket
@@ -11,6 +13,7 @@ import socket
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from byzantinemomentum_tpu import ops, utils
@@ -18,9 +21,10 @@ from byzantinemomentum_tpu.analysis import contracts
 from byzantinemomentum_tpu.obs.forensics import ClientSuspicionStore
 from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat
 from byzantinemomentum_tpu.serve import (
-    AggregationService, OversizeRequest, N_BUCKETS)
+    AggregationService, OversizeRequest, D_PAD_EXACT, N_BUCKETS)
 from byzantinemomentum_tpu.serve.frontend import AggregationServer
-from byzantinemomentum_tpu.serve.programs import batch_bucket, row_bucket
+from byzantinemomentum_tpu.serve.programs import (
+    Cell, _build, batch_bucket, col_bucket, row_bucket)
 
 
 def _cohort(n, d, seed=0):
@@ -45,21 +49,49 @@ def service():
 # Shape buckets
 
 def test_row_bucket_policy():
-    """Masked-family GARs round up the ladder; rules without masked
-    kernels get exact cells (their NaN-routing fallback only absorbs
-    padding within f); beyond the ladder is an oversize rejection."""
+    """EVERY registered rule rounds up the ladder now that the traced
+    -count masked kernels are universal; the one exception is brute at an
+    infeasible worst-case rank space (the masked enumeration must
+    provision `C(bucket, f)` statically), which gets an exact row cell;
+    beyond the ladder is an oversize rejection."""
     assert row_bucket("krum", 11) == 16
     assert row_bucket("krum", 16) == 16
     assert row_bucket("native-krum", 3) == 4
     assert row_bucket("median", 33) == 64
-    assert row_bucket("bulyan", 11) == 11   # exact: no masked kernel
-    assert row_bucket("brute", 7) == 7
+    assert row_bucket("bulyan", 11, f=2) == 16   # masked-bucketed since r10
+    assert row_bucket("phocas", 11, f=2) == 16
+    assert row_bucket("aksel", 5, f=1) == 8
+    assert row_bucket("cge", 17, f=1) == 32
+    assert row_bucket("brute", 7, f=2) == 8      # C(8, 2) feasible
+    assert row_bucket("brute", 40, f=5) == 40    # C(64, 5) > cap: exact
     with pytest.raises(OversizeRequest):
         row_bucket("krum", N_BUCKETS[-1] + 1)
     with pytest.raises(OversizeRequest):
         row_bucket("bulyan", N_BUCKETS[-1] + 1)
     with pytest.raises(utils.UserException):
         row_bucket("krum", 0)
+
+
+def test_col_bucket_policy():
+    """Columns round up the d-ladder (doubling past its top) for every
+    rule whose zero-padding proof holds — all of them today — and an
+    unproven rule routes to exact-d."""
+    assert col_bucket("krum", 17) == 32
+    assert col_bucket("bulyan", 128) == 128
+    assert col_bucket("brute", 129) == 256
+    assert col_bucket("median", 5000) == 8192    # doubling past the ladder
+    assert all(D_PAD_EXACT[g] for g in D_PAD_EXACT)  # today: all proven
+    with pytest.raises(utils.UserException):
+        col_bucket("krum", 0)
+
+
+def test_col_bucket_unproven_rule_routes_exact(monkeypatch):
+    """The registry is load-bearing: a rule whose d-padding proof fails
+    must serve exact-d cells."""
+    from byzantinemomentum_tpu.serve import programs
+    monkeypatch.setitem(programs.D_PAD_EXACT, "krum", False)
+    assert col_bucket("krum", 17) == 17
+    assert col_bucket("native-krum", 17) == 17
 
 
 def test_batch_bucket():
@@ -88,15 +120,79 @@ def test_padded_bucket_matches_direct_gar(service, gar, n, f):
     assert result.n == n
 
 
-def test_exact_cell_gar_without_masked_kernel(service):
-    """A rule outside the masked family (bulyan) serves from an exact
-    cell: no padded rows, aggregate equals the direct kernel."""
-    G = _cohort(11, 32, seed=3)
-    result = service.aggregate(G, gar="bulyan", f=2, diagnostics=False)
-    direct = np.asarray(ops.gars["bulyan"].unchecked(jnp.asarray(G), f=2))
-    np.testing.assert_allclose(result.aggregate, direct, rtol=5e-5,
-                               atol=5e-6)
-    assert result.cell.n_bucket == 11
+def test_bulyan_brute_serve_from_padded_buckets(service):
+    """The r10 holdout rules (bulyan's stage-1 scan, brute's subset
+    enumeration) now serve from padded buckets: bucketed cell, aggregate
+    equal to the direct kernel on the submitted rows."""
+    for gar, n, f in (("bulyan", 11, 2), ("brute", 9, 2)):
+        G = _cohort(n, 32, seed=3)
+        result = service.aggregate(G, gar=gar, f=f, diagnostics=False)
+        direct = np.asarray(ops.gars[gar].unchecked(jnp.asarray(G), f=f))
+        np.testing.assert_allclose(result.aggregate, direct, rtol=5e-5,
+                                   atol=5e-6)
+        assert result.cell.n_bucket == 16
+
+
+# --------------------------------------------------------------------------- #
+# The tentpole oracle grid: for EVERY first-tier rule, the padded-(n, d)
+# bucket program is BIT-identical to the exact cell program — f in
+# {1, 2, 3}, with a planted NaN row (within f) and a duplicate-row tie
+
+ALL_GARS = ("average", "median", "trmean", "phocas", "meamed", "krum",
+            "bulyan", "aksel", "cge", "brute")
+
+
+def _run_cell_program(cell, G, n):
+    """One request through a cell's compiled program at batch 1."""
+    Gp = np.zeros((1, cell.n_bucket, cell.d_bucket), np.float32)
+    Gp[0, :n, :G.shape[1]] = G
+    active = np.zeros((1, cell.n_bucket), bool)
+    active[0, :n] = True
+    out = _build(cell)(jax.device_put(Gp), jax.device_put(active))
+    return {k: np.asarray(v)[0] for k, v in out.items()}
+
+
+@pytest.mark.parametrize("gar", ALL_GARS)
+@pytest.mark.parametrize("f", (1, 2, 3))
+def test_padded_nd_bucket_bit_identical_to_exact_cell(gar, f):
+    """The two-axis bucket ladder is exact, not approximate: the padded
+    (n-bucket, d-bucket) program and the exact (n, d) cell produce
+    bit-identical aggregates, f_eff AND serve aux for every rule —
+    including a planted NaN row (worst-case routing) and a duplicated
+    row (stable tie-breaking must not read the padding)."""
+    n = 4 * f + 3          # satisfies every rule's contract up to f=3
+    d = 19                 # off-ladder width -> real column padding
+    rng = np.random.default_rng(100 * f + len(gar))
+    G = rng.standard_normal((n, d)).astype(np.float32)
+    G[1] = G[0]            # duplicate-row tie
+    G[-1, :4] = np.nan     # corrupt-but-present row, within f
+    exact = _run_cell_program(Cell(gar, n, f, d, True), G, n)
+    from byzantinemomentum_tpu.serve.programs import ProgramCache
+    bucket_cell = ProgramCache().cell(gar, n, f, d, True)
+    assert bucket_cell.n_bucket > n and bucket_cell.d_bucket > d
+    padded = _run_cell_program(bucket_cell, G, n)
+    for key in exact:
+        e = np.asarray(exact[key])
+        p = np.asarray(padded[key])
+        if key == "aggregate":
+            p = p[:d]
+        elif p.ndim == 1 and p.shape != e.shape:
+            p = p[:n]
+        np.testing.assert_array_equal(
+            np.nan_to_num(e, nan=7e9, posinf=8e9),
+            np.nan_to_num(p, nan=7e9, posinf=8e9),
+            err_msg=f"{gar} f={f} output {key!r} not bit-identical "
+                    f"across the bucket padding")
+
+
+def test_brute_infeasible_bucket_serves_exact_row_cell(service):
+    """Brute beyond its masked rank-space cap gets an exact row cell —
+    the documented routing reason in `serve/programs.py::row_bucket` —
+    and still aggregates correctly through the quorum fallback."""
+    n, f = 40, 5           # C(64, 5) = 7.6M > MASKED_MAX_SUBSETS
+    from byzantinemomentum_tpu.ops import brute as brute_mod
+    assert brute_mod.masked_rank_space(64, f) is None
+    assert row_bucket("brute", n, f=f) == n
 
 
 # --------------------------------------------------------------------------- #
@@ -278,13 +374,24 @@ def test_loadgen_smoke_payload(tmp_path):
     sys.modules.setdefault("serve_loadgen", mod)
     spec.loader.exec_module(mod)
     payload = mod.run_loadgen(requests=40, n=7, d=32, f=1, max_batch=4,
-                              max_delay_ms=2.0, repeats=1)
+                              max_delay_ms=2.0, repeats=1,
+                              hetero_repeats=1)
     assert payload["kind"] == "serve"
     cells = payload["cells"]
     assert set(cells) == {"serve.sequential", "serve.batched",
-                          "serve.open_loop"}
+                          "serve.open_loop", "serve.hetero"}
     for cell in cells.values():
         assert cell["p50_ms"] <= cell["p99_ms"]
         assert cell["agg_per_sec"] > 0
     assert payload["speedup_batched_vs_sequential"] > 0
-    assert payload["stats"]["served"] >= 120  # all three phases resolved
+    assert payload["stats"]["served"] >= 120  # the main phases resolved
+    # The r10 heterogeneous workload: >= 4x fewer distinct compiled
+    # cells than the per-(n, d) PR 8 policy, zero warm compiles, and the
+    # cold phase's compile count matches the distinct program count of
+    # its sequential (batch-1) pass
+    compiles = payload["compiles"]
+    assert compiles["warm_compiles"] == 0
+    assert compiles["reduction_vs_per_nd"] >= 4.0
+    assert compiles["distinct_cells"] < compiles["per_nd_policy_cells"]
+    assert payload["cold_start"]["compiles"] > 0
+    assert payload["cold_start"]["p99_ms"] >= payload["cold_start"]["p50_ms"]
