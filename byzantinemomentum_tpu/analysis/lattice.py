@@ -66,12 +66,14 @@ MESH_VARIANTS = {2: ("plain", "diag"), 4: ("plain",)}
 # with zero communication or replicates)
 GRAM_RULES = frozenset({"krum", "bulyan", "brute"})
 
-# Coordinate-wise trim rules with a NATIVE sharded diagnostics kernel
+# Coordinate-wise rules with a NATIVE sharded diagnostics kernel
 # (`parallel/sharded.py::_coord_diag_builder`): their diag-under-mesh
 # cells psum ONE tuple — (Gram, dev², kept-counts) — which StableHLO
 # spells as three all_reduce ops (one per tuple leaf); the census pins
-# that the tuple never unfuses into extra collectives
-COORD_DIAG_RULES = frozenset({"trmean", "phocas", "meamed"})
+# that the tuple never unfuses into extra collectives. Median joined in
+# the PR 11 round (was-median kept-counts — the last generic-fallback
+# holdout of the ROADMAP's lattice rung 3).
+COORD_DIAG_RULES = frozenset({"trmean", "phocas", "meamed", "median"})
 COORD_DIAG_PSUMS = 3
 
 # Serve-axis cells: (gar, n_bucket, f, d, diagnostics, batch) — masked
@@ -204,6 +206,35 @@ def _masked_bucket_cell(name):
         expect=hlolint.Expect(psums=0, gather_limit=N_BUCKET * D - 1))
 
 
+def _quarantine_cell(name):
+    """The closed defense loop's per-step program at the quarantine call
+    site (`arena/quarantine.py::quarantine_defense_kernel`): sanitize +
+    masked-quorum aggregate with the ACTIVE MASK and the reclaimed-quorum
+    credit as runtime operands + the rule-agnostic suspicion aux.
+    Structural contract: like the masked cells — no collectives, and no
+    worker-matrix-scale gather (H02) — so an eviction is provably a
+    runtime bool flip over this one program, never a retrace into a
+    different one."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from byzantinemomentum_tpu import ops
+        from byzantinemomentum_tpu.arena.quarantine import (
+            quarantine_defense_kernel)
+
+        spec = jax.ShapeDtypeStruct((N, D), jnp.float32)
+        mask = jax.ShapeDtypeStruct((N,), jnp.bool_)
+        f_evicted = jax.ShapeDtypeStruct((), jnp.int32)
+        return (quarantine_defense_kernel(ops.gars[name], f=F),
+                (spec, mask, f_evicted))
+
+    return LatticeCell(
+        key=f"{name}/quarantine", build=build,
+        expect=hlolint.Expect(psums=0, gather_limit=N * D - 1))
+
+
 def _serve_cell(gar, n_bucket, f, d, diagnostics, batch):
     def build():
         import jax
@@ -269,6 +300,10 @@ def enumerate_cells(gars=None, variants=None, meshes=None, serve=None):
         # padded serving shape (H02 census: no worker-matrix gather)
         for name in gars:
             cells.append(_masked_bucket_cell(name))
+        # The quarantine axis: the closed loop's defense-plus-aux program
+        # (PR 11), runtime-mask contract per rule
+        for name in gars:
+            cells.append(_quarantine_cell(name))
     for k in meshes:
         for name in gars:
             for variant in MESH_VARIANTS.get(k, ("plain",)):

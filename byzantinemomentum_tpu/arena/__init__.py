@@ -1,0 +1,36 @@
+"""The attack-vs-defense arena — the closed defense loop and the
+tournament that earns it.
+
+PR 4/8 built the sensors (in-jit GAR diagnostics, per-worker/per-client
+EWMA suspicion) but nothing ever *acted* on a verdict. This package
+closes the loop and then stress-tests it:
+
+* `quarantine.py` — `QuarantinePolicy`: suspicion verdicts become an
+  ACTIVE MASK fed to the masked-quorum GAR kernels (`faults/quorum.py`)
+  as a runtime operand, so evictions re-use the compiled program (zero
+  retrace — asserted in the tournament smoke) and the effective quorum
+  `f_eff` shrinks in-jit with each eviction. Hysteresis, an eviction
+  patience, a max-evictions budget and a keep-one collusion dedup keep a
+  framing attack from turning the defense against honest workers.
+* `loop.py` — the closed training loop: a probe engine (the
+  `tests/test_engine.py` quadratic-probe technique — every trajectory is
+  analytically checkable) with optional label-skewed non-IID worker
+  shards, driven step by step with the policy's mask in the carry.
+* `sybil.py` — the serve-side red team: one perturbation split across
+  many client ids, under every per-client threshold
+  (`obs/forensics.py::ClientSuspicionStore`), caught only by the
+  cohort-level collusion channel + admission control
+  (`serve/admission.py`).
+* `tournament.py` — the grid runner: attack x GAR x quarantine {on, off}
+  in train mode plus the serve-mode Sybil cells, emitting the
+  machine-readable resilience scoreboard (`TOURNAMENT_r*.json`,
+  rendered over rounds by `scripts/bench_history.py`).
+
+The red team lives in `attacks/` (alie / alie-warmup / framing join the
+paper's static roster through the same registry, with the new optional
+state hook for the time-coupled ones).
+"""
+
+from byzantinemomentum_tpu.arena.quarantine import QuarantinePolicy
+
+__all__ = ["QuarantinePolicy"]

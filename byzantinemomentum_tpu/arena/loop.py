@@ -1,0 +1,246 @@
+"""The closed defense loop: engine step + quarantine policy, end to end.
+
+Train mode of the tournament (`arena/tournament.py`): a probe engine —
+the `tests/test_engine.py` technique, a quadratic model whose per-worker
+gradient is exactly `theta - mean(batch rows)` — runs the full
+Byzantine-SGD step (honest phase, worker momentum, in-jit attack
+synthesis against the live defense, masked-quorum aggregation) while a
+host-side `QuarantinePolicy` turns each step's diagnostics into the next
+step's active mask. The probe keeps every cell CPU-cheap (one cell is
+~100 ms of XLA compile + tens of microsecond steps) while exercising the
+real engine code paths: `Engine._phase_honest` / `_phase_update`, the
+attack registry incl. the stateful hook, `faults/quorum.py` masked
+kernels with dynamic `f_eff`, and `ops/diag.py::masked_generic_aux`.
+
+Zero-recompile discipline: the step is compiled ONCE per (attack, GAR)
+cell; the quarantine mask enters as a runtime bool[n] operand
+(`quarantine_defense_kernel`), so quarantine {on, off} runs — and every
+eviction within a run — share the same executable
+(`analysis/contracts.py::assert_recompile_budget` holds this to zero in
+the tournament smoke).
+
+Non-IID honest data (`noniid_batches`): each worker's shard is "label
+-skewed" — its batch rows draw from a worker-specific mean
+`optimum + skew * sigma * dir_i` (dir_i a signed basis direction), the
+mean-estimation analogue of a worker whose local class mix shifts its
+local optimum. With skew > 0 the honest gradients are no longer i.i.d.,
+the variance envelope the GARs assume widens, and an in-envelope attack
+gets more room — the failure mode Karimireddy et al.'s bucketing line
+studies (PAPERS.md).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import losses, ops
+from byzantinemomentum_tpu.arena.quarantine import (
+    QuarantinePolicy, quarantine_defense_kernel)
+from byzantinemomentum_tpu.attacks import attacks as attack_registry
+from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+from byzantinemomentum_tpu.models import ModelDef
+
+__all__ = ["ArenaCell", "noniid_batches", "probe_model_def", "probe_loss"]
+
+
+def probe_model_def(d):
+    """Quadratic probe: output = batch, gradient of the loss below w.r.t.
+    theta = theta - mean(batch rows) — fully analytic trajectories."""
+    def init(key):
+        return {"w": jnp.zeros((d,), jnp.float32)}, {}
+
+    def apply(params, state, x, train=False, rng=None):
+        return x, state
+
+    return ModelDef("arena-probe", init, apply, (d,))
+
+
+def probe_loss():
+    """0.5 * ||theta - mean(batch)||^2 — the minimum sits at the data
+    mean, so `||theta - optimum||` is the accuracy proxy."""
+    return losses.Loss(
+        lambda output, target, params:
+        0.5 * jnp.sum((params - jnp.mean(output, axis=0)) ** 2))
+
+
+def noniid_batches(rng, *, steps, workers, batch, optimum, sigma, skew):
+    """f32[steps, S, B, d] honest data stream. Worker i's rows draw from
+    `N(optimum + skew * sigma * dir_i, sigma^2)` — dir_i the signed basis
+    direction `(-1)^i e_{i mod d}` — so `skew=0` is the i.i.d. grid and
+    `skew>0` the label-skewed one (worker optima fan out around the true
+    optimum; the population mean stays near `optimum` when S covers the
+    directions evenly)."""
+    d = optimum.shape[0]
+    dirs = np.zeros((workers, d), np.float32)
+    for i in range(workers):
+        dirs[i, i % d] = 1.0 if i % 2 == 0 else -1.0
+    means = optimum[None, :] + skew * sigma * dirs
+    noise = rng.normal(size=(steps, workers, batch, d)).astype(np.float32)
+    return means[None, :, None, :] + sigma * noise
+
+
+class ArenaCell:
+    """One (attack, GAR) train-mode cell: a compiled closed-loop step,
+    runnable with quarantine on or off against the SAME executable.
+
+    Args mirror the tournament grid: `n` workers of which `f_real`
+    attack, `f_decl` declared; the probe dimension `d`; `attack_args`
+    forwarded to the attack plugin.
+    """
+
+    def __init__(self, gar, attack, *, n=11, f_decl=3, f_real=3, d=32,
+                 attack_args=None, gar_kwargs=None):
+        if attack not in attack_registry:
+            raise ValueError(f"Unknown attack {attack!r}")
+        self.gar_name, self.attack_name = gar, attack
+        self.n, self.f_decl, self.f_real, self.d = n, f_decl, f_real, d
+        self.cfg = EngineConfig(
+            nb_workers=n, nb_decl_byz=f_decl, nb_real_byz=f_real,
+            nb_for_study=0, momentum=0.9, dampening=0.0,
+            momentum_at="worker")
+        self.engine = build_engine(
+            cfg=self.cfg, model_def=probe_model_def(d), loss=probe_loss(),
+            criterion=losses.Criterion("sigmoid"),
+            defenses=[(ops.gars[gar], 1.0, dict(gar_kwargs or {}))],
+            attack=attack_registry[attack],
+            attack_kwargs=dict(attack_args or {}))
+        self.step = self._build_step()
+
+    def _build_step(self):
+        engine = self.engine
+        cfg = self.cfg
+        kernel = quarantine_defense_kernel(
+            ops.gars[self.gar_name], f=cfg.nb_decl_byz,
+            kwargs=engine.defenses[0][2])
+
+        def traced(state, xs, ys, lr, active, f_evicted):
+            (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
+             G_honest, _fault, new_fb) = engine._phase_honest(
+                state, xs, ys, lr)
+
+            def defense_fn(gradients, f):
+                # Adaptive attacks line-search against the defense the
+                # loop actually mounts: the masked kernel over the
+                # policy's CURRENT active set (probes of another row
+                # count fall back to the plain program)
+                if gradients.shape[0] == active.shape[0]:
+                    return kernel(gradients, active,
+                                  f_evicted)["aggregate"]
+                return engine._run_defense(
+                    gradients, jax.random.uniform(mix_key))
+
+            attack_state = state.attack_state
+            if cfg.nb_real_byz > 0:
+                with jax.named_scope("attack"):
+                    if engine.attack.stateful:
+                        G_attack, attack_state = engine.attack.unchecked(
+                            G_honest, f_decl=cfg.nb_decl_byz,
+                            f_real=cfg.nb_real_byz, defense=defense_fn,
+                            state=attack_state, **engine.attack_kwargs)
+                    else:
+                        G_attack = engine.attack.unchecked(
+                            G_honest, f_decl=cfg.nb_decl_byz,
+                            f_real=cfg.nb_real_byz, defense=defense_fn,
+                            **engine.attack_kwargs)
+                    G_attack = G_attack.astype(G_honest.dtype)
+            else:
+                G_attack = jnp.zeros((0, engine.d), G_honest.dtype)
+
+            G_all = jnp.concatenate([G_honest, G_attack])
+            out = kernel(G_all, active, f_evicted)
+            grad_defense = out.pop("aggregate").astype(G_honest.dtype)
+            # The uncorrupted reference signal: what a fault-free
+            # averaging server would apply this step
+            ideal = jnp.mean(G_honest, axis=0)
+            out["agg_err"] = jnp.sqrt(
+                jnp.sum((grad_defense - ideal) ** 2))
+            out["loss"] = loss_avg
+            new_state, _ = engine._phase_update(
+                state, rng, G_sampled, loss_avg, net_state, new_mw,
+                G_honest, G_attack, grad_defense,
+                jnp.float32(jnp.nan), lr, xs.shape[1],
+                None, new_fb, None, attack_state)
+            return new_state, out
+
+        return jax.jit(traced, donate_argnums=(0,))
+
+    # -------------------------------------------------------------- #
+
+    def run(self, *, quarantine=True, steps=60, seed=0, batch=8,
+            sigma=0.5, skew=0.0, lr=0.1, policy_kwargs=None,
+            warm_recompile_check=False):
+        """Drive the closed loop for `steps`; returns the scoreboard row.
+
+        `warm_recompile_check` additionally asserts — via
+        `analysis/contracts.py::assert_recompile_budget` — that three
+        extra steps under a CHANGING active mask compile nothing: the
+        eviction path re-uses the one compiled program.
+        """
+        n, h = self.n, self.cfg.nb_honests
+        rng = np.random.default_rng(seed)
+        optimum = np.ones(self.d, np.float32) / np.sqrt(self.d)
+        data = noniid_batches(rng, steps=steps, workers=h, batch=batch,
+                              optimum=optimum, sigma=sigma, skew=skew)
+        ys = jnp.zeros((h, batch), jnp.float32)
+        lr = jnp.float32(lr)
+
+        policy = (QuarantinePolicy(n, self.f_decl, **(policy_kwargs or {}))
+                  if quarantine else None)
+        state = self.engine.init(jax.random.PRNGKey(seed))
+        active = np.ones(n, dtype=bool)
+        reclaimed = 0
+        agg_errs, losses_seen = [], []
+        for t in range(steps):
+            state, out = self.step(state, jnp.asarray(data[t]), ys, lr,
+                                   jnp.asarray(active),
+                                   jnp.int32(reclaimed))
+            host = jax.device_get(out)
+            agg_errs.append(float(host["agg_err"]))
+            losses_seen.append(float(host["loss"]))
+            if policy is not None:
+                active = policy.update(
+                    t, host["selection"], distances=host["worker_dist"],
+                    active=host["active"], dist_matrix=host["dist"])
+                reclaimed = policy.f_reclaimed()
+
+        if warm_recompile_check:
+            from byzantinemomentum_tpu.analysis import contracts
+
+            flip = {"i": 0}
+
+            def warm_step():
+                # A mask (and quorum credit) that CHANGES between calls
+                # must not retrace
+                mask = np.ones(n, dtype=bool)
+                mask[n - 1 - (flip["i"] % 2)] = False
+                flip["i"] += 1
+                _state, out = self.step(
+                    self.engine.init(jax.random.PRNGKey(7)),
+                    jnp.asarray(data[0]), ys, lr, jnp.asarray(mask),
+                    jnp.int32(flip["i"] % 2))
+                return out["agg_err"]
+
+            contracts.assert_recompile_budget(
+                warm_step, steps=3, budget=0,
+                label=f"arena {self.gar_name}/{self.attack_name}")
+
+        theta = np.asarray(jax.device_get(state.theta))
+        evicted = sorted(policy.evicted_at) if policy else []
+        evicted_honest = [w for w in evicted if w < h]
+        evicted_byz = [w for w in evicted if w >= h]
+        ttq = (min(policy.evicted_at[w] for w in evicted_byz)
+               if evicted_byz else None)
+        return {
+            "gar": self.gar_name, "attack": self.attack_name,
+            "quarantine": bool(quarantine), "steps": steps,
+            "final_err": round(float(np.linalg.norm(theta - optimum)), 6),
+            "agg_err_mean": round(float(np.mean(agg_errs)), 6),
+            "agg_err_last10": round(float(np.mean(agg_errs[-10:])), 6),
+            "loss_last": round(losses_seen[-1], 6),
+            "evicted_honest": len(evicted_honest),
+            "evicted_byz": len(evicted_byz),
+            "time_to_quarantine": ttq,
+            "f_reclaimed": int(reclaimed),
+            "active_final": int(np.sum(active)),
+        }

@@ -1,0 +1,264 @@
+"""Suspicion-driven quarantine — verdicts become actions.
+
+`QuarantinePolicy` folds each step's defense diagnostics into a
+`SuspicionTracker` (`obs/forensics.py`, with the collusion channel
+enabled) and maintains the ACTIVE MASK the next step's masked-quorum
+aggregation runs with. The mask is a runtime operand of one compiled
+program (`quarantine_defense_kernel` below — blessed as the
+`<gar>/quarantine` lattice cells), so an eviction costs a bool flip, not
+a retrace, and the dynamic quorum (`faults/quorum.py::effective_f`)
+shrinks `f_eff` in-jit as workers leave.
+
+Eviction is deliberately harder than suspicion (the framing-resistance
+contract — a Byzantine coalition must not be able to spend its rows
+getting honest workers evicted):
+
+  statistical channel   blended suspicion must sit at or above
+                        `evict_threshold` (ABOVE the tracker's suspect
+                        threshold) for `patience` consecutive steps.
+                        The statistical components a framer can push
+                        onto a victim — selection deficit (weight w_sel)
+                        and distance z (w_dist) — are weighted so that
+                        even a victim starved to deficit 1 with an
+                        elevated-but-honest z stays BELOW the threshold;
+                        crossing it needs the saturated z-score a
+                        genuinely distant row earns, or collusion mass.
+  collusion channel     a near-duplicate cluster whose members' collusion
+                        EWMA reaches `collusion_evict` is DEDUPLICATED:
+                        every member is evicted except the one with the
+                        lowest collusion history (ties keep the lowest
+                        row index — honest rows precede attack rows in
+                        the stacked matrix, and a mimicry-framed victim's
+                        row is byte-identical to its copies anyway, so
+                        the kept representative preserves the victim's
+                        information regardless). Keeping one member also
+                        keeps the dedup sound for an honest pair that
+                        briefly collides.
+  budget                at most `max_evictions` workers (default: the
+                        declared f) are ever out at once — the hard cap
+                        on the blast radius of ANY policy failure.
+  hysteresis            with `reinstate=True`, a statistically-evicted
+                        worker whose suspicion falls to the tracker's
+                        clear level for `patience` steps re-enters (and
+                        frees budget); default off — an eviction is an
+                        operator-visible event, not a flap.
+
+Everything here is host-side numpy between steps (the same cadence as
+the CSV flush); the in-jit half is the masked kernel the mask feeds.
+"""
+
+import numpy as np
+
+from byzantinemomentum_tpu.obs import recorder
+from byzantinemomentum_tpu.obs.forensics import SuspicionTracker
+
+__all__ = ["QuarantinePolicy", "quarantine_defense_kernel",
+           "DEFAULT_WEIGHTS"]
+
+# (selection deficit, distance z, quarantine history, collusion) — chosen
+# so the framable channels (deficit + z at honest levels) cannot reach
+# the default evict_threshold on their own: a starved victim reads
+# ~0.35 + 0.25 * z_honest/4 < 0.55 for z_honest < ~3.2 sigma, while a
+# genuinely distant never-selected row saturates to 0.6 and a colluding
+# cluster adds up to 0.3 of hard evidence.
+DEFAULT_WEIGHTS = (0.35, 0.25, 0.10, 0.30)
+
+
+class QuarantinePolicy:
+    """The closed loop's actuator: suspicion in, active mask out.
+
+    Args:
+      nb_workers: rows in the stacked submission matrix (honest + byz).
+      f_decl: declared Byzantine tolerance — the default eviction budget.
+      evict_threshold: blended-suspicion level the statistical channel
+        must hold for `patience` steps (must exceed the tracker's
+        suspect `threshold`).
+      patience: consecutive steps of evidence before an eviction (and,
+        with `reinstate`, of calm before a re-entry).
+      collusion_evict: collusion-EWMA level that triggers cluster dedup.
+      max_evictions: hard cap on concurrently-evicted workers
+        (None -> f_decl).
+      reinstate: allow statistically-evicted workers back after calm.
+      tracker: extra kwargs for the underlying `SuspicionTracker`
+        (alpha/threshold/clear/weights/min_steps/collusion_frac).
+    """
+
+    def __init__(self, nb_workers, f_decl, *, evict_threshold=0.55,
+                 patience=5, collusion_evict=0.8, max_evictions=None,
+                 reinstate=False, tracker=None):
+        kwargs = {"alpha": 0.1, "weights": DEFAULT_WEIGHTS, "min_steps": 10}
+        kwargs.update(tracker or {})
+        self.tracker = SuspicionTracker(nb_workers, **kwargs)
+        if len(self.tracker.weights) != 4:
+            raise ValueError(
+                "QuarantinePolicy needs the 4-component tracker (the "
+                "collusion channel); pass a 4-tuple of weights")
+        if evict_threshold < self.tracker.threshold:
+            raise ValueError(
+                f"evict_threshold ({evict_threshold}) must not undercut "
+                f"the suspect threshold ({self.tracker.threshold}) — "
+                f"eviction is the stronger verdict")
+        self.nb_workers = int(nb_workers)
+        self.f_decl = int(f_decl)
+        self.evict_threshold = float(evict_threshold)
+        self.patience = int(patience)
+        self.collusion_evict = float(collusion_evict)
+        self.max_evictions = (self.f_decl if max_evictions is None
+                              else int(max_evictions))
+        self.reinstate = bool(reinstate)
+        n = self.nb_workers
+        self.evicted = np.zeros(n, dtype=bool)
+        self.evicted_at = {}          # worker -> first eviction step
+        self.evictions_total = 0
+        self._streak = np.zeros(n, dtype=np.int64)
+        self._calm = np.zeros(n, dtype=np.int64)
+        self._by_collusion = np.zeros(n, dtype=bool)
+
+    # -------------------------------------------------------------- #
+
+    def mask(self):
+        """The active mask for the NEXT step's masked aggregation."""
+        return ~self.evicted
+
+    def f_reclaimed(self):
+        """Quorum credit for the masked kernels (`faults/quorum.py::
+        masked_aggregate` `f_evicted`): evictions backed by COLLUSION
+        evidence — a deduplicated copy of a kept row adds no adversarial
+        dimension to the remaining stack, so the declared tolerance can
+        shrink with it without under-provisioning. Statistical-channel
+        evictions never reclaim (a framed honest victim's eviction must
+        not lower the tolerance below the real attacker count)."""
+        return int(np.sum(self.evicted & self._by_collusion))
+
+    def update(self, step, selection, distances=None, active=None,
+               dist_matrix=None):
+        """Fold one step's diagnostics (the `quarantine_defense_kernel`
+        outputs) and return the updated active mask.
+
+        `active` is the step's POST-sanitize effective mask (evictions
+        already excluded, NaN rows quarantined) — it feeds the tracker's
+        quarantine-history channel.
+        """
+        susp = self.tracker.update(step, selection, distances=distances,
+                                   active=active, dist_matrix=dist_matrix)
+        if self.tracker.steps < self.tracker.min_steps:
+            return self.mask()
+
+        # Statistical channel: sustained blended suspicion
+        hot = (susp >= self.evict_threshold) & ~self.evicted
+        self._streak = np.where(hot, self._streak + 1, 0)
+        candidates = [(float(susp[w]), int(w), "suspicion")
+                      for w in np.nonzero(
+                          (self._streak >= self.patience)
+                          & ~self.evicted)[0]]
+
+        # Collusion channel: dedup each saturated near-duplicate cluster,
+        # keeping its lowest-collusion member (ties -> lowest index)
+        coll = self.tracker.collusion
+        saturated = (coll >= self.collusion_evict) & ~self.evicted
+        for cluster in self._clusters(saturated):
+            keep = min(cluster, key=lambda w: (coll[w], w))
+            candidates.extend(
+                (float(coll[w]), int(w), "collusion")
+                for w in cluster if w != keep)
+
+        # Strongest evidence first, within the global budget
+        for score, worker, channel in sorted(candidates, reverse=True):
+            if self.evicted[worker]:
+                continue  # a worker can surface on both channels
+            if int(self.evicted.sum()) >= self.max_evictions:
+                break
+            self.evicted[worker] = True
+            # Collusion-backed evictions (the dedup channel, or a blended
+            # eviction whose worker spent the majority of its recent
+            # history in a near-duplicate cluster) reclaim quorum
+            self._by_collusion[worker] = (channel == "collusion"
+                                          or coll[worker] >= 0.5)
+            self.evicted_at.setdefault(worker, int(step))
+            self.evictions_total += 1
+            self._streak[worker] = 0
+            recorder.emit("quarantine_evict", worker=worker, step=int(step),
+                          channel=channel, score=round(score, 4),
+                          active=int((~self.evicted).sum()))
+
+        if self.reinstate:
+            calm = susp <= self.tracker.clear
+            self._calm = np.where(calm, self._calm + 1, 0)
+            back = (self.evicted & ~self._by_collusion
+                    & (self._calm >= self.patience))
+            for worker in np.nonzero(back)[0]:
+                self.evicted[worker] = False
+                self._calm[worker] = 0
+                recorder.emit("quarantine_reinstate", worker=int(worker),
+                              step=int(step),
+                              suspicion=round(float(susp[worker]), 4))
+        return self.mask()
+
+    def _clusters(self, members):
+        """Connected components of the tracker's last near-duplicate
+        adjacency, restricted to `members`; singletons dropped (a lone
+        saturated row with no current partner is stale evidence)."""
+        partners = self.tracker.partners
+        seen = np.zeros(self.nb_workers, dtype=bool)
+        for start in np.nonzero(members)[0]:
+            if seen[start]:
+                continue
+            stack, component = [int(start)], []
+            seen[start] = True
+            while stack:
+                w = stack.pop()
+                component.append(w)
+                for nxt in np.nonzero(partners[w] & members & ~seen)[0]:
+                    seen[nxt] = True
+                    stack.append(int(nxt))
+            if len(component) > 1:
+                yield sorted(component)
+
+    # -------------------------------------------------------------- #
+
+    def summary(self):
+        """JSON-safe snapshot (tournament scoreboard / report rows)."""
+        return {
+            "evicted": [int(w) for w in np.nonzero(self.evicted)[0]],
+            "evictions_total": int(self.evictions_total),
+            "evicted_at": {str(w): s for w, s in
+                           sorted(self.evicted_at.items())},
+            "budget": self.max_evictions,
+            "f_reclaimed": self.f_reclaimed(),
+            "tracker": self.tracker.summary(),
+        }
+
+
+def quarantine_defense_kernel(gar, *, f, kwargs=None, dynamic=True):
+    """The closed loop's per-step defense program AT THE QUARANTINE CALL
+    SITE — the traceable program the `<gar>/quarantine` lattice cells
+    fingerprint
+    (`analysis/lattice.py`): NaN-sanitize composed over the policy mask,
+    the masked-quorum aggregate with dynamic `f_eff`
+    (`faults/quorum.py::masked_aggregate`), and the rule-agnostic serve
+    aux (`ops/diag.py::masked_generic_aux`) whose selection /
+    worker-distance / distance-matrix outputs are exactly what
+    `QuarantinePolicy.update` consumes.
+
+    `(G: f32[n, d], active: bool[n], f_evicted: i32[]) -> dict` —
+    `active` and `f_evicted` are RUNTIME operands: mask updates (and the
+    quorum credit for confirmed-duplicate evictions, `masked_aggregate`'s
+    `f_evicted`) re-use this one compiled program between steps — the
+    zero-recompile contract the tournament smoke asserts.
+    """
+    from byzantinemomentum_tpu.faults import quorum, sanitize
+    from byzantinemomentum_tpu.ops import diag
+
+    kwargs = {} if kwargs is None else kwargs
+
+    def program(G, active, f_evicted):
+        active_eff, _ = sanitize.quarantine(G, active)
+        aggregate, f_eff = quorum.masked_aggregate(
+            gar, G, active_eff, f_decl=f, dynamic=dynamic,
+            f_evicted=f_evicted, **kwargs)
+        aux = diag.masked_generic_aux(G, aggregate, active_eff, f_eff)
+        return {"aggregate": aggregate, "f_eff": f_eff,
+                "active": active_eff, "selection": aux["selection"],
+                "worker_dist": aux["worker_dist"], "dist": aux["dist"]}
+
+    return program

@@ -1,0 +1,116 @@
+"""Sybil serve attack — one perturbation split across many client ids.
+
+The per-client suspicion store (`obs/forensics.py`) is exactly as strong
+as its keying assumption: one client, one history. A Sybil adversary
+buys `k` fresh client ids and splits one attack vector across them —
+each row carries only `scale / k` of the perturbation, so every
+per-client statistic (selection rate, distance z) stays deep inside the
+honest envelope while the COALITION carries the full `scale` and, being
+`k > f` rows, sits outside the GAR's per-request tolerance contract.
+
+The defense that catches it is cohort-level, not client-level: the split
+shards are mutually near-identical (they all encode the same direction),
+which the store's collusion channel reads straight off the serve aux's
+pairwise-distance matrix — distinct client ids closer to each other than
+`COLLUSION_FRAC` of the cohort's median distance. With admission control
+on (`serve/admission.py`), those ids' rows are masked out of the
+aggregate once their collusion EWMA crosses the threshold; with it off,
+the verdicts ride the responses and nothing stops the shift — the
+regression pair `tests/test_serve.py` pins.
+
+`run_sybil_cell` is the tournament's serve-mode cell runner: it replays
+the same request stream against admission {on, off} and reports the
+aggregate shift each sustains plus the detection bookkeeping.
+"""
+
+import numpy as np
+
+__all__ = ["sybil_cohort", "run_sybil_cell"]
+
+
+def sybil_cohort(rng, *, n_honest, k, d, direction, scale, sigma=1.0,
+                 shard_jitter=0.02):
+    """One request's `(matrix, client_ids)`: `n_honest` honest rows of
+    `N(0, sigma^2)` under stable ids `h<i>`, plus `k` Sybil rows under
+    ids `s<j>` — each the honest-looking base point `scale/k` along
+    `direction`, with `shard_jitter * sigma` of per-shard noise (the
+    knob the adversary turns against duplicate detection)."""
+    honest = sigma * rng.standard_normal((n_honest, d)).astype(np.float32)
+    base = sigma * 0.1 * rng.standard_normal(d).astype(np.float32)
+    shard = base[None, :] + (scale / k) * direction[None, :]
+    sybil = (shard
+             + shard_jitter * sigma
+             * rng.standard_normal((k, d)).astype(np.float32))
+    matrix = np.concatenate([honest, sybil.astype(np.float32)])
+    ids = tuple(f"h{i}" for i in range(n_honest)) + tuple(
+        f"s{j}" for j in range(k))
+    return matrix, ids
+
+
+def run_sybil_cell(*, gar="krum", admission=True, requests=30, n_honest=8,
+                   k=6, d=32, f=2, scale=6.0, sigma=1.0, shard_jitter=0.02,
+                   seed=0, service_kwargs=None):
+    """One serve-mode tournament cell: the Sybil stream against a live
+    `AggregationService` with admission control on or off.
+
+    Returns the scoreboard row: mean aggregate shift relative to the
+    same stream's honest-rows-only aggregate (the quantity the coalition
+    is trying to move), the Sybil detection rate (flagged `s*` ids /
+    k at the end), honest ids caught in the blast radius (must be 0),
+    and the admission counters.
+    """
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    kwargs = dict(service_kwargs or {})
+    kwargs.setdefault("max_batch", 4)
+    kwargs.setdefault("max_delay_ms", 1.0)
+    if admission:
+        kwargs.setdefault("admission", {"mode": "mask"})
+    else:
+        # The collusion channel still OBSERVES (verdicts ride responses)
+        # so the off-cell measures pure detection without enforcement
+        from byzantinemomentum_tpu.serve.admission import ADMISSION_WEIGHTS
+        kwargs.setdefault("suspicion", {"weights": ADMISSION_WEIGHTS})
+
+    rng = np.random.default_rng(seed)
+    direction = np.zeros(d, np.float32)
+    direction[0] = 1.0
+    shifts, honest_baseline_shifts = [], []
+    with AggregationService(**kwargs) as svc:
+        last = None
+        for _ in range(requests):
+            matrix, ids = sybil_cohort(
+                rng, n_honest=n_honest, k=k, d=d, direction=direction,
+                scale=scale, sigma=sigma, shard_jitter=shard_jitter)
+            last = svc.aggregate(matrix, gar=gar, f=f, client_ids=ids,
+                                 diagnostics=True, timeout=30.0)
+            honest_only = svc.aggregate(matrix[:n_honest], gar=gar, f=f,
+                                        timeout=30.0)
+            shift = np.linalg.norm(np.asarray(last.aggregate)
+                                   - np.asarray(honest_only.aggregate))
+            shifts.append(float(shift))
+            honest_baseline_shifts.append(
+                float(np.linalg.norm(np.asarray(honest_only.aggregate))))
+        stats = svc.stats()
+        verdicts = last.verdicts or {}
+        flagged = {c for c, v in verdicts.items()
+                   if v["suspect"] or v.get("collusion", 0.0) >= 0.5}
+        admission_info = last.admission or {}
+    sybil_ids = {f"s{j}" for j in range(k)}
+    honest_ids = {f"h{i}" for i in range(n_honest)}
+    masked_final = {c for c, a in admission_info.items()
+                    if a["action"] == "mask"}
+    # Steady state: the last third of the stream, after the store warmed
+    tail = max(len(shifts) // 3, 1)
+    return {
+        "mode": "serve-sybil", "gar": gar, "admission": bool(admission),
+        "requests": requests, "k_sybil": k, "n_honest": n_honest,
+        "scale": scale, "shard_jitter": shard_jitter,
+        "agg_shift_mean": round(float(np.mean(shifts)), 6),
+        "agg_shift_tail": round(float(np.mean(shifts[-tail:])), 6),
+        "detection_rate": round(len(flagged & sybil_ids) / k, 4),
+        "honest_flagged": len(flagged & honest_ids),
+        "honest_masked": len(masked_final & honest_ids),
+        "sybil_masked_final": len(masked_final & sybil_ids),
+        "masked_rows_total": stats["admission"]["masked_rows"],
+    }

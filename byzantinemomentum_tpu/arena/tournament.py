@@ -1,0 +1,174 @@
+"""The attack-vs-defense tournament: every attack x every GAR x
+quarantine {on, off}, train and serve.
+
+This is the repo's first result surface BEYOND the paper's own grid
+(ROADMAP "close the defense loop" flagship): the paper fixes the attack
+set and sweeps GARs/momentum; here the adversary adapts (ALIE z-margins,
+EWMA-warm-up timing, framing, Sybil id-splitting, non-IID shards) and
+the defense acts (quarantine, admission control), so each scoreboard
+cell is one round of the actual game.
+
+Scoreboard schema (`TOURNAMENT_r*.json`, rendered across rounds by
+`scripts/bench_history.py`):
+
+  train_cells   one row per (attack, gar, quarantine) from
+                `ArenaCell.run`: final accuracy proxy (`final_err` —
+                distance to the probe optimum), mean/steady-state
+                aggregate error vs the uncorrupted honest mean,
+                evicted honest/Byzantine counts, time-to-quarantine,
+                reclaimed quorum.
+  serve_cells   the Sybil cells from `arena/sybil.py::run_sybil_cell`:
+                aggregate shift sustained with admission {on, off},
+                detection rate, honest blast radius.
+  summary       the acceptance digests: which selection GARs quarantine
+                -on strictly dominates on steady-state aggregate error
+                against EVERY adaptive attack, total honest evictions
+                (framing rows must show zero), Sybil detection.
+
+The grid runs on the CPU-cheap probe engine (`arena/loop.py`) — one
+compiled step per (attack, gar) cell, shared verbatim by the on/off
+runs and every mask update (the zero-recompile contract
+`run(warm_recompile_check=True)` asserts through
+`analysis/contracts.py`).
+"""
+
+from byzantinemomentum_tpu.arena.loop import ArenaCell
+from byzantinemomentum_tpu.arena.sybil import run_sybil_cell
+from byzantinemomentum_tpu.attacks import attacks as attack_registry
+
+__all__ = ["ADAPTIVE_ATTACKS", "SELECTION_GARS", "train_roster",
+           "run_tournament"]
+
+# The adaptive half of the red team — the attacks that read the defense
+# (the acceptance's dominance digest quantifies quarantine against
+# these; the label below must match the roster's cell labels).
+ADAPTIVE_ATTACKS = ("alie", "alie-warmup", "framing", "alie+noniid")
+
+# Selection-family GARs (the rules whose per-row choices the suspicion
+# machinery can observe sharpest — the dominance claim targets these).
+SELECTION_GARS = ("krum", "bulyan", "brute", "aksel", "cge")
+
+# Label-skew level of the non-IID roster entry: worker optima fan out
+# 1.5 honest-sigma from the population optimum, violating the i.i.d.
+# variance assumption every GAR bound is stated under.
+NONIID_SKEW = 1.5
+
+
+def train_roster():
+    """[(label, attack, attack_args, skew)] — every runnable registered
+    attack (the template registration deliberately declines its own
+    check) plus the non-IID honest-data mode riding the in-envelope
+    attacker."""
+    roster = [(name, name, {}, 0.0)
+              for name in sorted(attack_registry) if name != "template"]
+    roster.append(("alie+noniid", "alie", {}, NONIID_SKEW))
+    return roster
+
+
+def run_tournament(*, gars=None, roster=None, steps=80, seed=0, n=11,
+                   f_decl=3, f_real=3, d=32, serve_requests=30,
+                   serve_gar="krum", recompile_check=False, log=None):
+    """Run the grid; returns the scoreboard dict (see module docstring).
+
+    `recompile_check` asserts the zero-recompile contract on the first
+    train cell (the tournament smoke's acceptance hook); `log` is an
+    optional `print`-like progress callback.
+    """
+    import jax
+
+    if gars is None:
+        from byzantinemomentum_tpu.analysis.lattice import CELL_GARS
+        gars = CELL_GARS
+    roster = train_roster() if roster is None else roster
+    say = log if log is not None else (lambda *_: None)
+
+    train_cells = []
+    checked = False
+    for gar in gars:
+        for label, attack, attack_args, skew in roster:
+            cell = ArenaCell(gar, attack, n=n, f_decl=f_decl,
+                             f_real=f_real, d=d, attack_args=attack_args)
+            rows = []
+            for quarantine in (True, False):
+                row = cell.run(
+                    quarantine=quarantine, steps=steps, seed=seed,
+                    skew=skew,
+                    warm_recompile_check=recompile_check and not checked)
+                checked = True
+                row["attack"] = label
+                row["skew"] = skew
+                rows.append(row)
+                train_cells.append(row)
+            say(f"  {gar:>8} x {label:<14} on/off agg_err_last10 = "
+                f"{rows[0]['agg_err_last10']:.3f}/"
+                f"{rows[1]['agg_err_last10']:.3f}  "
+                f"evicted h/b = {rows[0]['evicted_honest']}/"
+                f"{rows[0]['evicted_byz']}")
+
+    serve_cells = []
+    for admission in (True, False):
+        row = run_sybil_cell(gar=serve_gar, admission=admission,
+                             requests=serve_requests, f=2, seed=seed)
+        serve_cells.append(row)
+        say(f"  serve sybil admission={admission}: "
+            f"tail shift {row['agg_shift_tail']:.3f}, "
+            f"detection {row['detection_rate']:.2f}")
+
+    scoreboard = {
+        "kind": "tournament",
+        "backend": jax.default_backend(),
+        "config": {"n": n, "f_decl": f_decl, "f_real": f_real, "d": d,
+                   "steps": steps, "seed": seed,
+                   "noniid_skew": NONIID_SKEW,
+                   "gars": list(gars),
+                   "attacks": [label for label, *_ in roster]},
+        "train_cells": train_cells,
+        "serve_cells": serve_cells,
+        "summary": _summarize(train_cells, serve_cells),
+    }
+    return scoreboard
+
+
+def _summarize(train_cells, serve_cells):
+    """The acceptance digests over the raw cells."""
+    by_key = {(c["gar"], c["attack"], c["quarantine"]): c
+              for c in train_cells}
+    gars = sorted({c["gar"] for c in train_cells})
+    adaptive = [a for a in ADAPTIVE_ATTACKS
+                if any(c["attack"] == a for c in train_cells)]
+
+    dominated = []
+    for gar in gars:
+        wins = []
+        for attack in adaptive:
+            on = by_key.get((gar, attack, True))
+            off = by_key.get((gar, attack, False))
+            if on is None or off is None:
+                break
+            wins.append(on["agg_err_last10"] < off["agg_err_last10"])
+        if wins and all(wins):
+            dominated.append(gar)
+
+    framing_honest = sum(c["evicted_honest"] for c in train_cells
+                         if c["attack"] == "framing" and c["quarantine"])
+    sybil = {}
+    for row in serve_cells:
+        key = "on" if row["admission"] else "off"
+        sybil[f"shift_tail_{key}"] = row["agg_shift_tail"]
+        if row["admission"]:
+            sybil["detection_rate"] = row["detection_rate"]
+            sybil["honest_masked"] = row["honest_masked"]
+
+    return {
+        "dominance_metric": "agg_err_last10",
+        "adaptive_attacks": adaptive,
+        "selection_gars_dominated": [g for g in dominated
+                                     if g in SELECTION_GARS],
+        "gars_dominated": dominated,
+        "framing_honest_evictions": framing_honest,
+        "honest_evictions_total": sum(c["evicted_honest"]
+                                      for c in train_cells),
+        "byz_evictions_total": sum(c["evicted_byz"]
+                                   for c in train_cells),
+        "sybil": sybil,
+    }

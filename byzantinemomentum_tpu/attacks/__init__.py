@@ -14,6 +14,18 @@ semantics once concatenated with the honest rows.
 
 Registry parity: `attacks: name -> Attack`, each with `.checked` /
 `.unchecked` / `.check` members (reference `attacks/__init__.py:46-87`).
+
+Stateful (adaptive) attacks: an attack registered with a `state_init`
+hook threads history across steps — `state_init(f_real, d) -> pytree`
+builds the initial state, the attack function receives a `state=` kwarg
+and returns `(f32[f_real, d], new_state)` instead of the bare matrix.
+The engine carries the pytree in `TrainState.attack_state` (donated,
+checkpointed, sharding-replicated like every scalar counter), so a
+time-coupled attack — e.g. one exploiting a defense's EWMA warm-up
+window (`attacks/warmup.py`) — composes with the fused step, the arena
+closed loop and resume. Static attacks are untouched: no `state_init`
+means no `state` kwarg, a bare matrix return, and an empty `()` state
+leaf in `TrainState`.
 """
 
 import pathlib
@@ -32,24 +44,41 @@ attacks = {}
 class Attack:
     """A registered attack; calling it runs the checked path."""
 
-    def __init__(self, name, unchecked, check):
+    def __init__(self, name, unchecked, check, state_init=None):
         self.name = name
         self.unchecked = unchecked
         self.check = check
+        self.state_init = state_init
 
-    def checked(self, grad_honests, f_decl, f_real, defense=None, **kwargs):
+    @property
+    def stateful(self):
+        """Whether the attack threads history (see the module docstring):
+        it takes `state=` and returns `(matrix, new_state)`."""
+        return self.state_init is not None
+
+    def checked(self, grad_honests, f_decl, f_real, defense=None, state=None,
+                **kwargs):
         grad_honests = as_matrix(grad_honests)
         message = self.check(
             grad_honests=grad_honests, f_decl=f_decl, f_real=f_real, defense=defense, **kwargs)
         if message is not None:
             raise utils.UserException(f"Attack {self.name!r} cannot be used: {message}")
-        result = self.unchecked(
-            grad_honests, f_decl=f_decl, f_real=f_real, defense=defense, **kwargs)
+        if self.stateful:
+            if state is None:
+                state = self.state_init(f_real=f_real,
+                                        d=grad_honests.shape[1])
+            result, state = self.unchecked(
+                grad_honests, f_decl=f_decl, f_real=f_real, defense=defense,
+                state=state, **kwargs)
+        else:
+            result = self.unchecked(
+                grad_honests, f_decl=f_decl, f_real=f_real, defense=defense,
+                **kwargs)
         expected = (f_real, grad_honests.shape[1])
         if result.shape != expected:
             raise utils.UserException(
                 f"Attack {self.name!r} returned shape {result.shape}, expected {expected}")
-        return result
+        return (result, state) if self.stateful else result
 
     def __call__(self, grad_honests, f_decl, f_real, defense=None, **kwargs):
         return self.checked(grad_honests, f_decl, f_real, defense=defense, **kwargs)
@@ -58,11 +87,15 @@ class Attack:
         return f"Attack({self.name!r})"
 
 
-def register(name, unchecked, check):
-    """Register an attack under `name` (reference `attacks/__init__.py:46-77`)."""
+def register(name, unchecked, check, state_init=None):
+    """Register an attack under `name` (reference `attacks/__init__.py:46-77`).
+
+    `state_init(f_real, d) -> pytree` marks the attack STATEFUL: its
+    `unchecked` must accept `state=` and return `(matrix, new_state)` —
+    see the module docstring."""
     if name in attacks:
         utils.warning(f"Attack {name!r} registered twice; keeping the last")
-    atk = Attack(name, unchecked, check)
+    atk = Attack(name, unchecked, check, state_init=state_init)
     attacks[name] = atk
     return atk
 

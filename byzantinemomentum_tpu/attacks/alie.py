@@ -1,0 +1,94 @@
+"""ALIE — "A Little Is Enough" with an explicit z-margin (Baruch,
+Baruch, Goldberg, NeurIPS 2019; PAPERS.md).
+
+The Byzantine rows sit at `mean + z * std` of the honest submissions,
+coordinate-wise: INSIDE the honest variance envelope, where distance- and
+score-based GARs cannot distinguish them from legitimate noise. The
+existing `little` attack (`attacks/identical.py`) line-searches its
+factor against the live defense; this registration implements the
+paper's CLOSED-FORM margin instead — the largest z such that enough
+honest workers are expected farther from the mean than the attackers:
+
+    s = floor(n/2) + 1 - f        (honest supporters the attack needs)
+    z_max = Phi^-1((n - f - s) / (n - f))
+
+with `n` the total worker count and `f` the declared tolerance — so the
+attack needs NO defense evaluations at all (it reads only the paper's
+published diagnostics assumption: honest gradients are i.i.d. roughly
+normal per coordinate). The `z` kwarg overrides the margin — the arena's
+tournament sweeps it to trace the stealth/damage frontier — and `jitter`
+adds deterministic per-row noise (a fraction of the honest std) so the
+f_real rows are not byte-identical, the knob an adaptive adversary turns
+to dodge collusion/duplicate detection (`obs/forensics.py`).
+"""
+
+import statistics
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+
+__all__ = ["attack", "zmax"]
+
+
+def zmax(n, f):
+    """The paper's closed-form margin for `n` total workers, `f` of them
+    Byzantine (clamped non-negative: a grid where the attackers are the
+    majority has no hiding margin and degenerates to the mean). Pure
+    host-side math on the STATIC (n, f) — the margin must stay a trace
+    -time constant inside the jitted step."""
+    s = n // 2 + 1 - f
+    if s <= 0:
+        return 0.0  # attacker majority: no supporters needed, no margin
+    denom = max(n - f, 1)
+    q = min(max((n - f - s) / denom, 0.5), 1.0 - 1e-6)
+    return statistics.NormalDist().inv_cdf(q)
+
+
+def _row_key(grad_honests):
+    """Deterministic PRNG key from the operand content (attacks are pure
+    functions of their inputs — no ambient RNG), the same content-hash
+    trick as the engine's per-call mixture draw (`engine/step.py`)."""
+    bits = lax.bitcast_convert_type(
+        grad_honests.astype(jnp.float32), jnp.uint32)
+    mult = (jnp.arange(bits.size, dtype=jnp.uint32).reshape(bits.shape)
+            * jnp.uint32(2654435761) | jnp.uint32(1))
+    return jax.random.fold_in(jax.random.PRNGKey(0xA11E),
+                              jnp.sum(bits * mult, dtype=jnp.uint32))
+
+
+def attack(grad_honests, f_decl, f_real, defense, z=None, jitter=0.0,
+           **kwargs):
+    """Generate the f_real Byzantine rows at `mean + z * std` (sample
+    std, ddof=1 — torch parity with `attacks/identical.py`)."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests)
+    h = grad_honests.shape[0]
+    mu = jnp.mean(grad_honests, axis=0)
+    sigma = jnp.sqrt(jnp.var(grad_honests, axis=0, ddof=1)) if h > 1 else (
+        jnp.zeros_like(mu))
+    z_eff = zmax(h + f_real, f_decl) if z is None else float(z)
+    byz = mu + z_eff * sigma
+    rows = jnp.tile(byz[None, :], (f_real, 1))
+    if jitter:
+        noise = jax.random.normal(_row_key(grad_honests), rows.shape,
+                                  dtype=rows.dtype)
+        rows = rows + float(jitter) * sigma[None, :] * noise
+    return rows
+
+
+def check(grad_honests, f_real, defense, z=None, jitter=0.0, **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return (f"Expected a non-negative number of Byzantine gradients to "
+                f"generate, got {f_real!r}")
+    if z is not None and not isinstance(z, (int, float)):
+        return f"Expected a number for the z-margin, got {z!r}"
+    if not isinstance(jitter, (int, float)) or jitter < 0:
+        return f"Expected a non-negative jitter fraction, got {jitter!r}"
+
+
+register("alie", attack, check)

@@ -1,0 +1,71 @@
+"""Framing attack — the adversary attacks the DEFENSE's trust model, not
+the aggregate: it tries to get an honest worker auto-quarantined.
+
+A suspicion-driven quarantine loop (`arena/quarantine.py`) turns
+statistical evidence — selection deficit, distance z-scores — into
+evictions. That creates a new attack surface: instead of biasing the
+aggregate, the Byzantine rows can spend their mass making a chosen
+honest `victim` look like the outlier. The rows here sit in a tight
+cluster at the mean of the honests EXCLUDING the victim, pushed `push`
+further away from the victim's row: the cluster (a) dominates the
+selection of score-based GARs (its members certify each other, the
+Krum/Bulyan colluder pattern), starving the victim's selection rate, and
+(b) shifts the submission cloud so the victim's relative distance
+z-score rises.
+
+The quarantine policy's answer — eviction hysteresis, a max-evictions
+budget, and the statistical channels capped below the eviction threshold
+when unconfirmed by hard (collusion) evidence — is exactly what the
+tournament's "zero honest evictions under framing" acceptance row
+proves. The attackers themselves ARE mutually identical (a collusion
+cluster), so the dedup channel evicts the cluster instead; `jitter`
+(fraction of the honest std) is the knob to blur the cluster and trade
+framing pressure against self-exposure.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+
+__all__ = ["attack"]
+
+
+def attack(grad_honests, f_decl, f_real, defense, victim=0, push=1.0,
+           jitter=0.0, **kwargs):
+    """f_real rows clustered at mean(honests \\ victim) + push * (that
+    mean - victim's row)."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests)
+    h = grad_honests.shape[0]
+    g_victim = grad_honests[victim]
+    others = (jnp.sum(grad_honests, axis=0) - g_victim) / max(h - 1, 1)
+    byz = others + float(push) * (others - g_victim)
+    rows = jnp.tile(byz[None, :], (f_real, 1))
+    if jitter:
+        from byzantinemomentum_tpu.attacks import alie as alie_mod
+
+        sigma = jnp.sqrt(jnp.var(grad_honests, axis=0, ddof=1)) if h > 1 \
+            else jnp.zeros_like(byz)
+        noise = jax.random.normal(alie_mod._row_key(grad_honests),
+                                  rows.shape, dtype=rows.dtype)
+        rows = rows + float(jitter) * sigma[None, :] * noise
+    return rows
+
+
+def check(grad_honests, f_real, defense, victim=0, push=1.0, jitter=0.0,
+          **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return (f"Expected a non-negative number of Byzantine gradients to "
+                f"generate, got {f_real!r}")
+    if not isinstance(victim, int) or not (
+            0 <= victim < grad_honests.shape[0]):
+        return (f"Expected a victim index within the {grad_honests.shape[0]} "
+                f"honest rows, got {victim!r}")
+    if not isinstance(push, (int, float)) or push < 0:
+        return f"Expected a non-negative push factor, got {push!r}"
+
+
+register("framing", attack, check)

@@ -25,6 +25,16 @@ def attack(grad_honests, f_decl, f_real, defense, **kwargs):
       **kwargs: attack-specific arguments from `--attack-args` (auto-typed).
     Returns:
       f32[f_real, d] Byzantine gradient matrix.
+
+    Stateful variant (ADAPTIVE attacks threading history across steps):
+    register with `register(name, attack, check, state_init=fn)` where
+    `state_init(f_real, d) -> pytree` builds the initial state; the
+    attack then additionally receives `state=<pytree>` and returns
+    `(f32[f_real, d], new_state)`. The engine threads the pytree through
+    `TrainState.attack_state` inside the jitted step (so the state is
+    donated, checkpointed and resume-safe); static attacks like this
+    template never see a `state` kwarg. Example: `attacks/warmup.py`
+    (a step counter driving a time-coupled perturbation).
     """
     raise NotImplementedError(
         "I am template code, please replace me with useful stuff")

@@ -1,0 +1,64 @@
+"""Time-coupled attack exploiting the defense's EWMA warm-up window —
+the first STATEFUL attack (the `attacks/__init__.py` state hook).
+
+The host-side suspicion machinery (`obs/forensics.py`) gates its
+verdicts behind a warm-up of `min_steps` observations and smooths every
+signal with an EWMA, so evidence accumulated during the first steps is
+both un-actionable (no events fire) and discounted later (the EWMA
+forgets geometrically). This attack reads that published behavior: for
+the first `window` steps it bursts at full amplitude (`-burst * mean`,
+the Fall-of-Empires direction, wrecking the cold momentum trajectory),
+then drops INSIDE the honest variance envelope (ALIE rows at a small
+`z`) for the rest of the run — by the time the tracker can act, the
+burst is history it never got to punish.
+
+State: one i32 step counter, threaded through `TrainState.attack_state`
+by the engine (or by the arena loop's carry), so the schedule survives
+checkpoints/resume and stays inside the jitted step.
+"""
+
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+
+__all__ = ["attack", "state_init"]
+
+
+def state_init(f_real, d):
+    """i32 step counter (the only history the schedule needs)."""
+    return jnp.int32(0)
+
+
+def attack(grad_honests, f_decl, f_real, defense, state=None, window=12,
+           burst=20.0, z=0.3, jitter=0.0, **kwargs):
+    """Burst for `window` steps, then hide at `mean + z * std`."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests), state
+    from byzantinemomentum_tpu.attacks import alie as alie_mod
+
+    step = jnp.int32(0) if state is None else state
+    mu = jnp.mean(grad_honests, axis=0)
+    hot = -float(burst) * mu
+    hidden = alie_mod.attack(grad_honests, f_decl, f_real, defense,
+                             z=float(z), jitter=jitter)
+    rows = jnp.where(step < window,
+                     jnp.tile(hot[None, :], (f_real, 1)), hidden)
+    return rows.astype(grad_honests.dtype), step + 1
+
+
+def check(grad_honests, f_real, defense, window=12, burst=20.0, z=0.3,
+          jitter=0.0, **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return (f"Expected a non-negative number of Byzantine gradients to "
+                f"generate, got {f_real!r}")
+    if not isinstance(window, int) or window < 0:
+        return f"Expected a non-negative warm-up window, got {window!r}"
+    if not isinstance(burst, (int, float)):
+        return f"Expected a number for the burst amplitude, got {burst!r}"
+    if not isinstance(z, (int, float)):
+        return f"Expected a number for the hidden z-margin, got {z!r}"
+
+
+register("alie-warmup", attack, check, state_init=state_init)

@@ -186,10 +186,16 @@ def load(path, template, *, return_data=False):
                 # buffer at the template's zeros — the documented cold-start
                 out[name] = jnp.asarray(ref)
                 continue
+            if name == "attack_state":
+                # Pre-adaptive-attack checkpoints lack the attack history;
+                # resuming them under a stateful attack restarts it at the
+                # template's `state_init` value — the documented cold-start
+                out[name] = ref
+                continue
             raise utils.UserException(
                 f"Unable to load checkpoint {str(path)!r}: missing field {name!r}")
         value = stored[name]
-        if name in ("net_state", "opt_state"):
+        if name in ("net_state", "opt_state", "attack_state"):
             value = serialization.from_state_dict(ref, value)
         else:
             value = jnp.asarray(value)

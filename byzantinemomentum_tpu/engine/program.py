@@ -149,12 +149,13 @@ def device_gar_step(engine, gar_device):
     post = jax.jit(engine._phase_update, static_argnums=(11,),
                    donate_argnums=(0,))
 
-    def mid_traced(G_honest, mix_key, fault):
+    def mid_traced(G_honest, mix_key, fault, attack_state):
         if dev.platform != "tpu":
             # The GAR device cannot run Mosaic kernels
             with pallas_sort.disabled():
-                return engine._phase_defense(G_honest, mix_key, fault)
-        return engine._phase_defense(G_honest, mix_key, fault)
+                return engine._phase_defense(G_honest, mix_key, fault,
+                                             attack_state)
+        return engine._phase_defense(G_honest, mix_key, fault, attack_state)
 
     mid = jax.jit(mid_traced)
 
@@ -163,16 +164,18 @@ def device_gar_step(engine, gar_device):
          G_honest, fault, new_fb) = pre(state, xs, ys, lr)
         main_dev = list(G_honest.devices())[0]
         # --- the hop (reference `attack.py:811-815`; the tiny fault
-        # context — active mask + counter — hops along with the rows) --- #
+        # context — active mask + counter — and the adaptive attack's
+        # history pytree hop along with the rows) --- #
         out = mid(jax.device_put(G_honest, dev),
                   jax.device_put(mix_key, dev),
-                  None if fault is None else jax.device_put(fault, dev))
-        (G_attack, grad_defense, accept_ratio, fault_metrics,
-         diag_metrics) = jax.device_put(out, main_dev)
+                  None if fault is None else jax.device_put(fault, dev),
+                  jax.device_put(state.attack_state, dev))
+        (G_attack, grad_defense, accept_ratio, fault_metrics, diag_metrics,
+         attack_state) = jax.device_put(out, main_dev)
         batch = engine._batch_of(xs)
         return post(state, rng, G_sampled, loss_avg, net_state, new_mw,
                     G_honest, G_attack, grad_defense, accept_ratio, lr,
-                    batch, fault_metrics, new_fb, diag_metrics)
+                    batch, fault_metrics, new_fb, diag_metrics, attack_state)
 
     return step
 

@@ -43,16 +43,23 @@ class TrainState(typing.NamedTuple):
     #                              (shape (0, d) unless the engine carries
     #                              a fault schedule with stragglers —
     #                              `faults/inject.py`)
+    attack_state: typing.Any = ()  # adaptive-attack history pytree
+    #                              (`attacks/__init__.py` state hook);
+    #                              empty for static attacks — zero leaves,
+    #                              zero cost
 
 
 def init_state(cfg, theta, net_state, rng, *, study, opt_state=(),
-               fault_buffer_rows=0):
+               fault_buffer_rows=0, attack_state=()):
     """Fresh-run initialization (reference `attack.py:668-681`).
 
     `fault_buffer_rows`: honest-worker count when the engine's fault
     schedule contains stragglers (the stale-submission buffer), else 0 —
     the buffer starts at zeros, so a straggler window opening at step 0
     replays a no-progress submission.
+
+    `attack_state`: the adaptive attack's initial history pytree
+    (`Attack.state_init`); `()` for static attacks.
     """
     d = theta.shape[0]
     h = cfg.nb_honests
@@ -74,4 +81,5 @@ def init_state(cfg, theta, net_state, rng, *, study, opt_state=(),
         datapoints=jnp.int32(0),
         rng=rng,
         fault_buffer=jnp.zeros((fault_buffer_rows, d), theta.dtype),
+        attack_state=attack_state,
     )

@@ -322,10 +322,15 @@ class Engine:
         buffer_rows = (self.cfg.nb_honests
                        if self.faults is not None and self.faults.has_stale
                        else 0)
+        attack_state = ()
+        if self.attack is not None and self.attack.stateful:
+            attack_state = self.attack.state_init(
+                f_real=self.cfg.nb_real_byz, d=self.d)
         return init_state(self.cfg, theta, net_state,
                           jax.random.fold_in(key, 1), study=study,
                           opt_state=self.optimizer.init(theta),
-                          fault_buffer_rows=buffer_rows)
+                          fault_buffer_rows=buffer_rows,
+                          attack_state=attack_state)
 
     # ----------------------------------------------------------------- #
     # Per-worker gradient
@@ -669,20 +674,23 @@ class Engine:
         return (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
                 G_honest, fault, new_fb)
 
-    def _phase_defense(self, G_honest, mix_key, fault=None):
+    def _phase_defense(self, G_honest, mix_key, fault=None, attack_state=()):
         """Attack synthesis + aggregation + influence (reference
-        `attack.py:818-822`). Pure in (G_honest, mix_key, fault) given the
-        static config, so it compiles for whatever device its inputs live
-        on. With a `fault` context the aggregation runs the degradation
-        policy: absent rows masked out, non-finite rows quarantined
-        (`cfg.fault_quarantine`) and the effective quorum recomputed
-        (`cfg.fault_dynamic_quorum`); returns the fault metric dict as the
-        fourth element (None without faults). The fifth element is the
-        forensic metric dict when `cfg.gar_diagnostics` is on with the
-        study active (None otherwise): the outer aggregation runs through
-        the GAR's diagnostics kernel and its aux pytree is digested
-        in-graph (`engine/metrics.py::forensic_metrics`) — the attack's
-        line-search probes keep hitting the plain kernels."""
+        `attack.py:818-822`). Pure in (G_honest, mix_key, fault,
+        attack_state) given the static config, so it compiles for whatever
+        device its inputs live on. With a `fault` context the aggregation
+        runs the degradation policy: absent rows masked out, non-finite
+        rows quarantined (`cfg.fault_quarantine`) and the effective quorum
+        recomputed (`cfg.fault_dynamic_quorum`); returns the fault metric
+        dict as the fourth element (None without faults). The fifth
+        element is the forensic metric dict when `cfg.gar_diagnostics` is
+        on with the study active (None otherwise): the outer aggregation
+        runs through the GAR's diagnostics kernel and its aux pytree is
+        digested in-graph (`engine/metrics.py::forensic_metrics`) — the
+        attack's line-search probes keep hitting the plain kernels. The
+        sixth element is the attack's updated history pytree (stateful
+        attacks only — `attacks/__init__.py` state hook; `()` in, `()`
+        out otherwise)."""
         cfg = self.cfg
         mix_u = jax.random.uniform(mix_key)
         per_call = cfg.gars_per_call and len(self.defenses) > 1
@@ -706,10 +714,17 @@ class Engine:
             # attribution's outermost-first precedence charges them to the
             # attack, matching PERF_NOTES' "attack incl. its defense call"
             with jax.named_scope("attack"):
-                G_attack = self.attack.unchecked(
-                    G_honest, f_decl=cfg.nb_decl_byz,
-                    f_real=cfg.nb_real_byz,
-                    defense=defense_fn, **self.attack_kwargs)
+                if self.attack.stateful:
+                    G_attack, attack_state = self.attack.unchecked(
+                        G_honest, f_decl=cfg.nb_decl_byz,
+                        f_real=cfg.nb_real_byz,
+                        defense=defense_fn, state=attack_state,
+                        **self.attack_kwargs)
+                else:
+                    G_attack = self.attack.unchecked(
+                        G_honest, f_decl=cfg.nb_decl_byz,
+                        f_real=cfg.nb_real_byz,
+                        defense=defense_fn, **self.attack_kwargs)
                 # Attack internals (line-search factors) may promote to
                 # f32; pin the Byzantine rows back to the gradient dtype
                 G_attack = G_attack.astype(G_honest.dtype)
@@ -741,7 +756,8 @@ class Engine:
                     G_honest.dtype)
                 diag_metrics = None
             accept_ratio = self._run_influence(G_honest, G_attack, infl_u)
-            return G_attack, grad_defense, accept_ratio, None, diag_metrics
+            return (G_attack, grad_defense, accept_ratio, None, diag_metrics,
+                    attack_state)
 
         active = fault.active
         if cfg.fault_quarantine:
@@ -767,7 +783,7 @@ class Engine:
             diag_metrics = metrics_mod.forensic_metrics(aux, G_honest)
             diag_metrics["Active mask"] = active.astype(jnp.float32)
         return (G_attack, grad_defense, accept_ratio, fault_metrics,
-                diag_metrics)
+                diag_metrics, attack_state)
 
     def _run_defense_masked(self, G, mix_u, active):
         """The masked-variant defense program (`engine/program.py`):
@@ -781,17 +797,18 @@ class Engine:
         """xs: f32[S, B, ...] (or f32[S, k, B, ...] for k local steps)."""
         (rng, mix_key, G_sampled, loss_avg, net_state, new_mw,
          G_honest, fault, new_fb) = self._phase_honest(state, xs, ys, lr)
-        (G_attack, grad_defense, accept_ratio, fault_metrics,
-         diag_metrics) = self._phase_defense(G_honest, mix_key, fault)
+        (G_attack, grad_defense, accept_ratio, fault_metrics, diag_metrics,
+         attack_state) = self._phase_defense(G_honest, mix_key, fault,
+                                             state.attack_state)
         return self._phase_update(
             state, rng, G_sampled, loss_avg, net_state, new_mw, G_honest,
             G_attack, grad_defense, accept_ratio, lr, self._batch_of(xs),
-            fault_metrics, new_fb, diag_metrics)
+            fault_metrics, new_fb, diag_metrics, attack_state)
 
     def _phase_update(self, state, rng, G_sampled, loss_avg, net_state,
                       new_mw, G_honest, G_attack, grad_defense, accept_ratio,
                       lr, batch, fault_metrics=None, fault_buffer=None,
-                      diag_metrics=None):
+                      diag_metrics=None, attack_state=None):
         """Model update + study metrics (reference `attack.py:832-878`)."""
         cfg = self.cfg
         h = cfg.nb_honests
@@ -848,6 +865,8 @@ class Engine:
             rng=rng,
             fault_buffer=(state.fault_buffer if fault_buffer is None
                           else fault_buffer),
+            attack_state=(state.attack_state if attack_state is None
+                          else attack_state),
         )
         return new_state, metrics
 

@@ -62,7 +62,7 @@ def effective_f(gar_name, n_eff, f_decl):
 
 
 def masked_aggregate(gar, gradients, active, *, f_decl, dynamic=True,
-                     **kwargs):
+                     f_evicted=None, **kwargs):
     """Aggregate the active rows of `gradients` with `gar`.
 
     Args:
@@ -73,6 +73,16 @@ def masked_aggregate(gar, gradients, active, *, f_decl, dynamic=True,
       f_decl: static declared Byzantine count.
       dynamic: recompute the effective quorum (False = keep the declared
         `f`, only excluding the absent rows from the aggregation).
+      f_evicted: optional traced i32 — Byzantine rows the caller has
+        already CONFIRMED and excluded from `active` (the quarantine
+        loop's collusion-deduplicated evictions, `arena/quarantine.py`).
+        They are subtracted from the declared tolerance before the
+        clamp, so evicting a confirmed attacker does not ALSO shrink the
+        selection width the remaining rows aggregate with (a Krum over
+        n_eff rows at the un-credited f would drop `2 * evictions`
+        selected rows' worth of variance reduction). The static `f_decl`
+        still provisions every worst-case bound (brute's rank space,
+        scan lengths) — the credit only moves the traced `f_eff`.
       kwargs: the GAR's registered plugin args.
 
     Returns:
@@ -81,8 +91,12 @@ def masked_aggregate(gar, gradients, active, *, f_decl, dynamic=True,
     """
     name = _base_name(gar.name)
     n_eff = jnp.sum(active.astype(jnp.int32))
-    f_eff = (effective_f(name, n_eff, f_decl) if dynamic
-             else jnp.asarray(f_decl, jnp.int32))
+    f_claim = (jnp.maximum(
+        jnp.asarray(f_decl, jnp.int32)
+        - jnp.asarray(f_evicted, jnp.int32), 0)
+        if f_evicted is not None else f_decl)
+    f_eff = (effective_f(name, n_eff, f_claim) if dynamic
+             else jnp.asarray(f_claim, jnp.int32))
 
     if name == "average":
         return _common.masked_mean(gradients, active, n_eff), f_eff

@@ -80,9 +80,60 @@ def selfcheck():
         assert "recompiles=3" in report and "run_end" in report
         assert "forensics:" in report and "suspects=[4]" in report
 
+    # Closed-loop phase (PR 11): verdicts must become actions — outside
+    # the recorder window above so its suspect/evict events don't mix
+    # into the timeline the assertions just pinned
+    closed_loop_selfcheck()
     attribution_selfcheck()
     print("obs selfcheck: OK")
     return 0
+
+
+def closed_loop_selfcheck(K=25):
+    """The defense loop closes: (a) a planted Byzantine pair is flagged
+    AND quarantined within K steps; (b) a framing stream — an honest
+    victim starved of selection and pushed to the single-outlier
+    distance bound — ends with ZERO evictions (the hysteresis/threshold
+    proof: the statistical channels a framer can aim at a victim are
+    weighted below the eviction threshold; see `arena/quarantine.py`).
+    Host-side numpy only — no engine, no jax."""
+    import numpy as np
+
+    from byzantinemomentum_tpu.arena import QuarantinePolicy
+
+    n, f = 8, 2
+    # (a) rows 6/7 attack: never selected, distant, mutually identical
+    policy = QuarantinePolicy(n, f)
+    selection = np.ones(n)
+    selection[6:] = 0.0
+    distances = np.ones(n)
+    distances[6:] = 9.0
+    dmat = np.full((n, n), 5.0)
+    np.fill_diagonal(dmat, np.inf)
+    dmat[6, 7] = dmat[7, 6] = 0.01
+    for step in range(K):
+        mask = policy.update(step, selection, distances=distances,
+                             dist_matrix=dmat)
+    assert {6, 7} <= set(policy.tracker.suspects), policy.tracker.suspects
+    evicted = set(policy.evicted_at)
+    assert evicted and evicted <= {6, 7}, policy.summary()
+    assert not mask[sorted(evicted)[0]] and mask[:6].all(), mask
+
+    # (b) framing: victim 0 starved + the worst single-outlier distance
+    # (z self-bounds at sqrt(n-1) — a framer cannot push it further)
+    framed = QuarantinePolicy(n, f)
+    selection = np.ones(n)
+    selection[0] = 0.0
+    distances = np.ones(n)
+    distances[0] = 50.0
+    clean = np.full((n, n), 5.0)
+    np.fill_diagonal(clean, np.inf)
+    for step in range(3 * K):
+        framed.update(step, selection, distances=distances,
+                      dist_matrix=clean)
+    assert framed.evictions_total == 0, framed.summary()
+    print(f"closed loop: evicted={sorted(evicted)} within {K} steps, "
+          f"framing evictions=0")
 
 
 def attribution_selfcheck():

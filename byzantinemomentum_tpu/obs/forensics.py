@@ -27,6 +27,22 @@ evidence" reads 0 and "consistent evidence" saturates toward its weight:
                       indicator (`faults/sanitize.py` via the engine's
                       post-quarantine active mask, when a fault plan or
                       quarantine is live).
+  collusion           OPTIONAL fourth component (a 4-tuple `weights`
+                      enables it): EWMA of a near-duplicate indicator
+                      read off the full pairwise-distance matrix —
+                      workers whose rows sit closer than
+                      `collusion_frac` of the cohort's median pairwise
+                      distance to another row are colluding. This is the
+                      channel that catches attacks the statistical
+                      channels cannot: ALIE rows live INSIDE the honest
+                      variance envelope (z ~ 0, selected often), but the
+                      f attack rows are mutually (near-)identical — a
+                      geometric signature honest i.i.d. noise at
+                      realistic d essentially never produces. It is also
+                      the only channel an adversary cannot aim at an
+                      honest victim without byte-mimicking the victim's
+                      own row (in which case deduplication keeps the
+                      row's information — see `arena/quarantine.py`).
 
 All weights sum to 1, so `suspicion` lives in [0, 1]. Crossing
 `threshold` (rising edge) emits `suspect_worker`; falling back below
@@ -39,12 +55,36 @@ import numpy as np
 
 from byzantinemomentum_tpu.obs import recorder
 
-__all__ = ["SuspicionTracker", "ClientSuspicionStore", "Z_CLIP"]
+__all__ = ["SuspicionTracker", "ClientSuspicionStore", "Z_CLIP",
+           "COLLUSION_FRAC", "collusion_partners"]
 
 # Distance z-scores are clipped here before normalization: beyond ~4
 # sigma, "farther" carries no additional information, and a single inf
 # row must not destroy the EWMA.
 Z_CLIP = 4.0
+
+# Near-duplicate threshold, as a fraction of the cohort's median finite
+# pairwise distance: honest i.i.d. rows sit ~sigma*sqrt(2d) apart (the
+# median), while colluding copies differ only by whatever jitter the
+# attacker dares to add — 0.2 leaves the adversary a factor-5 gap to
+# cross before its rows blend into the honest cloud.
+COLLUSION_FRAC = 0.2
+
+
+def collusion_partners(dist, frac=COLLUSION_FRAC):
+    """`bool[n, n]` near-duplicate adjacency from a pairwise-distance
+    matrix (`ops/diag.py` aux convention: +inf diagonal, non-finite
+    -> +inf): an edge where the finite off-diagonal distance is at most
+    `frac` times the median finite off-diagonal distance. A fully
+    degenerate cohort (median 0 — every row identical) keeps exact-zero
+    edges, which is the honest reading of that cohort."""
+    d = np.asarray(dist, dtype=np.float64)
+    n = d.shape[0]
+    offdiag = ~np.eye(n, dtype=bool)
+    finite = np.isfinite(d) & offdiag
+    if not finite.any():
+        return np.zeros((n, n), dtype=bool)
+    return finite & (d <= frac * float(np.median(d[finite])))
 
 
 class SuspicionTracker:
@@ -56,20 +96,29 @@ class SuspicionTracker:
       threshold: suspicion level whose rising edge emits `suspect_worker`.
       clear: level whose falling edge emits `suspect_cleared` (hysteresis:
         must be < threshold).
-      weights: (selection, distance, quarantine) component weights;
-        normalized to sum 1.
+      weights: (selection, distance, quarantine) component weights —
+        or a 4-tuple (selection, distance, quarantine, collusion) to
+        enable the near-duplicate channel (fed by `update`'s
+        `dist_matrix`); normalized to sum 1.
       min_steps: observations before any event fires (the first few steps'
         selection rates are pure noise).
+      collusion_frac: near-duplicate threshold as a fraction of the
+        cohort's median pairwise distance (`collusion_partners`).
     """
 
     def __init__(self, nb_workers, *, alpha=0.05, threshold=0.5, clear=0.25,
-                 weights=(0.5, 0.3, 0.2), min_steps=10):
+                 weights=(0.5, 0.3, 0.2), min_steps=10,
+                 collusion_frac=COLLUSION_FRAC):
         if not 0.0 < alpha <= 1.0:
             raise ValueError(f"EWMA alpha must be in (0, 1], got {alpha}")
         if not 0.0 <= clear < threshold:
             raise ValueError(
                 f"Need 0 <= clear < threshold, got clear={clear} "
                 f"threshold={threshold}")
+        if len(weights) not in (3, 4):
+            raise ValueError(
+                f"Expected 3 (sel, dist, quarantine) or 4 (+ collusion) "
+                f"component weights, got {len(weights)}")
         self.nb_workers = int(nb_workers)
         self.alpha = float(alpha)
         self.threshold = float(threshold)
@@ -77,11 +126,14 @@ class SuspicionTracker:
         total = float(sum(weights))
         self.weights = tuple(float(w) / total for w in weights)
         self.min_steps = int(min_steps)
+        self.collusion_frac = float(collusion_frac)
         self.steps = 0
         n = self.nb_workers
         self._sel_rate = np.zeros(n)      # EWMA of the selected indicator
         self._dist_z = np.zeros(n)        # EWMA of the clipped z-score
         self._quarantine = np.zeros(n)    # EWMA of the quarantined indicator
+        self.collusion = np.zeros(n)      # EWMA of the near-duplicate flag
+        self.partners = np.zeros((n, n), dtype=bool)  # last step's adjacency
         self.suspicion = np.zeros(n)
         self._suspect = np.zeros(n, dtype=bool)
 
@@ -90,7 +142,8 @@ class SuspicionTracker:
     def _ewma(self, state, observation):
         return (1.0 - self.alpha) * state + self.alpha * observation
 
-    def update(self, step, selection, distances=None, active=None):
+    def update(self, step, selection, distances=None, active=None,
+               dist_matrix=None):
         """Fold one step's diagnostics into the scores.
 
         Args:
@@ -102,6 +155,9 @@ class SuspicionTracker:
             far.
           active: optional (n,) post-quarantine active mask (1 = healthy);
             absent means nobody was quarantined this step.
+          dist_matrix: optional (n, n) pairwise-distance matrix (the diag
+            aux `dist`) feeding the collusion channel — only consumed
+            when the tracker was built with a 4-tuple of weights.
         Returns:
           The (n,) suspicion array after the update.
         """
@@ -130,6 +186,12 @@ class SuspicionTracker:
                                    .reshape(n) > 0.0))
         self._quarantine = self._ewma(self._quarantine, quarantined)
 
+        if len(self.weights) == 4 and dist_matrix is not None:
+            self.partners = collusion_partners(dist_matrix,
+                                               self.collusion_frac)
+            self.collusion = self._ewma(
+                self.collusion, self.partners.any(axis=1).astype(np.float64))
+
         self.steps += 1
         mean_rate = float(self._sel_rate.mean())
         if mean_rate > 0.0:
@@ -137,10 +199,12 @@ class SuspicionTracker:
                               0.0, 1.0)
         else:
             deficit = np.zeros(n)
-        w_sel, w_dist, w_quar = self.weights
+        w_sel, w_dist, w_quar = self.weights[:3]
         self.suspicion = (w_sel * deficit
                           + w_dist * self._dist_z / Z_CLIP
                           + w_quar * self._quarantine)
+        if len(self.weights) == 4:
+            self.suspicion = self.suspicion + self.weights[3] * self.collusion
         self._emit_edges(step)
         return self.suspicion
 
@@ -173,12 +237,15 @@ class SuspicionTracker:
 
     def summary(self):
         """JSON-safe snapshot (heartbeat / report consumption)."""
-        return {
+        out = {
             "steps": self.steps,
             "suspects": self.suspects,
             "suspicion": [round(float(s), 4) for s in self.suspicion],
             "sel_rate": [round(float(r), 4) for r in self._sel_rate],
         }
+        if len(self.weights) == 4:
+            out["collusion"] = [round(float(c), 4) for c in self.collusion]
+        return out
 
 
 class ClientSuspicionStore:
@@ -209,7 +276,8 @@ class ClientSuspicionStore:
     """
 
     def __init__(self, *, alpha=0.05, threshold=0.5, clear=0.25,
-                 weights=(0.5, 0.3, 0.2), min_obs=10, max_clients=1_000_000):
+                 weights=(0.5, 0.3, 0.2), min_obs=10, max_clients=1_000_000,
+                 collusion_frac=COLLUSION_FRAC):
         if not 0.0 < alpha <= 1.0:
             raise ValueError(f"EWMA alpha must be in (0, 1], got {alpha}")
         if not 0.0 <= clear < threshold:
@@ -218,6 +286,10 @@ class ClientSuspicionStore:
                 f"threshold={threshold}")
         if max_clients < 1:
             raise ValueError(f"Expected max_clients >= 1, got {max_clients}")
+        if len(weights) not in (3, 4):
+            raise ValueError(
+                f"Expected 3 (sel, dist, quarantine) or 4 (+ collusion) "
+                f"component weights, got {len(weights)}")
         self.alpha = float(alpha)
         self.threshold = float(threshold)
         self.clear = float(clear)
@@ -225,17 +297,19 @@ class ClientSuspicionStore:
         self.weights = tuple(float(w) / total for w in weights)
         self.min_obs = int(min_obs)
         self.max_clients = int(max_clients)
+        self.collusion_frac = float(collusion_frac)
         self.requests = 0
-        # client -> [sel_rate, dist_z, quarantine, observations, suspect]
-        # (insertion order == recency order: re-observed clients move to
-        # the end, so eviction pops the least recently observed)
+        # client -> [sel_rate, dist_z, quarantine, observations, suspect,
+        #            collusion] (insertion order == recency order:
+        # re-observed clients move to the end, so eviction pops the least
+        # recently observed)
         self._state = {}
 
     def _ewma(self, state, observation):
         return (1.0 - self.alpha) * state + self.alpha * observation
 
     def observe(self, client_ids, selection, distances=None, active=None,
-                step=None):
+                step=None, dist=None):
         """Fold one request's serve aux into the per-client scores.
 
         Args:
@@ -246,9 +320,17 @@ class ClientSuspicionStore:
           active: optional (n,) request-level active/quarantine mask.
           step: optional sequence stamp for emitted events (defaults to
             the running request count).
+          dist: optional (n, n) pairwise-distance matrix (the serve aux's
+            `dist`) feeding the collusion channel — the Sybil defense:
+            rows from DISTINCT client ids sitting nearer than
+            `collusion_frac` of the cohort's median distance are a
+            coordinated cluster (one perturbation split across many ids
+            to stay under every per-client threshold). Only consumed
+            with a 4-tuple of weights.
         Returns:
           {client_id: verdict dict} for the cohort, where a verdict is
-          `{"suspicion": float, "suspect": bool, "observations": int}`.
+          `{"suspicion": float, "suspect": bool, "observations": int,
+          "collusion": float}`.
         """
         n = len(client_ids)
         selected = (np.asarray(selection, dtype=np.float64).reshape(n)
@@ -270,18 +352,37 @@ class ClientSuspicionStore:
         quarantined = (np.zeros(n) if active is None
                        else 1.0 - (np.asarray(active, dtype=np.float64)
                                    .reshape(n) > 0.0))
+        colluding = np.zeros(n)
+        measured = np.ones(n, dtype=bool)
+        if len(self.weights) == 4 and dist is not None:
+            partners = collusion_partners(dist, self.collusion_frac)
+            # Only cross-client edges are Sybil evidence: one client
+            # resubmitting its own vector is noisy, not coordinated
+            ids = list(client_ids)
+            same = np.array([[a == b for b in ids] for a in ids], dtype=bool)
+            colluding = (partners & ~same).any(axis=1).astype(np.float64)
+            if active is not None:
+                # An admission-masked (inactive) row was never measured —
+                # its distances are the +inf routing, not geometry — so
+                # its collusion EWMA HOLDS instead of decaying toward
+                # innocence while it sits in quarantine
+                measured = np.asarray(active, dtype=bool).reshape(n)
 
         self.requests += 1
         step = self.requests if step is None else step
         for i, client in enumerate(client_ids):
             state = self._state.pop(client, None)
             if state is None:
-                state = [0.0, 0.0, 0.0, 0, False]
+                state = [0.0, 0.0, 0.0, 0, False, 0.0]
+            elif len(state) == 5:   # pre-collusion state layout
+                state = state + [0.0]
             state[0] = self._ewma(state[0], selected[i])
             if z is not None:
                 state[1] = self._ewma(state[1], z[i])
             state[2] = self._ewma(state[2], quarantined[i])
             state[3] += 1
+            if measured[i]:
+                state[5] = self._ewma(state[5], colluding[i])
             self._state[client] = state  # re-insert: most recent last
 
         mean_rate = (sum(s[0] for s in self._state.values())
@@ -289,18 +390,14 @@ class ClientSuspicionStore:
         verdicts = {}
         for client in client_ids:
             state = self._state[client]
-            sel_rate, dist_z, quar, obs, suspect = state
-            deficit = (min(max((mean_rate - sel_rate) / mean_rate, 0.0), 1.0)
-                       if mean_rate > 0.0 else 0.0)
-            w_sel, w_dist, w_quar = self.weights
-            suspicion = (w_sel * deficit + w_dist * dist_z / Z_CLIP
-                         + w_quar * quar)
+            suspicion = self._score(state, mean_rate)
+            obs, suspect = state[3], state[4]
             if obs >= self.min_obs:
                 if suspicion >= self.threshold and not suspect:
                     state[4] = suspect = True
                     recorder.emit("suspect_client", client=str(client),
                                   step=step, suspicion=round(suspicion, 4),
-                                  sel_rate=round(sel_rate, 4))
+                                  sel_rate=round(state[0], 4))
                 elif suspicion <= self.clear and suspect:
                     state[4] = suspect = False
                     recorder.emit("suspect_client_cleared",
@@ -308,12 +405,42 @@ class ClientSuspicionStore:
                                   suspicion=round(suspicion, 4))
             verdicts[client] = {"suspicion": round(float(suspicion), 4),
                                 "suspect": bool(state[4]),
-                                "observations": int(obs)}
+                                "observations": int(obs),
+                                "collusion": round(float(state[5]), 4)}
         # Evict AFTER the verdicts so a cohort larger than the cap still
         # answers for every row of the request it just made
         while len(self._state) > self.max_clients:
             self._state.pop(next(iter(self._state)))
         return verdicts
+
+    def _score(self, state, mean_rate):
+        """The blended suspicion of one client state against the current
+        population mean selection rate."""
+        sel_rate, dist_z, quar = state[0], state[1], state[2]
+        deficit = (min(max((mean_rate - sel_rate) / mean_rate, 0.0), 1.0)
+                   if mean_rate > 0.0 else 0.0)
+        w_sel, w_dist, w_quar = self.weights[:3]
+        suspicion = (w_sel * deficit + w_dist * dist_z / Z_CLIP
+                     + w_quar * quar)
+        if len(self.weights) == 4:
+            suspicion += self.weights[3] * state[5]
+        return suspicion
+
+    def verdict(self, client):
+        """Read-only peek at one client's current verdict (None for a
+        client the store has never observed) — the admission-control path
+        (`serve/admission.py`) consults this at submit time WITHOUT
+        advancing any EWMA or recency state."""
+        state = self._state.get(client)
+        if state is None:
+            return None
+        mean_rate = (sum(s[0] for s in self._state.values())
+                     / max(len(self._state), 1))
+        return {"suspicion": round(float(self._score(state, mean_rate)), 4),
+                "suspect": bool(state[4]),
+                "observations": int(state[3]),
+                "collusion": round(float(state[5] if len(state) > 5
+                                         else 0.0), 4)}
 
     @property
     def suspects(self):

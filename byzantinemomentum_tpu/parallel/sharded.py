@@ -217,20 +217,25 @@ def shard_gar_diag(gar, mesh, *, f, **kwargs):
     nothing to any distance, so these rules ignore `d_real`.
 
     Coordinate-wise rules (trmean/phocas/meamed — the ROADMAP "lattice
-    rung 1"): trim fractions are per-coordinate MEANS, so the sharded aux
-    sums d-LOCAL partial quantities and psums them with shard widths
-    accounted: each shard counts its kept coordinates and squared
-    deviations over its REAL columns only (a global-column-index mask
-    derived from `d_real` excludes the divisibility padding, whose
-    all-zero columns would otherwise count as universally kept), one
-    tupled psum carries `(Gram, dev², kept-counts)` across ICI, and the
-    replicated totals divide by the true width. Oracle-tested against the
-    unsharded native aux (`tests/test_lattice.py`).
+    rung 1" — and, since the PR 11 round, median): trim fractions are
+    per-coordinate MEANS, so the sharded aux sums d-LOCAL partial
+    quantities and psums them with shard widths accounted: each shard
+    counts its kept coordinates and squared deviations over its REAL
+    columns only (a global-column-index mask derived from `d_real`
+    excludes the divisibility padding, whose all-zero columns would
+    otherwise count as universally kept), one tupled psum carries
+    `(Gram, dev², kept-counts)` across ICI, and the replicated totals
+    divide by the true width. Median's "kept" is its was-median
+    indicator (`ops/median.py::diagnose` — the sharded aux retires the
+    generic geometry fallback the ROADMAP's lattice rung 3 pointed at).
+    Oracle-tested against the unsharded native aux
+    (`tests/test_lattice.py`).
     """
     name = gar.name
 
-    if name in ("trmean", "phocas", "meamed"):
-        return _coord_diag_builder(name, gar, mesh, f=f, **kwargs)
+    if name in ("trmean", "phocas", "meamed", "median", "native-median"):
+        base = name[len("native-"):] if name.startswith("native-") else name
+        return _coord_diag_builder(base, gar, mesh, f=f, **kwargs)
 
     if name in ("krum", "native-krum"):
         from byzantinemomentum_tpu.ops import (
@@ -319,7 +324,13 @@ def _coord_diag_builder(name, gar, mesh, *, f, **kwargs):
             n = g_local.shape[0]
             width = g_local.shape[1]
             with pallas_sort.allowed():
-                if name == "trmean":
+                if name == "median":
+                    # Coordinate-wise ops are exact per d-shard; "kept"
+                    # is the was-median indicator (NaN rows compare
+                    # False, exactly as the unsharded native aux)
+                    agg = _common.lower_median(g_local)
+                    kept = g_local == agg[None, :]
+                elif name == "trmean":
                     agg = trmean_mod.trmean(g_local, f)
                     kept = diag.rank_kept_mask(g_local, f)
                 elif name == "phocas":
@@ -392,6 +403,8 @@ def sharded_state_spec(state):
         # The straggler-fault stale buffer (`faults/inject.py`) is (h, d):
         # d-sharded like every flat-parameter-space buffer
         fault_buffer=P(None, MODEL),
+        # Adaptive-attack history (tiny counter pytrees): replicated
+        attack_state=jax.tree.map(lambda _: P(), state.attack_state),
     )
 
 

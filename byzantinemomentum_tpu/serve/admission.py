@@ -1,0 +1,133 @@
+"""Admission control — the first defense the aggregation service
+actually MOUNTS (until now suspicion verdicts only rode responses).
+
+At submit time the service peeks each row's client verdict
+(`obs/forensics.py::ClientSuspicionStore.verdict` — read-only, no EWMA
+advance) and applies the configured action to the rows of clients the
+store currently distrusts:
+
+  mask        the row enters the packed batch INACTIVE: the traced-count
+              masked kernels exclude it and the effective quorum
+              recomputes (`faults/quorum.py`) — the row is rejected from
+              the aggregate but the request still answers, with the
+              rejection recorded in the response's admission provenance.
+  downweight  the row is blended toward the mean of the cohort's
+              admitted rows (`weight * row + (1 - weight) * mean`): the
+              client keeps a (shrunk) vote while its evidence
+              accumulates — the soft action for low-confidence verdicts.
+
+Two evidence channels gate a row, mirroring the train-side quarantine
+policy (`arena/quarantine.py`):
+
+  suspect     the store's hysteresis verdict (blended EWMA suspicion
+              over `min_obs` observations) — the per-client statistical
+              channel.
+  collusion   the store's near-duplicate EWMA at `collusion_threshold`
+              or above after `collusion_min_obs` observations — the
+              COHORT-level channel, and the one that catches Sybil
+              traffic: one perturbation split across many fresh client
+              ids keeps every per-client statistic unremarkable, but the
+              split shards are mutually near-identical across distinct
+              ids (`arena/sybil.py` is the red team for exactly this).
+
+Safety: at most `max_frac` of a cohort is ever masked (weakest evidence
+readmitted first) — an admission false-positive storm must not disable
+the GAR's own robustness by shrinking the quorum below usefulness.
+"""
+
+import numpy as np
+
+__all__ = ["AdmissionPolicy", "ADMISSION_WEIGHTS"]
+
+# Store weights that enable the collusion channel (the 4-component form
+# of `obs/forensics.py` — same shape as the train-side quarantine
+# policy's DEFAULT_WEIGHTS).
+ADMISSION_WEIGHTS = (0.35, 0.25, 0.10, 0.30)
+
+
+class AdmissionPolicy:
+    """The service's row-admission rule.
+
+    Args:
+      mode: "mask" (reject rows from the aggregate) or "downweight"
+        (blend toward the admitted cohort mean).
+      collusion_threshold: collusion-EWMA level that flags a client.
+      collusion_min_obs: observations before the collusion channel may
+        flag (below the store's own `min_obs` — coordinated duplicates
+        are harder evidence than statistics, so they act sooner).
+      downweight: surviving weight of a downweighted row.
+      max_frac: largest fraction of a cohort the policy may mask.
+    """
+
+    def __init__(self, mode="mask", *, collusion_threshold=0.5,
+                 collusion_min_obs=3, downweight=0.25, max_frac=0.5):
+        if mode not in ("mask", "downweight"):
+            raise ValueError(
+                f"Unknown admission mode {mode!r}; expected 'mask' or "
+                f"'downweight'")
+        if not 0.0 <= downweight <= 1.0:
+            raise ValueError(
+                f"Expected a downweight in [0, 1], got {downweight}")
+        if not 0.0 < max_frac <= 1.0:
+            raise ValueError(
+                f"Expected max_frac in (0, 1], got {max_frac}")
+        self.mode = mode
+        self.collusion_threshold = float(collusion_threshold)
+        self.collusion_min_obs = int(collusion_min_obs)
+        self.downweight = float(downweight)
+        self.max_frac = float(max_frac)
+
+    def decide(self, client_ids, store):
+        """Per-row admission decision for one cohort.
+
+        Returns `(admitted: bool[n], flagged: {client: reason})` —
+        `admitted` is False only in "mask" mode (downweighting keeps the
+        row active); `flagged` carries the verdict provenance either way.
+        """
+        n = len(client_ids)
+        admitted = np.ones(n, dtype=bool)
+        flagged = {}
+        evidence = []  # (score, row) for the max_frac readmission order
+        for i, client in enumerate(client_ids):
+            verdict = store.verdict(client)
+            if verdict is None:
+                continue
+            reason = None
+            if (verdict["collusion"] >= self.collusion_threshold
+                    and verdict["observations"] >= self.collusion_min_obs):
+                reason = "collusion"
+            elif verdict["suspect"]:
+                reason = "suspect"
+            if reason is not None:
+                flagged[str(client)] = {
+                    "reason": reason, "action": self.mode,
+                    "suspicion": verdict["suspicion"],
+                    "collusion": verdict["collusion"]}
+                evidence.append(
+                    (max(verdict["collusion"], verdict["suspicion"]), i))
+        if self.mode == "mask" and evidence:
+            budget = int(self.max_frac * n)
+            evidence.sort(reverse=True)
+            for rank, (_, row) in enumerate(evidence):
+                if rank < budget:
+                    admitted[row] = False
+                else:  # weakest evidence re-admitted under the cap
+                    flagged[str(client_ids[row])]["action"] = "readmitted"
+        return admitted, flagged
+
+    def apply(self, matrix, admitted, flagged, client_ids):
+        """Transform the request payload per the decisions (called once
+        at submit time, before packing): "mask" leaves the matrix alone
+        (the packer drops the rows from the active set); "downweight"
+        blends flagged rows toward the mean of the unflagged ones."""
+        if self.mode != "downweight" or not flagged:
+            return matrix
+        flagged_rows = np.array(
+            [str(c) in flagged for c in client_ids], dtype=bool)
+        if flagged_rows.all():
+            return matrix  # nothing trustworthy to blend toward
+        center = matrix[~flagged_rows].mean(axis=0)
+        out = matrix.copy()
+        out[flagged_rows] = (self.downweight * matrix[flagged_rows]
+                             + (1.0 - self.downweight) * center[None, :])
+        return out

@@ -43,17 +43,23 @@ class ServeRequest:
     """One enqueued aggregation: the packed payload plus its future.
     `n`/`d` are the RAW request shape (the cell's n_bucket/d_bucket are
     the compiled sizes); the packer pads up and the resolver slices
-    back."""
+    back. `admitted`/`admission` carry the submit-time admission-control
+    decisions (`serve/admission.py`): rows with `admitted` False pack as
+    INACTIVE (the masked kernels reject them), and the flagged-client
+    provenance rides back on the response."""
 
     __slots__ = ("cell", "n", "d", "matrix", "client_ids", "future",
-                 "t_submit")
+                 "t_submit", "admitted", "admission")
 
-    def __init__(self, cell, n, matrix, client_ids):
+    def __init__(self, cell, n, matrix, client_ids, admitted=None,
+                 admission=None):
         self.cell = cell
         self.n = int(n)
         self.d = int(matrix.shape[1])
         self.matrix = matrix          # np.f32[n, d] (host)
         self.client_ids = client_ids  # tuple[str] | None
+        self.admitted = admitted      # bool[n] | None (None = all)
+        self.admission = admission    # {client: decision} | None
         self.future = concurrent.futures.Future()
         self.t_submit = time.monotonic()
 

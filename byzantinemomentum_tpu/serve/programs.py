@@ -224,6 +224,11 @@ def _build(cell):
             out["scores"] = aux["scores"]
             out["selection"] = aux["selection"]
             out["worker_dist"] = aux["worker_dist"]
+            # The (N, N) pairwise matrix rides out too: the suspicion
+            # store's collusion channel (Sybil detection) needs the
+            # cohort geometry, not just per-row summaries — at bucket
+            # sizes (N <= 64) it is noise next to the (N, D) payload
+            out["dist"] = aux["dist"]
         return out
 
     return jax.jit(jax.vmap(one))

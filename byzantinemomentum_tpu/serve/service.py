@@ -44,14 +44,18 @@ class AggregateResult:
     """One resolved aggregation response."""
 
     __slots__ = ("aggregate", "f_eff", "n", "cell", "verdicts",
-                 "latency_ms")
+                 "admission", "latency_ms")
 
-    def __init__(self, aggregate, f_eff, n, cell, verdicts, latency_ms):
+    def __init__(self, aggregate, f_eff, n, cell, verdicts, latency_ms,
+                 admission=None):
         self.aggregate = aggregate    # np.f32[d] (raw request width)
         self.f_eff = f_eff            # effective Byzantine tolerance used
         self.n = n                    # submitted rows (pre-bucket)
         self.cell = cell              # the program cell served from
         self.verdicts = verdicts      # {client_id: verdict} | None
+        self.admission = admission    # {client_id: decision} | None —
+        #                               the submit-time admission-control
+        #                               provenance (`serve/admission.py`)
         self.latency_ms = latency_ms  # submit -> resolve wall time
 
     def as_dict(self):
@@ -64,6 +68,7 @@ class AggregateResult:
                      "f": self.cell.f, "d_bucket": self.cell.d_bucket,
                      "diagnostics": self.cell.diagnostics},
             "verdicts": self.verdicts,
+            "admission": self.admission,
             "latency_ms": round(self.latency_ms, 3),
         }
 
@@ -83,20 +88,39 @@ class AggregationService:
         watchdog can supervise the serving process like any run.
       heartbeat_interval: seconds between heartbeat writes (with a
         directory; the writer is a daemon thread).
-      suspicion: kwargs forwarded to `ClientSuspicionStore`.
+      suspicion: kwargs forwarded to `ClientSuspicionStore`. With
+        admission enabled and no explicit weights, the store runs the
+        4-component form (`serve/admission.py::ADMISSION_WEIGHTS`) so
+        the collusion/Sybil channel is live.
+      admission: None (verdicts ride responses but gate nothing — the
+        pre-admission behavior), an `AdmissionPolicy`, or a kwargs dict
+        for one (`serve/admission.py`): suspect/colluding clients' rows
+        are masked out of (or down-weighted in) the aggregate at submit
+        time, with the decision provenance on the response.
     """
 
     def __init__(self, *, max_batch=8, max_delay_ms=2.0, buckets=N_BUCKETS,
                  diagnostics=True, directory=None, heartbeat_interval=2.0,
-                 suspicion=None):
+                 suspicion=None, admission=None):
+        from byzantinemomentum_tpu.serve.admission import (
+            ADMISSION_WEIGHTS, AdmissionPolicy)
+
         self.cache = ProgramCache(buckets=buckets)
         self.max_batch = int(max_batch)
         self.diagnostics = bool(diagnostics)
-        self.suspicion = ClientSuspicionStore(**(suspicion or {}))
+        if isinstance(admission, dict):
+            admission = AdmissionPolicy(**admission)
+        self.admission = admission
+        suspicion = dict(suspicion or {})
+        if admission is not None:
+            suspicion.setdefault("weights", ADMISSION_WEIGHTS)
+        self.suspicion = ClientSuspicionStore(**suspicion)
         self._suspicion_lock = threading.Lock()
         self._requests = 0
         self._served = 0
         self._rejected = 0
+        self._admission_masked = 0
+        self._admission_downweighted = 0
         self._closed = False
         self._telemetry = None
         self.directory = None
@@ -144,9 +168,29 @@ class AggregationService:
             recorder.counter("serve_rejected")
             raise
         n = matrix.shape[0]
+        admitted, admission = None, None
+        if self.admission is not None and client_ids is not None:
+            with self._suspicion_lock:
+                admitted, admission = self.admission.decide(
+                    client_ids, self.suspicion)
+            if admission:
+                matrix = self.admission.apply(matrix, admitted, admission,
+                                              client_ids)
+                masked = int(n - admitted.sum())
+                blended = sum(1 for a in admission.values()
+                              if a["action"] == "downweight")
+                self._admission_masked += masked
+                self._admission_downweighted += blended
+                if masked:
+                    recorder.counter("serve_admission_masked", masked)
+                if blended:
+                    recorder.counter("serve_admission_downweighted",
+                                     blended)
         self._requests += 1
         recorder.counter("serve_requests")
-        return self.batcher.submit(ServeRequest(cell, n, matrix, client_ids))
+        return self.batcher.submit(ServeRequest(cell, n, matrix, client_ids,
+                                                admitted=admitted,
+                                                admission=admission))
 
     def _validate(self, vectors, gar, f, client_ids, diagnostics):
         """Everything that can reject a request, in one place (every
@@ -241,7 +285,10 @@ class AggregationService:
         active = np.zeros((B, N), dtype=bool)
         for i, r in enumerate(requests):
             G[i, :r.n, :r.d] = r.matrix
-            active[i, :r.n] = True
+            # Admission-masked rows stay INACTIVE: the traced-count
+            # masked kernels exclude them and f_eff recomputes — the
+            # same mechanism as the bucket padding rows
+            active[i, :r.n] = True if r.admitted is None else r.admitted
         for i in range(len(requests), B):
             G[i], active[i] = G[0], active[0]
         if recorder.active() is not None:
@@ -271,11 +318,15 @@ class AggregationService:
                     verdicts = self.suspicion.observe(
                         r.client_ids,
                         host["selection"][i, :r.n],
-                        distances=host["worker_dist"][i, :r.n])
+                        distances=host["worker_dist"][i, :r.n],
+                        active=r.admitted,
+                        dist=(host["dist"][i, :r.n, :r.n]
+                              if "dist" in host else None))
             result = AggregateResult(
                 aggregate=host["aggregate"][i, :r.d],
                 f_eff=int(host["f_eff"][i]),
                 n=r.n, cell=r.cell, verdicts=verdicts,
+                admission=r.admission,
                 latency_ms=(now - r.t_submit) * 1000.0)
             self._served += 1
             if not r.future.done():
@@ -291,6 +342,12 @@ class AggregationService:
             "requests": self._requests,
             "served": self._served,
             "rejected": self._rejected,
+            "admission": {
+                "enabled": self.admission is not None,
+                "mode": getattr(self.admission, "mode", None),
+                "masked_rows": self._admission_masked,
+                "downweighted_rows": self._admission_downweighted,
+            },
             "queue_depth": self.batcher.depth(),
             "cache": self.cache.stats(),
             "suspicion": self.suspicion.summary(),

@@ -49,8 +49,9 @@ sys.path.insert(0, str(ROOT / "scripts"))
 
 from bench_compare import load_artifact, _rates  # noqa: E402
 
-__all__ = ["collect_history", "collect_serve", "render_table", "main",
-           "GAR_COLUMN", "SERVE_COLUMNS"]
+__all__ = ["collect_history", "collect_serve", "collect_tournament",
+           "render_table", "main", "GAR_COLUMN", "SERVE_COLUMNS",
+           "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -132,6 +133,46 @@ def collect_serve(root, labels):
             if (stats := _serve_stats(root, label)) is not None}
 
 
+# Tournament (defense-loop) trajectory columns (`scripts/tournament.py`
+# artifacts): the median time-to-quarantine over quarantine-on cells
+# that actually evicted a Byzantine worker, and the honest-eviction
+# total (the framing-resistance quantity — it must stay 0)
+TOURNAMENT_COLUMNS = ("ttq median", "evicted honest")
+
+
+def _tournament_stats(root, label):
+    """`{ttq_median, evicted_honest, cells} | None` for one round's
+    tournament scoreboard: `TOURNAMENT_r*.json` per round, the working
+    tree's `TOURNAMENT.json` for the `current` row."""
+    name = ("TOURNAMENT.json" if label == "current"
+            else f"TOURNAMENT_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if (not isinstance(payload, dict)
+            or payload.get("kind") != "tournament"):
+        return None
+    cells = payload.get("train_cells") or []
+    ttqs = sorted(c["time_to_quarantine"] for c in cells
+                  if c.get("quarantine")
+                  and c.get("time_to_quarantine") is not None)
+    summary = payload.get("summary") or {}
+    return {
+        "ttq_median": (ttqs[len(ttqs) // 2] if ttqs else None),
+        "evicted_honest": summary.get("honest_evictions_total"),
+        "cells": len(cells),
+    }
+
+
+def collect_tournament(root, labels):
+    """{label: tournament stats} over the history rows (independent
+    instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _tournament_stats(root, label)) is not None}
+
+
 def collect_history(root=ROOT):
     """[(label, rates | None, reason | None, gar)] over every round
     artifact (sorted by round number) plus the working tree's
@@ -154,7 +195,9 @@ def collect_history(root=ROOT):
     # steps/s
     for glob, pattern in (("ATTRIB_r*.json", r"ATTRIB_r(\d+)\.json$"),
                           ("BENCH_serve_r*.json",
-                           r"BENCH_serve_r(\d+)\.json$")):
+                           r"BENCH_serve_r(\d+)\.json$"),
+                          ("TOURNAMENT_r*.json",
+                           r"TOURNAMENT_r(\d+)\.json$")):
         for path in root.glob(glob):
             m = re.search(pattern, path.name)
             if m:
@@ -163,7 +206,8 @@ def collect_history(root=ROOT):
     paths = [rounds[number] for number in sorted(rounds)]
     current = root / "BENCH_cells.json"
     if (current.is_file() or (root / "attribution.json").is_file()
-            or (root / "BENCH_serve.json").is_file()):
+            or (root / "BENCH_serve.json").is_file()
+            or (root / "TOURNAMENT.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
     for label, path in zip(labels, paths):
@@ -192,20 +236,22 @@ def _load_rates(path):
     return rates, None
 
 
-def render_table(history, serve=None):
+def render_table(history, serve=None, tournament=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
-    attribution column and the serve p50/p99/throughput columns when any
-    round carries the matching artifact."""
+    attribution column, the serve p50/p99/throughput columns and the
+    tournament defense-loop columns when any round carries the matching
+    artifact."""
     serve = serve or {}
+    tournament = tournament or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
             if name not in columns:
                 columns.append(name)
     any_gar = any(gar is not None for _, _, _, gar in history)
-    if not columns and not any_gar and not serve:
+    if not columns and not any_gar and not serve and not tournament:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -214,6 +260,8 @@ def render_table(history, serve=None):
         columns = columns + [GAR_COLUMN]
     if serve:
         columns = columns + list(SERVE_COLUMNS)
+    if tournament:
+        columns = columns + list(TOURNAMENT_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -234,6 +282,7 @@ def render_table(history, serve=None):
                 None, "tpu"):
             notes.append(f"  {label}: serve columns from a "
                          f"backend={row_serve['backend']} load report")
+        row_tournament = tournament.get(label)
 
         def cell(c, w):
             if c == GAR_COLUMN:
@@ -248,6 +297,14 @@ def render_table(history, serve=None):
                 if key == "compiles":
                     return f"{int(value):>{w}d}"
                 return f"{value:>{w}.3f}"
+            if c in TOURNAMENT_COLUMNS:
+                key = {"ttq median": "ttq_median",
+                       "evicted honest": "evicted_honest"}[c]
+                value = (None if row_tournament is None
+                         else row_tournament.get(key))
+                if value is None:
+                    return f"{'-':>{w}}"
+                return f"{int(value):>{w}d}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -279,15 +336,18 @@ def main(argv=None):
         return 0
     serve = collect_serve(pathlib.Path(args.root),
                           [label for label, *_ in history])
+    tournament = collect_tournament(pathlib.Path(args.root),
+                                    [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
              "gar_ms_per_step": None if gar is None else gar[0],
              "gar_backend": None if gar is None else gar[1],
-             "serve": serve.get(label)}
+             "serve": serve.get(label),
+             "tournament": tournament.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
-    print(render_table(history, serve))
+    print(render_table(history, serve, tournament))
     return 0
 
 

@@ -226,6 +226,39 @@ def main():
                                      if not k.endswith("_tail")})
     print(f"  {serve_tier}", flush=True)
 
+    # Tournament tier (PR 11): the attack-vs-defense smoke grid — 2
+    # attacks x 2 GARs x quarantine {on, off} + the Sybil admission pair,
+    # with the zero-recompile assertion armed (quarantine mask updates
+    # must re-use the compiled step). Own green bit + telemetry span
+    # recording the cells run.
+    print("tournament tier ...", flush=True)
+    with telemetry.span("tier_tournament"):
+        tour_proc = subprocess.run(
+            [sys.executable, "scripts/tournament.py", "--smoke"],
+            cwd=ROOT, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    tournament_tier = {"returncode": tour_proc.returncode}
+    for line in tour_proc.stdout.splitlines():
+        if line.startswith("tournament: "):
+            try:
+                payload = json.loads(line[len("tournament: "):])
+            except ValueError:
+                continue
+            tournament_tier["cells"] = payload.get("cells")
+            tournament_tier["serve_cells"] = payload.get("serve_cells")
+            summary = payload.get("summary") or {}
+            tournament_tier["dominated"] = summary.get(
+                "gars_dominated")
+            tournament_tier["honest_evictions"] = summary.get(
+                "honest_evictions_total")
+    if tour_proc.returncode != 0:
+        tournament_tier["tail"] = (tour_proc.stdout
+                                   + tour_proc.stderr).splitlines()[-12:]
+    telemetry.event("tournament_tier",
+                    **{k: v for k, v in tournament_tier.items()
+                       if k != "tail"})
+    print(f"  {tournament_tier}", flush=True)
+
     shards = {}
     for path in sorted((ROOT / "tests").glob("test_*.py")):
         print(f"slow tier: {path.name} ...", flush=True)
@@ -258,6 +291,7 @@ def main():
         "default_tier": default,
         "nopallas_tier": nopallas,
         "serve_tier": serve_tier,
+        "tournament_tier": tournament_tier,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
         "telemetry": telemetry.path.name,
@@ -270,6 +304,7 @@ def main():
                       and nopallas["failed"] == 0
                       and nopallas["returncode"] == 0
                       and serve_tier["returncode"] == 0
+                      and tournament_tier["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }

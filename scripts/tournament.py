@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Attack-vs-defense tournament runner (`byzantinemomentum_tpu/arena/`).
+
+Full mode sweeps every registered attack x every first-tier GAR x
+quarantine {on, off} in train mode plus the serve-mode Sybil admission
+pair, and writes the resilience scoreboard `TOURNAMENT_r{N}.json` at the
+repo root (the committed-artifact convention of BENCH_r*/ATTRIB_r*;
+`scripts/bench_history.py` renders the trajectory).
+
+`--smoke` runs the CI grid — 2 attacks x 2 GARs + a short Sybil pair —
+with the zero-recompile assertion armed
+(`analysis/contracts.py::assert_recompile_budget` over changing
+quarantine masks), exits non-zero on any broken invariant, and prints
+one machine-readable summary line for the tier harness
+(`scripts/run_test_tiers.py`).
+
+Usage:
+  python scripts/tournament.py --round 11           # full grid artifact
+  python scripts/tournament.py --smoke              # CI smoke
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# The grid is CPU-sized (probe engine); never wait on a TPU tunnel
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SMOKE_GARS = ("krum", "median")
+SMOKE_ATTACKS = ("alie", "framing")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="tournament",
+        description="attack x GAR x quarantine resilience scoreboard")
+    parser.add_argument("--round", type=int, default=None,
+                        help="write TOURNAMENT_r{N}.json at the repo root")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI smoke: 2x2 grid + recompile assertion, "
+                             "no artifact unless --out/--round is given")
+    parser.add_argument("--steps", type=int, default=None,
+                        help="train steps per cell (default: 40 smoke, "
+                             "80 full)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", type=str, default=None,
+                        help="explicit artifact path (overrides --round)")
+    args = parser.parse_args(argv)
+
+    from byzantinemomentum_tpu.arena import tournament
+
+    start = time.monotonic()
+    if args.smoke:
+        roster = [(a, a, {}, 0.0) for a in SMOKE_ATTACKS]
+        scoreboard = tournament.run_tournament(
+            gars=SMOKE_GARS, roster=roster,
+            steps=args.steps or 40, seed=args.seed,
+            serve_requests=18, recompile_check=True, log=print)
+    else:
+        scoreboard = tournament.run_tournament(
+            steps=args.steps or 80, seed=args.seed,
+            recompile_check=True, log=print)
+    scoreboard["elapsed_s"] = round(time.monotonic() - start, 1)
+    if args.round is not None:
+        scoreboard["round"] = args.round
+
+    summary = scoreboard["summary"]
+    failures = []
+    if summary["framing_honest_evictions"]:
+        failures.append(
+            f"framing evicted {summary['framing_honest_evictions']} honest "
+            f"worker(s) — the hysteresis contract broke")
+    if args.smoke:
+        # The smoke's own green conditions beyond the recompile assertion
+        # (which already raised if violated): the Sybil pair must show
+        # admission catching what slips through without it
+        sybil = summary["sybil"]
+        if not (sybil.get("shift_tail_on", 1e9)
+                < sybil.get("shift_tail_off", 0.0)):
+            failures.append(f"sybil admission pair inverted: {sybil}")
+        if sybil.get("honest_masked", 1):
+            failures.append(f"sybil admission masked honest ids: {sybil}")
+    else:
+        if not summary["selection_gars_dominated"]:
+            failures.append(
+                "quarantine-on dominates quarantine-off on NO selection "
+                "GAR against the adaptive attacks")
+
+    path = None
+    if args.out or args.round is not None:
+        path = pathlib.Path(args.out) if args.out else (
+            ROOT / f"TOURNAMENT_r{args.round:02d}.json")
+        path.write_text(json.dumps(scoreboard, indent=1) + "\n")
+
+    print("tournament: " + json.dumps({
+        "cells": len(scoreboard["train_cells"]),
+        "serve_cells": len(scoreboard["serve_cells"]),
+        "elapsed_s": scoreboard["elapsed_s"],
+        "artifact": path.name if path else None,
+        "summary": summary,
+        "green": not failures,
+    }, sort_keys=True))
+    for failure in failures:
+        print(f"tournament FAILURE: {failure}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -509,6 +509,65 @@ def suspicion_timeline(session):
     return plot
 
 
+def load_tournament(path):
+    """Parse one tournament scoreboard artifact
+    (`scripts/tournament.py` -> `TOURNAMENT_r*.json`)."""
+    path = pathlib.Path(path)
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError) as err:
+        raise utils.UserException(
+            f"Unable to read tournament artifact {str(path)!r}: {err}")
+    if not isinstance(payload, dict) or payload.get("kind") != "tournament":
+        raise utils.UserException(
+            f"{str(path)!r} is not a tournament scoreboard "
+            f"(kind != 'tournament')")
+    return payload
+
+
+def tournament_scoreboard(source, metric="agg_err_last10"):
+    """Attack x GAR resilience heatmap from a tournament scoreboard:
+    each cell is the PROTECTION RATIO `off / on` of `metric` (steady
+    -state aggregate error by default) — above 1.0 the quarantine loop
+    strictly helped against that attack on that rule, at 1.0 it was
+    neutral. Returns `(matrix, attack_labels, gar_labels, HeatmapPlot)`.
+
+    `source` is a scoreboard dict (`arena/tournament.py::run_tournament`)
+    or an artifact path.
+    """
+    import numpy as np
+
+    scoreboard = (source if isinstance(source, dict)
+                  else load_tournament(source))
+    cells = scoreboard.get("train_cells") or []
+    if not cells:
+        raise utils.UserException("Tournament scoreboard has no train cells")
+    attacks = sorted({c["attack"] for c in cells})
+    gars = sorted({c["gar"] for c in cells})
+    value = {(c["attack"], c["gar"], bool(c["quarantine"])): c.get(metric)
+             for c in cells}
+    matrix = np.full((len(attacks), len(gars)), np.nan)
+    for i, attack in enumerate(attacks):
+        for j, gar in enumerate(gars):
+            on = value.get((attack, gar, True))
+            off = value.get((attack, gar, False))
+            if on and off is not None:
+                matrix[i, j] = off / on
+    plot = HeatmapPlot()
+    plot.render(np.nan_to_num(matrix, nan=0.0),
+                title=f"Quarantine protection (off/on {metric})",
+                xlabel="GAR", ylabel="attack",
+                clabel="protection ratio (>1 = quarantine wins)",
+                cmap="RdYlGn")
+    # Name the grid axes (the generic renderer labels rows numerically)
+    plot._ax.set_xticks(range(len(gars)))
+    plot._ax.set_xticklabels(gars, rotation=45, ha="right", fontsize=7)
+    plot._ax.set_yticks(range(len(attacks)))
+    plot._ax.set_yticklabels(attacks, fontsize=7)
+    plot._fig.tight_layout()
+    return matrix, attacks, gars, plot
+
+
 # --------------------------------------------------------------------------- #
 # Interactive DataFrame viewer (reference `study.py:44-78`, `:129-180`:
 # a GTK3 TreeView window, degrading to a warning when GTK is unavailable)

@@ -497,8 +497,9 @@ def test_bless_idempotent_and_check_ok(tmp_path, monkeypatch):
     lowering.bless(path)
     assert path.read_bytes() == first  # byte-idempotent
     report = lowering.check(path)
-    # 2 GARs x (plain/diag/masked + the r10 masked-bucket cell)
-    assert report["status"] == "ok" and report["checked"] == 8
+    # 2 GARs x (plain/diag/masked + the r10 masked-bucket cell + the
+    # r11 quarantine cell)
+    assert report["status"] == "ok" and report["checked"] == 10
 
 
 def test_planted_gar_edit_trips_drift_gate(tmp_path, monkeypatch):

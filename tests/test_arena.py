@@ -1,0 +1,307 @@
+"""Arena tests: the closed defense loop (`byzantinemomentum_tpu/arena/`),
+the adaptive red team (`attacks/alie.py`/`warmup.py`/`framing.py` + the
+registry's stateful hook), the quarantine policy's eviction/hysteresis/
+budget contracts, the tournament scoreboard, and the engine threading of
+adaptive-attack state through `TrainState`."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu import attacks as attacks_mod, checkpoint, ops
+from byzantinemomentum_tpu.arena import QuarantinePolicy
+from byzantinemomentum_tpu.arena.loop import ArenaCell, noniid_batches
+from byzantinemomentum_tpu.arena.quarantine import quarantine_defense_kernel
+from byzantinemomentum_tpu.arena import tournament
+from byzantinemomentum_tpu.attacks.alie import zmax
+from byzantinemomentum_tpu.obs.forensics import (
+    SuspicionTracker, collusion_partners)
+
+
+def _honest(h=8, d=16, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(
+        size=(h, d)).astype(np.float32))
+
+
+# --------------------------------------------------------------------------- #
+# Adaptive attacks + the registry state hook
+
+def test_alie_rows_sit_on_the_variance_envelope():
+    G = _honest()
+    rows = attacks_mod.attacks["alie"].checked(G, 3, 3, defense=None)
+    assert rows.shape == (3, G.shape[1])
+    mu = np.mean(np.asarray(G), axis=0)
+    sigma = np.std(np.asarray(G), axis=0, ddof=1)
+    expected = mu + zmax(G.shape[0] + 3, 3) * sigma
+    np.testing.assert_allclose(np.asarray(rows[0]), expected, rtol=1e-5)
+    # All f rows identical (the collusion signature the defense reads)
+    np.testing.assert_array_equal(np.asarray(rows[0]), np.asarray(rows[1]))
+
+
+def test_alie_z_override_and_jitter_decorrelate():
+    G = _honest()
+    tight = attacks_mod.attacks["alie"].checked(G, 3, 2, defense=None, z=0.1)
+    wide = attacks_mod.attacks["alie"].checked(G, 3, 2, defense=None, z=2.0)
+    mu = np.mean(np.asarray(G), axis=0)
+    assert (np.linalg.norm(np.asarray(wide[0]) - mu)
+            > np.linalg.norm(np.asarray(tight[0]) - mu))
+    jittered = attacks_mod.attacks["alie"].checked(
+        G, 3, 2, defense=None, z=0.5, jitter=0.2)
+    assert not np.array_equal(np.asarray(jittered[0]),
+                              np.asarray(jittered[1]))
+
+
+def test_zmax_closed_form():
+    # n=11, f=4: s=2, q=5/7 -> Phi^-1(0.714...) ~ 0.566 (Baruch et al.)
+    assert zmax(11, 4) == pytest.approx(0.566, abs=5e-3)
+    assert zmax(11, 2) == pytest.approx(0.1397, abs=5e-3)
+    assert zmax(4, 3) == 0.0  # degenerate majority clamps to the mean
+
+
+def test_warmup_attack_is_stateful_and_time_coupled():
+    atk = attacks_mod.attacks["alie-warmup"]
+    assert atk.stateful
+    G = _honest()
+    rows0, state = atk.checked(G, 2, 2, defense=None, window=2, burst=10.0)
+    assert int(state) == 1
+    mu = np.mean(np.asarray(G), axis=0)
+    np.testing.assert_allclose(np.asarray(rows0[0]), -10.0 * mu, rtol=1e-5)
+    _, state = atk.checked(G, 2, 2, defense=None, state=state, window=2)
+    rows2, state = atk.checked(G, 2, 2, defense=None, state=state, window=2)
+    assert int(state) == 3
+    # Past the window the rows hide inside the envelope (near the mean)
+    assert (np.linalg.norm(np.asarray(rows2[0]) - mu)
+            < np.linalg.norm(np.asarray(rows0[0]) - mu))
+
+
+def test_static_attacks_keep_the_stateless_interface():
+    atk = attacks_mod.attacks["empire"]
+    assert not atk.stateful
+    out = atk.checked(_honest(), 2, 2, defense=lambda gradients, f:
+                      jnp.mean(gradients, axis=0))
+    assert out.shape == (2, 16)  # a bare matrix, no state tuple
+
+
+def test_framing_attack_clusters_away_from_victim():
+    G = _honest()
+    rows = attacks_mod.attacks["framing"].checked(
+        G, 3, 3, defense=None, victim=2, push=1.0)
+    others = (np.sum(np.asarray(G), axis=0) - np.asarray(G[2])) / 7
+    np.testing.assert_allclose(
+        np.asarray(rows[0]), others + (others - np.asarray(G[2])),
+        rtol=1e-4)
+    assert attacks_mod.attacks["framing"].check(
+        grad_honests=G, f_real=1, defense=None, victim=99) is not None
+
+
+# --------------------------------------------------------------------------- #
+# Collusion channel + quarantine policy
+
+def test_collusion_partners_relative_threshold():
+    dist = np.full((4, 4), 10.0)
+    np.fill_diagonal(dist, np.inf)
+    dist[2, 3] = dist[3, 2] = 0.5  # well under 0.2 * median(10)
+    partners = collusion_partners(dist)
+    assert partners[2, 3] and partners[3, 2]
+    assert partners.sum() == 2
+    # Non-finite rows never partner
+    dist[0, 1] = dist[1, 0] = np.nan
+    assert not collusion_partners(dist)[0, 1]
+
+
+def test_tracker_weight_arity():
+    with pytest.raises(ValueError):
+        SuspicionTracker(4, weights=(1.0, 1.0))
+    three = SuspicionTracker(4)               # 3-weight form unchanged
+    assert len(three.weights) == 3
+    four = SuspicionTracker(4, weights=(0.35, 0.25, 0.1, 0.3))
+    dist = np.full((4, 4), 10.0)
+    np.fill_diagonal(dist, np.inf)
+    dist[0, 1] = dist[1, 0] = 0.1
+    four.update(0, np.ones(4), dist_matrix=dist)
+    assert four.collusion[0] > 0 and four.collusion[2] == 0
+
+
+def test_policy_evicts_colluding_pair_keeps_one_and_respects_budget():
+    n = 8
+    policy = QuarantinePolicy(n, 2, max_evictions=1)
+    sel = np.ones(n)
+    sel[5:] = 0.0
+    dmat = np.full((n, n), 5.0)
+    np.fill_diagonal(dmat, np.inf)
+    for i in (5, 6, 7):
+        for j in (5, 6, 7):
+            if i != j:
+                dmat[i, j] = 0.01  # a 3-clique of near-duplicates
+    for t in range(40):
+        mask = policy.update(t, sel, dist_matrix=dmat)
+    # Budget 1: exactly one eviction despite three saturated colluders
+    assert policy.evictions_total == 1
+    assert int(mask.sum()) == n - 1
+    assert sorted(policy.evicted_at) and min(policy.evicted_at) >= 5
+
+
+def test_policy_collusion_dedup_keeps_lowest_history_member():
+    n = 6
+    policy = QuarantinePolicy(n, 3)
+    sel = np.ones(n)
+    dmat = np.full((n, n), 5.0)
+    np.fill_diagonal(dmat, np.inf)
+    dmat[4, 5] = dmat[5, 4] = 0.01
+    for t in range(40):
+        policy.update(t, sel, dist_matrix=dmat)
+    # The pair saturates together; the dedup keeps the lower index
+    assert sorted(policy.evicted_at) == [5]
+    assert policy.f_reclaimed() == 1
+
+
+def test_policy_framing_stream_never_evicts():
+    """The hysteresis contract: a starved victim at the single-outlier
+    distance bound (z self-limits at sqrt(n-1)) stays below the eviction
+    threshold forever."""
+    n = 11
+    policy = QuarantinePolicy(n, 3)
+    sel = np.ones(n)
+    sel[0] = 0.0
+    dist = np.ones(n)
+    dist[0] = 100.0
+    clean = np.full((n, n), 5.0)
+    np.fill_diagonal(clean, np.inf)
+    for t in range(120):
+        policy.update(t, sel, distances=dist, dist_matrix=clean)
+    assert policy.evictions_total == 0
+    assert policy.tracker.suspicion[0] < policy.evict_threshold
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="4-tuple"):
+        QuarantinePolicy(4, 1, tracker={"weights": (0.5, 0.3, 0.2)})
+    with pytest.raises(ValueError, match="undercut"):
+        QuarantinePolicy(4, 1, evict_threshold=0.1)
+
+
+def test_quarantine_kernel_masks_and_reclaims_quorum():
+    G = np.array(_honest(11, 16), copy=True)
+    G[9] = np.nan  # sanitize must fold corrupt rows into the mask
+    kernel = quarantine_defense_kernel(ops.gars["krum"], f=3)
+    active = np.ones(11, dtype=bool)
+    active[10] = False
+    out = kernel(jnp.asarray(G), jnp.asarray(active), jnp.int32(0))
+    assert not bool(out["active"][9]) and not bool(out["active"][10])
+    assert int(out["f_eff"]) == 3
+    credited = kernel(jnp.asarray(G), jnp.asarray(active), jnp.int32(2))
+    assert int(credited["f_eff"]) == 1  # the eviction credit shrinks f
+    assert np.isfinite(np.asarray(credited["aggregate"])).all()
+    # Masked rows read +inf worker distance and zero selection
+    assert np.isinf(np.asarray(out["worker_dist"])[9:]).all()
+    assert np.asarray(out["selection"])[9:].sum() == 0
+
+
+# --------------------------------------------------------------------------- #
+# Engine threading of adaptive-attack state
+
+def test_engine_threads_attack_state_and_checkpoints_it(tmp_path):
+    from byzantinemomentum_tpu import losses
+    from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+    from byzantinemomentum_tpu.arena.loop import probe_loss, probe_model_def
+
+    cfg = EngineConfig(nb_workers=6, nb_decl_byz=2, nb_real_byz=2,
+                       nb_for_study=0, momentum=0.0, momentum_at="update")
+    engine = build_engine(
+        cfg=cfg, model_def=probe_model_def(8), loss=probe_loss(),
+        criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars["median"], 1.0, {})],
+        attack=attacks_mod.attacks["alie-warmup"],
+        attack_kwargs={"window": 3})
+    state = engine.init(jax.random.PRNGKey(0))
+    assert int(state.attack_state) == 0
+    xs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 2, 8)).astype(np.float32))
+    ys = jnp.zeros((4, 2), jnp.float32)
+    for expected in (1, 2):
+        state, _ = engine.train_step(state, xs, ys, jnp.float32(0.1))
+        assert int(state.attack_state) == expected
+    # The counter survives a checkpoint round-trip (resume keeps the
+    # attack's schedule aligned with the step counter)
+    path = checkpoint.save(tmp_path / "ck.bin", state)
+    restored = checkpoint.load(path, engine.init(jax.random.PRNGKey(1)))
+    assert int(restored.attack_state) == 2
+
+
+# --------------------------------------------------------------------------- #
+# The closed loop end to end
+
+@pytest.fixture(scope="module")
+def krum_alie_cell():
+    return ArenaCell("krum", "alie", n=11, f_decl=3, f_real=3, d=32)
+
+
+def test_closed_loop_evicts_attackers_not_honests(krum_alie_cell):
+    row = krum_alie_cell.run(quarantine=True, steps=60, seed=1,
+                             warm_recompile_check=True)
+    assert row["evicted_honest"] == 0
+    assert row["evicted_byz"] >= 1
+    assert row["time_to_quarantine"] is not None
+    assert row["time_to_quarantine"] <= 40
+    assert row["f_reclaimed"] >= 1
+
+
+def test_closed_loop_on_off_share_one_compiled_program(krum_alie_cell):
+    """Quarantine on/off — and every mask update in between — run the
+    SAME executable: after the warm on-run, the off-run compiles
+    nothing."""
+    from byzantinemomentum_tpu.analysis import contracts
+
+    krum_alie_cell.run(quarantine=True, steps=12, seed=3)  # warm
+    with contracts.count_compiles() as log:
+        off = krum_alie_cell.run(quarantine=False, steps=12, seed=3)
+    assert log.count == 0, log.events
+    assert off["evicted_byz"] == 0 and off["active_final"] == 11
+
+
+def test_closed_loop_quarantine_dominates_steady_state(krum_alie_cell):
+    on = krum_alie_cell.run(quarantine=True, steps=80, seed=0)
+    off = krum_alie_cell.run(quarantine=False, steps=80, seed=0)
+    assert on["agg_err_last10"] < off["agg_err_last10"]
+
+
+def test_framing_cell_zero_honest_evictions():
+    cell = ArenaCell("krum", "framing", n=11, f_decl=3, f_real=3, d=32)
+    row = cell.run(quarantine=True, steps=80, seed=0)
+    assert row["evicted_honest"] == 0
+
+
+def test_noniid_batches_skew_moves_worker_means():
+    rng = np.random.default_rng(0)
+    optimum = np.zeros(16, np.float32)
+    iid = noniid_batches(rng, steps=4, workers=6, batch=64,
+                         optimum=optimum, sigma=0.5, skew=0.0)
+    assert iid.shape == (4, 6, 64, 16)
+    skewed = noniid_batches(np.random.default_rng(0), steps=4, workers=6,
+                            batch=64, optimum=optimum, sigma=0.5, skew=2.0)
+    worker_means = skewed.mean(axis=(0, 2))
+    spread = np.linalg.norm(worker_means, axis=1)
+    assert (spread > 0.5).all()  # each worker's optimum fanned out
+    assert np.linalg.norm(iid.mean(axis=(0, 2)), axis=1).max() < 0.2
+
+
+def test_tournament_scoreboard_schema_and_digests():
+    roster = [("alie", "alie", {}, 0.0)]
+    sb = tournament.run_tournament(
+        gars=("median",), roster=roster, steps=24, seed=0,
+        serve_requests=8, serve_gar="median")
+    assert sb["kind"] == "tournament"
+    assert len(sb["train_cells"]) == 2  # one cell x on/off
+    assert {c["quarantine"] for c in sb["train_cells"]} == {True, False}
+    for c in sb["train_cells"]:
+        for key in ("final_err", "agg_err_mean", "agg_err_last10",
+                    "evicted_honest", "evicted_byz",
+                    "time_to_quarantine", "f_reclaimed"):
+            assert key in c
+    assert len(sb["serve_cells"]) == 2
+    summary = sb["summary"]
+    assert summary["dominance_metric"] == "agg_err_last10"
+    assert "framing_honest_evictions" in summary
+    assert "sybil" in summary and "detection_rate" in summary["sybil"]

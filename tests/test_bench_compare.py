@@ -510,3 +510,45 @@ def test_bench_history_serve_columns(tmp_path, capsys):
     by_round = {row["round"]: row for row in payload}
     assert by_round["r02"]["serve"]["p99"] == 6.0
     assert by_round["r01"]["serve"] is None
+
+
+def test_bench_history_tournament_columns(tmp_path, capsys):
+    """Time-to-quarantine / evicted-honest columns render from committed
+    TOURNAMENT_r*.json scoreboards; rounds without one dash out, and a
+    tournament-only round still gets a row."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    cells = [
+        {"gar": "krum", "attack": "alie", "quarantine": True,
+         "time_to_quarantine": 15, "evicted_honest": 0},
+        {"gar": "krum", "attack": "alie", "quarantine": False,
+         "time_to_quarantine": None, "evicted_honest": 0},
+        {"gar": "cge", "attack": "nan", "quarantine": True,
+         "time_to_quarantine": 11, "evicted_honest": 0},
+    ]
+    (tmp_path / "TOURNAMENT_r02.json").write_text(json.dumps({
+        "kind": "tournament", "train_cells": cells,
+        "summary": {"honest_evictions_total": 0}}))
+
+    stats = bench_history.collect_tournament(tmp_path, ["r01", "r02"])
+    assert "r01" not in stats
+    assert stats["r02"]["ttq_median"] == 15  # median of [11, 15], upper
+    assert stats["r02"]["evicted_honest"] == 0
+    assert stats["r02"]["cells"] == 3
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for column in bench_history.TOURNAMENT_COLUMNS:
+        assert column in out
+    r02 = [l for l in out.splitlines() if l.startswith("r02")][0]
+    assert r02.split()[-2:] == ["15", "0"]
+    r01 = [l for l in out.splitlines() if l.startswith("r01")][0]
+    assert r01.split()[-1] == "-"
+
+    rc = bench_history.main(["--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_round = {row["round"]: row for row in payload}
+    assert by_round["r02"]["tournament"]["ttq_median"] == 15
+    assert by_round["r01"]["tournament"] is None

@@ -428,8 +428,8 @@ def test_per_call_mixture_draw_counts_one_step():
 
     rng = np.random.default_rng(3)
     G_honest = jnp.asarray(rng.normal(size=(6, D)).astype(np.float32))
-    G_attack, _, _, _, _ = engine._phase_defense(G_honest,
-                                              jax.random.PRNGKey(11))
+    G_attack, _, _, _, _, _ = engine._phase_defense(G_honest,
+                                                    jax.random.PRNGKey(11))
     G_attack = np.asarray(G_attack)
     # Classify each invocation's draw by its distinguishable offset
     draws = []

@@ -74,7 +74,7 @@ def test_lattice_covers_mesh_serve_and_update_axes():
     assert len(keys) == len(set(keys)), "duplicate cell keys"
     assert len(keys) >= 60
     legacy = [k for k in keys if "/" in k and "@" not in k
-              and not k.endswith("/masked-bucket")
+              and not k.endswith(("/masked-bucket", "/quarantine"))
               and not k.startswith(("serve/", "engine/"))]
     assert len(legacy) == 30
     for k in lattice.MESH_AXES:
@@ -88,6 +88,10 @@ def test_lattice_covers_mesh_serve_and_update_axes():
         assert f"{name}/masked-bucket" in keys
     assert "serve/bulyan/n16f2d32b2" in keys
     assert "serve/brute/n8f2d32b2+diag" in keys
+    # The r11 quarantine axis: the closed defense loop's per-rule
+    # defense-plus-aux program with the runtime mask/credit operands
+    for name in lattice.CELL_GARS:
+        assert f"{name}/quarantine" in keys
 
 
 def test_masked_bucket_cells_hold_h01_h02():
@@ -101,6 +105,20 @@ def test_masked_bucket_cells_hold_h01_h02():
         key, text, expect = lattice.lower_cell(cell)
         assert expect.psums == 0
         assert expect.gather_limit == lattice.N_BUCKET * lattice.D - 1
+        assert hlolint.lint_module(text, expect, key) == [], key
+
+
+def test_quarantine_cells_hold_h01_h02():
+    """The r11 quarantine call-site programs (`arena/quarantine.py` —
+    masked-quorum kernel + dynamic f_eff + suspicion aux, with the
+    active mask and the reclaimed-quorum credit as runtime operands):
+    zero collectives, no worker-matrix-scale gather — an eviction is a
+    bool flip over one program, structurally."""
+    for name in ("krum", "bulyan", "brute", "median"):
+        cell = next(c for c in lattice.enumerate_cells(meshes=(), serve=())
+                    if c.key == f"{name}/quarantine")
+        key, text, expect = lattice.lower_cell(cell)
+        assert expect.psums == 0
         assert hlolint.lint_module(text, expect, key) == [], key
 
 
@@ -274,15 +292,15 @@ def test_sharded_diag_aux_matches_unsharded(name, f):
     _aux_equal(aux_s, aux_u)
 
 
-@pytest.mark.parametrize("name", ["trmean", "phocas", "meamed"])
+@pytest.mark.parametrize("name", ["trmean", "phocas", "meamed", "median"])
 @pytest.mark.parametrize("f", [1, 2, 3])
 def test_sharded_coord_diag_aux_matches_unsharded(name, f):
-    """The r10 coordinate-wise sharded diagnostics (ROADMAP lattice rung
-    1): trmean/phocas/meamed trim fractions and deviation scores from
-    d-local partial sums psum'd with shard widths accounted — oracle
-    -tested against the unsharded NATIVE aux, with planted NaN rows and a
-    non-dividing d (divisibility padding must not dilute the per
-    -coordinate means)."""
+    """The coordinate-wise sharded diagnostics (r10 for the trim rules,
+    r11 for median's was-median fraction — ROADMAP lattice rung 3):
+    trim fractions and deviation scores from d-local partial sums psum'd
+    with shard widths accounted — oracle-tested against the unsharded
+    NATIVE aux, with planted NaN rows and a non-dividing d (divisibility
+    padding must not dilute the per-coordinate means)."""
     mesh = make_mesh(4, model_parallel=4)
     n, d = 4 * f + 4, 66  # 66 % 4 != 0: the facade pads two zero columns
     rng = np.random.default_rng(20 * f + len(name))
@@ -300,11 +318,13 @@ def test_sharded_coord_diag_aux_matches_unsharded(name, f):
 
 
 def test_sharded_diag_generic_fallback_for_coordinate_rules():
-    """Rules without a native sharded aux (median's was-median fraction
-    remains one) keep the generic geometry fallback."""
+    """Rules without a native sharded aux (average, since r11 the last
+    ones standing are the index-selection rules aksel/cge and average)
+    keep the generic geometry fallback; median — the former holdout —
+    now routes natively."""
     mesh = make_mesh(2, model_parallel=2)
     facade = shard_defense_list(
-        [(ops.gars["median"], 1.0, {})], mesh, f=2)[0][0]
+        [(ops.gars["average"], 1.0, {})], mesh, f=2)[0][0]
     assert facade._diag_fn is None
     g = jnp.asarray(np.random.default_rng(3).normal(
         size=(11, 16)).astype(np.float32))
@@ -312,8 +332,11 @@ def test_sharded_diag_generic_fallback_for_coordinate_rules():
     assert set(aux) == {"scores", "selection", "dist", "trim_frac"}
     np.testing.assert_allclose(
         np.asarray(agg),
-        np.asarray(ops.gars["median"].unchecked(g, f=2)),
+        np.asarray(ops.gars["average"].unchecked(g, f=2)),
         rtol=1e-4, atol=1e-5)
+    native = shard_defense_list(
+        [(ops.gars["median"], 1.0, {})], mesh, f=2)[0][0]
+    assert native._diag_fn is not None
 
 
 # --------------------------------------------------------------------------- #

@@ -178,6 +178,8 @@ def test_padded_nd_bucket_bit_identical_to_exact_cell(gar, f):
             p = p[:d]
         elif p.ndim == 1 and p.shape != e.shape:
             p = p[:n]
+        elif p.ndim == 2 and p.shape != e.shape:
+            p = p[:n, :n]  # the pairwise matrix of the real rows
         np.testing.assert_array_equal(
             np.nan_to_num(e, nan=7e9, posinf=8e9),
             np.nan_to_num(p, nan=7e9, posinf=8e9),
@@ -395,3 +397,135 @@ def test_loadgen_smoke_payload(tmp_path):
     assert compiles["distinct_cells"] < compiles["per_nd_policy_cells"]
     assert payload["cold_start"]["compiles"] > 0
     assert payload["cold_start"]["p99_ms"] >= payload["cold_start"]["p50_ms"]
+
+
+# --------------------------------------------------------------------------- #
+# Admission control (PR 11): verdicts gate rows at submit time
+
+class _StubStore:
+    """A verdict table standing in for the suspicion store in policy
+    unit tests."""
+
+    def __init__(self, verdicts):
+        self._verdicts = verdicts
+
+    def verdict(self, client):
+        return self._verdicts.get(client)
+
+
+def test_admission_policy_decisions_and_cap():
+    from byzantinemomentum_tpu.serve.admission import AdmissionPolicy
+
+    store = _StubStore({
+        "bad": {"suspicion": 0.8, "suspect": True, "observations": 20,
+                "collusion": 0.1},
+        "syb": {"suspicion": 0.2, "suspect": False, "observations": 5,
+                "collusion": 0.9},
+        "new": {"suspicion": 0.1, "suspect": False, "observations": 1,
+                "collusion": 0.0},
+    })
+    policy = AdmissionPolicy("mask")
+    ids = ("bad", "syb", "new", "unseen")
+    admitted, flagged = policy.decide(ids, store)
+    assert not admitted[0] and not admitted[1]
+    assert admitted[2] and admitted[3]
+    assert flagged["bad"]["reason"] == "suspect"
+    assert flagged["syb"]["reason"] == "collusion"
+    # The max_frac cap readmits the WEAKEST evidence first
+    capped = AdmissionPolicy("mask", max_frac=0.25)
+    admitted, flagged = capped.decide(ids, store)
+    assert int((~admitted).sum()) == 1
+    assert not admitted[1]  # collusion 0.9 is the strongest evidence
+    assert flagged["bad"]["action"] == "readmitted"
+    with pytest.raises(ValueError):
+        AdmissionPolicy("reject")
+
+
+def test_admission_downweight_blends_toward_admitted_mean():
+    from byzantinemomentum_tpu.serve.admission import AdmissionPolicy
+
+    policy = AdmissionPolicy("downweight", downweight=0.25)
+    matrix = np.stack([np.zeros(4, np.float32),
+                       np.zeros(4, np.float32),
+                       np.full(4, 8.0, np.float32)])
+    flagged = {"s0": {"reason": "collusion", "action": "downweight",
+                      "suspicion": 0.2, "collusion": 0.9}}
+    out = policy.apply(matrix, np.ones(3, bool), flagged,
+                       ("h0", "h1", "s0"))
+    np.testing.assert_allclose(out[2], np.full(4, 2.0))  # 0.25 * 8
+    np.testing.assert_array_equal(out[:2], matrix[:2])
+
+
+def test_diagnostics_cells_expose_the_distance_matrix():
+    program = _build(Cell("median", 8, 1, 32, True))
+    G = jnp.zeros((1, 8, 32), jnp.float32)
+    out = program(G, jnp.ones((1, 8), bool))
+    assert out["dist"].shape == (1, 8, 8)
+
+
+def test_store_collusion_channel_and_readonly_verdict():
+    from byzantinemomentum_tpu.serve.admission import ADMISSION_WEIGHTS
+
+    store = ClientSuspicionStore(weights=ADMISSION_WEIGHTS, min_obs=3,
+                                 alpha=0.2)
+    dist = np.full((4, 4), 10.0)
+    np.fill_diagonal(dist, np.inf)
+    dist[2, 3] = dist[3, 2] = 0.05
+    ids = ("h0", "h1", "s0", "s1")
+    for _ in range(6):
+        verdicts = store.observe(ids, np.ones(4), dist=dist)
+    assert verdicts["s0"]["collusion"] > 0.4
+    assert verdicts["h0"]["collusion"] == 0.0
+    # Same-client near-duplicates are NOT collusion evidence
+    solo = ClientSuspicionStore(weights=ADMISSION_WEIGHTS)
+    v = solo.observe(("h0", "h1", "same", "same"), np.ones(4), dist=dist)
+    assert v["same"]["collusion"] == 0.0
+    # The admission peek never advances observation counts
+    before = store.verdict("s0")["observations"]
+    store.verdict("s0")
+    assert store.verdict("s0")["observations"] == before
+    assert store.verdict("unknown") is None
+
+
+def test_admission_masks_suspects_and_counts(tmp_path):
+    """End-to-end: a client the store distrusts gets its rows masked out
+    (f_eff recomputes), the rejection counters tick, and the provenance
+    rides the response."""
+    with AggregationService(max_batch=1, max_delay_ms=0.5,
+                            suspicion={"alpha": 0.25},
+                            admission={"mode": "mask",
+                                       "collusion_min_obs": 2}) as svc:
+        rng = np.random.default_rng(0)
+        ids = tuple(f"h{i}" for i in range(6)) + ("s0", "s1")
+        result = None
+        for _ in range(8):
+            matrix = rng.standard_normal((8, 32)).astype(np.float32)
+            # s0/s1 submit the same vector: a cross-client duplicate
+            matrix[7] = matrix[6]
+            result = svc.aggregate(matrix, gar="median", f=2,
+                                   client_ids=ids, timeout=30.0)
+        assert result.admission and set(result.admission) == {"s0", "s1"}
+        assert all(a["action"] == "mask"
+                   for a in result.admission.values())
+        assert result.f_eff == 2  # 6 active rows keep the declared f
+        stats = svc.stats()
+        assert stats["admission"]["enabled"]
+        assert stats["admission"]["masked_rows"] >= 2
+
+
+def test_sybil_regression_pair():
+    """The Sybil split attack slips past per-client thresholds with
+    admission OFF (sustained aggregate shift, nobody suspect by the
+    blended per-client score alone crossing into masking) and is caught
+    with admission ON (tail shift collapses, every sybil id masked, no
+    honest collateral)."""
+    from byzantinemomentum_tpu.arena.sybil import run_sybil_cell
+
+    off = run_sybil_cell(gar="krum", admission=False, requests=18, seed=0)
+    on = run_sybil_cell(gar="krum", admission=True, requests=18, seed=0)
+    assert off["masked_rows_total"] == 0
+    assert off["agg_shift_tail"] > 1.0          # the attack lands
+    assert on["agg_shift_tail"] < off["agg_shift_tail"] / 2
+    assert on["detection_rate"] >= 0.8
+    assert on["honest_masked"] == 0 and on["honest_flagged"] == 0
+    assert on["masked_rows_total"] > 0

@@ -387,3 +387,37 @@ def test_appendix_names_group_with_reproduce_analyze():
                 "honests": 11 - f, "seed": "1"}
         base = reproduce._baseline_name(info)
         assert base.rsplit("-", 1)[0] in baselines, (name, base)
+
+
+def test_tournament_scoreboard_heatmap(tmp_path):
+    """The attack x GAR protection-ratio heatmap over a tournament
+    scoreboard artifact (`study.tournament_scoreboard`)."""
+    import json
+
+    from byzantinemomentum_tpu import utils
+
+    cells = []
+    for gar in ("krum", "median"):
+        for attack in ("alie", "framing"):
+            for quarantine, err in ((True, 0.5), (False, 1.5)):
+                cells.append({"gar": gar, "attack": attack,
+                              "quarantine": quarantine,
+                              "agg_err_last10": err})
+    artifact = tmp_path / "TOURNAMENT_r99.json"
+    artifact.write_text(json.dumps(
+        {"kind": "tournament", "train_cells": cells}))
+    matrix, attacks, gars, plot = study.tournament_scoreboard(artifact)
+    try:
+        assert attacks == ["alie", "framing"] and gars == ["krum", "median"]
+        np.testing.assert_allclose(matrix, 3.0)  # off/on = 1.5/0.5
+        out = tmp_path / "scoreboard.png"
+        plot.save(out)
+        assert out.stat().st_size > 0
+    finally:
+        plot.close()
+    with pytest.raises(utils.UserException):
+        study.tournament_scoreboard(tmp_path / "missing.json")
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text("{}")
+    with pytest.raises(utils.UserException):
+        study.tournament_scoreboard(bogus)
