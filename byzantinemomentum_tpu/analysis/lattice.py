@@ -43,9 +43,10 @@ import dataclasses
 from byzantinemomentum_tpu.analysis import hlolint
 
 __all__ = ["CELL_GARS", "VARIANTS", "MESH_AXES", "MESH_VARIANTS",
-           "SERVE_CELLS", "GRAM_RULES", "COORD_DIAG_RULES",
-           "COORD_DIAG_PSUMS", "N", "N_BUCKET", "D", "F", "LatticeCell",
-           "enumerate_cells", "lower_cell", "spec_info"]
+           "MULTIPROC_GARS", "SERVE_CELLS", "GRAM_RULES",
+           "COORD_DIAG_RULES", "COORD_DIAG_PSUMS", "N", "N_BUCKET", "D",
+           "F", "LatticeCell", "enumerate_cells", "lower_cell",
+           "multiprocess_cells", "spec_info"]
 
 # Every first-tier registered rule with real kernels (the `native-` tier
 # shares these kernels; `template` declines its own check)
@@ -100,11 +101,18 @@ N_BUCKET = 16
 @dataclasses.dataclass(frozen=True)
 class LatticeCell:
     """One golden cell: a stable key, a builder of `(fn, avals)`, and the
-    structural contract its lowered text must satisfy."""
+    structural contract its lowered text must satisfy.
+
+    `pin=False` marks a STRUCTURAL-ONLY cell: its lowering is linted
+    against `expect` on every check but its fingerprint is never blessed
+    — the contract for programs whose bytes legitimately churn (the full
+    fused step re-lowers with every engine change) but whose collective
+    census must not."""
 
     key: str
     build: object   # () -> (traceable fn, tuple of ShapeDtypeStructs)
     expect: hlolint.Expect
+    pin: bool = True
 
     def lower(self):
         """The cell's StableHLO text (lowered on abstract values only).
@@ -284,6 +292,119 @@ def _update_cell():
         expect=hlolint.Expect(psums=0, donated=(1,)))
 
 
+def _full_step_cell():
+    """STRUCTURAL-ONLY coverage of the FULL fused multi-chip step — the
+    workers-axis `shard_map` of the grouped honest phase
+    (`engine/step.py::_workers_grad_grouped_sharded`) composed with the
+    d-sharded defense kernels, exactly what a `--mesh WxM` run compiles.
+
+    The cell's fingerprint is deliberately NOT pinned (`pin=False`): the
+    whole-step bytes churn with every engine change and re-blessing them
+    per PR would be noise. What must NOT churn is the communication
+    pattern, and that is what the BMT-H contract pins: exactly ONE
+    explicit collective (krum's psum'd distance Gram — the grouped
+    honest phase's shard_map is collective-free, worker rows are data
+    parallel) and NO explicit worker-matrix all_gather (H02; the
+    jit-propagated resharding at the shard_map boundaries never
+    materializes the (n, d) matrix in the traced program).
+    """
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from byzantinemomentum_tpu import attacks, losses, models, ops
+        from byzantinemomentum_tpu.engine import (
+            EngineConfig, build_engine)
+        from byzantinemomentum_tpu.parallel import sharded_train_step
+        from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS
+
+        if len(jax.devices()) < 4:
+            raise RuntimeError(
+                "the full-step structural cell needs a (2, 2) virtual "
+                "mesh — set XLA_FLAGS="
+                "--xla_force_host_platform_device_count>=4 (the analysis "
+                "CLI and bless script do this themselves)")
+        mesh = jax.make_mesh((2, 2), (WORKERS, MODEL))
+        cfg = EngineConfig(
+            nb_workers=5, nb_decl_byz=1, nb_real_byz=1, nb_for_study=0,
+            nb_for_study_past=1, momentum=0.9)
+        engine = build_engine(
+            cfg=cfg, model_def=models.build("simples-full"),
+            loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+            defenses=[(ops.gars["krum"], 1.0, {})],
+            attack=attacks.attacks["empire"],
+            attack_kwargs={"factor": 1.1})
+        state = engine.init(jax.random.PRNGKey(0))
+        fn = sharded_train_step(engine, mesh, state)
+        S, B = cfg.nb_sampled, 4
+        xs = jax.ShapeDtypeStruct((S, B, 28, 28, 1), jnp.float32)
+        ys = jax.ShapeDtypeStruct((S, B), jnp.int32)
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        return fn, (state, xs, ys, lr)
+
+    return LatticeCell(
+        key="engine/full-step@mesh2x2", build=build,
+        expect=hlolint.Expect(psums=1, gather_limit=N * D - 1),
+        pin=False)
+
+
+# GARs whose multi-process cells the cluster census lowers: the two
+# Gram-psum rules prove the cross-host (n, n) reduction, the two
+# coordinate-wise rules prove zero-communication d-sharding across hosts
+MULTIPROC_GARS = ("krum", "bulyan", "median", "average")
+
+
+def multiprocess_cells(gars=MULTIPROC_GARS, *, min_processes=2):
+    """Cells over a LIVE multi-process backend (`jax.distributed`): the
+    d-sharded defense kernels rebuilt on a (workers=1, model=P) mesh
+    spanning every process's devices, so the selection rules' Gram psum
+    is a REAL cross-host collective. Keys: `<gar>/plain@proc<P>`.
+
+    These cells cannot be blessed by the single-process CLIs (no fleet in
+    the lint tier); instead every host of a cluster run lowers them,
+    census-checks them, and writes its fingerprints for the launcher's
+    cross-host agreement check (`cluster/host.py::_run_census`) — same
+    census/fingerprint treatment, consensus instead of a committed file.
+
+    `min_processes` guards against silently degrading to a single-process
+    mesh (tests that only need the builder shape pass 1).
+    """
+    import jax
+    import numpy as np
+
+    from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS
+    from jax.sharding import Mesh
+
+    procs = jax.process_count()
+    if procs < min_processes:
+        raise RuntimeError(
+            f"multiprocess cells need a >= {min_processes}-process "
+            f"fleet (jax.distributed), found {procs} — launch through "
+            f"byzantinemomentum_tpu.cluster")
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices.reshape(1, devices.size), (WORKERS, MODEL))
+
+    def cell(name):
+        def build():
+            from byzantinemomentum_tpu import ops
+            from byzantinemomentum_tpu.engine import program
+
+            facade = program.shard_axis(
+                [(ops.gars[name], 1.0, {})], mesh, f=F)[0][0]
+            return (program.defense_kernel(facade, "plain", f=F),
+                    _avals("plain"))
+
+        return LatticeCell(
+            key=f"{name}/plain@proc{procs}", build=build,
+            expect=hlolint.Expect(
+                psums=1 if name in GRAM_RULES else 0,
+                gather_limit=N * D - 1),
+            pin=False)
+
+    return [cell(name) for name in gars]
+
+
 def enumerate_cells(gars=None, variants=None, meshes=None, serve=None):
     """The full lattice, as `LatticeCell`s (defaults read the module
     attributes at call time, so tests can shrink the grid)."""
@@ -313,8 +434,11 @@ def enumerate_cells(gars=None, variants=None, meshes=None, serve=None):
         cells.append(_serve_cell(*spec))
     if serve:
         # The update-axis donation contract rides with the default grid
-        # (shrunken test grids that drop the serve axis drop it too)
+        # (shrunken test grids that drop the serve axis drop it too),
+        # as does the structural-only full-step cell (linted every
+        # check, never fingerprinted — see `_full_step_cell`)
         cells.append(_update_cell())
+        cells.append(_full_step_cell())
     return cells
 
 
@@ -327,4 +451,6 @@ def spec_info():
     """The enumeration coordinates recorded next to the fingerprints."""
     return {"n": N, "n_bucket": N_BUCKET, "d": D, "f": F,
             "meshes": [int(k) for k in MESH_AXES],
-            "serve_cells": len(SERVE_CELLS)}
+            "serve_cells": len(SERVE_CELLS),
+            "structural_cells": sum(1 for c in enumerate_cells()
+                                    if not c.pin)}

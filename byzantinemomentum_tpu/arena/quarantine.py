@@ -31,9 +31,11 @@ getting honest workers evicted):
                         the stacked matrix, and a mimicry-framed victim's
                         row is byte-identical to its copies anyway, so
                         the kept representative preserves the victim's
-                        information regardless). Keeping one member also
-                        keeps the dedup sound for an honest pair that
-                        briefly collides.
+                        information regardless; the analysis is now
+                        FIELDED as `attacks/mimic.py` and pinned by the
+                        tournament's zero-honest-eviction regression).
+                        Keeping one member also keeps the dedup sound
+                        for an honest pair that briefly collides.
   budget                at most `max_evictions` workers (default: the
                         declared f) are ever out at once — the hard cap
                         on the blast radius of ANY policy failure.

@@ -42,6 +42,11 @@ __all__ = ["ADAPTIVE_ATTACKS", "SELECTION_GARS", "train_roster",
 # The adaptive half of the red team — the attacks that read the defense
 # (the acceptance's dominance digest quantifies quarantine against
 # these; the label below must match the roster's cell labels).
+# `mimic` (attacker byte-copies a victim's row, `attacks/mimic.py`)
+# rides the grid through the registry but stays OFF this list: its rows
+# are honest-valued, so it never biases the aggregate — its acceptance
+# metric is the zero-honest-eviction regression (dedup keeps the
+# victim), not agg-error dominance.
 ADAPTIVE_ATTACKS = ("alie", "alie-warmup", "framing", "alie+noniid")
 
 # Selection-family GARs (the rules whose per-row choices the suspicion

@@ -1,0 +1,69 @@
+"""Mimicry attack — the attacker BYTE-COPIES a victim's row.
+
+Analyzed in `arena/quarantine.py` (PR 11) and now fielded: every
+Byzantine row submits an exact copy of honest worker `victim`'s fresh
+gradient. The submission is perfectly in-envelope — no GAR can reject it
+on geometry (it IS an honest gradient) — so the attack pressure is
+entirely on the TRUST machinery:
+
+* the duplicated mass biases mean-family rules toward the victim's draw
+  and hands selection-family rules a self-certifying cluster (f_real + 1
+  identical rows out-vote genuine neighborhoods in Krum-style scoring);
+* the collusion detector sees a near-duplicate cluster CONTAINING THE
+  VICTIM — a framing vector: naive dedup that evicts whole clusters
+  would evict an honest worker on the attacker's schedule.
+
+The quarantine policy's answer (the contract `tests/test_arena.py` pins
+as the tournament regression): cluster dedup keeps the lowest-collusion
+member with ties to the LOWEST ROW INDEX — honest rows precede attack
+rows in the stacked matrix, and a mimicry victim's row is byte-identical
+to its copies anyway, so the kept representative preserves the victim's
+information regardless. The copies are evicted (collusion channel,
+quorum reclaimed), the victim never is: zero honest evictions.
+
+`jitter` (fraction of the honest std, like `framing`) blurs the copies to
+probe the collusion detector's near-duplicate threshold — the crossover
+knob of the arms-race rung (ROADMAP arena item).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from byzantinemomentum_tpu.attacks import empty_byzantine, register
+
+__all__ = ["attack"]
+
+
+def attack(grad_honests, f_decl, f_real, defense, victim=0, jitter=0.0,
+           **kwargs):
+    """f_real byte-copies of honest row `victim` (optionally jittered)."""
+    if f_real == 0:
+        return empty_byzantine(grad_honests)
+    rows = jnp.tile(grad_honests[victim][None, :], (f_real, 1))
+    if jitter:
+        from byzantinemomentum_tpu.attacks import alie as alie_mod
+
+        h = grad_honests.shape[0]
+        sigma = (jnp.sqrt(jnp.var(grad_honests, axis=0, ddof=1)) if h > 1
+                 else jnp.zeros_like(rows[0]))
+        noise = jax.random.normal(alie_mod._row_key(grad_honests),
+                                  rows.shape, dtype=rows.dtype)
+        rows = rows + float(jitter) * sigma[None, :] * noise
+    return rows
+
+
+def check(grad_honests, f_real, defense, victim=0, jitter=0.0, **kwargs):
+    if grad_honests.shape[0] == 0:
+        return "Expected a non-empty list of honest gradients"
+    if not isinstance(f_real, int) or f_real < 0:
+        return (f"Expected a non-negative number of Byzantine gradients "
+                f"to generate, got {f_real!r}")
+    if not isinstance(victim, int) or not (
+            0 <= victim < grad_honests.shape[0]):
+        return (f"Expected a victim index within the "
+                f"{grad_honests.shape[0]} honest rows, got {victim!r}")
+    if not isinstance(jitter, (int, float)) or jitter < 0:
+        return f"Expected a non-negative jitter fraction, got {jitter!r}"
+
+
+register("mimic", attack, check)

@@ -28,6 +28,13 @@ Crash safety (preemptible-slice hardening, PR 2):
   newest N) and persists the run's restart counter across preemptions. The
   manifest is advisory: resume scans the directory, so a kill between the
   checkpoint rename and the manifest update loses nothing.
+* `save(..., mirror=dir)` additionally lands the SAME sealed bytes in a
+  second directory with the same atomic protocol — the off-slice mirror of
+  a multi-host run (`byzantinemomentum_tpu/cluster/`): when a host dies
+  and takes its local disk with it, the fleet resumes from the mirror and
+  losing the local copy costs nothing. `find_latest_valid_any(dirs)` scans
+  several directories (local + mirror) and returns the globally newest
+  valid checkpoint.
 """
 
 import json
@@ -49,8 +56,8 @@ from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.obs import recorder as obs
 
 __all__ = ["VERSION", "MAGIC", "MANIFEST_NAME", "save", "load", "seal",
-           "verify", "find_latest_valid", "checkpoint_step",
-           "read_manifest", "bump_restarts"]
+           "verify", "find_latest_valid", "find_latest_valid_any",
+           "checkpoint_step", "read_manifest", "bump_restarts"]
 
 # Must be unique and incremented on every incompatible layout change
 # (reference `attack.py:622` — the reference is at version 4; this framework
@@ -119,7 +126,7 @@ def _chaos_torn_write(path, data, step):
     os._exit(137)
 
 
-def save(path, state, *, data_state=None, keep=None):
+def save(path, state, *, data_state=None, keep=None, mirror=None):
     """Serialize `state` to `path` (reference `Checkpoint.save`,
     `experiments/checkpoint.py:134-148`) — atomically, with the integrity
     footer, and registered in the run's manifest.
@@ -131,6 +138,13 @@ def save(path, state, *, data_state=None, keep=None):
 
     `keep`: retention — after a successful save, delete this run's oldest
     checkpoints beyond the newest `keep` (None/0 keeps everything).
+
+    `mirror`: optional second directory receiving the same sealed bytes
+    under the same file name with the same atomic protocol — the off-slice
+    replica a multi-host resume survives local-disk loss through. The
+    primary write commits first; a kill between the two leaves the mirror
+    one checkpoint behind, which the multi-directory resume scan
+    (`find_latest_valid_any`) absorbs.
     """
     state = jax.device_get(state)
     path = pathlib.Path(path)
@@ -145,15 +159,27 @@ def save(path, state, *, data_state=None, keep=None):
             payload["data"] = data_state
         data = seal(serialization.msgpack_serialize(payload))
         _chaos_torn_write(path, data, step)
-        tmp = path.with_name(path.name + ".tmp")
-        with tmp.open("wb") as fd:
-            fd.write(data)
-            fd.flush()
-            os.fsync(fd.fileno())
-        os.replace(tmp, path)
-        _fsync_directory(path.parent)
+        _atomic_write(path, data)
         _manifest_add(path.parent, path.name, step, len(data), keep=keep)
+        if mirror is not None:
+            mirror = pathlib.Path(mirror)
+            mirror.mkdir(parents=True, exist_ok=True)
+            _atomic_write(mirror / path.name, data)
+            _manifest_add(mirror, path.name, step, len(data), keep=keep)
+            obs.emit("checkpoint_mirrored", file=path.name, step=step)
     return path
+
+
+def _atomic_write(path, data):
+    """tmp + fsync + `os.replace` + best-effort directory fsync — the
+    crash-safe write every checkpoint copy (primary and mirror) uses."""
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("wb") as fd:
+        fd.write(data)
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    _fsync_directory(path.parent)
 
 
 def load(path, template, *, return_data=False):
@@ -270,6 +296,27 @@ def find_latest_valid(directory, prefix="checkpoint-"):
         utils.warning(f"Skipping torn/corrupt checkpoint {entry.name}")
         obs.emit("checkpoint_invalid", file=entry.name)
     return None
+
+
+def find_latest_valid_any(directories, prefix="checkpoint-"):
+    """The globally newest valid checkpoint across several directories
+    (e.g. a run's local directory plus its off-slice mirror): the
+    candidate with the highest step wins; a tie keeps the earlier
+    directory's copy (the primary). Directories that do not exist simply
+    contribute nothing."""
+    best = None
+    best_step = -1
+    for directory in directories:
+        if directory is None:
+            continue
+        found = find_latest_valid(directory, prefix=prefix)
+        if found is None:
+            continue
+        step = checkpoint_step(found)
+        step = -1 if step is None else step
+        if step > best_step:
+            best, best_step = found, step
+    return best
 
 
 # ------------------------------------------------------------------------- #

@@ -223,6 +223,13 @@ def process_commandline(argv=None):
     add("--keep-checkpoints", type=int, default=0,
         help="Retention: keep only this run's newest N checkpoints "
              "(manifest-driven GC at save time), 0 to keep all")
+    add("--checkpoint-mirror", type=str, default=None,
+        help="Off-slice checkpoint mirror: every checkpoint is also "
+             "written (atomically, same integrity footer) into this "
+             "second directory, and '--auto-resume' scans BOTH "
+             "directories for the newest valid checkpoint — so losing "
+             "the run's local storage (a dead host in a multi-host "
+             "fleet, a preempted slice's scratch disk) costs nothing")
     add("--rollback-budget", type=int, default=0,
         help="Divergence rollback: when the training state goes non-finite "
              "mid-run, restore the last good checkpoint, re-seed the step "
@@ -354,6 +361,10 @@ def _postprocess(args):
     if args.keep_checkpoints < 0:
         utils.fatal(f"Invalid arguments: negative checkpoint retention "
                     f"{args.keep_checkpoints}")
+    if args.checkpoint_mirror is not None and args.result_directory is None:
+        utils.warning("'--checkpoint-mirror' needs '--result-directory' "
+                      "(there is no primary to mirror); mirror disabled")
+        args.checkpoint_mirror = None
     if args.telemetry and args.no_telemetry:
         utils.fatal("Invalid arguments: '--telemetry' and '--no-telemetry' "
                     "are mutually exclusive")
@@ -768,8 +779,21 @@ def main(argv=None):
                 args.checkpoint_delta = 0
             else:
                 args.result_directory = resdir
+                if args.checkpoint_mirror is not None:
+                    mirror_dir = pathlib.Path(args.checkpoint_mirror).resolve()
+                    try:
+                        mirror_dir.mkdir(mode=0o755, parents=True,
+                                         exist_ok=True)
+                    except OSError as err:
+                        utils.warning(f"Unable to create the checkpoint "
+                                      f"mirror {str(mirror_dir)!r} ({err}); "
+                                      f"mirror disabled")
+                        args.checkpoint_mirror = None
+                    else:
+                        args.checkpoint_mirror = mirror_dir
                 if args.auto_resume:
-                    found = checkpoint_mod.find_latest_valid(resdir)
+                    found = checkpoint_mod.find_latest_valid_any(
+                        (resdir, args.checkpoint_mirror))
                     if found is None:
                         utils.info("Auto-resume: no valid checkpoint in "
                                    f"{str(resdir)!r}; cold start")
@@ -1147,7 +1171,8 @@ def main(argv=None):
                             f"({args.rollback_budget}) is exhausted; "
                             f"giving up")
                 return False
-            found = checkpoint_mod.find_latest_valid(args.result_directory)
+            found = checkpoint_mod.find_latest_valid_any(
+                (args.result_directory, args.checkpoint_mirror))
             if found is None:
                 utils.error("Non-finite training state and no valid "
                             "checkpoint to roll back to; giving up")
@@ -1267,7 +1292,8 @@ def main(argv=None):
                     try:
                         checkpoint_mod.save(filename, state,
                                             data_state=data_snapshot,
-                                            keep=args.keep_checkpoints or None)
+                                            keep=args.keep_checkpoints or None,
+                                            mirror=args.checkpoint_mirror)
                     except Exception as err:  # bmt: noqa[BMT-E05] a failed save (disk full, serialization) must not kill training; the next milestone retries
                         utils.warning(f"Checkpoint save failed: {err}")
                 just_loaded = False

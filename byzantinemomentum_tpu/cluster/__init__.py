@@ -1,0 +1,63 @@
+"""Multi-host cluster runtime: true multi-controller execution,
+system-level chaos, and coordinated recovery.
+
+Every resilience layer before this package — PR 1 fault injection, PR 2
+resume/rollback, PR 3 heartbeats, PR 11 quarantine — ran inside ONE
+process: "device loss" was a masked row, never lost hardware. Here the
+fleet is real processes:
+
+  runtime.py    `jax.distributed` per-host initialization over a local
+                TCP coordinator (CPU-provable in CI via the gloo
+                collectives; `BMT_CLUSTER_NATIVE=1` re-enables a real
+                accelerator fleet), with every bind/connect bounded —
+                unavailability is a clean exit code and artifact, never
+                an rc=124 hang.
+  host.py       one controller of the fleet: the mesh-sharded engine step
+                over the global (workers, model) mesh — real cross-host
+                collectives — deterministic cross-host sampling, per-host
+                atomic heartbeats, local + off-slice-mirrored
+                checkpoints, and a study CSV whose killed-and-resumed
+                output is bit-identical to an uninterrupted run's.
+  manifest.py   the per-run consensus artifact (`cluster.json`, single
+                writer) and the heartbeat-aggregated cluster liveness
+                view — the Ray-style split (PAPERS.md) between a central
+                liveness record and per-host state ownership.
+  chaos.py      the system-level `FaultPlan` driver: `device_loss`
+                events SIGKILL real host processes, fire-once through
+                the manifest so recovery replays training, not the kill.
+  launcher.py   the fleet supervisor tying it together: spawn, liveness,
+                chaos, teardown-on-host-death, restart-step agreement,
+                relaunch with `--auto-resume`, and the `CLUSTER.json`
+                outcome artifact. Supervisable itself by `utils/jobs.py`
+                through the aggregated heartbeat (the seedless
+                service-job form).
+
+Entry point: `python -m byzantinemomentum_tpu.cluster --hosts N ...`.
+"""
+
+from byzantinemomentum_tpu.cluster.chaos import SystemFaultDriver
+from byzantinemomentum_tpu.cluster.manifest import (
+    CLUSTER_MANIFEST_NAME,
+    agree_restart_step,
+    liveness_view,
+    read_cluster_manifest,
+    update_cluster_manifest,
+    write_cluster_manifest,
+)
+from byzantinemomentum_tpu.cluster.runtime import (
+    UNAVAILABLE_RC,
+    ClusterUnavailable,
+    HostSpec,
+    cluster_mesh,
+    free_port,
+    initialize,
+    shutdown,
+)
+
+__all__ = [
+    "CLUSTER_MANIFEST_NAME", "ClusterUnavailable", "HostSpec",
+    "SystemFaultDriver", "UNAVAILABLE_RC", "agree_restart_step",
+    "cluster_mesh", "free_port", "initialize", "liveness_view",
+    "read_cluster_manifest", "shutdown", "update_cluster_manifest",
+    "write_cluster_manifest",
+]

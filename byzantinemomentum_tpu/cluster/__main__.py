@@ -1,0 +1,9 @@
+"""`python -m byzantinemomentum_tpu.cluster` — the fleet launcher CLI
+(`cluster/launcher.py`)."""
+
+import sys
+
+from byzantinemomentum_tpu.cluster.launcher import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""System-level chaos: the `FaultPlan` pointed at the FLEET.
+
+PR 1's fault plans inject masked rows inside the jitted step — "device
+loss" there is arithmetic. Here the SAME declarative artifact drives real
+process destruction: at system scope an event's `worker` indexes a HOST of
+the multi-controller fleet, and `device_loss` means the launcher SIGKILLs
+that host's process the first time the cluster's observed step reaches
+`event.step`. Only `faults.plan.SYSTEM_KINDS` are legal at this scope
+(`FaultPlan.validate_system`).
+
+Fire-once discipline: recovery REPLAYS training steps (the fleet resumes
+below the kill step and passes it again), so a naively re-armed plan would
+kill the fleet forever. The launcher persists each fired event's index in
+the cluster manifest BEFORE sending the signal; a relaunched fleet (same
+launcher retry loop, or a whole new launcher process under the Jobs
+supervisor) rebuilds the driver with `fired=manifest["fired_faults"]` and
+never re-injects. The plan stays deterministic data — `(plan, manifest)`
+fully determine what has been and will be injected.
+"""
+
+__all__ = ["SystemFaultDriver"]
+
+
+class SystemFaultDriver:
+    """Interprets a `FaultPlan` at host scope for the cluster launcher.
+
+    The launcher polls `due(step)` with the fleet's observed max step and
+    SIGKILLs the returned hosts, calling `mark(index)` (and persisting the
+    manifest) BEFORE each signal.
+    """
+
+    def __init__(self, plan, nb_hosts, *, fired=()):
+        message = plan.validate_system(nb_hosts)
+        if message is not None:
+            raise ValueError(f"fault plan cannot run at system scope: "
+                             f"{message}")
+        self.plan = plan
+        self.nb_hosts = int(nb_hosts)
+        self._fired = set(int(i) for i in fired)
+
+    def due(self, step):
+        """`[(index, event)]` not yet fired whose step has been reached
+        (None step — no host heartbeat yet — never fires anything)."""
+        if step is None:
+            return []
+        return [(i, e) for i, e in enumerate(self.plan.events)
+                if i not in self._fired and step >= e.step]
+
+    def mark(self, index):
+        """Record event `index` as injected (idempotent)."""
+        self._fired.add(int(index))
+
+    def fired(self):
+        """Sorted fired-event indices — what the manifest persists."""
+        return sorted(self._fired)
+
+    def exhausted(self):
+        """Whether every scheduled event has been injected (the launcher
+        only declares a chaos run clean once the plan is spent)."""
+        return len(self._fired) >= len(self.plan.events)

@@ -1,0 +1,369 @@
+"""One host of the multi-controller fleet.
+
+`python -m byzantinemomentum_tpu.cluster.host --procs N --proc-id I ...`
+joins the `jax.distributed` fleet (`cluster/runtime.py`), builds the SAME
+engine every other host builds, and drives the mesh-sharded training step
+(`parallel/sharded.py::sharded_train_step` over the global
+`(workers=N_devices, model=1)` mesh) — so every step's honest phase is
+data-parallel across hosts and the aggregation's gathers/psums are real
+cross-host collectives, not a masked row in a simulator.
+
+Multi-controller discipline (the determinism contract everything else
+stands on):
+
+* every host seeds numpy/jax identically and constructs the same host
+  dataset samplers, so all hosts sample byte-identical `(S, B, ...)`
+  batches each step and `parallel.global_batch` materializes only this
+  process's workers-axis shard of them;
+* the training state is fully replicated (`cluster_mesh` pins
+  model_parallel=1), so ANY host can read metrics/state —
+  host 0 writes the study CSV and the checkpoints, every host writes its
+  own atomic `hosts/host-<i>.heartbeat.json` liveness signal;
+* checkpoints land in the host's LOCAL directory (`host-<i>/`, the
+  stand-in for slice-local disk) and host 0 additionally mirrors them
+  off-slice (`checkpoint.save(mirror=...)`); resume NEVER reads local
+  copies — the launcher agrees the restart step via the cluster manifest
+  (`cluster/manifest.py::agree_restart_step`, mirror-only) and every
+  host loads the mirror's copy, validates it, and reports the adopted
+  step in its first heartbeat for the launcher's unanimity check.
+
+The study CSV follows the driver's exact semantics (`cli/attack.py`'s
+`_ResultFiles`, reused): on resume the rows at or past the restart step
+are truncated and regenerated, so a killed-and-resumed fleet's CSV is
+bit-identical to an uninterrupted fleet's (`tests/test_cluster.py`,
+`scripts/cluster_smoke.py`).
+
+Contract hooks ridden by the cluster tier: `--recompile-check` asserts a
+ZERO-compile warm loop on the multi-process step
+(`analysis/contracts.py::count_compiles`), `--lattice-census` lowers the
+multi-process lattice cells (`analysis/lattice.py::multiprocess_cells`)
+and writes each host's fingerprints + BMT-H census to
+`hosts/host-<i>.census.json` — the launcher requires the fingerprints to
+agree across hosts (consensus on the PROGRAM, not just the state).
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+
+__all__ = ["main", "process_commandline", "UNAVAILABLE_RC"]
+
+from byzantinemomentum_tpu.cluster.runtime import UNAVAILABLE_RC
+
+# Exit code for "the manifest's restart step and the mirror disagree" —
+# a consensus violation, distinct from unavailability and training faults
+DISAGREE_RC = 21
+
+
+def process_commandline(argv=None):
+    parser = argparse.ArgumentParser(prog="cluster-host")
+    add = parser.add_argument
+    add("--procs", type=int, required=True, help="Fleet size")
+    add("--proc-id", type=int, required=True, help="This host's index")
+    add("--coordinator", type=str, required=True,
+        help="host:port of the jax.distributed coordinator (host 0 binds)")
+    add("--connect-timeout", type=float, default=60.0,
+        help="Bounded seconds for the coordinator bind/connect handshake")
+    add("--result-directory", type=str, required=True)
+    add("--mirror", type=str, required=True,
+        help="Off-slice checkpoint mirror directory (the consensus copy)")
+    add("--auto-resume", action="store_true", default=False,
+        help="Adopt the cluster manifest's restart_step (cold start when "
+             "the manifest names none)")
+    add("--parent-pipe", action="store_true", default=False,
+        help="Exit when stdin reaches EOF (the launcher holds the write "
+             "end: a dead launcher must never leak a training fleet)")
+    add("--nb-steps", type=int, default=8,
+        help="TOTAL steps from step 0 (resumed fleets stop where an "
+             "uninterrupted one would)")
+    add("--seed", type=int, default=1)
+    add("--nb-workers", type=int, default=8)
+    add("--nb-decl-byz", type=int, default=2)
+    add("--nb-real-byz", type=int, default=2)
+    add("--gar", type=str, default="median")
+    add("--attack", type=str, default="empire")
+    add("--attack-args", nargs="*")
+    add("--model", type=str, default="simples-full")
+    add("--dataset", type=str, default="mnist")
+    add("--batch-size", type=int, default=8)
+    add("--nb-for-study", type=int, default=8)
+    add("--nb-for-study-past", type=int, default=2)
+    add("--learning-rate", type=float, default=0.05)
+    add("--momentum", type=float, default=0.9)
+    add("--checkpoint-delta", type=int, default=2)
+    add("--recompile-check", type=int, default=0,
+        help="Assert ZERO backend compiles across this many warm steps "
+             "of the multi-process program (0 disables)")
+    add("--lattice-census", action="store_true", default=False,
+        help="Lower the multi-process lattice cells and write this "
+             "host's fingerprint + BMT-H census artifact")
+    return parser.parse_args(sys.argv[1:] if argv is None else argv)
+
+
+def _watch_parent():
+    """Die when the launcher does: the launcher holds this process's
+    stdin pipe exclusively, so launcher death (any signal, any crash)
+    closes it and the read returns EOF. SIGKILL leaves no other channel —
+    an orphaned fleet would hold the coordinator port and the result
+    directory forever."""
+    def watch():
+        # Raw os.read, NOT sys.stdin.buffer: a daemon thread blocked in
+        # the buffered reader holds its lock across interpreter shutdown
+        # and aborts an otherwise-clean exit ("_enter_buffered_busy")
+        try:
+            while os.read(0, 4096):
+                pass
+        except OSError:
+            pass
+        os._exit(3)
+
+    threading.Thread(target=watch, name="parent-watch", daemon=True).start()
+
+
+def _run_census(resdir, proc_id):
+    """Lower the multi-process cells, lint them, and write this host's
+    census artifact. Every host lowers the SAME cells — the launcher's
+    cross-host fingerprint comparison is the consensus check that all
+    controllers are about to run the same programs."""
+    import jax
+
+    from byzantinemomentum_tpu.analysis import hlolint, lattice, lowering
+
+    cells = {}
+    violations = 0
+    for cell in lattice.multiprocess_cells():
+        key, text, expect = lattice.lower_cell(cell)
+        found = hlolint.lint_module(text, expect, label=key)
+        cells[key] = {
+            "fingerprint": lowering.fingerprint(text),
+            "violations": [v.as_dict() for v in found],
+        }
+        violations += len(found)
+    artifact = {"host": proc_id, "processes": jax.process_count(),
+                "cells": cells, "violations": violations}
+    path = (pathlib.Path(resdir) / "hosts"
+            / f"host-{proc_id}.census.json")
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(artifact, indent="\t", sort_keys=True)
+                    + "\n")
+    return artifact
+
+
+def main(argv=None):
+    args = process_commandline(argv)
+    if args.parent_pipe:
+        _watch_parent()
+
+    from byzantinemomentum_tpu.cluster import manifest as manifest_mod
+    from byzantinemomentum_tpu.cluster import runtime
+
+    spec = runtime.HostSpec(
+        coordinator=args.coordinator, num_processes=args.procs,
+        process_id=args.proc_id, connect_timeout=args.connect_timeout)
+    try:
+        runtime.initialize(spec)
+    except runtime.ClusterUnavailable as err:
+        print(f"cluster-host: unavailable: {err}", flush=True)
+        return UNAVAILABLE_RC
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from byzantinemomentum_tpu import attacks as attacks_mod
+    from byzantinemomentum_tpu import checkpoint as checkpoint_mod
+    from byzantinemomentum_tpu import data as data_mod
+    from byzantinemomentum_tpu import losses as losses_mod
+    from byzantinemomentum_tpu import models as models_mod
+    from byzantinemomentum_tpu import ops as ops_mod
+    from byzantinemomentum_tpu.cli.attack import _ResultFiles
+    from byzantinemomentum_tpu.engine import (
+        STUDY_COLUMNS, EngineConfig, build_engine)
+    from byzantinemomentum_tpu.obs.heartbeat import write_host_heartbeat
+    from byzantinemomentum_tpu.parallel import (
+        global_batch, global_train_state, sharded_train_step)
+
+    proc = args.proc_id
+    lead = proc == 0
+    resdir = pathlib.Path(args.result_directory).resolve()
+    mirror = pathlib.Path(args.mirror).resolve()
+    local_dir = resdir / f"host-{proc}"
+    local_dir.mkdir(parents=True, exist_ok=True)
+    if lead:
+        mirror.mkdir(parents=True, exist_ok=True)
+
+    mesh = runtime.cluster_mesh()
+    workers_ax = mesh.shape["workers"]
+
+    # --- the same deterministic setup on every host --- #
+    seed = max(args.seed, 0)
+    np.random.seed(seed % 2**32)
+    trainset, testset = data_mod.make_datasets(
+        args.dataset, args.batch_size, args.batch_size,
+        seed=seed % 2**32)
+    from byzantinemomentum_tpu import utils as utils_mod
+    attack = attacks_mod.attacks[args.attack]
+    cfg = EngineConfig(
+        nb_workers=args.nb_workers, nb_decl_byz=args.nb_decl_byz,
+        nb_real_byz=args.nb_real_byz, nb_for_study=args.nb_for_study,
+        nb_for_study_past=max(args.nb_for_study_past, 1),
+        momentum=args.momentum, momentum_at="update")
+    engine = build_engine(
+        cfg=cfg, model_def=models_mod.build(args.model),
+        loss=losses_mod.Loss("nll"), criterion=losses_mod.Criterion("top-k"),
+        defenses=[(ops_mod.gars[args.gar], 1.0, {})], attack=attack,
+        attack_kwargs=utils_mod.parse_keyval(args.attack_args))
+    S = cfg.nb_sampled
+    if S % workers_ax != 0:
+        print(f"cluster-host: {S} sampled gradients do not divide the "
+              f"{workers_ax}-way worker axis", flush=True)
+        return 2
+
+    state = engine.init(jax.random.PRNGKey(seed))
+
+    # --- consensus resume: the manifest names the step, the mirror holds
+    # the bytes, every host validates both --- #
+    resume_step = None
+    if args.auto_resume:
+        cluster_manifest = manifest_mod.read_cluster_manifest(resdir)
+        resume_step = cluster_manifest.get("restart_step")
+        if resume_step is not None:
+            found = mirror / f"checkpoint-{int(resume_step)}"
+            if not checkpoint_mod.verify(found):
+                print(f"cluster-host: manifest restart_step={resume_step} "
+                      f"but {found.name} is missing/invalid in the mirror",
+                      flush=True)
+                return DISAGREE_RC
+            state, data_state = checkpoint_mod.load(
+                found, state, return_data=True)
+            if data_state is not None:
+                trainset.set_state(data_state["train"])
+                testset.set_state(data_state["test"])
+            resume_step = int(resume_step)
+
+    write_host_heartbeat(resdir, proc, {
+        "step": int(state.steps), "status": "starting",
+        "resume_step": resume_step})
+
+    if args.lattice_census:
+        _run_census(resdir, proc)
+
+    step_fn = sharded_train_step(engine, mesh, state,
+                                 replicate_metrics=True)
+    gstate = global_train_state(mesh, state)
+
+    results = None
+    fd_study = None
+    if lead:
+        results = _ResultFiles(resdir)
+        results.make("study", *STUDY_COLUMNS, resume_step=resume_step)
+        fd_study = results.get("study")
+    float_format = "%.8e"
+
+    steps_host = int(state.steps)
+    datapoints_host = int(state.datapoints)
+    inc = args.batch_size * cfg.nb_honests * cfg.nb_local_steps
+    just_loaded = resume_step is not None
+    nb_steps = args.nb_steps
+    first_step = steps_host
+    # (--recompile-check) one count_compiles window over the warm steps:
+    # opened after the first chunk (which legitimately compiles), closed
+    # after the requested number of further steps, asserted ZERO
+    compile_window = None
+    compile_window_log = None
+    compiles_checked = 0
+    compile_check_done = args.recompile_check <= 0
+    rate_t0 = None
+    rate_from = None
+
+    def sample_batch():
+        xs, ys = zip(*(trainset.sample() for _ in range(S)))
+        return np.stack(xs), np.stack(ys)
+
+    try:
+        while steps_host < nb_steps:
+            if (args.checkpoint_delta > 0
+                    and steps_host % args.checkpoint_delta == 0
+                    and not just_loaded):
+                snapshot = {"train": trainset.get_state(),
+                            "test": testset.get_state()}
+                host_state = jax.device_get(gstate)
+                # Every host keeps a local copy (its "slice-local disk");
+                # ONLY host 0 commits the off-slice mirror the manifest
+                # agreement reads — single writer, like the manifest
+                checkpoint_mod.save(
+                    local_dir / f"checkpoint-{steps_host}", host_state,
+                    data_state=snapshot,
+                    mirror=mirror if lead else None)
+            just_loaded = False
+            xs, ys = sample_batch()
+            gx = global_batch(mesh, xs)
+            gy = global_batch(mesh, ys)
+            if (not compile_check_done and compile_window is None
+                    and steps_host > first_step):
+                # The program is warm (the first chunk carried its
+                # compile): every further step must be a pure dispatch
+                from byzantinemomentum_tpu.analysis import contracts
+                compile_window = contracts.count_compiles()
+                compile_window_log = compile_window.__enter__()
+            gstate, metrics = step_fn(gstate, gx, gy,
+                                      jnp.float32(args.learning_rate))
+            steps = steps_host
+            steps_host += 1
+            datapoints = datapoints_host
+            datapoints_host += inc
+            if compile_window is not None:
+                compiles_checked += 1
+                if compiles_checked >= args.recompile_check:
+                    compile_window.__exit__(None, None, None)
+                    compile_check_done = True
+                    count = compile_window_log.count
+                    compile_window = None
+                    if count != 0:
+                        print(f"cluster-host: RECOMPILE in the warm "
+                              f"multi-process loop ({count} over "
+                              f"{compiles_checked} steps)", flush=True)
+                        return 4
+            if rate_t0 is None:
+                rate_t0, rate_from = time.monotonic(), steps_host
+            host_metrics = jax.device_get(metrics)
+            if lead and fd_study is not None:
+                row = [steps, datapoints]
+                for column in STUDY_COLUMNS[2:-1]:
+                    row.append(float_format % float(host_metrics[column]))
+                row.append(float(host_metrics[
+                    "Attack acceptation ratio"]))
+                results.store(fd_study, *row)
+            write_host_heartbeat(resdir, proc, {
+                "step": steps_host, "status": "running",
+                "resume_step": resume_step})
+    finally:
+        if results is not None:
+            results.close()
+
+    elapsed = (time.monotonic() - rate_t0
+               if rate_t0 is not None else None)
+    warm_steps = steps_host - (rate_from or steps_host)
+    rate = (warm_steps / elapsed if elapsed and warm_steps > 0 else None)
+    summary = {
+        "host": proc, "steps": steps_host,
+        "steps_per_sec": (round(rate, 3) if rate else None),
+        "resume_step": resume_step,
+        "recompile_checked": (compiles_checked
+                              if args.recompile_check else None),
+    }
+    write_host_heartbeat(resdir, proc, {
+        "step": steps_host, "status": "completed",
+        "resume_step": resume_step,
+        "steps_per_sec": summary["steps_per_sec"]})
+    print("cluster-host: " + json.dumps(summary), flush=True)
+    runtime.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

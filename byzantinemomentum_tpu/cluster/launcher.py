@@ -1,0 +1,481 @@
+"""The fleet launcher/coordinator — owner of the cluster manifest, the
+aggregated heartbeat, and the system-level chaos driver.
+
+`python -m byzantinemomentum_tpu.cluster --hosts N ...` spawns N host
+processes (`cluster/host.py`, one `jax.distributed` controller each over a
+local TCP coordinator on a probed free port) and supervises them:
+
+* **liveness** — per-host atomic heartbeats aggregate into the cluster
+  liveness view (`cluster/manifest.py::liveness_view`, process table +
+  heartbeat freshness) and into ONE top-level `heartbeat.json`, so the
+  `Jobs` watchdog supervises a whole fleet through the same file a
+  single-process run writes (`Jobs(seeds=(None,))`, the seedless
+  service-job form — SIGKILL of this launcher kills the fleet through the
+  per-host stdin pipes, and the Jobs retry relaunches it with
+  `--auto-resume`).
+* **chaos** — a system-scope `FaultPlan` (`--fault-plan`,
+  `cluster/chaos.py`) SIGKILLs the planned host the first time the
+  observed cluster step reaches the event's step; fired events persist in
+  the manifest BEFORE the kill so recovery replays training, never the
+  kill.
+* **recovery** — on host death the launcher tears the fleet down (a
+  gloo fleet missing a peer can only wedge), agrees the restart step from
+  the off-slice mirror into the manifest
+  (`manifest.agree_restart_step` — the dead host's local state is never
+  consulted), and relaunches with `--auto-resume` (up to
+  `--fleet-retries` times in-process; an exhausted launcher exits
+  non-zero so an outer Jobs supervisor takes over with the same
+  semantics). Every relaunched host reports the restart step it adopted;
+  the launcher requires unanimity before declaring `restart_agreed`.
+* **artifact** — the outcome lands in a `CLUSTER.json`-shape artifact
+  (`--bench-out`, default `<result-directory>/CLUSTER.json`): hosts,
+  steps/s, recovery-step count, the cross-host lattice census verdict
+  and the zero-recompile bit. An unreachable runtime writes
+  `"status": "unavailable"` and exits 0 — the bench.py cpu-fallback
+  discipline, never an rc=124 hang (`cluster/runtime.py` bounds every
+  bind/connect).
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+__all__ = ["main", "process_commandline"]
+
+from byzantinemomentum_tpu.cluster.runtime import UNAVAILABLE_RC, free_port
+
+# The repo root (the package's parent): host subprocesses are spawned
+# with it on PYTHONPATH so `-m byzantinemomentum_tpu.cluster.host`
+# resolves regardless of the launcher's own working directory
+_PKG_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+# Host-run flags forwarded verbatim to every host process (the fleet's
+# shared run spec; argparse dest -> flag)
+_RUN_FLAGS = ("nb_steps", "seed", "nb_workers", "nb_decl_byz",
+              "nb_real_byz", "gar", "attack", "model", "dataset",
+              "batch_size", "nb_for_study", "nb_for_study_past",
+              "learning_rate", "momentum", "checkpoint_delta",
+              "connect_timeout")
+
+
+def process_commandline(argv=None):
+    parser = argparse.ArgumentParser(prog="cluster")
+    add = parser.add_argument
+    add("--hosts", type=int, default=2,
+        help="Fleet size: one jax.distributed controller process per host")
+    add("--result-directory", type=str, required=True)
+    add("--mirror", type=str, default=None,
+        help="Off-slice checkpoint mirror (default: "
+             "<result-directory>/mirror). Restart steps are agreed from "
+             "HERE, never from any host's local directory")
+    add("--device", type=str, default="auto",
+        help="Accepted for Jobs-supervisor compatibility; the fleet "
+             "simulates hosts on the CPU backend unless "
+             "BMT_CLUSTER_NATIVE=1")
+    add("--seed", type=int, default=1)
+    add("--auto-resume", action="store_true", default=False,
+        help="Resume the fleet from the mirror's newest valid checkpoint "
+             "(the Jobs supervisor appends this on retries)")
+    add("--fleet-retries", type=int, default=2,
+        help="In-process fleet relaunches after a host loss (0: exit "
+             "non-zero immediately and let an outer supervisor retry)")
+    add("--fault-plan", type=str, default=None,
+        help="System-scope FaultPlan JSON: device_loss events SIGKILL "
+             "the named HOST at the named step (cluster/chaos.py)")
+    add("--connect-timeout", type=float, default=60.0)
+    add("--heartbeat-stale", type=float, default=60.0,
+        help="Seconds without a host heartbeat update before the "
+             "liveness view marks it stale")
+    add("--poll", type=float, default=0.2,
+        help="Supervision poll interval in seconds")
+    add("--recompile-check", type=int, default=0)
+    add("--lattice-census", action="store_true", default=False)
+    add("--bench-out", type=str, default=None,
+        help="Path of the CLUSTER.json outcome artifact (default: "
+             "<result-directory>/CLUSTER.json)")
+    add("--nb-steps", type=int, default=8)
+    add("--nb-workers", type=int, default=8)
+    add("--nb-decl-byz", type=int, default=2)
+    add("--nb-real-byz", type=int, default=2)
+    add("--gar", type=str, default="median")
+    add("--attack", type=str, default="empire")
+    add("--attack-args", nargs="*")
+    add("--model", type=str, default="simples-full")
+    add("--dataset", type=str, default="mnist")
+    add("--batch-size", type=int, default=8)
+    add("--nb-for-study", type=int, default=8)
+    add("--nb-for-study-past", type=int, default=2)
+    add("--learning-rate", type=float, default=0.05)
+    add("--momentum", type=float, default=0.9)
+    add("--checkpoint-delta", type=int, default=2)
+    return parser.parse_args(sys.argv[1:] if argv is None else argv)
+
+
+class _Fleet:
+    """One fleet attempt: the host subprocesses plus their stdin pipes
+    (held exclusively here — launcher death closes them and the hosts'
+    parent-watch threads exit, so a SIGKILLed launcher never leaks a
+    training fleet)."""
+
+    def __init__(self, procs):
+        self.procs = procs
+
+    def running(self):
+        return {i: p.poll() is None for i, p in enumerate(self.procs)}
+
+    def returncodes(self):
+        return [p.poll() for p in self.procs]
+
+    def kill(self, host):
+        try:
+            self.procs[host].kill()
+        except OSError:
+            pass
+
+    def teardown(self):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # bmt: noqa[BMT-E05] a kill-then-wait that still fails means the OS is reaping it; teardown must not raise
+                pass
+            if p.stdin is not None:
+                try:
+                    p.stdin.close()
+                except OSError:
+                    pass
+
+
+def _spawn_fleet(args, resdir, mirror, port):
+    import subprocess
+
+    hosts_dir = resdir / "hosts"
+    hosts_dir.mkdir(parents=True, exist_ok=True)
+    procs = []
+    for host in range(args.hosts):
+        cmd = [sys.executable, "-m", "byzantinemomentum_tpu.cluster.host",
+               "--procs", str(args.hosts), "--proc-id", str(host),
+               "--coordinator", f"127.0.0.1:{port}",
+               "--result-directory", str(resdir),
+               "--mirror", str(mirror),
+               "--parent-pipe"]
+        if args.auto_resume:
+            cmd.append("--auto-resume")
+        if args.recompile_check:
+            cmd += ["--recompile-check", str(args.recompile_check)]
+        if args.lattice_census:
+            cmd.append("--lattice-census")
+        if args.attack_args:
+            cmd += ["--attack-args", *args.attack_args]
+        for dest in _RUN_FLAGS:
+            cmd += [f"--{dest.replace('_', '-')}",
+                    str(getattr(args, dest))]
+        out = (hosts_dir / f"host-{host}.out.log").open("ab")
+        err = (hosts_dir / f"host-{host}.err.log").open("ab")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (str(_PKG_ROOT) + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        proc = subprocess.Popen(cmd, stdin=subprocess.PIPE, stdout=out,
+                                stderr=err, cwd=str(_PKG_ROOT), env=env)
+        out.close()
+        err.close()
+        procs.append(proc)
+    return _Fleet(procs)
+
+
+def _clear_host_signals(resdir, hosts):
+    """Stale heartbeats/census from a previous attempt must not feed this
+    attempt's liveness view or agreement checks."""
+    from byzantinemomentum_tpu.obs.heartbeat import host_heartbeat_path
+
+    for host in range(hosts):
+        for path in (host_heartbeat_path(resdir, host),
+                     resdir / "hosts" / f"host-{host}.census.json"):
+            try:
+                path.unlink()
+            except OSError:
+                pass
+
+
+def _check_census(resdir, hosts):
+    """Cross-host census verdict: every host lowered the same cells to
+    the same fingerprints with zero BMT-H violations. Returns a dict (or
+    None when no host wrote a census)."""
+    artifacts = {}
+    for host in range(hosts):
+        path = resdir / "hosts" / f"host-{host}.census.json"
+        try:
+            artifacts[host] = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+    if not artifacts:
+        return None
+    fingerprints = [
+        {key: cell.get("fingerprint")
+         for key, cell in (art.get("cells") or {}).items()}
+        for art in artifacts.values()]
+    violations = sum(int(art.get("violations") or 0)
+                     for art in artifacts.values())
+    agree = all(fp == fingerprints[0] for fp in fingerprints[1:])
+    return {"hosts_reporting": sorted(artifacts),
+            "cells": len(fingerprints[0]),
+            "fingerprints_agree": bool(agree and fingerprints[0]),
+            "violations": violations,
+            "ok": bool(agree and fingerprints[0] and violations == 0)}
+
+
+def main(argv=None):
+    args = process_commandline(argv)
+    if args.hosts < 1:
+        print("cluster: need at least one host")
+        return 2
+    resdir = pathlib.Path(args.result_directory).resolve()
+    resdir.mkdir(parents=True, exist_ok=True)
+    mirror = pathlib.Path(args.mirror).resolve() if args.mirror \
+        else resdir / "mirror"
+    mirror.mkdir(parents=True, exist_ok=True)
+    bench_out = (pathlib.Path(args.bench_out) if args.bench_out
+                 else resdir / "CLUSTER.json")
+
+    from byzantinemomentum_tpu.cluster import chaos as chaos_mod
+    from byzantinemomentum_tpu.cluster import manifest as manifest_mod
+    from byzantinemomentum_tpu.obs import Telemetry
+    from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+
+    plan = None
+    if args.fault_plan is not None:
+        from byzantinemomentum_tpu.faults import FaultPlan
+
+        try:
+            plan = FaultPlan.load(args.fault_plan)
+        except (OSError, ValueError, TypeError) as err:
+            print(f"cluster: unable to load fault plan "
+                  f"{args.fault_plan!r}: {err}")
+            return 2
+        message = plan.validate_system(args.hosts)
+        if message is not None:
+            print(f"cluster: fault plan rejected: {message}")
+            return 2
+
+    manifest = manifest_mod.read_cluster_manifest(resdir)
+    manifest["hosts"] = args.hosts
+    driver = (chaos_mod.SystemFaultDriver(
+        plan, args.hosts, fired=manifest.get("fired_faults") or ())
+        if plan is not None else None)
+
+    telem = Telemetry(resdir)
+    telem.event("cluster_start", hosts=args.hosts, steps=args.nb_steps,
+                auto_resume=bool(args.auto_resume),
+                fault_events=(len(plan.events) if plan else 0))
+    # A live signal BEFORE the slow part (spawn + jax imports + compile),
+    # so an outer Jobs watchdog never kills a fleet for starting up
+    write_heartbeat(resdir, {"step": None, "status": "launching",
+                             "hosts": args.hosts})
+
+    # The Jobs-watchdog chaos hook (tests/test_cluster.py): once the
+    # fleet reaches the step, kill it and go silent — the aggregated
+    # heartbeat stalls and the OUTER watchdog must SIGKILL this launcher
+    wedge_at = os.environ.get("BMT_CHAOS_CLUSTER_WEDGE_AT")
+    wedge_at = int(wedge_at) if wedge_at else None
+    wedge_fuse = resdir / "wedge.fired"
+
+    def aggregate(view, status):
+        alive = view["alive"]
+        write_heartbeat(resdir, {
+            "step": view["min_step"], "status": status,
+            "hosts": args.hosts, "hosts_alive": len(alive),
+            "host_steps": {str(h): view["hosts"][h]["step"]
+                           for h in alive}})
+
+    recoveries = list(manifest.get("recoveries") or [])
+    attempt = int(manifest.get("attempt") or 0)
+    outcome = None
+    final_view = None
+    steps_per_sec = None
+
+    while True:
+        attempt += 1
+        restart_step = None
+        if args.auto_resume:
+            restart_step, _ = manifest_mod.agree_restart_step(mirror)
+        manifest.update(attempt=attempt, restart_step=restart_step,
+                        status="launching",
+                        fired_faults=(driver.fired() if driver else []))
+        manifest_mod.write_cluster_manifest(resdir, manifest)
+        _clear_host_signals(resdir, args.hosts)
+        port = free_port()
+        telem.event("fleet_launch", attempt=attempt, hosts=args.hosts,
+                    coordinator_port=port, restart_step=restart_step)
+        fleet = _spawn_fleet(args, resdir, mirror, port)
+        agreed = False
+        outcome = None
+        killed_host = None
+        killed_at = None
+        while outcome is None:
+            time.sleep(max(args.poll, 0.01))
+            running = fleet.running()
+            view = manifest_mod.liveness_view(
+                resdir, args.hosts, stale_after=args.heartbeat_stale,
+                running=running)
+            aggregate(view, "running")
+            # Restart agreement: once every host has reported, the
+            # adopted steps must be unanimous and equal the manifest's
+            if not agreed and restart_step is not None:
+                reported = [view["hosts"][h].get("resume_step")
+                            for h in range(args.hosts)
+                            if view["hosts"][h]["step"] is not None]
+                if len(reported) == args.hosts:
+                    if any(r != restart_step for r in reported):
+                        telem.event("restart_disagreement",
+                                    manifest_step=restart_step,
+                                    reported=reported)
+                        outcome = "disagreement"
+                        break
+                    agreed = True
+                    telem.event("restart_agreed", step=restart_step,
+                                hosts=args.hosts)
+            # System-level chaos: persist the fired record, THEN kill
+            if driver is not None:
+                for index, event in driver.due(view["max_step"]):
+                    driver.mark(index)
+                    manifest.update(fired_faults=driver.fired())
+                    manifest_mod.write_cluster_manifest(resdir, manifest)
+                    telem.event("fault_injected", kind=event.kind,
+                                host=event.worker,
+                                at_step=view["max_step"],
+                                plan_step=event.step)
+                    fleet.kill(event.worker)
+            if wedge_at is not None and not wedge_fuse.exists() \
+                    and view["max_step"] is not None \
+                    and view["max_step"] >= wedge_at:
+                wedge_fuse.write_text(str(view["max_step"]))
+                telem.event("wedge", step=view["max_step"])
+                fleet.teardown()
+                while True:  # silent: the outer watchdog must kill us
+                    time.sleep(60)
+            rcs = fleet.returncodes()
+            if all(rc == 0 for rc in rcs):
+                outcome = "completed"
+            elif any(rc == UNAVAILABLE_RC for rc in rcs):
+                outcome = "unavailable"
+            elif any(rc not in (None, 0) for rc in rcs):
+                outcome = "host_lost"
+                killed_host = next(i for i, rc in enumerate(rcs)
+                                   if rc not in (None, 0))
+                killed_at = view["max_step"]
+            final_view = view
+        fleet.teardown()
+        if outcome == "completed":
+            break
+        if outcome in ("unavailable", "disagreement"):
+            break
+        # host_lost: record the recovery, then relaunch or hand off
+        telem.event("host_dead", host=killed_host, at_step=killed_at,
+                    attempt=attempt)
+        # Lost hardware loses its local disk with it: delete the dead
+        # host's slice-local directory (its checkpoints included) so the
+        # recovery path PROVABLY depends on the off-slice mirror alone
+        import shutil
+
+        shutil.rmtree(resdir / f"host-{killed_host}", ignore_errors=True)
+        new_restart, _ = manifest_mod.agree_restart_step(mirror)
+        recovery = {"host": killed_host, "died_at_step": killed_at,
+                    "restart_step": new_restart,
+                    "recovery_steps": (killed_at - new_restart
+                                       if None not in (killed_at,
+                                                       new_restart)
+                                       else None)}
+        recoveries.append(recovery)
+        manifest.update(recoveries=recoveries, status="recovering")
+        manifest_mod.write_cluster_manifest(resdir, manifest)
+        telem.event("fleet_teardown", attempt=attempt,
+                    restart_step=new_restart)
+        if attempt > args.fleet_retries:
+            outcome = "retries_exhausted"
+            break
+        if not args.auto_resume:
+            # Without resume a relaunch replays from step 0 AND re-frees
+            # the fired faults' steps — hand off to the outer supervisor,
+            # which appends --auto-resume on its retry
+            outcome = "needs_resume"
+            break
+
+    # ---------------- outcome -> artifact + exit code ---------------- #
+    census = _check_census(resdir, args.hosts)
+    if outcome == "completed":
+        from byzantinemomentum_tpu.obs.heartbeat import (
+            read_host_heartbeats)
+        beats = read_host_heartbeats(resdir)
+        rates = [b.get("steps_per_sec") for b in beats.values()
+                 if isinstance(b.get("steps_per_sec"), (int, float))]
+        # The fleet advances in lockstep (collectives synchronize), so
+        # the slowest host's estimate is the honest cluster rate
+        steps_per_sec = round(min(rates), 3) if rates else None
+
+    recovery_steps = sum(r["recovery_steps"] for r in recoveries
+                         if r.get("recovery_steps") is not None)
+    import jax  # the launcher never initializes a backend: version only
+
+    artifact = {
+        "kind": "cluster",
+        "backend": ("cpu" if not os.environ.get("BMT_CLUSTER_NATIVE")
+                    else "native"),
+        "jax": jax.__version__,
+        "status": {"completed": "ok"}.get(outcome, outcome),
+        "hosts": args.hosts,
+        "steps": args.nb_steps,
+        "steps_per_sec": steps_per_sec,
+        "config": {"nb_workers": args.nb_workers, "gar": args.gar,
+                   "attack": args.attack, "model": args.model,
+                   "seed": args.seed,
+                   "checkpoint_delta": args.checkpoint_delta},
+        "recovery": {"events": len(recoveries),
+                     "recoveries": recoveries,
+                     "recovery_steps": recovery_steps,
+                     "attempts": attempt},
+        "census": census,
+        "zero_recompile": ({"warm_steps": args.recompile_check,
+                            "asserted": outcome == "completed"}
+                           if args.recompile_check else None),
+    }
+    bench_out.parent.mkdir(parents=True, exist_ok=True)
+    bench_out.write_text(json.dumps(artifact, indent="\t", sort_keys=True)
+                         + "\n")
+    status = artifact["status"]
+    manifest.update(status=status)
+    manifest_mod.write_cluster_manifest(resdir, manifest)
+    telem.event("cluster_end", status=status,
+                steps_per_sec=steps_per_sec,
+                recovery_steps=recovery_steps, attempts=attempt)
+    telem.close()
+    final_status = {"ok": "completed"}.get(status, status)
+    write_heartbeat(resdir, {
+        "step": (final_view or {}).get("min_step"),
+        "status": final_status, "hosts": args.hosts})
+    print("cluster: " + json.dumps(
+        {"status": status, "hosts": args.hosts,
+         "steps_per_sec": steps_per_sec,
+         "recovery_steps": recovery_steps, "attempts": attempt,
+         "census_ok": (census or {}).get("ok"),
+         "artifact": str(bench_out)}), flush=True)
+    if status == "ok":
+        if args.lattice_census and not (census or {}).get("ok"):
+            return 5  # the fleet trained but the program census failed
+        return 0
+    if status == "unavailable":
+        # The bounded-timeout contract: a missing runtime is a clean,
+        # machine-readable artifact and a zero exit — never an rc=124
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

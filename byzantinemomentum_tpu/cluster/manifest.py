@@ -1,0 +1,161 @@
+"""The per-run cluster manifest — the fleet's consensus artifact — and the
+liveness view aggregated from per-host heartbeats.
+
+Ray's ownership argument (PAPERS.md) splits cluster state into a
+centralized liveness record and per-object owners; the translation here:
+the LAUNCHER owns `cluster.json` (single writer, atomic replace), and the
+hosts own their training state. Everything the fleet must AGREE on flows
+through the manifest:
+
+  restart_step    the step the NEXT fleet attempt resumes from — computed
+                  by the launcher as the newest valid checkpoint in the
+                  off-slice MIRROR (`checkpoint.find_latest_valid`), never
+                  from any host's local disk, so a dead host's lost local
+                  state is irrelevant by construction. Every host reads
+                  the same number from the same file, validates the
+                  checkpoint it names (CRC + version), and reports the
+                  step it actually adopted in its first heartbeat; the
+                  launcher cross-checks the reports and only declares
+                  `restart_agreed` when they are unanimous.
+  fired_faults    indices of system-level FaultPlan events already
+                  injected (`cluster/chaos.py`) — persisted BEFORE the
+                  SIGKILL is sent, so a relaunched fleet replays the
+                  training steps but never the kill (the same
+                  determinism-with-recovery contract the in-step fault
+                  schedule has).
+  attempts /      the fleet-launch history: which attempt is running,
+  recoveries      which host died when, and how many steps each recovery
+                  re-executed (the `recovery_steps` the CLUSTER artifact
+                  and bench_history report).
+
+Liveness (`liveness_view`): each host writes an atomic
+`hosts/host-<i>.heartbeat.json` every step (`obs/heartbeat.py`); the view
+joins them with the child process table — a host is `alive` while its
+process runs, `stale` when its heartbeat stops advancing (wedged
+collective), `dead` once its process is gone. Heartbeats are a *signal*;
+process exit is *ground truth* — the same two-tier design as the Jobs
+watchdog.
+"""
+
+import json
+import os
+import pathlib
+import time
+
+from byzantinemomentum_tpu.obs.heartbeat import read_host_heartbeats
+
+__all__ = ["CLUSTER_MANIFEST_NAME", "agree_restart_step", "liveness_view",
+           "read_cluster_manifest", "update_cluster_manifest",
+           "write_cluster_manifest"]
+
+CLUSTER_MANIFEST_NAME = "cluster.json"
+VERSION = 1
+
+
+def _defaults():
+    return {"version": VERSION, "hosts": None, "attempt": 0,
+            "restart_step": None, "fired_faults": [], "recoveries": [],
+            "status": "new"}
+
+
+def read_cluster_manifest(directory):
+    """The run's cluster manifest (defaults when absent/torn — like the
+    checkpoint manifest, a fresh file must mean 'nothing agreed yet',
+    never a crash)."""
+    path = pathlib.Path(directory) / CLUSTER_MANIFEST_NAME
+    try:
+        manifest = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return _defaults()
+    if not isinstance(manifest, dict):
+        return _defaults()
+    out = _defaults()
+    out.update(manifest)
+    return out
+
+
+def write_cluster_manifest(directory, manifest):
+    """Atomic single-writer replace (the launcher is the only writer;
+    hosts only read)."""
+    path = pathlib.Path(directory) / CLUSTER_MANIFEST_NAME
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("w", encoding="utf-8") as fd:
+        fd.write(json.dumps(manifest, ensure_ascii=False, indent="\t"))
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def update_cluster_manifest(directory, **fields):
+    """Read-modify-write convenience for the single writer."""
+    manifest = read_cluster_manifest(directory)
+    manifest.update(fields)
+    write_cluster_manifest(directory, manifest)
+    return manifest
+
+
+def agree_restart_step(mirror_dir):
+    """The restart step the next fleet attempt must converge on: the
+    newest VALID checkpoint in the off-slice mirror (None -> cold start).
+    Returns `(step, path)`. Only the mirror counts — a host's local
+    checkpoints may have died with the host."""
+    from byzantinemomentum_tpu import checkpoint
+
+    found = checkpoint.find_latest_valid(mirror_dir)
+    if found is None:
+        return None, None
+    return checkpoint.checkpoint_step(found), found
+
+
+def liveness_view(run_dir, nb_hosts, *, stale_after=None, running=None,
+                  now=None):
+    """The aggregated cluster liveness view.
+
+    Args:
+      run_dir: the fleet's result directory (per-host heartbeats live
+        under its `hosts/`).
+      nb_hosts: fleet size — hosts with no heartbeat yet still get a row.
+      stale_after: seconds without a heartbeat update before a live host
+        counts `stale` (None disables staleness).
+      running: optional {host_id: bool} process-table truth from the
+        launcher; hosts reported not-running are `dead` regardless of
+        how fresh their last heartbeat looks.
+      now: injected clock for tests.
+
+    Returns `{"hosts": {id: {...}}, "alive": [...], "min_step": int|None,
+    "max_step": int|None}` where per-host status is one of
+    `alive`/`stale`/`dead`/`unknown` (no signal yet).
+    """
+    now = time.time() if now is None else now
+    beats = read_host_heartbeats(run_dir)
+    hosts = {}
+    alive = []
+    steps = []
+    for host in range(int(nb_hosts)):
+        beat = beats.get(host)
+        process_up = None if running is None else bool(running.get(host))
+        row = {"step": None, "age": None, "status": "unknown"}
+        if beat is not None:
+            row["step"] = beat.get("step")
+            row["age"] = max(0.0, now - float(beat.get("updated", now)))
+            if beat.get("resume_step") is not None:
+                row["resume_step"] = beat.get("resume_step")
+            if beat.get("status"):
+                row["host_status"] = beat.get("status")
+        if process_up is False:
+            row["status"] = "dead"
+        elif beat is None:
+            row["status"] = "unknown"
+        elif stale_after is not None and row["age"] > stale_after:
+            row["status"] = "stale"
+        else:
+            row["status"] = "alive"
+        if row["status"] == "alive":
+            alive.append(host)
+            if isinstance(row["step"], int):
+                steps.append(row["step"])
+        hosts[host] = row
+    return {"hosts": hosts, "alive": alive,
+            "min_step": min(steps) if steps else None,
+            "max_step": max(steps) if steps else None}

@@ -1,0 +1,180 @@
+"""Multi-controller runtime glue — `jax.distributed` with bounded, CI-safe
+initialization.
+
+One process per "host": each calls `initialize(HostSpec(...))`, which pins
+the CPU backend (unless `BMT_CLUSTER_NATIVE=1` opts a real accelerator
+fleet back in), selects the gloo CPU collectives implementation, and joins
+the coordinator over local TCP. After it returns, `jax.devices()` spans
+EVERY process's devices and a `(workers, model)` mesh over them runs the
+engine step with real cross-host collectives (`cluster/host.py`).
+
+Timeout discipline (the MULTICHIP_r05 lesson — an unreachable backend must
+degrade, never hang CI at rc=124): the coordinator bind and every
+follower's connect are bounded by `HostSpec.connect_timeout`, and any
+initialization failure raises `ClusterUnavailable` — which `host.py` turns
+into the reserved `UNAVAILABLE_RC` exit code and the launcher turns into a
+clean `"status": "unavailable"` artifact (bench.py's cpu-fallback
+discipline), instead of a wedged fleet.
+"""
+
+import dataclasses
+import os
+import socket
+
+__all__ = ["ClusterUnavailable", "HostSpec", "UNAVAILABLE_RC",
+           "cluster_mesh", "free_port", "initialize", "shutdown"]
+
+# Exit code a host process reserves for "the distributed runtime could not
+# come up" (coordinator unreachable, bind refused, init timeout) — the
+# launcher maps it to a clean `unavailable` outcome, distinct from a
+# training failure or a SIGKILL
+UNAVAILABLE_RC = 17
+
+
+class ClusterUnavailable(RuntimeError):
+    """The distributed runtime could not initialize within its bounds."""
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSpec:
+    """One host process's coordinates in the fleet.
+
+    `coordinator` is `host:port` (process 0 binds it, everyone connects);
+    `connect_timeout` bounds BOTH sides of that handshake in seconds.
+    """
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+    connect_timeout: float = 60.0
+
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError(
+                f"Non-positive process count {self.num_processes}")
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError(
+                f"Process id {self.process_id} outside the "
+                f"{self.num_processes}-process fleet")
+        if self.connect_timeout <= 0:
+            raise ValueError(
+                f"Non-positive connect timeout {self.connect_timeout}")
+
+
+def free_port(host="127.0.0.1"):
+    """An OS-assigned free TCP port (the launcher picks the coordinator
+    port with this; the tiny bind-release race is re-tried by the fleet
+    retry loop, never hung on)."""
+    with socket.socket() as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+
+
+def _await_coordinator(spec):
+    """Bounded TCP probe of the coordinator BEFORE jax touches it: the
+    XLA distributed client LOG(FATAL)s the whole process on a connect
+    deadline (RegisterTask DEADLINE_EXCEEDED aborts with SIGABRT — no
+    Python exception ever surfaces), so an unreachable coordinator must
+    be detected here, where it can become a clean `ClusterUnavailable`.
+    Followers WAIT for the coordinator to appear (host 0 binds it a
+    beat after they start), retrying until the spec's deadline."""
+    import time
+
+    host, _, port = spec.coordinator.rpartition(":")
+    deadline = time.monotonic() + spec.connect_timeout
+    while True:
+        try:
+            with socket.create_connection((host or "127.0.0.1", int(port)),
+                                          timeout=2.0):
+                return
+        except OSError as err:
+            if time.monotonic() >= deadline:
+                raise ClusterUnavailable(
+                    f"coordinator {spec.coordinator} unreachable within "
+                    f"{spec.connect_timeout}s ({err})") from err
+            time.sleep(0.2)
+
+
+def initialize(spec):
+    """Join the fleet: pin the CPU backend (CI-provable; a real device
+    fleet opts back in with `BMT_CLUSTER_NATIVE=1`), select gloo CPU
+    collectives, and run `jax.distributed.initialize` under the spec's
+    bounded timeout. Raises `ClusterUnavailable` on any failure."""
+    import jax
+
+    if spec.process_id != 0:
+        _await_coordinator(spec)
+
+    if not os.environ.get("BMT_CLUSTER_NATIVE"):
+        # Same pin as `__graft_entry__.dryrun_multichip`: an un-pinned
+        # probe on a host with a broken accelerator tunnel hangs backend
+        # setup indefinitely (the MULTICHIP_r05 rc=124 failure mode)
+        jax.config.update("jax_platforms", "cpu")
+        # One simulated host = ONE device: an inherited
+        # xla_force_host_platform_device_count (the test suite's virtual
+        # 8-device platform) would multiply every host into a virtual
+        # slice and break the fleet's worker-axis arithmetic. Effective
+        # because the backend has not initialized yet (this runs before
+        # any device use in the host process).
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            part for part in flags.split()
+            if "xla_force_host_platform_device_count" not in part)
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=1").strip()
+    try:
+        # Cross-process CPU collectives need the gloo implementation; the
+        # knob predates its promotion to a stable name, hence the guard
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass  # newer jax: gloo is the multi-process CPU default
+    try:
+        jax.distributed.initialize(
+            coordinator_address=spec.coordinator,
+            num_processes=spec.num_processes,
+            process_id=spec.process_id,
+            # jaxlib's distributed client takes whole seconds only
+            initialization_timeout=max(1, int(spec.connect_timeout)))
+    except Exception as err:  # bmt: noqa[BMT-E05] the distributed client raises backend-specific types (RuntimeError, XlaRuntimeError, OSError); every one of them means the same bounded 'unavailable'
+        raise ClusterUnavailable(
+            f"distributed runtime unavailable (coordinator "
+            f"{spec.coordinator}, process {spec.process_id}/"
+            f"{spec.num_processes}, timeout {spec.connect_timeout}s): "
+            f"{err}") from err
+    if jax.process_count() != spec.num_processes:
+        raise ClusterUnavailable(
+            f"joined a {jax.process_count()}-process fleet but the spec "
+            f"declares {spec.num_processes}")
+
+
+def cluster_mesh(model_parallel=1):
+    """The global `(workers, model)` mesh over EVERY process's devices.
+
+    The default `model_parallel=1` keeps every state buffer fully
+    replicated, so any process can read (and host 0 can checkpoint) the
+    training state without cross-process gathers; `model_parallel > 1`
+    d-shards the state ACROSS hosts — the lattice census covers that
+    layout's collectives (`analysis/lattice.py::multiprocess_cells`), but
+    checkpointing it needs a gather pass this runtime does not do yet.
+    """
+    import jax
+
+    from byzantinemomentum_tpu.parallel import make_mesh
+
+    if model_parallel != 1:
+        raise ValueError(
+            "cluster_mesh only supports model_parallel=1 for now: the "
+            "host runtime reads and checkpoints the state from single "
+            "processes, which requires it fully replicated")
+    return make_mesh(len(jax.devices()), model_parallel=model_parallel)
+
+
+def shutdown():
+    """Leave the fleet (best-effort: a process on its way out must never
+    fail in teardown)."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # bmt: noqa[BMT-E05] teardown races the coordinator's own exit; any error here is moot by definition
+        pass

@@ -27,7 +27,7 @@ import json
 import pathlib
 
 __all__ = ["FaultEvent", "FaultPolicy", "FaultPlan", "KINDS", "MODES",
-           "straggler", "drop_worker", "corrupt_gradient",
+           "SYSTEM_KINDS", "straggler", "drop_worker", "corrupt_gradient",
            "duplicate_submission", "device_loss"]
 
 # Fault taxonomy. `device_loss` is the permanent form of `drop_worker`:
@@ -38,6 +38,14 @@ KINDS = ("straggler", "drop_worker", "corrupt_gradient",
 # corrupt_gradient modes: all-NaN shard, all-zero shard, or a scaled
 # (exploding/vanishing) shard.
 MODES = ("nan", "zero", "scale")
+
+# Kinds a plan may carry at SYSTEM scope (`cluster/chaos.py`): there,
+# `worker` indexes a HOST process of a multi-controller fleet and
+# `device_loss` means SIGKILL — real lost hardware, not a masked row.
+# The in-step kinds (straggler/corruption/duplication) have no system
+# analogue yet; `validate_system` refuses them so a plan cannot silently
+# mean two different things.
+SYSTEM_KINDS = ("device_loss",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,6 +202,32 @@ class FaultPlan:
                     return (f"duplicate_submission on worker {e.worker} "
                             f"copies itself (a no-op; refusing a plan that "
                             f"cannot mean what it says)")
+        return None
+
+    def validate_system(self, nb_hosts):
+        """None if the plan can drive HOST-scope chaos on an
+        `nb_hosts`-process fleet (`cluster/chaos.py::SystemFaultDriver`),
+        else a human-readable refusal. At system scope `worker` indexes a
+        host and only `SYSTEM_KINDS` are meaningful (a SIGKILL has no
+        'corrupted submission' analogue)."""
+        for e in self.events:
+            if e.kind not in SYSTEM_KINDS:
+                return (f"fault {e.kind!r} has no system-scope meaning; a "
+                        f"host-level plan may only use "
+                        f"{'/'.join(SYSTEM_KINDS)}")
+            if e.worker >= nb_hosts:
+                return (f"system fault targets host {e.worker} but the "
+                        f"fleet has only {nb_hosts} hosts")
+            if e.worker == 0 and nb_hosts > 1:
+                # Host 0 runs the jax.distributed coordinator service:
+                # killing it wedges the SURVIVORS' collectives inside the
+                # runtime rather than failing them — the launcher's
+                # teardown still recovers, but the plan should say what it
+                # means (kill a non-coordinator host, or a 1-host fleet)
+                return ("system fault targets host 0 (the distributed "
+                        "coordinator); target a non-coordinator host so "
+                        "the survivors' failure mode is peer loss, not "
+                        "coordinator loss")
         return None
 
     # ------------------------------------------------------------------ #

@@ -67,8 +67,12 @@ from byzantinemomentum_tpu.obs.forensics import (  # noqa: F401
 )
 from byzantinemomentum_tpu.obs.heartbeat import (  # noqa: F401
     HEARTBEAT_NAME,
+    HOSTS_DIRNAME,
+    host_heartbeat_path,
     read_heartbeat,
+    read_host_heartbeats,
     write_heartbeat,
+    write_host_heartbeat,
 )
 from byzantinemomentum_tpu.obs.perf import (  # noqa: F401
     SlidingRate,
@@ -84,7 +88,9 @@ from byzantinemomentum_tpu.obs import attrib  # noqa: F401
 __all__ = [
     "TELEMETRY_NAME", "Telemetry", "activate", "active", "counter",
     "deactivate", "emit", "install_compile_listener", "load_records", "span",
-    "HEARTBEAT_NAME", "read_heartbeat", "write_heartbeat",
+    "HEARTBEAT_NAME", "HOSTS_DIRNAME", "host_heartbeat_path",
+    "read_heartbeat", "read_host_heartbeats", "write_heartbeat",
+    "write_host_heartbeat",
     "SlidingRate", "StepTimer", "SuspicionTracker", "attrib",
     "flops_of_compiled", "host_rss_mb", "logical_flops", "mfu",
     "peak_flops",

@@ -18,6 +18,15 @@ and its `last_event` summary.
 
 Host-only on purpose (no jax import): `utils/jobs.py` reads heartbeats
 from supervisor threads that must never initialize a backend.
+
+Multi-host runs (`byzantinemomentum_tpu/cluster/`) extend the scheme one
+level: every host process writes its own atomic
+`hosts/host-<i>.heartbeat.json` (same discipline, same payload shape plus
+`host`/`resume_step`), and the cluster launcher aggregates them into the
+run's single top-level `heartbeat.json` — so the `Jobs` watchdog
+supervises a whole fleet through the exact same file a single-process run
+writes. The per-host files are the raw signal the launcher's liveness
+view (`cluster/manifest.py::liveness_view`) is computed from.
 """
 
 import json
@@ -25,20 +34,26 @@ import os
 import pathlib
 import time
 
-__all__ = ["HEARTBEAT_NAME", "write_heartbeat", "read_heartbeat"]
+__all__ = ["HEARTBEAT_NAME", "HOSTS_DIRNAME", "write_heartbeat",
+           "read_heartbeat", "host_heartbeat_path", "write_host_heartbeat",
+           "read_host_heartbeats"]
 
 HEARTBEAT_NAME = "heartbeat.json"
+# Per-host heartbeat files of a multi-host run live under this
+# subdirectory of the run's result directory
+HOSTS_DIRNAME = "hosts"
 VERSION = 1
 
 
-def write_heartbeat(directory, payload):
-    """Atomically write `heartbeat.json` under `directory`; `payload` keys
-    override nothing — `version`/`pid`/`updated` are stamped here so every
-    heartbeat is self-describing and freshness-comparable."""
+def write_heartbeat(directory, payload, name=HEARTBEAT_NAME):
+    """Atomically write `name` (default `heartbeat.json`) under
+    `directory`; `payload` keys override nothing — `version`/`pid`/
+    `updated` are stamped here so every heartbeat is self-describing and
+    freshness-comparable."""
     directory = pathlib.Path(directory)
     record = {"version": VERSION, "pid": os.getpid(), "updated": time.time()}
     record.update(payload)
-    path = directory / HEARTBEAT_NAME
+    path = directory / name
     tmp = path.with_name(path.name + ".tmp")
     with tmp.open("w", encoding="utf-8") as fd:
         fd.write(json.dumps(record, ensure_ascii=False, indent="\t"))
@@ -48,13 +63,50 @@ def write_heartbeat(directory, payload):
     return path
 
 
-def read_heartbeat(directory):
+def read_heartbeat(directory, name=HEARTBEAT_NAME):
     """The parsed heartbeat of a run directory, or None when absent or
     unreadable (never raises: the watchdog must not die on a mangled
     file, and a missing heartbeat just means the fallback signal rules)."""
-    path = pathlib.Path(directory) / HEARTBEAT_NAME
+    path = pathlib.Path(directory) / name
     try:
         record = json.loads(path.read_text(encoding="utf-8"))
     except (OSError, ValueError):
         return None  # absent/torn/mid-replace file: the fallback signal rules
     return record if isinstance(record, dict) else None
+
+
+# ------------------------------------------------------------------------- #
+# Per-host heartbeats of a multi-host run (`byzantinemomentum_tpu/cluster/`)
+
+def host_heartbeat_path(run_dir, host_id):
+    return (pathlib.Path(run_dir) / HOSTS_DIRNAME
+            / f"host-{int(host_id)}.heartbeat.json")
+
+
+def write_host_heartbeat(run_dir, host_id, payload):
+    """Atomically write host `host_id`'s heartbeat under the run's
+    `hosts/` directory; the `host` id is stamped into the payload so the
+    file is self-describing even when moved."""
+    path = host_heartbeat_path(run_dir, host_id)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    record = {"host": int(host_id)}
+    record.update(payload)
+    return write_heartbeat(path.parent, record, name=path.name)
+
+
+def read_host_heartbeats(run_dir):
+    """{host_id: record} over every readable per-host heartbeat of a run
+    (absent hosts simply have no entry; torn files are skipped — the
+    liveness view treats both as 'no signal yet')."""
+    hosts_dir = pathlib.Path(run_dir) / HOSTS_DIRNAME
+    out = {}
+    if not hosts_dir.is_dir():
+        return out
+    for path in sorted(hosts_dir.glob("host-*.heartbeat.json")):
+        record = read_heartbeat(hosts_dir, name=path.name)
+        if record is None:
+            continue
+        suffix = path.name[len("host-"):-len(".heartbeat.json")]
+        if suffix.isdigit():
+            out[int(suffix)] = record
+    return out

@@ -28,6 +28,9 @@ from byzantinemomentum_tpu.parallel.ring import (
     ulysses_attention,
 )
 from byzantinemomentum_tpu.parallel.sharded import (
+    global_batch,
+    global_train_state,
+    host_to_global,
     pairwise_distances_sharded,
     shard_defense_list,
     shard_defenses,
@@ -39,7 +42,8 @@ from byzantinemomentum_tpu.parallel.sharded import (
     sharded_train_step,
 )
 
-__all__ = ["make_mesh", "mesh_axes", "pairwise_distances_sharded",
+__all__ = ["global_batch", "global_train_state", "host_to_global",
+           "make_mesh", "mesh_axes", "pairwise_distances_sharded",
            "shard_defense_list", "shard_defenses", "shard_gar",
            "shard_gar_diag", "sharded_eval_many",
            "sharded_state_spec", "sharded_train_step",

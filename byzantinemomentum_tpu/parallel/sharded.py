@@ -41,7 +41,8 @@ from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.ops import pallas_sort
 from byzantinemomentum_tpu.parallel.mesh import MODEL, WORKERS, shard_map
 
-__all__ = ["pairwise_distances_sharded", "shard_defense_list",
+__all__ = ["global_batch", "global_train_state", "host_to_global",
+           "pairwise_distances_sharded", "shard_defense_list",
            "shard_defenses", "shard_gar", "shard_gar_diag",
            "sharded_eval_many", "sharded_state_spec", "sharded_train_step",
            "sharded_train_multi", "COORDINATE_WISE"]
@@ -380,6 +381,52 @@ def _coord_diag_builder(name, gar, mesh, *, f, **kwargs):
     return fn
 
 
+# ------------------------------------------------------------------------- #
+# Multi-controller (multi-process) support: the jit + shardings recipe
+# below is already multi-process-ready — the same compiled program runs on
+# every process of a `jax.distributed` fleet — but each process only holds
+# its *addressable* shards, so host-side values (freshly initialized
+# state, sampled batches) must be lifted into global `jax.Array`s before
+# they can feed a global-mesh program. Every process calls these with the
+# SAME host values (the cluster runtime's determinism contract:
+# same seed -> same init, same sampler stream -> same batch), and
+# `jax.make_array_from_callback` materializes only the shards this
+# process owns.
+
+def host_to_global(mesh, host_tree, spec_tree):
+    """Lift a host-value pytree into global arrays on `mesh` according to
+    a matching pytree of `PartitionSpec`s (leaves that are specs, e.g.
+    `sharded_state_spec`'s output)."""
+    import numpy as np
+
+    shardings = jax.tree.map(lambda p: NamedSharding(mesh, p), spec_tree,
+                             is_leaf=lambda x: isinstance(x, P))
+
+    def put(leaf, sharding):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx, a=arr: a[idx])
+
+    return jax.tree.map(put, host_tree, shardings)
+
+
+def global_train_state(mesh, state):
+    """A `TrainState` (freshly initialized or checkpoint-restored on this
+    host) as global arrays laid out per `sharded_state_spec` — the input
+    the multi-process `sharded_train_step` consumes. On a
+    (workers=N, model=1) cluster mesh every buffer is fully replicated,
+    so `jax.device_get` on the OUTPUT state works from any process (what
+    checkpointing and the study CSV read)."""
+    return host_to_global(mesh, jax.device_get(state),
+                          sharded_state_spec(state))
+
+
+def global_batch(mesh, array, spec=P(WORKERS)):
+    """One host-sampled batch as a global array sharded per `spec`
+    (default: rows along the workers axis, the training-step layout)."""
+    return host_to_global(mesh, array, spec)
+
+
 def sharded_state_spec(state):
     """PartitionSpecs for a `TrainState` on a (workers, model) mesh: all
     d-dimensional buffers shard along "model"; scalars/counters/PRNG
@@ -484,7 +531,7 @@ def _defenses_overridden(engine, defenses):
 
 
 def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
-                          engine=None):
+                          engine=None, replicate_metrics=False):
     """Shared sharding setup for the single- and multi-step programs.
 
     The traced function is wrapped in `pallas_sort.disabled()`: Mosaic
@@ -517,15 +564,21 @@ def _sharded_step_builder(step_fn, mesh, state_example, batch_spec,
         with ctx, pallas_sort.disabled(), grouped_sharded(mesh):
             return step_fn(*args)
 
+    # Single-process runs leave the metrics layout to the compiler; a
+    # multi-process fleet pins them REPLICATED so every process can read
+    # the study metrics off its own addressable shard (`jax.device_get`
+    # on a partially-addressable array would fail)
+    metrics_sharding = (NamedSharding(mesh, P()) if replicate_metrics
+                        else None)
     return jax.jit(
         traced,
         in_shardings=(state_shardings, batch_sharding, batch_sharding,
                       lr_sharding),
-        out_shardings=(state_shardings, None),
+        out_shardings=(state_shardings, metrics_sharding),
         donate_argnums=(0,))
 
 
-def sharded_train_step(engine, mesh, state_example):
+def sharded_train_step(engine, mesh, state_example, replicate_metrics=False):
     """Compile the engine's training step for a multi-chip mesh.
 
     Batches shard along "workers" (each chip computes its workers' gradients
@@ -535,11 +588,16 @@ def sharded_train_step(engine, mesh, state_example):
     Pallas for coordinate-wise rules); XLA inserts the all-gather of gradient
     rows feeding it and the collectives for the d-sharded update.
 
+    `replicate_metrics` pins the metrics output replicated — required on a
+    multi-process mesh, where every controller reads them
+    (`byzantinemomentum_tpu/cluster/host.py`).
+
     Returns `step(state, xs, ys, lr) -> (state, metrics)` — a drop-in for
     `engine.train_step`.
     """
     return _sharded_step_builder(engine._train_step, mesh, state_example,
-                                 P(WORKERS), engine=engine)
+                                 P(WORKERS), engine=engine,
+                                 replicate_metrics=replicate_metrics)
 
 
 def sharded_eval_many(engine, mesh, state_example):

@@ -41,6 +41,17 @@ On TPU, "devices" are whole accelerator slices/processes rather than the
 reference's per-GPU `--device cuda:N`: each slot exports its device string
 through the `BMT_JOB_DEVICE` environment variable and passes it to the
 driver's `--device` flag.
+
+Fleet supervision (PR 12): the multi-host cluster launcher
+(`byzantinemomentum_tpu/cluster/launcher.py`) aggregates its hosts'
+per-host heartbeats into the SAME top-level `heartbeat.json` a training
+run writes, so `Jobs(seeds=(None,), heartbeat_timeout=...)` — the
+seedless service-job form above — supervises a whole N-process fleet
+with zero changes here: a wedged launcher stalls the aggregated
+heartbeat, the watchdog SIGKILLs it (the hosts die with it through
+their launcher-held stdin pipes), and the retry's `--auto-resume`
+relaunches the fleet from the off-slice checkpoint mirror
+(`tests/test_cluster.py::test_jobs_supervises_cluster_launcher_service_job`).
 """
 
 import os

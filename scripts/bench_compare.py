@@ -44,7 +44,7 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 __all__ = ["load_artifact", "compare", "compare_attribution",
-           "compare_serve", "main"]
+           "compare_cluster", "compare_serve", "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -223,6 +223,36 @@ def compare_serve(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+def compare_cluster(old_payload, new_payload, tolerance):
+    """The multi-host gate over two `CLUSTER_r*.json` artifacts
+    (`scripts/cluster_smoke.py`): cluster steps/s is a RATE (drop past
+    tolerance fails); the recovery-step count and fleet attempts are
+    INFORMATIONAL rows (they follow the fault plan's kill step, not code
+    quality — bench_history renders their trajectory). Pairs from
+    different backends or host counts are the caller's INCOMPARABLE
+    case, as is any non-`ok` artifact (e.g. `unavailable`)."""
+    rows = []
+    regressions = []
+    old_rate = old_payload.get("steps_per_sec")
+    new_rate = new_payload.get("steps_per_sec")
+    if (isinstance(old_rate, (int, float)) and old_rate > 0
+            and isinstance(new_rate, (int, float))):
+        delta = new_rate / old_rate - 1.0
+        rows.append(("cluster.steps_per_sec", float(old_rate),
+                     float(new_rate), delta))
+        if delta < -tolerance:
+            regressions.append(rows[-1])
+    for key in ("recovery_steps", "events"):
+        old = (old_payload.get("recovery") or {}).get(key)
+        new = (new_payload.get("recovery") or {}).get(key)
+        if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+            delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                      else float("inf"))
+            rows.append((f"recovery.{key} (info)", float(old), float(new),
+                         delta))
+    return rows, regressions
+
+
 def _latest_pair():
     found = sorted(ROOT.glob("BENCH_r*.json"))
     if len(found) < 2:
@@ -299,6 +329,47 @@ def main(argv=None):
                   f"{delta * 100:+7.2f}%{flag}")
         if regressions:
             print(f"bench_compare: {len(regressions)} serve metric(s) "
+                  f"regressed past the {args.tolerance * 100:.1f}% "
+                  f"tolerance")
+            return 1
+        return 0
+
+    is_cluster = [p.get("kind") == "cluster" for p in payloads]
+    if any(is_cluster):
+        # Multi-host gate over two CLUSTER_r*.json artifacts
+        if not all(is_cluster):
+            print("bench_compare: INCOMPARABLE — one artifact is a "
+                  "cluster run, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — cluster runs from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        hosts = [p.get("hosts") for p in payloads]
+        if hosts[0] != hosts[1]:
+            print(f"bench_compare: INCOMPARABLE — different fleet sizes "
+                  f"({hosts[0]} vs {hosts[1]} hosts)")
+            return 0
+        statuses = [p.get("status") for p in payloads]
+        if any(s != "ok" for s in statuses):
+            print(f"bench_compare: INCOMPARABLE — cluster run status "
+                  f"{statuses[0]!r} vs {statuses[1]!r} (only ok runs "
+                  f"carry comparable throughput)")
+            return 0
+        rows, regressions = compare_cluster(old_payload, new_payload,
+                                            args.tolerance)
+        if not rows:
+            print("  no common cluster metrics; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.3f} -> {new:10.3f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} cluster metric(s) "
                   f"regressed past the {args.tolerance * 100:.1f}% "
                   f"tolerance")
             return 1

@@ -49,9 +49,9 @@ sys.path.insert(0, str(ROOT / "scripts"))
 
 from bench_compare import load_artifact, _rates  # noqa: E402
 
-__all__ = ["collect_history", "collect_serve", "collect_tournament",
-           "render_table", "main", "GAR_COLUMN", "SERVE_COLUMNS",
-           "TOURNAMENT_COLUMNS"]
+__all__ = ["collect_cluster", "collect_history", "collect_serve",
+           "collect_tournament", "render_table", "main", "GAR_COLUMN",
+           "CLUSTER_COLUMNS", "SERVE_COLUMNS", "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -173,6 +173,44 @@ def collect_tournament(root, labels):
             if (stats := _tournament_stats(root, label)) is not None}
 
 
+# Multi-host trajectory columns (`scripts/cluster_smoke.py` artifacts):
+# fleet size, lockstep cluster throughput, and the steps each chaos
+# round's recovery re-executed (kill-to-restart distance — follows the
+# fault plan, rendered for trend, gated nowhere)
+CLUSTER_COLUMNS = ("hosts", "cluster steps/s", "recovery steps")
+
+
+def _cluster_stats(root, label):
+    """`{hosts, rate, recovery_steps, backend} | None` for one round's
+    cluster artifact: `CLUSTER_r*.json` per round, the working tree's
+    `CLUSTER.json` for the `current` row. Non-`ok` rounds (e.g. an
+    `unavailable` runtime) are INCOMPARABLE for this instrument."""
+    name = ("CLUSTER.json" if label == "current"
+            else f"CLUSTER_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or payload.get("kind") != "cluster":
+        return None
+    if payload.get("status") != "ok":
+        return None
+    rate = payload.get("steps_per_sec")
+    return {"hosts": payload.get("hosts"),
+            "rate": float(rate) if isinstance(rate, (int, float)) else None,
+            "recovery_steps": (payload.get("recovery") or {}).get(
+                "recovery_steps"),
+            "backend": payload.get("backend")}
+
+
+def collect_cluster(root, labels):
+    """{label: cluster stats} over the history rows (independent
+    instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _cluster_stats(root, label)) is not None}
+
+
 def collect_history(root=ROOT):
     """[(label, rates | None, reason | None, gar)] over every round
     artifact (sorted by round number) plus the working tree's
@@ -197,7 +235,8 @@ def collect_history(root=ROOT):
                           ("BENCH_serve_r*.json",
                            r"BENCH_serve_r(\d+)\.json$"),
                           ("TOURNAMENT_r*.json",
-                           r"TOURNAMENT_r(\d+)\.json$")):
+                           r"TOURNAMENT_r(\d+)\.json$"),
+                          ("CLUSTER_r*.json", r"CLUSTER_r(\d+)\.json$")):
         for path in root.glob(glob):
             m = re.search(pattern, path.name)
             if m:
@@ -207,7 +246,8 @@ def collect_history(root=ROOT):
     current = root / "BENCH_cells.json"
     if (current.is_file() or (root / "attribution.json").is_file()
             or (root / "BENCH_serve.json").is_file()
-            or (root / "TOURNAMENT.json").is_file()):
+            or (root / "TOURNAMENT.json").is_file()
+            or (root / "CLUSTER.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
     for label, path in zip(labels, paths):
@@ -236,22 +276,25 @@ def _load_rates(path):
     return rates, None
 
 
-def render_table(history, serve=None, tournament=None):
+def render_table(history, serve=None, tournament=None, cluster=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
-    attribution column, the serve p50/p99/throughput columns and the
-    tournament defense-loop columns when any round carries the matching
+    attribution column, the serve p50/p99/throughput columns, the
+    tournament defense-loop columns and the multi-host hosts/steps-per-s/
+    recovery-steps columns when any round carries the matching
     artifact."""
     serve = serve or {}
     tournament = tournament or {}
+    cluster = cluster or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
             if name not in columns:
                 columns.append(name)
     any_gar = any(gar is not None for _, _, _, gar in history)
-    if not columns and not any_gar and not serve and not tournament:
+    if not columns and not any_gar and not serve and not tournament \
+            and not cluster:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -262,6 +305,8 @@ def render_table(history, serve=None, tournament=None):
         columns = columns + list(SERVE_COLUMNS)
     if tournament:
         columns = columns + list(TOURNAMENT_COLUMNS)
+    if cluster:
+        columns = columns + list(CLUSTER_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -283,6 +328,14 @@ def render_table(history, serve=None, tournament=None):
             notes.append(f"  {label}: serve columns from a "
                          f"backend={row_serve['backend']} load report")
         row_tournament = tournament.get(label)
+        row_cluster = cluster.get(label)
+        if row_cluster is not None and row_cluster.get("backend") not in (
+                None, "native"):
+            # Cluster steps/s from the CPU-simulated fleet: comparable to
+            # other CPU rounds only (the bench_compare cross-backend
+            # discipline); flagged so a future native round stands out
+            notes.append(f"  {label}: cluster columns from a "
+                         f"backend={row_cluster['backend']} fleet")
 
         def cell(c, w):
             if c == GAR_COLUMN:
@@ -304,6 +357,16 @@ def render_table(history, serve=None, tournament=None):
                          else row_tournament.get(key))
                 if value is None:
                     return f"{'-':>{w}}"
+                return f"{int(value):>{w}d}"
+            if c in CLUSTER_COLUMNS:
+                key = {"hosts": "hosts", "cluster steps/s": "rate",
+                       "recovery steps": "recovery_steps"}[c]
+                value = (None if row_cluster is None
+                         else row_cluster.get(key))
+                if value is None:
+                    return f"{'-':>{w}}"
+                if key == "rate":
+                    return f"{value:>{w}.3f}"
                 return f"{int(value):>{w}d}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
@@ -338,16 +401,19 @@ def main(argv=None):
                           [label for label, *_ in history])
     tournament = collect_tournament(pathlib.Path(args.root),
                                     [label for label, *_ in history])
+    cluster = collect_cluster(pathlib.Path(args.root),
+                              [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
              "gar_ms_per_step": None if gar is None else gar[0],
              "gar_backend": None if gar is None else gar[1],
              "serve": serve.get(label),
-             "tournament": tournament.get(label)}
+             "tournament": tournament.get(label),
+             "cluster": cluster.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
-    print(render_table(history, serve, tournament))
+    print(render_table(history, serve, tournament, cluster))
     return 0
 
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""The multi-host chaos acceptance, as one command: an N-host CPU-backend
+fleet trains uninterrupted; a second identical fleet has one host
+SIGKILLed mid-step by the system-level FaultPlan, recovers through the
+launcher (manifest-agreed restart step, off-slice mirror, auto-resume —
+the dead host's local directory is deleted at teardown), and the resumed
+study CSV must be BIT-IDENTICAL to the uninterrupted run's.
+
+Writes a `CLUSTER.json` artifact (`"kind": "cluster"`) merging the
+uninterrupted fleet's throughput + census/zero-recompile verdicts with
+the chaos fleet's recovery record and the bit-identity bit — the
+artifact `scripts/bench_compare.py` gates and `scripts/bench_history.py`
+renders across rounds (`CLUSTER_r*.json`). An unavailable distributed
+runtime produces a clean `"status": "unavailable"` artifact and exit 0
+(the bench.py cpu-fallback discipline) — never an rc=124 hang.
+
+Usage:
+  python scripts/cluster_smoke.py --smoke            # 2 hosts, CI size
+  python scripts/cluster_smoke.py --hosts 4 --steps 12 --out CLUSTER.json
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def _env():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("BMT_SYNTH_TRAIN", "512")
+    env.setdefault("BMT_SYNTH_TEST", "128")
+    return env
+
+
+def _launch(resdir, hosts, steps, extra, timeout):
+    cmd = [sys.executable, "-m", "byzantinemomentum_tpu.cluster",
+           "--hosts", str(hosts), "--result-directory", str(resdir),
+           "--nb-steps", str(steps), "--checkpoint-delta", "2",
+           "--poll", "0.1", *extra]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=ROOT, env=_env(), capture_output=True,
+                          text=True, timeout=timeout)
+    elapsed = time.monotonic() - t0
+    artifact = None
+    try:
+        artifact = json.loads((resdir / "CLUSTER.json").read_text())
+    except (OSError, ValueError):
+        pass
+    return proc, artifact, elapsed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="cluster_smoke")
+    parser.add_argument("--hosts", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=6)
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI preset: 2 hosts, 6 steps")
+    parser.add_argument("--kill-step", type=int, default=None,
+                        help="cluster step at which the chaos plan kills "
+                             "a host (default: steps // 2)")
+    parser.add_argument("--workdir", type=str, default=None,
+                        help="keep the run directories here instead of a "
+                             "temp dir")
+    parser.add_argument("--out", type=str, default=None,
+                        help="artifact path (default: <workdir>/"
+                             "CLUSTER.json; pass the repo root to commit "
+                             "a round)")
+    parser.add_argument("--timeout", type=float, default=1200.0,
+                        help="bound on EACH fleet run in seconds")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.hosts, args.steps = 2, 6
+    if args.hosts < 2:
+        parser.error("the recovery proof needs at least 2 hosts")
+    # Default kill step: mid-run, and ODD so it lands between the
+    # checkpoint-delta-2 milestones — the recovery then provably
+    # re-executes at least one step instead of resuming for free
+    kill_step = args.kill_step
+    if kill_step is None:
+        kill_step = max(1, args.steps // 2)
+        kill_step += 1 - (kill_step % 2)
+
+    workdir = pathlib.Path(args.workdir) if args.workdir else pathlib.Path(
+        tempfile.mkdtemp(prefix="bmt-cluster-smoke-"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    out = pathlib.Path(args.out) if args.out else workdir / "CLUSTER.json"
+
+    def finish(payload, rc):
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(payload, indent="\t", sort_keys=True)
+                       + "\n")
+        print("cluster-smoke: " + json.dumps(
+            {"status": payload.get("status"),
+             "hosts": payload.get("hosts"),
+             "steps_per_sec": payload.get("steps_per_sec"),
+             "recovery_steps": (payload.get("recovery") or {}).get(
+                 "recovery_steps"),
+             "bit_identical": payload.get("bit_identical"),
+             "artifact": str(out)}), flush=True)
+        if args.workdir is None and rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return rc
+
+    # --- fleet A: uninterrupted (throughput + census + zero-recompile) --- #
+    full_dir = workdir / "full"
+    proc, full_art, _ = _launch(
+        full_dir, args.hosts, args.steps,
+        ["--recompile-check", "2", "--lattice-census"], args.timeout)
+    if full_art is None:
+        print(proc.stdout[-2000:] + proc.stderr[-2000:], file=sys.stderr)
+        return finish({"kind": "cluster", "hosts": args.hosts,
+                       "status": "crashed", "steps_per_sec": None}, 1)
+    if full_art.get("status") == "unavailable":
+        # Bounded-timeout contract: clean artifact, exit 0, no rc=124
+        return finish(full_art, 0)
+    if proc.returncode != 0 or full_art.get("status") != "ok":
+        print(proc.stdout[-2000:] + proc.stderr[-2000:], file=sys.stderr)
+        return finish(dict(full_art, status="failed"), 1)
+
+    # --- fleet B: one host SIGKILLed mid-step, recovered, bit-compared --- #
+    from byzantinemomentum_tpu.faults import FaultPlan
+    from byzantinemomentum_tpu.faults.plan import device_loss
+
+    chaos_dir = workdir / "chaos"
+    plan_path = workdir / "system-fault-plan.json"
+    # Kill the highest host index: never the coordinator (host 0), and
+    # with >2 hosts the survivors outnumber the dead — the quorum story
+    FaultPlan(events=(device_loss(args.hosts - 1, kill_step),)).save(
+        plan_path)
+    proc, chaos_art, _ = _launch(
+        chaos_dir, args.hosts, args.steps,
+        ["--fault-plan", str(plan_path), "--auto-resume",
+         "--fleet-retries", "2"], args.timeout)
+    if proc.returncode != 0 or chaos_art is None \
+            or chaos_art.get("status") != "ok":
+        print(proc.stdout[-2000:] + proc.stderr[-2000:], file=sys.stderr)
+        return finish(dict(chaos_art or {"kind": "cluster"},
+                           status="chaos_failed", hosts=args.hosts), 1)
+
+    recovery = chaos_art.get("recovery") or {}
+    if not recovery.get("events"):
+        return finish(dict(chaos_art, status="no_kill_observed"), 1)
+
+    try:
+        identical = ((full_dir / "study").read_bytes()
+                     == (chaos_dir / "study").read_bytes())
+    except OSError:
+        identical = False
+
+    artifact = dict(full_art)
+    artifact["recovery"] = recovery
+    artifact["bit_identical"] = bool(identical)
+    artifact["kill_step"] = kill_step
+    if not identical:
+        artifact["status"] = "divergent_resume"
+        return finish(artifact, 1)
+    return finish(artifact, 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -259,6 +259,41 @@ def main():
                        if k != "tail"})
     print(f"  {tournament_tier}", flush=True)
 
+    # Cluster tier (PR 12): the multi-host recovery proof — a 2-host
+    # multi-process CPU fleet trains uninterrupted, a second fleet has
+    # one host SIGKILLed mid-step by the system-level FaultPlan and must
+    # recover (manifest-agreed restart step, off-slice mirror,
+    # auto-resume) to a BIT-IDENTICAL study CSV — plus the cross-host
+    # lattice census and the zero-recompile assertion on the
+    # multi-process step. Own green bit + telemetry span recording host
+    # count and recovery steps. An unavailable distributed runtime is a
+    # clean `unavailable` artifact with rc 0, never an rc=124 hang.
+    print("cluster tier ...", flush=True)
+    with telemetry.span("tier_cluster"):
+        cluster_proc = subprocess.run(
+            [sys.executable, "scripts/cluster_smoke.py", "--smoke"],
+            cwd=ROOT, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    cluster_tier = {"returncode": cluster_proc.returncode}
+    for line in cluster_proc.stdout.splitlines():
+        if line.startswith("cluster-smoke: "):
+            try:
+                payload = json.loads(line[len("cluster-smoke: "):])
+            except ValueError:
+                continue
+            cluster_tier["status"] = payload.get("status")
+            cluster_tier["hosts"] = payload.get("hosts")
+            cluster_tier["steps_per_sec"] = payload.get("steps_per_sec")
+            cluster_tier["recovery_steps"] = payload.get("recovery_steps")
+            cluster_tier["bit_identical"] = payload.get("bit_identical")
+    if cluster_proc.returncode != 0:
+        cluster_tier["tail"] = (cluster_proc.stdout
+                                + cluster_proc.stderr).splitlines()[-12:]
+    telemetry.event("cluster_tier",
+                    **{k: v for k, v in cluster_tier.items()
+                       if k != "tail"})
+    print(f"  {cluster_tier}", flush=True)
+
     shards = {}
     for path in sorted((ROOT / "tests").glob("test_*.py")):
         print(f"slow tier: {path.name} ...", flush=True)
@@ -292,6 +327,7 @@ def main():
         "nopallas_tier": nopallas,
         "serve_tier": serve_tier,
         "tournament_tier": tournament_tier,
+        "cluster_tier": cluster_tier,
         "slow_tier_total": slow_total,
         "slow_tier_shards": shards,
         "telemetry": telemetry.path.name,
@@ -305,6 +341,7 @@ def main():
                       and nopallas["returncode"] == 0
                       and serve_tier["returncode"] == 0
                       and tournament_tier["returncode"] == 0
+                      and cluster_tier["returncode"] == 0
                       and slow_total["failed"] == 0
                       and all(s["returncode"] == 0 for s in shards.values())),
     }

@@ -273,6 +273,49 @@ def test_framing_cell_zero_honest_evictions():
     assert row["evicted_honest"] == 0
 
 
+def test_mimic_rows_are_byte_copies_of_the_victim():
+    rng = np.random.default_rng(3)
+    honests = jnp.asarray(rng.normal(size=(6, 16)).astype(np.float32))
+    rows = attacks_mod.attacks["mimic"].checked(
+        honests, f_decl=3, f_real=3, defense=lambda **kw: None, victim=2)
+    assert rows.shape == (3, 16)
+    assert (np.asarray(rows) == np.asarray(honests[2])).all()
+    # jitter decorrelates the copies (the collusion-threshold probe knob)
+    blurred = attacks_mod.attacks["mimic"].checked(
+        honests, f_decl=3, f_real=3, defense=lambda **kw: None, victim=2,
+        jitter=0.5)
+    assert not (np.asarray(blurred[0]) == np.asarray(blurred[1])).all()
+    # Contract errors stay readable
+    assert "victim" in attacks_mod.attacks["mimic"].check(
+        grad_honests=honests, f_decl=3, f_real=3,
+        defense=lambda **kw: None, victim=9)
+
+
+def test_mimic_cell_zero_honest_evictions_dedup_keeps_victim():
+    """The tournament regression the fielded mimicry attack pins
+    (ROADMAP arena rung 1): byte-copies of an honest victim's row form a
+    collusion cluster CONTAINING the victim — dedup must evict the
+    copies (quorum reclaimed: a copy adds no adversarial dimension) and
+    keep the victim, on the attacker's schedule or any other. Zero
+    honest evictions, every Byzantine copy out."""
+    cell = ArenaCell("krum", "mimic", n=11, f_decl=3, f_real=3, d=32)
+    row = cell.run(quarantine=True, steps=60, seed=0)
+    assert row["evicted_honest"] == 0
+    assert row["evicted_byz"] == 3
+    assert row["f_reclaimed"] == 3  # dedup evictions reclaim quorum
+    assert row["time_to_quarantine"] is not None
+
+
+def test_mimic_rides_the_tournament_grid():
+    """The registry-driven roster fields mimic automatically; it stays
+    OFF the dominance list (honest-valued rows never bias the
+    aggregate — its acceptance metric is the eviction regression
+    above)."""
+    labels = [label for label, *_ in tournament.train_roster()]
+    assert "mimic" in labels
+    assert "mimic" not in tournament.ADAPTIVE_ATTACKS
+
+
 def test_noniid_batches_skew_moves_worker_means():
     rng = np.random.default_rng(0)
     optimum = np.zeros(16, np.float32)

@@ -552,3 +552,94 @@ def test_bench_history_tournament_columns(tmp_path, capsys):
     by_round = {row["round"]: row for row in payload}
     assert by_round["r02"]["tournament"]["ttq_median"] == 15
     assert by_round["r01"]["tournament"] is None
+
+
+# --------------------------------------------------------------------------- #
+# Cluster gate + history columns (multi-host CLUSTER_r*.json artifacts)
+
+def _cluster_artifact(tmp_path, name, rate, hosts=2, status="ok",
+                      backend="cpu", recovery_steps=1, events=1):
+    payload = {"kind": "cluster", "backend": backend, "status": status,
+               "hosts": hosts, "steps": 12, "steps_per_sec": rate,
+               "recovery": {"events": events,
+                            "recovery_steps": recovery_steps,
+                            "attempts": events + 1}}
+    path = tmp_path / name
+    path.write_text(json.dumps(payload))
+    return path
+
+
+def test_cluster_gate_within_tolerance_passes(tmp_path, capsys):
+    old = _cluster_artifact(tmp_path, "CLUSTER_r12.json", 1.00)
+    new = _cluster_artifact(tmp_path, "CLUSTER_r13.json", 0.98,
+                            recovery_steps=3)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "cluster.steps_per_sec" in out
+    # recovery rows render for trend but never gate
+    assert "recovery.recovery_steps (info)" in out
+
+
+def test_cluster_gate_throughput_drop_fails(tmp_path, capsys):
+    old = _cluster_artifact(tmp_path, "CLUSTER_r12.json", 1.00)
+    new = _cluster_artifact(tmp_path, "CLUSTER_r13.json", 0.80)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSED" in out
+
+
+def test_cluster_gate_incomparable_pairs(tmp_path, capsys):
+    ok = _cluster_artifact(tmp_path, "CLUSTER_r12.json", 1.0)
+    # Different backend (the CPU-simulated fleet vs a native one)
+    other = _cluster_artifact(tmp_path, "CLUSTER_native.json", 3.0,
+                              backend="native")
+    assert bench_compare.main([str(ok), str(other)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+    # Different fleet size
+    wide = _cluster_artifact(tmp_path, "CLUSTER_wide.json", 1.4, hosts=4)
+    assert bench_compare.main([str(ok), str(wide)]) == 0
+    assert "fleet sizes" in capsys.readouterr().out
+    # An unavailable round carries no comparable throughput
+    unavail = _cluster_artifact(tmp_path, "CLUSTER_un.json", None,
+                                status="unavailable")
+    assert bench_compare.main([str(ok), str(unavail)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+    # Mixed kinds
+    bench = _artifact(tmp_path, "BENCH_r09.json", 10.0)
+    assert bench_compare.main([str(ok), str(bench)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+
+
+def test_bench_history_cluster_columns(tmp_path, capsys):
+    """hosts / cluster steps-per-s / recovery-steps columns render from
+    committed CLUSTER_r*.json artifacts; a cluster-only round still gets
+    a row, non-ok rounds dash out, and --json carries the dict."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _cluster_artifact(tmp_path, "CLUSTER_r02.json", 0.9, hosts=4,
+                      recovery_steps=2)
+    _cluster_artifact(tmp_path, "CLUSTER_r03.json", None,
+                      status="unavailable")
+
+    stats = bench_history.collect_cluster(tmp_path, ["r01", "r02", "r03"])
+    assert "r01" not in stats and "r03" not in stats
+    assert stats["r02"]["hosts"] == 4
+    assert stats["r02"]["recovery_steps"] == 2
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for column in bench_history.CLUSTER_COLUMNS:
+        assert column in out
+    r02 = [l for l in out.splitlines() if l.startswith("r02")][0]
+    assert r02.split()[-3:] == ["4", "0.900", "2"]
+    assert "backend=cpu fleet" in out  # flagged: CPU-simulated fleet
+
+    rc = bench_history.main(["--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_round = {row["round"]: row for row in payload}
+    assert by_round["r02"]["cluster"]["rate"] == 0.9
+    assert by_round["r01"]["cluster"] is None

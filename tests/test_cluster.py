@@ -1,0 +1,382 @@
+"""Multi-host cluster runtime (`byzantinemomentum_tpu/cluster/`): the
+consensus manifest, the heartbeat-aggregated liveness view, the
+system-scope fault driver, off-slice checkpoint mirroring, bounded
+unavailability, and — slow-marked — the real multi-process fleets: the
+kill-one-host recovery proof (bit-identical resumed study CSV) and the
+Jobs supervisor driving the launcher through the seedless service-job
+form."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+from flax import serialization
+
+from byzantinemomentum_tpu import checkpoint
+from byzantinemomentum_tpu.cluster import (
+    HostSpec, SystemFaultDriver, agree_restart_step, liveness_view,
+    read_cluster_manifest, update_cluster_manifest, write_cluster_manifest)
+from byzantinemomentum_tpu.cluster.runtime import UNAVAILABLE_RC, free_port
+from byzantinemomentum_tpu.faults import FaultPlan
+from byzantinemomentum_tpu.faults.plan import (
+    corrupt_gradient, device_loss, drop_worker)
+from byzantinemomentum_tpu.obs.heartbeat import (
+    host_heartbeat_path, read_host_heartbeats, write_host_heartbeat)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _fake_checkpoint(directory, step):
+    """A minimal file `checkpoint.verify` accepts (version + state dict +
+    integrity footer) — enough for the resume-scan machinery without
+    building an engine."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    payload = {"version": checkpoint.VERSION, "state": {"steps": step}}
+    path = directory / f"checkpoint-{step}"
+    path.write_bytes(checkpoint.seal(
+        serialization.msgpack_serialize(payload)))
+    return path
+
+
+# --------------------------------------------------------------------------- #
+# Runtime spec + port probing
+
+def test_host_spec_validation():
+    with pytest.raises(ValueError, match="process count"):
+        HostSpec("127.0.0.1:1", 0, 0)
+    with pytest.raises(ValueError, match="outside"):
+        HostSpec("127.0.0.1:1", 2, 2)
+    with pytest.raises(ValueError, match="timeout"):
+        HostSpec("127.0.0.1:1", 2, 1, connect_timeout=0)
+    spec = HostSpec("127.0.0.1:1", 4, 3)
+    assert spec.connect_timeout == 60.0
+
+
+def test_free_port_is_bindable():
+    import socket
+
+    port = free_port()
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", port))
+
+
+# --------------------------------------------------------------------------- #
+# Cluster manifest: the consensus artifact
+
+def test_manifest_roundtrip_and_defaults(tmp_path):
+    manifest = read_cluster_manifest(tmp_path)
+    assert manifest["restart_step"] is None
+    assert manifest["fired_faults"] == []
+    manifest["restart_step"] = 4
+    manifest["fired_faults"] = [0]
+    write_cluster_manifest(tmp_path, manifest)
+    again = read_cluster_manifest(tmp_path)
+    assert again["restart_step"] == 4 and again["fired_faults"] == [0]
+    update_cluster_manifest(tmp_path, status="recovering", attempt=2)
+    final = read_cluster_manifest(tmp_path)
+    assert final["status"] == "recovering" and final["attempt"] == 2
+    assert final["restart_step"] == 4  # update merges, never clobbers
+
+
+def test_manifest_torn_file_means_defaults(tmp_path):
+    (tmp_path / "cluster.json").write_text("{ torn")
+    assert read_cluster_manifest(tmp_path)["attempt"] == 0
+
+
+def test_agree_restart_step_reads_only_the_mirror(tmp_path):
+    mirror = tmp_path / "mirror"
+    assert agree_restart_step(mirror) == (None, None)
+    _fake_checkpoint(mirror, 2)
+    newest = _fake_checkpoint(mirror, 6)
+    # A torn newer file must be walked past, not adopted
+    torn = mirror / "checkpoint-8"
+    torn.write_bytes(newest.read_bytes()[:10])
+    step, path = agree_restart_step(mirror)
+    assert step == 6 and path.name == "checkpoint-6"
+
+
+# --------------------------------------------------------------------------- #
+# Per-host heartbeats -> liveness view
+
+def test_host_heartbeats_roundtrip(tmp_path):
+    write_host_heartbeat(tmp_path, 0, {"step": 3, "status": "running"})
+    write_host_heartbeat(tmp_path, 2, {"step": 5, "status": "running"})
+    # A torn heartbeat is skipped, not fatal
+    host_heartbeat_path(tmp_path, 1).write_text("{ torn")
+    beats = read_host_heartbeats(tmp_path)
+    assert sorted(beats) == [0, 2]
+    assert beats[0]["host"] == 0 and beats[0]["step"] == 3
+    assert beats[2]["pid"] == os.getpid()  # stamped, self-describing
+
+
+def test_liveness_view_statuses(tmp_path):
+    now = time.time()
+    write_host_heartbeat(tmp_path, 0, {"step": 4, "resume_step": 2})
+    write_host_heartbeat(tmp_path, 1, {"step": 3})
+    view = liveness_view(tmp_path, 4, stale_after=30.0,
+                         running={0: True, 1: True, 2: True, 3: False},
+                         now=now)
+    assert view["hosts"][0]["status"] == "alive"
+    assert view["hosts"][0]["resume_step"] == 2
+    assert view["hosts"][1]["status"] == "alive"
+    assert view["hosts"][2]["status"] == "unknown"  # no signal yet
+    assert view["hosts"][3]["status"] == "dead"     # process table wins
+    assert view["alive"] == [0, 1]
+    assert view["min_step"] == 3 and view["max_step"] == 4
+    # A fresh-looking heartbeat from a dead process is still dead
+    write_host_heartbeat(tmp_path, 3, {"step": 9})
+    view = liveness_view(tmp_path, 4, running={0: True, 1: True, 2: True,
+                                               3: False}, now=now)
+    assert view["hosts"][3]["status"] == "dead"
+    assert view["max_step"] == 4  # dead hosts' steps never count
+
+
+def test_liveness_view_staleness(tmp_path):
+    write_host_heartbeat(tmp_path, 0, {"step": 1})
+    later = time.time() + 100.0
+    view = liveness_view(tmp_path, 1, stale_after=30.0,
+                         running={0: True}, now=later)
+    assert view["hosts"][0]["status"] == "stale"
+    assert view["alive"] == []
+
+
+# --------------------------------------------------------------------------- #
+# System-scope fault plans
+
+def test_validate_system_scope():
+    plan = FaultPlan(events=(device_loss(1, 3),))
+    assert plan.validate_system(2) is None
+    assert "only" in FaultPlan(events=(drop_worker(1, 3),)
+                               ).validate_system(2)
+    assert "only" in FaultPlan(events=(corrupt_gradient(1, 3),)
+                               ).validate_system(4)
+    assert "2 hosts" in FaultPlan(events=(device_loss(2, 3),)
+                                  ).validate_system(2)
+    assert "coordinator" in FaultPlan(events=(device_loss(0, 3),)
+                                      ).validate_system(2)
+
+
+def test_system_fault_driver_fires_once():
+    plan = FaultPlan(events=(device_loss(1, 3), device_loss(2, 5)))
+    driver = SystemFaultDriver(plan, 4)
+    assert driver.due(None) == []          # no heartbeat yet
+    assert driver.due(2) == []
+    due = driver.due(3)
+    assert [(i, e.worker) for i, e in due] == [(0, 1)]
+    driver.mark(0)
+    assert driver.due(4) == []             # fired events never re-fire
+    assert not driver.exhausted()
+    due = driver.due(9)                    # late poll catches up
+    assert [(i, e.worker) for i, e in due] == [(1, 2)]
+    driver.mark(1)
+    assert driver.exhausted() and driver.fired() == [0, 1]
+    # A relaunched launcher rebuilds from the persisted record
+    again = SystemFaultDriver(plan, 4, fired=driver.fired())
+    assert again.due(99) == []
+
+
+def test_system_fault_driver_rejects_bad_plans():
+    with pytest.raises(ValueError, match="system scope"):
+        SystemFaultDriver(FaultPlan(events=(drop_worker(1, 1),)), 2)
+
+
+# --------------------------------------------------------------------------- #
+# Off-slice checkpoint mirroring
+
+def test_find_latest_valid_any_prefers_newest_across_dirs(tmp_path):
+    local = tmp_path / "local"
+    mirror = tmp_path / "mirror"
+    _fake_checkpoint(local, 4)
+    _fake_checkpoint(mirror, 6)
+    found = checkpoint.find_latest_valid_any((local, mirror))
+    assert found.parent == mirror and checkpoint.checkpoint_step(found) == 6
+    # Losing the whole local directory costs nothing
+    found = checkpoint.find_latest_valid_any((tmp_path / "gone", mirror))
+    assert checkpoint.checkpoint_step(found) == 6
+    # None entries (no mirror configured) are skipped
+    found = checkpoint.find_latest_valid_any((local, None))
+    assert checkpoint.checkpoint_step(found) == 4
+    assert checkpoint.find_latest_valid_any((None, None)) is None
+
+
+def test_save_mirror_writes_both_copies(tmp_path):
+    import jax
+
+    from byzantinemomentum_tpu import losses, ops
+    from byzantinemomentum_tpu.arena.loop import probe_loss, probe_model_def
+    from byzantinemomentum_tpu.engine import EngineConfig, build_engine
+
+    engine = build_engine(
+        cfg=EngineConfig(nb_workers=3, nb_decl_byz=0, nb_real_byz=0,
+                         nb_for_study=0),
+        model_def=probe_model_def(4), loss=probe_loss(),
+        criterion=losses.Criterion("sigmoid"),
+        defenses=[(ops.gars["average"], 1.0, {})])
+    state = engine.init(jax.random.PRNGKey(0))
+    local = tmp_path / "local"
+    mirror = tmp_path / "mirror"
+    local.mkdir()
+    checkpoint.save(local / "checkpoint-0", state, mirror=mirror)
+    assert (local / "checkpoint-0").read_bytes() == \
+        (mirror / "checkpoint-0").read_bytes()
+    # Both directories carry their own manifest entry
+    assert checkpoint.read_manifest(local)["checkpoints"][0]["step"] == 0
+    assert checkpoint.read_manifest(mirror)["checkpoints"][0]["step"] == 0
+    # And both copies verify + load independently
+    assert checkpoint.verify(mirror / "checkpoint-0")
+    restored = checkpoint.load(mirror / "checkpoint-0", state)
+    assert int(restored.steps) == 0
+
+
+# --------------------------------------------------------------------------- #
+# Bounded unavailability (the MULTICHIP_r05 lesson, satellite)
+
+def test_unreachable_coordinator_is_a_clean_bounded_exit(tmp_path):
+    """A follower whose coordinator never answers must exit with the
+    reserved UNAVAILABLE_RC within its bounded timeout — a clean
+    machine-readable line, never an rc=124 CI hang."""
+    port = free_port()  # probed then released: nothing listens on it
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "byzantinemomentum_tpu.cluster.host",
+         "--procs", "2", "--proc-id", "1",
+         "--coordinator", f"127.0.0.1:{port}",
+         "--connect-timeout", "2",
+         "--result-directory", str(tmp_path / "run"),
+         "--mirror", str(tmp_path / "mirror")],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == UNAVAILABLE_RC, proc.stderr[-2000:]
+    assert "cluster-host: unavailable:" in proc.stdout
+    assert elapsed < 90  # bounded: the 2s timeout plus process overhead
+
+
+# --------------------------------------------------------------------------- #
+# Driver integration: --checkpoint-mirror resumes through the mirror
+
+def test_driver_checkpoint_mirror_survives_local_loss(tmp_path,
+                                                      monkeypatch):
+    """`cli/attack.py --checkpoint-mirror`: checkpoints land in both
+    directories, and after the run directory's local checkpoints are
+    destroyed, `--auto-resume` restarts from the mirror's copy."""
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "256")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "64")
+    from byzantinemomentum_tpu.cli.attack import main
+
+    resdir = tmp_path / "run"
+    mirror = tmp_path / "offslice"
+    argv = ["--nb-steps", "4", "--batch-size", "8",
+            "--batch-size-test", "32", "--batch-size-test-reps", "1",
+            "--evaluation-delta", "0", "--checkpoint-delta", "2",
+            "--model", "simples-full", "--seed", "7", "--gar", "median",
+            "--nb-for-study", "0", "--auto-resume",
+            "--result-directory", str(resdir),
+            "--checkpoint-mirror", str(mirror)]
+    assert main(argv) == 0
+    assert (resdir / "checkpoint-2").is_file()
+    assert (mirror / "checkpoint-2").is_file()
+    # The local slice dies; the mirror is the only surviving copy
+    for path in resdir.glob("checkpoint-*"):
+        path.unlink()
+    assert main(argv) == 0
+    from byzantinemomentum_tpu import obs
+
+    records = obs.load_records(resdir)
+    restarts = [r for r in records if r.get("name") == "restart"]
+    assert restarts and restarts[-1]["data"]["step"] >= 2
+
+
+# --------------------------------------------------------------------------- #
+# The real fleets (slow): recovery proof + Jobs supervision
+
+def _smoke_env():
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BMT_SYNTH_TRAIN="512",
+               BMT_SYNTH_TEST="128")
+    return env
+
+
+@pytest.mark.slow
+def test_cluster_kill_one_host_recovery_is_bit_identical(tmp_path):
+    """The chaos acceptance at CI size: 2-host fleet, one host SIGKILLed
+    mid-step by the system FaultPlan, launcher-recovered through the
+    manifest + mirror; the resumed study CSV equals the uninterrupted
+    fleet's byte for byte and the consensus trail is on the timeline."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/cluster_smoke.py", "--smoke",
+         "--workdir", str(tmp_path)],
+        cwd=ROOT, env=_smoke_env(), capture_output=True, text=True,
+        timeout=1100)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("cluster-smoke: ")][-1]
+    payload = json.loads(line[len("cluster-smoke: "):])
+    assert payload["status"] == "ok"
+    assert payload["bit_identical"] is True
+    assert payload["recovery_steps"] >= 1
+    artifact = json.loads((tmp_path / "CLUSTER.json").read_text())
+    assert artifact["kind"] == "cluster" and artifact["hosts"] == 2
+    assert artifact["census"]["ok"] is True
+    assert artifact["zero_recompile"]["asserted"] is True
+    # The consensus trail: the chaos fleet's manifest fired the fault
+    # once and recorded the agreed restart step; the relaunched hosts
+    # reported unanimous adoption (restart_agreed on the timeline)
+    manifest = json.loads((tmp_path / "chaos" / "cluster.json").read_text())
+    assert manifest["fired_faults"] == [0]
+    assert manifest["recoveries"][0]["restart_step"] is not None
+    events = [json.loads(l)["name"]
+              for l in (tmp_path / "chaos"
+                        / "telemetry.jsonl").read_text().splitlines()
+              if '"kind":"event"' in l]
+    assert "fault_injected" in events
+    assert "host_dead" in events
+    assert "restart_agreed" in events
+
+
+@pytest.mark.slow
+def test_jobs_supervises_cluster_launcher_service_job(tmp_path,
+                                                      monkeypatch):
+    """Satellite: the Jobs watchdog consumes the launcher's AGGREGATED
+    cluster heartbeat through the seedless service-job form. The wedge
+    hook kills the fleet and silences the launcher mid-run; the watchdog
+    must SIGKILL the launcher and the retry (with --auto-resume, in the
+    same pending dir) must resume the whole fleet to a study CSV
+    bit-identical to an uninterrupted fleet's."""
+    from byzantinemomentum_tpu.utils.jobs import Jobs
+
+    env = _smoke_env()
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    # Reference fleet: uninterrupted
+    full = tmp_path / "full"
+    proc = subprocess.run(
+        [sys.executable, "-m", "byzantinemomentum_tpu.cluster",
+         "--hosts", "2", "--result-directory", str(full),
+         "--nb-steps", "4", "--checkpoint-delta", "2", "--poll", "0.1"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+
+    # Supervised fleet: wedges at step 2 on the first attempt only (the
+    # fuse file lives in the pending dir the retry shares)
+    monkeypatch.setenv("BMT_CHAOS_CLUSTER_WEDGE_AT", "2")
+    grid = tmp_path / "grid"
+    command = [sys.executable, "-m", "byzantinemomentum_tpu.cluster",
+               "--hosts", "2", "--nb-steps", "4",
+               "--checkpoint-delta", "2", "--poll", "0.1",
+               "--fleet-retries", "0"]
+    jobs = Jobs(grid, seeds=(None,), max_retries=1, retry_backoff=0,
+                heartbeat_timeout=5.0)
+    jobs.submit("fleet", command)
+    jobs.wait()
+    done = grid / "fleet"
+    assert done.is_dir(), list(grid.iterdir())
+    assert (done / "wedge.fired").exists()  # the first attempt really hung
+    assert (done / "study").read_bytes() == (full / "study").read_bytes()
+    artifact = json.loads((done / "CLUSTER.json").read_text())
+    assert artifact["status"] == "ok"

@@ -123,13 +123,68 @@ def test_quarantine_cells_hold_h01_h02():
 
 
 def test_committed_goldens_are_the_enumeration():
-    """The committed goldens file holds exactly the enumerated keys (no
-    stale keys can linger: the file IS the enumeration)."""
+    """The committed goldens file holds exactly the enumerated PINNED
+    keys (no stale keys can linger: the file IS the enumeration;
+    structural-only cells are linted every check but never blessed)."""
     blessed = json.loads(
         (ROOT / "tests" / "goldens" / "lowerings.json").read_text())
     assert set(blessed["cells"]) == {
-        c.key for c in lattice.enumerate_cells()}
+        c.key for c in lattice.enumerate_cells() if c.pin}
     assert blessed["spec"]["meshes"] == list(lattice.MESH_AXES)
+
+
+def test_full_step_cell_is_structural_only():
+    """The workers-axis grouped honest phase finally has lowering
+    coverage: the FULL fused mesh step is enumerated, its census is
+    pinned (exactly the one Gram psum, no explicit worker-matrix
+    all_gather), and its high-churn fingerprint is NOT blessed
+    (`pin=False`) — so engine refactors re-lower it through the BMT-H
+    gate without a re-bless treadmill."""
+    cells = {c.key: c for c in lattice.enumerate_cells()}
+    cell = cells["engine/full-step@mesh2x2"]
+    assert cell.pin is False
+    assert cell.expect.psums == 1
+    assert cell.expect.gather_limit is not None
+    # Not fingerprinted: compute_cells skips it, check() lints it
+    assert "engine/full-step@mesh2x2" not in lowering.compute_cells(
+        [cells["engine/full-step@mesh2x2"],
+         cells["engine/sgd-update@donate"]])
+
+
+@pytest.mark.slow
+def test_full_step_cell_census_holds():
+    """Lower the full fused step over the (2, 2) virtual mesh and run
+    the census: the grouped honest phase's shard_map must stay
+    collective-free (worker rows are data parallel), krum's psum'd Gram
+    must stay the ONLY explicit collective, and nothing may all-gather
+    the worker matrix."""
+    cell = next(c for c in lattice.enumerate_cells()
+                if c.key == "engine/full-step@mesh2x2")
+    key, text, expect = lattice.lower_cell(cell)
+    assert hlolint.lint_module(text, expect, key) == [], key
+    # The census is exact, not vacuous: the text really contains the one
+    # explicit all_reduce of the d-sharded Gram
+    assert text.count("stablehlo.all_reduce") >= 1
+
+
+def test_multiprocess_cells_need_a_fleet_but_build_single_process():
+    """`multiprocess_cells` refuses to silently degrade to one process;
+    with the guard lowered (the builder-shape path tests use) the cells
+    lower on the virtual platform and hold their census — the SAME
+    cells every cluster host lowers for the launcher's cross-host
+    fingerprint agreement (`cluster/host.py::_run_census`)."""
+    with pytest.raises(RuntimeError, match="fleet"):
+        lattice.multiprocess_cells()
+    cells = lattice.multiprocess_cells(min_processes=1)
+    keys = [c.key for c in cells]
+    assert keys == [f"{name}/plain@proc1"
+                    for name in lattice.MULTIPROC_GARS]
+    for cell in cells:
+        assert cell.pin is False  # consensus-checked, never blessed
+        key, text, expect = lattice.lower_cell(cell)
+        assert hlolint.lint_module(text, expect, key) == [], key
+        wants_psum = cell.key.split("/")[0] in lattice.GRAM_RULES
+        assert expect.psums == (1 if wants_psum else 0)
 
 
 # --------------------------------------------------------------------------- #
