@@ -40,6 +40,13 @@ multi-process lattice cells (`analysis/lattice.py::multiprocess_cells`)
 and writes each host's fingerprints + BMT-H census to
 `hosts/host-<i>.census.json` — the launcher requires the fingerprints to
 agree across hosts (consensus on the PROGRAM, not just the state).
+
+Fleet observability (`obs/trace/fleet.py`): besides the heartbeat, every
+host records its own `hosts/host-<i>.telemetry.jsonl` — lifecycle events
+(`host_start`/`host_resume`/`host_end`), a per-step `host_step` progress
+gauge, and (through the active-recorder API) the checkpoint save/load
+spans — the per-host stream the launcher's timeline join orders against
+its own supervision events via the heartbeat clock-offset estimates.
 """
 
 import argparse
@@ -196,6 +203,17 @@ def main(argv=None):
     if lead:
         mirror.mkdir(parents=True, exist_ok=True)
 
+    # This host's own telemetry stream (obs/trace/fleet.py joins it with
+    # the launcher's into the fleet timeline). ACTIVATED, so deep layers
+    # — checkpoint save/load spans — land on this host's timeline too.
+    from byzantinemomentum_tpu import obs
+
+    (resdir / "hosts").mkdir(parents=True, exist_ok=True)
+    telem = obs.activate(obs.Telemetry(
+        resdir / "hosts", filename=f"host-{proc}.telemetry.jsonl"))
+    telem.event("host_start", host=proc, procs=args.procs,
+                seed=args.seed, auto_resume=bool(args.auto_resume))
+
     mesh = runtime.cluster_mesh()
     workers_ax = mesh.shape["workers"]
 
@@ -244,6 +262,7 @@ def main(argv=None):
                 trainset.set_state(data_state["train"])
                 testset.set_state(data_state["test"])
             resume_step = int(resume_step)
+            telem.event("host_resume", host=proc, step=resume_step)
 
     write_host_heartbeat(resdir, proc, {
         "step": int(state.steps), "status": "starting",
@@ -341,6 +360,7 @@ def main(argv=None):
             write_host_heartbeat(resdir, proc, {
                 "step": steps_host, "status": "running",
                 "resume_step": resume_step})
+            telem.gauge("host_step", steps_host)
     finally:
         if results is not None:
             results.close()
@@ -360,6 +380,11 @@ def main(argv=None):
         "step": steps_host, "status": "completed",
         "resume_step": resume_step,
         "steps_per_sec": summary["steps_per_sec"]})
+    telem.event("host_end", host=proc, steps=steps_host,
+                steps_per_sec=summary["steps_per_sec"],
+                resume_step=resume_step)
+    obs.deactivate()
+    telem.close()
     print("cluster-host: " + json.dumps(summary), flush=True)
     runtime.shutdown()
     return 0

@@ -249,6 +249,7 @@ def main(argv=None):
     from byzantinemomentum_tpu.cluster import manifest as manifest_mod
     from byzantinemomentum_tpu.obs import Telemetry
     from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+    from byzantinemomentum_tpu.obs.trace import ClockOffsetTracker
 
     plan = None
     if args.fault_plan is not None:
@@ -300,6 +301,25 @@ def main(argv=None):
     outcome = None
     final_view = None
     steps_per_sec = None
+    # Fleet-timeline substrate (obs/trace/fleet.py): per-host clock
+    # offsets estimated from the heartbeat handshake on every poll, and
+    # liveness edges emitted as first-class events (the raw per-host
+    # heartbeats are overwritten in place — without the edge events the
+    # joined timeline could not show WHEN a host went stale or died)
+    clock = ClockOffsetTracker()
+    last_status = {}
+
+    def observe_view(view, now):
+        for host, row in view["hosts"].items():
+            if row.get("updated") is not None:
+                clock.observe(host, row["updated"], now)
+            status = row["status"]
+            if last_status.get(host) != status:
+                if host in last_status or status != "unknown":
+                    telem.event("liveness_transition", host=host,
+                                **{"from": last_status.get(host),
+                                   "to": status, "step": row.get("step")})
+                last_status[host] = status
 
     while True:
         attempt += 1
@@ -325,6 +345,7 @@ def main(argv=None):
             view = manifest_mod.liveness_view(
                 resdir, args.hosts, stale_after=args.heartbeat_stale,
                 running=running)
+            observe_view(view, time.time())
             aggregate(view, "running")
             # Restart agreement: once every host has reported, the
             # adopted steps must be unanimous and equal the manifest's
@@ -372,6 +393,11 @@ def main(argv=None):
                                    if rc not in (None, 0))
                 killed_at = view["max_step"]
             final_view = view
+        # Persist the clock-offset estimates BEFORE teardown: the
+        # timeline join (obs/trace/fleet.py::estimate_offsets) reads
+        # the newest clock_offsets event, and a relaunch keeps refining
+        if clock.estimate():
+            telem.event("clock_offsets", **clock.as_event_data())
         fleet.teardown()
         if outcome == "completed":
             break

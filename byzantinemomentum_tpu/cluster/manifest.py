@@ -139,6 +139,11 @@ def liveness_view(run_dir, nb_hosts, *, stale_after=None, running=None,
         if beat is not None:
             row["step"] = beat.get("step")
             row["age"] = max(0.0, now - float(beat.get("updated", now)))
+            # The raw host-clock write stamp: the launcher's clock-offset
+            # estimator (obs/trace/fleet.py) reads it against its own
+            # clock on every poll — the heartbeat handshake IS the
+            # offset-measurement channel
+            row["updated"] = beat.get("updated")
             if beat.get("resume_step") is not None:
                 row["resume_step"] = beat.get("resume_step")
             if beat.get("status"):

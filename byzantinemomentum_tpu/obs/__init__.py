@@ -30,6 +30,11 @@ used to guess liveness from study-CSV mtime). Three pieces:
   MXU/memory/relayout op classes, and the per-run `attribution.json`
   artifact behind `cli/attack.py --attribution` (the SIGUSR1 live window
   auto-attributes too).
+* **trace** (`trace/`) — request-scoped serve tracing (per-request span
+  stamps from frontend parse to resolve, a bounded completed-trace ring
+  behind `stats`/SIGUSR1 and the `ATTRIB_serve.json` artifact) and
+  fleet-wide attribution (the launcher+host telemetry streams of a
+  cluster run joined into one clock-aligned, causally-ordered timeline).
 * **forensics** (`forensics.py`) — per-worker EWMA suspicion scores over
   the in-jit GAR diagnostics stream (`--gar-diagnostics`): selection-
   frequency deficit, distance z-score and NaN-quarantine history, with
@@ -84,6 +89,7 @@ from byzantinemomentum_tpu.obs.perf import (  # noqa: F401
     peak_flops,
 )
 from byzantinemomentum_tpu.obs import attrib  # noqa: F401
+from byzantinemomentum_tpu.obs import trace  # noqa: F401
 
 __all__ = [
     "TELEMETRY_NAME", "Telemetry", "activate", "active", "counter",
@@ -91,7 +97,7 @@ __all__ = [
     "HEARTBEAT_NAME", "HOSTS_DIRNAME", "host_heartbeat_path",
     "read_heartbeat", "read_host_heartbeats", "write_heartbeat",
     "write_host_heartbeat",
-    "SlidingRate", "StepTimer", "SuspicionTracker", "attrib",
+    "SlidingRate", "StepTimer", "SuspicionTracker", "attrib", "trace",
     "flops_of_compiled", "host_rss_mb", "logical_flops", "mfu",
     "peak_flops",
 ]

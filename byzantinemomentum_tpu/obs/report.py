@@ -26,7 +26,7 @@ __all__ = ["render_report", "main"]
 _TIMELINE_EVENTS = ("restart", "rollback", "divergence_giveup", "retry",
                     "checkpoint_invalid", "profiler_window", "attribution",
                     "run_start", "run_end", "suspect_worker",
-                    "suspect_cleared")
+                    "suspect_cleared", "serve_trace_snapshot")
 
 
 def _fmt_seconds(seconds):
@@ -134,6 +134,15 @@ def render_report(run_dir):
     attribution = _load_attribution(run_dir)
     if attribution is not None:
         lines.extend(_attribution_lines(attribution))
+
+    # Fleet health (obs/trace/fleet.py): cluster run dirs — a cluster
+    # manifest or per-host telemetry streams — get the joined,
+    # clock-aligned fleet timeline (fired faults, host deaths, liveness
+    # transitions, agreed restarts as ordered events)
+    from byzantinemomentum_tpu.obs.trace import render_fleet_report
+    fleet_lines = render_fleet_report(run_dir)
+    if fleet_lines:
+        lines.extend(fleet_lines)
 
     if not records:
         lines.append("telemetry: (no telemetry.jsonl)")

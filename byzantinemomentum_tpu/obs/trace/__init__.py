@@ -1,0 +1,50 @@
+"""Request-scoped serve tracing and fleet-wide attribution.
+
+PR 6's attribution machinery stops at training runs (per-phase ms/step
+inside ONE compiled program); this package extends the profile-guided
+discipline to the two surfaces that grew past it:
+
+* **request** (`request.py`) — per-request tracing through the serving
+  stack: every `ServeRequest` carries a trace id and monotonic-clock
+  span stamps from frontend parse through admission, packing, queue
+  wait, device dispatch and resolve, so the serve hot path's next wall
+  (host-side packing? resolver wake-up? queue wait?) is *attributed*,
+  not guessed. Completed traces land in a bounded in-memory ring buffer
+  (`TraceBuffer`) whose per-phase p50/p99 summary rides `stats` and the
+  SIGUSR1 snapshot; `scripts/serve_loadgen.py --trace` turns the stream
+  into the `ATTRIB_serve.json` artifact `bench_compare.py` gates.
+* **fleet** (`fleet.py`) — fleet-level attribution for cluster runs:
+  the launcher's `telemetry.jsonl` and every host's
+  `hosts/host-<i>.telemetry.jsonl` join into one causally-ordered fleet
+  timeline (host clock offsets estimated from the launcher's heartbeat
+  handshake — the launcher stamps each host heartbeat's `updated` field
+  against its own clock on every poll, and the minimum skew over the
+  run is the offset bound), with restarts, fired faults and liveness
+  transitions as first-class timeline events. `obs_report` and
+  `study.py` render it as the one-page fleet health view.
+
+Import discipline: stdlib only at module scope (the obs contract) —
+host-only consumers (the report, the launcher, test harnesses) never
+initialize an accelerator backend through this package.
+"""
+
+from byzantinemomentum_tpu.obs.trace.request import (  # noqa: F401
+    REQUEST_PHASES,
+    RequestTrace,
+    TraceBuffer,
+    percentile,
+)
+from byzantinemomentum_tpu.obs.trace.fleet import (  # noqa: F401
+    FLEET_TIMELINE_EVENTS,
+    ClockOffsetTracker,
+    estimate_offsets,
+    fleet_timeline,
+    load_fleet,
+    render_fleet_report,
+)
+
+__all__ = [
+    "REQUEST_PHASES", "RequestTrace", "TraceBuffer", "percentile",
+    "FLEET_TIMELINE_EVENTS", "ClockOffsetTracker", "estimate_offsets",
+    "fleet_timeline", "load_fleet", "render_fleet_report",
+]

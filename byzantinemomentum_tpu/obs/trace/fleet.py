@@ -1,0 +1,279 @@
+"""Fleet-wide attribution: one causally-ordered timeline for a cluster
+run directory.
+
+A cluster run (`byzantinemomentum_tpu/cluster/`) leaves N+1 telemetry
+streams behind: the launcher's `telemetry.jsonl` (fleet launches, fired
+faults, host deaths, restart agreement, liveness transitions) and one
+`hosts/host-<i>.telemetry.jsonl` per host (start/resume/end, per-step
+progress gauges, checkpoint spans). Each stream is stamped with ITS
+process's wall clock — joining them naively can order a host's step
+AFTER the launcher observed the host dead. This module builds the joined
+view the PR 12 runtime never had:
+
+* **clock offsets** — the launcher estimates each host's clock skew from
+  the heartbeat handshake it already performs: every supervision poll
+  reads each host's atomic heartbeat, whose `updated` field is the
+  host's clock at write time; `seen - updated` on the launcher's clock
+  is `offset + delay` with transport/poll delay >= 0, so the MINIMUM
+  over a run's polls is a one-sided offset estimate (the NTP argument,
+  minus the return path). `ClockOffsetTracker` keeps the minimum; the
+  launcher persists the estimates as one `clock_offsets` telemetry
+  event at fleet teardown/end.
+* **timeline** — `fleet_timeline(run_dir)` merges all streams with host
+  timestamps shifted onto the launcher's clock (`t_host + offset`),
+  sorted, each entry tagged with its `source` — so restart, fired-fault
+  and liveness transitions read as one ordered story.
+* **report** — `render_fleet_report(run_dir)` is the one-page fleet
+  health view `obs_report` appends for cluster run dirs: the manifest
+  summary (attempts, recoveries, status), per-host outcomes, clock
+  offsets, and the ordered event timeline.
+
+Stdlib only (the obs import discipline): the launcher and the report
+tooling never initialize an accelerator backend through this module.
+"""
+
+import json
+import pathlib
+import re
+import time
+
+from byzantinemomentum_tpu.obs.heartbeat import HOSTS_DIRNAME
+from byzantinemomentum_tpu.obs.recorder import load_records
+
+__all__ = ["FLEET_TIMELINE_EVENTS", "HOST_TELEMETRY_PATTERN",
+           "ClockOffsetTracker", "estimate_offsets", "fleet_timeline",
+           "host_telemetry_path", "load_fleet", "render_fleet_report"]
+
+# Events worth a line on the fleet timeline (everything else in the
+# joined streams is summarized by count) — the launcher's supervision
+# story plus each host's lifecycle marks.
+FLEET_TIMELINE_EVENTS = (
+    # launcher
+    "cluster_start", "fleet_launch", "restart_agreed",
+    "restart_disagreement", "fault_injected", "host_dead",
+    "liveness_transition", "fleet_teardown", "wedge", "cluster_end",
+    # hosts
+    "host_start", "host_resume", "host_end", "restart", "rollback",
+)
+
+HOST_TELEMETRY_PATTERN = re.compile(r"host-(\d+)\.telemetry\.jsonl$")
+
+
+def host_telemetry_path(run_dir, host_id):
+    """Where host `host_id` of a cluster run writes its telemetry."""
+    return (pathlib.Path(run_dir) / HOSTS_DIRNAME
+            / f"host-{int(host_id)}.telemetry.jsonl")
+
+
+class ClockOffsetTracker:
+    """One-sided per-host clock-offset estimator over the launcher's
+    heartbeat polls.
+
+    `observe(host, host_wall, seen_wall)` folds one handshake sample:
+    `seen_wall` (launcher clock, when the heartbeat was read) minus
+    `host_wall` (host clock, the heartbeat's `updated` stamp) equals
+    `offset + delay` with `delay >= 0` — the running MINIMUM over a
+    fleet's polls is the tightest offset bound the one-way channel
+    admits. `estimate()` maps host -> offset such that
+    `t_launcher ~= t_host + offset`."""
+
+    def __init__(self):
+        self._min = {}
+        self._samples = {}
+
+    def observe(self, host, host_wall, seen_wall=None):
+        if host_wall is None:
+            return
+        seen_wall = time.time() if seen_wall is None else seen_wall
+        skew = float(seen_wall) - float(host_wall)
+        host = int(host)
+        current = self._min.get(host)
+        if current is None or skew < current:
+            self._min[host] = skew
+        self._samples[host] = self._samples.get(host, 0) + 1
+
+    def estimate(self):
+        """{host: offset_seconds} (empty until the first observation)."""
+        return dict(self._min)
+
+    @property
+    def samples(self):
+        return dict(self._samples)
+
+    def as_event_data(self):
+        """The `clock_offsets` telemetry event payload the launcher
+        persists (string keys: the record round-trips through JSON)."""
+        return {"offsets": {str(h): round(o, 6)
+                            for h, o in self._min.items()},
+                "samples": {str(h): n for h, n in self._samples.items()}}
+
+
+def load_fleet(run_dir):
+    """All of a cluster run's telemetry streams:
+    `{"launcher": [records], "hosts": {id: [records]}}` (empty lists for
+    missing streams — a partially-recorded run still renders)."""
+    run_dir = pathlib.Path(run_dir)
+    hosts = {}
+    hosts_dir = run_dir / HOSTS_DIRNAME
+    if hosts_dir.is_dir():
+        for path in sorted(hosts_dir.glob("host-*.telemetry.jsonl")):
+            m = HOST_TELEMETRY_PATTERN.search(path.name)
+            if m:
+                hosts[int(m.group(1))] = load_records(path)
+    return {"launcher": load_records(run_dir), "hosts": hosts}
+
+
+def estimate_offsets(launcher_records):
+    """{host: offset_seconds} from the newest `clock_offsets` event in a
+    launcher telemetry stream (the tracker's persisted estimates).
+    Missing event -> {} — hosts then merge unshifted, which is exact for
+    same-machine fleets and a documented approximation otherwise."""
+    offsets = {}
+    for record in launcher_records:
+        if record.get("kind") == "event" \
+                and record.get("name") == "clock_offsets":
+            data = (record.get("data") or {}).get("offsets") or {}
+            parsed = {}
+            for key, value in data.items():
+                try:
+                    parsed[int(key)] = float(value)
+                except (TypeError, ValueError):
+                    continue
+            offsets = parsed  # newest event wins
+    return offsets
+
+
+def fleet_timeline(run_dir, *, events=FLEET_TIMELINE_EVENTS,
+                   offsets=None):
+    """The joined, causally-ordered fleet timeline.
+
+    Returns a list of `{"t", "source", "name", "kind", "data"}` entries
+    sorted by launcher-clock time: launcher records keep their stamps,
+    host records are shifted by the per-host clock offset
+    (`estimate_offsets` when not given). `events=None` keeps every
+    event; the default keeps the supervision story
+    (`FLEET_TIMELINE_EVENTS`). Span records (checkpoint save/load) ride
+    along as entries with a `dur` field."""
+    fleet = load_fleet(run_dir)
+    if offsets is None:
+        offsets = estimate_offsets(fleet["launcher"])
+
+    entries = []
+
+    def keep(record):
+        if record.get("kind") == "span":
+            return True
+        if record.get("kind") != "event":
+            return False
+        return events is None or record.get("name") in events
+
+    for record in fleet["launcher"]:
+        if keep(record):
+            entries.append({"t": float(record.get("t", 0.0)),
+                            "source": "launcher",
+                            "name": record.get("name"),
+                            "kind": record.get("kind"),
+                            "data": record.get("data") or {},
+                            **({"dur": record["dur"]}
+                               if "dur" in record else {})})
+    for host, records in fleet["hosts"].items():
+        shift = float(offsets.get(host, 0.0))
+        for record in records:
+            if keep(record):
+                entries.append({"t": float(record.get("t", 0.0)) + shift,
+                                "source": f"host-{host}",
+                                "name": record.get("name"),
+                                "kind": record.get("kind"),
+                                "data": record.get("data") or {},
+                                **({"dur": record["dur"]}
+                                   if "dur" in record else {})})
+    entries.sort(key=lambda e: e["t"])
+    return entries
+
+
+def host_progress(run_dir, *, offsets=None):
+    """{host: [(t_launcher, step)]} from the hosts' per-step progress
+    gauges (`host_step`), clock-shifted — the raw series behind
+    `study.fleet_health`'s per-host lines."""
+    fleet = load_fleet(run_dir)
+    if offsets is None:
+        offsets = estimate_offsets(fleet["launcher"])
+    out = {}
+    for host, records in fleet["hosts"].items():
+        shift = float(offsets.get(host, 0.0))
+        series = [(float(r.get("t", 0.0)) + shift, int(r["value"]))
+                  for r in records
+                  if r.get("kind") == "gauge" and r.get("name") == "host_step"
+                  and isinstance(r.get("value"), (int, float))]
+        if series:
+            out[host] = series
+    return out
+
+
+def _fmt_offset(seconds):
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:.0f}us"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:.1f}ms"
+    if seconds < 120.0:
+        return f"{seconds:.2f}s"
+    return f"{seconds / 60.0:.1f}min"
+
+
+def render_fleet_report(run_dir, limit=40):
+    """The fleet health view as text lines (the `obs_report` section for
+    cluster run dirs). Empty list when the directory carries no cluster
+    signal at all (no manifest, no host streams)."""
+    run_dir = pathlib.Path(run_dir)
+    try:
+        manifest = json.loads((run_dir / "cluster.json").read_text())
+        if not isinstance(manifest, dict):
+            manifest = None
+    except (OSError, ValueError):
+        manifest = None
+    fleet = load_fleet(run_dir)
+    if manifest is None and not fleet["hosts"]:
+        return []
+
+    lines = []
+    if manifest is not None:
+        recoveries = manifest.get("recoveries") or []
+        parts = [f"hosts={manifest.get('hosts')}",
+                 f"status={manifest.get('status')}",
+                 f"attempts={manifest.get('attempt')}",
+                 f"recoveries={len(recoveries)}"]
+        if manifest.get("restart_step") is not None:
+            parts.append(f"restart_step={manifest['restart_step']}")
+        if manifest.get("fired_faults"):
+            parts.append(f"fired_faults={manifest['fired_faults']}")
+        lines.append("fleet: " + ", ".join(parts))
+        for rec in recoveries:
+            lines.append(f"  recovery: host {rec.get('host')} died at step "
+                         f"{rec.get('died_at_step')}, restarted from "
+                         f"{rec.get('restart_step')} "
+                         f"({rec.get('recovery_steps')} steps replayed)")
+
+    offsets = estimate_offsets(fleet["launcher"])
+    if offsets:
+        lines.append("clock offsets (host -> launcher): " + ", ".join(
+            f"host-{h} {_fmt_offset(abs(o))}"
+            + ("" if o >= 0 else " ahead")
+            for h, o in sorted(offsets.items())))
+
+    timeline = fleet_timeline(run_dir, offsets=offsets)
+    if timeline:
+        t0 = timeline[0]["t"]
+        lines.append(f"fleet timeline ({len(timeline)} entries"
+                     + (f", last {limit}" if len(timeline) > limit else "")
+                     + "):")
+        for entry in timeline[-limit:]:
+            offset = max(0.0, entry["t"] - t0)
+            data = entry.get("data") or {}
+            extra = " ".join(f"{k}={v}" for k, v in sorted(data.items())
+                             if not isinstance(v, (dict, list)))
+            dur = (f" [{entry['dur'] * 1e3:.1f}ms]"
+                   if "dur" in entry else "")
+            lines.append(f"  +{_fmt_offset(offset):<9} "
+                         f"{entry['source']:<9} {entry['name']}{dur}"
+                         + (f"  {extra}" if extra else ""))
+    return lines

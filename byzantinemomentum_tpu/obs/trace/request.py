@@ -1,0 +1,249 @@
+"""Per-request span tracing for the aggregation service.
+
+One `RequestTrace` rides each `ServeRequest` through the serving stack,
+stamping a monotonic clock at every hand-off. The stamps are cheap (one
+`time.monotonic()` call and a dict store each — the measured overhead
+budget is the serve selfcheck's trace phase), and the derived spans TILE
+the measured request latency: summed, they must equal submit→resolve
+wall time, so a latency regression always shows up in exactly one phase
+instead of hiding between instruments.
+
+Stamp points (writer in parentheses) and the spans between them:
+
+  recv        (frontend)  the raw line arrived, before JSON parse
+  accept      (service)   `submit()` entered
+  submit      (service)   request enqueued (validation+admission done)
+  flush       (batcher)   the flusher picked the request's batch
+  packed      (service)   host-side numpy packing done
+  dispatched  (service)   device_put + program call returned (async)
+  resolver    (batcher)   the resolver thread picked the batch up
+  device      (service)   `jax.device_get` returned (device done)
+  done        (service)   this request's future about to resolve
+
+  parse    = accept - recv        (frontend JSON decode; frontend only)
+  validate = submit - accept      (validation + admission decision)
+  queue    = flush - submit       (waiting for batch-mates / flusher)
+  pack     = packed - flush       (host-side numpy packing)
+  dispatch = dispatched - packed  (device_put + async program enqueue)
+  resolver_wake = resolver - dispatched  (flusher→resolver hand-off)
+  device   = device - resolver    (blocking on device completion)
+  resolve  = done - device        (unpack, suspicion, future set)
+
+`queue + pack + dispatch + resolver_wake + device + resolve` is the
+request's submit→resolve latency; `parse`/`validate` sit before the
+enqueue and are reported separately (a socket client pays them, the
+in-process API pays only `validate`).
+
+Completed traces land in a `TraceBuffer` — a bounded, thread-safe ring
+(old traces fall off; the buffer can never grow a long-lived server's
+heap) — whose `summary()` is the per-phase p50/p99 view served by
+`stats` and the SIGUSR1 snapshot. Stdlib only: the obs import
+discipline (no jax, no numpy) keeps every consumer host-only.
+"""
+
+import collections
+import itertools
+import threading
+import time
+
+__all__ = ["REQUEST_PHASES", "RequestTrace", "TraceBuffer", "percentile"]
+
+# Span names in causal order: (phase, start stamp, end stamp). The first
+# two phases precede the queue hand-off and are absent when the caller
+# didn't stamp them (in-process submits have no `recv`).
+REQUEST_PHASES = (
+    ("parse", "recv", "accept"),
+    ("validate", "accept", "submit"),
+    ("queue", "submit", "flush"),
+    ("pack", "flush", "packed"),
+    ("dispatch", "packed", "dispatched"),
+    ("resolver_wake", "dispatched", "resolver"),
+    ("device", "resolver", "device"),
+    ("resolve", "device", "done"),
+)
+
+# Phases whose sum IS the submit→resolve latency (the tiling contract)
+LATENCY_PHASES = ("queue", "pack", "dispatch", "resolver_wake", "device",
+                  "resolve")
+
+_ids = itertools.count(1)
+
+
+class RequestTrace:
+    """Monotonic-clock span stamps for one request's trip through the
+    serving stack. Stamping is append-only and single-writer per stamp
+    (each pipeline stage writes its own), so no lock is needed.
+
+    Hot-path economics (the tracing-overhead budget is the selfcheck's
+    trace phase and the committed ATTRIB_serve artifact): the per-batch
+    hand-off stamps — flush, packed, dispatched, resolver, device — are
+    IDENTICAL for every request of a batch, so the pipeline stamps them
+    once into a shared `batch_stamps` dict each request references (one
+    attribute store per request instead of five timestamped method
+    calls); auto trace-id formatting is deferred to `as_dict()`."""
+
+    __slots__ = ("_id", "stamps", "batch_stamps", "depth_at_submit",
+                 "meta")
+
+    def __init__(self, trace_id=None):
+        # Explicit (wire) ids stringify up front; auto ids stay the bare
+        # counter int until someone reads `trace_id`
+        self._id = str(trace_id) if trace_id is not None else next(_ids)
+        # Creation IS acceptance: the service constructs the trace on
+        # `submit()` entry, so the accept stamp rides the constructor
+        self.stamps = {"accept": time.monotonic()}
+        self.batch_stamps = None      # shared per-batch stamp dict
+        self.depth_at_submit = None   # queued requests when this one joined
+        self.meta = None              # {gar, n, d} stamped at submit
+
+    @property
+    def trace_id(self):
+        """The wire id (auto ids format lazily — never on the hot path)."""
+        return self._id if isinstance(self._id, str) else f"t{self._id:08d}"
+
+    @property
+    def batch_size(self):
+        return (self.batch_stamps or {}).get("batch_size")
+
+    @property
+    def batch_occupancy(self):
+        return (self.batch_stamps or {}).get("batch_occupancy")
+
+    def stamp(self, name, at=None):
+        """Record stamp `name` now (or at the given monotonic time)."""
+        self.stamps[name] = time.monotonic() if at is None else at
+
+    def _stamp_at(self, name):
+        value = self.stamps.get(name)
+        if value is None and self.batch_stamps is not None:
+            value = self.batch_stamps.get(name)
+        return value
+
+    def spans_ms(self):
+        """{phase: ms} for every phase whose both stamps exist
+        (per-request or shared batch stamps), in causal order. Negative
+        spans are clamped to 0.0 (adjacent stamps taken on different
+        threads can invert by scheduler quanta)."""
+        spans = {}
+        for phase, start, end in REQUEST_PHASES:
+            t0, t1 = self._stamp_at(start), self._stamp_at(end)
+            if t0 is not None and t1 is not None:
+                spans[phase] = max(0.0, (t1 - t0) * 1000.0)
+        return spans
+
+    def total_ms(self):
+        """submit→done wall time in ms (None before `done`)."""
+        t0, t1 = self._stamp_at("submit"), self._stamp_at("done")
+        if t0 is not None and t1 is not None:
+            return max(0.0, (t1 - t0) * 1000.0)
+        return None
+
+    def as_dict(self):
+        """The completed-trace record (ring buffer entry / response
+        payload): spans in ms plus the queue/batch context."""
+        record = {"trace_id": self.trace_id, "spans_ms": {
+            k: round(v, 4) for k, v in self.spans_ms().items()}}
+        total = self.total_ms()
+        if total is not None:
+            record["total_ms"] = round(total, 4)
+        for key in ("depth_at_submit", "batch_size", "batch_occupancy"):
+            value = getattr(self, key)
+            if value is not None:
+                record[key] = value
+        if self.meta:
+            record.update(self.meta)
+        return record
+
+
+def percentile(values, q):
+    """Nearest-rank percentile of a non-empty sequence (stdlib-only — the
+    obs package must not import numpy for a stats line)."""
+    ordered = sorted(values)
+    if not ordered:
+        raise ValueError("percentile of an empty sequence")
+    rank = max(0, min(len(ordered) - 1,
+                      int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+def _dist(values):
+    """{p50, p99, mean, max} summary of a sample (rounded for JSON)."""
+    return {
+        "p50": round(percentile(values, 50), 4),
+        "p99": round(percentile(values, 99), 4),
+        "mean": round(sum(values) / len(values), 4),
+        "max": round(max(values), 4),
+    }
+
+
+class TraceBuffer:
+    """Bounded, thread-safe ring of completed traces.
+
+    The resolver thread appends; `stats`/SIGUSR1 readers snapshot. The
+    deque's maxlen is the bound — a long-lived server holds at most
+    `maxlen` completed traces no matter how much traffic it serves.
+    `add` is the serving hot path, so it stores the `RequestTrace`
+    OBJECT (one lock + deque append); the dict conversion happens
+    lazily at `snapshot()`/`summary()` time, on the reader's clock."""
+
+    def __init__(self, maxlen=512):
+        if maxlen < 1:
+            raise ValueError(f"Expected maxlen >= 1, got {maxlen}")
+        self.maxlen = int(maxlen)
+        self._ring = collections.deque(maxlen=self.maxlen)
+        self._lock = threading.Lock()
+        self._completed = 0
+
+    def add(self, trace):
+        """Append one completed `RequestTrace` (or prebuilt record)."""
+        with self._lock:
+            self._ring.append(trace)
+            self._completed += 1
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def completed(self):
+        """Total traces ever completed (monotonic; the ring only holds
+        the newest `maxlen` of them)."""
+        with self._lock:
+            return self._completed
+
+    def snapshot(self):
+        """The buffered traces as record dicts, oldest first (a copy —
+        safe to mutate; conversion cost is paid here, never on the
+        resolver thread)."""
+        with self._lock:
+            items = list(self._ring)
+        return [t.as_dict() if isinstance(t, RequestTrace) else dict(t)
+                for t in items]
+
+    def summary(self):
+        """Per-phase p50/p99/mean/max ms over the buffered traces, plus
+        the queue-depth and batch-occupancy distributions — the `stats`
+        payload's `tracing` section."""
+        records = self.snapshot()
+        out = {"completed": self.completed, "buffered": len(records),
+               "maxlen": self.maxlen}
+        if not records:
+            return out
+        phases = {}
+        for record in records:
+            for phase, ms in (record.get("spans_ms") or {}).items():
+                phases.setdefault(phase, []).append(float(ms))
+        out["phases_ms"] = {phase: _dist(values)
+                           for phase, values in phases.items()}
+        totals = [float(r["total_ms"]) for r in records
+                  if isinstance(r.get("total_ms"), (int, float))]
+        if totals:
+            out["total_ms"] = _dist(totals)
+        for key, label in (("depth_at_submit", "queue_depth"),
+                           ("batch_size", "batch_size"),
+                           ("batch_occupancy", "batch_occupancy")):
+            values = [float(r[key]) for r in records
+                      if isinstance(r.get(key), (int, float))]
+            if values:
+                out[label] = _dist(values)
+        return out

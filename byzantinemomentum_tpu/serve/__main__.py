@@ -20,8 +20,16 @@ stage-1 scan, subset enumeration, coordinate-wise), each spanning >= 3
 raw row counts AND >= 3 raw widths — also compiles ZERO programs (the
 two-axis bucket ladder's whole point: novel raw (n, d) shapes land on
 warm bucket programs), (3) a planted outlier client's suspicion rises
-and its verdict rides the response, and (4) the socket front end
-answers ping/aggregate/stats over a real TCP connection.
+and its verdict rides the response, (4) the socket front end answers
+ping/aggregate/stats over a real TCP connection, and (5) the trace
+phase (`obs/trace`): warm requests yield traces whose span sum tiles
+the client-measured end-to-end latency within tolerance, and the
+tracing-on vs tracing-off throughput overhead is measured, printed
+(`serve trace: {...}`, recorded by the tier harness) and bounded.
+
+A live serving process answers SIGUSR1 with a trace-ring snapshot
+(`traces-<completed>.json` in the result directory) — the serve twin of
+the driver's SIGUSR1 profiler window.
 """
 
 import argparse
@@ -179,6 +187,69 @@ def selfcheck(seed=1, requests=120, verbose=True):
         if verbose:
             print("serve selfcheck: socket front end ok", flush=True)
 
+        # (5) trace phase (obs/trace): warm traced requests must tile
+        # the end-to-end latency the CLIENT measures, and tracing must
+        # not cost meaningful throughput (the stamps are a handful of
+        # monotonic-clock reads per request)
+        import time
+
+        gar, n, f, d, _ = SELFCHECK_CELLS[0]  # warm since phase (1)
+        walls, sums = [], []
+        for _ in range(24):
+            cohort = rng.standard_normal((n, d)).astype(np.float32)
+            t0 = time.monotonic()
+            result = service.aggregate(cohort, gar=gar, f=f,
+                                       diagnostics=True, timeout=30)
+            walls.append((time.monotonic() - t0) * 1000.0)
+            sums.append(sum(result.trace.spans_ms().values()))
+        tile_error = abs(sum(sums) - sum(walls)) / max(sum(walls), 1e-9)
+        if tile_error > 0.20:
+            raise AssertionError(
+                f"trace spans do not tile the measured latency: span sum "
+                f"mean {sum(sums) / len(sums):.3f} ms vs client wall mean "
+                f"{sum(walls) / len(walls):.3f} ms "
+                f"({tile_error * 100:.1f}% off)")
+
+        def _rate(count=96):
+            best = None
+            for _ in range(3):
+                t0 = time.monotonic()
+                futures = [service.submit(
+                    rng.standard_normal((n, d)).astype(np.float32),
+                    gar=gar, f=f, diagnostics=True)
+                    for _ in range(count)]
+                for fut in futures:
+                    fut.result(timeout=60)
+                rate = count / (time.monotonic() - t0)
+                best = rate if best is None else max(best, rate)
+            return best
+
+        rate_on = _rate()
+        service.tracing = False
+        try:
+            rate_off = _rate()
+        finally:
+            service.tracing = True
+        overhead = max(0.0, 1.0 - rate_on / rate_off)
+        trace_line = {
+            "requests": len(walls),
+            "tile_error_frac": round(tile_error, 4),
+            "agg_per_sec_tracing_on": round(rate_on, 1),
+            "agg_per_sec_tracing_off": round(rate_off, 1),
+            "overhead_frac": round(overhead, 4),
+        }
+        print(f"serve trace: {json.dumps(trace_line)}", flush=True)
+        if overhead > 0.25:
+            # Generous CI bound (1-core hosts jitter); the committed
+            # ATTRIB_serve artifact holds the real <= 3% measurement
+            raise AssertionError(
+                f"tracing overhead {overhead * 100:.1f}% exceeds the "
+                f"25% selfcheck bound")
+        if verbose:
+            print(f"serve selfcheck: trace spans tile latency "
+                  f"({tile_error * 100:.2f}% off), tracing overhead "
+                  f"{overhead * 100:.2f}%", flush=True)
+
         stats = service.stats()
     finally:
         service.close()
@@ -200,6 +271,12 @@ def main(argv=None):
     parser.add_argument("--max-delay-ms", type=float, default=2.0)
     parser.add_argument("--no-diagnostics", action="store_true",
                         help="default new requests to diagnostics=False")
+    parser.add_argument("--no-tracing", action="store_true",
+                        help="disable per-request span tracing "
+                             "(obs/trace; on by default)")
+    parser.add_argument("--trace-buffer", type=int, default=512,
+                        help="completed traces the in-memory ring keeps "
+                             "(the stats/SIGUSR1 summary window)")
     parser.add_argument("--heartbeat-interval", type=float, default=2.0)
     parser.add_argument("--result-directory", default=None,
                         help="run directory for heartbeat.json + "
@@ -230,7 +307,24 @@ def main(argv=None):
         max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
         diagnostics=not args.no_diagnostics,
         directory=args.result_directory,
-        heartbeat_interval=args.heartbeat_interval)
+        heartbeat_interval=args.heartbeat_interval,
+        tracing=not args.no_tracing, trace_buffer=args.trace_buffer)
+    # SIGUSR1 -> trace-ring snapshot (the serve twin of the driver's
+    # SIGUSR1 profiler window): a live server dumps its completed-trace
+    # buffer + per-phase summary without restarting or pausing
+    import signal
+
+    def _on_usr1(signum, frame):
+        try:
+            path = service.write_trace_snapshot()
+            print(f"serve: SIGUSR1 trace snapshot -> {path}", flush=True)
+        except OSError as err:
+            print(f"serve: SIGUSR1 snapshot failed: {err}", flush=True)
+
+    try:
+        signal.signal(signal.SIGUSR1, _on_usr1)
+    except (ValueError, AttributeError, OSError):
+        pass  # non-main thread / platform without SIGUSR1: snapshot via stats
     try:
         with AggregationServer((args.host, args.port), service) as server:
             print(f"serving aggregation on {args.host}:{server.port} "

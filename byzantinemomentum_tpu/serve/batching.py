@@ -26,6 +26,16 @@ affected futures with the exception instead of wedging callers.
 Queue depth and batch occupancy land on the active obs recorder
 (`serve_queue_depth` gauge, `serve_batches`/`serve_batched_requests`
 counters) — the telemetry substrate every other subsystem already uses.
+The depth gauge is emitted at every queue TRANSITION — submit (depth
+after enqueue), flush (depth after the batch left) and resolver drain
+(depth as a batch resolves) — so an idle-then-burst profile is visible
+in the gauge sequence instead of only its flush-time residue.
+
+Request tracing (`obs/trace/request.py`): when a request carries a
+`RequestTrace`, the batcher stamps the two hand-offs it owns — `flush`
+(queue wait ends: the flusher picked the batch) and `resolver` (the
+resolver thread picked the in-flight batch up, ending the dispatch→
+resolver wake-up gap). Everything else is stamped by the service.
 """
 
 import collections
@@ -46,13 +56,16 @@ class ServeRequest:
     back. `admitted`/`admission` carry the submit-time admission-control
     decisions (`serve/admission.py`): rows with `admitted` False pack as
     INACTIVE (the masked kernels reject them), and the flagged-client
-    provenance rides back on the response."""
+    provenance rides back on the response. `trace` optionally carries
+    the request's `RequestTrace` (`obs/trace`); when present its
+    `submit` stamp is the same instant as `t_submit` so traced spans
+    tile the measured latency."""
 
     __slots__ = ("cell", "n", "d", "matrix", "client_ids", "future",
-                 "t_submit", "admitted", "admission")
+                 "t_submit", "admitted", "admission", "trace")
 
     def __init__(self, cell, n, matrix, client_ids, admitted=None,
-                 admission=None):
+                 admission=None, trace=None):
         self.cell = cell
         self.n = int(n)
         self.d = int(matrix.shape[1])
@@ -60,8 +73,11 @@ class ServeRequest:
         self.client_ids = client_ids  # tuple[str] | None
         self.admitted = admitted      # bool[n] | None (None = all)
         self.admission = admission    # {client: decision} | None
+        self.trace = trace            # RequestTrace | None
         self.future = concurrent.futures.Future()
         self.t_submit = time.monotonic()
+        if trace is not None:
+            trace.stamp("submit", at=self.t_submit)
 
 
 class MicroBatcher:
@@ -108,7 +124,16 @@ class MicroBatcher:
                 raise RuntimeError("MicroBatcher is closed")
             self._queues.setdefault(request.cell, collections.deque()
                                     ).append(request)
+            depth = sum(len(q) for q in self._queues.values())
             self._cond.notify()
+        if request.trace is not None:
+            request.trace.depth_at_submit = depth
+        # Depth on SUBMIT, not only on flush: an idle-then-burst queue
+        # build-up is otherwise invisible (the gauge would only record
+        # the post-flush residue)
+        if recorder.active() is not None:
+            recorder.active().gauge("serve_queue_depth", depth,
+                                    edge="submit")
         return request.future
 
     def depth(self):
@@ -163,10 +188,20 @@ class MicroBatcher:
                     timeout = self._next_deadline(time.monotonic())
                     self._cond.wait(timeout=timeout)
                 batch, depth_after = picked
+            # One shared stamp dict per batch: every hand-off below this
+            # point is batch-granular, so traced requests reference it
+            # instead of each paying five timestamped stores
+            batch_stamps = None
+            for r in batch:
+                if r.trace is not None:
+                    if batch_stamps is None:
+                        batch_stamps = {"flush": time.monotonic()}
+                    r.trace.batch_stamps = batch_stamps
             recorder.counter("serve_batches")
             recorder.counter("serve_batched_requests", len(batch))
             if recorder.active() is not None:
-                recorder.active().gauge("serve_queue_depth", depth_after)
+                recorder.active().gauge("serve_queue_depth", depth_after,
+                                        edge="flush")
             try:
                 handle = self._dispatch(batch[0].cell, batch)
             except Exception as err:  # bmt: noqa[BMT-E05] one poisoned batch must fail its own futures, not kill the flusher serving every other caller
@@ -185,12 +220,23 @@ class MicroBatcher:
             if item is None:
                 return
             handle, batch = item
+            t_wake = time.monotonic()
+            for r in batch:
+                if r.trace is not None and r.trace.batch_stamps is not None:
+                    r.trace.batch_stamps["resolver"] = t_wake
+                    break  # shared dict: one store covers the batch
             try:
                 self._resolve(handle, batch)
             except Exception as err:  # bmt: noqa[BMT-E05] a failed resolution must fail its own futures, not kill the resolver thread behind every in-flight batch
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(err)
+            # Depth on resolver DRAIN: with submit/flush above, every
+            # queue transition lands on the gauge, so a depth timeline
+            # can be read straight off the telemetry
+            if recorder.active() is not None:
+                recorder.active().gauge("serve_queue_depth", self.depth(),
+                                        edge="drain")
 
     # ------------------------------------------------------------------ #
 

@@ -4,9 +4,10 @@ Protocol: newline-delimited JSON over TCP, one object per line, one
 response line per request line, in order:
 
   {"op": "aggregate", "vectors": [[...], ...], "gar": "krum", "f": 1,
-   "clients": ["c0", ...], "diagnostics": true}
+   "clients": ["c0", ...], "diagnostics": true, "trace": "req-17"}
       -> {"ok": true, "aggregate": [...], "f_eff": 1, "n": 11,
-          "cell": {...}, "verdicts": {...}, "latency_ms": 3.2}
+          "cell": {...}, "verdicts": {...}, "latency_ms": 3.2,
+          "trace": {"trace_id": "req-17", "spans_ms": {...}, ...}}
   {"op": "stats"}   -> {"ok": true, "stats": {...}}
   {"op": "ping"}    -> {"ok": true, "op": "ping"}
 
@@ -16,11 +17,20 @@ connection gets its own handler thread (`ThreadingTCPServer`), and the
 handler blocks on ITS request's future only — the service's dispatch
 stays batched and asynchronous underneath, so concurrent connections
 pack into shared device programs.
+
+Trace-id propagation (`obs/trace/request.py`): an optional `"trace"`
+field (string or number) names the request's trace; with tracing on the
+completed span record rides back under the response's `"trace"` key,
+its `parse` span opened at the instant the raw line arrived (stamped
+BEFORE the JSON decode, so client-visible decode cost is attributed).
+Absent ids are auto-assigned server-side; a malformed id (object/array)
+answers an error on its line slot without severing the connection.
 """
 
 import json
 import socketserver
 import threading
+import time
 
 from byzantinemomentum_tpu import utils
 
@@ -31,11 +41,14 @@ class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         service = self.server.service
         for raw in self.rfile:
+            received_at = time.monotonic()  # before the JSON decode:
+            #                                 parse cost is attributed
             line = raw.strip()
             if not line:
                 continue
             try:
-                response = self._one(service, json.loads(line))
+                response = self._one(service, json.loads(line),
+                                     received_at)
             except (ValueError, KeyError, TypeError,
                     utils.UserException) as err:
                 response = {"ok": False, "error": str(err)}
@@ -50,7 +63,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 return  # client hung up mid-response
 
     @staticmethod
-    def _one(service, request):
+    def _one(service, request, received_at=None):
         if not isinstance(request, dict):
             raise ValueError("expected a JSON object per line")
         op = request.get("op", "aggregate")
@@ -60,13 +73,22 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"ok": True, "stats": service.stats()}
         if op != "aggregate":
             raise ValueError(f"unknown op {op!r}")
+        trace_id = request.get("trace")
+        if trace_id is not None and not isinstance(trace_id, (str, int,
+                                                              float)):
+            # A malformed id answers an error on ITS line slot (the
+            # handler catches ValueError); the connection lives on
+            raise ValueError(
+                f"trace id must be a string or number, got "
+                f"{type(trace_id).__name__}")
         vectors = request["vectors"]
         future = service.submit(
             vectors,
             gar=request.get("gar", "krum"),
             f=int(request.get("f", 1)),
             client_ids=request.get("clients"),
-            diagnostics=request.get("diagnostics"))
+            diagnostics=request.get("diagnostics"),
+            trace_id=trace_id, received_at=received_at)
         result = future.result()
         return {"ok": True, **result.as_dict()}
 

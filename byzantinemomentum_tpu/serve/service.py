@@ -16,6 +16,16 @@ the telemetry substrate every other subsystem already uses:
     diagnostics cell's serve aux into client-id-keyed EWMA suspicion,
     whose verdicts ride back on each response.
 
+Request tracing (`obs/trace/request.py`, on by default): every request
+carries a `RequestTrace` whose monotonic span stamps — validate, queue
+wait, pack, dispatch, resolver wake-up, device, resolve — tile the
+measured submit→resolve latency; completed traces land in a bounded
+ring buffer (`TraceBuffer`) whose per-phase p50/p99 summary rides
+`stats()` and the SIGUSR1 snapshot (`write_trace_snapshot`), and the
+trace record rides back on each response. `tracing=False` disables the
+stamps entirely (the serve selfcheck measures and bounds the on/off
+overhead).
+
 Supervision follows the run pattern (`utils/jobs.py`): the service
 writes the same atomic `heartbeat.json` the Jobs watchdog consumes (the
 `step` field counts served requests, so a wedged device stalls the
@@ -33,6 +43,7 @@ from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.obs import recorder
 from byzantinemomentum_tpu.obs.forensics import ClientSuspicionStore
 from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+from byzantinemomentum_tpu.obs.trace import RequestTrace, TraceBuffer
 from byzantinemomentum_tpu.serve.batching import MicroBatcher, ServeRequest
 from byzantinemomentum_tpu.serve.programs import (
     N_BUCKETS, ProgramCache, batch_bucket)
@@ -44,10 +55,10 @@ class AggregateResult:
     """One resolved aggregation response."""
 
     __slots__ = ("aggregate", "f_eff", "n", "cell", "verdicts",
-                 "admission", "latency_ms")
+                 "admission", "latency_ms", "trace")
 
     def __init__(self, aggregate, f_eff, n, cell, verdicts, latency_ms,
-                 admission=None):
+                 admission=None, trace=None):
         self.aggregate = aggregate    # np.f32[d] (raw request width)
         self.f_eff = f_eff            # effective Byzantine tolerance used
         self.n = n                    # submitted rows (pre-bucket)
@@ -57,9 +68,12 @@ class AggregateResult:
         #                               the submit-time admission-control
         #                               provenance (`serve/admission.py`)
         self.latency_ms = latency_ms  # submit -> resolve wall time
+        self.trace = trace            # completed RequestTrace | None
 
     def as_dict(self):
-        """JSON-safe view (the line-JSON front end's response body)."""
+        """JSON-safe view (the line-JSON front end's response body).
+        The trace converts to its record dict HERE — on the serializing
+        caller's clock, never the resolver thread's."""
         return {
             "aggregate": [float(x) for x in self.aggregate],
             "f_eff": int(self.f_eff),
@@ -70,6 +84,8 @@ class AggregateResult:
             "verdicts": self.verdicts,
             "admission": self.admission,
             "latency_ms": round(self.latency_ms, 3),
+            **({"trace": self.trace.as_dict()}
+               if self.trace is not None else {}),
         }
 
 
@@ -97,17 +113,26 @@ class AggregationService:
         for one (`serve/admission.py`): suspect/colluding clients' rows
         are masked out of (or down-weighted in) the aggregate at submit
         time, with the decision provenance on the response.
+      tracing: per-request span tracing (`obs/trace/request.py`). On by
+        default — the stamps are a handful of monotonic-clock reads per
+        request (overhead measured and bounded by the serve selfcheck's
+        trace phase); `False` skips them entirely.
+      trace_buffer: completed traces the in-memory ring keeps (the
+        `stats`/SIGUSR1 summary window; old traces fall off).
     """
 
     def __init__(self, *, max_batch=8, max_delay_ms=2.0, buckets=N_BUCKETS,
                  diagnostics=True, directory=None, heartbeat_interval=2.0,
-                 suspicion=None, admission=None):
+                 suspicion=None, admission=None, tracing=True,
+                 trace_buffer=512):
         from byzantinemomentum_tpu.serve.admission import (
             ADMISSION_WEIGHTS, AdmissionPolicy)
 
         self.cache = ProgramCache(buckets=buckets)
         self.max_batch = int(max_batch)
         self.diagnostics = bool(diagnostics)
+        self.tracing = bool(tracing)
+        self.traces = TraceBuffer(trace_buffer)
         if isinstance(admission, dict):
             admission = AdmissionPolicy(**admission)
         self.admission = admission
@@ -148,7 +173,7 @@ class AggregationService:
     # Submission API
 
     def submit(self, vectors, *, gar="krum", f=1, client_ids=None,
-               diagnostics=None):
+               diagnostics=None, trace_id=None, received_at=None):
         """Queue one aggregation; returns a `Future[AggregateResult]`.
 
         `vectors` is the (n, d) cohort matrix (array-like, one row per
@@ -156,10 +181,18 @@ class AggregationService:
         suspicion verdicts can ride back (requires a diagnostics cell).
         Invalid requests raise synchronously (`utils.UserException` /
         `OversizeRequest`) — the caller never holds a future that was
-        doomed from the start.
+        doomed from the start. `trace_id` names the request's trace
+        (auto-assigned when tracing is on and none is given);
+        `received_at` is the frontend's monotonic receive stamp, opening
+        a `parse` span before validation.
         """
         if self._closed:
             raise RuntimeError("AggregationService is closed")
+        trace = None
+        if self.tracing:
+            trace = RequestTrace(trace_id)  # stamps `accept` at creation
+            if received_at is not None:
+                trace.stamp("recv", at=float(received_at))
         try:
             cell, matrix, client_ids = self._validate(
                 vectors, gar, f, client_ids, diagnostics)
@@ -188,9 +221,12 @@ class AggregationService:
                                      blended)
         self._requests += 1
         recorder.counter("serve_requests")
+        if trace is not None:
+            trace.meta = {"gar": cell.gar, "n": n, "d": int(matrix.shape[1])}
         return self.batcher.submit(ServeRequest(cell, n, matrix, client_ids,
                                                 admitted=admitted,
-                                                admission=admission))
+                                                admission=admission,
+                                                trace=trace))
 
     def _validate(self, vectors, gar, f, client_ids, diagnostics):
         """Everything that can reject a request, in one place (every
@@ -294,10 +330,19 @@ class AggregationService:
         if recorder.active() is not None:
             recorder.active().gauge("serve_batch_occupancy",
                                     len(requests) / B, cell=repr(cell))
+        batch_stamps = next((r.trace.batch_stamps for r in requests
+                             if r.trace is not None
+                             and r.trace.batch_stamps is not None), None)
+        if batch_stamps is not None:
+            batch_stamps["packed"] = time.monotonic()
+            batch_stamps["batch_size"] = len(requests)
+            batch_stamps["batch_occupancy"] = len(requests) / B
         program = self.cache.get(cell, B)
         # Explicit device_put (the transfer-guard contract: the serving
         # hot loop performs no implicit host<->device transfers)
         out = program(jax.device_put(G), jax.device_put(active))
+        if batch_stamps is not None:
+            batch_stamps["dispatched"] = time.monotonic()
         return out
 
     def _resolve(self, out, requests):
@@ -311,6 +356,10 @@ class AggregationService:
 
         host = jax.device_get(out)
         now = time.monotonic()
+        for r in requests:
+            if r.trace is not None and r.trace.batch_stamps is not None:
+                r.trace.batch_stamps["device"] = now
+                break  # shared dict: one store covers the batch
         for i, r in enumerate(requests):
             verdicts = None
             if r.cell.diagnostics and r.client_ids is not None:
@@ -322,12 +371,20 @@ class AggregationService:
                         active=r.admitted,
                         dist=(host["dist"][i, :r.n, :r.n]
                               if "dist" in host else None))
+            done = time.monotonic()
+            if r.trace is not None:
+                # Hot path: stamp + ring append only — the dict/rounding
+                # conversion happens lazily on whoever READS the trace
+                # (response serialization, stats snapshot)
+                r.trace.stamp("done", at=done)
+                self.traces.add(r.trace)
             result = AggregateResult(
                 aggregate=host["aggregate"][i, :r.d],
                 f_eff=int(host["f_eff"][i]),
                 n=r.n, cell=r.cell, verdicts=verdicts,
                 admission=r.admission,
-                latency_ms=(now - r.t_submit) * 1000.0)
+                latency_ms=(done - r.t_submit) * 1000.0,
+                trace=r.trace)
             self._served += 1
             if not r.future.done():
                 r.future.set_result(result)
@@ -351,7 +408,28 @@ class AggregationService:
             "queue_depth": self.batcher.depth(),
             "cache": self.cache.stats(),
             "suspicion": self.suspicion.summary(),
+            "tracing": ({"enabled": True, **self.traces.summary()}
+                        if self.tracing else {"enabled": False}),
         }
+
+    def write_trace_snapshot(self, path=None):
+        """Dump the trace ring buffer (summary + raw records) to a JSON
+        file — the SIGUSR1 hook of the serving CLI. Default path:
+        `traces-<completed>.json` in the service directory (CWD without
+        one). Returns the path written."""
+        import json
+
+        payload = {"kind": "serve_traces", "written": time.time(),
+                   "summary": self.traces.summary(),
+                   "traces": self.traces.snapshot()}
+        if path is None:
+            base = self.directory or pathlib.Path(".")
+            path = base / f"traces-{self.traces.completed}.json"
+        path = pathlib.Path(path)
+        path.write_text(json.dumps(payload, indent="\t") + "\n")
+        recorder.emit("serve_trace_snapshot", path=str(path),
+                      buffered=len(self.traces))
+        return path
 
     def _beat_loop(self, interval):
         # First beat immediately: a supervisor adopting a fresh server

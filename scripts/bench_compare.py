@@ -11,8 +11,13 @@ Raw `bench.py` output JSON (the payload without the wrapper) is accepted
 too, as is an `attribution.json` (`"kind": "attribution"`): for those the
 diff runs over per-phase ms/step, the relayout-copy budget and the
 host-gap fraction — COST metrics, so the gate fails on *growth* past the
-tolerance. A `BENCH_serve.json` pair (`"kind": "serve"`,
-`scripts/serve_loadgen.py`) gates the aggregation service the same way:
+tolerance. An `ATTRIB_serve*.json` pair (`"kind": "serve_attribution"`,
+`scripts/serve_loadgen.py --trace`) gets the same treatment per SERVE
+phase (queue wait, pack, dispatch, resolver wake-up, device, resolve:
+p50/p99 growth past tolerance over an absolute noise floor fails; the
+tracing-overhead row is informational). A `BENCH_serve.json` pair
+(`"kind": "serve"`, `scripts/serve_loadgen.py`) gates the aggregation
+service the same way:
 p50/p99 latencies are costs (growth fails), aggregations/s and the
 batched-vs-sequential speedup are rates (drops fail), and cross-backend
 pairs are INCOMPARABLE. That is the phase-budget gate: a PR that regrows the relayout
@@ -44,7 +49,8 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 __all__ = ["load_artifact", "compare", "compare_attribution",
-           "compare_cluster", "compare_serve", "main"]
+           "compare_cluster", "compare_serve", "compare_serve_attribution",
+           "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -223,6 +229,62 @@ def compare_serve(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+# Serve-attribution phases below this many ms are scheduler noise on a
+# 1-core host (a p99 of 0.1 ms doubles on a context switch); the gate
+# never fails on their relative growth alone
+_SERVE_ATTRIB_FLOOR_MS = 0.25
+
+
+def compare_serve_attribution(old_payload, new_payload, tolerance):
+    """The serve-attribution gate over two `ATTRIB_serve*.json` payloads
+    (`scripts/serve_loadgen.py --trace`): per-phase p50/p99 ms and the
+    end-to-end latency percentiles are COSTS — the gate fails on GROWTH
+    past `tolerance` with the `_SERVE_ATTRIB_FLOOR_MS` absolute floor
+    (the training phase-budget discipline, applied per serve phase so a
+    regression in resolver wake-up or host-side packing fails CI by
+    name instead of hiding inside an unchanged aggregate p99). The
+    tracing-overhead fraction and the queue-depth/occupancy rows are
+    INFORMATIONAL (they follow load, not code quality). Mixed-kind and
+    cross-backend pairs are the caller's INCOMPARABLE case."""
+    def costs(payload):
+        out = {}
+        for phase, cell in (payload.get("phases") or {}).items():
+            if not isinstance(cell, dict):
+                continue
+            for key in ("p50_ms", "p99_ms"):
+                value = cell.get(key)
+                if isinstance(value, (int, float)):
+                    out[f"phase.{phase}.{key}"] = float(value)
+        for key in ("p50_ms", "p99_ms"):
+            value = (payload.get("latency") or {}).get(key)
+            if isinstance(value, (int, float)):
+                out[f"latency.{key}"] = float(value)
+        return out
+
+    old_costs, new_costs = costs(old_payload), costs(new_payload)
+    rows = []
+    regressions = []
+    for name in sorted(old_costs):
+        if name not in new_costs:
+            continue
+        old, new = old_costs[name], new_costs[name]
+        delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                   else float("inf"))
+        rows.append((name, old, new, delta))
+        if (new > old * (1.0 + tolerance)
+                and new - old > _SERVE_ATTRIB_FLOOR_MS):
+            regressions.append((name, old, new, delta))
+    for key in ("frac",):
+        old = (old_payload.get("overhead") or {}).get(key)
+        new = (new_payload.get("overhead") or {}).get(key)
+        if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+            delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                      else float("inf"))
+            rows.append((f"overhead.{key} (info)", float(old), float(new),
+                         delta))
+    return rows, regressions
+
+
 def compare_cluster(old_payload, new_payload, tolerance):
     """The multi-host gate over two `CLUSTER_r*.json` artifacts
     (`scripts/cluster_smoke.py`): cluster steps/s is a RATE (drop past
@@ -303,6 +365,35 @@ def main(argv=None):
     print(f"bench_compare: {pathlib.Path(old_path).name} -> "
           f"{pathlib.Path(new_path).name} "
           f"(tolerance {args.tolerance * 100:.1f}%)")
+
+    is_serve_attr = [p.get("kind") == "serve_attribution" for p in payloads]
+    if any(is_serve_attr):
+        # Serve-attribution gate over two ATTRIB_serve*.json artifacts
+        if not all(is_serve_attr):
+            print("bench_compare: INCOMPARABLE — one artifact is a serve "
+                  "attribution, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — serve attributions from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        rows, regressions = compare_serve_attribution(
+            old_payload, new_payload, args.tolerance)
+        if not rows:
+            print("  no common serve phases; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.4f} -> {new:10.4f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} serve phase(s) grew "
+                  f"past the {args.tolerance * 100:.1f}% tolerance")
+            return 1
+        return 0
 
     is_serve = [p.get("kind") == "serve" for p in payloads]
     if any(is_serve):

@@ -25,7 +25,11 @@ The aggregation-service trajectory rides along the same way: per-round
 working tree's `BENCH_serve.json` as `current`) render serve p50/p99
 latency and aggregations/s columns — the quantities the batching layer
 moves and a serving regression would regrow; non-TPU load reports are
-backend-noted like the attribution column.
+backend-noted like the attribution column. Per-round serve-attribution
+artifacts (`ATTRIB_serve_r*.json`, `serve_loadgen.py --trace`, working
+tree `ATTRIB_serve.json` as `current`) add the per-phase columns —
+queue-wait / device / resolve p50 ms — so "which phase ate the p99"
+reads off one table across rounds.
 
 Incomparability discipline (as `bench_compare.py`): a crashed round
 (`rc != 0`, no parsed payload — e.g. the BENCH_r05 down-tunnel crash), a
@@ -50,8 +54,9 @@ sys.path.insert(0, str(ROOT / "scripts"))
 from bench_compare import load_artifact, _rates  # noqa: E402
 
 __all__ = ["collect_cluster", "collect_history", "collect_serve",
-           "collect_tournament", "render_table", "main", "GAR_COLUMN",
-           "CLUSTER_COLUMNS", "SERVE_COLUMNS", "TOURNAMENT_COLUMNS"]
+           "collect_serve_attrib", "collect_tournament", "render_table",
+           "main", "GAR_COLUMN", "CLUSTER_COLUMNS", "SERVE_COLUMNS",
+           "SERVE_ATTRIB_COLUMNS", "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -131,6 +136,48 @@ def collect_serve(root, labels):
     independent, the bench_compare discipline)."""
     return {label: stats for label in labels
             if (stats := _serve_stats(root, label)) is not None}
+
+
+# Serve-attribution trajectory columns (`scripts/serve_loadgen.py
+# --trace` artifacts, r13): the open-loop p50 of the three phases the
+# serve optimizations move — queue wait (batching policy), device
+# (kernels/buckets) and resolve (host-side unpack + suspicion) — from
+# committed `ATTRIB_serve_r*.json` rounds
+SERVE_ATTRIB_COLUMNS = ("queue-wait ms", "device ms", "resolve ms")
+
+
+def _serve_attrib_stats(root, label):
+    """`{queue, device, resolve, backend} | None` for one round's serve
+    attribution: `ATTRIB_serve_r*.json` per round, the working tree's
+    `ATTRIB_serve.json` for the `current` row."""
+    name = ("ATTRIB_serve.json" if label == "current"
+            else f"ATTRIB_serve_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "serve_attribution":
+        return None
+    phases = payload.get("phases") or {}
+
+    def p50(phase):
+        value = (phases.get(phase) or {}).get("p50_ms")
+        return float(value) if isinstance(value, (int, float)) else None
+
+    stats = {"queue": p50("queue"), "device": p50("device"),
+             "resolve": p50("resolve"), "backend": payload.get("backend")}
+    if all(stats[k] is None for k in ("queue", "device", "resolve")):
+        return None  # legacy/foreign payload with no renderable phase
+    return stats
+
+
+def collect_serve_attrib(root, labels):
+    """{label: serve-attribution stats} over the history rows
+    (independent instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _serve_attrib_stats(root, label)) is not None}
 
 
 # Tournament (defense-loop) trajectory columns (`scripts/tournament.py`
@@ -234,6 +281,8 @@ def collect_history(root=ROOT):
     for glob, pattern in (("ATTRIB_r*.json", r"ATTRIB_r(\d+)\.json$"),
                           ("BENCH_serve_r*.json",
                            r"BENCH_serve_r(\d+)\.json$"),
+                          ("ATTRIB_serve_r*.json",
+                           r"ATTRIB_serve_r(\d+)\.json$"),
                           ("TOURNAMENT_r*.json",
                            r"TOURNAMENT_r(\d+)\.json$"),
                           ("CLUSTER_r*.json", r"CLUSTER_r(\d+)\.json$")):
@@ -246,6 +295,7 @@ def collect_history(root=ROOT):
     current = root / "BENCH_cells.json"
     if (current.is_file() or (root / "attribution.json").is_file()
             or (root / "BENCH_serve.json").is_file()
+            or (root / "ATTRIB_serve.json").is_file()
             or (root / "TOURNAMENT.json").is_file()
             or (root / "CLUSTER.json").is_file()):
         labels.append("current")
@@ -276,17 +326,20 @@ def _load_rates(path):
     return rates, None
 
 
-def render_table(history, serve=None, tournament=None, cluster=None):
+def render_table(history, serve=None, tournament=None, cluster=None,
+                 serve_attrib=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
-    attribution column, the serve p50/p99/throughput columns, the
+    attribution column, the serve p50/p99/throughput columns, the serve
+    per-phase attribution columns (queue-wait/device/resolve ms), the
     tournament defense-loop columns and the multi-host hosts/steps-per-s/
     recovery-steps columns when any round carries the matching
     artifact."""
     serve = serve or {}
     tournament = tournament or {}
     cluster = cluster or {}
+    serve_attrib = serve_attrib or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
@@ -294,7 +347,7 @@ def render_table(history, serve=None, tournament=None, cluster=None):
                 columns.append(name)
     any_gar = any(gar is not None for _, _, _, gar in history)
     if not columns and not any_gar and not serve and not tournament \
-            and not cluster:
+            and not cluster and not serve_attrib:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -303,6 +356,8 @@ def render_table(history, serve=None, tournament=None, cluster=None):
         columns = columns + [GAR_COLUMN]
     if serve:
         columns = columns + list(SERVE_COLUMNS)
+    if serve_attrib:
+        columns = columns + list(SERVE_ATTRIB_COLUMNS)
     if tournament:
         columns = columns + list(TOURNAMENT_COLUMNS)
     if cluster:
@@ -327,6 +382,12 @@ def render_table(history, serve=None, tournament=None, cluster=None):
                 None, "tpu"):
             notes.append(f"  {label}: serve columns from a "
                          f"backend={row_serve['backend']} load report")
+        row_serve_attrib = serve_attrib.get(label)
+        if row_serve_attrib is not None and row_serve_attrib.get(
+                "backend") not in (None, "tpu"):
+            notes.append(f"  {label}: serve phase columns from a "
+                         f"backend={row_serve_attrib['backend']} trace "
+                         f"report")
         row_tournament = tournament.get(label)
         row_cluster = cluster.get(label)
         if row_cluster is not None and row_cluster.get("backend") not in (
@@ -349,6 +410,14 @@ def render_table(history, serve=None, tournament=None, cluster=None):
                     return f"{'-':>{w}}"
                 if key == "compiles":
                     return f"{int(value):>{w}d}"
+                return f"{value:>{w}.3f}"
+            if c in SERVE_ATTRIB_COLUMNS:
+                key = {"queue-wait ms": "queue", "device ms": "device",
+                       "resolve ms": "resolve"}[c]
+                value = (None if row_serve_attrib is None
+                         else row_serve_attrib.get(key))
+                if value is None:
+                    return f"{'-':>{w}}"
                 return f"{value:>{w}.3f}"
             if c in TOURNAMENT_COLUMNS:
                 key = {"ttq median": "ttq_median",
@@ -399,6 +468,8 @@ def main(argv=None):
         return 0
     serve = collect_serve(pathlib.Path(args.root),
                           [label for label, *_ in history])
+    serve_attrib = collect_serve_attrib(pathlib.Path(args.root),
+                                        [label for label, *_ in history])
     tournament = collect_tournament(pathlib.Path(args.root),
                                     [label for label, *_ in history])
     cluster = collect_cluster(pathlib.Path(args.root),
@@ -409,11 +480,12 @@ def main(argv=None):
              "gar_ms_per_step": None if gar is None else gar[0],
              "gar_backend": None if gar is None else gar[1],
              "serve": serve.get(label),
+             "serve_attrib": serve_attrib.get(label),
              "tournament": tournament.get(label),
              "cluster": cluster.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
-    print(render_table(history, serve, tournament, cluster))
+    print(render_table(history, serve, tournament, cluster, serve_attrib))
     return 0
 
 

@@ -196,6 +196,16 @@ def main():
                   "loadgen": serve_load.returncode,
                   "returncode": serve_check.returncode
                   or serve_load.returncode}
+    # The selfcheck's trace phase (PR 13): span-tiling error and the
+    # tracing on/off overhead, printed as one `serve trace: {...}` line
+    for line in serve_check.stdout.splitlines():
+        if line.startswith("serve trace: "):
+            try:
+                parsed = json.loads(line[len("serve trace: "):])
+            except ValueError:
+                continue
+            serve_tier["trace_tile_error"] = parsed.get("tile_error_frac")
+            serve_tier["trace_overhead"] = parsed.get("overhead_frac")
     for label, proc in (("selfcheck", serve_check), ("loadgen", serve_load)):
         if proc.returncode != 0:
             serve_tier[f"{label}_tail"] = (proc.stdout

@@ -36,9 +36,22 @@ p99 by `max_delay` (the longest a request waits for batch-mates) plus
 one program execution (measured warm) — the artifact records the bound
 and whether the run met it.
 
+Trace-collection mode (`--trace`, r13): the same open-loop probe run
+with request tracing (`obs/trace`) on, writing `ATTRIB_serve.json`
+(`"kind": "serve_attribution"`) — the serving twin of the training
+`attribution.json`: per-phase p50/p99/mean ms (validate, queue wait,
+pack, dispatch, resolver wake-up, device, resolve) whose means TILE the
+measured request latency (the artifact records the tiling error and the
+15% acceptance bit), the queue-depth and batch-occupancy distributions
+each request observed, and the tracing-on-vs-off throughput overhead
+(paired saturation windows, median of per-pair ratios — robust to the
+1-core host's drift). `bench_compare.py` gates two of these per phase;
+committed rounds live as `ATTRIB_serve_r*.json`.
+
 Usage:
   python scripts/serve_loadgen.py [--smoke] [--out BENCH_serve.json]
   python scripts/serve_loadgen.py --requests 600 --rate 400
+  python scripts/serve_loadgen.py --trace [--out ATTRIB_serve.json]
 
 All traffic runs against the in-process `AggregationService` (the same
 engine the socket front end wraps) on one cell, client ids attached, so
@@ -56,8 +69,8 @@ import numpy as np
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
-__all__ = ["run_loadgen", "run_hetero", "pr8_policy_cells", "percentiles",
-           "main"]
+__all__ = ["run_loadgen", "run_hetero", "run_trace", "pr8_policy_cells",
+           "percentiles", "main"]
 
 
 def percentiles(latencies_ms):
@@ -257,6 +270,134 @@ def run_hetero(*, repeats_per_shape=8, max_batch=8, max_delay_ms=5.0,
     }
 
 
+def run_trace(*, requests=400, n=11, d=128, f=2, gar="krum", max_batch=8,
+              max_delay_ms=5.0, rate=None, seed=1, overhead_pairs=8,
+              tile_tolerance=0.15):
+    """Trace-collection mode: the `ATTRIB_serve.json` payload.
+
+    Phases: (1) tracing OVERHEAD — `overhead_pairs` interleaved
+    on/off/off/on saturation windows; the median of per-pair throughput
+    ratios estimates the cost (pairing cancels host drift, the median
+    ignores outlier windows); (2) the open-loop Poisson probe at half the
+    measured capacity with tracing on, every response's trace collected:
+    per-phase p50/p99/mean ms, the tiling check (phase means must sum to
+    the mean measured latency within `tile_tolerance`), and the
+    queue-depth / batch-occupancy distributions the traces carried."""
+    import statistics
+
+    import jax
+
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    rng = np.random.default_rng(seed)
+    clients = tuple(f"client-{i}" for i in range(n))
+    try:
+        with AggregationService(max_batch=max_batch,
+                                max_delay_ms=max_delay_ms) as service:
+            service.warmup([(gar, n, f, d, True)])
+
+            def window(count=max(100, requests // 4)):
+                t0 = time.perf_counter()
+                futures = [_submit(service, c, gar, f, clients)
+                           for c in _cohorts(rng, count, n, d)]
+                for fut in futures:
+                    fut.result(timeout=120)
+                return count / (time.perf_counter() - t0)
+
+            window(50)  # warm the measurement path itself
+            ratios, on_rates, off_rates = [], [], []
+            for _ in range(overhead_pairs):
+                service.tracing = True
+                a_on = window()
+                service.tracing = False
+                a_off = window()
+                b_off = window()
+                service.tracing = True
+                b_on = window()
+                ratios.append((a_on + b_on) / (a_off + b_off))
+                on_rates += [a_on, b_on]
+                off_rates += [a_off, b_off]
+            overhead = max(0.0, 1.0 - statistics.median(ratios))
+
+            # Open-loop probe, tracing on: the trace stream that becomes
+            # the per-phase attribution
+            service.tracing = True
+            if rate is None:
+                rate = max(1.0, 0.5 * max(on_rates))
+            cohorts = _cohorts(rng, requests, n, d)
+            gaps = rng.exponential(1.0 / rate, size=len(cohorts))
+            arrivals = np.cumsum(gaps)
+            futures = []
+            t0 = time.perf_counter()
+            for cohort, due in zip(cohorts, arrivals):
+                delay = t0 + due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append(_submit(service, cohort, gar, f, clients))
+            results = [fut.result(timeout=120) for fut in futures]
+
+        phases = {}
+        depths, occupancies = [], []
+        latencies = []
+        for result in results:
+            for phase, ms in result.trace.spans_ms().items():
+                phases.setdefault(phase, []).append(ms)
+            record = result.trace
+            if record.depth_at_submit is not None:
+                depths.append(float(record.depth_at_submit))
+            if record.batch_occupancy is not None:
+                occupancies.append(float(record.batch_occupancy))
+            latencies.append(result.latency_ms)
+
+        from byzantinemomentum_tpu.obs.trace.request import LATENCY_PHASES
+        span_sum_mean = sum(
+            sum(phases[p]) / len(phases[p])
+            for p in LATENCY_PHASES if phases.get(p))
+        latency_mean = float(np.mean(latencies))
+        tile_error = abs(span_sum_mean - latency_mean) \
+            / max(latency_mean, 1e-9)
+
+        def dist(values):
+            return {**percentiles(values),
+                    "max_ms": round(float(np.max(values)), 3)}
+
+        return {
+            "kind": "serve_attribution",
+            "backend": jax.default_backend(),
+            "config": {"requests": requests, "n": n, "d": d, "f": f,
+                       "gar": gar, "max_batch": max_batch,
+                       "max_delay_ms": max_delay_ms, "seed": seed,
+                       "rate_per_sec": round(float(rate), 2)},
+            "phases": {phase: dist(values)
+                       for phase, values in sorted(phases.items())},
+            "latency": dist(latencies),
+            "tile": {
+                "span_sum_mean_ms": round(span_sum_mean, 4),
+                "latency_mean_ms": round(latency_mean, 4),
+                "error_frac": round(tile_error, 4),
+                "within_tolerance": bool(tile_error <= tile_tolerance),
+                "tolerance": tile_tolerance,
+            },
+            "queue_depth": ({k.replace("_ms", ""): v
+                             for k, v in dist(depths).items()}
+                            if depths else None),
+            "batch_occupancy": ({k.replace("_ms", ""): v
+                                 for k, v in dist(occupancies).items()}
+                                if occupancies else None),
+            "overhead": {
+                "pairs": overhead_pairs,
+                "agg_per_sec_tracing_on": round(max(on_rates), 2),
+                "agg_per_sec_tracing_off": round(max(off_rates), 2),
+                "ratio_median": round(statistics.median(ratios), 4),
+                "frac": round(overhead, 4),
+            },
+        }
+    finally:
+        sys.setswitchinterval(old_switch)
+
+
 def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
                  seed, repeats, AggregationService, backend):
     rng = np.random.default_rng(seed)
@@ -347,7 +488,10 @@ def main(argv=None):
     parser.add_argument("--repeats", type=int, default=2,
                         help="throughput windows per phase (best kept)")
     parser.add_argument("--seed", type=int, default=1)
-    parser.add_argument("--out", default=str(ROOT / "BENCH_serve.json"))
+    parser.add_argument("--out", default=None,
+                        help="artifact path (default: BENCH_serve.json at "
+                             "the repo root; ATTRIB_serve.json under "
+                             "--trace)")
     parser.add_argument("--smoke", action="store_true",
                         help="tiny CI-sized run (mechanics proof, not a "
                              "measurement); no artifact unless --out-smoke")
@@ -355,7 +499,33 @@ def main(argv=None):
                         help="write the artifact even under --smoke")
     parser.add_argument("--no-heterogeneous", action="store_true",
                         help="skip the mixed-(n, d) workload phase")
+    parser.add_argument("--trace", action="store_true",
+                        help="trace-collection mode: per-phase serve "
+                             "attribution + tracing overhead, written as "
+                             "ATTRIB_serve.json (obs/trace)")
     args = parser.parse_args(argv)
+
+    if args.trace:
+        kwargs = dict(requests=args.requests, n=args.n, d=args.d,
+                      f=args.f, gar=args.gar, max_batch=args.max_batch,
+                      max_delay_ms=args.max_delay_ms, rate=args.rate,
+                      seed=args.seed)
+        if args.smoke:
+            kwargs.update(requests=min(args.requests, 120),
+                          d=min(args.d, 64), overhead_pairs=2)
+        payload = run_trace(**kwargs)
+        line = {k: payload[k] for k in ("kind", "backend")}
+        line["phases_p50_ms"] = {name: cell["p50_ms"]
+                                 for name, cell in payload["phases"].items()}
+        line["tile"] = payload["tile"]
+        line["overhead_frac"] = payload["overhead"]["frac"]
+        print(json.dumps(line))
+        if not args.smoke or args.out_smoke:
+            out = pathlib.Path(args.out) if args.out \
+                else ROOT / "ATTRIB_serve.json"
+            out.write_text(json.dumps(payload, indent=2) + "\n")
+            print(f"serve_loadgen: wrote {out}")
+        return 0
 
     kwargs = dict(requests=args.requests, n=args.n, d=args.d, f=args.f,
                   gar=args.gar, max_batch=args.max_batch,
@@ -377,7 +547,8 @@ def main(argv=None):
         line["compiles"] = payload["compiles"]
     print(json.dumps(line))
     if not args.smoke or args.out_smoke:
-        out = pathlib.Path(args.out)
+        out = pathlib.Path(args.out) if args.out \
+            else ROOT / "BENCH_serve.json"
         out.write_text(json.dumps(payload, indent=2) + "\n")
         print(f"serve_loadgen: wrote {out}")
     return 0

@@ -24,7 +24,8 @@ __all__ = ["Session", "LinePlot", "BoxPlot", "HeatmapPlot", "display",
            "select", "discard",
            "fault_timeline", "fault_rate_sweep",
            "load_telemetry", "run_health", "throughput_sweep",
-           "selection_matrix", "worker_heatmap", "suspicion_timeline"]
+           "selection_matrix", "worker_heatmap", "suspicion_timeline",
+           "load_fleet_timeline", "fleet_health"]
 
 # Training-set sizes for epoch derivation (reference `study.py:309`)
 TRAINING_SIZES = {"mnist": 60000, "fashionmnist": 60000, "kmnist": 60000,
@@ -408,6 +409,62 @@ def throughput_sweep(sessions, reducer="mean"):
     for name, value in zip(names, values):
         plot.include([value], name)
     plot.finalize("Throughput sweep", "Steps/s")
+    return frame, plot
+
+
+# --------------------------------------------------------------------------- #
+# Fleet health (PR 13, `obs/trace/fleet.py`): a cluster run's launcher +
+# per-host telemetry streams joined into one clock-aligned timeline — the
+# multi-host companion of `run_health`.
+
+def load_fleet_timeline(run):
+    """One cluster run's joined fleet timeline as a DataFrame (columns:
+    t, rel_s (seconds since the first entry), source, kind, name, data)
+    — launcher supervision events and every host's lifecycle events,
+    host clocks shifted by the launcher's heartbeat-handshake offset
+    estimates so ordering is causal. Raises when the directory carries
+    no fleet telemetry at all."""
+    from byzantinemomentum_tpu.obs.trace import fleet_timeline
+    entries = fleet_timeline(_session_dir(run))
+    if not entries:
+        raise utils.UserException(
+            f"No fleet telemetry under {str(_session_dir(run))!r}; expected "
+            f"a cluster run directory (launcher telemetry.jsonl + "
+            f"hosts/host-*.telemetry.jsonl)")
+    t0 = entries[0]["t"]
+    rows = [dict(entry, rel_s=entry["t"] - t0) for entry in entries]
+    return pandas.DataFrame(rows)
+
+
+def fleet_health(run):
+    """One cluster run's health timeline: per-host step progress over
+    wall time (clock-aligned), with the supervision story — fired
+    faults, host deaths, liveness transitions, restart agreement —
+    marked as vertical lines. The `obs_report` fleet section, as a
+    plot."""
+    from byzantinemomentum_tpu.obs.trace import host_progress
+    run_dir = _session_dir(run)
+    progress = host_progress(run_dir)
+    frame = load_fleet_timeline(run)
+    if not progress:
+        raise utils.UserException(
+            f"No per-host step gauges under {str(run_dir)!r}; the fleet "
+            f"must run with PR 13+ host telemetry")
+    t0 = min(series[0][0] for series in progress.values())
+    t0 = min(t0, float(frame["t"].iloc[0]))
+    plot = LinePlot()
+    for host, series in sorted(progress.items()):
+        sub = pandas.DataFrame(
+            {f"host-{host} step": [step for _, step in series]},
+            index=pandas.Index([t - t0 for t, _ in series],
+                               name="Run time (s)"))
+        plot.include(sub, f"host-{host} step", axkey="step")
+    events = frame[frame["kind"] == "event"]
+    for name, color in (("fault_injected", "red"), ("host_dead", "black"),
+                        ("restart_agreed", "green"), ("wedge", "orange")):
+        for _, event in events[events["name"] == name].iterrows():
+            plot.vline(float(event["t"]) - t0, color=color, label=name)
+    plot.finalize("Fleet health", "Run time (s)", "Host step")
     return frame, plot
 
 

@@ -643,3 +643,118 @@ def test_bench_history_cluster_columns(tmp_path, capsys):
     by_round = {row["round"]: row for row in payload}
     assert by_round["r02"]["cluster"]["rate"] == 0.9
     assert by_round["r01"]["cluster"] is None
+
+
+# --------------------------------------------------------------------------- #
+# serve-attribution gate: ATTRIB_serve*.json per-phase comparison (PR 13)
+
+def _serve_attrib_artifact(path, queue_p99=5.0, device_p99=0.3,
+                           resolve_p99=1.0, backend="cpu",
+                           overhead=0.02):
+    def cell(p99):
+        return {"p50_ms": round(p99 / 3.0, 3), "p90_ms": round(p99 / 1.5, 3),
+                "p99_ms": p99, "mean_ms": round(p99 / 2.5, 3),
+                "max_ms": p99 * 1.1}
+
+    payload = {
+        "kind": "serve_attribution", "backend": backend,
+        "phases": {"validate": cell(0.05), "queue": cell(queue_p99),
+                   "pack": cell(0.06), "dispatch": cell(1.0),
+                   "resolver_wake": cell(0.4), "device": cell(device_p99),
+                   "resolve": cell(resolve_p99)},
+        "latency": cell(queue_p99 + 2.0),
+        "tile": {"error_frac": 0.01, "within_tolerance": True},
+        "queue_depth": {"p50": 4.0, "p99": 9.0, "mean": 4.5, "max": 12.0},
+        "batch_occupancy": {"p50": 1.0, "p99": 1.0, "mean": 0.97,
+                            "max": 1.0},
+        "overhead": {"frac": overhead},
+    }
+    path.write_text(json.dumps(payload))
+    return path
+
+
+def test_serve_attrib_gate_within_tolerance_passes(tmp_path, capsys):
+    old = _serve_attrib_artifact(tmp_path / "old.json", queue_p99=5.0)
+    new = _serve_attrib_artifact(tmp_path / "new.json", queue_p99=5.1)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "phase.queue.p99_ms" in out and "latency.p99_ms" in out
+    assert "overhead.frac (info)" in out
+    assert "REGRESSED" not in out
+
+
+def test_serve_attrib_gate_phase_p99_growth_fails(tmp_path, capsys):
+    old = _serve_attrib_artifact(tmp_path / "old.json", resolve_p99=1.0)
+    new = _serve_attrib_artifact(tmp_path / "new.json", resolve_p99=2.5)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [l for l in out.splitlines() if "phase.resolve.p99_ms" in l][0]
+    assert "REGRESSED" in line
+
+
+def test_serve_attrib_gate_sub_floor_growth_is_noise(tmp_path, capsys):
+    """A phase that doubles from 0.1 to 0.2 ms is scheduler noise on a
+    1-core host — the absolute floor keeps it out of the gate."""
+    old = _serve_attrib_artifact(tmp_path / "old.json", device_p99=0.10)
+    new = _serve_attrib_artifact(tmp_path / "new.json", device_p99=0.20)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REGRESSED" not in out
+
+
+def test_serve_attrib_gate_overhead_is_informational(tmp_path, capsys):
+    old = _serve_attrib_artifact(tmp_path / "old.json", overhead=0.01)
+    new = _serve_attrib_artifact(tmp_path / "new.json", overhead=0.05)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "overhead.frac (info)" in out and "REGRESSED" not in out
+
+
+def test_serve_attrib_gate_incomparable_pairs(tmp_path, capsys):
+    attrib = _serve_attrib_artifact(tmp_path / "a.json")
+    # Cross-backend
+    native = _serve_attrib_artifact(tmp_path / "b.json", backend="tpu")
+    assert bench_compare.main([str(attrib), str(native)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+    # Mixed kinds: a serve load report is NOT a serve attribution
+    serve = _serve_artifact(tmp_path / "c.json")
+    assert bench_compare.main([str(attrib), str(serve)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+    bench = _artifact(tmp_path, "BENCH_r09.json", 10.0)
+    assert bench_compare.main([str(attrib), str(bench)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+
+
+def test_bench_history_serve_phase_columns(tmp_path, capsys):
+    """queue-wait / device / resolve ms columns render from committed
+    ATTRIB_serve_r*.json rounds; an attribution-only round still gets a
+    row and the CPU backend is flagged in the notes."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _serve_attrib_artifact(tmp_path / "ATTRIB_serve_r02.json",
+                           queue_p99=3.0, device_p99=0.3, resolve_p99=1.5)
+
+    stats = bench_history.collect_serve_attrib(tmp_path, ["r01", "r02"])
+    assert "r01" not in stats
+    assert stats["r02"]["queue"] == 1.0   # p50 = p99 / 3 per the helper
+    assert stats["r02"]["resolve"] == 0.5
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for column in bench_history.SERVE_ATTRIB_COLUMNS:
+        assert column in out
+    r02 = [l for l in out.splitlines() if l.startswith("r02")][0]
+    assert r02.split()[-3:] == ["1.000", "0.100", "0.500"]
+    assert "backend=cpu trace report" in out
+
+    rc = bench_history.main(["--root", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_round = {row["round"]: row for row in payload}
+    assert by_round["r02"]["serve_attrib"]["queue"] == 1.0
+    assert by_round["r01"]["serve_attrib"] is None

@@ -337,6 +337,34 @@ def test_cluster_kill_one_host_recovery_is_bit_identical(tmp_path):
     assert "fault_injected" in events
     assert "host_dead" in events
     assert "restart_agreed" in events
+    # Fleet-wide attribution (PR 13, obs/trace/fleet.py): the run dir's
+    # launcher + per-host telemetry streams join into ONE causally
+    # ordered timeline — the fired fault, the host death and the agreed
+    # restart step must read as ordered events, host clock offsets
+    # estimated from the heartbeat handshake
+    from byzantinemomentum_tpu.obs.trace import (
+        estimate_offsets, fleet_timeline, load_fleet)
+    chaos_dir = tmp_path / "chaos"
+    fleet = load_fleet(chaos_dir)
+    assert sorted(fleet["hosts"]) == [0, 1]  # every host left a stream
+    assert estimate_offsets(fleet["launcher"])  # handshake estimates
+    timeline = fleet_timeline(chaos_dir)
+    names = [entry["name"] for entry in timeline]
+    assert names.index("fault_injected") < names.index("host_dead") \
+        < names.index("restart_agreed")
+    # Host streams interleave: the killed host started, the relaunch
+    # adopted the agreed step (host_resume), and liveness edges are
+    # first-class events
+    sources = {entry["source"] for entry in timeline}
+    assert {"launcher", "host-0", "host-1"} <= sources
+    assert "host_resume" in names and "liveness_transition" in names
+    # The one-pager renders the same ordered story for the run dir
+    from byzantinemomentum_tpu.obs.report import render_report
+    from byzantinemomentum_tpu.obs.trace import render_fleet_report
+    assert "fleet timeline" in render_report(chaos_dir)
+    full = "\n".join(render_fleet_report(chaos_dir, limit=1000))
+    assert full.index("fault_injected") < full.index("host_dead") \
+        < full.index("restart_agreed")
 
 
 @pytest.mark.slow

@@ -1,0 +1,418 @@
+"""Request-scoped serve tracing + fleet-wide attribution (`obs/trace/`,
+PR 13): span stamps that tile the measured serve latency, the bounded
+completed-trace ring, trace-id propagation through the line-JSON
+protocol (malformed ids answer without severing), the queue-depth gauge
+emitted on every queue transition, the heartbeat-handshake clock-offset
+estimator, and the joined fleet timeline that reorders skewed host
+streams correctly."""
+
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import obs
+from byzantinemomentum_tpu.obs.trace import (
+    ClockOffsetTracker, RequestTrace, TraceBuffer, estimate_offsets,
+    fleet_timeline, percentile, render_fleet_report)
+from byzantinemomentum_tpu.obs.trace.fleet import host_telemetry_path
+from byzantinemomentum_tpu.obs.trace.request import LATENCY_PHASES
+
+
+# --------------------------------------------------------------------------- #
+# RequestTrace: span computation
+
+def test_request_trace_spans_and_total():
+    trace = RequestTrace("req-1")
+    base = 1000.0
+    for name, at in (("recv", 0.000), ("accept", 0.001), ("submit", 0.002),
+                     ("done", 0.012)):
+        trace.stamp(name, at=base + at)
+    trace.batch_stamps = {"flush": base + 0.004, "packed": base + 0.005,
+                          "dispatched": base + 0.006,
+                          "resolver": base + 0.008, "device": base + 0.010,
+                          "batch_size": 4, "batch_occupancy": 0.5}
+    spans = trace.spans_ms()
+    assert spans["parse"] == pytest.approx(1.0, rel=1e-6)
+    assert spans["validate"] == pytest.approx(1.0, rel=1e-6)
+    assert spans["queue"] == pytest.approx(2.0, rel=1e-6)
+    assert spans["pack"] == pytest.approx(1.0, rel=1e-6)
+    assert spans["dispatch"] == pytest.approx(1.0, rel=1e-6)
+    assert spans["resolver_wake"] == pytest.approx(2.0, rel=1e-6)
+    assert spans["device"] == pytest.approx(2.0, rel=1e-6)
+    assert spans["resolve"] == pytest.approx(2.0, rel=1e-6)
+    # The tiling identity: latency phases sum to submit->done
+    assert sum(spans[p] for p in LATENCY_PHASES) == pytest.approx(
+        trace.total_ms(), rel=1e-9)
+    record = trace.as_dict()
+    assert record["trace_id"] == "req-1"
+    assert record["batch_size"] == 4 and record["batch_occupancy"] == 0.5
+
+
+def test_request_trace_partial_stamps_and_auto_id():
+    trace = RequestTrace()  # auto id, accept stamped at creation
+    assert trace.trace_id.startswith("t")
+    spans = trace.spans_ms()  # nothing else stamped: no complete phase
+    assert spans == {}
+    assert trace.total_ms() is None
+    # A numeric wire id round-trips as its string form, verbatim
+    assert RequestTrace(17).trace_id == "17"
+    assert RequestTrace(17).as_dict()["trace_id"] == "17"
+
+
+def test_request_trace_negative_span_clamps():
+    trace = RequestTrace("x")
+    trace.stamp("submit", at=10.0)
+    trace.batch_stamps = {"flush": 9.9}  # cross-thread stamp inversion
+    assert trace.spans_ms()["queue"] == 0.0
+
+
+# --------------------------------------------------------------------------- #
+# TraceBuffer: bounding + summary
+
+def test_trace_buffer_bounds_and_counts():
+    buffer = TraceBuffer(maxlen=8)
+    for i in range(50):
+        trace = RequestTrace(f"t{i}")
+        trace.stamp("submit", at=float(i))
+        trace.stamp("done", at=float(i) + 0.001 * (i + 1))
+        buffer.add(trace)
+    assert len(buffer) == 8               # the ring is BOUNDED
+    assert buffer.completed == 50         # ...but the count is total
+    records = buffer.snapshot()
+    assert [r["trace_id"] for r in records] == [f"t{i}" for i in
+                                                range(42, 50)]
+    summary = buffer.summary()
+    assert summary["buffered"] == 8 and summary["completed"] == 50
+    assert summary["total_ms"]["max"] == pytest.approx(50.0, rel=1e-6)
+    with pytest.raises(ValueError, match="maxlen"):
+        TraceBuffer(maxlen=0)
+
+
+def test_percentile_nearest_rank():
+    values = list(range(1, 101))
+    assert percentile(values, 50) in (50, 51)
+    assert percentile(values, 99) == 100 or percentile(values, 99) == 99
+    assert percentile([7.0], 99) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+# --------------------------------------------------------------------------- #
+# Service end-to-end: spans tile latency, gauge transitions, snapshot
+
+def test_service_traces_tile_latency_and_ride_responses(tmp_path):
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    rng = np.random.default_rng(0)
+    with AggregationService(max_batch=4, max_delay_ms=2.0) as service:
+        service.warmup([("krum", 7, 1, 32, False)])
+        futures = [service.submit(
+            rng.standard_normal((7, 32)).astype(np.float32),
+            gar="krum", f=1, diagnostics=False, trace_id=f"req-{k}")
+            for k in range(12)]
+        results = [fut.result(timeout=60) for fut in futures]
+    for k, result in enumerate(results):
+        assert result.trace.trace_id == f"req-{k}"
+        spans = result.trace.spans_ms()
+        tiled = sum(spans[p] for p in LATENCY_PHASES if p in spans)
+        # The span sum IS the measured latency (same stamps)
+        assert tiled == pytest.approx(result.latency_ms, rel=0.01)
+        record = result.trace.as_dict()
+        assert record["gar"] == "krum" and record["n"] == 7
+        assert record["depth_at_submit"] >= 1
+    # The ring buffer saw every request
+    assert len(results) == 12
+
+
+def test_service_tracing_off_skips_everything():
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    rng = np.random.default_rng(0)
+    with AggregationService(max_batch=2, max_delay_ms=1.0,
+                            tracing=False) as service:
+        result = service.aggregate(
+            rng.standard_normal((5, 16)).astype(np.float32),
+            gar="median", f=1, diagnostics=False, timeout=60)
+        assert result.trace is None
+        assert "trace" not in result.as_dict()
+        assert service.stats()["tracing"] == {"enabled": False}
+        assert service.traces.completed == 0
+
+
+def test_queue_depth_gauge_emitted_on_every_transition(tmp_path):
+    """The satellite fix: `serve_queue_depth` lands on submit, flush AND
+    resolver drain — an idle-then-burst queue is visible as a rise-fall
+    sequence, not only the post-flush residue."""
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    telemetry = obs.activate(obs.Telemetry(tmp_path))
+    try:
+        rng = np.random.default_rng(0)
+        with AggregationService(max_batch=4, max_delay_ms=50.0) as service:
+            service.warmup([("median", 5, 1, 16, False)])
+            futures = [service.submit(
+                rng.standard_normal((5, 16)).astype(np.float32),
+                gar="median", f=1, diagnostics=False) for _ in range(4)]
+            for fut in futures:
+                fut.result(timeout=60)
+    finally:
+        obs.deactivate()
+        telemetry.close()
+    gauges = [(r["data"]["edge"], r["value"])
+              for r in obs.load_records(tmp_path)
+              if r.get("kind") == "gauge"
+              and r.get("name") == "serve_queue_depth"]
+    edges = [e for e, _ in gauges]
+    assert "submit" in edges and "flush" in edges and "drain" in edges
+    # The burst builds depth on submit edges...
+    submit_depths = [v for e, v in gauges if e == "submit"]
+    assert max(submit_depths) >= 2
+    # ...and the queue is drained by the end
+    assert [v for e, v in gauges if e == "drain"][-1] == 0
+
+
+def test_trace_snapshot_file(tmp_path):
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    rng = np.random.default_rng(0)
+    with AggregationService(max_batch=2, max_delay_ms=1.0,
+                            directory=tmp_path / "run") as service:
+        service.aggregate(rng.standard_normal((5, 16)).astype(np.float32),
+                          gar="median", f=1, diagnostics=False, timeout=60)
+        path = service.write_trace_snapshot()
+    payload = json.loads(path.read_text())
+    assert payload["kind"] == "serve_traces"
+    assert payload["summary"]["completed"] >= 1
+    assert payload["traces"] and "spans_ms" in payload["traces"][0]
+
+
+# --------------------------------------------------------------------------- #
+# Frontend: trace-id propagation + malformed ids
+
+def _roundtrip_lines(server_port, lines):
+    out = []
+    with socket.create_connection(("127.0.0.1", server_port),
+                                  timeout=30) as conn:
+        fd = conn.makefile("rwb")
+        for line in lines:
+            fd.write(json.dumps(line).encode() + b"\n")
+            fd.flush()
+            out.append(json.loads(fd.readline()))
+    return out
+
+
+def test_frontend_trace_id_roundtrip_and_malformed(tmp_path):
+    from byzantinemomentum_tpu.serve import AggregationService
+    from byzantinemomentum_tpu.serve.frontend import AggregationServer
+
+    rng = np.random.default_rng(0)
+    cohort = rng.standard_normal((5, 16)).astype(np.float32).tolist()
+    with AggregationService(max_batch=2, max_delay_ms=1.0) as service:
+        with AggregationServer(("127.0.0.1", 0), service) as server:
+            server.serve_background()
+            responses = _roundtrip_lines(server.port, [
+                {"op": "aggregate", "gar": "median", "f": 1,
+                 "vectors": cohort, "trace": "wire-7"},
+                # malformed id: answers an error WITHOUT severing
+                {"op": "aggregate", "gar": "median", "f": 1,
+                 "vectors": cohort, "trace": {"bad": 1}},
+                # absent id: auto-assigned, trace still rides back
+                {"op": "aggregate", "gar": "median", "f": 1,
+                 "vectors": cohort},
+                {"op": "ping"},
+            ])
+            server.shutdown()
+    assert responses[0]["ok"] and responses[0]["trace"]["trace_id"] == \
+        "wire-7"
+    assert responses[0]["trace"]["spans_ms"]["parse"] >= 0.0
+    assert not responses[1]["ok"] and "trace id" in responses[1]["error"]
+    assert responses[2]["ok"] and responses[2]["trace"]["trace_id"]
+    assert responses[3] == {"ok": True, "op": "ping"}
+
+
+def test_frontend_tracing_off_omits_trace_key():
+    from byzantinemomentum_tpu.serve import AggregationService
+    from byzantinemomentum_tpu.serve.frontend import AggregationServer
+
+    rng = np.random.default_rng(0)
+    cohort = rng.standard_normal((5, 16)).astype(np.float32).tolist()
+    with AggregationService(max_batch=2, max_delay_ms=1.0,
+                            tracing=False) as service:
+        with AggregationServer(("127.0.0.1", 0), service) as server:
+            server.serve_background()
+            (response,) = _roundtrip_lines(server.port, [
+                {"op": "aggregate", "gar": "median", "f": 1,
+                 "vectors": cohort, "trace": "ignored"}])
+            server.shutdown()
+    assert response["ok"] and "trace" not in response
+
+
+# --------------------------------------------------------------------------- #
+# Clock-offset estimator
+
+def test_clock_offset_tracker_takes_the_minimum_skew():
+    tracker = ClockOffsetTracker()
+    # Host 1 runs 5.0s BEHIND the launcher; poll delay varies 0.1-0.9s
+    for delay in (0.9, 0.3, 0.1, 0.5):
+        host_wall = 100.0
+        tracker.observe(1, host_wall, host_wall + 5.0 + delay)
+    est = tracker.estimate()
+    assert est[1] == pytest.approx(5.1, abs=1e-9)  # min(5.0 + delay)
+    assert tracker.samples[1] == 4
+    # A host AHEAD of the launcher estimates negative
+    tracker.observe(2, 200.0, 197.0)
+    assert tracker.estimate()[2] == pytest.approx(-3.0)
+    # None host stamps are ignored, not fatal
+    tracker.observe(3, None, 100.0)
+    assert 3 not in tracker.estimate()
+    data = tracker.as_event_data()
+    assert data["offsets"]["1"] == pytest.approx(5.1, abs=1e-6)
+    assert data["samples"]["2"] == 1
+
+
+def test_estimate_offsets_reads_newest_event():
+    records = [
+        {"kind": "event", "name": "clock_offsets",
+         "data": {"offsets": {"0": 1.0, "1": 2.0}}},
+        {"kind": "event", "name": "other"},
+        {"kind": "event", "name": "clock_offsets",
+         "data": {"offsets": {"0": 0.5, "1": 1.5, "bad": "x"}}},
+    ]
+    assert estimate_offsets(records) == {0: 0.5, 1: 1.5}
+    assert estimate_offsets([]) == {}
+
+
+# --------------------------------------------------------------------------- #
+# Fleet timeline: skewed synthetic host streams reorder correctly
+
+def _write_jsonl(path, records):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _synthetic_cluster_run(tmp_path, *, skew=30.0):
+    """A 2-host run dir whose host-1 clock runs `skew` seconds BEHIND
+    the launcher: naively merged, its events would sort before the
+    launch. The launcher's clock_offsets event carries the estimate."""
+    t0 = 1000.0
+    _write_jsonl(tmp_path / "telemetry.jsonl", [
+        {"t": t0 + 0.0, "kind": "event", "name": "cluster_start",
+         "data": {"hosts": 2}},
+        {"t": t0 + 0.1, "kind": "event", "name": "fleet_launch",
+         "data": {"attempt": 1}},
+        {"t": t0 + 3.0, "kind": "event", "name": "fault_injected",
+         "data": {"kind": "device_loss", "host": 1, "at_step": 3}},
+        {"t": t0 + 3.5, "kind": "event", "name": "liveness_transition",
+         "data": {"from": "alive", "to": "dead", "host": 1}},
+        {"t": t0 + 4.0, "kind": "event", "name": "host_dead",
+         "data": {"host": 1, "at_step": 3}},
+        {"t": t0 + 5.0, "kind": "event", "name": "clock_offsets",
+         "data": {"offsets": {"0": 0.0, "1": skew}}},
+        {"t": t0 + 6.0, "kind": "event", "name": "restart_agreed",
+         "data": {"step": 2, "hosts": 2}},
+        {"t": t0 + 9.0, "kind": "event", "name": "cluster_end",
+         "data": {"status": "ok"}},
+    ])
+    _write_jsonl(host_telemetry_path(tmp_path, 0), [
+        {"t": t0 + 1.0, "kind": "event", "name": "host_start",
+         "data": {"host": 0}},
+        {"t": t0 + 2.0, "kind": "gauge", "name": "host_step", "value": 1},
+        {"t": t0 + 8.0, "kind": "event", "name": "host_end",
+         "data": {"host": 0, "steps": 6}},
+    ])
+    # Host 1's clock: launcher time minus skew
+    _write_jsonl(host_telemetry_path(tmp_path, 1), [
+        {"t": t0 + 1.2 - skew, "kind": "event", "name": "host_start",
+         "data": {"host": 1}},
+        {"t": t0 + 2.5 - skew, "kind": "gauge", "name": "host_step",
+         "value": 2},
+    ])
+    return t0
+
+
+def test_fleet_timeline_reorders_skewed_host_streams(tmp_path):
+    t0 = _synthetic_cluster_run(tmp_path, skew=30.0)
+    timeline = fleet_timeline(tmp_path)
+    names = [(e["source"], e["name"]) for e in timeline]
+    # Host 1's start sorts AFTER the launch despite its skewed stamps
+    assert names.index(("launcher", "fleet_launch")) \
+        < names.index(("host-1", "host_start"))
+    # The supervision story is ordered: fault -> death -> restart
+    assert names.index(("launcher", "fault_injected")) \
+        < names.index(("launcher", "host_dead")) \
+        < names.index(("launcher", "restart_agreed"))
+    # Clock shift applied exactly: host-1 host_start at t0+1.2
+    start = next(e for e in timeline
+                 if e["source"] == "host-1" and e["name"] == "host_start")
+    assert start["t"] == pytest.approx(t0 + 1.2, abs=1e-6)
+    # Without offsets the skewed stream would sort FIRST — prove the
+    # counterfactual the estimator exists for
+    naive = fleet_timeline(tmp_path, offsets={})
+    assert naive[0]["source"] == "host-1"
+
+
+def test_fleet_report_renders_ordered_events(tmp_path):
+    _synthetic_cluster_run(tmp_path, skew=30.0)
+    (tmp_path / "cluster.json").write_text(json.dumps({
+        "hosts": 2, "status": "ok", "attempt": 2,
+        "restart_step": 2, "fired_faults": [0],
+        "recoveries": [{"host": 1, "died_at_step": 3, "restart_step": 2,
+                        "recovery_steps": 1}]}))
+    lines = render_fleet_report(tmp_path)
+    text = "\n".join(lines)
+    assert "fleet: hosts=2" in text and "fired_faults=[0]" in text
+    assert "recovery: host 1 died at step 3" in text
+    assert "clock offsets" in text and "host-1" in text
+    assert text.index("fault_injected") < text.index("host_dead") \
+        < text.index("restart_agreed")
+    # The obs one-pager appends the same section for cluster dirs
+    from byzantinemomentum_tpu.obs.report import render_report
+    report = render_report(tmp_path)
+    assert "fleet timeline" in report and "fault_injected" in report
+
+
+def test_fleet_report_empty_for_plain_run_dir(tmp_path):
+    assert render_fleet_report(tmp_path) == []
+
+
+def test_study_fleet_timeline_frame(tmp_path):
+    _synthetic_cluster_run(tmp_path, skew=10.0)
+    import study
+
+    frame = study.load_fleet_timeline(tmp_path)
+    assert set(frame["source"]) >= {"launcher", "host-0", "host-1"}
+    assert (frame["t"].diff().dropna() >= 0).all()  # causally ordered
+    with pytest.raises(Exception, match="No fleet telemetry"):
+        study.load_fleet_timeline(tmp_path / "empty")
+
+
+# --------------------------------------------------------------------------- #
+# Loadgen trace-collection mode (the ATTRIB_serve.json payload)
+
+@pytest.mark.slow
+def test_loadgen_trace_mode_payload():
+    import importlib.util
+    import pathlib
+    import sys as _sys
+
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "serve_loadgen.py")
+    spec = importlib.util.spec_from_file_location("serve_loadgen", script)
+    loadgen = importlib.util.module_from_spec(spec)
+    _sys.modules.setdefault("serve_loadgen", loadgen)
+    spec.loader.exec_module(loadgen)
+
+    payload = loadgen.run_trace(requests=80, n=7, d=32, f=1,
+                                overhead_pairs=1)
+    assert payload["kind"] == "serve_attribution"
+    phases = payload["phases"]
+    for phase in ("queue", "pack", "dispatch", "resolver_wake", "device",
+                  "resolve", "validate"):
+        assert phase in phases and phases[phase]["p99_ms"] >= 0.0
+    assert payload["tile"]["within_tolerance"], payload["tile"]
+    assert payload["queue_depth"]["max"] >= 1
+    assert 0.0 < payload["batch_occupancy"]["max"] <= 1.0
+    assert "frac" in payload["overhead"]
