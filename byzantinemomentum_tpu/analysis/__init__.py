@@ -21,13 +21,28 @@ properties of the source and of the lowering itself:
              byte-identical assertion into a blessed contract
              (`tests/goldens/lowerings.json`, `scripts/bless_lowerings.py`)
              with a CI gate that fails on unexplained lowering drift.
+  concurrency  BMT-T lock-discipline rules (RacerD-style thread-role ×
+             lock-set analysis over the serve/cluster thread surface):
+             unguarded cross-thread writes, inconsistent guards,
+             lock-order inversions, blocking calls under locks, leaked
+             threads. Registered in `lint.RULES`, so one lint pass runs
+             both AST families under one noqa contract.
+  schedule   The dynamic twin: a deterministic interleaving harness
+             (instrumented Lock/Condition + explicit preemption points,
+             replayable schedule strings, exhaustive bounded-preemption
+             exploration, deadlock detection) that demonstrates the
+             races the T-rules claim and pins the fixed code as
+             schedule-clean.
 
-CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints;
-`--check-lowerings` runs the drift gate; `--rules` prints the rule table.
+CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints (E- and
+T-families); `--check-lowerings` runs the drift gate; `--schedule-smoke`
+runs the interleaving-harness selfcheck; `--rules` prints the rule table.
 Suppressions are per-line `# bmt: noqa[BMT-Exx] <reason>` and the reason
 is mandatory (an empty reason is itself a violation, `BMT-E00`).
 """
 
 from byzantinemomentum_tpu.analysis import lint  # noqa: F401 (jax-free)
+# Importing registers the BMT-T concurrency rules in lint.RULES (jax-free)
+from byzantinemomentum_tpu.analysis import concurrency  # noqa: F401
 
-__all__ = ["lint"]
+__all__ = ["lint", "concurrency"]

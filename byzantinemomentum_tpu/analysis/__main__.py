@@ -1,19 +1,26 @@
-"""CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints;
-`--check-lowerings` runs the lattice drift gate (StableHLO fingerprints
-+ BMT-H structural lint over every enumerated cell); `--rules` prints
-both registries (jaxlint BMT-E, hlolint BMT-H). Exit 0 = clean (or
-incomparable goldens), 1 = violations/drift, 2 = usage error."""
+"""CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints
+(jaxlint BMT-E rules AND the BMT-T concurrency rules — both AST
+families run in one pass); `--check-lowerings` runs the lattice drift
+gate (StableHLO fingerprints + BMT-H structural lint over every
+enumerated cell); `--schedule-smoke` runs the deterministic
+interleaving harness's selfcheck (the planted serve-counter lost-update
+must be found; the fixed pattern must be schedule-clean); `--rules`
+prints all three registries (E, H, T) in one table. Exit 0 = clean (or
+incomparable goldens), 1 = violations/drift/failed smoke, 2 = usage
+error."""
 
 import argparse
 import json
 import sys
 
+# Importing the package registers the BMT-T rules beside the E-rules
 from byzantinemomentum_tpu.analysis import hlolint, lint
 
 
 def _print_rules():
-    """Both registries, one table: the AST rules (E) over source and the
-    structural rules (H) over lowered programs."""
+    """All registries, one table: the AST rules over source (jaxlint
+    BMT-E + the BMT-T concurrency contracts, one registry) and the
+    structural rules (BMT-H) over lowered programs."""
     rules = {**lint.RULES, **hlolint.HLO_RULES}
     width = max(len(r.slug) for r in rules.values())
     for rule_id in sorted(rules):
@@ -73,6 +80,11 @@ def main(argv=None):
     parser.add_argument("--check-lowerings", action="store_true",
                         help="compare StableHLO fingerprints against the "
                              "blessed goldens")
+    parser.add_argument("--schedule-smoke", action="store_true",
+                        help="run the interleaving-harness selfcheck "
+                             "(analysis/schedule.py): the planted "
+                             "lost-update is found, the fixed counter is "
+                             "schedule-clean")
     parser.add_argument("--goldens", default=None,
                         help="override the goldens path "
                              "(default tests/goldens/lowerings.json)")
@@ -81,9 +93,10 @@ def main(argv=None):
     if args.rules:
         _print_rules()
         return 0
-    if not args.paths and not args.check_lowerings:
+    if (not args.paths and not args.check_lowerings
+            and not args.schedule_smoke):
         parser.error("nothing to do: give paths to lint, "
-                     "--check-lowerings, or --rules")
+                     "--check-lowerings, --schedule-smoke, or --rules")
 
     rc = 0
     if args.paths:
@@ -96,6 +109,12 @@ def main(argv=None):
         rc = 1 if violations else rc
     if args.check_lowerings:
         rc = max(rc, _check_lowerings(args.goldens, args.json))
+    if args.schedule_smoke:
+        from byzantinemomentum_tpu.analysis import schedule
+        report = schedule.selfcheck()
+        # One parseable line (the lint tier records it) + human detail
+        print("schedule: " + json.dumps(report, sort_keys=True))
+        rc = max(rc, 0 if report["ok"] else 1)
     return rc
 
 

@@ -1,0 +1,606 @@
+"""BMT-T — concurrency contracts: a RacerD-style lock-set lint over the
+host-thread surface.
+
+jaxlint (`analysis/lint.py`) covers traced JAX code and hlolint covers
+lowered HLO; this module covers the THIRD execution substrate the serve/
+cluster layers grew: host threads. The analysis is pure AST (one pass,
+no imports executed) and per class, in the spirit of RacerD (Blackshear
+et al., CACM 2019 — see PAPERS.md): infer which *thread role* each
+method runs under, infer each shared attribute's *guarding lock* from
+the lock held on the majority of its accesses, and report the
+disciplined-concurrency violations this codebase can actually have.
+What RacerD's Java deployment needed and Python does not — ownership
+inference and value-escape tracking — is deliberately dropped: under
+the GIL single bytecodes are atomic, so the bug class that matters is
+the compound check-then-act / read-modify-write on `self` state shared
+across threads, which the role × lock-set table catches.
+
+Thread-role inference, per class (documented in the README):
+
+  * a method passed as `threading.Thread(target=self.m)` is a thread
+    entry — it and every method reachable from it through same-class
+    `self.x()` calls run under role `thread:m`;
+  * `handle` of a `socketserver.*RequestHandler` subclass (and its
+    same-class callees) runs under role `handler` — one per connection
+    under `ThreadingTCPServer`;
+  * a bound method that ESCAPES by reference (`Worker(self._cb, ...)`,
+    `x = self._cb`) is assumed to run on whatever thread calls it back:
+    role `escape:m`. This is exactly how `serve/service.py` hands
+    `_dispatch`/`_resolve` to the microbatcher's daemon threads;
+  * public methods (and private ones nobody in the class calls) run
+    under role `caller`;
+  * `__init__` is excluded everywhere: construction happens-before any
+    thread the object starts (the RacerD ownership assumption, reduced
+    to the one case Python needs).
+
+Only modules that import `threading` or `socketserver` are analyzed —
+a class that never touches the thread machinery cannot share state
+across threads it does not create (callbacks it hands to OTHER modules'
+threads are that module's `escape:` surface).
+
+Lock-set inference: a *lock attribute* is any `self.x` assigned from
+`threading.Lock/RLock/Condition`. An access holds the locks of every
+enclosing `with self.lock:` block, plus the locks held at EVERY
+same-class call site of its method (so `_due`, only ever called by the
+flusher inside `with self._cond:`, is correctly seen as guarded).
+
+Rules (registered in `lint.RULES` beside the E-family, so the
+`# bmt: noqa[BMT-Txx] reason` contract, BMT-E00 reason enforcement and
+BMT-E09 dead-noqa detection all apply unchanged):
+
+  BMT-T01  unguarded-cross-thread-write   an attribute written in one
+           role and touched in another, with a write access holding no
+           lock — the lost-update shape (`x += 1` from two threads).
+  BMT-T02  inconsistent-guard             one attribute guarded by
+           DIFFERENT locks on different accesses — each thread is
+           mutually excluded only against itself.
+  BMT-T03  lock-order-inversion           a cycle in the class's lock
+           acquisition graph (A taken under B and B under A): the
+           classic ABBA deadlock.
+  BMT-T04  blocking-call-under-lock       `time.sleep`, socket calls,
+           `future.result()`, `Event.wait`, `Thread.join`,
+           `queue.get` ... while holding a lock — every other thread
+           needing the lock stalls behind an unbounded wait.
+           (`Condition.wait` on the held condition is the one correct
+           blocking-under-lock pattern and is exempt.)
+  BMT-T05  leaked-thread                  a non-daemon `Thread` that is
+           never joined (and never marked daemon) — it outlives its
+           owner and blocks interpreter shutdown.
+
+The dynamic twin of this module is `analysis/schedule.py`: what the
+lock-set table claims statically, the deterministic interleaving
+harness demonstrates (and regression-pins) by exploring schedules.
+"""
+
+import ast
+
+from byzantinemomentum_tpu.analysis.lint import (
+    Violation, _dotted, _terminal, rule)
+
+__all__ = ["ClassThreads", "module_classes"]
+
+
+# --------------------------------------------------------------------------- #
+# Shared syntactic helpers
+
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+_EVENT_FACTORIES = frozenset({"Event", "Semaphore", "BoundedSemaphore",
+                              "Barrier"})
+_QUEUE_FACTORIES = frozenset({"Queue", "SimpleQueue", "LifoQueue",
+                              "PriorityQueue"})
+
+# Method calls that mutate the receiver in place: `self.q.append(x)` is a
+# WRITE of `q` even though the attribute node itself is a Load. (Plain
+# `.get`/lookups stay reads — a dict `.get` is pure.)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "put", "put_nowait", "set",
+})
+
+# Call terminals that block unboundedly (T04). `.wait`/`.join`/`.get`
+# are handled separately — they need receiver context.
+_BLOCKING_TERMINALS = frozenset({
+    "sleep", "result", "recv", "recv_into", "accept", "connect",
+    "sendall", "urlopen", "getaddrinfo",
+})
+
+_SELF_NAMES = frozenset({"self"})
+
+
+def _self_attr(node):
+    """`self.x` -> "x" (None for anything else)."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in _SELF_NAMES):
+        return node.attr
+    return None
+
+
+def _imports_threading(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] in ("threading", "socketserver")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] in ("threading",
+                                                     "socketserver"):
+                return True
+    return False
+
+
+def _is_thread_call(node):
+    return isinstance(node, ast.Call) and _terminal(node.func) == "Thread"
+
+
+def _thread_target(call):
+    """The `target=` expression of a Thread(...) call (None if absent)."""
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+def _thread_is_daemon(call):
+    for kw in call.keywords:
+        if (kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------- #
+# Per-class analysis
+
+class ClassThreads:
+    """The thread-role / lock-set table of one ClassDef."""
+
+    def __init__(self, mod, node):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods = {c.name: c for c in node.body
+                        if isinstance(c, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.handler = any("RequestHandler" in (_terminal(b) or "")
+                           for b in node.bases)
+        self._classify_attrs()
+        self._find_entries_and_escapes()
+        self._call_graph()
+        self._infer_roles()
+        self._inherit_locks()
+        self._collect_accesses()
+
+    # -- attribute classification --------------------------------------- #
+
+    def _classify_attrs(self):
+        """Which `self.x` attributes are locks / events / queues /
+        threads, from their construction sites."""
+        self.lock_attrs, self.event_attrs = set(), set()
+        self.queue_attrs, self.thread_attrs = set(), set()
+        for method in self.methods.values():
+            for stmt in ast.walk(method):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for target in stmt.targets:
+                    attr = _self_attr(target)
+                    if attr is None or not isinstance(stmt.value, ast.Call):
+                        continue
+                    factory = _terminal(stmt.value.func)
+                    if factory in _LOCK_FACTORIES:
+                        self.lock_attrs.add(attr)
+                    elif factory in _EVENT_FACTORIES:
+                        self.event_attrs.add(attr)
+                    elif factory in _QUEUE_FACTORIES:
+                        self.queue_attrs.add(attr)
+                    elif factory == "Thread":
+                        self.thread_attrs.add(attr)
+
+    # -- thread entries and escaped callbacks ---------------------------- #
+
+    def _find_entries_and_escapes(self):
+        self.entries = set()      # methods that are Thread targets
+        self.escapes = set()      # methods handed out by reference
+        target_nodes = set()
+        for method in self.methods.values():
+            for call in ast.walk(method):
+                if not _is_thread_call(call):
+                    continue
+                target = _thread_target(call)
+                attr = _self_attr(target)
+                if attr in self.methods:
+                    self.entries.add(attr)
+                    target_nodes.add(id(target))
+        for method in self.methods.values():
+            for n in ast.walk(method):
+                attr = _self_attr(n)
+                if (attr not in self.methods or id(n) in target_nodes
+                        or not isinstance(n.ctx, ast.Load)):
+                    continue
+                parent = self.mod.parent.get(n)
+                if isinstance(parent, ast.Call) and parent.func is n:
+                    continue  # a plain `self.m(...)` call, not an escape
+                self.escapes.add(attr)
+
+    # -- same-class call graph ------------------------------------------- #
+
+    def _call_graph(self):
+        self.calls = {m: [] for m in self.methods}   # m -> [(callee, node)]
+        for name, method in self.methods.items():
+            for call in ast.walk(method):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = _self_attr(call.func)
+                if callee in self.methods:
+                    self.calls[name].append((callee, call))
+
+    # -- roles ------------------------------------------------------------ #
+
+    def _infer_roles(self):
+        """method -> set of role strings. Seeds: thread entries, the
+        handler entry, escaped callbacks, and `caller` for public (or
+        nowhere-called private) methods; roles then propagate along
+        same-class call edges (`__init__` is ownership: excluded)."""
+        roles = {m: set() for m in self.methods}
+        for m in self.entries:
+            roles[m].add(f"thread:{m}")
+        if self.handler and "handle" in self.methods:
+            roles["handle"].add("handler")
+        for m in self.escapes:
+            roles[m].add(f"escape:{m}")
+        called = set()
+        for caller, edges in self.calls.items():
+            if caller == "__init__":
+                continue
+            called.update(callee for callee, _ in edges)
+        for m in self.methods:
+            if m == "__init__":
+                continue
+            public = not m.startswith("_") or (m.startswith("__")
+                                               and m.endswith("__"))
+            if public or (m not in called and not roles[m]):
+                roles[m].add("caller")
+        changed = True
+        while changed:
+            changed = False
+            for caller, edges in self.calls.items():
+                if caller == "__init__":
+                    continue
+                for callee, _ in edges:
+                    missing = roles[caller] - roles[callee]
+                    if missing:
+                        roles[callee] |= missing
+                        changed = True
+        self.roles = roles
+
+    # -- lock sets --------------------------------------------------------- #
+
+    def _with_locks(self, node, method):
+        """Lock attributes held at `node` through enclosing `with
+        self.lock:` blocks inside `method`."""
+        held = set()
+        cur = self.mod.parent.get(node)
+        while cur is not None and cur is not method:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in self.lock_attrs:
+                        held.add(attr)
+            cur = self.mod.parent.get(cur)
+        return held
+
+    def _inherit_locks(self):
+        """Locks a method's body may assume: the intersection over every
+        same-class call site of (locks held at the site + the caller's
+        own inherited locks). Monotone fixpoint from the empty set."""
+        sites = {m: [] for m in self.methods}
+        for caller, edges in self.calls.items():
+            if caller == "__init__":
+                continue
+            for callee, call in edges:
+                sites[callee].append(
+                    (caller, self._with_locks(call, self.methods[caller])))
+        inherited = {m: set() for m in self.methods}
+        changed = True
+        while changed:
+            changed = False
+            for m, callers in sites.items():
+                if not callers:
+                    continue
+                new = None
+                for caller, locks in callers:
+                    held = locks | inherited[caller]
+                    new = held if new is None else (new & held)
+                if new != inherited[m]:
+                    inherited[m] = new
+                    changed = True
+        self.inherited = inherited
+
+    def locks_at(self, node, method_name):
+        method = self.methods[method_name]
+        return self._with_locks(node, method) | self.inherited[method_name]
+
+    # -- accesses ----------------------------------------------------------- #
+
+    def _collect_accesses(self):
+        """attr -> [(kind, roles, locks, line, method)] for every data
+        attribute touched outside `__init__`. A write is a Store/Del/
+        AugAssign on `self.x`, a Store/Del through `self.x[...]`, or a
+        mutating method call `self.x.append(...)`."""
+        self.accesses = {}
+        for name, method in self.methods.items():
+            if name == "__init__":
+                continue
+            for n in ast.walk(method):
+                attr = _self_attr(n)
+                if (attr is None or attr in self.lock_attrs
+                        or attr in self.event_attrs
+                        or attr in self.methods):
+                    continue
+                kind = "read"
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    kind = "write"
+                else:
+                    parent = self.mod.parent.get(n)
+                    if (isinstance(parent, ast.Attribute)
+                            and parent.value is n
+                            and parent.attr in _MUTATORS):
+                        grand = self.mod.parent.get(parent)
+                        if isinstance(grand, ast.Call) and grand.func is parent:
+                            kind = "write"
+                    elif (isinstance(parent, ast.Subscript)
+                            and parent.value is n
+                            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+                        kind = "write"
+                self.accesses.setdefault(attr, []).append(
+                    (kind, frozenset(self.roles[name]),
+                     frozenset(self.locks_at(n, name)), n.lineno, name))
+
+    # -- derived tables ------------------------------------------------------ #
+
+    def cross_thread_attrs(self):
+        """Attributes written outside `__init__` and touched under >= 2
+        distinct roles (internally-synchronized queue attributes are
+        exempt — `queue.Queue` carries its own lock)."""
+        out = {}
+        for attr, accs in self.accesses.items():
+            if attr in self.queue_attrs:
+                continue
+            roles = set()
+            for _, r, _, _, _ in accs:
+                roles |= r
+            if len(roles) >= 2 and any(k == "write" for k, _, _, _, _ in accs):
+                out[attr] = accs
+        return out
+
+    def acquisition_edges(self):
+        """[(held, taken, line)] — lock `taken` acquired while `held` is
+        held, anywhere in the class (inherited locks included)."""
+        edges = []
+        for name, method in self.methods.items():
+            for n in ast.walk(method):
+                if not isinstance(n, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in n.items:
+                    taken = _self_attr(item.context_expr)
+                    if taken not in self.lock_attrs:
+                        continue
+                    held = self.locks_at(n, name) - {taken}
+                    edges.extend((h, taken, n.lineno) for h in sorted(held))
+        return edges
+
+
+def module_classes(mod):
+    """The per-class analyses of one `lint.Module` (cached on the module
+    object — every T-rule reads the same table). Modules that import
+    neither `threading` nor `socketserver` analyze to nothing."""
+    cached = getattr(mod, "_bmt_class_threads", None)
+    if cached is None:
+        if _imports_threading(mod.tree):
+            cached = [ClassThreads(mod, n) for n in ast.walk(mod.tree)
+                      if isinstance(n, ast.ClassDef)]
+        else:
+            cached = []
+        mod._bmt_class_threads = cached
+    return cached
+
+
+def _role_names(roles):
+    return ", ".join(sorted(roles))
+
+
+# --------------------------------------------------------------------------- #
+# BMT-T01 — unguarded cross-thread write
+
+@rule("BMT-T01", "unguarded-cross-thread-write",
+      "a `self.*` attribute written on one thread role and touched on "
+      "another, with no lock held at a write — the lost-update race")
+def _check_unguarded_write(mod):
+    out = []
+    for cls in module_classes(mod):
+        for attr, accs in sorted(cls.cross_thread_attrs().items()):
+            all_roles = set()
+            for _, roles, _, _, _ in accs:
+                all_roles |= roles
+            seen_lines = set()
+            for kind, roles, locks, line, method in accs:
+                if kind != "write" or locks or line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                others = all_roles - roles
+                out.append(Violation(
+                    mod.path, line, 0, "BMT-T01",
+                    f"{cls.name}.{attr} is written in {method}() "
+                    f"[{_role_names(roles)}] with no lock, but is also "
+                    f"touched from [{_role_names(others) or 'caller'}] — "
+                    f"guard every access with one lock"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-T02 — inconsistent guard
+
+@rule("BMT-T02", "inconsistent-guard",
+      "one cross-thread attribute is guarded by DIFFERENT locks on "
+      "different accesses — mutual exclusion holds against nobody")
+def _check_inconsistent_guard(mod):
+    out = []
+    for cls in module_classes(mod):
+        for attr, accs in sorted(cls.cross_thread_attrs().items()):
+            counts = {}
+            for _, _, locks, _, _ in accs:
+                for lock in locks:
+                    counts[lock] = counts.get(lock, 0) + 1
+            if len(counts) < 2:
+                continue
+            majority = max(sorted(counts), key=lambda k: counts[k])
+            seen_lines = set()
+            for kind, roles, locks, line, method in accs:
+                if not locks or majority in locks or line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                out.append(Violation(
+                    mod.path, line, 0, "BMT-T02",
+                    f"{cls.name}.{attr} is mostly guarded by "
+                    f"self.{majority} but {method}() holds "
+                    f"{', '.join('self.' + l for l in sorted(locks))} here "
+                    f"— pick ONE guarding lock per attribute"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-T03 — lock-order inversion
+
+@rule("BMT-T03", "lock-order-inversion",
+      "a cycle in a class's lock-acquisition graph (A under B and B "
+      "under A) — the ABBA deadlock")
+def _check_lock_order(mod):
+    out = []
+    for cls in module_classes(mod):
+        graph = {}   # held -> {taken: first line}
+        for held, taken, line in cls.acquisition_edges():
+            graph.setdefault(held, {}).setdefault(taken, line)
+        reported = set()
+        for a in sorted(graph):
+            for b in sorted(graph[a]):
+                if a in graph.get(b, ()) and frozenset((a, b)) not in reported:
+                    reported.add(frozenset((a, b)))
+                    line_ab, line_ba = graph[a][b], graph[b][a]
+                    out.append(Violation(
+                        mod.path, max(line_ab, line_ba), 0, "BMT-T03",
+                        f"{cls.name} acquires self.{b} while holding "
+                        f"self.{a} (line {line_ab}) AND self.{a} while "
+                        f"holding self.{b} (line {line_ba}) — an ABBA "
+                        f"deadlock; order the locks"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-T04 — blocking call under a lock
+
+def _blocking_reason(cls, call):
+    """Why `call` is an unbounded wait (None if it is not). The held
+    condition's own `.wait()` is the one legitimate pattern (it releases
+    the lock) and lock `.acquire()` is T03's domain, not T04's."""
+    func = call.func
+    dotted = _dotted(func)
+    if dotted == "time.sleep":
+        return "time.sleep() parks the thread with the lock held"
+    if dotted is not None and dotted.startswith("subprocess."):
+        return f"{dotted}() blocks on a child process"
+    if not isinstance(func, ast.Attribute):
+        return None
+    receiver = _self_attr(func.value)
+    name = func.attr
+    if receiver in cls.lock_attrs:
+        return None
+    if name in _BLOCKING_TERMINALS and not isinstance(func.value,
+                                                      ast.Constant):
+        return f".{name}() is an unbounded wait"
+    if name == "wait":
+        if isinstance(func.value, ast.Constant):
+            return None
+        return ".wait() on a non-held primitive blocks with the lock held"
+    if name == "join":
+        if receiver in cls.thread_attrs:
+            return ".join() on a thread blocks with the lock held"
+        terminal = _terminal(func.value)
+        if terminal and "thread" in terminal.lower():
+            return ".join() on a thread blocks with the lock held"
+        return None
+    if name in ("get", "get_nowait") and receiver in cls.queue_attrs:
+        if name == "get":
+            return ".get() on a queue blocks with the lock held"
+    return None
+
+
+@rule("BMT-T04", "blocking-call-under-lock",
+      "time.sleep / socket ops / future.result() / Event.wait / "
+      "Thread.join while holding a lock — everyone needing the lock "
+      "stalls behind an unbounded wait")
+def _check_blocking_under_lock(mod):
+    out = []
+    for cls in module_classes(mod):
+        for name, method in cls.methods.items():
+            if name == "__init__":
+                continue
+            for call in ast.walk(method):
+                if not isinstance(call, ast.Call):
+                    continue
+                locks = cls.locks_at(call, name)
+                if not locks:
+                    continue
+                reason = _blocking_reason(cls, call)
+                if reason is None:
+                    continue
+                out.append(Violation(
+                    mod.path, call.lineno, call.col_offset, "BMT-T04",
+                    f"{cls.name}.{name}() holds "
+                    f"{', '.join('self.' + l for l in sorted(locks))}: "
+                    f"{reason} — move the wait outside the lock"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# BMT-T05 — leaked thread
+
+def _joined_or_daemonized(mod, binding):
+    """Whether the module ever joins `binding` (a local name or a
+    `self.x` attr string like "self._worker") or marks it daemon."""
+    for n in ast.walk(mod.tree):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "join"
+                and _dotted(n.func.value) == binding):
+            return True
+        if isinstance(n, ast.Assign):
+            for target in n.targets:
+                if (isinstance(target, ast.Attribute)
+                        and target.attr == "daemon"
+                        and _dotted(target.value) == binding
+                        and isinstance(n.value, ast.Constant)
+                        and n.value.value is True):
+                    return True
+    return False
+
+
+@rule("BMT-T05", "leaked-thread",
+      "a non-daemon Thread that is never joined (nor marked daemon) — "
+      "it outlives its owner and blocks interpreter shutdown")
+def _check_leaked_thread(mod):
+    if not _imports_threading(mod.tree):
+        return ()
+    out = []
+    for node in ast.walk(mod.tree):
+        if not _is_thread_call(node) or _thread_is_daemon(node):
+            continue
+        parent = mod.parent.get(node)
+        binding = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            binding = _dotted(parent.targets[0])
+        if binding is not None and _joined_or_daemonized(mod, binding):
+            continue
+        out.append(Violation(
+            mod.path, node.lineno, node.col_offset, "BMT-T05",
+            "Thread created without daemon=True and never joined — pass "
+            "daemon=True or join it on the shutdown path"))
+    return out

@@ -754,6 +754,46 @@ def _check_dynamic_scope_name(mod):
 
 
 # --------------------------------------------------------------------------- #
+# BMT-E10 — synchronization primitives allocated on hot paths
+
+_SYNC_FACTORIES = frozenset({
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier",
+})
+
+
+@rule("BMT-E10", "lock-in-hot-path",
+      "threading.Lock()/Condition()/... constructed inside a traced "
+      "scope or a loop body — per-call allocation churn, and useless "
+      "under jit (the trace captures one construction, not a guard)")
+def _check_lock_in_hot_path(mod):
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _terminal(node.func.value) == "threading"
+                and node.func.attr in _SYNC_FACTORIES):
+            continue
+        if mod.in_traced(node):
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E10",
+                f"threading.{node.func.attr}() inside a traced scope — "
+                f"the primitive is created at trace time and guards "
+                f"nothing at run time; synchronize on the host, outside "
+                f"the trace"))
+            continue
+        scope = mod.enclosing_function(node) or mod.tree
+        if _enclosing_loop(mod, node, scope) is not None:
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-E10",
+                f"threading.{node.func.attr}() constructed inside a loop "
+                f"body — one primitive per iteration guards nothing "
+                f"across iterations (and churns allocations on a hot "
+                f"path); hoist it to __init__/module scope"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # BMT-E09 — dead suppressions (annotations must not rot)
 
 @rule("BMT-E09", "dead-noqa",

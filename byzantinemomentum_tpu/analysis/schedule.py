@@ -1,0 +1,539 @@
+"""Deterministic interleaving harness — the dynamic twin of the BMT-T
+lock-set lint (`analysis/concurrency.py`).
+
+The static pass claims "this unguarded read-modify-write can lose an
+update"; this module DEMONSTRATES it, reproducibly, and then pins the
+fixed code as schedule-clean. The idea is stateless model checking in
+the CHESS tradition: a small *model* of a threaded class runs under a
+cooperative scheduler that serializes its threads — exactly one runs at
+a time, every other parks on a semaphore — and hands control over only
+at explicit *preemption points*:
+
+  * `sched.point()` — a marked interleaving point (e.g. between the
+    load and the store of a `+=`);
+  * every acquire/release of the instrumented primitives the harness
+    provides (`sched.lock()`, `sched.condition()`), whose blocking
+    semantics are modeled inside the scheduler (a thread waiting on a
+    held lock is simply not runnable, so a schedule can never "pick"
+    it — and an empty runnable set with live threads is a detected
+    DEADLOCK, reported with the schedule that produced it).
+
+A *schedule* is the sequence of thread ids picked at each decision
+point, rendered as a digit string ("0100111"): the same model + the
+same schedule string replays the same interleaving, bit for bit. Three
+drivers build on that determinism:
+
+  run_schedule(model, "010...")   replay one schedule (a failing
+                                  schedule string from CI reproduces
+                                  locally by copy-paste);
+  explore(model, max_preemptions) exhaustive bounded-preemption
+                                  enumeration: every schedule reachable
+                                  with at most K preemptions (switching
+                                  away from a still-runnable thread) is
+                                  run once. Small models exhaust in
+                                  well under a second;
+  random_walks(model, runs, seed) seeded random schedules for models
+                                  too big to exhaust.
+
+A model is a callable `model(sched) -> (thread_fns, check)`: build the
+shared state (using `sched.lock()`/`sched.condition()`/`sched.point()`
+at the boundaries that matter), return one function per thread plus a
+`check()` that raises AssertionError if the final state violates the
+invariant. Models must be pure host Python — no real blocking calls
+(a real `time.sleep`/socket wait inside a model stalls the scheduler,
+which reports it instead of hanging, via a watchdog timeout).
+
+`selfcheck()` is the tier smoke (`python -m byzantinemomentum_tpu.analysis
+--schedule-smoke`): it proves the planted lost-update in the PRE-FIX
+`serve/service.py` counter pattern is FOUND by bounded exploration, and
+that the fixed (stats-lock) pattern survives the same exhaustive
+2-thread exploration with zero failures. Stdlib only — importing this
+module never touches jax or numpy.
+"""
+
+import dataclasses
+import random
+import threading
+import time
+
+__all__ = ["Scheduler", "SchedLock", "SchedCondition", "DeadlockError",
+           "SchedulerError", "RunResult", "ExploreReport", "run_schedule",
+           "explore", "random_walks", "lost_update_model",
+           "fixed_counter_model", "selfcheck"]
+
+# A worker that fails to reach its next preemption point within this many
+# seconds is assumed to have entered a REAL blocking call (which the
+# scheduler cannot preempt) — the run aborts with SchedulerError instead
+# of wedging the test process.
+_WATCHDOG_S = 30.0
+
+
+class DeadlockError(RuntimeError):
+    """No runnable thread, but not every thread is done."""
+
+
+class SchedulerError(RuntimeError):
+    """The harness itself was misused (bad schedule, non-yielding model,
+    relocking a held non-reentrant lock, ...)."""
+
+
+class _Killed(BaseException):
+    """Raised inside abandoned workers so they unwind instead of leaking
+    parked threads after a deadlock/abort (BaseException: a model's
+    `except Exception` must not swallow the teardown)."""
+
+
+class _TState:
+    __slots__ = ("sem", "done", "blocked", "waiting", "exc", "kill")
+
+    def __init__(self):
+        self.sem = threading.Semaphore(0)
+        self.done = False
+        self.blocked = None    # SchedLock this thread waits to acquire
+        self.waiting = None    # SchedCondition this thread waits on
+        self.exc = None
+        self.kill = False
+
+
+class Scheduler:
+    """Cooperative serializer: exactly one model thread runs at a time;
+    control returns here at every preemption point."""
+
+    def __init__(self):
+        self._main = threading.Semaphore(0)
+        self._local = threading.local()
+        self._states = []
+        self.trace = []        # thread id picked at each decision
+        self.decisions = []    # runnable-id tuple at each decision
+
+    # ---------------------------------------------------------------- #
+    # Worker-side protocol
+
+    def _tid(self):
+        try:
+            return self._local.tid
+        except AttributeError:
+            raise SchedulerError(
+                "instrumented primitive used outside a scheduled thread")
+
+    def point(self):
+        """A preemption point: pause here, let the scheduler decide who
+        runs next."""
+        self._pause(self._tid())
+
+    def _pause(self, tid):
+        state = self._states[tid]
+        if state.kill:
+            # Abandoned (deadlock teardown): unwind WITHOUT parking —
+            # instrumented calls on the unwind path (a `with lock:`
+            # __exit__ releasing) must not wait for a grant that will
+            # never come
+            raise _Killed()
+        self._main.release()
+        state.sem.acquire()
+        if state.kill:
+            raise _Killed()
+
+    def lock(self):
+        return SchedLock(self)
+
+    def condition(self, lock=None):
+        return SchedCondition(self, lock)
+
+    # ---------------------------------------------------------------- #
+    # Scheduler side
+
+    def run(self, fns, picker, max_steps=20_000):
+        """Run the model threads to completion under `picker(runnable,
+        trace) -> tid`. Returns None; inspect `trace`/`decisions`.
+        Raises DeadlockError when no thread is runnable, and re-raises
+        the first model-thread exception (AssertionError included).
+        `max_steps` bounds the schedule length: a model that spin-waits
+        (always runnable, never done) is a harness misuse and raises
+        SchedulerError instead of exploring forever — model waits with
+        `sched.condition()`, not polling loops."""
+        if len(fns) > 10:
+            raise SchedulerError("schedule strings encode one digit per "
+                                 "thread: at most 10 threads")
+        self._states = [_TState() for _ in fns]
+        threads = []
+        for i, fn in enumerate(fns):
+            def body(fn=fn, i=i):
+                self._local.tid = i
+                state = self._states[i]
+                state.sem.acquire()
+                try:
+                    if not state.kill:
+                        fn()
+                except _Killed:
+                    pass
+                except BaseException as err:  # bmt: noqa[BMT-E05] the model's exception IS the result — it re-raises on the scheduler thread below
+                    state.exc = err
+                finally:
+                    state.done = True
+                    self._main.release()
+            t = threading.Thread(target=body, daemon=True,
+                                 name=f"sched-{i}")
+            threads.append(t)
+            t.start()
+        try:
+            while True:
+                runnable = [i for i, s in enumerate(self._states)
+                            if not s.done and s.blocked is None
+                            and s.waiting is None]
+                if not runnable:
+                    if all(s.done for s in self._states):
+                        break
+                    raise DeadlockError(
+                        f"deadlock after schedule "
+                        f"{''.join(map(str, self.trace))!r}: threads "
+                        f"{[i for i, s in enumerate(self._states) if not s.done]} "
+                        f"are blocked")
+                if len(self.trace) >= max_steps:
+                    raise SchedulerError(
+                        f"schedule exceeded {max_steps} steps — a "
+                        f"spin-wait in the model? (park with "
+                        f"sched.condition().wait() instead of polling)")
+                self.decisions.append(tuple(runnable))
+                tid = picker(runnable, self.trace)
+                if tid not in runnable:
+                    raise SchedulerError(
+                        f"picker chose thread {tid}, runnable: {runnable}")
+                self.trace.append(tid)
+                self._states[tid].sem.release()
+                if not self._main.acquire(timeout=_WATCHDOG_S):
+                    raise SchedulerError(
+                        f"thread {tid} did not yield within {_WATCHDOG_S}s "
+                        f"— a real blocking call inside the model?")
+        finally:
+            self._abandon()
+        for state in self._states:
+            if state.exc is not None:
+                raise state.exc
+
+    def _abandon(self):
+        """Unwind every unfinished worker (deadlock/abort paths) so runs
+        never leak parked threads."""
+        for state in self._states:
+            if not state.done:
+                state.kill = True
+                state.sem.release()
+        deadline = time.monotonic() + _WATCHDOG_S
+        for state in self._states:
+            while not state.done and time.monotonic() < deadline:
+                self._main.acquire(timeout=0.1)
+
+
+class SchedLock:
+    """Non-reentrant mutex whose blocking lives in the scheduler model:
+    acquiring a held lock parks the thread (not runnable) until release."""
+
+    __slots__ = ("_sched", "_owner")
+
+    def __init__(self, sched):
+        self._sched = sched
+        self._owner = None
+
+    def acquire(self):
+        sched = self._sched
+        tid = sched._tid()
+        sched.point()                 # decision point before the acquire
+        while self._owner is not None:
+            if self._owner == tid:
+                raise SchedulerError(
+                    "re-acquiring a held SchedLock (non-reentrant): "
+                    "a self-deadlock in the model")
+            state = sched._states[tid]
+            state.blocked = self
+            sched._pause(tid)         # release() marks us runnable again
+        self._owner = tid
+
+    def release(self):
+        sched = self._sched
+        if self._owner != sched._tid():
+            if sched._states[sched._tid()].kill:
+                raise _Killed()  # interrupted mid-acquire; keep unwinding
+            raise SchedulerError("releasing a SchedLock the thread "
+                                 "does not hold")
+        self._owner = None
+        for state in sched._states:
+            if state.blocked is self:
+                state.blocked = None  # runnable; re-checks owner when run
+        sched.point()                 # release is a decision point too
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class SchedCondition:
+    """Condition variable over a `SchedLock` with wait/notify modeled in
+    the scheduler (no spurious wakeups, no timeouts — model explicit
+    wake signals instead)."""
+
+    __slots__ = ("_sched", "_lock")
+
+    def __init__(self, sched, lock=None):
+        self._sched = sched
+        self._lock = lock if lock is not None else SchedLock(sched)
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def wait(self):
+        sched = self._sched
+        tid = sched._tid()
+        if self._lock._owner != tid:
+            raise SchedulerError("SchedCondition.wait() without the lock")
+        # Atomically: drop the lock, park until notified
+        self._lock._owner = None
+        for state in sched._states:
+            if state.blocked is self._lock:
+                state.blocked = None
+        state = sched._states[tid]
+        state.waiting = self
+        sched._pause(tid)
+        self._lock.acquire()          # woken: re-take the lock (may park)
+
+    def notify(self, n=1):
+        self._notify(n)
+
+    def notify_all(self):
+        self._notify(None)
+
+    def _notify(self, n):
+        sched = self._sched
+        if self._lock._owner != sched._tid():
+            raise SchedulerError("SchedCondition.notify() without the lock")
+        woken = 0
+        for state in sched._states:
+            if state.waiting is self:
+                state.waiting = None
+                woken += 1
+                if n is not None and woken >= n:
+                    break
+
+
+# --------------------------------------------------------------------------- #
+# Drivers: replay, exhaustive bounded-preemption exploration, random walks
+
+@dataclasses.dataclass
+class RunResult:
+    """One schedule's outcome. `schedule` is the full realized digit
+    string (replayable); `error` is None on success, else the failure
+    text (assertion, deadlock, model exception)."""
+
+    schedule: str
+    preemptions: int
+    error: str = None
+
+    @property
+    def ok(self):
+        return self.error is None
+
+
+@dataclasses.dataclass
+class ExploreReport:
+    """What `explore`/`random_walks` covered: `runs` distinct schedules,
+    the failing ones in `failures`, and whether the frontier was fully
+    exhausted within the run cap."""
+
+    runs: int
+    failures: list
+    max_preemptions: int
+    exhausted: bool = True
+
+    @property
+    def ok(self):
+        return not self.failures
+
+
+def _preemptions(trace, decisions):
+    count = 0
+    for i in range(1, len(trace)):
+        if trace[i - 1] in decisions[i] and trace[i] != trace[i - 1]:
+            count += 1
+    return count
+
+
+def _forced_picker(forced):
+    """Follow the forced prefix, then run the CURRENT thread as long as
+    it stays runnable (fewest-preemption continuation), else the lowest
+    runnable id — fully deterministic."""
+    def picker(runnable, trace):
+        if len(trace) < len(forced):
+            tid = forced[len(trace)]
+            if tid not in runnable:
+                raise SchedulerError(
+                    f"schedule step {len(trace)} picks thread {tid}, "
+                    f"but runnable is {runnable}")
+            return tid
+        if trace and trace[-1] in runnable:
+            return trace[-1]
+        return runnable[0]
+    return picker
+
+
+def _run(model, forced):
+    """One run under a forced schedule prefix. Returns (RunResult,
+    decisions, trace)."""
+    sched = Scheduler()
+    fns, check = model(sched)
+    error = None
+    try:
+        sched.run(fns, _forced_picker(forced))
+        check()
+    except (AssertionError, DeadlockError) as err:
+        error = f"{type(err).__name__}: {err}"
+    schedule = "".join(map(str, sched.trace))
+    return (RunResult(schedule, _preemptions(sched.trace, sched.decisions),
+                      error),
+            list(sched.decisions), list(sched.trace))
+
+
+def run_schedule(model, schedule=""):
+    """Replay one schedule (prefix) of a model; returns its RunResult
+    with the FULL realized schedule string."""
+    forced = [int(c) for c in schedule]
+    result, _, _ = _run(model, forced)
+    return result
+
+
+def explore(model, max_preemptions=3, max_runs=4000):
+    """Exhaustive bounded-preemption exploration: depth-first over every
+    divergence from already-realized schedules whose preemption count
+    stays within the bound. Deterministic; each distinct schedule runs
+    exactly once."""
+    seen = set()       # realized schedules already run
+    tried = set()      # forced prefixes already queued
+    frontier = [()]
+    failures = []
+    runs = 0
+    while frontier:
+        if runs >= max_runs:
+            return ExploreReport(runs, failures, max_preemptions,
+                                 exhausted=False)
+        forced = frontier.pop()
+        result, decisions, trace = _run(model, list(forced))
+        key = tuple(trace)
+        if key in seen:
+            continue
+        seen.add(key)
+        runs += 1
+        if not result.ok:
+            failures.append(result)
+        # Branch: at every decision, every alternative pick that stays
+        # within the preemption budget
+        for i in range(len(trace)):
+            for alt in decisions[i]:
+                if alt == trace[i]:
+                    continue
+                prefix = key[:i] + (alt,)
+                if prefix in tried:
+                    continue
+                if _preemptions(list(prefix), decisions[:i + 1]) \
+                        > max_preemptions:
+                    continue
+                tried.add(prefix)
+                frontier.append(prefix)
+    return ExploreReport(runs, failures, max_preemptions)
+
+
+def random_walks(model, runs=100, seed=0):
+    """Seeded random schedules (for models too big to exhaust). The
+    failing `RunResult.schedule` strings replay via `run_schedule`."""
+    rng = random.Random(seed)
+    failures = []
+    seen = set()
+    for _ in range(runs):
+        sched = Scheduler()
+        fns, check = model(sched)
+        error = None
+        try:
+            sched.run(fns, lambda runnable, trace: rng.choice(runnable))
+            check()
+        except (AssertionError, DeadlockError) as err:
+            error = f"{type(err).__name__}: {err}"
+        schedule = "".join(map(str, sched.trace))
+        seen.add(schedule)
+        if error is not None:
+            failures.append(RunResult(
+                schedule, _preemptions(sched.trace, sched.decisions), error))
+    return ExploreReport(len(seen), failures, max_preemptions=-1)
+
+
+# --------------------------------------------------------------------------- #
+# The canonical models: the serve counter race, before and after the fix
+
+def lost_update_model(sched):
+    """The PRE-fix `serve/service.py` counter pattern (fixture copy of
+    `_resolve`'s `self._served += 1` at PR 13): two resolver-ish threads
+    bump an unguarded counter; `sched.point()` sits exactly where the
+    bytecode boundary between the LOAD and the STORE of `+=` is."""
+    class Service:
+        def __init__(self):
+            self._served = 0
+
+        def resolve(self):
+            value = self._served          # the read of `+= 1`
+            sched.point()                 # ... preempted here ...
+            self._served = value + 1      # the write of `+= 1`
+
+    svc = Service()
+
+    def check():
+        assert svc._served == 2, f"lost update: _served == {svc._served}"
+
+    return [svc.resolve, svc.resolve], check
+
+
+def fixed_counter_model(sched):
+    """The FIXED pattern (`AggregationService._stats_lock`): the same
+    read-modify-write, now guarded — every schedule must end at 2."""
+    class Service:
+        def __init__(self):
+            self._stats_lock = sched.lock()
+            self._served = 0
+
+        def resolve(self):
+            with self._stats_lock:
+                value = self._served
+                sched.point()
+                self._served = value + 1
+
+    svc = Service()
+
+    def check():
+        assert svc._served == 2, f"lost update: _served == {svc._served}"
+
+    return [svc.resolve, svc.resolve], check
+
+
+def selfcheck(max_preemptions=3):
+    """The lint-tier schedule smoke: the planted lost-update must be
+    FOUND within the preemption bound, and the fixed counter must
+    survive the same exhaustive exploration clean. Returns a JSON-safe
+    report with `ok`."""
+    t0 = time.monotonic()
+    broken = explore(lost_update_model, max_preemptions=max_preemptions)
+    fixed = explore(fixed_counter_model, max_preemptions=max_preemptions)
+    return {
+        "ok": bool(broken.failures) and fixed.ok and fixed.exhausted,
+        "lost_update_found": bool(broken.failures),
+        "witness": broken.failures[0].schedule if broken.failures else None,
+        "schedules_prefix": broken.runs,
+        "schedules_fixed": fixed.runs,
+        "fixed_clean": fixed.ok,
+        "exhausted": broken.exhausted and fixed.exhausted,
+        "max_preemptions": max_preemptions,
+        "seconds": round(time.monotonic() - t0, 3),
+    }

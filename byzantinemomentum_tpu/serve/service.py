@@ -141,6 +141,15 @@ class AggregationService:
             suspicion.setdefault("weights", ADMISSION_WEIGHTS)
         self.suspicion = ClientSuspicionStore(**suspicion)
         self._suspicion_lock = threading.Lock()
+        # One stats lock for the request/serve counters: they are bumped
+        # from submitter (frontend handler) threads AND the resolver
+        # thread and read by the heartbeat thread — `n += 1` is a
+        # read-modify-write, so unguarded concurrent bumps lose updates
+        # (BMT-T01; the schedule-harness regression in
+        # tests/test_concurrency.py demonstrates the loss on the pre-fix
+        # pattern). `stats()` snapshots under the same lock so one
+        # payload is internally coherent.
+        self._stats_lock = threading.Lock()
         self._requests = 0
         self._served = 0
         self._rejected = 0
@@ -197,7 +206,8 @@ class AggregationService:
             cell, matrix, client_ids = self._validate(
                 vectors, gar, f, client_ids, diagnostics)
         except utils.UserException:
-            self._rejected += 1
+            with self._stats_lock:
+                self._rejected += 1
             recorder.counter("serve_rejected")
             raise
         n = matrix.shape[0]
@@ -212,14 +222,16 @@ class AggregationService:
                 masked = int(n - admitted.sum())
                 blended = sum(1 for a in admission.values()
                               if a["action"] == "downweight")
-                self._admission_masked += masked
-                self._admission_downweighted += blended
+                with self._stats_lock:
+                    self._admission_masked += masked
+                    self._admission_downweighted += blended
                 if masked:
                     recorder.counter("serve_admission_masked", masked)
                 if blended:
                     recorder.counter("serve_admission_downweighted",
                                      blended)
-        self._requests += 1
+        with self._stats_lock:
+            self._requests += 1
         recorder.counter("serve_requests")
         if trace is not None:
             trace.meta = {"gar": cell.gar, "n": n, "d": int(matrix.shape[1])}
@@ -377,7 +389,7 @@ class AggregationService:
                 # conversion happens lazily on whoever READS the trace
                 # (response serialization, stats snapshot)
                 r.trace.stamp("done", at=done)
-                self.traces.add(r.trace)
+                self.traces.add(r.trace)  # bmt: noqa[BMT-T01] TraceBuffer is internally locked (its own _lock serializes the ring)
             result = AggregateResult(
                 aggregate=host["aggregate"][i, :r.d],
                 f_eff=int(host["f_eff"][i]),
@@ -385,7 +397,8 @@ class AggregationService:
                 admission=r.admission,
                 latency_ms=(done - r.t_submit) * 1000.0,
                 trace=r.trace)
-            self._served += 1
+            with self._stats_lock:
+                self._served += 1
             if not r.future.done():
                 r.future.set_result(result)
 
@@ -394,16 +407,23 @@ class AggregationService:
 
     def stats(self):
         """Counter snapshot (the front end's `stats` op, the heartbeat
-        payload, the load generator's occupancy report)."""
+        payload, the load generator's occupancy report). The counters are
+        read under the stats lock so one payload is coherent — `served`
+        can never exceed `requests` within a snapshot."""
+        with self._stats_lock:
+            requests, served = self._requests, self._served
+            rejected = self._rejected
+            masked = self._admission_masked
+            downweighted = self._admission_downweighted
         return {
-            "requests": self._requests,
-            "served": self._served,
-            "rejected": self._rejected,
+            "requests": requests,
+            "served": served,
+            "rejected": rejected,
             "admission": {
                 "enabled": self.admission is not None,
                 "mode": getattr(self.admission, "mode", None),
-                "masked_rows": self._admission_masked,
-                "downweighted_rows": self._admission_downweighted,
+                "masked_rows": masked,
+                "downweighted_rows": downweighted,
             },
             "queue_depth": self.batcher.depth(),
             "cache": self.cache.stats(),

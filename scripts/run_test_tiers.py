@@ -115,18 +115,48 @@ def main():
     telemetry.event("bench_compare_smoke", returncode=bench_cmp.returncode)
     print(f"  {bench_compare}", flush=True)
 
-    # Lint tier (PR 5, grown in PR 9): jaxlint (BMT-E rules incl. the
-    # dead-noqa detector) over the package + scripts — the source half of
-    # the static gate, with its own green bit
+    # Lint tier (PR 5, grown in PR 9/14): jaxlint (BMT-E rules incl. the
+    # dead-noqa detector) AND the BMT-T concurrency rules over the
+    # package + scripts — the source half of the static gate, with its
+    # own green bit. --json so the artifact records the per-family hit
+    # counts (t_rule_hits MUST be 0: the thread surface is contract-
+    # clean), plus the schedule smoke: the interleaving harness proves
+    # the planted serve-counter lost-update is FOUND and the fixed
+    # stats-lock pattern is schedule-clean (exhaustive 2-thread
+    # exploration, well under the 10 s budget).
     print("lint tier ...", flush=True)
     with telemetry.span("tier_lint"):
         lint_proc = subprocess.run(
             [sys.executable, "-m", "byzantinemomentum_tpu.analysis",
-             "byzantinemomentum_tpu", "scripts"],
+             "byzantinemomentum_tpu", "scripts", "--json"],
             cwd=ROOT, capture_output=True, text=True)
-    lint_tier = {"returncode": lint_proc.returncode,
+        sched_proc = subprocess.run(
+            [sys.executable, "-m", "byzantinemomentum_tpu.analysis",
+             "--schedule-smoke"],
+            cwd=ROOT, capture_output=True, text=True)
+    lint_tier = {"returncode": lint_proc.returncode
+                 or sched_proc.returncode,
                  "tail": lint_proc.stdout.splitlines()[-4:]}
-    telemetry.event("lint_tier", returncode=lint_proc.returncode)
+    try:
+        counts = json.loads(lint_proc.stdout).get("counts", {})
+        lint_tier["t_rule_hits"] = sum(
+            v for k, v in counts.items() if k.startswith("BMT-T"))
+        lint_tier["e_rule_hits"] = sum(
+            v for k, v in counts.items() if k.startswith("BMT-E"))
+    except ValueError:
+        pass  # non-JSON output means the CLI crashed; returncode covers it
+    schedule_smoke = None
+    for line in sched_proc.stdout.splitlines():
+        if line.startswith("schedule: "):
+            try:
+                schedule_smoke = json.loads(line[len("schedule: "):])
+            except ValueError:
+                continue
+    if schedule_smoke is not None:
+        lint_tier["schedule_smoke"] = schedule_smoke
+    telemetry.event("lint_tier", returncode=lint_tier["returncode"],
+                    t_rule_hits=lint_tier.get("t_rule_hits"),
+                    schedule_smoke=schedule_smoke)
     print(f"  {lint_tier}", flush=True)
 
     # Lattice tier (PR 9): the builder-derived lowering-contract gate —

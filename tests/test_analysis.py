@@ -148,6 +148,24 @@ def f(x, step):
         return x * 2
 """,
     ),
+    "BMT-E10": (
+        """
+import threading
+def serve(requests):
+    for r in requests:
+        lock = threading.Lock()
+        with lock:
+            r.handle()
+""",
+        """
+import threading
+_LOCK = threading.Lock()
+def serve(requests):
+    for r in requests:
+        with _LOCK:
+            r.handle()
+""",
+    ),
     "BMT-E09": (
         # The suppression names a rule that does NOT fire on the line —
         # the annotation rotted (here: the except was narrowed but the
@@ -223,11 +241,17 @@ def outer(g, mesh, in_specs, out_specs):
 
 
 def test_rule_registry_complete():
-    """Every registered rule id is BMT-Exx and has a fixture pair (E00,
-    the suppression-hygiene rule, is proven by the noqa tests below)."""
-    assert set(lint.RULES) == set(FIXTURES) | {"BMT-E00"}
+    """Every registered E-rule id has a fixture pair here (E00, the
+    suppression-hygiene rule, is proven by the noqa tests below); the
+    BMT-T concurrency family shares the registry (so noqa/E00/E09 apply
+    to it) and has its fixture pairs in tests/test_concurrency.py."""
+    e_rules = {r for r in lint.RULES if r.startswith("BMT-E")}
+    t_rules = {r for r in lint.RULES if r.startswith("BMT-T")}
+    assert e_rules == set(FIXTURES) | {"BMT-E00"}
+    assert t_rules == {f"BMT-T0{i}" for i in range(1, 6)}
+    assert e_rules | t_rules == set(lint.RULES)
     for rule_id, rule in lint.RULES.items():
-        assert rule_id.startswith("BMT-E") and rule.summary
+        assert rule.summary
 
 
 def test_dead_noqa_details():

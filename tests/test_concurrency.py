@@ -1,0 +1,741 @@
+"""Concurrency-contract suite: the BMT-T lock-set rules (violating +
+clean fixture pair per rule, role/lock-set inference details, the noqa
+contract, the repo-wide clean gate, CLI exit codes) and the
+deterministic interleaving harness (`analysis/schedule.py`): replayable
+schedules, exhaustive bounded-preemption exploration, deadlock
+detection, the planted serve-counter lost-update regression, and the
+schedule models of the real `MicroBatcher` flush/submit surface and the
+real `ClientSuspicionStore` admission-hold invariant.
+
+Everything here is host-only (no jax import): the T-rules are pure AST
+and the harness is pure stdlib, so this file runs even where no backend
+initializes.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu.analysis import concurrency, lint, schedule
+from byzantinemomentum_tpu.analysis.__main__ import main as analysis_main
+from byzantinemomentum_tpu.obs.forensics import ClientSuspicionStore
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------------------- #
+# BMT-T fixtures: one violating + one clean pair per rule. The T01 pair
+# is the REAL pre-fix `serve/service.py` counter pattern (PR 8-13): the
+# submitter bumps `_requests`, the escaped resolver callback bumps
+# `_served`, the heartbeat thread reads both — no lock anywhere.
+
+T_FIXTURES = {
+    "BMT-T01": (
+        """
+import threading
+
+class AggregationService:
+    def __init__(self, batcher_cls):
+        self._requests = 0
+        self._served = 0
+        self.batcher = batcher_cls(self._resolve)
+        self._beat_thread = threading.Thread(target=self._beat_loop,
+                                             daemon=True)
+        self._beat_thread.start()
+
+    def submit(self, request):
+        self._requests += 1
+        return self.batcher.submit(request)
+
+    def _resolve(self, out, requests):
+        for _ in requests:
+            self._served += 1
+
+    def stats(self):
+        return {"requests": self._requests, "served": self._served}
+
+    def _beat_loop(self):
+        while True:
+            self._write_heartbeat()
+
+    def _write_heartbeat(self):
+        return self.stats()
+""",
+        """
+import threading
+
+class AggregationService:
+    def __init__(self, batcher_cls):
+        self._stats_lock = threading.Lock()
+        self._requests = 0
+        self._served = 0
+        self.batcher = batcher_cls(self._resolve)
+        self._beat_thread = threading.Thread(target=self._beat_loop,
+                                             daemon=True)
+        self._beat_thread.start()
+
+    def submit(self, request):
+        with self._stats_lock:
+            self._requests += 1
+        return self.batcher.submit(request)
+
+    def _resolve(self, out, requests):
+        for _ in requests:
+            with self._stats_lock:
+                self._served += 1
+
+    def stats(self):
+        with self._stats_lock:
+            return {"requests": self._requests, "served": self._served}
+
+    def _beat_loop(self):
+        while True:
+            self._write_heartbeat()
+
+    def _write_heartbeat(self):
+        return self.stats()
+""",
+    ),
+    "BMT-T02": (
+        """
+import threading
+
+class Store:
+    def __init__(self):
+        self._read_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._count = 0
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def bump(self):
+        with self._read_lock:
+            self._count += 1
+
+    def _worker(self):
+        with self._write_lock:
+            self._count += 1
+""",
+        """
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def _worker(self):
+        with self._lock:
+            self._count += 1
+""",
+    ),
+    "BMT-T03": (
+        """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def _worker(self):
+        with self._b:
+            with self._a:
+                return 2
+""",
+        """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def _worker(self):
+        with self._a:
+            with self._b:
+                return 2
+""",
+    ),
+    "BMT-T04": (
+        """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def read(self):
+        with self._lock:
+            time.sleep(0.1)
+            return self._value
+
+    def _worker(self):
+        with self._lock:
+            self._value += 1
+""",
+        """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def read(self):
+        time.sleep(0.1)
+        with self._lock:
+            return self._value
+
+    def _worker(self):
+        with self._lock:
+            self._value += 1
+""",
+    ),
+    "BMT-T05": (
+        """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+""",
+        """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(T_FIXTURES))
+def test_t_rule_fixture_pair(rule_id):
+    """Every T-rule fires on its violating fixture and stays silent on
+    the clean one (and the clean one trips no OTHER rule either)."""
+    bad, good = T_FIXTURES[rule_id]
+    hits = {v.rule for v in lint.lint_source(bad)}
+    assert rule_id in hits, f"{rule_id} missed its violating fixture"
+    clean = lint.lint_source(good)
+    assert clean == [], f"clean fixture not clean: {clean}"
+
+
+def test_t01_names_the_race_precisely():
+    """The T01 report carries the class, attribute, writing method, its
+    role, and the other roles touching the attribute — the triage facts."""
+    bad, _ = T_FIXTURES["BMT-T01"]
+    hits = [v for v in lint.lint_source(bad) if v.rule == "BMT-T01"]
+    attrs = {v.message.split()[0] for v in hits}
+    assert attrs == {"AggregationService._requests",
+                     "AggregationService._served"}
+    served = next(v for v in hits if "_served" in v.message)
+    assert "escape:_resolve" in served.message
+    assert "thread:_beat_loop" in served.message
+
+
+def test_t05_joined_thread_is_clean():
+    """The non-daemon form is fine when the owner joins it (the join is
+    the shutdown path)."""
+    src = """
+import threading
+
+class Owner:
+    def start(self):
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        return 1
+
+    def close(self):
+        self._worker.join()
+"""
+    assert lint.lint_source(src) == []
+
+
+# --------------------------------------------------------------------------- #
+# Role / lock-set inference details
+
+def _classes(src):
+    return concurrency.module_classes(lint.Module("<t>", src))
+
+
+def test_escaped_callback_role_and_propagation():
+    """A bound method handed out by reference gets its own role, and
+    roles propagate along same-class calls — the `serve/service.py`
+    shape that motivated the analysis."""
+    src, _ = T_FIXTURES["BMT-T01"]
+    (cls,) = _classes(src)
+    assert "escape:_resolve" in cls.roles["_resolve"]
+    assert "thread:_beat_loop" in cls.roles["_beat_loop"]
+    # stats is public (caller) AND reachable from the heartbeat thread
+    assert {"caller", "thread:_beat_loop"} <= cls.roles["stats"]
+    assert "thread:_beat_loop" in cls.roles["_write_heartbeat"]
+
+
+def test_inherited_locks_through_call_sites():
+    """A helper only ever called under `with self._cond:` is analyzed as
+    guarded — the `MicroBatcher._due` idiom must not false-positive."""
+    src = """
+import collections
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queues = {}
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True)
+        self._flusher.start()
+
+    def submit(self, request):
+        with self._cond:
+            self._queues.setdefault(request.cell,
+                                    collections.deque()).append(request)
+            self._cond.notify()
+
+    def depth(self):
+        with self._cond:
+            return sum(len(q) for q in self._queues.values())
+
+    def _due(self):
+        for cell, q in self._queues.items():
+            if q:
+                return cell
+        return None
+
+    def _flush_loop(self):
+        while True:
+            with self._cond:
+                cell = self._due()
+                if cell is None:
+                    self._cond.wait()
+"""
+    assert lint.lint_source(src) == []
+    (cls,) = _classes(src)
+    assert cls.inherited["_due"] == {"_cond"}
+    # And the Condition counts as the majority guard of _queues
+    accs = cls.accesses["_queues"]
+    assert all("_cond" in locks for _, _, locks, _, _ in accs)
+
+
+def test_queue_attr_is_exempt():
+    """`queue.Queue` attributes carry their own lock: cross-thread
+    put/get on one is not a T01."""
+    src = """
+import queue
+import threading
+
+class Pipe:
+    def __init__(self):
+        self._inflight = queue.Queue()
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def push(self, item):
+        self._inflight.put(item)
+
+    def _drain(self):
+        while True:
+            self._inflight.get()
+"""
+    assert lint.lint_source(src) == []
+
+
+def test_unthreaded_module_is_skipped():
+    """A module that never imports threading/socketserver analyzes to
+    nothing — shared-looking attributes in it are single-threaded."""
+    src = """
+class Accumulator:
+    def __init__(self):
+        self.total = 0
+
+    def bump(self):
+        self.total += 1
+"""
+    assert lint.lint_source(src) == []
+    assert _classes(src) == []
+
+
+def test_handler_class_role():
+    """`handle` of a RequestHandler subclass is a per-connection thread
+    under ThreadingTCPServer: its unguarded writes against caller reads
+    are T01."""
+    src = """
+import socketserver
+
+class Handler(socketserver.StreamRequestHandler):
+    served = 0
+
+    def handle(self):
+        type(self).served += 1
+
+class Counter:
+    def __init__(self, server):
+        self.server = server
+"""
+    # type(self).served is a class-attribute write — out of the self.*
+    # surface, so this exact shape is NOT flagged (documented limit)...
+    assert all(v.rule != "BMT-T01" for v in lint.lint_source(src))
+    # ...but a self-attribute version is:
+    src2 = """
+import socketserver
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        self.hits = getattr(self, "hits", 0) + 1
+        self.report()
+
+    def report(self):
+        return self.hits
+"""
+    (cls,) = _classes(src2)
+    assert "handler" in cls.roles["handle"]
+
+
+def test_t_noqa_contract():
+    """T suppressions follow the PR 5 contract: a reasoned noqa
+    suppresses, a reasonless one is BMT-E00 (and does not suppress),
+    a rotten one is BMT-E09."""
+    bad, good = T_FIXTURES["BMT-T04"]
+    annotated = bad.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # bmt: noqa[BMT-T04] poller cadence IS the contract here")
+    assert lint.lint_source(annotated) == []
+    reasonless = bad.replace("time.sleep(0.1)",
+                             "time.sleep(0.1)  # bmt: noqa[BMT-T04]")
+    rules = {v.rule for v in lint.lint_source(reasonless)}
+    assert rules == {"BMT-E00", "BMT-T04"}
+    rotten = good.replace(
+        "with self._lock:\n            return self._value",
+        "with self._lock:\n            return self._value  # bmt: noqa[BMT-T04] sleep holds the lock")
+    assert {v.rule for v in lint.lint_source(rotten)} == {"BMT-E09"}
+
+
+def test_repo_thread_surface_is_t_clean():
+    """The whole package + scripts pass the T-rules with zero
+    unannotated hits — the day-one findings (the serve counter races)
+    are fixed, everything else is reasoned."""
+    t_rules = {r for r in lint.RULES if r.startswith("BMT-T")}
+    violations = lint.lint_paths(
+        [ROOT / "byzantinemomentum_tpu", ROOT / "scripts"],
+        rules=t_rules | {"BMT-E00"})
+    assert violations == [], lint.format_human(violations)
+
+
+def test_cli_exit_code_on_t_hit(tmp_path, capsys):
+    """The analysis CLI exits 1 on a T violation, and --rules lists the
+    E-, H-, and T-families in one table."""
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(T_FIXTURES["BMT-T01"][0])
+    assert analysis_main([str(dirty)]) == 1
+    clean = tmp_path / "clean.py"
+    clean.write_text(T_FIXTURES["BMT-T01"][1])
+    assert analysis_main([str(clean)]) == 0
+    capsys.readouterr()
+    assert analysis_main(["--rules"]) == 0
+    table = capsys.readouterr().out
+    for rule_id in ("BMT-E01", "BMT-H01", "BMT-T01", "BMT-T05"):
+        assert rule_id in table, f"--rules table is missing {rule_id}"
+
+
+# --------------------------------------------------------------------------- #
+# The interleaving harness
+
+def test_schedule_replay_is_deterministic():
+    r = schedule.explore(schedule.lost_update_model, max_preemptions=3)
+    assert r.failures, "bounded exploration must find the lost update"
+    witness = r.failures[0]
+    again = schedule.run_schedule(schedule.lost_update_model,
+                                  witness.schedule)
+    assert again.schedule == witness.schedule
+    assert again.error == witness.error
+    assert "lost update" in again.error
+
+
+def test_lost_update_found_within_one_preemption():
+    """The planted race needs exactly one preemption (the `+=` window):
+    the cheapest possible exploration already finds it."""
+    r = schedule.explore(schedule.lost_update_model, max_preemptions=1)
+    assert r.failures and r.exhausted
+    assert min(f.preemptions for f in r.failures) == 1
+
+
+def test_fixed_counter_is_schedule_clean():
+    """The stats-lock pattern (the PR 14 `AggregationService` fix)
+    survives EXHAUSTIVE 2-thread/3-preemption exploration."""
+    r = schedule.explore(schedule.fixed_counter_model, max_preemptions=3)
+    assert r.exhausted and not r.failures
+    assert r.runs > 1  # the lock still leaves schedule choices
+
+
+def test_unpreempted_schedule_passes_even_prefix():
+    """Serial execution of the pre-fix pattern is correct — the bug IS
+    the interleaving, which is why it hid until the harness."""
+    serial = schedule.run_schedule(schedule.lost_update_model, "")
+    assert serial.ok and serial.preemptions == 0
+
+
+def test_deadlock_detection_with_schedule():
+    def abba(sched):
+        a, b = sched.lock(), sched.lock()
+
+        def t0():
+            with a:
+                with b:
+                    pass
+
+        def t1():
+            with b:
+                with a:
+                    pass
+
+        return [t0, t1], lambda: None
+
+    r = schedule.explore(abba, max_preemptions=2)
+    deadlocks = [f for f in r.failures if "DeadlockError" in f.error]
+    assert deadlocks, "ABBA must deadlock under some schedule"
+    # The failing schedule replays to the same deadlock
+    again = schedule.run_schedule(abba, deadlocks[0].schedule)
+    assert "DeadlockError" in again.error
+
+
+def test_random_walks_are_seeded():
+    a = schedule.random_walks(schedule.lost_update_model, runs=50, seed=7)
+    b = schedule.random_walks(schedule.lost_update_model, runs=50, seed=7)
+    assert [f.schedule for f in a.failures] == \
+        [f.schedule for f in b.failures]
+    assert a.failures, "50 seeded walks find the 1-preemption race"
+
+
+def test_selfcheck_proves_the_pair_quickly():
+    report = schedule.selfcheck()
+    assert report["ok"]
+    assert report["lost_update_found"] and report["fixed_clean"]
+    assert report["exhausted"]
+    assert report["seconds"] < 10.0, "the tier smoke must stay cheap"
+    # The witness is a replayable schedule string
+    replay = schedule.run_schedule(schedule.lost_update_model,
+                                   report["witness"])
+    assert not replay.ok
+
+
+# --------------------------------------------------------------------------- #
+# The harness applied to the real thread surfaces
+
+def _microbatcher_model(sched):
+    """The `serve/batching.py` flush/submit surface, reduced to its race
+    skeleton: per-cell deques guarded by ONE condition, a flusher that
+    drains due cells, submitters that append and notify, close() as the
+    shutdown handshake. Invariant: every submitted request is flushed
+    exactly once, and the flusher terminates."""
+    cond = sched.condition()
+    state = {"queues": [], "closed": False, "flushed": []}
+
+    def submitter():
+        for i in range(2):
+            with cond:
+                state["queues"].append(i)
+                cond.notify()
+        with cond:
+            state["closed"] = True
+            cond.notify()
+
+    def flusher():
+        while True:
+            with cond:
+                while not state["queues"] and not state["closed"]:
+                    cond.wait()
+                batch, state["queues"] = state["queues"], []
+                done = state["closed"] and not state["queues"]
+            if batch:
+                state["flushed"].extend(batch)   # dispatch: outside the lock
+            if done and not batch:
+                return
+
+    def check():
+        assert state["flushed"] == [0, 1], state["flushed"]
+        assert state["closed"]
+
+    return [submitter, flusher], check
+
+
+def test_microbatcher_flush_submit_surface_is_schedule_clean():
+    r = schedule.explore(_microbatcher_model, max_preemptions=2)
+    assert r.exhausted and not r.failures, r.failures[:3]
+    assert r.runs > 10  # the surface has real interleavings to survive
+
+
+def _unlocked_microbatcher_model(sched):
+    """The same surface WITHOUT the condition: the check-then-drain on
+    the shared queue loses submissions under preemption — the harness
+    finds it (the negative control for the model above)."""
+    state = {"queues": [], "flushed": [], "submitted": 0}
+
+    def submitter():
+        for i in range(2):
+            queued = state["queues"]          # read
+            sched.point()                     # ... preempted ...
+            state["queues"] = queued + [i]    # write-back loses the drain
+            state["submitted"] += 1
+
+    def flusher():
+        for _ in range(3):
+            sched.point()
+            batch, state["queues"] = state["queues"], []
+            state["flushed"].extend(batch)
+
+    def check():
+        lost = state["submitted"] - len(state["flushed"]) \
+            - len(state["queues"])
+        assert lost == 0, f"{lost} submission(s) lost"
+
+    return [submitter, flusher], check
+
+
+def test_unlocked_queue_loses_submissions():
+    r = schedule.explore(_unlocked_microbatcher_model, max_preemptions=2)
+    assert r.failures, "the unguarded queue swap must lose a submission"
+    assert any("lost" in f.error for f in r.failures)
+
+
+def _store_model(sched):
+    """The REAL `ClientSuspicionStore` under the service's
+    `_suspicion_lock` discipline: two submitter threads fold cohorts in
+    under one lock, with client "c2" admission-masked. Invariants (on
+    every schedule): every observe landed (no lost EWMA update — each
+    client's observation count is exact) and the admission-hold
+    contract: the masked client's collusion EWMA stays EXACTLY zero
+    while colluding clients c0/c1 accumulate evidence."""
+    store = ClientSuspicionStore(weights=(0.4, 0.2, 0.2, 0.2), min_obs=1)
+    lock = sched.lock()
+    clients = ("c0", "c1", "c2")
+    # c0/c1 are near-duplicates (colluding); c2 sits far away and is
+    # admission-masked, so its collusion EWMA must HOLD, not decay
+    dist = np.array([[np.inf, 0.01, 1.0],
+                     [0.01, np.inf, 1.0],
+                     [1.0, 1.0, np.inf]])
+    selection = np.array([1.0, 1.0, 0.0])
+    active = np.array([True, True, False])
+
+    def submitter():
+        for _ in range(2):
+            with lock:
+                store.observe(clients, selection,
+                              distances=np.array([0.5, 0.5, 1.0]),
+                              active=active, dist=dist)
+            sched.point()
+
+    def check():
+        for client in clients:
+            verdict = store.verdict(client)
+            assert verdict["observations"] == 4, (client, verdict)
+        assert store.verdict("c2")["collusion"] == 0.0
+        assert store.verdict("c0")["collusion"] > 0.0
+        assert store.verdict("c1")["collusion"] > 0.0
+        assert store.requests == 4
+
+    return [submitter, submitter], check
+
+
+def test_suspicion_store_admission_hold_under_schedules():
+    r = schedule.explore(_store_model, max_preemptions=2)
+    assert r.exhausted and not r.failures, r.failures[:3]
+
+
+def _fixed_service_stats_model(sched):
+    """The FIXED `AggregationService` stats path end to end: a submitter
+    bumps `_requests` under the stats lock and hands work over; the
+    resolver bumps `_served` under the same lock; a reader snapshots
+    under the lock. Coherence: within one snapshot `served <= requests`,
+    and the final counts are exact — race-free under the same schedules
+    that break the pre-fix pattern."""
+    class Service:
+        def __init__(self):
+            self._stats_lock = sched.lock()
+            self._cond = sched.condition()   # the batcher hand-off
+            self._requests = 0
+            self._served = 0
+            self.pending = 0
+
+        def submit(self):
+            with self._stats_lock:
+                value = self._requests
+                sched.point()
+                self._requests = value + 1
+            with self._cond:
+                self.pending += 1
+                self._cond.notify()
+
+        def resolve_loop(self):
+            resolved = 0
+            while resolved < 2:
+                with self._cond:
+                    while self.pending == 0:
+                        self._cond.wait()
+                    self.pending -= 1
+                with self._stats_lock:
+                    value = self._served
+                    sched.point()
+                    self._served = value + 1
+                resolved += 1
+
+        def stats(self):
+            with self._stats_lock:
+                return {"requests": self._requests, "served": self._served}
+
+    svc = Service()
+    snapshots = []
+
+    def submitter():
+        svc.submit()
+        svc.submit()
+
+    def resolver():
+        svc.resolve_loop()
+
+    def reader():
+        for _ in range(2):
+            snapshots.append(svc.stats())
+            sched.point()
+
+    def check():
+        for snap in snapshots:
+            assert snap["served"] <= snap["requests"], snap
+        # All threads are done: read the final state directly (the
+        # instrumented lock only exists for the scheduled threads)
+        assert (svc._requests, svc._served) == (2, 2), vars(svc)
+
+    return [submitter, resolver, reader], check
+
+
+def test_fixed_service_stats_model_is_race_free():
+    r = schedule.explore(_fixed_service_stats_model, max_preemptions=2,
+                         max_runs=3000)
+    assert not r.failures, r.failures[:3]
+    assert r.runs > 50  # three threads: a real schedule space was covered
