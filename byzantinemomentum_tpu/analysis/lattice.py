@@ -266,6 +266,56 @@ def _serve_cell(gar, n_bucket, f, d, diagnostics, batch):
         expect=hlolint.Expect(psums=0))
 
 
+def _health_cell():
+    """The numerics flight recorder's in-jit stats program
+    (`engine/health.py::health_metrics`) at the canonical spec —
+    histogram bucketing, Var ratio, norms and non-finite counts are pure
+    elementwise/contraction work: no collectives, no worker-matrix
+    gather. Pinned: the health-on step variant rides this fingerprint
+    (the step program itself only churns with engine changes)."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from byzantinemomentum_tpu.engine import health
+
+        Gh = jax.ShapeDtypeStruct((N - F, D), jnp.float32)
+        Ga = jax.ShapeDtypeStruct((F, D), jnp.float32)
+        vec = jax.ShapeDtypeStruct((D,), jnp.float32)
+        return jax.jit(health.health_metrics), (Gh, Ga, vec, vec, vec)
+
+    return LatticeCell(
+        key="engine/health-stats", build=build,
+        expect=hlolint.Expect(psums=0, gather_limit=N * D - 1))
+
+
+def _health_mesh_cell(k):
+    """The d-sharded health stats (`engine/health.py::
+    sharded_health_metrics`): shard-local partials with the width-aware
+    real-column mask, ONE tupled psum — `health.HEALTH_PSUMS` all_reduce
+    ops (per-row norm² partials + the packed scalar partials), the
+    census that pins the tuple never unfuses."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from byzantinemomentum_tpu.engine import health
+
+        mesh = _virtual_mesh(k)
+        Gh = jax.ShapeDtypeStruct((N - F, D), jnp.float32)
+        Ga = jax.ShapeDtypeStruct((F, D), jnp.float32)
+        vec = jax.ShapeDtypeStruct((D,), jnp.float32)
+        return (jax.jit(health.sharded_health_metrics(mesh)),
+                (Gh, Ga, vec, vec, vec))
+
+    from byzantinemomentum_tpu.engine.health import HEALTH_PSUMS
+    return LatticeCell(
+        key=f"engine/health-stats@mesh{k}", build=build,
+        expect=hlolint.Expect(psums=HEALTH_PSUMS, gather_limit=N * D - 1))
+
+
 def _update_cell():
     """The engine's update-phase donation contract: the SGD update
     (`optim.py` — what actually runs inside the donated train step)
@@ -436,8 +486,13 @@ def enumerate_cells(gars=None, variants=None, meshes=None, serve=None):
         # The update-axis donation contract rides with the default grid
         # (shrunken test grids that drop the serve axis drop it too),
         # as does the structural-only full-step cell (linted every
-        # check, never fingerprinted — see `_full_step_cell`)
+        # check, never fingerprinted — see `_full_step_cell`), and the
+        # flight recorder's health-stats cells (unsharded + the tupled-
+        # psum d-sharded form; PR 15)
         cells.append(_update_cell())
+        cells.append(_health_cell())
+        if 2 in meshes:
+            cells.append(_health_mesh_cell(2))
         cells.append(_full_step_cell())
     return cells
 

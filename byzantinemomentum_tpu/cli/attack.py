@@ -48,8 +48,8 @@ from byzantinemomentum_tpu import obs as obs_mod
 from byzantinemomentum_tpu import ops as ops_mod
 from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.engine import (
-    EngineConfig, FAULT_COLUMNS, FORENSIC_COLUMNS, RECOVERY_COLUMNS,
-    STUDY_COLUMNS, build_engine)
+    EngineConfig, FAULT_COLUMNS, FORENSIC_COLUMNS, HEALTH_COLUMNS,
+    RECOVERY_COLUMNS, STUDY_COLUMNS, build_engine)
 from byzantinemomentum_tpu.models.core import apply_named_init
 
 __all__ = ["process_commandline", "main"]
@@ -116,6 +116,24 @@ def process_commandline(argv=None):
              "tracker (obs/forensics.py: suspect_worker telemetry events). "
              "Off by default: the diagnostic aux rides the compiled step "
              "as extra outputs (measured overhead documented in README)")
+    add("--health", action="store_true", default=False,
+        help="Numerics flight recorder: compute the in-jit tensor-health "
+             "vector every step (engine/health.py — fixed-bin log-scale "
+             "histogram of submitted-momentum norms, the paper's Var/norm "
+             "ratio, weight/update norms, per-phase NaN/Inf counts), "
+             "append the HEALTH_COLUMNS to the study CSV and feed the "
+             "host-side SPC monitor (obs/health: EWMA+MAD z-scores with "
+             "sustained-run rules, health_anomaly/health_cleared telemetry "
+             "events, health_blackbox.json post-mortem ring). Off by "
+             "default; when off the compiled step is byte-identical to "
+             "the pre-health program")
+    add("--rollback-on-anomaly", action="store_true", default=False,
+        help="Upgrade the divergence-rollback trigger from 'training "
+             "state went non-finite' to 'non-finite OR sustained health "
+             "anomaly' (implies --health; needs '--rollback-budget'): the "
+             "SPC monitor's rising anomaly edge rolls the run back to the "
+             "last good checkpoint BEFORE the state is destroyed, reusing "
+             "the pipelined rollback machinery")
     add("--attack", type=str, default="nan", help="Attack to use")
     add("--attack-args", nargs="*", help="key:value args for the attack")
     add("--fault-plan", type=str, default=None,
@@ -394,6 +412,20 @@ def _postprocess(args):
                       "('--checkpoint-delta' with '--result-directory'); "
                       "rollback disabled")
         args.rollback_budget = 0
+    if args.rollback_on_anomaly and not args.health:
+        args.health = True  # the early-warning trigger needs the stream
+    if args.health and (args.result_directory is None
+                        or args.nb_for_study < 1):
+        utils.warning("'--health' needs the study pipeline "
+                      "('--nb-for-study' with '--result-directory'); "
+                      "health columns disabled")
+        args.health = False
+        args.rollback_on_anomaly = False
+    if args.rollback_on_anomaly and args.rollback_budget <= 0:
+        utils.warning("'--rollback-on-anomaly' needs '--rollback-budget' "
+                      "(there is no rollback machinery to trigger); "
+                      "anomaly trigger disabled")
+        args.rollback_on_anomaly = False
     # Study coercions (reference `attack.py:301-313`)
     if args.result_directory is None:
         args.nb_for_study = 0
@@ -681,7 +713,8 @@ def main(argv=None):
                               if fault_plan is not None else True),
             fault_dynamic_quorum=(fault_plan.policy.dynamic_quorum
                                   if fault_plan is not None else True),
-            gar_diagnostics=args.gar_diagnostics)
+            gar_diagnostics=args.gar_diagnostics,
+            health=args.health)
         from byzantinemomentum_tpu import optim
         optimizer = optim.build(args.optimizer,
                                 weight_decay=args.weight_decay,
@@ -768,6 +801,10 @@ def main(argv=None):
         forensics_active = cfg.gar_diagnostics and cfg.study
         suspicion = (obs_mod.SuspicionTracker(args.nb_workers)
                      if forensics_active else None)
+        # Numerics flight recorder (--health): in-jit health vector out of
+        # the step, host-side SPC monitor over it (obs/health)
+        health_active = cfg.health and cfg.study
+        monitor = obs_mod.HealthMonitor() if health_active else None
         if args.result_directory is not None:
             resdir = pathlib.Path(args.result_directory).resolve()
             try:
@@ -816,6 +853,8 @@ def main(argv=None):
                         study_columns = study_columns + RECOVERY_COLUMNS
                     if forensics_active:
                         study_columns = study_columns + FORENSIC_COLUMNS
+                    if health_active:
+                        study_columns = study_columns + HEALTH_COLUMNS
                     results.make("study", *study_columns,
                                  resume_step=resume_step)
                 (resdir / "config").write_text(_config_text(args) + os.linesep)
@@ -983,6 +1022,14 @@ def main(argv=None):
         next_sample_step = steps_host
         mfu_flops = None   # logical FLOPs/step: lazy, False = gave up
         mfu_peak = None
+
+        def _health_hb():
+            """The heartbeat's `health` block (training-dynamics state
+            next to liveness — the Jobs watchdog and the fleet liveness
+            view read it); empty without the flight recorder."""
+            return ({"health": monitor.summary()}
+                    if monitor is not None else {})
+
         if telem is not None:
             try:
                 mfu_peak = obs_mod.peak_flops(jax.devices()[0].device_kind)
@@ -990,7 +1037,8 @@ def main(argv=None):
                 mfu_peak = None  # backend probe failed: MFU gauge stays off
             # First heartbeat before the first (slow: compile) dispatch, so
             # a supervisor watchdog sees a live signal immediately
-            telem.heartbeat(step=steps_host, status="running")
+            telem.heartbeat(step=steps_host, status="running",
+                            **_health_hb())
         # (directory, from_step) of a live SIGUSR1 profiler window
         profile_active = None
         # --attribution: deterministic phase attribution of one traced
@@ -1117,6 +1165,33 @@ def main(argv=None):
                                      distances=_per_step("Worker dist"),
                                      active=active)
                     row.append(float_format % suspicion.max())
+                if health_active:
+                    # HEALTH_COLUMNS: the in-jit health vector formatted
+                    # for the CSV and folded into the SPC monitor (the
+                    # anomaly/rollback trigger reads the monitor at the
+                    # next loop top — pipelined like the isfinite flag)
+                    def _hval(key):
+                        value = np.asarray(p_metrics[key])
+                        return value[i] if p_m > 1 else value
+                    for column in ("Var ratio", "Weight norm",
+                                   "Update norm", "Update/weight"):
+                        row.append(float_format % float(_hval(column)))
+                    hist = [int(c) for c in np.asarray(_hval("Norm hist"))]
+                    row.append(";".join(str(c) for c in hist))
+                    nonfinite = {}
+                    for column in ("Nonfinite submitted",
+                                   "Nonfinite aggregate",
+                                   "Nonfinite state"):
+                        nonfinite[column] = int(_hval(column))
+                        row.append(nonfinite[column])
+                    monitor.update(p_steps + i, {
+                        "var_ratio": float(_hval("Var ratio")),
+                        "update_ratio": float(_hval("Update/weight")),
+                        "weight_norm": float(_hval("Weight norm")),
+                        "update_norm": float(_hval("Update norm")),
+                        "nonfinite": sum(nonfinite.values()),
+                        "norm_hist": hist,
+                    })
                 results.store(fd_study, *row)
             if fault_schedule is not None and telem is not None:
                 # The chunk's scheduled-fault total lands on the system
@@ -1158,16 +1233,17 @@ def main(argv=None):
             utils.warning(f"Rollback: declared Byzantine count tightened "
                           f"to f={new_f} (step program rebuilt)")
 
-        def roll_back():
-            """Restore the last good checkpoint after a non-finite state;
-            False when the run must give up (budget spent / nothing valid
-            to restore)."""
+        def roll_back(trigger="non-finite"):
+            """Restore the last good checkpoint after a health trigger
+            ('non-finite' state, or a sustained 'anomaly' under
+            --rollback-on-anomaly); False when the run must give up
+            (budget spent / nothing valid to restore)."""
             nonlocal state, steps_host, datapoints_host, current_lr, \
                 just_loaded, rollbacks, fd_eval, fd_study
             rollbacks += 1
             if rollbacks > args.rollback_budget:
-                utils.error(f"Non-finite training state at step {steps_host} "
-                            f"and the rollback budget "
+                utils.error(f"Health trigger ({trigger}) at step "
+                            f"{steps_host} and the rollback budget "
                             f"({args.rollback_budget}) is exhausted; "
                             f"giving up")
                 return False
@@ -1208,14 +1284,15 @@ def main(argv=None):
                 fd_eval = results.get("eval")
                 fd_study = results.get("study")
             utils.warning(f"Rollback #{rollbacks}/{args.rollback_budget}: "
-                          f"non-finite training state; restored "
+                          f"{trigger} health trigger; restored "
                           f"{found.name} (step {steps_host})")
             if telem is not None:
                 telem.counter("rollbacks")
                 telem.event("rollback", step=steps_host,
-                            restored=found.name,
+                            restored=found.name, trigger=trigger,
                             budget_left=args.rollback_budget - rollbacks)
-                telem.heartbeat(step=steps_host, status="rolled-back")
+                telem.heartbeat(step=steps_host, status="rolled-back",
+                                **_health_hb())
             if args.rollback_tighten_quorum:
                 tighten_quorum()
             return True
@@ -1228,22 +1305,54 @@ def main(argv=None):
         chaos_nan = os.environ.get("BMT_CHAOS_NAN_AT_STEP")
         chaos_nan = int(chaos_nan) if chaos_nan else None
         chaos_nan_repeat = os.environ.get("BMT_CHAOS_NAN_REPEAT") == "1"
+        # Gradual-divergence hook (the early-warning acceptance surface):
+        # scale the parameters by a factor per chunk past the step — the
+        # norms blow up over several steps BEFORE overflowing to inf, so
+        # the SPC anomaly must fire ahead of the isfinite flag
+        chaos_blow = os.environ.get("BMT_CHAOS_BLOWUP_AT_STEP")
+        chaos_blow = int(chaos_blow) if chaos_blow else None
+        chaos_blow_factor = float(
+            os.environ.get("BMT_CHAOS_BLOWUP_FACTOR", "1e12"))
 
         try:
             while not exit_is_requested():
                 if chaos_kill is not None and steps_host >= chaos_kill:
                     os.kill(os.getpid(), signal.SIGKILL)
                 # Health verdict of the previous chunk, BEFORE any milestone
-                # can evaluate/checkpoint (never snapshots a poisoned state)
+                # can evaluate/checkpoint (never snapshots a poisoned
+                # state). Two triggers, checked hard-signal first: the
+                # pipelined isfinite flag, and — with --rollback-on-anomaly
+                # — the SPC monitor's sustained-anomaly edge (the early
+                # warning: it fires while the state is still finite)
+                trigger = None
                 if pending_health:
                     if not bool(np.asarray(pending_health.pop())):
-                        if not roll_back():
-                            if telem is not None:
-                                telem.event("divergence_giveup",
-                                            step=steps_host)
-                            diverged = True
-                            break
-                        continue
+                        trigger = "non-finite"
+                if (trigger is None and args.rollback_on_anomaly
+                        and monitor is not None
+                        and monitor.rollback_pending()):
+                    trigger = "anomaly"
+                if trigger is not None:
+                    if telem is not None:
+                        telem.event("health_flag", step=steps_host,
+                                    trigger=trigger)
+                    if monitor is not None:
+                        # The post-mortem BEFORE the trajectory rewinds:
+                        # the ring holds the exact steps that went bad
+                        monitor.dump_blackbox(args.result_directory,
+                                              reason=trigger)
+                    if not roll_back(trigger):
+                        if telem is not None:
+                            telem.event("divergence_giveup",
+                                        step=steps_host)
+                        if monitor is not None:
+                            monitor.dump_blackbox(args.result_directory,
+                                                  reason="divergence_giveup")
+                        diverged = True
+                        break
+                    if monitor is not None:
+                        monitor.note_rollback()
+                    continue
                 steps = steps_host
                 milestone_evaluation = (args.evaluation_delta > 0
                                         and steps % args.evaluation_delta == 0)
@@ -1302,7 +1411,8 @@ def main(argv=None):
                     # Milestones already synced the device; refresh the
                     # heartbeat for free
                     telem.heartbeat(step=steps, status="running",
-                                    steps_per_sec=rate_window.rate())
+                                    steps_per_sec=rate_window.rate(),
+                                    **_health_hb())
                 if milestone_user_input:
                     code.interact(banner=f"Interactive prompt (step {steps}); "
                                   "Ctrl-D to resume", local={"state": state,
@@ -1314,6 +1424,11 @@ def main(argv=None):
                 # right after the chunk it covers is drained below
                 if profile_request[0] and profile_active is None:
                     profile_request[0] = False
+                    if monitor is not None:
+                        # SIGUSR1 is the live-debug hook: snapshot the
+                        # flight recording alongside the profiler window
+                        monitor.dump_blackbox(args.result_directory,
+                                              reason="sigusr1")
                     if args.result_directory is None:
                         utils.warning("SIGUSR1 profiling needs "
                                       "'--result-directory'; ignored")
@@ -1453,7 +1568,7 @@ def main(argv=None):
                     telem.heartbeat(step=steps_host, status="running",
                                     steps_per_sec=rate,
                                     device_step_ms=device_ms, rss_mb=rss,
-                                    mfu=mfu_now)
+                                    mfu=mfu_now, **_health_hb())
                     next_sample_step = steps_host + telem.interval
                 attrib_seen_m.add(M)
                 if attrib_window is not None:
@@ -1497,6 +1612,13 @@ def main(argv=None):
                         dispatch_fn, dispatch_args)
                     attribute_window(pdir, steps_host - pstep, hlo_text,
                                      pflops, pdir)
+                if chaos_blow is not None and steps_host > chaos_blow:
+                    # Gradual-divergence chaos: multiplicative blow-up per
+                    # chunk — several anomalous-but-finite steps precede
+                    # the overflow (see the hook's comment above)
+                    state = state._replace(
+                        theta=state.theta * jnp.asarray(
+                            chaos_blow_factor, state.theta.dtype))
                 if chaos_nan is not None and steps_host > chaos_nan:
                     # Poison the freshly dispatched state (chaos hook): the
                     # health flag below must flip and trigger the rollback
@@ -1541,6 +1663,12 @@ def main(argv=None):
     if args.trace_dir is not None:
         obs_mod.emit("profiler_trace_stop", directory=str(args.trace_dir))
         jax.profiler.stop_trace()
+    if monitor is not None and monitor.steps > 0 and not diverged:
+        # Every recorded run leaves a post-mortem, failed or not (the
+        # blackbox of a completed run is its last-K health trace); a
+        # diverged run keeps its divergence_giveup dump — the post-mortem
+        # that matters must not be clobbered by a latest-wins rewrite
+        monitor.dump_blackbox(args.result_directory, reason="run_end")
     if telem is not None:
         if suspicion is not None and suspicion.steps > 0:
             # Final forensics snapshot: who ended the run under suspicion
@@ -1549,10 +1677,14 @@ def main(argv=None):
         status = ("diverged" if diverged
                   else "interrupted" if exit_is_requested()
                   else "completed")
+        if monitor is not None and monitor.steps > 0:
+            # Final health snapshot: the run's standing anomaly state and
+            # envelope estimates (the timeline has the per-edge events)
+            telem.event("health_summary", **monitor.summary())
         telem.event("run_end", step=steps_host, status=status,
                     rollbacks=rollbacks, restarts=restart_count)
         telem.heartbeat(step=steps_host, status=status,
-                        steps_per_sec=rate_window.rate())
+                        steps_per_sec=rate_window.rate(), **_health_hb())
         telem.close()
         obs_mod.deactivate()
     # A diverged run that spent its rollback budget is a failure: the Jobs

@@ -105,6 +105,10 @@ def process_commandline(argv=None):
     add("--recompile-check", type=int, default=0,
         help="Assert ZERO backend compiles across this many warm steps "
              "of the multi-process program (0 disables)")
+    add("--health", action="store_true", default=False,
+        help="Numerics flight recorder: in-jit health stats in the "
+             "sharded step + a per-host SPC monitor whose summary rides "
+             "this host's heartbeat 'health' block")
     add("--lattice-census", action="store_true", default=False,
         help="Lower the multi-process lattice cells and write this "
              "host's fingerprint + BMT-H census artifact")
@@ -229,7 +233,13 @@ def main(argv=None):
         nb_workers=args.nb_workers, nb_decl_byz=args.nb_decl_byz,
         nb_real_byz=args.nb_real_byz, nb_for_study=args.nb_for_study,
         nb_for_study_past=max(args.nb_for_study_past, 1),
-        momentum=args.momentum, momentum_at="update")
+        momentum=args.momentum, momentum_at="update",
+        health=args.health)
+    # Per-host flight recorder (obs/health): folds the in-jit health
+    # vector this host reads off the replicated metrics; its summary
+    # rides the host heartbeat's `health` block, which the liveness view
+    # and the launcher's aggregated fleet heartbeat carry through
+    monitor = obs.HealthMonitor() if args.health else None
     engine = build_engine(
         cfg=cfg, model_def=models_mod.build(args.model),
         loss=losses_mod.Loss("nll"), criterion=losses_mod.Criterion("top-k"),
@@ -357,9 +367,23 @@ def main(argv=None):
                 row.append(float(host_metrics[
                     "Attack acceptation ratio"]))
                 results.store(fd_study, *row)
-            write_host_heartbeat(resdir, proc, {
-                "step": steps_host, "status": "running",
-                "resume_step": resume_step})
+            beat = {"step": steps_host, "status": "running",
+                    "resume_step": resume_step}
+            if monitor is not None:
+                monitor.update(steps, {
+                    "var_ratio": float(host_metrics["Var ratio"]),
+                    "update_ratio": float(host_metrics["Update/weight"]),
+                    "weight_norm": float(host_metrics["Weight norm"]),
+                    "update_norm": float(host_metrics["Update norm"]),
+                    "nonfinite": (
+                        float(host_metrics["Nonfinite submitted"])
+                        + float(host_metrics["Nonfinite aggregate"])
+                        + float(host_metrics["Nonfinite state"])),
+                    "norm_hist": [float(c) for c in
+                                  np.asarray(host_metrics["Norm hist"])],
+                })
+                beat["health"] = monitor.summary()
+            write_host_heartbeat(resdir, proc, beat)
             telem.gauge("host_step", steps_host)
     finally:
         if results is not None:
@@ -376,10 +400,14 @@ def main(argv=None):
         "recompile_checked": (compiles_checked
                               if args.recompile_check else None),
     }
-    write_host_heartbeat(resdir, proc, {
+    final_beat = {
         "step": steps_host, "status": "completed",
         "resume_step": resume_step,
-        "steps_per_sec": summary["steps_per_sec"]})
+        "steps_per_sec": summary["steps_per_sec"]}
+    if monitor is not None and monitor.steps > 0:
+        final_beat["health"] = monitor.summary()
+        monitor.dump_blackbox(local_dir, reason="run_end")
+    write_host_heartbeat(resdir, proc, final_beat)
     telem.event("host_end", host=proc, steps=steps_host,
                 steps_per_sec=summary["steps_per_sec"],
                 resume_step=resume_step)

@@ -111,6 +111,11 @@ def process_commandline(argv=None):
     add("--learning-rate", type=float, default=0.05)
     add("--momentum", type=float, default=0.9)
     add("--checkpoint-delta", type=int, default=2)
+    add("--health", action="store_true", default=False,
+        help="Numerics flight recorder on every host (engine/health.py "
+             "in-jit stats + per-host SPC monitor): each host's "
+             "heartbeat gains a 'health' block the liveness view and "
+             "the aggregated fleet heartbeat carry through")
     return parser.parse_args(sys.argv[1:] if argv is None else argv)
 
 
@@ -173,6 +178,8 @@ def _spawn_fleet(args, resdir, mirror, port):
             cmd += ["--recompile-check", str(args.recompile_check)]
         if args.lattice_census:
             cmd.append("--lattice-census")
+        if args.health:
+            cmd.append("--health")
         if args.attack_args:
             cmd += ["--attack-args", *args.attack_args]
         for dest in _RUN_FLAGS:
@@ -290,11 +297,20 @@ def main(argv=None):
 
     def aggregate(view, status):
         alive = view["alive"]
-        write_heartbeat(resdir, {
+        payload = {
             "step": view["min_step"], "status": status,
             "hosts": args.hosts, "hosts_alive": len(alive),
             "host_steps": {str(h): view["hosts"][h]["step"]
-                           for h in alive}})
+                           for h in alive}}
+        # Training-dynamics state rides the fleet heartbeat too: the
+        # per-host flight-recorder blocks (obs/health via the driver's
+        # heartbeat), so the Jobs watchdog sees anomaly state, not just
+        # liveness
+        health = {str(h): view["hosts"][h]["health"] for h in alive
+                  if view["hosts"][h].get("health")}
+        if health:
+            payload["health"] = health
+        write_heartbeat(resdir, payload)
 
     recoveries = list(manifest.get("recoveries") or [])
     attempt = int(manifest.get("attempt") or 0)
@@ -483,9 +499,20 @@ def main(argv=None):
                 recovery_steps=recovery_steps, attempts=attempt)
     telem.close()
     final_status = {"ok": "completed"}.get(status, status)
-    write_heartbeat(resdir, {
+    final_beat = {
         "step": (final_view or {}).get("min_step"),
-        "status": final_status, "hosts": args.hosts})
+        "status": final_status, "hosts": args.hosts}
+    # Final training-dynamics state: completed hosts are no longer
+    # "alive", so read their last heartbeats' flight-recorder blocks
+    # directly — the fleet's post-mortem heartbeat carries the health
+    # story, not just liveness
+    from byzantinemomentum_tpu.obs.heartbeat import read_host_heartbeats
+    health = {str(h): beat["health"]
+              for h, beat in read_host_heartbeats(resdir).items()
+              if isinstance(beat.get("health"), dict)}
+    if health:
+        final_beat["health"] = health
+    write_heartbeat(resdir, final_beat)
     print("cluster: " + json.dumps(
         {"status": status, "hosts": args.hosts,
          "steps_per_sec": steps_per_sec,

@@ -148,6 +148,12 @@ def liveness_view(run_dir, nb_hosts, *, stale_after=None, running=None,
                 row["resume_step"] = beat.get("resume_step")
             if beat.get("status"):
                 row["host_status"] = beat.get("status")
+            if isinstance(beat.get("health"), dict):
+                # Training-dynamics state (the flight recorder's
+                # heartbeat block, obs/health): the liveness view carries
+                # it through so the fleet exposes anomaly state next to
+                # liveness, not just "the process is up"
+                row["health"] = beat["health"]
         if process_up is False:
             row["status"] = "dead"
         elif beat is None:

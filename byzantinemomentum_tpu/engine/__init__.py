@@ -15,9 +15,10 @@ from byzantinemomentum_tpu.engine.config import EngineConfig
 from byzantinemomentum_tpu.engine.state import TrainState
 from byzantinemomentum_tpu.engine.step import Engine, build_engine
 from byzantinemomentum_tpu.engine.metrics import (
-    FAULT_COLUMNS, FORENSIC_COLUMNS, RECOVERY_COLUMNS, STUDY_COLUMNS)
+    FAULT_COLUMNS, FORENSIC_COLUMNS, HEALTH_COLUMNS, RECOVERY_COLUMNS,
+    STUDY_COLUMNS)
 
 __all__ = ["EngineConfig", "TrainState", "Engine", "build_engine",
            "program",
-           "FAULT_COLUMNS", "FORENSIC_COLUMNS", "RECOVERY_COLUMNS",
-           "STUDY_COLUMNS"]
+           "FAULT_COLUMNS", "FORENSIC_COLUMNS", "HEALTH_COLUMNS",
+           "RECOVERY_COLUMNS", "STUDY_COLUMNS"]

@@ -77,6 +77,14 @@ class EngineConfig:
     #                               Trace-time switch: False compiles the
     #                               exact pre-diagnostics program (no
     #                               hot-path cost; `tests/test_diag.py`)
+    health: bool = False          # --health: compute the in-jit tensor-
+    #                               health vector (`engine/health.py`) and
+    #                               emit the HEALTH_COLUMNS study columns
+    #                               (norm histogram, Var ratio, update/
+    #                               weight norms, non-finite counts).
+    #                               Trace-time switch like gar_diagnostics:
+    #                               False compiles the exact pre-health
+    #                               program (byte-identical lowerings)
 
     def __post_init__(self):
         if self.momentum_at not in ("update", "server", "worker"):

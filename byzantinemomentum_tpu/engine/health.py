@@ -1,0 +1,247 @@
+"""In-jit tensor-health statistics — the numerics flight recorder's
+device half.
+
+The paper's whole argument runs through one observable — the
+variance-to-norm ratio of the submitted momenta — yet before this module
+it only surfaced under opt-in full GAR diagnostics
+(`engine/metrics.py::FORENSIC_COLUMNS`), and the divergence watchdog was
+a post-hoc `isfinite(max|theta|)` flag that fires after the state is
+already destroyed. ALIE-style attacks (Baruch et al., PAPERS.md) win
+precisely by hiding *inside* the honest variance envelope, so the
+envelope itself must be a first-class, always-cheap, continuously
+monitored signal. This module computes, INSIDE the compiled step:
+
+  norm histogram    fixed-bin log2-scale histogram of the per-worker
+                    submitted-momentum L2 norms (`HIST_BINS` bins of
+                    `HIST_WIDTH` octaves starting at `2**HIST_LO`; exact
+                    zeros land in the underflow bin, non-finite rows in
+                    the overflow bin) — the shape of the submission cloud
+                    without shipping the cloud.
+  Var ratio         the paper's variance-to-norm ratio of the honest
+                    submissions (`ops/diag.py::var_norm_ratio` formula),
+                    promoted out of the diagnostics path — and computed
+                    from the SAME `avg`/`dev²` subexpressions the study
+                    pipeline already builds (`metrics.avg_dev_max`), so
+                    under the study (always, for health) XLA CSE makes it
+                    free.
+  weight/update     global L2 norms of the updated parameter vector and
+                    of the applied update, plus their ratio — the
+                    classical "update-to-weight" training-health signal.
+  non-finite counts per phase: submitted rows whose norm is non-finite
+                    (derived from the per-row norms — no extra pass over
+                    the (n, d) stack), and NaN/Inf entries in the
+                    aggregated defense gradient and the updated
+                    parameter vector.
+
+Everything is a flat dict of f32 scalars plus ONE f32[HIST_BINS] vector,
+keyed by `engine/metrics.py::HEALTH_COLUMNS`, merged into the step's
+metrics dict — it rides the existing device->host metrics fetch with
+zero extra syncs. The gate is a trace-time config switch
+(`EngineConfig.health`): off compiles the exact pre-health program
+(byte-identical lowerings, the drift gate's contract). The incremental
+work is engineered to the few passes the study pipeline does not already
+do — per-row norms of the submitted rows and two d-vector reductions —
+measured ≤ 3% steps/s on the CPU smoke config
+(`scripts/health_overhead.py`).
+
+Sharded form: under a `--mesh` run the flat parameter axis is d-sharded,
+so `sharded_health_metrics(mesh)` computes the same reduction partials
+d-locally inside a `shard_map` (width-aware real-column masks exclude
+the divisibility padding from the vector non-finite counts,
+`parallel/sharded.py::_coord_diag_builder` discipline) and psums ONE
+(per-row norm², scalar-pack) tuple — two all_reduce ops, the collective
+census `analysis/lattice.py` pins. The unsharded path is literally the
+one-shard case (`_partials` + `_finalize` shared), so the histogram
+BUCKET counts and non-finite counts are bit-identical across shardings
+(integer counts of per-row bucket predicates, oracle-tested in
+`tests/test_health.py`; the continuous scalars match to psum-vs-full-
+width reduction rounding).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["HIST_BINS", "HIST_LO", "HIST_WIDTH", "norm_histogram",
+           "health_metrics", "sharded_health_metrics", "HEALTH_PSUMS"]
+
+# Log2-scale histogram geometry: HIST_BINS bins of HIST_WIDTH octaves
+# each, starting at 2**HIST_LO. Bin 0 doubles as the underflow bin
+# (exact-zero and sub-2**HIST_LO norms), the last bin as overflow AND the
+# non-finite route — fixed at trace time so the bucket assignment is a
+# pure per-row predicate (bit-stable across shardings and paddings).
+HIST_BINS = 16
+HIST_LO = -12
+HIST_WIDTH = 2
+
+# Collective census of the sharded form (`analysis/lattice.py`): one
+# tupled psum of (per-row norm² partials, packed scalar partials) —
+# StableHLO spells the tuple as one all_reduce per leaf.
+HEALTH_PSUMS = 2
+
+# Update-to-weight guard against a zero weight vector (the ratio is a
+# health signal, not an invariant; +inf there would poison the monitor)
+_TINY = 1e-30
+
+
+def norm_histogram(norms):
+    """`f32[m] -> f32[HIST_BINS]` fixed-bin log2 histogram of L2 norms.
+
+    Exact zeros land in bin 0 (underflow), non-finite norms in the last
+    bin (overflow — their count also rides the non-finite columns); the
+    finite positive range buckets by `floor((log2(n) - HIST_LO) /
+    HIST_WIDTH)`, clipped into range.
+    """
+    finite = jnp.isfinite(norms)
+    safe = jnp.where(finite & (norms > 0), norms, jnp.float32(1.0))
+    idx = jnp.floor((jnp.log2(safe) - HIST_LO) / HIST_WIDTH).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, HIST_BINS - 1)
+    idx = jnp.where(norms == 0, 0, idx)
+    idx = jnp.where(finite, idx, HIST_BINS - 1)
+    onehot = idx[:, None] == jnp.arange(HIST_BINS, dtype=jnp.int32)[None, :]
+    return jnp.sum(onehot.astype(jnp.float32), axis=0)
+
+
+def _partials(G_honest, G_attack, grad_defense, theta_old, theta_new):
+    """The d-local reduction partials of one (shard of the) health
+    vector: (per-row norm² over the submitted stack, packed scalars).
+    Plain `jnp.sum` reductions on purpose — the honest avg/dev²
+    subexpressions then match the study pipeline's
+    (`metrics.avg_dev_max`), `sum(grad_defense²)` matches its 'Defense
+    gradient norm', and the d-vector sums XLA's fuser folds into the
+    update phase — so under the study (always, for health) CSE leaves
+    only the passes nothing else does: the per-row norms of the
+    submitted rows and the theta/update reductions."""
+    norm2 = jnp.concatenate([jnp.sum(G_honest * G_honest, axis=1),
+                             jnp.sum(G_attack * G_attack, axis=1)])
+    avg = jnp.mean(G_honest, axis=0)
+    dev = G_honest - avg
+    update = theta_old - theta_new
+    scalars = jnp.stack([
+        jnp.sum(dev * dev),                              # dev² total
+        jnp.sum(avg * avg),                              # ||avg||²
+        jnp.sum(theta_new * theta_new),                  # ||theta||²
+        jnp.sum(update * update),                        # ||update||²
+        jnp.sum(grad_defense * grad_defense),            # ||aggregate||²
+    ])
+    return norm2, scalars
+
+
+def _finalize(norm2, scalars, m_honest):
+    """The health metric dict from the (psum'd) reduction totals, keyed
+    by `engine/metrics.py::HEALTH_COLUMNS`. The non-finite signals are
+    DERIVED from reductions already on hand — a sum-of-squares is
+    NaN/Inf iff its operand holds a NaN/Inf (or overflows f32, which is
+    the same emergency one step earlier) — so they cost no pass:
+    'Nonfinite submitted' counts rows with a non-finite norm, the
+    aggregate/state columns are 0/1 indicators off `||aggregate||²` /
+    `||theta||²`."""
+    dev2, navg2, w2, u2, agg2 = (scalars[i] for i in range(5))
+    if m_honest >= 2:
+        var_ratio = ((dev2 / (m_honest - 1)) / navg2).astype(jnp.float32)
+    else:
+        var_ratio = jnp.float32(jnp.nan)
+    weight_norm = jnp.sqrt(w2)
+    update_norm = jnp.sqrt(u2)
+    return {
+        "Var ratio": var_ratio,
+        "Weight norm": weight_norm,
+        "Update norm": update_norm,
+        "Update/weight": update_norm / jnp.maximum(weight_norm, _TINY),
+        "Norm hist": norm_histogram(jnp.sqrt(norm2)),
+        "Nonfinite submitted": jnp.sum(
+            (~jnp.isfinite(norm2)).astype(jnp.float32)),
+        "Nonfinite aggregate": (~jnp.isfinite(agg2)).astype(jnp.float32),
+        "Nonfinite state": (~jnp.isfinite(w2)).astype(jnp.float32),
+    }
+
+
+def _as_f32(*arrays):
+    # Identity for f32 inputs ON PURPOSE (not just an optimization): an
+    # f32->f32 convert would make the honest avg/dev² subexpressions
+    # structurally different from the study pipeline's and defeat the
+    # CSE this module's cost budget leans on
+    return tuple(a if a.dtype == jnp.float32 else a.astype(jnp.float32)
+                 for a in arrays)
+
+
+def health_metrics(G_honest, G_attack, grad_defense, theta_old,
+                   theta_new):
+    """The per-step health vector, single-device form.
+
+    Args:
+      G_honest: f32[h, d] — the honest submissions, post fault injection
+        (the paper's Var/norm ratio cohort, matching the forensic
+        column's definition).
+      G_attack: f32[f, d] — the Byzantine rows (f may be 0); the norm
+        histogram and non-finite counts cover honest + attack rows, what
+        the server actually saw.
+      grad_defense: f32[d] — the aggregated defense gradient.
+      theta_old / theta_new: f32[d] — parameters before/after the update.
+    """
+    G_honest, G_attack, grad_defense, theta_old, theta_new = _as_f32(
+        G_honest, G_attack, grad_defense, theta_old, theta_new)
+    norm2, scalars = _partials(G_honest, G_attack, grad_defense,
+                               theta_old, theta_new)
+    return _finalize(norm2, scalars, G_honest.shape[0])
+
+
+def sharded_health_metrics(mesh):
+    """The per-step health vector as an explicit d-sharded `shard_map`:
+    shard-local `_partials` with the width-aware real-column mask, ONE
+    tupled psum (`HEALTH_PSUMS` all_reduce ops — the census
+    `analysis/lattice.py` pins), replicated output. Returns a drop-in
+    for `health_metrics` (same signature, same dict)."""
+    from jax.sharding import PartitionSpec as P
+
+    from byzantinemomentum_tpu.parallel.mesh import MODEL, shard_map
+
+    axis = mesh.shape[MODEL]
+
+    def fn(G_honest, G_attack, grad_defense, theta_old, theta_new):
+        G_honest, G_attack, grad_defense, theta_old, theta_new = _as_f32(
+            G_honest, G_attack, grad_defense, theta_old, theta_new)
+        d = theta_new.shape[0]
+        pad = (-d) % axis
+        if pad:
+            G_honest = jnp.pad(G_honest, ((0, 0), (0, pad)))
+            G_attack = jnp.pad(G_attack, ((0, 0), (0, pad)))
+            grad_defense = jnp.pad(grad_defense, (0, pad))
+            theta_old = jnp.pad(theta_old, (0, pad))
+            theta_new = jnp.pad(theta_new, (0, pad))
+        m_honest = G_honest.shape[0]
+
+        def kernel(g_hon, g_att, g_def, t_old, t_new):
+            # Width-aware real-column mask (`_coord_diag_builder`
+            # discipline): the divisibility padding is finite zeros by
+            # construction — exact identities for every sum below — but
+            # masking the shard inputs keeps the partials correct
+            # regardless of what the padder shipped
+            width = t_new.shape[0]
+            start = lax.axis_index(MODEL).astype(jnp.int32) * width
+            real = (start + jnp.arange(width, dtype=jnp.int32)) < d
+            zero = jnp.float32(0.0)
+            norm2, scalars = _partials(
+                jnp.where(real[None, :], g_hon, zero),
+                jnp.where(real[None, :], g_att, zero),
+                jnp.where(real, g_def, zero),
+                jnp.where(real, t_old, zero),
+                jnp.where(real, t_new, zero))
+            norm2, scalars = lax.psum((norm2, scalars), MODEL)
+            return _finalize(norm2, scalars, m_honest)
+
+        out_specs = {
+            "Var ratio": P(), "Weight norm": P(), "Update norm": P(),
+            "Update/weight": P(), "Norm hist": P(),
+            "Nonfinite submitted": P(), "Nonfinite aggregate": P(),
+            "Nonfinite state": P(),
+        }
+        # check_vma=False: the replicated outputs ride the tupled psum
+        # (the `_coord_diag_builder` discipline)
+        return shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P(None, MODEL), P(None, MODEL), P(MODEL), P(MODEL),
+                      P(MODEL)),
+            out_specs=out_specs, check_vma=False,
+        )(G_honest, G_attack, grad_defense, theta_old, theta_new)
+
+    return fn

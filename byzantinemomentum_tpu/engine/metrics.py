@@ -20,7 +20,7 @@ f32 scalars; the host merely formats them (`%.8e`, reference
 import jax.numpy as jnp
 
 __all__ = ["STUDY_COLUMNS", "FAULT_COLUMNS", "RECOVERY_COLUMNS",
-           "FORENSIC_COLUMNS",
+           "FORENSIC_COLUMNS", "HEALTH_COLUMNS",
            "avg_dev_max", "cosine",
            "forensic_metrics", "study_metrics", "push_past"]
 
@@ -63,6 +63,23 @@ RECOVERY_COLUMNS = ("Rollbacks", "Restarts")
 # default runs keep the reference's exact CSV schema.
 FORENSIC_COLUMNS = ("Sel workers", "Dist honest med", "Var/norm ratio",
                     "Clip frac", "Suspicion max")
+
+# Tensor-health columns, appended when the numerics flight recorder is on
+# (`--health` / `EngineConfig.health`; `engine/health.py`): the paper's
+# variance-to-norm ratio of the honest submissions ('Var ratio' — the
+# forensic 'Var/norm ratio' promoted out of the diagnostics path), global
+# weight/update norms and their ratio, the ';'-joined fixed-bin log2
+# histogram of the submitted-momentum norms, and the per-phase NaN/Inf
+# signals ('Nonfinite submitted' counts ROWS of the submitted stack with
+# a non-finite norm; 'Nonfinite aggregate'/'Nonfinite state' are 0/1
+# indicators — all derived from sums-of-squares already on hand, so the
+# non-finite surveillance costs no extra pass; see engine/health.py).
+# Opt-in like the other extension families so default runs keep the
+# reference's exact CSV schema; when off the compiled step is
+# byte-identical to the pre-health program (trace-time switch).
+HEALTH_COLUMNS = ("Var ratio", "Weight norm", "Update norm",
+                  "Update/weight", "Norm hist", "Nonfinite submitted",
+                  "Nonfinite aggregate", "Nonfinite state")
 
 # NaN as a Python float: creating a device array at import time would
 # initialize the JAX backend before the CLI's --device platform selection

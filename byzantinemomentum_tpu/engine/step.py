@@ -854,6 +854,22 @@ class Engine:
             metrics.update(fault_metrics)
         if cfg.study and diag_metrics is not None:
             metrics.update(diag_metrics)
+        if cfg.study and cfg.health:
+            # Numerics flight recorder (`engine/health.py`): the health
+            # vector rides the metrics dict — zero extra syncs, and a
+            # trace-time switch (off compiles the exact pre-health
+            # program). Under a `--mesh` step (`_grouped_mode` is the
+            # mesh inside the sharded builder's trace) the d axis is
+            # sharded, so the stats reduce through the explicit
+            # width-aware shard_map form.
+            from byzantinemomentum_tpu.engine import health as health_mod
+            mode = _grouped_mode
+            health_fn = (health_mod.sharded_health_metrics(mode)
+                         if mode is not None and mode != "off"
+                         else health_mod.health_metrics)
+            with jax.named_scope("metrics"):
+                metrics.update(health_fn(
+                    G_honest, G_attack, grad_defense, state.theta, theta))
 
         new_state = TrainState(
             theta=theta, net_state=net_state, opt_state=opt_state,

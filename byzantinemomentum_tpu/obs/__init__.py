@@ -35,6 +35,12 @@ used to guess liveness from study-CSV mtime). Three pieces:
   behind `stats`/SIGUSR1 and the `ATTRIB_serve.json` artifact) and
   fleet-wide attribution (the launcher+host telemetry streams of a
   cluster run joined into one clock-aligned, causally-ordered timeline).
+* **health** (`health/`) — the numerics flight recorder's host half:
+  online SPC (EWMA + MAD z-scores, Western-Electric sustained-run
+  rules) over the in-jit tensor-health stream (`engine/health.py`,
+  `--health`), `health_anomaly`/`health_cleared` events, the
+  early-warning rollback trigger (`--rollback-on-anomaly`) and the
+  bounded `health_blackbox.json` post-mortem ring.
 * **forensics** (`forensics.py`) — per-worker EWMA suspicion scores over
   the in-jit GAR diagnostics stream (`--gar-diagnostics`): selection-
   frequency deficit, distance z-score and NaN-quarantine history, with
@@ -89,7 +95,12 @@ from byzantinemomentum_tpu.obs.perf import (  # noqa: F401
     peak_flops,
 )
 from byzantinemomentum_tpu.obs import attrib  # noqa: F401
+from byzantinemomentum_tpu.obs import health  # noqa: F401
 from byzantinemomentum_tpu.obs import trace  # noqa: F401
+from byzantinemomentum_tpu.obs.health import (  # noqa: F401
+    HealthMonitor,
+    load_blackbox,
+)
 
 __all__ = [
     "TELEMETRY_NAME", "Telemetry", "activate", "active", "counter",
@@ -97,7 +108,8 @@ __all__ = [
     "HEARTBEAT_NAME", "HOSTS_DIRNAME", "host_heartbeat_path",
     "read_heartbeat", "read_host_heartbeats", "write_heartbeat",
     "write_host_heartbeat",
-    "SlidingRate", "StepTimer", "SuspicionTracker", "attrib", "trace",
+    "HealthMonitor", "SlidingRate", "StepTimer", "SuspicionTracker",
+    "attrib", "health", "load_blackbox", "trace",
     "flops_of_compiled", "host_rss_mb", "logical_flops", "mfu",
     "peak_flops",
 ]

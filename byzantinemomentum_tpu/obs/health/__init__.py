@@ -1,0 +1,26 @@
+"""The numerics flight recorder's host half.
+
+`engine/health.py` computes the per-step tensor-health vector inside the
+compiled step (`HEALTH_COLUMNS`); this package watches the streamed
+vectors on the host:
+
+* **monitor** (`monitor.py`) — `HealthMonitor`: online EWMA + MAD
+  z-scores per channel with Western-Electric-style sustained-run rules,
+  emitting `health_anomaly` / `health_cleared` events through the active
+  recorder, arming the early-warning rollback trigger
+  (`cli/attack.py --rollback-on-anomaly`), and keeping a bounded ring of
+  the last K full health vectors that is dumped as
+  `health_blackbox.json` on rollback, divergence give-up, SIGUSR1 or run
+  end — so every failed run leaves a post-mortem.
+
+Stdlib-only (the obs import discipline): no jax, no numpy — the monitor
+folds a handful of floats per step on the study-CSV flush path.
+"""
+
+from byzantinemomentum_tpu.obs.health.monitor import (  # noqa: F401
+    BLACKBOX_NAME,
+    HealthMonitor,
+    load_blackbox,
+)
+
+__all__ = ["BLACKBOX_NAME", "HealthMonitor", "load_blackbox"]

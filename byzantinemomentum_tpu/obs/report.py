@@ -26,7 +26,9 @@ __all__ = ["render_report", "main"]
 _TIMELINE_EVENTS = ("restart", "rollback", "divergence_giveup", "retry",
                     "checkpoint_invalid", "profiler_window", "attribution",
                     "run_start", "run_end", "suspect_worker",
-                    "suspect_cleared", "serve_trace_snapshot")
+                    "suspect_cleared", "serve_trace_snapshot",
+                    "health_anomaly", "health_cleared", "health_flag",
+                    "health_blackbox")
 
 
 def _fmt_seconds(seconds):
@@ -145,6 +147,13 @@ def render_report(run_dir):
         lines.extend(fleet_lines)
 
     if not records:
+        # A telemetry-less directory can still hold a flight recording
+        # (e.g. a --no-telemetry run's blackbox): render it standalone
+        from byzantinemomentum_tpu.obs.health import load_blackbox
+        blackbox = load_blackbox(run_dir)
+        if blackbox is not None:
+            lines.append(f"health: blackbox [{blackbox.get('reason')}] "
+                         f"ring x{len(blackbox.get('ring') or [])}")
         lines.append("telemetry: (no telemetry.jsonl)")
         return "\n".join(lines) + "\n"
     lines.append(f"telemetry: {len(records)} records")
@@ -205,6 +214,36 @@ def render_report(run_dir):
             parts.append(f"max suspicion {scores[worst]:.3g} "
                          f"(worker {worst})")
         lines.append("forensics: " + ", ".join(parts))
+
+    # Numerics flight recorder (obs/health): the run's anomaly story from
+    # the health_summary event + edge counts, and the blackbox dump's
+    # coordinates when one was written
+    health = None
+    health_edges = {"health_anomaly": 0, "health_cleared": 0}
+    for record in records:
+        if record.get("kind") != "event":
+            continue
+        if record.get("name") == "health_summary":
+            health = record.get("data") or {}
+        elif record.get("name") in health_edges:
+            health_edges[record["name"]] += 1
+    from byzantinemomentum_tpu.obs.health import load_blackbox
+    blackbox = load_blackbox(run_dir)
+    if health is not None or any(health_edges.values()) \
+            or blackbox is not None:
+        parts = [f"anomalies x{health_edges['health_anomaly']}",
+                 f"cleared x{health_edges['health_cleared']}"]
+        source = health or (blackbox or {}).get("summary") or {}
+        if source.get("var_ratio_ewma") is not None:
+            parts.append(f"var/norm EWMA {source['var_ratio_ewma']:.3g}")
+        last = source.get("last_anomaly")
+        if last:
+            parts.append(f"last anomaly {last.get('channel')}"
+                         f"@{last.get('step')} ({last.get('rule')})")
+        if blackbox is not None:
+            parts.append(f"blackbox [{blackbox.get('reason')}] "
+                         f"ring x{len(blackbox.get('ring') or [])}")
+        lines.append("health: " + ", ".join(parts))
 
     timeline = [r for r in records if r.get("kind") == "event"
                 and r.get("name") in _TIMELINE_EVENTS]

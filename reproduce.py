@@ -169,6 +169,10 @@ def submit_smoke(jobs):
         "nb-for-study": 9, "nb-for-study-past": 3, "nb-workers": 9,
         "batch-size-test": 32, "batch-size-test-reps": 2,
         "learning-rate": 0.5,
+        # Flight recorder on: the smoke grid exercises the health columns
+        # end to end and the analysis stage renders the variance-envelope
+        # and health-timeline plots off them
+        "health": True,
     }
     f = 2
     params = dict(base)
@@ -610,6 +614,26 @@ def analyze(data_dir, plot_dir):
                 plot.close()
             except Exception as err:
                 utils.warning(f"Unable to plot the forensics of "
+                              f"{path.name!r}: {err}")
+        # Flight-recorder plots (--health runs): the variance envelope —
+        # the paper's observable as a first-class timeline — and the
+        # norm/ratio health timeline with anomaly edges
+        for path in paths:
+            sess = _session(cache, path)
+            if sess is None or sess.data is None \
+                    or "Var ratio" not in sess.data.columns:
+                continue
+            try:
+                plot = study.variance_envelope(sess)
+                plot.save(plot_dir / f"variance-envelope-{path.name}.png",
+                          xsize=4, ysize=3)
+                plot.close()
+                plot = study.health_timeline(sess)
+                plot.save(plot_dir / f"health-timeline-{path.name}.png",
+                          xsize=4, ysize=3)
+                plot.close()
+            except Exception as err:
+                utils.warning(f"Unable to plot the health timeline of "
                               f"{path.name!r}: {err}")
         utils.info(f"Plots written to {plot_dir}")
 

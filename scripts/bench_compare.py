@@ -49,8 +49,8 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 __all__ = ["load_artifact", "compare", "compare_attribution",
-           "compare_cluster", "compare_serve", "compare_serve_attribution",
-           "main"]
+           "compare_cluster", "compare_health", "compare_serve",
+           "compare_serve_attribution", "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -285,6 +285,44 @@ def compare_serve_attribution(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+# The health-overhead fraction is an absolute few-percent figure; growth
+# below one percentage point is measurement noise on any host and never
+# fails the gate on its own
+_HEALTH_OVERHEAD_FLOOR = 0.01
+
+
+def compare_health(old_payload, new_payload, tolerance):
+    """The flight-recorder overhead gate over two `BENCH_health*.json`
+    artifacts (`scripts/health_overhead.py`): the paired on/off steps/s
+    rates regress by DROPPING past tolerance, and the overhead fraction
+    — the telemetry discipline's headline number — regresses by GROWING
+    past tolerance over a one-point absolute floor
+    (`_HEALTH_OVERHEAD_FLOOR`). Cross-backend pairs and `--smoke`
+    artifacts (3-pair CI form — harness proof, not a measurement) are
+    the caller's INCOMPARABLE case."""
+    rows = []
+    regressions = []
+    for key in ("steps_per_sec_off", "steps_per_sec_on"):
+        old, new = old_payload.get(key), new_payload.get(key)
+        if not (isinstance(old, (int, float)) and old > 0
+                and isinstance(new, (int, float))):
+            continue
+        delta = new / old - 1.0
+        rows.append((key, float(old), float(new), delta))
+        if delta < -tolerance:
+            regressions.append(rows[-1])
+    old = old_payload.get("overhead_frac")
+    new = new_payload.get("overhead_frac")
+    if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+        delta = (new / old - 1.0) if old > 0 else (0.0 if new <= old
+                                                   else float("inf"))
+        rows.append(("overhead_frac", float(old), float(new), delta))
+        if (new > old * (1.0 + tolerance)
+                and new - old > _HEALTH_OVERHEAD_FLOOR):
+            regressions.append(rows[-1])
+    return rows, regressions
+
+
 def compare_cluster(old_payload, new_payload, tolerance):
     """The multi-host gate over two `CLUSTER_r*.json` artifacts
     (`scripts/cluster_smoke.py`): cluster steps/s is a RATE (drop past
@@ -420,6 +458,40 @@ def main(argv=None):
                   f"{delta * 100:+7.2f}%{flag}")
         if regressions:
             print(f"bench_compare: {len(regressions)} serve metric(s) "
+                  f"regressed past the {args.tolerance * 100:.1f}% "
+                  f"tolerance")
+            return 1
+        return 0
+
+    is_health = [p.get("kind") == "health_overhead" for p in payloads]
+    if any(is_health):
+        # Flight-recorder overhead gate over two BENCH_health*.json
+        if not all(is_health):
+            print("bench_compare: INCOMPARABLE — one artifact is a "
+                  "health-overhead report, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — health runs from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        if any(p.get("smoke") for p in payloads):
+            print("bench_compare: INCOMPARABLE — a --smoke health "
+                  "artifact proves the harness, not the overhead")
+            return 0
+        rows, regressions = compare_health(old_payload, new_payload,
+                                           args.tolerance)
+        if not rows:
+            print("  no common health metrics; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.4f} -> {new:10.4f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} health metric(s) "
                   f"regressed past the {args.tolerance * 100:.1f}% "
                   f"tolerance")
             return 1

@@ -258,6 +258,42 @@ def collect_cluster(root, labels):
             if (stats := _cluster_stats(root, label)) is not None}
 
 
+# Flight-recorder trajectory column (`scripts/health_overhead.py`
+# artifacts): the paired on/off steps/s overhead of the in-jit health
+# vector — the telemetry discipline's number, per round
+HEALTH_COLUMNS = ("health ovh %",)
+
+
+def _health_stats(root, label):
+    """`{overhead_frac, backend} | None` for one round's health-overhead
+    artifact: `BENCH_health_r*.json` per round, the working tree's
+    `BENCH_health.json` for the `current` row. `--smoke` artifacts are
+    INCOMPARABLE (harness proof, not a measurement)."""
+    name = ("BENCH_health.json" if label == "current"
+            else f"BENCH_health_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "health_overhead" \
+            or payload.get("smoke"):
+        return None
+    overhead = payload.get("overhead_frac")
+    if not isinstance(overhead, (int, float)):
+        return None
+    return {"overhead_frac": float(overhead),
+            "backend": payload.get("backend")}
+
+
+def collect_health(root, labels):
+    """{label: health-overhead stats} over the history rows (independent
+    instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _health_stats(root, label)) is not None}
+
+
 def collect_history(root=ROOT):
     """[(label, rates | None, reason | None, gar)] over every round
     artifact (sorted by round number) plus the working tree's
@@ -285,7 +321,9 @@ def collect_history(root=ROOT):
                            r"ATTRIB_serve_r(\d+)\.json$"),
                           ("TOURNAMENT_r*.json",
                            r"TOURNAMENT_r(\d+)\.json$"),
-                          ("CLUSTER_r*.json", r"CLUSTER_r(\d+)\.json$")):
+                          ("CLUSTER_r*.json", r"CLUSTER_r(\d+)\.json$"),
+                          ("BENCH_health_r*.json",
+                           r"BENCH_health_r(\d+)\.json$")):
         for path in root.glob(glob):
             m = re.search(pattern, path.name)
             if m:
@@ -297,7 +335,8 @@ def collect_history(root=ROOT):
             or (root / "BENCH_serve.json").is_file()
             or (root / "ATTRIB_serve.json").is_file()
             or (root / "TOURNAMENT.json").is_file()
-            or (root / "CLUSTER.json").is_file()):
+            or (root / "CLUSTER.json").is_file()
+            or (root / "BENCH_health.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
     for label, path in zip(labels, paths):
@@ -327,7 +366,7 @@ def _load_rates(path):
 
 
 def render_table(history, serve=None, tournament=None, cluster=None,
-                 serve_attrib=None):
+                 serve_attrib=None, health=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
@@ -340,6 +379,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     tournament = tournament or {}
     cluster = cluster or {}
     serve_attrib = serve_attrib or {}
+    health = health or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
@@ -347,7 +387,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 columns.append(name)
     any_gar = any(gar is not None for _, _, _, gar in history)
     if not columns and not any_gar and not serve and not tournament \
-            and not cluster and not serve_attrib:
+            and not cluster and not serve_attrib and not health:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -362,6 +402,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         columns = columns + list(TOURNAMENT_COLUMNS)
     if cluster:
         columns = columns + list(CLUSTER_COLUMNS)
+    if health:
+        columns = columns + list(HEALTH_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -390,6 +432,11 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                          f"report")
         row_tournament = tournament.get(label)
         row_cluster = cluster.get(label)
+        row_health = health.get(label)
+        if row_health is not None and row_health.get("backend") not in (
+                None, "tpu"):
+            notes.append(f"  {label}: health overhead from a "
+                         f"backend={row_health['backend']} measurement")
         if row_cluster is not None and row_cluster.get("backend") not in (
                 None, "native"):
             # Cluster steps/s from the CPU-simulated fleet: comparable to
@@ -437,6 +484,10 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 if key == "rate":
                     return f"{value:>{w}.3f}"
                 return f"{int(value):>{w}d}"
+            if c in HEALTH_COLUMNS:
+                if row_health is None:
+                    return f"{'-':>{w}}"
+                return f"{row_health['overhead_frac'] * 100:>{w}.2f}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -474,6 +525,8 @@ def main(argv=None):
                                     [label for label, *_ in history])
     cluster = collect_cluster(pathlib.Path(args.root),
                               [label for label, *_ in history])
+    health = collect_health(pathlib.Path(args.root),
+                            [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
@@ -482,10 +535,12 @@ def main(argv=None):
              "serve": serve.get(label),
              "serve_attrib": serve_attrib.get(label),
              "tournament": tournament.get(label),
-             "cluster": cluster.get(label)}
+             "cluster": cluster.get(label),
+             "health": health.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
-    print(render_table(history, serve, tournament, cluster, serve_attrib))
+    print(render_table(history, serve, tournament, cluster, serve_attrib,
+                       health))
     return 0
 
 

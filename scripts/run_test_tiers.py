@@ -87,19 +87,30 @@ def main():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     obs_selfcheck = {"returncode": selfcheck.returncode}
     attribution = None
+    health = None
     for line in selfcheck.stdout.splitlines():
         if line.startswith("attribution: "):
             try:
                 attribution = json.loads(line[len("attribution: "):])
             except ValueError:
                 pass  # a torn artifact line is a selfcheck bug, not ours
+        elif line.startswith("health: "):
+            # The flight-recorder phase (PR 15): detection lags for the
+            # planted NaN burst / variance collapse, clean-stream false
+            # positives (must be 0), blackbox ring bound
+            try:
+                health = json.loads(line[len("health: "):])
+            except ValueError:
+                pass
     if attribution is not None:
         obs_selfcheck["attribution"] = attribution
+    if health is not None:
+        obs_selfcheck["health"] = health
     if selfcheck.returncode != 0:
         obs_selfcheck["tail"] = (selfcheck.stdout
                                  + selfcheck.stderr).splitlines()[-12:]
     telemetry.event("obs_selfcheck", returncode=selfcheck.returncode,
-                    attribution=attribution)
+                    attribution=attribution, health=health)
     print(f"  {obs_selfcheck}", flush=True)
 
     # Bench-regression tooling smoke: the comparator must run over the

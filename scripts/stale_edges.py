@@ -1,0 +1,204 @@
+#!/usr/bin/env python3
+"""Summarize stale→dead / stale→alive edge durations from recorded fleet
+timelines and recommend a bounded-wait window for the straggler-host
+policy.
+
+A cluster launcher (`byzantinemomentum_tpu/cluster/launcher.py`) emits a
+`liveness_transition` event every time a host's status edge flips
+(alive/stale/dead/unknown — `obs/trace/fleet.py` joins them into the
+fleet timeline). The ROADMAP's straggler-host rung needs a data-driven
+answer to ONE question before a policy can exist: when a host goes
+stale, how long is it worth waiting before treating it as dead? Wait too
+little and every GC pause / slow poll kills a healthy host (a fleet
+teardown + restart each time); wait too long and a genuinely dead host
+stalls recovery by exactly the window.
+
+This script measures both sides from recorded runs: each host's stale
+episodes are extracted from the transition stream, split by how they
+resolved (back to `alive` — a straggler that recovered — vs `dead`), and
+the recommended window is the 95th percentile of the observed recovery
+durations with a 1.25x safety margin — long enough to cover ~95% of
+recoveries, with the expected cost per actually-dead host (the window
+itself) reported next to it so the trade is explicit. Episodes still
+open when the stream ends are counted as censored, never guessed.
+
+Usage:
+  python scripts/stale_edges.py RUN_DIR [RUN_DIR ...] [--json]
+
+Each RUN_DIR is a cluster run's result directory (its `telemetry.jsonl`
+holds the launcher stream); a direct path to a telemetry .jsonl file
+works too. Prints a human summary plus one parseable
+`stale-edges: {...}` line.
+"""
+
+import argparse
+import json
+import math
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from byzantinemomentum_tpu.obs.recorder import load_records  # noqa: E402
+
+__all__ = ["stale_episodes", "summarize", "recommend_window", "main"]
+
+# Safety margin over the observed recovery tail: clocks jitter, polls
+# quantize, and the recorded runs undersample the tail
+MARGIN = 1.25
+
+
+def stale_episodes(records):
+    """Split a launcher telemetry stream into per-host stale episodes.
+
+    Returns `{"recovered": [durations], "died": [durations],
+    "censored": int}` — durations in seconds from the host's `-> stale`
+    edge to the edge that resolved it (`-> alive` = recovered,
+    `-> dead` = died; a `-> unknown` edge or end-of-stream censors the
+    episode).
+    """
+    open_since = {}   # host -> t of the -> stale edge
+    recovered, died = [], []
+    censored = 0
+    for record in records:
+        if record.get("kind") != "event" \
+                or record.get("name") != "liveness_transition":
+            continue
+        data = record.get("data") or {}
+        host, to = data.get("host"), data.get("to")
+        t = record.get("t")
+        if host is None or t is None:
+            continue
+        started = open_since.pop(host, None)
+        if to == "stale":
+            open_since[host] = float(t)
+            continue
+        if started is None:
+            continue
+        duration = max(0.0, float(t) - started)
+        if to == "alive":
+            recovered.append(duration)
+        elif to == "dead":
+            died.append(duration)
+        else:
+            censored += 1  # -> unknown: the signal vanished, not resolved
+    censored += len(open_since)
+    return {"recovered": sorted(recovered), "died": sorted(died),
+            "censored": censored}
+
+
+def _percentile(values, q):
+    """Nearest-rank percentile of a sorted list (None when empty)."""
+    if not values:
+        return None
+    rank = max(1, math.ceil(q * len(values)))
+    return values[rank - 1]
+
+
+def _stats(values):
+    if not values:
+        return None
+    return {"count": len(values),
+            "min_s": round(values[0], 3),
+            "median_s": round(_percentile(values, 0.5), 3),
+            "p95_s": round(_percentile(values, 0.95), 3),
+            "max_s": round(values[-1], 3)}
+
+
+def recommend_window(episodes):
+    """The bounded-wait recommendation from measured episodes.
+
+    `p95(recovered) * MARGIN` when recoveries were observed — the window
+    that covers ~95% of observed stragglers; with only deaths on record
+    there is nothing worth waiting for, so half the fastest observed
+    death keeps the wait strictly below every measured failure. None
+    when the stream carries no resolved episodes at all.
+    """
+    recovered = episodes["recovered"]
+    died = episodes["died"]
+    if recovered:
+        return round(_percentile(recovered, 0.95) * MARGIN, 3)
+    if died:
+        return round(died[0] / 2.0, 3)
+    return None
+
+
+def summarize(run_dirs):
+    """The aggregate summary over one or more run directories (or direct
+    telemetry file paths)."""
+    merged = {"recovered": [], "died": [], "censored": 0}
+    runs = 0
+    for run in run_dirs:
+        records = load_records(pathlib.Path(run))
+        if not records:
+            continue
+        runs += 1
+        episodes = stale_episodes(records)
+        merged["recovered"].extend(episodes["recovered"])
+        merged["died"].extend(episodes["died"])
+        merged["censored"] += episodes["censored"]
+    merged["recovered"].sort()
+    merged["died"].sort()
+    window = recommend_window(merged)
+    return {
+        "kind": "stale_edges",
+        "runs": runs,
+        "stale_to_alive": _stats(merged["recovered"]),
+        "stale_to_dead": _stats(merged["died"]),
+        "censored": merged["censored"],
+        "recommended_wait_s": window,
+        # The explicit trade: a dead host costs the whole window before
+        # recovery starts; a recovery inside the window costs nothing
+        "wait_cost_per_dead_host_s": window,
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="stale_edges",
+        description="Summarize stale->dead / stale->alive edge durations "
+                    "from recorded fleet timelines and print a "
+                    "recommended bounded-wait window")
+    parser.add_argument("runs", nargs="+",
+                        help="cluster run directories (or telemetry "
+                             ".jsonl files) holding launcher "
+                             "liveness_transition events")
+    parser.add_argument("--json", action="store_true",
+                        help="print only the JSON summary line")
+    args = parser.parse_args(argv)
+
+    summary = summarize(args.runs)
+    line = "stale-edges: " + json.dumps(summary, sort_keys=True)
+    if args.json:
+        print(line)
+        return 0 if summary["runs"] else 1
+    if not summary["runs"]:
+        print("stale_edges: no telemetry records found under the given "
+              "paths")
+        return 1
+    print(f"stale edges over {summary['runs']} run(s):")
+    for label, key in (("stale -> alive (recovered)", "stale_to_alive"),
+                       ("stale -> dead  (died)", "stale_to_dead")):
+        stats = summary[key]
+        if stats is None:
+            print(f"  {label:<28} (none observed)")
+            continue
+        print(f"  {label:<28} x{stats['count']}  min {stats['min_s']}s  "
+              f"median {stats['median_s']}s  p95 {stats['p95_s']}s  "
+              f"max {stats['max_s']}s")
+    if summary["censored"]:
+        print(f"  censored episodes            x{summary['censored']} "
+              f"(unresolved at end of stream)")
+    if summary["recommended_wait_s"] is None:
+        print("  no resolved episodes; no recommendation")
+    else:
+        print(f"  recommended bounded wait: {summary['recommended_wait_s']}s"
+              f" (p95 of recoveries x{MARGIN}; a dead host costs the "
+              f"window before recovery starts)")
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -566,6 +566,89 @@ def suspicion_timeline(session):
     return plot
 
 
+def health_timeline(run):
+    """One --health run's flight-recorder timeline: the weight and update
+    norms (left axis, the blow-up channels) against the update-to-weight
+    ratio (right axis), with the run's `health_anomaly`/`health_cleared`
+    telemetry events marked as vertical lines and the per-phase
+    non-finite total noted in the title."""
+    session = run
+    data = _as_frame(run)
+    missing = [c for c in ("Weight norm", "Update/weight")
+               if c not in data.columns]
+    if missing:
+        raise utils.UserException(
+            f"No health columns {missing} in the study data; the run must "
+            f"be recorded with --health")
+    sub = data.dropna(subset=["Weight norm"])
+    plot = LinePlot()
+    plot.include(sub, "Weight norm", axkey="norm")
+    if "Update norm" in sub.columns:
+        plot.include(sub, "Update norm", axkey="norm")
+    plot.include(sub, "Update/weight", axkey="ratio")
+    try:
+        frame = load_telemetry(session)
+    except utils.UserException:
+        frame = None
+    if frame is not None:
+        events = frame[frame["kind"] == "event"]
+        for name, color in (("health_anomaly", "red"),
+                            ("health_cleared", "green"),
+                            ("health_flag", "black")):
+            for _, event in events[events["name"] == name].iterrows():
+                data_ = event.get("data")
+                step = data_.get("step") if isinstance(data_, dict) else None
+                if step is not None:
+                    plot.vline(step, color=color, label=name)
+    nonfinite = 0
+    for column in ("Nonfinite submitted", "Nonfinite aggregate",
+                   "Nonfinite state"):
+        if column in data.columns:
+            series = data[column].dropna()
+            if len(series):
+                nonfinite += int(series.sum())
+    suffix = f" ({nonfinite} non-finite entries)" if nonfinite else ""
+    plot.finalize("Health timeline" + suffix, "Step number", "L2 norm",
+                  zlabel="Update/weight")
+    return plot
+
+
+def variance_envelope(run):
+    """The paper's observable as a first-class plot: one --health run's
+    Var ratio (the variance-to-norm ratio of the honest submissions) over
+    steps, with anomaly edges marked — ALIE-style attacks live or die by
+    whether they stay inside this envelope, and the SPC monitor's events
+    show when the stream left its own history."""
+    data = _as_frame(run)
+    if "Var ratio" not in data.columns:
+        raise utils.UserException(
+            "No 'Var ratio' column in the study data; the run must be "
+            "recorded with --health")
+    sub = data.dropna(subset=["Var ratio"])
+    plot = LinePlot()
+    plot.include(sub, "Var ratio")
+    try:
+        frame = load_telemetry(run)
+    except utils.UserException:
+        frame = None
+    if frame is not None:
+        events = frame[frame["kind"] == "event"]
+        for name, color in (("health_anomaly", "red"),
+                            ("health_cleared", "green")):
+            sel = events[events["name"] == name]
+            for _, event in sel.iterrows():
+                data_ = event.get("data")
+                if not isinstance(data_, dict):
+                    continue
+                if data_.get("channel") not in (None, "var_ratio"):
+                    continue
+                step = data_.get("step")
+                if step is not None:
+                    plot.vline(step, color=color, label=name)
+    plot.finalize("Variance envelope", "Step number", "Var ratio")
+    return plot
+
+
 def load_tournament(path):
     """Parse one tournament scoreboard artifact
     (`scripts/tournament.py` -> `TOURNAMENT_r*.json`)."""

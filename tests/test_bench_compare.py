@@ -758,3 +758,94 @@ def test_bench_history_serve_phase_columns(tmp_path, capsys):
     by_round = {row["round"]: row for row in payload}
     assert by_round["r02"]["serve_attrib"]["queue"] == 1.0
     assert by_round["r01"]["serve_attrib"] is None
+
+
+# --------------------------------------------------------------------------- #
+# Flight-recorder overhead gate (`BENCH_health*.json`, PR 15)
+
+def _health_artifact(tmp_path, name, overhead, off=22.0, backend="cpu",
+                     smoke=False):
+    payload = {"kind": "health_overhead", "backend": backend,
+               "steps_per_sec_off": off,
+               "steps_per_sec_on": off * (1.0 - overhead),
+               "overhead_frac": overhead,
+               "overhead_ok": overhead <= 0.03}
+    if smoke:
+        payload["smoke"] = True
+    path = tmp_path / name
+    path.write_text(json.dumps(payload))
+    return path
+
+
+def test_health_gate_within_tolerance_passes(tmp_path, capsys):
+    old = _health_artifact(tmp_path, "BENCH_health_r15.json", 0.015)
+    new = _health_artifact(tmp_path, "BENCH_health_r16.json", 0.018)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "overhead_frac" in out and "REGRESSED" not in out
+
+
+def test_health_gate_overhead_growth_fails(tmp_path, capsys):
+    old = _health_artifact(tmp_path, "BENCH_health_r15.json", 0.015)
+    new = _health_artifact(tmp_path, "BENCH_health_r16.json", 0.045)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "overhead_frac" in out and "REGRESSED" in out
+
+
+def test_health_gate_sub_floor_growth_is_noise(tmp_path, capsys):
+    # +0.4 points of overhead is under the 1-point absolute floor: noise
+    old = _health_artifact(tmp_path, "BENCH_health_r15.json", 0.010)
+    new = _health_artifact(tmp_path, "BENCH_health_r16.json", 0.014)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    assert rc == 0
+    assert "REGRESSED" not in capsys.readouterr().out
+
+
+def test_health_gate_rate_drop_fails(tmp_path, capsys):
+    old = _health_artifact(tmp_path, "BENCH_health_r15.json", 0.015,
+                           off=22.0)
+    new = _health_artifact(tmp_path, "BENCH_health_r16.json", 0.015,
+                           off=18.0)
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    assert rc == 1
+    assert "steps_per_sec" in capsys.readouterr().out
+
+
+def test_health_gate_incomparable_pairs(tmp_path, capsys):
+    ok = _health_artifact(tmp_path, "BENCH_health_r15.json", 0.015)
+    other = _health_artifact(tmp_path, "BENCH_health_tpu.json", 0.002,
+                             backend="tpu")
+    assert bench_compare.main([str(ok), str(other)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+    smoke = _health_artifact(tmp_path, "BENCH_health_smoke.json", 0.2,
+                             smoke=True)
+    assert bench_compare.main([str(ok), str(smoke)]) == 0
+    assert "smoke" in capsys.readouterr().out
+    bench = _artifact(tmp_path, "BENCH_r09.json", 10.0)
+    assert bench_compare.main([str(ok), str(bench)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+
+
+def test_bench_history_health_column(tmp_path, capsys):
+    """The health-overhead column renders from committed
+    BENCH_health_r*.json artifacts; a health-only round still gets a
+    row, smoke artifacts are skipped, and --json carries the dict."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _health_artifact(tmp_path, "BENCH_health_r02.json", 0.0151)
+    _health_artifact(tmp_path, "BENCH_health_r03.json", 0.2, smoke=True)
+
+    stats = bench_history.collect_health(tmp_path, ["r01", "r02", "r03"])
+    assert "r01" not in stats and "r03" not in stats
+    assert stats["r02"]["overhead_frac"] == 0.0151
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "health ovh %" in out
+    r02 = [line for line in out.splitlines() if line.startswith("r02")][0]
+    assert r02.split()[-1] == "1.51"
+    assert "backend=cpu measurement" in out

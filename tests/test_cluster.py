@@ -145,6 +145,24 @@ def test_liveness_view_staleness(tmp_path):
     assert view["alive"] == []
 
 
+def test_liveness_view_carries_health_block(tmp_path):
+    """A host heartbeat's flight-recorder `health` block (obs/health,
+    PR 15) rides the liveness view, so the fleet exposes training-
+    dynamics state next to liveness — hosts without one simply have no
+    key."""
+    health = {"steps": 7, "anomaly": True, "anomalies_total": 1,
+              "last_anomaly": {"channel": "var_ratio", "step": 6,
+                               "rule": "spike"},
+              "var_ratio_ewma": 0.42}
+    write_host_heartbeat(tmp_path, 0, {"step": 7, "health": health})
+    write_host_heartbeat(tmp_path, 1, {"step": 7})
+    view = liveness_view(tmp_path, 2, running={0: True, 1: True},
+                         now=time.time())
+    assert view["hosts"][0]["health"]["anomaly"] is True
+    assert view["hosts"][0]["health"]["var_ratio_ewma"] == 0.42
+    assert "health" not in view["hosts"][1]
+
+
 # --------------------------------------------------------------------------- #
 # System-scope fault plans
 

@@ -1,0 +1,409 @@
+"""Numerics flight recorder (PR 15): in-jit health stats, the SPC
+monitor, and the early-warning rollback trigger.
+
+Covers the ISSUE 15 acceptance surface: the sharded-vs-unsharded
+health-BUCKET bit-exactness oracle (mesh2, width-aware masks, f in
+{1, 2, 3}, planted NaN rows), monitor unit behavior (warm-up,
+hysteresis, blackbox ring bounding), the zero-recompile budget with
+health ON, and the e2e anomaly -> rollback story under empire at
+momentum-at-worker — including the headline claim: on a planted gradual
+divergence the SPC anomaly fires at least 2 steps BEFORE the isfinite
+flag, and `--rollback-on-anomaly` rolls back (and, budget spent, gives
+up) without the state ever going non-finite.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import attacks, losses, models, obs, ops
+from byzantinemomentum_tpu.engine import (EngineConfig, HEALTH_COLUMNS,
+                                          build_engine)
+from byzantinemomentum_tpu.engine import health
+from byzantinemomentum_tpu.obs.health import HealthMonitor, load_blackbox
+from byzantinemomentum_tpu.parallel import make_mesh
+
+DRIVER_BASE = ["--batch-size", "8", "--batch-size-test", "32",
+               "--batch-size-test-reps", "2", "--evaluation-delta", "0",
+               "--model", "simples-full", "--seed", "11",
+               "--nb-for-study", "11", "--nb-for-study-past", "2"]
+
+
+@pytest.fixture(autouse=True)
+def small_synth(monkeypatch):
+    monkeypatch.setenv("BMT_SYNTH_TRAIN", "512")
+    monkeypatch.setenv("BMT_SYNTH_TEST", "128")
+
+
+def _vector(var=0.5, upd=1e-3, weight=6.0, nonfinite=0):
+    return {"var_ratio": var, "update_ratio": upd, "weight_norm": weight,
+            "nonfinite": nonfinite, "norm_hist": [0.0] * health.HIST_BINS}
+
+
+# --------------------------------------------------------------------------- #
+# In-jit stats
+
+
+def test_norm_histogram_routing():
+    """Exact zeros -> underflow bin, non-finite -> overflow bin, finite
+    norms -> their log2 bucket; counts always sum to the row count."""
+    norms = jnp.asarray([0.0, 2.0 ** health.HIST_LO, 1.0, 2.0 ** 19,
+                         np.inf, np.nan], jnp.float32)
+    hist = np.asarray(health.norm_histogram(norms))
+    assert hist.sum() == len(norms)
+    assert hist[0] == 2.0               # the exact zero + the underflow edge
+    assert hist[-1] == 3.0              # inf + nan + the 2^19 overflow bucket
+    mid = (0 - health.HIST_LO) // health.HIST_WIDTH
+    assert hist[mid] == 1.0             # norm 1.0 -> log2 0
+
+
+def test_health_metrics_values_and_nonfinite():
+    rng = np.random.default_rng(0)
+    d = 64
+    Gh = rng.normal(size=(6, d)).astype(np.float32)
+    Ga = rng.normal(size=(2, d)).astype(np.float32)
+    Ga[0] = np.nan
+    gd = rng.normal(size=(d,)).astype(np.float32)
+    t0 = rng.normal(size=(d,)).astype(np.float32)
+    t1 = t0 - 0.1 * gd
+    out = health.health_metrics(*map(jnp.asarray, (Gh, Ga, gd, t0, t1)))
+    assert set(out) == set(HEALTH_COLUMNS)
+    assert float(out["Nonfinite submitted"]) == 1.0
+    assert float(out["Nonfinite aggregate"]) == 0.0
+    assert float(out["Nonfinite state"]) == 0.0
+    np.testing.assert_allclose(float(out["Weight norm"]),
+                               np.linalg.norm(t1), rtol=1e-5)
+    np.testing.assert_allclose(float(out["Update norm"]),
+                               np.linalg.norm(t0 - t1), rtol=1e-5)
+    # Var ratio == the forensic Var/norm ratio definition
+    from byzantinemomentum_tpu.ops import diag
+    np.testing.assert_allclose(float(out["Var ratio"]),
+                               float(diag.var_norm_ratio(jnp.asarray(Gh))),
+                               rtol=1e-5)
+    hist = np.asarray(out["Norm hist"])
+    assert hist.sum() == 8 and hist[-1] >= 1.0  # the NaN row in overflow
+
+
+@pytest.mark.parametrize("f", [1, 2, 3])
+def test_sharded_health_buckets_bit_identical(f):
+    """The d-sharded health stats (mesh2, width-aware real-column masks,
+    non-dividing d so the facade pads a zero column) reproduce the
+    single-device BUCKET counts and non-finite counts BIT-exactly with f
+    planted NaN rows; the continuous scalars match to psum-vs-full-width
+    reduction rounding."""
+    mesh = make_mesh(2, model_parallel=2)
+    n, d = 4 * f + 4, 67  # 67 % 2 != 0: one divisibility-padding column
+    rng = np.random.default_rng(10 * f)
+    G = (rng.normal(size=(n, d)) * rng.uniform(1e-3, 1e3)).astype(np.float32)
+    G[-f:] = np.nan
+    Gh, Ga = map(jnp.asarray, (G[: n - f], G[n - f:]))
+    gd = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    t0 = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    t1 = t0 - 0.05 * gd
+    u = health.health_metrics(Gh, Ga, gd, t0, t1)
+    s = health.sharded_health_metrics(mesh)(Gh, Ga, gd, t0, t1)
+    assert np.array_equal(np.asarray(u["Norm hist"]),
+                          np.asarray(s["Norm hist"]))
+    for key in ("Nonfinite submitted", "Nonfinite aggregate",
+                "Nonfinite state"):
+        assert float(u[key]) == float(s[key]), key
+    assert float(s["Nonfinite submitted"]) == float(f)
+    for key in ("Var ratio", "Weight norm", "Update norm", "Update/weight"):
+        np.testing.assert_allclose(float(u[key]), float(s[key]),
+                                   rtol=1e-5, err_msg=key)
+
+
+def _smoke_engine(health_on, **overrides):
+    cfg = EngineConfig(nb_workers=7, nb_decl_byz=2, nb_real_byz=2,
+                       nb_for_study=7, nb_for_study_past=2, momentum=0.9,
+                       momentum_at="worker", health=health_on, **overrides)
+    engine = build_engine(
+        cfg=cfg, model_def=models.build("simples-full"),
+        loss=losses.Loss("nll"), criterion=losses.Criterion("top-k"),
+        defenses=[(ops.gars["krum"], 1.0, {})],
+        attack=attacks.attacks["empire"], attack_kwargs={"factor": 1.1})
+    return engine, engine.init(jax.random.PRNGKey(0))
+
+
+def test_engine_health_columns_ride_the_metrics():
+    engine, state = _smoke_engine(True)
+    S, B = engine.cfg.nb_sampled, 4
+    xs = jnp.zeros((S, B, 28, 28, 1), jnp.float32)
+    ys = jnp.zeros((S, B), jnp.int32)
+    state, metrics = engine.train_step(state, xs, ys, jnp.float32(0.05))
+    for column in HEALTH_COLUMNS:
+        assert column in metrics, column
+    assert float(np.asarray(metrics["Norm hist"]).sum()) == engine.cfg.nb_workers
+
+    engine_off, state_off = _smoke_engine(False)
+    state_off, metrics_off = engine_off.train_step(
+        state_off, xs, ys, jnp.float32(0.05))
+    assert not any(c in metrics_off for c in HEALTH_COLUMNS)
+
+
+def test_engine_health_zero_recompiles_warm_loop():
+    """Health ON keeps the engine's zero-recompile budget: the health
+    vector is extra outputs of the SAME compiled step, never a retrace."""
+    from byzantinemomentum_tpu.analysis.contracts import (
+        assert_recompile_budget)
+
+    engine, state = _smoke_engine(True)
+    S, B = engine.cfg.nb_sampled, 4
+    rng = np.random.default_rng(1)
+
+    def step(state):
+        xs = jnp.asarray(rng.normal(size=(S, B, 28, 28, 1))
+                         .astype(np.float32))
+        ys = jnp.asarray(rng.integers(0, 10, size=(S, B)).astype(np.int32))
+        return engine.train_step(state, xs, ys, jnp.float32(0.05))
+
+    state, _ = step(state)  # warm-up compile outside the budget window
+    holder = [state]
+
+    def warm():
+        holder[0], metrics = step(holder[0])
+        return metrics
+
+    assert_recompile_budget(warm, steps=3, budget=0,
+                            label="health-on warm loop")
+
+
+# --------------------------------------------------------------------------- #
+# Monitor units
+
+
+def test_monitor_warmup_gates_statistical_rules():
+    mon = HealthMonitor(warmup=50)
+    # A wild stream inside warm-up must not fire the statistical rules
+    for step in range(40):
+        mon.update(step, _vector(var=0.5 * (10.0 ** (step % 3))))
+    assert mon.anomalies_total == 0
+
+
+def test_monitor_nonfinite_rule_is_warmup_exempt():
+    mon = HealthMonitor(warmup=50)
+    mon.update(0, _vector())
+    assert mon.update(1, _vector(nonfinite=2))
+    assert mon.anomaly and mon.last_anomaly["channel"] == "nonfinite"
+
+
+def test_monitor_hysteresis_clears_after_clean_run():
+    mon = HealthMonitor(warmup=10, clear_after=5)
+    for step in range(30):
+        mon.update(step, _vector())
+    # Spike episode, then a clean stream: the channel must clear only
+    # after `clear_after` consecutive in-control observations
+    assert mon.update(30, _vector(var=5e4))
+    cleared_at = None
+    for step in range(31, 50):
+        active = mon.update(step, _vector())
+        if not active and cleared_at is None:
+            cleared_at = step
+    assert cleared_at is not None and cleared_at - 30 >= 5
+    assert any(e["kind"] == "health_cleared" for e in mon.blackbox("t")["edges"])
+
+
+def test_monitor_baseline_freezes_while_anomalous():
+    """The envelope must not adapt to the failure it is flagging: a
+    sustained 1000x collapse stays anomalous (a live EWMA would absorb
+    it and self-clear)."""
+    mon = HealthMonitor(warmup=10, clear_after=5)
+    for step in range(30):
+        mon.update(step, _vector())
+    for step in range(30, 80):
+        mon.update(step, _vector(var=5e-4))
+    assert mon.anomaly
+
+
+def test_monitor_rollback_pending_consume_once():
+    mon = HealthMonitor(warmup=5)
+    for step in range(20):
+        mon.update(step, _vector())
+    mon.update(20, _vector(var=1e5))
+    assert mon.rollback_pending()
+    assert not mon.rollback_pending()  # consumed: one rollback per episode
+    mon.note_rollback()
+    assert not mon.anomaly
+
+
+def test_monitor_blackbox_ring_bounded_and_dump(tmp_path):
+    mon = HealthMonitor(ring=16)
+    for step in range(100):
+        mon.update(step, _vector())
+    box = mon.blackbox("test")
+    assert len(box["ring"]) == 16
+    assert box["ring"][-1]["step"] == 99
+    path = mon.dump_blackbox(tmp_path, "test")
+    assert path is not None
+    loaded = load_blackbox(tmp_path)
+    assert loaded["reason"] == "test" and len(loaded["ring"]) == 16
+    json.dumps(loaded)  # JSON-safe end to end
+
+
+def test_monitor_validation():
+    with pytest.raises(ValueError, match="alpha"):
+        HealthMonitor(alpha=0.0)
+    with pytest.raises(ValueError, match="warmup"):
+        HealthMonitor(warmup=0)
+    with pytest.raises(ValueError, match="ring"):
+        HealthMonitor(ring=0)
+    with pytest.raises(ValueError, match="z_clear"):
+        HealthMonitor(z_clear=5.0, z_run4=2.0)
+
+
+def test_monitor_nonfinite_channel_value_never_folds():
+    """A NaN channel VALUE (e.g. Var ratio after gradients vanished) must
+    not poison the baseline; the non-finite COUNT rule covers the hard
+    case."""
+    mon = HealthMonitor(warmup=5)
+    for step in range(20):
+        mon.update(step, _vector())
+    before = mon.summary()["channels"]["var_ratio"]["mean_log10"]
+    mon.update(20, _vector(var=float("nan")))
+    after = mon.summary()["channels"]["var_ratio"]["mean_log10"]
+    assert before == after
+
+
+# --------------------------------------------------------------------------- #
+# Driver e2e: empire at momentum-at-worker, early-warning acceptance
+
+
+def _ew_args(resdir, extra):
+    return DRIVER_BASE + [
+        "--gar", "krum", "--nb-real-byz", "2", "--attack", "empire",
+        "--attack-args", "factor:1.1", "--momentum-at", "worker",
+        "--nb-steps", "48", "--checkpoint-delta", "5",
+        "--steps-per-program", "1", "--rollback-budget", "1",
+        "--result-directory", str(resdir)] + extra
+
+
+def test_driver_anomaly_leads_isfinite_flag(tmp_path, monkeypatch):
+    """The acceptance headline: on a planted gradual divergence
+    (BMT_CHAOS_BLOWUP) under empire at momentum-at-worker, the SPC
+    anomaly fires >= 2 steps before the isfinite flag, the blackbox is
+    written, and obs_report renders the health line."""
+    from byzantinemomentum_tpu.cli.attack import main
+    from byzantinemomentum_tpu.obs.report import render_report
+
+    monkeypatch.setenv("BMT_CHAOS_BLOWUP_AT_STEP", "36")
+    monkeypatch.setenv("BMT_CHAOS_BLOWUP_FACTOR", "1e6")
+    resdir = tmp_path / "lead"
+    rc = main(_ew_args(resdir, ["--health"]))
+    assert rc == 1  # budget 1, the blow-up repeats: divergence give-up
+    records = obs.load_records(resdir)
+    anomalies = [r for r in records if r["name"] == "health_anomaly"]
+    flags = [r for r in records if r["name"] == "health_flag"
+             and r["data"]["trigger"] == "non-finite"]
+    assert anomalies and flags
+    lead = (min(r["data"]["step"] for r in flags)
+            - min(r["data"]["step"] for r in anomalies))
+    assert lead >= 2, f"anomaly must lead the isfinite flag, lead={lead}"
+    box = load_blackbox(resdir)
+    assert box is not None and box["reason"] == "divergence_giveup"
+    assert box["ring"] and box["edges"]
+    report = render_report(resdir)
+    assert "health:" in report and "blackbox" in report
+
+
+def test_driver_rollback_on_anomaly_fires_before_nonfinite(tmp_path,
+                                                           monkeypatch):
+    """--rollback-on-anomaly upgrades the trigger: the rollback (and the
+    eventual budget-spent give-up) happens on the ANOMALY edge — the
+    state never reaches the non-finite flag."""
+    from byzantinemomentum_tpu.cli.attack import main
+
+    monkeypatch.setenv("BMT_CHAOS_BLOWUP_AT_STEP", "36")
+    monkeypatch.setenv("BMT_CHAOS_BLOWUP_FACTOR", "1e6")
+    resdir = tmp_path / "anomaly"
+    rc = main(_ew_args(resdir, ["--rollback-on-anomaly"]))
+    assert rc == 1
+    records = obs.load_records(resdir)
+    rollbacks = [r for r in records if r["name"] == "rollback"]
+    assert rollbacks and rollbacks[0]["data"]["trigger"] == "anomaly"
+    flags = [r["data"]["trigger"] for r in records
+             if r["name"] == "health_flag"]
+    assert flags and all(t == "anomaly" for t in flags)
+    assert any(r["name"] == "divergence_giveup" for r in records)
+    heartbeat = obs.read_heartbeat(resdir)
+    assert "health" in heartbeat
+    assert heartbeat["health"]["anomalies_total"] >= 1
+
+
+def test_driver_clean_run_health_columns_no_false_positives(tmp_path):
+    """A clean short run with --health: health columns land in the study
+    CSV, the heartbeat carries the health block, the blackbox dumps with
+    reason run_end — and the monitor stays quiet."""
+    from byzantinemomentum_tpu.cli.attack import main
+
+    resdir = tmp_path / "clean"
+    rc = main(DRIVER_BASE + ["--gar", "median", "--nb-steps", "40",
+                             "--steps-per-program", "2", "--health",
+                             "--result-directory", str(resdir)])
+    assert rc == 0
+    header = (resdir / "study").read_text().splitlines()[0]
+    for column in HEALTH_COLUMNS:
+        assert column in header, column
+    records = obs.load_records(resdir)
+    assert not [r for r in records if r["name"] == "health_anomaly"]
+    summary = [r for r in records if r["name"] == "health_summary"]
+    assert summary and summary[-1]["data"]["anomalies_total"] == 0
+    box = load_blackbox(resdir)
+    assert box is not None and box["reason"] == "run_end"
+    assert len(box["ring"]) == 40
+    heartbeat = obs.read_heartbeat(resdir)
+    assert heartbeat["health"]["var_ratio_ewma"] is not None
+
+
+def test_driver_flag_validation(tmp_path, capsys):
+    """--health without the study pipeline warns and disables;
+    --rollback-on-anomaly without a rollback budget warns and disables
+    (but keeps --health)."""
+    from byzantinemomentum_tpu.cli.attack import main
+
+    assert main(DRIVER_BASE + ["--nb-steps", "0", "--health"]) == 0
+    err = capsys.readouterr().err
+    assert "needs the study pipeline" in err
+
+    resdir = tmp_path / "nobudget"
+    rc = main(DRIVER_BASE + ["--nb-steps", "2", "--rollback-on-anomaly",
+                             "--result-directory", str(resdir)])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "rollback-budget" in err
+    # --health stayed on (implied) even though the trigger was disabled
+    assert "Var ratio" in (resdir / "study").read_text().splitlines()[0]
+
+
+# --------------------------------------------------------------------------- #
+# Study renderings
+
+
+def test_study_health_plots(tmp_path):
+    from byzantinemomentum_tpu.cli.attack import main
+    import study
+
+    resdir = tmp_path / "plots"
+    rc = main(DRIVER_BASE + ["--gar", "median", "--nb-steps", "8",
+                             "--steps-per-program", "2", "--health",
+                             "--result-directory", str(resdir)])
+    assert rc == 0
+    sess = study.Session(resdir)
+    plot = study.variance_envelope(sess)
+    plot.save(tmp_path / "envelope.png")
+    plot.close()
+    plot = study.health_timeline(sess)
+    plot.save(tmp_path / "timeline.png")
+    plot.close()
+    assert (tmp_path / "envelope.png").stat().st_size > 0
+    assert (tmp_path / "timeline.png").stat().st_size > 0
+
+    # A health-less run raises the documented UserException
+    from byzantinemomentum_tpu import utils
+    bare = tmp_path / "bare"
+    assert main(DRIVER_BASE + ["--gar", "median", "--nb-steps", "2",
+                               "--result-directory", str(bare)]) == 0
+    with pytest.raises(utils.UserException, match="--health"):
+        study.variance_envelope(study.Session(bare))

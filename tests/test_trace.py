@@ -416,3 +416,94 @@ def test_loadgen_trace_mode_payload():
     assert payload["queue_depth"]["max"] >= 1
     assert 0.0 < payload["batch_occupancy"]["max"] <= 1.0
     assert "frac" in payload["overhead"]
+
+
+# --------------------------------------------------------------------------- #
+# stale_edges (scripts/stale_edges.py, PR 15): the data-driven input the
+# straggler-host bounded-wait policy needs
+
+def _stale_edges():
+    import importlib.util
+    import pathlib
+    import sys
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "stale_edges.py")
+    spec = importlib.util.spec_from_file_location("stale_edges", script)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("stale_edges", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _liveness_stream(tmp_path, edges):
+    """Write a synthetic launcher telemetry stream of liveness
+    transitions: edges = [(t, host, from, to)]."""
+    lines = [json.dumps({"t": t, "kind": "event",
+                         "name": "liveness_transition",
+                         "data": {"host": host, "from": frm, "to": to,
+                                  "step": 1}})
+             for t, host, frm, to in edges]
+    (tmp_path / "telemetry.jsonl").write_text("\n".join(lines) + "\n")
+    return tmp_path
+
+
+def test_stale_edges_skewed_timeline(tmp_path, capsys):
+    """The synthetic skewed timeline: fast recoveries (0.5-2 s) vs one
+    slow death (12 s) plus a censored episode — the recommended bounded
+    wait is p95(recoveries) * 1.25, and the censored episode is counted,
+    never guessed."""
+    stale_edges = _stale_edges()
+    t = 100.0
+    edges = [(t, h, None, "alive") for h in range(3)]
+    for dt in (0.5, 1.0, 2.0):
+        edges += [(t, 0, "alive", "stale"), (t + dt, 0, "stale", "alive")]
+        t += 5.0
+    edges += [(t, 1, "alive", "stale"), (t + 12.0, 1, "stale", "dead")]
+    t += 20.0
+    edges += [(t, 2, "alive", "stale")]  # unresolved at end of stream
+    run = _liveness_stream(tmp_path, edges)
+
+    episodes = stale_edges.stale_episodes(
+        __import__("byzantinemomentum_tpu.obs.recorder",
+                   fromlist=["load_records"]).load_records(run))
+    assert episodes["recovered"] == [0.5, 1.0, 2.0]
+    assert episodes["died"] == [12.0]
+    assert episodes["censored"] == 1
+
+    summary = stale_edges.summarize([run])
+    assert summary["stale_to_alive"]["count"] == 3
+    assert summary["stale_to_alive"]["p95_s"] == 2.0
+    assert summary["stale_to_dead"]["median_s"] == 12.0
+    assert summary["recommended_wait_s"] == 2.5  # p95 * 1.25
+
+    assert stale_edges.main([str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "recommended bounded wait: 2.5s" in out
+    assert "stale-edges: " in out
+
+
+def test_stale_edges_death_only_and_empty(tmp_path, capsys):
+    """With only deaths on record there is nothing worth waiting for:
+    the window stays strictly below the fastest observed death; an empty
+    stream exits non-zero with no recommendation."""
+    stale_edges = _stale_edges()
+    run = _liveness_stream(tmp_path, [
+        (10.0, 1, "alive", "stale"), (18.0, 1, "stale", "dead")])
+    summary = stale_edges.summarize([run])
+    assert summary["stale_to_alive"] is None
+    assert summary["recommended_wait_s"] == 4.0  # min(death)/2
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert stale_edges.main([str(empty)]) == 1
+    assert "no telemetry records" in capsys.readouterr().out
+
+
+def test_stale_edges_unknown_edge_censors(tmp_path):
+    stale_edges = _stale_edges()
+    run = _liveness_stream(tmp_path, [
+        (10.0, 0, "alive", "stale"), (15.0, 0, "stale", "unknown")])
+    from byzantinemomentum_tpu.obs.recorder import load_records
+    episodes = stale_edges.stale_episodes(load_records(run))
+    assert episodes["recovered"] == [] and episodes["died"] == []
+    assert episodes["censored"] == 1
