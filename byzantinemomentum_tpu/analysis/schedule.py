@@ -59,7 +59,9 @@ import time
 __all__ = ["Scheduler", "SchedLock", "SchedCondition", "DeadlockError",
            "SchedulerError", "RunResult", "ExploreReport", "run_schedule",
            "explore", "random_walks", "lost_update_model",
-           "fixed_counter_model", "selfcheck"]
+           "fixed_counter_model", "router_lost_forward_model",
+           "router_forward_queue_model", "router_double_resolve_model",
+           "router_single_disposition_model", "selfcheck"]
 
 # A worker that fails to reach its next preemption point within this many
 # seconds is assumed to have entered a REAL blocking call (which the
@@ -518,22 +520,176 @@ def fixed_counter_model(sched):
     return [svc.resolve, svc.resolve], check
 
 
+# --------------------------------------------------------------------------- #
+# The fleet-router models (serve/fleet/router.py): the two interleavings
+# that decide its design — a lost forward and a double disposition —
+# each as the broken pattern the naive router would have, and the
+# pattern the shipped router uses, pinned schedule-clean.
+
+def router_lost_forward_model(sched):
+    """The PRE-fix forwarding pattern: liveness read as a SEND GUARD.
+    The connection thread checks the arc is alive and only then
+    enqueues; concurrently the arc dies and its dead-arc cleanup errors
+    everything queued. A kill landing BETWEEN the check and the enqueue
+    leaves the line queued behind a dead arc after cleanup already ran —
+    no reply, ever. Serial orders pass; one preemption finds it."""
+    state = {"alive": True, "queue": [], "errored": []}
+
+    def handler():
+        # check-then-enqueue: the race
+        if state["alive"]:
+            sched.point()             # ... the kill + cleanup land here
+            state["queue"].append(0)
+        else:
+            state["errored"].append(0)
+
+    def killer():
+        state["alive"] = False
+        sched.point()
+        # dead-arc cleanup: error whatever is queued NOW
+        state["errored"].extend(state["queue"])
+        state["queue"].clear()
+
+    def check():
+        assert 0 in state["errored"], (
+            f"lost forward: line 0 has no disposition "
+            f"(queued behind a dead arc: {state['queue']})")
+
+    return [handler, killer], check
+
+
+def router_forward_queue_model(sched):
+    """The SHIPPED pattern (`FleetRouter.handle_line` + `_forward_loop`):
+    the enqueue is UNCONDITIONAL — liveness is policy, never a send
+    guard — and the queue's SINGLE consumer (the arc's forwarder) gives
+    every item exactly one disposition, reading liveness at take time.
+    Exhaustively clean at the same preemption bound that breaks the
+    guarded version."""
+    cond = sched.condition()
+    state = {"alive": True, "queue": [], "answered": [], "errored": []}
+
+    def handler():
+        with cond:
+            state["queue"].append(0)  # unconditional: a kill cannot
+            cond.notify()             # land "between check and enqueue"
+
+    def forwarder():
+        with cond:
+            while not state["queue"]:
+                cond.wait()
+            line = state["queue"].pop(0)
+            alive = state["alive"]
+        # the single consumer owns the disposition
+        (state["answered"] if alive else state["errored"]).append(line)
+
+    def killer():
+        with cond:
+            state["alive"] = False
+
+    def check():
+        disposed = state["answered"] + state["errored"]
+        assert disposed == [0] and not state["queue"], (
+            f"line 0 needs exactly one disposition: answered="
+            f"{state['answered']} errored={state['errored']} "
+            f"queued={state['queue']}")
+
+    return [handler, forwarder, killer], check
+
+
+def router_double_resolve_model(sched):
+    """The PRE-fix in-flight cleanup: TWO detectors — the forwarder's
+    send-error path and a health-watcher style sweeper — each see the
+    same in-flight line and answer it. Interleaved, the client's one
+    line gets two replies (and, had the sweeper re-SENT it, the shard
+    would fold the cohort into its suspicion store twice — verdict
+    corruption). Serial orders pass; one preemption finds it."""
+    state = {"inflight": [0], "replies": []}
+
+    def dispose(tag):
+        def run():
+            if state["inflight"]:            # saw the line...
+                line = state["inflight"][0]
+                sched.point()                # ... the other detector too
+                state["replies"].append((tag, line))
+                if line in state["inflight"]:
+                    state["inflight"].remove(line)
+        return run
+
+    def check():
+        assert len(state["replies"]) == 1, (
+            f"line 0 disposed {len(state['replies'])} times: "
+            f"{state['replies']}")
+
+    return [dispose("error"), dispose("timeout")], check
+
+
+def router_single_disposition_model(sched):
+    """The SHIPPED pattern: taking the line OUT of the shared in-flight
+    state (pop under the lock) IS claiming its disposition — whoever
+    pops, replies; the loser finds nothing to take. In the real router
+    the same ownership is structural: an `_Item` lives in exactly one
+    forwarder's batch list, and the error path nulls its slot before
+    anything else can see it. Exhaustively clean."""
+    lock = sched.lock()
+    state = {"inflight": [0], "replies": []}
+
+    def dispose(tag):
+        def run():
+            with lock:
+                line = (state["inflight"].pop(0) if state["inflight"]
+                        else None)
+            if line is not None:             # we own it now
+                state["replies"].append((tag, line))
+        return run
+
+    def check():
+        assert len(state["replies"]) == 1, (
+            f"line 0 disposed {len(state['replies'])} times: "
+            f"{state['replies']}")
+
+    return [dispose("error"), dispose("timeout")], check
+
+
 def selfcheck(max_preemptions=3):
-    """The lint-tier schedule smoke: the planted lost-update must be
-    FOUND within the preemption bound, and the fixed counter must
-    survive the same exhaustive exploration clean. Returns a JSON-safe
-    report with `ok`."""
+    """The lint-tier schedule smoke: every planted bug — the serve
+    counter lost-update and the two router races (lost forward, double
+    disposition) — must be FOUND within the preemption bound, and every
+    fixed pattern must survive the same exhaustive exploration clean.
+    Returns a JSON-safe report with `ok`."""
     t0 = time.monotonic()
     broken = explore(lost_update_model, max_preemptions=max_preemptions)
     fixed = explore(fixed_counter_model, max_preemptions=max_preemptions)
+    r_lost = explore(router_lost_forward_model,
+                     max_preemptions=max_preemptions)
+    r_double = explore(router_double_resolve_model,
+                       max_preemptions=max_preemptions)
+    r_queue = explore(router_forward_queue_model,
+                      max_preemptions=max_preemptions)
+    r_single = explore(router_single_disposition_model,
+                       max_preemptions=max_preemptions)
+    router_fixed_clean = (r_queue.ok and r_queue.exhausted
+                          and r_single.ok and r_single.exhausted)
     return {
-        "ok": bool(broken.failures) and fixed.ok and fixed.exhausted,
+        "ok": (bool(broken.failures) and fixed.ok and fixed.exhausted
+               and bool(r_lost.failures) and bool(r_double.failures)
+               and router_fixed_clean),
         "lost_update_found": bool(broken.failures),
         "witness": broken.failures[0].schedule if broken.failures else None,
         "schedules_prefix": broken.runs,
         "schedules_fixed": fixed.runs,
         "fixed_clean": fixed.ok,
-        "exhausted": broken.exhausted and fixed.exhausted,
+        "router_lost_forward_found": bool(r_lost.failures),
+        "router_lost_forward_witness": (r_lost.failures[0].schedule
+                                        if r_lost.failures else None),
+        "router_double_resolve_found": bool(r_double.failures),
+        "router_double_resolve_witness": (r_double.failures[0].schedule
+                                          if r_double.failures else None),
+        "router_fixed_clean": router_fixed_clean,
+        "schedules_router": (r_lost.runs + r_double.runs + r_queue.runs
+                             + r_single.runs),
+        "exhausted": (broken.exhausted and fixed.exhausted
+                      and r_lost.exhausted and r_double.exhausted
+                      and r_queue.exhausted and r_single.exhausted),
         "max_preemptions": max_preemptions,
         "seconds": round(time.monotonic() - t0, 3),
     }

@@ -413,6 +413,27 @@ class ClientSuspicionStore:
             self._state.pop(next(iter(self._state)))
         return verdicts
 
+    def observe_batch(self, items, step=None):
+        """Fold one RESOLVED BATCH of requests, in submission order.
+
+        `items` is a sequence of kwargs dicts for `observe` (client_ids,
+        selection, distances, active, dist); returns the per-item
+        verdict dicts in the same order. The verdicts are byte-identical
+        to calling `observe` once per item: each item keeps ITS cohort's
+        z-scores and sees the population mean selection rate as of ITS
+        fold — the order-sensitive float arithmetic is part of the
+        verdict contract (the equivalence test in tests/test_fleet.py
+        pins it), so nothing is vectorized ACROSS items. What batching
+        buys is at the caller: the service resolver acquires the
+        suspicion lock ONCE per device batch and makes one call, instead
+        of a lock round-trip per request — with admission `decide`
+        contending on the same lock from every submitter thread, that
+        moved the resolve span's p50 (`ATTRIB_serve_r16.json`). A batch
+        is also atomic under that lock: an admission decision reads
+        verdicts from between batches, never mid-fold.
+        """
+        return [self.observe(step=step, **item) for item in items]
+
     def _score(self, state, mean_rate):
         """The blended suspicion of one client state against the current
         population mean selection rate."""
@@ -446,6 +467,12 @@ class ClientSuspicionStore:
     def suspects(self):
         """Currently-suspect client ids (sorted)."""
         return sorted(str(c) for c, s in self._state.items() if s[4])
+
+    def clients(self):
+        """The client ids currently held (sorted) — the fleet's
+        shard-ownership tests check a shard's store holds EXACTLY the
+        clients the ring routes to it."""
+        return sorted(str(c) for c in self._state)
 
     def __len__(self):
         return len(self._state)

@@ -30,9 +30,11 @@ initialize an accelerator backend through this package.
 
 from byzantinemomentum_tpu.obs.trace.request import (  # noqa: F401
     REQUEST_PHASES,
+    ROUTER_PHASES,
     RequestTrace,
     TraceBuffer,
     percentile,
+    phase_spans,
 )
 from byzantinemomentum_tpu.obs.trace.fleet import (  # noqa: F401
     FLEET_TIMELINE_EVENTS,
@@ -44,7 +46,8 @@ from byzantinemomentum_tpu.obs.trace.fleet import (  # noqa: F401
 )
 
 __all__ = [
-    "REQUEST_PHASES", "RequestTrace", "TraceBuffer", "percentile",
+    "REQUEST_PHASES", "ROUTER_PHASES", "RequestTrace", "TraceBuffer",
+    "percentile", "phase_spans",
     "FLEET_TIMELINE_EVENTS", "ClockOffsetTracker", "estimate_offsets",
     "fleet_timeline", "load_fleet", "render_fleet_report",
 ]

@@ -46,7 +46,8 @@ import itertools
 import threading
 import time
 
-__all__ = ["REQUEST_PHASES", "RequestTrace", "TraceBuffer", "percentile"]
+__all__ = ["REQUEST_PHASES", "ROUTER_PHASES", "RequestTrace",
+           "TraceBuffer", "percentile", "phase_spans"]
 
 # Span names in causal order: (phase, start stamp, end stamp). The first
 # two phases precede the queue hand-off and are absent when the caller
@@ -65,6 +66,31 @@ REQUEST_PHASES = (
 # Phases whose sum IS the submit→resolve latency (the tiling contract)
 LATENCY_PHASES = ("queue", "pack", "dispatch", "resolver_wake", "device",
                   "resolve")
+
+# The fleet router's leg of the same contract (serve/fleet/router.py):
+# `route` (line parse + ring lookup) and `shard_rtt` (owner-shard queue
+# wait + forward + the shard's whole service time) are contiguous, so
+# their sum tiles the router-path recv→reply latency exactly — the
+# ATTRIB_serve r16 acceptance bound checks it like the service phases.
+ROUTER_PHASES = (
+    ("route", "recv", "routed"),
+    ("shard_rtt", "routed", "reply"),
+)
+
+
+def phase_spans(stamps, phases):
+    """{phase: ms} over a plain stamp dict for the given (phase, start,
+    end) tuples — the RequestTrace span math for callers (the fleet
+    router) whose stamp lifecycle doesn't fit the service pipeline.
+    Returns None unless EVERY phase has both stamps (a partial router
+    trace tiles nothing)."""
+    spans = {}
+    for phase, start, end in phases:
+        t0, t1 = stamps.get(start), stamps.get(end)
+        if t0 is None or t1 is None:
+            return None
+        spans[phase] = max(0.0, (t1 - t0) * 1000.0)
+    return spans
 
 _ids = itertools.count(1)
 

@@ -250,10 +250,149 @@ def selfcheck(seed=1, requests=120, verbose=True):
                   f"({tile_error * 100:.2f}% off), tracing overhead "
                   f"{overhead * 100:.2f}%", flush=True)
 
+        # (6) fleet phase (serve/fleet): a 2-shard in-process ring —
+        # route determinism, shard-EXACT suspicion ownership, kill →
+        # readmit → re-warm bound, and zero recompiles on the routed
+        # warm path. Real router + real shard sockets; only process
+        # isolation is simulated (each shard still owns its own store).
+        from byzantinemomentum_tpu.serve.fleet.local import LocalFleet
+
+        gar, n, f, d = "median", 5, 1, 32
+        with LocalFleet(2, service={"max_batch": 4,
+                                    "max_delay_ms": 2.0}) as fleet:
+            for svc in fleet.services.values():
+                svc.warmup([(gar, n, f, d, True), (gar, n, f, d, False)])
+            bases = [f"fleet-{i}" for i in range(16)]
+            cohorts = {b: [b] + [f"{b}.{j}" for j in range(1, n)]
+                       for b in bases}
+            owners = {b: fleet.owner(b) for b in bases}
+            if owners != {b: fleet.owner(b) for b in bases}:
+                raise AssertionError("ring ownership is not deterministic")
+            if set(owners.values()) != set(fleet.shards):
+                raise AssertionError(
+                    f"16 cohorts landed on {sorted(set(owners.values()))} "
+                    f"only — the ring is not spreading")
+
+            def ask(clients, diagnostics=True):
+                cohort = rng.standard_normal((n, d)).astype(np.float32)
+                request = {"op": "aggregate", "gar": gar, "f": f,
+                           "vectors": cohort.tolist(),
+                           "diagnostics": diagnostics}
+                if clients is not None:
+                    request["clients"] = clients
+                reply = fleet.ask(request)
+                if not reply.get("ok"):
+                    raise AssertionError(f"fleet route failed: {reply}")
+                return reply
+
+            def fleet_step():
+                for base in bases:
+                    ask(cohorts[base])
+
+            contracts.assert_recompile_budget(
+                fleet_step, steps=3, budget=0,
+                label="warm routed fleet traffic (2 shards)")
+
+            # Ownership is EXACT: each shard's store holds the union of
+            # the cohorts whose routing key it owns, and nothing else
+            expected = {s: set() for s in fleet.shards}
+            for base in bases:
+                expected[owners[base]].update(cohorts[base])
+            for shard in fleet.shards:
+                got = set(fleet.suspicion_clients(shard))
+                if got != expected[shard]:
+                    raise AssertionError(
+                        f"{shard} store drifted from its arc: "
+                        f"unexpected={sorted(got - expected[shard])} "
+                        f"missing={sorted(expected[shard] - got)}")
+
+            # Routed vs direct throughput, one request in flight each
+            # (what the router's two extra socket hops cost); the tier
+            # harness records fleet_speedup from the printed line
+            count = 48
+            t0 = time.monotonic()
+            for k in range(count):
+                ask(None, diagnostics=False)
+            fleet_rate = count / (time.monotonic() - t0)
+            svc = fleet.services[fleet.shards[0]]
+            t0 = time.monotonic()
+            for k in range(count):
+                svc.aggregate(rng.standard_normal((n, d)).astype(
+                    np.float32), gar=gar, f=f, diagnostics=False,
+                    timeout=30)
+            direct_rate = count / (time.monotonic() - t0)
+
+            # Kill-safe failover: the victim restarts on ITS port with
+            # an EMPTY store — the returning cohort re-warms exactly as
+            # fast as a brand-new id (no resurrection channel), and the
+            # survivor's counts advance uncorrupted
+            victim = owners[bases[0]]
+            survivor_base = next(b for b in bases if owners[b] != victim)
+            before = ask(cohorts[survivor_base])["verdicts"][
+                survivor_base]["observations"]
+            fleet.kill(victim)
+            fleet.restart(victim)
+            returning = ask(cohorts[bases[0]])["verdicts"][
+                bases[0]]["observations"]
+            k = 0
+            while fleet.owner(f"newcomer-{k}") != victim:
+                k += 1
+            newcomer = f"newcomer-{k}"
+            fresh = ask([newcomer] + [f"{newcomer}.{j}"
+                                      for j in range(1, n)])["verdicts"][
+                newcomer]["observations"]
+            if returning != fresh:
+                raise AssertionError(
+                    f"returning client re-warmed faster than a fresh id "
+                    f"after the {victim} restart: returning came back at "
+                    f"{returning} observations, fresh starts at {fresh}")
+            after = ask(cohorts[survivor_base])["verdicts"][
+                survivor_base]["observations"]
+            if after != before + 1:
+                raise AssertionError(
+                    f"survivor verdicts corrupted by the {victim} "
+                    f"failover: {survivor_base} observations {before} -> "
+                    f"{after} (expected {before + 1})")
+            fleet_line = {
+                "shards": len(fleet.shards), "requests": 3 * len(bases),
+                "fleet_agg_per_sec": round(fleet_rate, 1),
+                "direct_agg_per_sec": round(direct_rate, 1),
+                "fleet_speedup": round(fleet_rate / direct_rate, 3),
+                "killed": victim, "rewarm_observations": returning,
+                "fresh_observations": fresh,
+            }
+        print(f"serve fleet: {json.dumps(fleet_line)}", flush=True)
+        if verbose:
+            print(f"serve fleet: 2-shard ring ok — ownership exact, "
+                  f"{victim} kill/restart re-warm bound holds, routed "
+                  f"rate {fleet_rate:.0f}/s vs direct "
+                  f"{direct_rate:.0f}/s", flush=True)
+
         stats = service.stats()
     finally:
         service.close()
     return stats
+
+
+def _watch_parent():
+    """Die with the launcher (`cluster/host.py` discipline): the fleet
+    launcher holds the write end of our stdin pipe and NEVER writes, so
+    EOF means the launcher is gone — whatever killed it. Raw `os.read`
+    on fd 0, not `sys.stdin.buffer`: a buffered reader's internal lock
+    can abort interpreter shutdown from a daemon thread."""
+    import os
+    import threading
+
+    def watch():
+        try:
+            while os.read(0, 4096):
+                pass
+        except OSError:
+            pass
+        os._exit(3)
+
+    threading.Thread(target=watch, name="parent-watch",
+                     daemon=True).start()
 
 
 def main(argv=None):
@@ -285,7 +424,22 @@ def main(argv=None):
                         help="selfcheck traffic seed (Jobs-compatible)")
     parser.add_argument("--device", default=None,
                         help="advisory device string (Jobs-compatible)")
+    parser.add_argument("--parent-pipe", action="store_true",
+                        help="exit when stdin hits EOF — the fleet "
+                             "launcher holds the write end of our stdin "
+                             "pipe, so a dead launcher (any signal) takes "
+                             "its shards with it instead of leaking "
+                             "orphan servers on bound ports")
+    parser.add_argument("--warmup", action="append", default=None,
+                        metavar="GAR:N:D:F",
+                        help="pre-compile this request shape (diagnostics "
+                             "cell) before binding the port; repeatable — "
+                             "the fleet launcher warms every shard so the "
+                             "readiness ping means 'warm', not 'bound'")
     args = parser.parse_args(argv)
+
+    if args.parent_pipe:
+        _watch_parent()
 
     if args.selfcheck:
         try:
@@ -309,6 +463,17 @@ def main(argv=None):
         directory=args.result_directory,
         heartbeat_interval=args.heartbeat_interval,
         tracing=not args.no_tracing, trace_buffer=args.trace_buffer)
+    if args.warmup:
+        cells = []
+        for spec in args.warmup:
+            parts = spec.split(":")
+            if len(parts) != 4:
+                parser.error(f"--warmup expects GAR:N:D:F, got {spec!r}")
+            gar, n, d, f = parts
+            cells.append((gar, int(n), int(f), int(d), True))
+        compiled = service.warmup(cells)
+        print(f"serve: warmed {compiled} programs over {len(cells)} "
+              f"request shapes", flush=True)
     # SIGUSR1 -> trace-ring snapshot (the serve twin of the driver's
     # SIGUSR1 profiler window): a live server dumps its completed-trace
     # buffer + per-phase summary without restarting or pausing

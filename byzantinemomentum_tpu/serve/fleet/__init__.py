@@ -1,0 +1,49 @@
+"""Sharded aggregation fleet: consistent-hash routing over N serve
+processes, shard-local suspicion, kill-safe failover.
+
+The single-process service (`serve/service.py`) tops out where one
+resolver and one suspicion lock do (`BENCH_serve_r10.json`; queue wait
+is 37% of p50 in `ATTRIB_serve_r13.json`). This package scales it OUT
+instead of up, without touching the aggregation or suspicion math:
+
+* `ring.py` — the consistent-hash ring (sha1 points, virtual nodes)
+  and the versioned, persist-before-change `Membership` that owns it.
+  Stdlib only; deterministic across processes.
+* `router.py` — `FleetRouter`/`RouterServer`: the line-JSON frontend
+  that maps each request's first client id onto its owner shard and
+  pipelines groups down one connection per shard, with exactly-one
+  disposition per line (queue-or-error on a dead arc, never re-send).
+* `launcher.py` — N supervised shard processes under the
+  `cluster/launcher.py` discipline: launcher-held stdin pipes (orphans
+  die), per-shard heartbeats aggregated into one `heartbeat.json`
+  (`Jobs(seeds=(None,))` supervises the fleet unchanged), membership
+  persisted to `fleet.json` BEFORE any ring change.
+* `local.py` — an in-process N-shard fleet on loopback for tests, the
+  serve selfcheck and loadgen tracing (real sockets, no subprocesses).
+
+Ownership follows the Ray split (PAPERS.md): the launcher/router decide
+LIVENESS, each shard decides its clients' STATE — a shard owns its arc's
+`ClientSuspicionStore` exactly, so fleet verdicts are byte-identical to
+a single process fed the same per-shard substream, and a killed shard's
+returning clients re-warm from scratch (no faster than a fresh id).
+"""
+
+from byzantinemomentum_tpu.serve.fleet.ring import (  # noqa: F401
+    DEFAULT_VNODES,
+    FLEET_MANIFEST_NAME,
+    HashRing,
+    Membership,
+    hash_point,
+    read_fleet_manifest,
+    write_fleet_manifest,
+)
+from byzantinemomentum_tpu.serve.fleet.router import (  # noqa: F401
+    FleetRouter,
+    RouterServer,
+)
+
+__all__ = [
+    "DEFAULT_VNODES", "FLEET_MANIFEST_NAME", "HashRing", "Membership",
+    "hash_point", "read_fleet_manifest", "write_fleet_manifest",
+    "FleetRouter", "RouterServer",
+]

@@ -1,0 +1,8 @@
+"""`python -m byzantinemomentum_tpu.serve.fleet` — launch the fleet."""
+
+import sys
+
+from byzantinemomentum_tpu.serve.fleet.launcher import main
+
+if __name__ == "__main__":
+    sys.exit(main())

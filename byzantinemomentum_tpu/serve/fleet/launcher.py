@@ -1,0 +1,338 @@
+"""The serve-fleet launcher: N supervised shard processes + the router.
+
+`python -m byzantinemomentum_tpu.serve.fleet --shards N ...` spawns N
+independent `AggregationService` processes (`python -m
+byzantinemomentum_tpu.serve`, one ephemeral pre-probed port each), runs
+the consistent-hash `FleetRouter` in-process, and supervises the lot
+with the `cluster/launcher.py` discipline:
+
+* **ownership split** (the Ray model, PAPERS.md): this launcher decides
+  LIVENESS — membership, versions, restarts; each shard decides STATE —
+  its clients' suspicion store, admission, verdicts. Nothing here ever
+  reads or moves suspicion state between shards.
+* **persist-before-change** — every membership/liveness transition
+  lands in the versioned `fleet.json` (atomic replace) BEFORE the ring
+  flips or a process is spawned/restarted, so a crash replays a
+  stale-but-consistent view, never a torn one.
+* **orphan death** — every shard is spawned with `--parent-pipe` and
+  its stdin held EXCLUSIVELY here: launcher death (any signal) closes
+  the pipe and the shard's parent-watch thread exits the process.
+* **one heartbeat** — per-shard atomic heartbeats
+  (`shards/shard-<i>/heartbeat.json`) aggregate into the run's single
+  top-level `heartbeat.json` (step = total served, monotonic), so
+  `Jobs(seeds=(None,))` supervises a whole fleet through the same file
+  a single-process run writes.
+* **kill-safe failover** — a dead shard's arc is marked dead (persist
+  first), the router queues or errors its lines per `--on-dead`, and
+  the shard restarts on ITS port with a FRESH store: ownership never
+  moves, and a returning client re-warms no faster than a fresh id.
+
+Stdlib + ring/router + obs.heartbeat only — the launcher never imports
+jax (the shards do, in their own processes).
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import socket
+import sys
+import time
+
+from byzantinemomentum_tpu.cluster.runtime import free_port
+from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat, \
+    write_heartbeat
+from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, \
+    Membership, write_fleet_manifest
+from byzantinemomentum_tpu.serve.fleet.router import FleetRouter, \
+    RouterServer
+
+__all__ = ["FleetLauncher", "main", "process_commandline"]
+
+# Repo root on the shards' PYTHONPATH (the cluster-launcher idiom)
+_PKG_ROOT = pathlib.Path(__file__).resolve().parents[3]
+
+SHARDS_DIRNAME = "shards"
+
+
+def process_commandline(argv=None):
+    parser = argparse.ArgumentParser(prog="serve.fleet")
+    add = parser.add_argument
+    add("--shards", type=int, default=2,
+        help="Shard count: one AggregationService process per shard")
+    add("--result-directory", type=str, required=True)
+    add("--host", type=str, default="127.0.0.1")
+    add("--port", type=int, default=7700,
+        help="Router port (0 picks an ephemeral one)")
+    add("--vnodes", type=int, default=DEFAULT_VNODES)
+    add("--on-dead", type=str, default="queue",
+        choices=("queue", "error"),
+        help="Dead-arc policy: park lines behind the restart, or fail "
+             "them fast")
+    add("--max-batch", type=int, default=8)
+    add("--max-delay-ms", type=float, default=2.0)
+    add("--no-diagnostics", action="store_true", default=False)
+    add("--no-tracing", action="store_true", default=False)
+    add("--heartbeat-interval", type=float, default=2.0)
+    add("--poll", type=float, default=0.2,
+        help="Supervision poll interval in seconds")
+    add("--shard-retries", type=int, default=5,
+        help="Restarts PER SHARD before the launcher gives up (the "
+             "outer Jobs supervisor takes over with the same semantics)")
+    add("--ready-timeout", type=float, default=120.0,
+        help="Seconds to wait for a spawned shard to answer ping")
+    add("--warmup", action="append", default=None,
+        help="gar:n:d:f spec compiled by every shard before it serves "
+             "(repeatable)")
+    add("--seed", type=int, default=1,
+        help="Accepted for Jobs-supervisor compatibility")
+    add("--device", type=str, default="auto",
+        help="Accepted for Jobs-supervisor compatibility")
+    add("--auto-resume", action="store_true", default=False,
+        help="Accepted for Jobs-supervisor compatibility (shards are "
+             "stateless: a relaunch IS a resume)")
+    return parser.parse_args(sys.argv[1:] if argv is None else argv)
+
+
+def _ping(host, port, timeout=1.0):
+    """One short-lived ping round-trip; False on any failure."""
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            files = sock.makefile("rwb")
+            files.write(b'{"op": "ping"}\n')
+            files.flush()
+            return bool(files.readline())
+    except OSError:
+        return False
+
+
+class FleetLauncher:
+    """The supervised fleet: shard processes, membership, router."""
+
+    def __init__(self, args):
+        self.args = args
+        self.resdir = pathlib.Path(args.result_directory).resolve()
+        self.shards_dir = self.resdir / SHARDS_DIRNAME
+        self.shards_dir.mkdir(parents=True, exist_ok=True)
+        self.host = args.host
+        self.membership = Membership(vnodes=args.vnodes)
+        self.procs = {}      # shard id -> Popen
+        self.restarts = {}   # shard id -> count
+        self.router = None
+        self.server = None
+
+    # -------------------------------------------------------------- #
+
+    def _persist(self):
+        write_fleet_manifest(self.resdir, self.membership,
+                             router={"host": self.host,
+                                     "port": (self.server.port
+                                              if self.server else None),
+                                     "pid": os.getpid(),
+                                     "on_dead": self.args.on_dead})
+
+    def _liveness_hook(self, shard, alive):
+        """Router-detected transitions: version + persist BEFORE the
+        ring flips (called under the router lock; no router calls)."""
+        self.membership.bump("alive" if alive else "dead", shard)
+        self._persist()
+
+    def _shard_cmd(self, shard, port):
+        args = self.args
+        cmd = [sys.executable, "-m", "byzantinemomentum_tpu.serve",
+               "--host", self.host, "--port", str(port),
+               "--parent-pipe",
+               "--result-directory", str(self.shards_dir / shard),
+               "--max-batch", str(args.max_batch),
+               "--max-delay-ms", str(args.max_delay_ms),
+               "--heartbeat-interval", str(args.heartbeat_interval)]
+        if args.no_diagnostics:
+            cmd.append("--no-diagnostics")
+        if args.no_tracing:
+            cmd.append("--no-tracing")
+        for spec in args.warmup or ():
+            cmd += ["--warmup", spec]
+        return cmd
+
+    def _spawn(self, shard, port):
+        import subprocess
+
+        (self.shards_dir / shard).mkdir(parents=True, exist_ok=True)
+        out = (self.shards_dir / f"{shard}.out.log").open("ab")
+        err = (self.shards_dir / f"{shard}.err.log").open("ab")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (str(_PKG_ROOT) + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        proc = subprocess.Popen(self._shard_cmd(shard, port),
+                                stdin=subprocess.PIPE, stdout=out,
+                                stderr=err, cwd=str(_PKG_ROOT), env=env)
+        out.close()
+        err.close()
+        self.procs[shard] = proc
+        self.membership.shards[shard]["pid"] = proc.pid
+        self._persist()
+        return proc
+
+    def _wait_ready(self, shard, deadline):
+        port = self.membership.shards[shard]["port"]
+        while time.monotonic() < deadline:
+            if _ping(self.host, port):
+                return True
+            if self.procs[shard].poll() is not None:
+                return False
+            time.sleep(0.1)
+        return False
+
+    # -------------------------------------------------------------- #
+
+    def launch(self):
+        """Membership first (persisted), then processes, then router."""
+        for index in range(self.args.shards):
+            shard = f"shard-{index}"
+            self.membership.bump("add", shard, host=self.host,
+                                 port=free_port())
+        self._persist()
+        for shard in sorted(self.membership.shards):
+            self._spawn(shard, self.membership.shards[shard]["port"])
+            self.restarts[shard] = 0
+        deadline = time.monotonic() + self.args.ready_timeout
+        for shard in sorted(self.membership.shards):
+            if not self._wait_ready(shard, deadline):
+                raise RuntimeError(f"{shard} never became ready "
+                                   f"(see {self.shards_dir}/{shard}.err.log)")
+        self.router = FleetRouter(
+            {s: (row["host"], row["port"])
+             for s, row in self.membership.shards.items()},
+            vnodes=self.args.vnodes, on_dead=self.args.on_dead,
+            liveness_hook=self._liveness_hook)
+        self.server = RouterServer((self.host, self.args.port), self.router)
+        self.server.serve_background()
+        self._persist()  # now the manifest names the router's real port
+        return self.server.port
+
+    def aggregate_heartbeat(self, status="serving"):
+        """Join the per-shard heartbeats into the run's single
+        `heartbeat.json` — step is TOTAL SERVED (monotonic across
+        restarts only while shards live; a restarted shard restarts its
+        count, so the watchdog key is the max-over-time the Jobs
+        signature already tolerates)."""
+        served = 0
+        alive = []
+        shard_steps = {}
+        for shard in sorted(self.membership.shards):
+            beat = read_heartbeat(self.shards_dir / shard)
+            if beat is None:
+                continue
+            step = beat.get("step")
+            if isinstance(step, (int, float)):
+                served += int(step)
+                shard_steps[shard] = int(step)
+            if beat.get("status") == "serving":
+                alive.append(shard)
+        write_heartbeat(self.resdir, {
+            "step": served, "status": status,
+            "shards": len(self.membership.shards),
+            "shards_alive": len(alive), "shard_steps": shard_steps,
+            "ring_version": self.membership.version,
+            "dead": list(self.router.dead_shards()) if self.router else []})
+
+    def supervise_once(self):
+        """One poll: restart dead shards (persist-first), refresh the
+        aggregated heartbeat. Returns the shards restarted this poll."""
+        restarted = []
+        for shard, proc in list(self.procs.items()):
+            if proc.poll() is None:
+                continue
+            if proc.stdin is not None:
+                try:
+                    proc.stdin.close()
+                except OSError:
+                    pass
+            self.restarts[shard] += 1
+            if self.restarts[shard] > self.args.shard_retries:
+                raise RuntimeError(
+                    f"{shard} exceeded --shard-retries="
+                    f"{self.args.shard_retries}")
+            # Dead BEFORE restart, both persisted: the manifest's
+            # history shows the arc go dark, then revive — on the SAME
+            # port, so ownership (and every other client's suspicion
+            # history) never moves
+            self.router.mark_dead(shard)
+            self._spawn(shard, self.membership.shards[shard]["port"])
+            deadline = time.monotonic() + self.args.ready_timeout
+            if not self._wait_ready(shard, deadline):
+                raise RuntimeError(f"{shard} did not come back after a "
+                                   f"restart")
+            self.router.mark_alive(shard)
+            restarted.append(shard)
+        self.aggregate_heartbeat()
+        return restarted
+
+    def teardown(self):
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+        if self.router is not None:
+            self.router.close()
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # bmt: noqa[BMT-E05] kill-then-wait failing means the OS is reaping it; teardown must not raise
+                pass
+            if proc.stdin is not None:
+                try:
+                    proc.stdin.close()
+                except OSError:
+                    pass
+
+
+def main(argv=None):
+    args = process_commandline(argv)
+    if args.shards < 1:
+        print("fleet: need at least one shard")
+        return 2
+    launcher = FleetLauncher(args)
+    # A live signal BEFORE the slow part (N shard spawns, each a jax
+    # import + warmup) so an outer Jobs watchdog never kills a fleet
+    # for starting up
+    write_heartbeat(launcher.resdir,
+                    {"step": None, "status": "launching",
+                     "shards": args.shards})
+    try:
+        port = launcher.launch()
+    except (RuntimeError, OSError) as err:
+        print(f"fleet: launch failed: {err}")
+        launcher.teardown()
+        return 1
+    print("fleet: " + json.dumps(
+        {"router": f"{args.host}:{port}", "shards": args.shards,
+         "ports": {s: row["port"]
+                   for s, row in launcher.membership.shards.items()},
+         "on_dead": args.on_dead,
+         "ring_version": launcher.membership.version}), flush=True)
+    try:
+        while True:
+            time.sleep(max(args.poll, 0.01))
+            launcher.supervise_once()
+    except KeyboardInterrupt:
+        pass
+    except RuntimeError as err:
+        print(f"fleet: {err}")
+        launcher.teardown()
+        launcher.aggregate_heartbeat(status="failed")
+        return 1
+    launcher.teardown()
+    launcher.aggregate_heartbeat(status="stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""An in-process serve fleet on loopback: real shard sockets, real
+router, no subprocesses.
+
+`LocalFleet(shards=2)` builds N independent `AggregationService`s (each
+with its OWN `ClientSuspicionStore` — the shard-local ownership the
+process fleet has), binds each behind an `AggregationServer` on an
+ephemeral loopback port, and routes through a real `FleetRouter`. The
+wire path is byte-for-byte the production one (line JSON over TCP,
+pipelined groups per shard connection); only process isolation is
+simulated — which is exactly what the selfcheck, the unit tests and the
+loadgen trace run need: route determinism, kill→restart→re-warm
+semantics and router-path attribution, minus N jax warm-ups.
+
+`kill(shard)` tears the shard's server+service down (the socket starts
+refusing, the router's forwarder marks the arc dead on its next
+connect); `restart(shard)` brings a FRESH service up on the SAME port —
+the suspicion store starts empty, as a restarted process's would, so
+returning clients re-warm from scratch.
+"""
+
+import json
+import socket
+
+from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, \
+    Membership
+from byzantinemomentum_tpu.serve.fleet.router import FleetRouter, \
+    RouterServer
+
+__all__ = ["LocalFleet", "ask_socket", "fleet_socket"]
+
+
+class LocalFleet:
+    """N in-process shards + router. Use as a context manager."""
+
+    def __init__(self, shards=2, *, vnodes=DEFAULT_VNODES,
+                 on_dead="queue", router_server=False, service=None):
+        from byzantinemomentum_tpu.serve.frontend import AggregationServer
+        from byzantinemomentum_tpu.serve.service import AggregationService
+
+        self._server_cls = AggregationServer
+        self._service_cls = AggregationService
+        self._service_kwargs = dict(service or {})
+        self.membership = Membership(vnodes=vnodes)
+        self.services = {}
+        self.servers = {}
+        for index in range(int(shards)):
+            shard = f"shard-{index}"
+            svc = AggregationService(**self._service_kwargs)
+            server = AggregationServer(("127.0.0.1", 0), svc)
+            server.serve_background()
+            self.services[shard] = svc
+            self.servers[shard] = server
+            self.membership.bump("add", shard, host="127.0.0.1",
+                                 port=server.port)
+        self.router = FleetRouter(
+            {s: (row["host"], row["port"])
+             for s, row in self.membership.shards.items()},
+            vnodes=vnodes, on_dead=on_dead)
+        self.server = None
+        if router_server:
+            self.server = RouterServer(("127.0.0.1", 0), self.router)
+            self.server.serve_background()
+
+    # -------------------------------------------------------------- #
+
+    @property
+    def shards(self):
+        return tuple(sorted(self.services))
+
+    @property
+    def port(self):
+        """The router's TCP port (None without `router_server=True`)."""
+        return None if self.server is None else self.server.port
+
+    def owner(self, client):
+        return self.router.owner(client)
+
+    def ask(self, request):
+        """One request dict through the router; returns the reply dict."""
+        raw = json.dumps(request).encode("utf-8")
+        return json.loads(self.router.handle_line(raw))
+
+    def suspicion_clients(self, shard):
+        """The client ids the shard's store currently holds (sorted)."""
+        return tuple(self.services[shard].suspicion.clients())
+
+    def kill(self, shard):
+        """SIGKILL-shaped teardown: the shard stops answering NOW (close
+        the server first so no farewell bytes reach the router), and the
+        router finds out the way production does — a failed connect."""
+        server = self.servers.pop(shard)
+        server.shutdown()
+        server.server_close()
+        self.services.pop(shard).close()
+        self.router.mark_dead(shard)
+
+    def restart(self, shard):
+        """A fresh service (EMPTY suspicion store) on the SAME port —
+        ownership never moves; state does not survive, by design."""
+        port = self.membership.shards[shard]["port"]
+        svc = self._service_cls(**self._service_kwargs)
+        server = self._server_cls(("127.0.0.1", port), svc)
+        server.serve_background()
+        self.services[shard] = svc
+        self.servers[shard] = server
+        self.membership.bump("alive", shard)
+        self.router.mark_alive(shard)
+
+    def close(self):
+        self.router.close()
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+        for shard in list(self.servers):
+            server = self.servers.pop(shard)
+            server.shutdown()
+            server.server_close()
+        for shard in list(self.services):
+            self.services.pop(shard).close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def fleet_socket(host, port, timeout=30.0):
+    """A connected line-JSON client socket to a router (or shard) —
+    returns (socket, buffered rwb file pair). Caller closes both."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(timeout)
+    return sock, sock.makefile("rwb")
+
+
+def ask_socket(files, request):
+    """One request dict over an open line-JSON connection."""
+    files.write(json.dumps(request).encode("utf-8") + b"\n")
+    files.flush()
+    line = files.readline()
+    if not line:
+        raise OSError("connection closed")
+    return json.loads(line)

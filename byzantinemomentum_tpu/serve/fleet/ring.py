@@ -1,0 +1,254 @@
+"""Consistent-hash ring: the fleet's client → shard ownership map.
+
+Every client id hashes onto a 64-bit circle; each shard contributes
+`vnodes` virtual points (sha1 of ``"shard:vnode"`` — NEVER the builtin
+``hash()``, whose per-process ``PYTHONHASHSEED`` salt would give every
+router process a different ring). A client is OWNED by the shard whose
+virtual point is first clockwise from the client's hash. Ownership is
+the suspicion-locality contract: the owner's `ClientSuspicionStore` is
+the only one that ever sees the client, so verdicts are byte-identical
+to a single-process store fed the same substream.
+
+Two properties the unit battery (tests/test_fleet.py) pins:
+
+* **determinism** — the same (shards, vnodes) build the same ring in
+  every process; routing is a pure function of the membership snapshot.
+* **minimal remap** — removing K of N shards remaps only the clients
+  the dead shards owned: an expected (and asserted) fraction of at most
+  (K+1)/N, while every other client keeps its owner (and therefore its
+  suspicion history).
+
+Liveness is deliberately SEPARATE from ownership (the Ray split the
+PAPERS.md annotation adopts: the launcher decides liveness, the owner
+decides state): `mark_dead`/`mark_alive` flip a shard's arc without
+moving any client, because a killed shard restarts on the same port and
+resumes owning exactly its old arc — with a fresh store, so a returning
+client re-warms no faster than a fresh id. `owner()` ignores liveness;
+`route()` consults it and reports a dead owner to the router's policy
+instead of silently failing clients over (which would leak suspicion
+state across shards).
+
+Membership is VERSIONED and persisted before any change takes effect:
+`Membership.bump` appends a history record and `write_fleet_manifest`
+lands it atomically (tmp + fsync + `os.replace`, the heartbeat/manifest
+discipline) BEFORE the launcher or router acts on the new view, so a
+crash replays at worst a stale-but-consistent ring, never a torn one.
+Stdlib only — no jax, no numpy — so the router and launcher never
+initialize a backend through this module.
+"""
+
+import bisect
+import hashlib
+import json
+import os
+import pathlib
+
+__all__ = ["DEFAULT_VNODES", "FLEET_MANIFEST_NAME", "HashRing",
+           "Membership", "hash_point", "read_fleet_manifest",
+           "write_fleet_manifest"]
+
+DEFAULT_VNODES = 64
+FLEET_MANIFEST_NAME = "fleet.json"
+_SPACE = 1 << 64
+
+
+def hash_point(key):
+    """Deterministic 64-bit circle position of `key` (sha1-derived:
+    stable across processes, platforms and Python versions)."""
+    digest = hashlib.sha1(str(key).encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class HashRing:
+    """The virtual-node circle over a set of shard ids.
+
+    `shards` maps shard id -> alive flag; `owner(client)` is pure
+    membership (stable under liveness flips), `route(client)` returns
+    `(owner, alive)` so the caller applies its dead-arc policy.
+    """
+
+    def __init__(self, shards=(), *, vnodes=DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError(f"Expected vnodes >= 1, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._alive = {}      # shard id -> bool
+        self._points = []     # sorted [(point, shard)]
+        for shard in shards:
+            self.add(shard)
+
+    # -------------------------------------------------------------- #
+    # membership
+
+    def add(self, shard):
+        shard = str(shard)
+        if shard in self._alive:
+            raise ValueError(f"shard {shard!r} is already on the ring")
+        self._alive[shard] = True
+        for v in range(self.vnodes):
+            point = hash_point(f"{shard}:{v}")
+            bisect.insort(self._points, (point, shard))
+
+    def remove(self, shard):
+        shard = str(shard)
+        if shard not in self._alive:
+            raise KeyError(shard)
+        del self._alive[shard]
+        self._points = [(p, s) for p, s in self._points if s != shard]
+
+    # -------------------------------------------------------------- #
+    # liveness (never moves ownership)
+
+    def mark_dead(self, shard):
+        if str(shard) not in self._alive:
+            raise KeyError(shard)
+        self._alive[str(shard)] = False
+
+    def mark_alive(self, shard):
+        if str(shard) not in self._alive:
+            raise KeyError(shard)
+        self._alive[str(shard)] = True
+
+    def alive(self, shard):
+        return bool(self._alive.get(str(shard), False))
+
+    @property
+    def shards(self):
+        return tuple(sorted(self._alive))
+
+    @property
+    def dead(self):
+        return tuple(sorted(s for s, a in self._alive.items() if not a))
+
+    # -------------------------------------------------------------- #
+    # routing
+
+    def owner(self, client):
+        """The shard owning `client` — pure membership, liveness-blind
+        (a killed-and-restarting shard keeps its arc)."""
+        if not self._points:
+            raise LookupError("ring has no shards")
+        point = hash_point(client) % _SPACE
+        index = bisect.bisect_right(self._points, (point, "￿"))
+        if index == len(self._points):
+            index = 0
+        return self._points[index][1]
+
+    def route(self, client):
+        """`(owner, alive)` — the router's dead-arc policy decides what
+        a False means (queue behind the restart, or error the line)."""
+        shard = self.owner(client)
+        return shard, self._alive[shard]
+
+    def spread(self, clients):
+        """{shard: owned-client count} over `clients` (balance probe)."""
+        counts = {shard: 0 for shard in self._alive}
+        for client in clients:
+            counts[self.owner(client)] += 1
+        return counts
+
+
+class Membership:
+    """The versioned fleet view `fleet.json` persists.
+
+    Every change appends a history record carrying the version AFTER the
+    change — strictly monotonic, replayable: `Membership.replay` folds
+    the history into the final shard set, and the unit battery asserts a
+    replayed manifest reproduces the live ring exactly.
+    """
+
+    def __init__(self, *, vnodes=DEFAULT_VNODES):
+        self.version = 0
+        self.vnodes = int(vnodes)
+        self.shards = {}   # shard id -> {"host", "port", "alive", "pid"}
+        self.history = []  # [{"version", "change", "shard"}]
+
+    def bump(self, change, shard, **fields):
+        """Apply one membership/liveness change and version it. Valid
+        `change`: add, remove, dead, alive."""
+        shard = str(shard)
+        if change == "add":
+            if shard in self.shards:
+                raise ValueError(f"shard {shard!r} already present")
+            self.shards[shard] = {"alive": True, **fields}
+        elif change == "remove":
+            self.shards.pop(shard)
+        elif change == "dead":
+            self.shards[shard]["alive"] = False
+            self.shards[shard].update(fields)
+        elif change == "alive":
+            self.shards[shard]["alive"] = True
+            self.shards[shard].update(fields)
+        else:
+            raise ValueError(f"unknown membership change {change!r}")
+        self.version += 1
+        self.history.append({"version": self.version, "change": change,
+                             "shard": shard})
+        return self.version
+
+    def ring(self):
+        """The HashRing this membership describes."""
+        ring = HashRing(sorted(self.shards), vnodes=self.vnodes)
+        for shard, row in self.shards.items():
+            if not row.get("alive", True):
+                ring.mark_dead(shard)
+        return ring
+
+    def as_dict(self):
+        return {"version": self.version, "vnodes": self.vnodes,
+                "shards": {s: dict(row) for s, row in self.shards.items()},
+                "history": [dict(h) for h in self.history]}
+
+    @classmethod
+    def from_dict(cls, payload):
+        membership = cls(vnodes=payload.get("vnodes", DEFAULT_VNODES))
+        membership.version = int(payload.get("version", 0))
+        membership.shards = {str(s): dict(row) for s, row
+                             in (payload.get("shards") or {}).items()}
+        membership.history = [dict(h) for h in payload.get("history") or []]
+        return membership
+
+    @classmethod
+    def replay(cls, payload):
+        """Fold the manifest's HISTORY (not its snapshot) into a
+        membership — the recovery-path proof that the persisted change
+        log alone reconstructs the ring. Raises on a non-monotonic
+        version sequence."""
+        membership = cls(vnodes=payload.get("vnodes", DEFAULT_VNODES))
+        for record in payload.get("history") or []:
+            version = membership.bump(record["change"], record["shard"])
+            if version != record["version"]:
+                raise ValueError(
+                    f"non-monotonic membership history: replayed version "
+                    f"{version} but the record says {record['version']}")
+        snapshot = payload.get("shards") or {}
+        for shard, row in snapshot.items():
+            membership.shards.setdefault(str(shard), {}).update(
+                {k: v for k, v in row.items() if k != "alive"})
+        return membership
+
+
+def write_fleet_manifest(directory, membership, name=FLEET_MANIFEST_NAME,
+                         **extra):
+    """Atomically persist the membership (checkpoint discipline: tmp +
+    fsync + replace) — called BEFORE the launcher/router act on a
+    change, so a crash can replay a stale view but never a torn one."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    payload = membership.as_dict()
+    payload.update(extra)
+    path = directory / name
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("w", encoding="utf-8") as fd:
+        fd.write(json.dumps(payload, indent="\t", sort_keys=True))
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_fleet_manifest(directory, name=FLEET_MANIFEST_NAME):
+    """The persisted manifest payload, or None when absent/torn."""
+    try:
+        return json.loads((pathlib.Path(directory) / name).read_text())
+    except (OSError, ValueError):
+        return None

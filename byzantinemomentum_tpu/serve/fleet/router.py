@@ -1,0 +1,469 @@
+"""The fleet's line-JSON router frontend: client → owner-shard forwarding.
+
+One `FleetRouter` fronts N `AggregationService` shard processes. A
+client connects to the router exactly as it would to a single-process
+`AggregationServer` (one JSON object per line each way — the protocol is
+unchanged); the router parses each line just enough to find its routing
+key (`clients[0]`: a cohort shares its first client's owner, which is
+what keeps whole cohorts shard-local), asks the consistent-hash ring for
+the OWNER shard, and forwards the raw line bytes — no re-encode — over
+that shard's connection. Replies pass back verbatim, so a shard-local
+verdict is byte-identical to what the single-process path would emit.
+
+Thread surface (the PR 14 covenant: every thread here has a schedule
+model in `analysis/schedule.py` and passes the BMT-T gate):
+
+* **connection threads** — one per client connection
+  (`socketserver.ThreadingTCPServer`): parse, route, enqueue the line on
+  the owner shard's `queue.Queue`, then block on the item's private
+  reply queue (no lock held — the T04 rule). The enqueue is
+  unconditional: liveness is read for POLICY, never as a send guard, so
+  a kill landing between the check and the enqueue cannot lose a line
+  (the `router_lost_forward_model` race, pinned schedule-clean).
+* **forwarder threads** — one per shard, the shard connection's sole
+  owner (sockets live in locals, never shared attributes). A forwarder
+  drains its queue in pipelined groups: write every line, flush once,
+  then read the replies in order (the shard frontend's per-connection
+  writer thread guarantees in-order replies), so the shard's
+  microbatcher sees concurrent requests and batches. Every item gets
+  EXACTLY ONE disposition — replied, or errored — decided at a single
+  point by its owning forwarder (the `router_double_resolve_model`
+  fix): once any byte of a line hit the wire, a failure ERRORS the line
+  rather than re-sending it, because a re-send could fold the same
+  cohort into the shard's suspicion store twice and corrupt verdicts.
+  Lines still queued behind a dead shard follow the `on_dead` policy:
+  `"queue"` parks them until the launcher restarts the shard on its
+  port (the arc revives, ownership never moved), `"error"` fails them
+  fast.
+* **health watcher** — probes dead arcs with short-lived ping
+  connections and revives them; under the `"error"` policy it is the
+  only revival path for a trafficless shard.
+
+Liveness changes go through `_set_liveness`, which calls the launcher's
+hook (persist the versioned membership FIRST — `fleet.json` discipline)
+before flipping the ring.
+
+Tracing (PR 13 extension): each routed line stamps `recv` → `routed` →
+`reply`, tiling the router-path latency into the `route` (parse + ring)
+and `shard_rtt` (queue wait + forward + shard service time) legs that
+`ATTRIB_serve_r16.json` records; `stats` carries the live summary.
+"""
+
+import json
+import queue
+import socket
+import socketserver
+import threading
+import time
+
+from byzantinemomentum_tpu.obs.trace import ROUTER_PHASES, percentile, \
+    phase_spans
+from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, HashRing
+
+__all__ = ["FleetRouter", "RouterServer"]
+
+# Lines written back-to-back per forwarder flush: bounds per-group reply
+# latency while keeping the owner shard's microbatcher fed
+_PIPELINE = 64
+
+
+class _Item:
+    """One routed line: raw bytes in, exactly one disposition out."""
+
+    __slots__ = ("raw", "reply_q", "stamps")
+
+    def __init__(self, raw, stamps=None):
+        self.raw = raw
+        self.reply_q = queue.Queue(maxsize=1)
+        self.stamps = stamps
+
+
+class FleetRouter:
+    """Consistent-hash router over `shards`: {shard id: (host, port)}."""
+
+    def __init__(self, shards, *, vnodes=DEFAULT_VNODES, on_dead="queue",
+                 reply_timeout=30.0, connect_timeout=2.0,
+                 retry_interval=0.05, probe_interval=0.25,
+                 trace_buffer=512, liveness_hook=None):
+        if on_dead not in ("queue", "error"):
+            raise ValueError(f"on_dead must be 'queue' or 'error', "
+                             f"got {on_dead!r}")
+        self.on_dead = on_dead
+        self._addresses = {str(s): tuple(addr) for s, addr in shards.items()}
+        self._ring = HashRing(sorted(self._addresses), vnodes=vnodes)
+        self._reply_timeout = float(reply_timeout)
+        self._connect_timeout = float(connect_timeout)
+        self._retry_interval = float(retry_interval)
+        self._probe_interval = float(probe_interval)
+        # `liveness_hook(shard, alive)` runs BEFORE the ring flips (the
+        # persist-before-change contract); it is called under the router
+        # lock and must not call back into the router.
+        self._liveness_hook = liveness_hook
+        self._lock = threading.Lock()
+        self._closed = False
+        self._wake = threading.Event()
+        self._routed = {s: 0 for s in self._addresses}
+        # Liveness epoch per arc: bumped on EVERY transition, so a
+        # forwarder can tell "my idle connection predates a
+        # kill+restart" and reconnect instead of erroring the first
+        # post-restart line into a dead socket
+        self._epochs = {s: 0 for s in self._addresses}
+        self._errors = 0
+        self._timeouts = 0
+        self._anon = 0
+        self._trace_buffer = int(trace_buffer)
+        self._spans = []  # bounded [(route_ms, shard_rtt_ms, total_ms)]
+        self._queues = {s: queue.Queue() for s in self._addresses}
+        self._forwarders = [
+            threading.Thread(target=self._forward_loop, args=(s,),
+                             name=f"fleet-forward-{s}", daemon=True)
+            for s in sorted(self._addresses)]
+        self._watcher = threading.Thread(target=self._watch_loop,
+                                         name="fleet-health-watcher",
+                                         daemon=True)
+        for thread in self._forwarders:
+            thread.start()
+        self._watcher.start()
+
+    # -------------------------------------------------------------- #
+    # liveness
+
+    def _is_closed(self):
+        with self._lock:
+            return self._closed
+
+    def _set_liveness(self, shard, alive):
+        """Flip one arc; persist-first via the hook; dedupes no-op
+        flips so concurrent detectors (forwarder + watcher) record one
+        transition. Returns True when the state actually changed."""
+        with self._lock:
+            if self._ring.alive(shard) == alive:
+                return False
+            if self._liveness_hook is not None:
+                self._liveness_hook(shard, alive)
+            if alive:
+                self._ring.mark_alive(shard)
+            else:
+                self._ring.mark_dead(shard)
+            self._epochs[shard] += 1
+            return True
+
+    def _epoch(self, shard):
+        with self._lock:
+            return self._epochs[shard]
+
+    def mark_dead(self, shard):
+        """Launcher-facing: the supervised process died."""
+        return self._set_liveness(str(shard), False)
+
+    def mark_alive(self, shard):
+        """Launcher-facing: the shard restarted on its port."""
+        return self._set_liveness(str(shard), True)
+
+    def dead_shards(self):
+        with self._lock:
+            return self._ring.dead
+
+    def owner(self, client):
+        """Pure ownership (liveness-blind) — determinism probes."""
+        return self._ring.owner(client)
+
+    # -------------------------------------------------------------- #
+    # the connection-thread path
+
+    def handle_line(self, raw, received_at=None):
+        """Route one client line; returns the reply BYTES (no newline).
+        Called from connection threads."""
+        received = time.monotonic() if received_at is None else received_at
+        raw = raw.strip()
+        try:
+            request = json.loads(raw)
+            if not isinstance(request, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as err:
+            return self._error_bytes(f"invalid request line: {err}")
+        op = request.get("op", "aggregate")
+        if op == "ping":
+            with self._lock:
+                payload = {"ok": True, "op": "ping", "router": True,
+                           "shards": len(self._addresses),
+                           "alive": (len(self._addresses)
+                                     - len(self._ring.dead))}
+            return json.dumps(payload).encode("utf-8")
+        if op == "stats":
+            return json.dumps(self.stats()).encode("utf-8")
+        clients = request.get("clients")
+        if clients:
+            key = str(clients[0])
+        else:
+            # No suspicion state to keep local: spread client-less
+            # lines round-robin instead of hot-spotting one arc
+            with self._lock:
+                self._anon += 1
+                key = f"anon:{self._anon}"
+        with self._lock:
+            shard = self._ring.owner(key)
+            alive = self._ring.alive(shard)
+            self._routed[shard] += 1
+        if not alive and self.on_dead == "error":
+            with self._lock:
+                self._errors += 1
+            return self._error_bytes(f"shard {shard} is dead "
+                                     f"(on_dead=error)", shard=shard)
+        item = _Item(raw, stamps={"recv": received})
+        item.stamps["routed"] = time.monotonic()
+        self._queues[shard].put(item)
+        try:
+            reply = item.reply_q.get(timeout=self._reply_timeout)
+        except queue.Empty:
+            with self._lock:
+                self._timeouts += 1
+            return self._error_bytes(f"shard {shard} reply timeout "
+                                     f"({self._reply_timeout}s)",
+                                     shard=shard)
+        item.stamps["reply"] = time.monotonic()
+        self._record_trace(item.stamps)
+        return reply
+
+    def _error_bytes(self, message, **extra):
+        return json.dumps({"ok": False, "error": f"router: {message}",
+                           **extra}).encode("utf-8")
+
+    def _record_trace(self, stamps):
+        spans = phase_spans(stamps, ROUTER_PHASES)
+        if spans is None:
+            return
+        total = (stamps["reply"] - stamps["recv"]) * 1000.0
+        with self._lock:
+            self._spans.append((spans["route"], spans["shard_rtt"], total))
+            if len(self._spans) > self._trace_buffer:
+                del self._spans[:len(self._spans) - self._trace_buffer]
+
+    # -------------------------------------------------------------- #
+    # the forwarder-thread path (sole owner of its shard connection)
+
+    def _connect(self, shard):
+        host, port = self._addresses[shard]
+        sock = socket.create_connection((host, port),
+                                        timeout=self._connect_timeout)
+        sock.settimeout(self._reply_timeout)
+        return sock, sock.makefile("rwb")
+
+    @staticmethod
+    def _close_sock(sock):
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _reply_error(self, item, message, shard=None):
+        with self._lock:
+            self._errors += 1
+        item.reply_q.put(self._error_bytes(message, **(
+            {"shard": shard} if shard is not None else {})))
+
+    def _forward_loop(self, shard):
+        q = self._queues[shard]
+        sock = files = None
+        epoch = None
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if files is not None and self._epoch(shard) != epoch:
+                # The arc transitioned (kill and/or restart) while this
+                # connection sat idle: it points at a dead process.
+                # Nothing of THIS batch touched the wire yet, so a
+                # reconnect is safe — no double-observe possible.
+                self._close_sock(sock)
+                sock = files = None
+            batch = [item]
+            while len(batch) < _PIPELINE:
+                try:
+                    extra = q.get_nowait()
+                except queue.Empty:
+                    break
+                if extra is None:
+                    q.put(None)  # re-arm the shutdown sentinel
+                    break
+                batch.append(extra)
+            # Ensure a connection. Under on_dead="queue" this retries
+            # until the launcher restarts the shard (the batch PARKS —
+            # nothing was sent, so a retry cannot double-observe);
+            # under "error" the batch fails fast.
+            while files is None:
+                if self._is_closed():
+                    for it in batch:
+                        self._reply_error(it, "router is closing", shard)
+                    batch = []
+                    break
+                try:
+                    sock, files = self._connect(shard)
+                    self._set_liveness(shard, True)
+                    epoch = self._epoch(shard)
+                except OSError as err:
+                    self._set_liveness(shard, False)
+                    if self.on_dead == "error":
+                        for it in batch:
+                            self._reply_error(
+                                it, f"shard {shard} unreachable: {err}",
+                                shard)
+                        batch = []
+                        break
+                    self._wake.wait(self._retry_interval)
+            if not batch:
+                continue
+            try:
+                for it in batch:
+                    files.write(it.raw + b"\n")
+                files.flush()
+                for index, it in enumerate(batch):
+                    reply = files.readline()
+                    if not reply:
+                        raise OSError("connection closed by shard")
+                    it.reply_q.put(reply.rstrip(b"\n"))
+                    batch[index] = None
+            except OSError as err:
+                # Past the first wire byte delivery is UNCERTAIN: a
+                # re-send could fold the same cohort into the shard's
+                # suspicion store twice (verdict corruption), so every
+                # undisposed item of this group ERRORS — exactly one
+                # disposition, owned here.
+                self._close_sock(sock)
+                sock = files = None
+                self._set_liveness(shard, False)
+                for it in batch:
+                    if it is not None:
+                        self._reply_error(
+                            it, f"shard {shard} died mid-request: {err}",
+                            shard)
+        self._close_sock(sock)
+
+    # -------------------------------------------------------------- #
+    # the health-watcher thread
+
+    def _probe(self, shard):
+        try:
+            sock, files = self._connect(shard)
+        except OSError:
+            return False
+        try:
+            files.write(b'{"op": "ping"}\n')
+            files.flush()
+            reply = files.readline()
+            return bool(reply)
+        except OSError:
+            return False
+        finally:
+            self._close_sock(sock)
+
+    def _watch_loop(self):
+        while True:
+            self._wake.wait(self._probe_interval)
+            if self._is_closed():
+                return
+            for shard in self.dead_shards():
+                if self._probe(shard):
+                    self._set_liveness(shard, True)
+
+    # -------------------------------------------------------------- #
+
+    def stats(self):
+        """Router-level stats + trace summary (shard internals stay
+        shard-local: ask a shard's own `stats` op for its view)."""
+        with self._lock:
+            spans = list(self._spans)
+            payload = {
+                "ok": True, "op": "stats", "router": True,
+                "on_dead": self.on_dead,
+                "shards": {s: {"routed": self._routed[s],
+                               "alive": self._ring.alive(s),
+                               "address": list(self._addresses[s])}
+                           for s in sorted(self._addresses)},
+                "dead": list(self._ring.dead),
+                "errors": self._errors,
+                "timeouts": self._timeouts,
+                "queued": {s: self._queues[s].qsize()
+                           for s in sorted(self._addresses)},
+            }
+        if spans:
+            payload["trace"] = {
+                "traced": len(spans),
+                "route": {"p50_ms": percentile([s[0] for s in spans], 50),
+                          "p99_ms": percentile([s[0] for s in spans], 99)},
+                "shard_rtt": {
+                    "p50_ms": percentile([s[1] for s in spans], 50),
+                    "p99_ms": percentile([s[1] for s in spans], 99)},
+                "total": {"p50_ms": percentile([s[2] for s in spans], 50),
+                          "p99_ms": percentile([s[2] for s in spans], 99)},
+            }
+        return payload
+
+    def trace_spans(self):
+        """[(route_ms, shard_rtt_ms, total_ms)] — the raw tiling rows
+        the ATTRIB artifact aggregates."""
+        with self._lock:
+            return list(self._spans)
+
+    def close(self, timeout=5.0):
+        """Stop every thread; parked lines error. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._wake.set()
+        for q in self._queues.values():
+            q.put(None)
+        for thread in self._forwarders:
+            thread.join(timeout=timeout)
+        self._watcher.join(timeout=timeout)
+        # Anything a forwarder left parked gets its one disposition
+        for shard, q in self._queues.items():
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    self._reply_error(item, "router closed", shard)
+
+
+class _RouterHandler(socketserver.StreamRequestHandler):
+    """One thread per client connection; the router does the work."""
+
+    def handle(self):
+        for raw in self.rfile:
+            received_at = time.monotonic()
+            try:
+                reply = self.server.router.handle_line(raw, received_at)
+            except Exception as err:  # bmt: noqa[BMT-E05] a failed route must answer its line, not sever every client on this connection
+                reply = json.dumps({"ok": False,
+                                    "error": f"router: {err}"}).encode()
+            try:
+                self.wfile.write(reply + b"\n")
+                self.wfile.flush()
+            except OSError:
+                return  # client went away mid-reply
+
+
+class RouterServer(socketserver.ThreadingTCPServer):
+    """TCP front door for a `FleetRouter` (protocol-identical to
+    `AggregationServer`, so clients cannot tell fleet from single)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, address, router):
+        self.router = router
+        super().__init__(address, _RouterHandler)
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+    def serve_background(self):
+        thread = threading.Thread(target=self.serve_forever,
+                                  name="fleet-router", daemon=True)
+        thread.start()
+        return thread

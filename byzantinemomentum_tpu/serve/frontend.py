@@ -13,10 +13,14 @@ response line per request line, in order:
 
 Errors answer `{"ok": false, "error": "..."}` on the same line slot; a
 malformed line never kills the connection, let alone the server. Each
-connection gets its own handler thread (`ThreadingTCPServer`), and the
-handler blocks on ITS request's future only — the service's dispatch
-stays batched and asynchronous underneath, so concurrent connections
-pack into shared device programs.
+connection gets its own handler thread (`ThreadingTCPServer`) plus a
+per-connection WRITER thread: the reader submits each line without
+blocking on its result and hands the future down an in-order reply
+queue the writer drains — so one connection can hold many requests in
+flight (the fleet router pipelines whole groups down a single shard
+connection) and the microbatcher still packs them into shared device
+programs. Replies stay strictly in request order; a client that sends
+one line and waits sees exactly the old behavior.
 
 Trace-id propagation (`obs/trace/request.py`): an optional `"trace"`
 field (string or number) names the request's trace; with tracing on the
@@ -28,6 +32,7 @@ answers an error on its line slot without severing the connection.
 """
 
 import json
+import queue
 import socketserver
 import threading
 import time
@@ -40,30 +45,62 @@ __all__ = ["AggregationServer", "serve_forever"]
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         service = self.server.service
-        for raw in self.rfile:
-            received_at = time.monotonic()  # before the JSON decode:
-            #                                 parse cost is attributed
-            line = raw.strip()
-            if not line:
-                continue
+        # In-order reply lane: the reader thread (this one) enqueues a
+        # dict (already-answered op/error) or a Future per line; the
+        # writer resolves and writes them in request order, so replies
+        # pipeline without ever reordering
+        replies = queue.Queue()
+        writer = threading.Thread(target=self._write_loop, args=(replies,),
+                                  name="serve-conn-writer", daemon=True)
+        writer.start()
+        try:
+            for raw in self.rfile:
+                received_at = time.monotonic()  # before the JSON decode:
+                #                                 parse cost is attributed
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    replies.put(self._one(service, json.loads(line),
+                                          received_at))
+                except (ValueError, KeyError, TypeError,
+                        utils.UserException) as err:
+                    replies.put({"ok": False, "error": str(err)})
+                except Exception as err:  # bmt: noqa[BMT-E05] a failed request must answer its line, not sever every client on this connection
+                    replies.put({"ok": False,
+                                 "error": f"{type(err).__name__}: {err}"})
+        finally:
+            replies.put(None)
+            writer.join()
+
+    def _write_loop(self, replies):
+        """Drain the reply lane in order; a future blocks only its own
+        line (later futures keep computing underneath)."""
+        broken = False
+        while True:
+            entry = replies.get()
+            if entry is None:
+                return
+            if not isinstance(entry, dict):
+                try:
+                    entry = {"ok": True, **entry.result().as_dict()}
+                except utils.UserException as err:
+                    entry = {"ok": False, "error": str(err)}
+                except Exception as err:  # bmt: noqa[BMT-E05] a failed request must answer its line, not sever every client on this connection
+                    entry = {"ok": False,
+                             "error": f"{type(err).__name__}: {err}"}
+            if broken:
+                continue  # client hung up: keep draining to the sentinel
             try:
-                response = self._one(service, json.loads(line),
-                                     received_at)
-            except (ValueError, KeyError, TypeError,
-                    utils.UserException) as err:
-                response = {"ok": False, "error": str(err)}
-            except Exception as err:  # bmt: noqa[BMT-E05] a failed request must answer its line, not sever every client on this connection
-                response = {"ok": False,
-                            "error": f"{type(err).__name__}: {err}"}
-            try:
-                self.wfile.write(json.dumps(response).encode("utf-8")
-                                 + b"\n")
+                self.wfile.write(json.dumps(entry).encode("utf-8") + b"\n")
                 self.wfile.flush()
             except OSError:
-                return  # client hung up mid-response
+                broken = True
 
     @staticmethod
     def _one(service, request, received_at=None):
+        """One parsed line -> an answered dict (ops) or the request's
+        Future (aggregate) for the writer to resolve in order."""
         if not isinstance(request, dict):
             raise ValueError("expected a JSON object per line")
         op = request.get("op", "aggregate")
@@ -82,15 +119,13 @@ class _Handler(socketserver.StreamRequestHandler):
                 f"trace id must be a string or number, got "
                 f"{type(trace_id).__name__}")
         vectors = request["vectors"]
-        future = service.submit(
+        return service.submit(
             vectors,
             gar=request.get("gar", "krum"),
             f=int(request.get("f", 1)),
             client_ids=request.get("clients"),
             diagnostics=request.get("diagnostics"),
             trace_id=trace_id, received_at=received_at)
-        result = future.result()
-        return {"ok": True, **result.as_dict()}
 
 
 class AggregationServer(socketserver.ThreadingTCPServer):

@@ -372,17 +372,31 @@ class AggregationService:
             if r.trace is not None and r.trace.batch_stamps is not None:
                 r.trace.batch_stamps["device"] = now
                 break  # shared dict: one store covers the batch
+        # Batched suspicion fold: slice the aux OUTSIDE the lock (it is
+        # cohort-local), then update the store once per BATCH under one
+        # acquisition — submitter threads (admission `decide`) contend
+        # on this lock, so per-request round-trips were resolve-span
+        # latency. `observe_batch` keeps per-request fold order, so
+        # verdicts are byte-identical to the sequential path.
+        items, rows = [], []
         for i, r in enumerate(requests):
-            verdicts = None
             if r.cell.diagnostics and r.client_ids is not None:
-                with self._suspicion_lock:
-                    verdicts = self.suspicion.observe(
-                        r.client_ids,
-                        host["selection"][i, :r.n],
-                        distances=host["worker_dist"][i, :r.n],
-                        active=r.admitted,
-                        dist=(host["dist"][i, :r.n, :r.n]
-                              if "dist" in host else None))
+                items.append(dict(
+                    client_ids=r.client_ids,
+                    selection=host["selection"][i, :r.n],
+                    distances=host["worker_dist"][i, :r.n],
+                    active=r.admitted,
+                    dist=(host["dist"][i, :r.n, :r.n]
+                          if "dist" in host else None)))
+                rows.append(i)
+        if items:
+            with self._suspicion_lock:
+                folded = self.suspicion.observe_batch(items)
+            batch_verdicts = dict(zip(rows, folded))
+        else:
+            batch_verdicts = {}
+        for i, r in enumerate(requests):
+            verdicts = batch_verdicts.get(i)
             done = time.monotonic()
             if r.trace is not None:
                 # Hot path: stamp + ring append only — the dict/rounding

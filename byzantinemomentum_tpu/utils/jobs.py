@@ -52,6 +52,12 @@ heartbeat, the watchdog SIGKILLs it (the hosts die with it through
 their launcher-held stdin pipes), and the retry's `--auto-resume`
 relaunches the fleet from the off-slice checkpoint mirror
 (`tests/test_cluster.py::test_jobs_supervises_cluster_launcher_service_job`).
+The serve fleet launcher (PR 16, `serve/fleet/launcher.py`) follows the
+same aggregated-heartbeat contract — per-shard serve heartbeats sum into
+one top-level `heartbeat.json` whose `step` is total requests served —
+so the identical seedless form supervises an N-shard aggregation fleet
+too: shard restarts are the launcher's job, launcher death is this
+watchdog's.
 """
 
 import os

@@ -20,7 +20,11 @@ tracing-overhead row is informational). A `BENCH_serve.json` pair
 service the same way:
 p50/p99 latencies are costs (growth fails), aggregations/s and the
 batched-vs-sequential speedup are rates (drops fail), and cross-backend
-pairs are INCOMPARABLE. That is the phase-budget gate: a PR that regrows the relayout
+pairs are INCOMPARABLE. A `BENCH_serve_fleet.json` pair (`"kind":
+"serve_fleet"`, `--fleet`) compares aggregations/s per (scenario,
+shard-count) cell and fails on any recovery invariant flipping false;
+pairs from different fleet sizes, host core counts or isolation modes
+are INCOMPARABLE — a 4-shard rate says nothing about a 2-shard one. That is the phase-budget gate: a PR that regrows the relayout
 copies or host gaps the r5 packing work removed (PERF_NOTES.md) fails CI
 here instead of silently eating the win inside an unchanged steps/s
 tolerance band.
@@ -50,7 +54,7 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 __all__ = ["load_artifact", "compare", "compare_attribution",
            "compare_cluster", "compare_health", "compare_serve",
-           "compare_serve_attribution", "main"]
+           "compare_serve_attribution", "compare_serve_fleet", "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -285,6 +289,60 @@ def compare_serve_attribution(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+def compare_serve_fleet(old_payload, new_payload, tolerance):
+    """The sharded-fleet gate over two `BENCH_serve_fleet.json` payloads
+    (`scripts/serve_loadgen.py --fleet`): aggregations/s per (scenario,
+    shard-count) cell is a RATE — the gate fails on a DROP past
+    `tolerance` — and only cells present in BOTH artifacts at the SAME
+    shard count are compared (a 2-shard rate vs a 4-shard rate measures
+    fleet size, not code; the caller treats mismatched shard-count sets
+    as INCOMPARABLE before reaching here). The recovery booleans
+    (parked-line recovery, survivor monotonicity, the re-warm bound)
+    regress by FLIPPING false — any of them false in the new artifact
+    fails regardless of tolerance, because a fleet that corrupts a
+    survivor's verdict stream during failover is wrong at any speed.
+    `fleet_speedup` is INFORMATIONAL: on a 1-core host (`host_cores`) a
+    shard count cannot buy parallelism, so its trajectory is rendered by
+    bench_history, not gated."""
+    rows = []
+    regressions = []
+    old_scen = old_payload.get("scenarios") or {}
+    new_scen = new_payload.get("scenarios") or {}
+    for scenario in sorted(old_scen):
+        if scenario not in new_scen:
+            continue
+        for count in sorted(old_scen[scenario],
+                            key=lambda c: (len(c), c)):
+            if count not in new_scen[scenario]:
+                continue
+            old = (old_scen[scenario][count] or {}).get("agg_per_sec")
+            new = (new_scen[scenario][count] or {}).get("agg_per_sec")
+            if not (isinstance(old, (int, float)) and old > 0
+                    and isinstance(new, (int, float))):
+                continue
+            delta = new / old - 1.0
+            rows.append((f"{scenario}.shards_{count}.agg_per_sec",
+                         float(old), float(new), delta))
+            if delta < -tolerance:
+                regressions.append(rows[-1])
+    for key in ("parked_line_recovered", "survivor_monotonic",
+                "rewarm_no_faster_than_fresh"):
+        old = (old_payload.get("recovery") or {}).get(key)
+        new = (new_payload.get("recovery") or {}).get(key)
+        if isinstance(old, bool) and isinstance(new, bool):
+            rows.append((f"recovery.{key}", float(old), float(new),
+                         0.0 if new >= old else -1.0))
+            if not new:
+                regressions.append(rows[-1])
+    old = old_payload.get("fleet_speedup")
+    new = new_payload.get("fleet_speedup")
+    if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+        delta = (new / old - 1.0) if old > 0 else 0.0
+        rows.append(("fleet_speedup (info)", float(old), float(new),
+                     delta))
+    return rows, regressions
+
+
 # The health-overhead fraction is an absolute few-percent figure; growth
 # below one percentage point is measurement noise on any host and never
 # fails the gate on its own
@@ -430,6 +488,53 @@ def main(argv=None):
         if regressions:
             print(f"bench_compare: {len(regressions)} serve phase(s) grew "
                   f"past the {args.tolerance * 100:.1f}% tolerance")
+            return 1
+        return 0
+
+    is_fleet = [p.get("kind") == "serve_fleet" for p in payloads]
+    if any(is_fleet):
+        # Sharded-fleet gate over two BENCH_serve_fleet.json artifacts
+        if not all(is_fleet):
+            print("bench_compare: INCOMPARABLE — one artifact is a serve "
+                  "fleet report, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — fleet runs from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        cores = [p.get("host_cores") for p in payloads]
+        if cores[0] != cores[1]:
+            print(f"bench_compare: INCOMPARABLE — fleet runs from hosts "
+                  f"with different core counts ({cores[0]} vs {cores[1]}) "
+                  f"— shard throughput is core-bound")
+            return 0
+        isolation = [p.get("isolation") for p in payloads]
+        if isolation[0] != isolation[1]:
+            print(f"bench_compare: INCOMPARABLE — fleet isolation modes "
+                  f"differ ({isolation[0]} vs {isolation[1]})")
+            return 0
+        sizes = [sorted((p.get("config") or {}).get("shard_counts") or [],
+                        key=str) for p in payloads]
+        if sizes[0] != sizes[1]:
+            print(f"bench_compare: INCOMPARABLE — different fleet sizes "
+                  f"({sizes[0]} vs {sizes[1]} shards)")
+            return 0
+        rows, regressions = compare_serve_fleet(old_payload, new_payload,
+                                                args.tolerance)
+        if not rows:
+            print("  no common fleet cells; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.3f} -> {new:10.3f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} fleet metric(s) "
+                  f"regressed past the {args.tolerance * 100:.1f}% "
+                  f"tolerance")
             return 1
         return 0
 

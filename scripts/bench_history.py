@@ -53,10 +53,11 @@ sys.path.insert(0, str(ROOT / "scripts"))
 
 from bench_compare import load_artifact, _rates  # noqa: E402
 
-__all__ = ["collect_cluster", "collect_history", "collect_serve",
-           "collect_serve_attrib", "collect_tournament", "render_table",
-           "main", "GAR_COLUMN", "CLUSTER_COLUMNS", "SERVE_COLUMNS",
-           "SERVE_ATTRIB_COLUMNS", "TOURNAMENT_COLUMNS"]
+__all__ = ["collect_cluster", "collect_fleet", "collect_history",
+           "collect_serve", "collect_serve_attrib", "collect_tournament",
+           "render_table", "main", "GAR_COLUMN", "CLUSTER_COLUMNS",
+           "FLEET_COLUMNS", "SERVE_COLUMNS", "SERVE_ATTRIB_COLUMNS",
+           "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -258,6 +259,57 @@ def collect_cluster(root, labels):
             if (stats := _cluster_stats(root, label)) is not None}
 
 
+# Sharded-fleet trajectory columns (`scripts/serve_loadgen.py --fleet`
+# artifacts, r16): the routed rotation-scenario throughput at the
+# round's LARGEST shard count, that count, and whether every failover
+# invariant held (parked-line recovery, survivor monotonicity, the
+# re-warm bound) — 1 means the kill round corrupted nothing
+FLEET_COLUMNS = ("fleet shards", "fleet agg/s", "fleet ok")
+
+
+def _fleet_stats(root, label):
+    """`{shards, rate, recovery_ok, backend} | None` for one round's
+    fleet artifact: `BENCH_serve_fleet_r*.json` per round, the working
+    tree's `BENCH_serve_fleet.json` for the `current` row. The rate is
+    the rotation scenario at the largest shard count measured (the
+    cross-shard-count INCOMPARABLE discipline lives in bench_compare;
+    here the trajectory just names which count it renders)."""
+    name = ("BENCH_serve_fleet.json" if label == "current"
+            else f"BENCH_serve_fleet_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "serve_fleet":
+        return None
+    rotation = (payload.get("scenarios") or {}).get("rotation") or {}
+    counts = sorted((c for c in rotation if c.isdigit()), key=int)
+    if not counts:
+        return None
+    top = counts[-1]
+    rate = (rotation[top] or {}).get("agg_per_sec")
+    recovery = payload.get("recovery") or {}
+    flags = [recovery.get(k) for k in ("parked_line_recovered",
+                                       "survivor_monotonic",
+                                       "rewarm_no_faster_than_fresh")]
+    return {"shards": int(top),
+            "rate": float(rate) if isinstance(rate, (int, float)) else None,
+            "recovery_ok": (None if not any(isinstance(f, bool)
+                                            for f in flags)
+                            else all(f for f in flags
+                                     if isinstance(f, bool))),
+            "backend": payload.get("backend")}
+
+
+def collect_fleet(root, labels):
+    """{label: fleet stats} over the history rows (independent
+    instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _fleet_stats(root, label)) is not None}
+
+
 # Flight-recorder trajectory column (`scripts/health_overhead.py`
 # artifacts): the paired on/off steps/s overhead of the in-jit health
 # vector — the telemetry discipline's number, per round
@@ -323,7 +375,9 @@ def collect_history(root=ROOT):
                            r"TOURNAMENT_r(\d+)\.json$"),
                           ("CLUSTER_r*.json", r"CLUSTER_r(\d+)\.json$"),
                           ("BENCH_health_r*.json",
-                           r"BENCH_health_r(\d+)\.json$")):
+                           r"BENCH_health_r(\d+)\.json$"),
+                          ("BENCH_serve_fleet_r*.json",
+                           r"BENCH_serve_fleet_r(\d+)\.json$")):
         for path in root.glob(glob):
             m = re.search(pattern, path.name)
             if m:
@@ -336,7 +390,8 @@ def collect_history(root=ROOT):
             or (root / "ATTRIB_serve.json").is_file()
             or (root / "TOURNAMENT.json").is_file()
             or (root / "CLUSTER.json").is_file()
-            or (root / "BENCH_health.json").is_file()):
+            or (root / "BENCH_health.json").is_file()
+            or (root / "BENCH_serve_fleet.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
     for label, path in zip(labels, paths):
@@ -366,7 +421,7 @@ def _load_rates(path):
 
 
 def render_table(history, serve=None, tournament=None, cluster=None,
-                 serve_attrib=None, health=None):
+                 serve_attrib=None, health=None, fleet=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
@@ -380,6 +435,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     cluster = cluster or {}
     serve_attrib = serve_attrib or {}
     health = health or {}
+    fleet = fleet or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
@@ -387,7 +443,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 columns.append(name)
     any_gar = any(gar is not None for _, _, _, gar in history)
     if not columns and not any_gar and not serve and not tournament \
-            and not cluster and not serve_attrib and not health:
+            and not cluster and not serve_attrib and not health \
+            and not fleet:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -404,6 +461,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         columns = columns + list(CLUSTER_COLUMNS)
     if health:
         columns = columns + list(HEALTH_COLUMNS)
+    if fleet:
+        columns = columns + list(FLEET_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -433,6 +492,11 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         row_tournament = tournament.get(label)
         row_cluster = cluster.get(label)
         row_health = health.get(label)
+        row_fleet = fleet.get(label)
+        if row_fleet is not None and row_fleet.get("backend") not in (
+                None, "tpu"):
+            notes.append(f"  {label}: fleet columns from a "
+                         f"backend={row_fleet['backend']} fleet run")
         if row_health is not None and row_health.get("backend") not in (
                 None, "tpu"):
             notes.append(f"  {label}: health overhead from a "
@@ -488,6 +552,15 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 if row_health is None:
                     return f"{'-':>{w}}"
                 return f"{row_health['overhead_frac'] * 100:>{w}.2f}"
+            if c in FLEET_COLUMNS:
+                key = {"fleet shards": "shards", "fleet agg/s": "rate",
+                       "fleet ok": "recovery_ok"}[c]
+                value = None if row_fleet is None else row_fleet.get(key)
+                if value is None:
+                    return f"{'-':>{w}}"
+                if key == "rate":
+                    return f"{value:>{w}.3f}"
+                return f"{int(value):>{w}d}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -527,6 +600,8 @@ def main(argv=None):
                               [label for label, *_ in history])
     health = collect_health(pathlib.Path(args.root),
                             [label for label, *_ in history])
+    fleet = collect_fleet(pathlib.Path(args.root),
+                          [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
@@ -536,11 +611,12 @@ def main(argv=None):
              "serve_attrib": serve_attrib.get(label),
              "tournament": tournament.get(label),
              "cluster": cluster.get(label),
-             "health": health.get(label)}
+             "health": health.get(label),
+             "fleet": fleet.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
     print(render_table(history, serve, tournament, cluster, serve_attrib,
-                       health))
+                       health, fleet))
     return 0
 
 

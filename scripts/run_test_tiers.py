@@ -247,6 +247,18 @@ def main():
                 continue
             serve_tier["trace_tile_error"] = parsed.get("tile_error_frac")
             serve_tier["trace_overhead"] = parsed.get("overhead_frac")
+    # The selfcheck's fleet phase (PR 16): the 2-shard in-process ring —
+    # shard count and routed-vs-direct throughput ratio, printed as one
+    # `serve fleet: {...}` line (the phase itself ASSERTS ownership
+    # exactness, the kill/readmit re-warm bound and zero recompiles)
+    for line in serve_check.stdout.splitlines():
+        if line.startswith("serve fleet: {"):
+            try:
+                parsed = json.loads(line[len("serve fleet: "):])
+            except ValueError:
+                continue
+            serve_tier["fleet_shards"] = parsed.get("shards")
+            serve_tier["fleet_speedup"] = parsed.get("fleet_speedup")
     for label, proc in (("selfcheck", serve_check), ("loadgen", serve_load)):
         if proc.returncode != 0:
             serve_tier[f"{label}_tail"] = (proc.stdout

@@ -46,12 +46,26 @@ measured request latency (the artifact records the tiling error and the
 each request observed, and the tracing-on-vs-off throughput overhead
 (paired saturation windows, median of per-pair ratios — robust to the
 1-core host's drift). `bench_compare.py` gates two of these per phase;
-committed rounds live as `ATTRIB_serve_r*.json`.
+committed rounds live as `ATTRIB_serve_r*.json`. Since r16 the payload
+also carries the `router` block: the 2-shard fleet router's `route` +
+`shard_rtt` spans and their tiling against the client-measured wall.
+
+Fleet mode (`--fleet`, r16): scenario traffic (`FLEET_SCENARIOS`)
+through a real consistent-hash `FleetRouter` TCP front door at each
+`--shards` count, plus the kill-safe failover round (shard killed
+mid-traffic: parked line recovers, survivor verdicts untouched,
+returning arc re-warms no faster than a fresh id). Writes
+`BENCH_serve_fleet.json` (`"kind": "serve_fleet"`), gated by
+`bench_compare.py compare_serve_fleet`. Shards are in-process
+(`serve/fleet/local.py`) — see `run_fleet` for why; `--router
+HOST:PORT` drives an external `python -m byzantinemomentum_tpu
+.serve.fleet` instead.
 
 Usage:
   python scripts/serve_loadgen.py [--smoke] [--out BENCH_serve.json]
   python scripts/serve_loadgen.py --requests 600 --rate 400
   python scripts/serve_loadgen.py --trace [--out ATTRIB_serve.json]
+  python scripts/serve_loadgen.py --fleet --shards 1,2,4
 
 All traffic runs against the in-process `AggregationService` (the same
 engine the socket front end wraps) on one cell, client ids attached, so
@@ -69,8 +83,19 @@ import numpy as np
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
-__all__ = ["run_loadgen", "run_hetero", "run_trace", "pr8_policy_cells",
-           "percentiles", "main"]
+__all__ = ["run_loadgen", "run_hetero", "run_trace", "run_fleet",
+           "run_router_trace", "pr8_policy_cells", "percentiles",
+           "FLEET_SCENARIOS", "main"]
+
+# Named fleet population scenarios (`--fleet`): how client ids arrive.
+#   rotation  uniform round-robin over a fixed population — the
+#             best-case spread the consistent-hash ring should match;
+#   zipf      heavy-tailed popularity (a few hot clients dominate) —
+#             the worst realistic case for per-shard balance;
+#   churn     generational turnover (ids appear, age out, never
+#             return) — exercises store growth + eviction, and the ring
+#             mapping fresh ids across all arcs.
+FLEET_SCENARIOS = ("rotation", "zipf", "churn")
 
 
 def percentiles(latencies_ms):
@@ -398,6 +423,325 @@ def run_trace(*, requests=400, n=11, d=128, f=2, gar="krum", max_batch=8,
         sys.setswitchinterval(old_switch)
 
 
+def _scenario_bases(name, requests, population, rng):
+    """The routing-key stream of one named scenario: request k's cohort
+    is keyed by its FIRST client id, so these bases are what the ring
+    actually routes on (the rest of each cohort rides along)."""
+    if name == "rotation":
+        return [f"r{k % population}" for k in range(requests)]
+    if name == "zipf":
+        ranks = np.minimum(rng.zipf(1.2, size=requests),
+                           population).astype(int) - 1
+        return [f"z{int(r)}" for r in ranks]
+    if name == "churn":
+        # A new generation of ids every 2*population requests; old
+        # generations never return (eviction-shaped traffic)
+        return [f"ch{(k % population) + (k // (2 * population)) * population}"
+                for k in range(requests)]
+    raise ValueError(f"unknown fleet scenario {name!r} "
+                     f"(have {FLEET_SCENARIOS})")
+
+
+def _drive_router(host, port, payloads, connections=8):
+    """Closed-loop client pool against a router (or single-server)
+    socket: `connections` threads, each with its own connection, each
+    one request in flight — concurrency comes from the pool, so the
+    router's per-shard pipelining and the shards' microbatchers see
+    parallel traffic. Returns (wall_s, latencies_ms, errors)."""
+    import queue as queue_mod
+    import threading
+
+    from byzantinemomentum_tpu.serve.fleet.local import (ask_socket,
+                                                         fleet_socket)
+
+    work = queue_mod.Queue()
+    for payload in payloads:
+        work.put(payload)
+    lock = threading.Lock()
+    latencies, errors = [], [0]
+
+    def client():
+        sock, files = fleet_socket(host, port, timeout=120)
+        try:
+            while True:
+                try:
+                    request = work.get_nowait()
+                except queue_mod.Empty:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    reply = ask_socket(files, request)
+                except OSError:
+                    reply = {"ok": False}
+                ms = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    if reply.get("ok"):
+                        latencies.append(ms)
+                    else:
+                        errors[0] += 1
+        finally:
+            sock.close()
+
+    threads = [threading.Thread(target=client, name=f"loadgen-client-{i}",
+                                daemon=True)
+               for i in range(connections)]
+    t0 = time.perf_counter()
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    return time.perf_counter() - t0, latencies, errors[0]
+
+
+def _fleet_payloads(bases, n, d, f, gar, rng):
+    return [{"op": "aggregate", "gar": gar, "f": f,
+             "vectors": rng.standard_normal((n, d)).astype(
+                 np.float32).tolist(),
+             "clients": [base] + [f"{base}.{j}" for j in range(1, n)]}
+            for base in bases]
+
+
+def _fleet_recovery(fleet, *, n, d, f, gar, rng):
+    """The kill-safe failover round on a live fleet: kill one shard,
+    verify (a) a line routed to the dead arc PARKS (on_dead="queue")
+    and completes after the restart, (b) the survivor's verdict stream
+    is untouched — zero errors, observations exactly monotonic — and
+    (c) the returning arc's clients re-warm no faster than a fresh id."""
+    import threading
+
+    from byzantinemomentum_tpu.serve.fleet.local import (ask_socket,
+                                                         fleet_socket)
+
+    shards = fleet.shards
+    victim = shards[0]
+    v_base = next(f"vic{k}" for k in range(10_000)
+                  if fleet.owner(f"vic{k}") == victim)
+    s_base = next(f"sur{k}" for k in range(10_000)
+                  if fleet.owner(f"sur{k}") != victim)
+
+    def ask(base):
+        return fleet.ask(_fleet_payloads([base], n, d, f, gar, rng)[0])
+
+    for _ in range(3):
+        before_v = ask(v_base)["verdicts"][v_base]["observations"]
+        before_s = ask(s_base)["verdicts"][s_base]["observations"]
+    fleet.kill(victim)
+    # The parked line: routed to the dead arc, it must wait out the
+    # outage and complete after the restart (exactly one disposition)
+    parked = {}
+
+    def park():
+        sock, files = fleet_socket("127.0.0.1", fleet.port, timeout=60)
+        try:
+            parked["reply"] = ask_socket(
+                files, _fleet_payloads([v_base], n, d, f, gar, rng)[0])
+        finally:
+            sock.close()
+
+    parker = threading.Thread(target=park, name="loadgen-parked",
+                              daemon=True)
+    parker.start()
+    # Survivor traffic rides through the outage untouched
+    outage_errors = 0
+    for _ in range(5):
+        reply = ask(s_base)
+        if not reply.get("ok"):
+            outage_errors += 1
+        else:
+            after_s = reply["verdicts"][s_base]["observations"]
+    fleet.restart(victim)
+    parker.join(timeout=60)
+    parked_reply = parked.get("reply") or {"ok": False}
+    rewarm = (parked_reply["verdicts"][v_base]["observations"]
+              if parked_reply.get("ok") else None)
+    fresh_base = next(f"fr{k}" for k in range(10_000)
+                      if fleet.owner(f"fr{k}") == victim)
+    fresh = ask(fresh_base)["verdicts"][fresh_base]["observations"]
+    return {
+        "killed": victim,
+        "on_dead": fleet.router.on_dead,
+        "parked_line_recovered": bool(parked_reply.get("ok")),
+        "survivor_errors": outage_errors,
+        "survivor_observations": {"before": before_s, "after": after_s},
+        "survivor_monotonic": bool(after_s == before_s + 5 - outage_errors),
+        "rewarm_observations": rewarm,
+        "fresh_observations": fresh,
+        "rewarm_no_faster_than_fresh": bool(rewarm == fresh),
+        "victim_observations_before_kill": before_v,
+    }
+
+
+def run_fleet(*, shard_counts=(1, 2, 4), scenarios=FLEET_SCENARIOS,
+              requests=240, population=64, n=5, d=64, f=1, gar="median",
+              max_batch=8, max_delay_ms=2.0, connections=8, seed=1,
+              vnodes=None, recovery=True, router=None):
+    """The sharded-fleet measurement: each named scenario driven through
+    a real `FleetRouter` TCP front door at each shard count, plus the
+    kill-safe failover round at the largest fleet. Returns the
+    `BENCH_serve_fleet.json` payload (`"kind": "serve_fleet"`).
+
+    Shards are IN-PROCESS (`serve/fleet/local.py`): real router, real
+    per-shard sockets and stores — everything the router path measures —
+    without N jax processes fighting for this host's cores (on the
+    1-core CI box a subprocess fleet measures the OS scheduler, not the
+    router; the artifact stamps `host_cores` so `bench_compare` can
+    refuse cross-host comparisons). The subprocess launcher path is
+    covered by the slow test tier (`tests/test_fleet.py`) instead.
+    `router="host:port"` drives an EXTERNAL, already-running fleet
+    (`python -m byzantinemomentum_tpu.serve.fleet`) and skips the
+    in-process builds and the recovery round."""
+    import os
+
+    import jax
+
+    from byzantinemomentum_tpu.serve.fleet.local import LocalFleet
+    from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES
+
+    vnodes = DEFAULT_VNODES if vnodes is None else int(vnodes)
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    rng = np.random.default_rng(seed)
+    scenario_rows = {name: {} for name in scenarios}
+    recovery_block = None
+    spread = None
+    try:
+        if router is not None:
+            host, port = router.rsplit(":", 1)
+            for name in scenarios:
+                bases = _scenario_bases(name, requests, population, rng)
+                payloads = _fleet_payloads(bases, n, d, f, gar, rng)
+                wall, lat, errors = _drive_router(host, int(port),
+                                                  payloads, connections)
+                scenario_rows[name]["external"] = {
+                    "agg_per_sec": round(len(lat) / max(wall, 1e-9), 2),
+                    "errors": errors, **percentiles(lat)}
+            shard_counts = ()
+        for shards in shard_counts:
+            with LocalFleet(shards, vnodes=vnodes, router_server=True,
+                            service={"max_batch": max_batch,
+                                     "max_delay_ms": max_delay_ms}) \
+                    as fleet:
+                for svc in fleet.services.values():
+                    svc.warmup([(gar, n, f, d, True)])
+                for name in scenarios:
+                    bases = _scenario_bases(name, requests, population,
+                                            rng)
+                    payloads = _fleet_payloads(bases, n, d, f, gar, rng)
+                    wall, lat, errors = _drive_router(
+                        "127.0.0.1", fleet.port, payloads, connections)
+                    scenario_rows[name][str(shards)] = {
+                        "agg_per_sec": round(len(lat) / max(wall, 1e-9),
+                                             2),
+                        "errors": errors, **percentiles(lat)}
+                if shards == max(shard_counts):
+                    ring = fleet.membership.ring()
+                    spread = ring.spread(
+                        _scenario_bases("rotation", 4096, 4096, rng))
+                    if recovery:
+                        recovery_block = _fleet_recovery(
+                            fleet, n=n, d=d, f=f, gar=gar, rng=rng)
+    finally:
+        sys.setswitchinterval(old_switch)
+
+    def _rate(name, count):
+        row = scenario_rows.get(name, {}).get(str(count))
+        return row["agg_per_sec"] if row else None
+
+    counts = sorted(int(c) for c in
+                    next(iter(scenario_rows.values()), {})
+                    if c != "external") if scenario_rows else []
+    speedup = None
+    if counts and len(counts) > 1:
+        lo, hi = _rate(scenarios[0], counts[0]), _rate(scenarios[0],
+                                                       counts[-1])
+        if lo and hi:
+            speedup = round(hi / lo, 3)
+    return {
+        "kind": "serve_fleet",
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        "isolation": "external" if router is not None else "in_process",
+        "config": {"requests": requests, "population": population,
+                   "n": n, "d": d, "f": f, "gar": gar,
+                   "max_batch": max_batch, "max_delay_ms": max_delay_ms,
+                   "connections": connections, "seed": seed,
+                   "vnodes": vnodes,
+                   "shard_counts": list(shard_counts) or ["external"]},
+        "ring": ({"vnodes": vnodes,
+                  "spread_4096_keys": {k: int(v)
+                                       for k, v in sorted(spread.items())}}
+                 if spread else None),
+        "scenarios": scenario_rows,
+        "recovery": recovery_block,
+        "fleet_speedup": speedup,
+    }
+
+
+def run_router_trace(*, requests=160, population=32, n=5, d=64, f=1,
+                     gar="median", max_batch=8, max_delay_ms=2.0, seed=1,
+                     tile_tolerance=0.15):
+    """The router-path attribution block for `ATTRIB_serve.json`
+    (`--trace`): a 2-shard in-process fleet, every line traced through
+    the router's two legs — `route` (parse + ring lookup) and
+    `shard_rtt` (queue wait + forward + the shard's whole service
+    time). The legs are contiguous, so their sum must tile the
+    client-measured request wall within `tile_tolerance` (what the
+    client additionally pays over the router's recv→reply is one socket
+    hop — if the tiling drifts past that, the router is spending time
+    nobody attributed)."""
+    from byzantinemomentum_tpu.serve.fleet.local import (LocalFleet,
+                                                         ask_socket,
+                                                         fleet_socket)
+
+    rng = np.random.default_rng(seed)
+    with LocalFleet(2, router_server=True,
+                    service={"max_batch": max_batch,
+                             "max_delay_ms": max_delay_ms}) as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([(gar, n, f, d, True)])
+        bases = _scenario_bases("rotation", requests, population, rng)
+        payloads = _fleet_payloads(bases, n, d, f, gar, rng)
+        sock, files = fleet_socket("127.0.0.1", fleet.port, timeout=120)
+        walls = []
+        try:
+            for payload in payloads:
+                t0 = time.perf_counter()
+                reply = ask_socket(files, payload)
+                walls.append((time.perf_counter() - t0) * 1000.0)
+                if not reply.get("ok"):
+                    raise RuntimeError(f"router trace request failed: "
+                                       f"{reply}")
+        finally:
+            sock.close()
+        spans = fleet.router.trace_spans()
+
+    route = [s[0] for s in spans]
+    shard_rtt = [s[1] for s in spans]
+    total = [s[2] for s in spans]
+    span_sum_mean = (sum(route) + sum(shard_rtt)) / max(len(spans), 1)
+    wall_mean = float(np.mean(walls))
+    tile_error = abs(span_sum_mean - wall_mean) / max(wall_mean, 1e-9)
+
+    def dist(values):
+        return {**percentiles(values),
+                "max_ms": round(float(np.max(values)), 3)}
+
+    return {
+        "shards": 2,
+        "requests": len(spans),
+        "phases": {"route": dist(route), "shard_rtt": dist(shard_rtt)},
+        "total": dist(total),
+        "client_wall": dist(walls),
+        "tile": {
+            "span_sum_mean_ms": round(span_sum_mean, 4),
+            "client_wall_mean_ms": round(wall_mean, 4),
+            "error_frac": round(tile_error, 4),
+            "within_tolerance": bool(tile_error <= tile_tolerance),
+            "tolerance": tile_tolerance,
+        },
+    }
+
+
 def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
                  seed, repeats, AggregationService, backend):
     rng = np.random.default_rng(seed)
@@ -502,8 +846,62 @@ def main(argv=None):
     parser.add_argument("--trace", action="store_true",
                         help="trace-collection mode: per-phase serve "
                              "attribution + tracing overhead, written as "
-                             "ATTRIB_serve.json (obs/trace)")
+                             "ATTRIB_serve.json (obs/trace); includes the "
+                             "2-shard router attribution block")
+    parser.add_argument("--fleet", action="store_true",
+                        help="sharded-fleet mode: scenario traffic through "
+                             "a consistent-hash router at each --shards "
+                             "count + the kill-safe failover round, written "
+                             "as BENCH_serve_fleet.json")
+    parser.add_argument("--shards", default="1,2,4",
+                        help="comma-separated shard counts for --fleet "
+                             "(default 1,2,4)")
+    parser.add_argument("--router", default=None, metavar="HOST:PORT",
+                        help="with --fleet: drive an EXTERNAL running "
+                             "fleet (python -m byzantinemomentum_tpu"
+                             ".serve.fleet) instead of in-process shards; "
+                             "skips the recovery round")
+    parser.add_argument("--population", type=int, default=64,
+                        help="distinct routing keys per --fleet scenario")
+    parser.add_argument("--connections", type=int, default=8,
+                        help="closed-loop client connections for --fleet")
     args = parser.parse_args(argv)
+
+    if args.fleet:
+        kwargs = dict(requests=args.requests, population=args.population,
+                      n=args.n, d=args.d, f=args.f, gar=args.gar,
+                      max_batch=args.max_batch,
+                      max_delay_ms=args.max_delay_ms,
+                      connections=args.connections, seed=args.seed,
+                      shard_counts=tuple(int(c) for c in
+                                         args.shards.split(",") if c),
+                      router=args.router)
+        if args.smoke:
+            kwargs.update(requests=min(args.requests, 60),
+                          population=min(args.population, 16),
+                          d=min(args.d, 64),
+                          shard_counts=tuple(
+                              c for c in kwargs["shard_counts"] if c <= 2)
+                          or (1, 2))
+        payload = run_fleet(**kwargs)
+        line = {k: payload[k] for k in ("kind", "backend", "host_cores",
+                                        "isolation", "fleet_speedup")}
+        line["scenarios"] = {
+            name: {count: row["agg_per_sec"]
+                   for count, row in rows.items()}
+            for name, rows in payload["scenarios"].items()}
+        if payload["recovery"]:
+            line["recovery"] = {k: payload["recovery"][k] for k in
+                                ("killed", "parked_line_recovered",
+                                 "survivor_errors", "survivor_monotonic",
+                                 "rewarm_no_faster_than_fresh")}
+        print(json.dumps(line))
+        if not args.smoke or args.out_smoke:
+            out = pathlib.Path(args.out) if args.out \
+                else ROOT / "BENCH_serve_fleet.json"
+            out.write_text(json.dumps(payload, indent=2) + "\n")
+            print(f"serve_loadgen: wrote {out}")
+        return 0
 
     if args.trace:
         kwargs = dict(requests=args.requests, n=args.n, d=args.d,
@@ -514,11 +912,22 @@ def main(argv=None):
             kwargs.update(requests=min(args.requests, 120),
                           d=min(args.d, 64), overhead_pairs=2)
         payload = run_trace(**kwargs)
+        payload["router"] = run_router_trace(
+            requests=min(args.requests, 160) if args.smoke
+            else max(args.requests // 2, 160),
+            d=min(args.d, 64) if args.smoke else args.d,
+            n=args.n, f=args.f, gar=args.gar, max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms, seed=args.seed)
         line = {k: payload[k] for k in ("kind", "backend")}
         line["phases_p50_ms"] = {name: cell["p50_ms"]
                                  for name, cell in payload["phases"].items()}
         line["tile"] = payload["tile"]
         line["overhead_frac"] = payload["overhead"]["frac"]
+        line["router"] = {
+            "route_p50_ms": payload["router"]["phases"]["route"]["p50_ms"],
+            "shard_rtt_p50_ms":
+                payload["router"]["phases"]["shard_rtt"]["p50_ms"],
+            "tile": payload["router"]["tile"]}
         print(json.dumps(line))
         if not args.smoke or args.out_smoke:
             out = pathlib.Path(args.out) if args.out \

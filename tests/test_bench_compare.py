@@ -849,3 +849,121 @@ def test_bench_history_health_column(tmp_path, capsys):
     r02 = [line for line in out.splitlines() if line.startswith("r02")][0]
     assert r02.split()[-1] == "1.51"
     assert "backend=cpu measurement" in out
+
+
+# --------------------------------------------------------------------------- #
+# serve-fleet gate: BENCH_serve_fleet.json per-(scenario, shard-count) cells
+
+def _fleet_artifact(path, *, rates=None, cores=1, isolation="in_process",
+                    backend="cpu", shard_counts=(1, 2), recovery=True,
+                    flags=(True, True, True)):
+    rates = rates or {"rotation": {"1": 300.0, "2": 280.0},
+                      "zipf": {"1": 310.0, "2": 290.0}}
+    payload = {
+        "kind": "serve_fleet", "backend": backend, "host_cores": cores,
+        "isolation": isolation,
+        "config": {"shard_counts": list(shard_counts)},
+        "scenarios": {name: {count: {"agg_per_sec": rate}
+                             for count, rate in rows.items()}
+                      for name, rows in rates.items()},
+        "recovery": ({"killed": "shard-0",
+                      "parked_line_recovered": flags[0],
+                      "survivor_monotonic": flags[1],
+                      "rewarm_no_faster_than_fresh": flags[2]}
+                     if recovery else None),
+        "fleet_speedup": 0.95,
+    }
+    path.write_text(json.dumps(payload))
+    return path
+
+
+def test_fleet_gate_within_tolerance_passes(tmp_path, capsys):
+    old = _fleet_artifact(tmp_path / "old.json")
+    new = _fleet_artifact(tmp_path / "new.json",
+                          rates={"rotation": {"1": 295.0, "2": 285.0},
+                                 "zipf": {"1": 320.0, "2": 288.0}})
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.05"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rotation.shards_2.agg_per_sec" in out
+    assert "fleet_speedup (info)" in out
+    assert "REGRESSED" not in out
+
+
+def test_fleet_gate_rate_drop_fails_per_shard_count(tmp_path, capsys):
+    old = _fleet_artifact(tmp_path / "old.json")
+    new = _fleet_artifact(tmp_path / "new.json",
+                          rates={"rotation": {"1": 300.0, "2": 180.0},
+                                 "zipf": {"1": 310.0, "2": 290.0}})
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.10"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [l for l in out.splitlines()
+            if "rotation.shards_2.agg_per_sec" in l][0]
+    assert "REGRESSED" in line
+    assert "rotation.shards_1" not in out.split("REGRESSED")[-1]
+
+
+def test_fleet_gate_recovery_flag_flip_fails(tmp_path, capsys):
+    """A fleet that corrupts a survivor's verdict stream during failover
+    is wrong at any speed: any recovery invariant flipping false fails
+    regardless of tolerance or throughput."""
+    old = _fleet_artifact(tmp_path / "old.json")
+    new = _fleet_artifact(tmp_path / "new.json",
+                          flags=(True, False, True))
+    rc = bench_compare.main([str(old), str(new), "--tolerance", "0.50"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [l for l in out.splitlines()
+            if "recovery.survivor_monotonic" in l][0]
+    assert "REGRESSED" in line
+
+
+def test_fleet_gate_incomparable_pairs(tmp_path, capsys):
+    """Different fleet sizes, host core counts, isolation modes,
+    backends, and mixed kinds are all INCOMPARABLE (exit 0) — a 4-shard
+    rate on an 8-core host says nothing about a 2-shard rate on 1."""
+    base = _fleet_artifact(tmp_path / "base.json")
+    sizes = _fleet_artifact(tmp_path / "sizes.json",
+                            shard_counts=(1, 2, 4),
+                            rates={"rotation": {"1": 300.0, "2": 280.0,
+                                                "4": 260.0}})
+    assert bench_compare.main([str(base), str(sizes)]) == 0
+    assert "different fleet sizes" in capsys.readouterr().out
+    cores = _fleet_artifact(tmp_path / "cores.json", cores=8)
+    assert bench_compare.main([str(base), str(cores)]) == 0
+    assert "core counts" in capsys.readouterr().out
+    iso = _fleet_artifact(tmp_path / "iso.json", isolation="external")
+    assert bench_compare.main([str(base), str(iso)]) == 0
+    assert "isolation" in capsys.readouterr().out
+    tpu = _fleet_artifact(tmp_path / "tpu.json", backend="tpu")
+    assert bench_compare.main([str(base), str(tpu)]) == 0
+    assert "different backends" in capsys.readouterr().out
+    bench = _artifact(tmp_path, "BENCH_r09.json", 10.0)
+    assert bench_compare.main([str(base), str(bench)]) == 0
+    assert "INCOMPARABLE" in capsys.readouterr().out
+
+
+def test_bench_history_fleet_columns(tmp_path, capsys):
+    """The fleet columns render from committed BENCH_serve_fleet_r*.json
+    artifacts: rotation agg/s at the round's largest shard count, the
+    count itself, and the recovery-invariants bit."""
+    bench_history = _bench_history()
+    _artifact(tmp_path, "BENCH_r01.json", 10.0)
+    _fleet_artifact(tmp_path / "BENCH_serve_fleet_r02.json",
+                    rates={"rotation": {"1": 300.0, "2": 281.25,
+                                        "4": 260.0}},
+                    shard_counts=(1, 2, 4))
+
+    stats = bench_history.collect_fleet(tmp_path, ["r01", "r02"])
+    assert "r01" not in stats
+    assert stats["r02"] == {"shards": 4, "rate": 260.0,
+                            "recovery_ok": True, "backend": "cpu"}
+
+    rc = bench_history.main(["--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet agg/s" in out and "fleet shards" in out
+    r02 = [line for line in out.splitlines() if line.startswith("r02")][0]
+    assert r02.split()[-3:] == ["4", "260.000", "1"]
+    assert "backend=cpu fleet run" in out

@@ -1,0 +1,429 @@
+"""Sharded-fleet tests (`byzantinemomentum_tpu/serve/fleet/`): the
+consistent-hash ring battery (cross-process determinism, the minimal
+remap bound, vnode balance, versioned-membership monotonicity replayed
+from a persisted `fleet.json`), the batched suspicion-resolve
+equivalence (per-batch folds byte-identical to sequential — the verdict
+contract the service's one-lock-per-batch optimization rides on), the
+in-process 2-shard router (ownership-exact stores, the suspicion parity
+oracle vs a single-process per-shard substream, dead-arc policy,
+kill/readmit with the re-warm bound), and the subprocess launcher's
+kill-safe failover + orphan discipline (slow tier).
+
+The ring/membership/store tests are jax-free by construction (`ring.py`
+is stdlib-only); the router tests pay two warm `AggregationService`
+builds and stay at d=32.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu.obs.forensics import ClientSuspicionStore
+from byzantinemomentum_tpu.serve.fleet.ring import (
+    DEFAULT_VNODES, FLEET_MANIFEST_NAME, HashRing, Membership, hash_point,
+    read_fleet_manifest, write_fleet_manifest)
+
+KEYS = [f"client-{i}" for i in range(4096)]
+
+
+# --------------------------------------------------------------------------- #
+# Hash ring
+
+def test_hash_point_cross_process_determinism():
+    """The ring must be a pure function of the membership snapshot in
+    EVERY process — sha1-derived points, never the builtin `hash()`
+    whose PYTHONHASHSEED salt differs per process. A child interpreter
+    (with a different, explicit hash seed) must compute identical points
+    and identical owners."""
+    shards = [f"shard-{i}" for i in range(4)]
+    probe = KEYS[:64]
+    child = subprocess.run(
+        [sys.executable, "-c",
+         "import json, sys\n"
+         "from byzantinemomentum_tpu.serve.fleet.ring import "
+         "HashRing, hash_point\n"
+         "shards, probe = json.loads(sys.stdin.read())\n"
+         "ring = HashRing(shards)\n"
+         "print(json.dumps({'points': [hash_point(k) for k in probe],\n"
+         "                  'owners': [ring.owner(k) for k in probe]}))"],
+        input=json.dumps([shards, probe]), capture_output=True, text=True,
+        env={**os.environ, "PYTHONHASHSEED": "12345",
+             "PYTHONPATH": os.pathsep.join(
+                 [str(p) for p in sys.path if p])},
+        check=True)
+    remote = json.loads(child.stdout)
+    ring = HashRing(shards)
+    assert remote["points"] == [hash_point(k) for k in probe]
+    assert remote["owners"] == [ring.owner(k) for k in probe]
+
+
+def test_remap_bound_on_shard_loss():
+    """Removing K of N shards may remap ONLY the clients the removed
+    shards owned — every survivor-owned client keeps its owner (and its
+    suspicion history); the moved fraction stays under (K+1)/N."""
+    shards = [f"shard-{i}" for i in range(4)]
+    ring = HashRing(shards)
+    before = {k: ring.owner(k) for k in KEYS}
+    ring.remove("shard-2")
+    moved = 0
+    for k in KEYS:
+        after = ring.owner(k)
+        if before[k] == "shard-2":
+            moved += 1
+            assert after != "shard-2"
+        else:
+            assert after == before[k], \
+                f"{k} moved {before[k]} -> {after} though its owner " \
+                f"survived"
+    assert moved / len(KEYS) <= 2 / 4
+    # and losing a second shard obeys the same bound against the
+    # ORIGINAL ring: K=2 of N=4 remaps at most 3/4
+    ring.remove("shard-0")
+    moved = sum(1 for k in KEYS if ring.owner(k) != before[k])
+    assert moved / len(KEYS) <= 3 / 4
+    for k in KEYS:
+        if before[k] not in ("shard-0", "shard-2"):
+            assert ring.owner(k) == before[k]
+
+
+def test_vnode_balance_bound():
+    """At `DEFAULT_VNODES` virtual points per shard the arcs are even
+    enough that no shard owns more than 1.5x (or less than half) the
+    mean load over a large uniform key population."""
+    ring = HashRing([f"shard-{i}" for i in range(4)],
+                    vnodes=DEFAULT_VNODES)
+    counts = ring.spread(KEYS)
+    mean = len(KEYS) / 4
+    assert max(counts.values()) / mean <= 1.5
+    assert min(counts.values()) / mean >= 0.5
+
+
+def test_ownership_is_liveness_blind():
+    """`mark_dead` flips the arc's policy bit without moving a single
+    client: a killed shard restarts on the same port owning exactly its
+    old arc, so suspicion never leaks across shards."""
+    ring = HashRing(["a", "b", "c"])
+    before = {k: ring.owner(k) for k in KEYS[:512]}
+    ring.mark_dead("b")
+    assert ring.dead == ("b",)
+    assert not ring.alive("b") and ring.alive("a")
+    for k, owner in before.items():
+        assert ring.owner(k) == owner
+        shard, alive = ring.route(k)
+        assert shard == owner and alive == (owner != "b")
+    ring.mark_alive("b")
+    assert ring.dead == ()
+
+
+def test_ring_membership_validation():
+    ring = HashRing(["a"])
+    with pytest.raises(ValueError):
+        ring.add("a")
+    with pytest.raises(KeyError):
+        ring.remove("zz")
+    with pytest.raises(KeyError):
+        ring.mark_dead("zz")
+    with pytest.raises(ValueError):
+        HashRing(vnodes=0)
+    with pytest.raises(LookupError):
+        HashRing().owner("anyone")
+
+
+# --------------------------------------------------------------------------- #
+# Versioned membership + manifest
+
+def test_membership_versions_and_manifest_roundtrip(tmp_path):
+    """Every change bumps the version exactly once and is REPLAYABLE
+    from the persisted history: a `fleet.json` written before the change
+    took effect reconstructs the live ring exactly."""
+    membership = Membership(vnodes=16)
+    for i in range(3):
+        assert membership.bump("add", f"shard-{i}", host="127.0.0.1",
+                               port=7700 + i) == i + 1
+    assert membership.bump("dead", "shard-1") == 4
+    assert membership.bump("alive", "shard-1", pid=4242) == 5
+    path = write_fleet_manifest(tmp_path, membership,
+                                router="127.0.0.1:7699")
+    assert path.name == FLEET_MANIFEST_NAME
+    payload = read_fleet_manifest(tmp_path)
+    assert payload["version"] == 5
+    assert payload["router"] == "127.0.0.1:7699"
+    assert Membership.from_dict(payload).as_dict() == membership.as_dict()
+
+    replayed = Membership.replay(payload)
+    assert replayed.version == membership.version
+    assert sorted(replayed.shards) == sorted(membership.shards)
+    live, again = membership.ring(), replayed.ring()
+    for k in KEYS[:512]:
+        assert live.owner(k) == again.owner(k)
+    for shard in membership.shards:
+        assert live.alive(shard) == again.alive(shard)
+    # the replay also recovers the non-liveness fields from the snapshot
+    assert replayed.shards["shard-1"]["pid"] == 4242
+    assert replayed.shards["shard-0"]["port"] == 7700
+
+
+def test_membership_replay_rejects_non_monotonic_history():
+    membership = Membership()
+    membership.bump("add", "a")
+    membership.bump("add", "b")
+    payload = membership.as_dict()
+    payload["history"][1]["version"] = 7  # torn/hand-edited manifest
+    with pytest.raises(ValueError, match="non-monotonic"):
+        Membership.replay(payload)
+
+
+def test_read_fleet_manifest_absent_or_torn(tmp_path):
+    assert read_fleet_manifest(tmp_path) is None
+    (tmp_path / FLEET_MANIFEST_NAME).write_text("{not json")
+    assert read_fleet_manifest(tmp_path) is None
+
+
+# --------------------------------------------------------------------------- #
+# Batched suspicion resolve (jax-free: store level)
+
+def _suspicion_items(rng, batch, population=6, n=4):
+    items = []
+    for _ in range(batch):
+        chosen = rng.choice(population, size=n, replace=False)
+        items.append(dict(
+            client_ids=[f"c{int(i)}" for i in chosen],
+            selection=rng.random(n),
+            distances=rng.random(n) * 3.0,
+            active=(rng.random(n) > 0.2).astype(np.float64)))
+    return items
+
+
+def test_observe_batch_matches_sequential_fold():
+    """`observe_batch` must be byte-identical to per-item `observe`
+    calls — same cohort z-scores, same as-of-fold population mean, same
+    float arithmetic order. The one-lock-per-batch service optimization
+    is only allowed to move WHERE the lock is taken, never a verdict."""
+    kwargs = dict(alpha=0.3, threshold=0.5, clear=0.2, min_obs=2,
+                  max_clients=32)
+    seq, bat = ClientSuspicionStore(**kwargs), ClientSuspicionStore(**kwargs)
+    rng = np.random.default_rng(7)
+    batches = [_suspicion_items(rng, batch) for batch in (1, 3, 4, 2, 5)]
+    for batch in batches:
+        expected = [seq.observe(**item) for item in batch]
+        got = bat.observe_batch(batch)
+        assert got == expected
+    assert seq.summary() == bat.summary()
+    assert seq.clients() == bat.clients()
+
+
+def test_store_clients_listing():
+    store = ClientSuspicionStore()
+    store.observe(["b", "a"], selection=[1.0, 0.0])
+    assert store.clients() == ["a", "b"]
+
+
+# --------------------------------------------------------------------------- #
+# In-process router (2 shards, real sockets end to end)
+
+def _fleet(shards=2, **kwargs):
+    from byzantinemomentum_tpu.serve.fleet.local import LocalFleet
+    return LocalFleet(shards, service={"max_batch": 4,
+                                       "max_delay_ms": 2.0}, **kwargs)
+
+
+def _payload(base, rng, n=5, d=32):
+    return {"op": "aggregate", "gar": "median", "f": 1,
+            "vectors": rng.standard_normal((n, d)).astype(
+                np.float32).tolist(),
+            "clients": [base] + [f"{base}.{j}" for j in range(1, n)]}
+
+
+def test_fleet_ownership_split_and_suspicion_parity():
+    """The parity oracle: a shard's verdict stream through the routed
+    fleet is byte-identical to a single-process service fed that shard's
+    substream directly — sharding must change WHERE suspicion lives,
+    never what it says. Also pins the ownership split: each shard's
+    store holds EXACTLY the clients the ring routes to it."""
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    rng = np.random.default_rng(3)
+    bases = [f"par-{i}" for i in range(10)]
+    stream = [_payload(b, rng) for b in bases for _ in range(3)]
+    with _fleet(2) as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([("median", 5, 1, 32, True)])
+        owners = {b: fleet.owner(b) for b in bases}
+        assert len(set(owners.values())) == 2, \
+            "10 bases should spread over both shards"
+        fleet_verdicts = []
+        for request in stream:
+            reply = fleet.ask(request)
+            assert reply["ok"], reply
+            fleet_verdicts.append(reply["verdicts"])
+        # ownership exactness, straight from each shard's store
+        for shard in fleet.shards:
+            expected = sorted(
+                c for request in stream
+                if owners[request["clients"][0]] == shard
+                for c in request["clients"])
+            assert fleet.suspicion_clients(shard) == \
+                tuple(sorted(set(expected)))
+        target = fleet.shards[0]
+    # the single-process oracle: one fresh service, fed ONLY the
+    # substream the ring routed to `target`, in the same order
+    with AggregationService(max_batch=4, max_delay_ms=2.0) as direct:
+        direct.warmup([("median", 5, 1, 32, True)])
+        for request, through_fleet in zip(stream, fleet_verdicts):
+            if owners[request["clients"][0]] != target:
+                continue
+            result = direct.aggregate(
+                np.asarray(request["vectors"], dtype=np.float32),
+                gar="median", f=1, client_ids=request["clients"])
+            # the fleet's copy crossed two json hops; normalize the
+            # oracle's the same way before the byte-for-byte compare
+            assert through_fleet == json.loads(json.dumps(result.verdicts))
+
+
+def test_fleet_dead_arc_error_policy_and_readmit():
+    """`on_dead="error"`: a line routed to a dead arc fails FAST with
+    the owner named (no parking); the restarted shard serves again —
+    with a fresh store, so the returning client re-warms from scratch,
+    exactly as fast as a fresh id (no suspicion shortcut through
+    death)."""
+    rng = np.random.default_rng(5)
+    with _fleet(2, on_dead="error") as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([("median", 5, 1, 32, True)])
+        base = "victim-client"
+        victim = fleet.owner(base)
+        for _ in range(3):
+            reply = fleet.ask(_payload(base, rng))
+            assert reply["ok"]
+        assert reply["verdicts"][base]["observations"] == 3
+        fleet.kill(victim)
+        dead_reply = fleet.ask(_payload(base, rng))
+        assert not dead_reply["ok"]
+        assert victim in dead_reply["error"]
+        # the OTHER arc keeps serving through the outage
+        other = next(f"ok{k}" for k in range(10_000)
+                     if fleet.owner(f"ok{k}") != victim)
+        assert fleet.ask(_payload(other, rng))["ok"]
+        fleet.restart(victim)
+        back = fleet.ask(_payload(base, rng))
+        assert back["ok"]
+        fresh = next(f"fresh{k}" for k in range(10_000)
+                     if fleet.owner(f"fresh{k}") == victim)
+        fresh_reply = fleet.ask(_payload(fresh, rng))
+        assert back["verdicts"][base]["observations"] == \
+            fresh_reply["verdicts"][fresh]["observations"] == 1
+
+
+def test_router_stats_and_round_robin_anonymous():
+    """Lines with no client ids spread round-robin (no owner to honor);
+    the router's stats surface names both shards and the routed
+    counts."""
+    rng = np.random.default_rng(9)
+    with _fleet(2) as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([("median", 5, 1, 32, True)])
+        for _ in range(8):
+            payload = _payload("x", rng)
+            del payload["clients"]
+            assert fleet.ask(payload)["ok"]
+        stats = fleet.ask({"op": "stats"})
+        assert stats["ok"]
+        per_shard = stats["shards"]
+        assert sorted(per_shard) == list(fleet.shards)
+        # ping/stats answer at the router; only the 8 aggregates routed
+        assert sum(row["routed"] for row in per_shard.values()) == 8
+        assert all(row["alive"] for row in per_shard.values())
+        ping = fleet.ask({"op": "ping"})
+        assert ping["ok"] and ping["router"] and ping["alive"] == 2
+
+
+# --------------------------------------------------------------------------- #
+# Subprocess launcher (slow tier: real processes, real SIGKILL)
+
+@pytest.mark.slow
+def test_launcher_kill_restart_and_orphan_discipline(tmp_path):
+    """The full failover story against real processes: SIGKILL a shard
+    mid-stream — the router errors or parks the uncertain in-flight
+    line (at-most-once: never re-sent), the launcher restarts the shard
+    on the SAME port, the membership history lands dead -> alive with
+    monotonic versions, the returning client re-warms no faster than a
+    fresh id, and killing the launcher itself reaps every shard through
+    the held stdin pipe (no orphans)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byzantinemomentum_tpu.serve.fleet",
+         "--shards", "2", "--port", "0", "--result-directory",
+         str(tmp_path), "--warmup", "median:5:32:1", "--max-batch", "4",
+         "--ready-timeout", "240"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env)
+    try:
+        deadline = time.monotonic() + 300
+        info = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, f"launcher exited early (rc={proc.poll()})"
+            if line.startswith("fleet: "):
+                info = json.loads(line[len("fleet: "):])
+                break
+        assert info is not None, "no fleet: line before timeout"
+        host, port = info["router"].rsplit(":", 1)
+
+        def ask(request, timeout=60):
+            with socket.create_connection((host, int(port)),
+                                          timeout=timeout) as conn:
+                fd = conn.makefile("rwb")
+                fd.write(json.dumps(request).encode() + b"\n")
+                fd.flush()
+                return json.loads(fd.readline())
+
+        rng = np.random.default_rng(1)
+        request = _payload("smoke-a", rng)
+        first = ask(request)
+        assert first["ok"]
+        assert first["verdicts"]["smoke-a"]["observations"] == 1
+
+        manifest = read_fleet_manifest(tmp_path)
+        owner = Membership.from_dict(manifest).ring().owner("smoke-a")
+        os.kill(manifest["shards"][owner]["pid"], signal.SIGKILL)
+
+        deadline = time.monotonic() + 240
+        while True:
+            reply = ask(request, timeout=240)
+            if reply.get("ok"):
+                break
+            assert time.monotonic() < deadline, "recovery timed out"
+            time.sleep(0.5)
+        # fresh store on the restarted shard: the client re-warmed
+        assert reply["verdicts"]["smoke-a"]["observations"] == 1
+
+        after = read_fleet_manifest(tmp_path)
+        changes = [(h["change"], h["shard"]) for h in after["history"]]
+        assert ("dead", owner) in changes and ("alive", owner) in changes
+        versions = [h["version"] for h in after["history"]]
+        assert versions == sorted(set(versions))
+        Membership.replay(after)  # monotonic by construction
+
+        shard_pids = [row["pid"] for row in after["shards"].values()]
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not any(os.path.exists(f"/proc/{pid}")
+                       for pid in shard_pids):
+                break
+            time.sleep(0.2)
+        orphans = [pid for pid in shard_pids
+                   if os.path.exists(f"/proc/{pid}")]
+        assert not orphans, f"shards leaked past the launcher: {orphans}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
