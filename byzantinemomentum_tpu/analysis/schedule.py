@@ -61,7 +61,9 @@ __all__ = ["Scheduler", "SchedLock", "SchedCondition", "DeadlockError",
            "explore", "random_walks", "lost_update_model",
            "fixed_counter_model", "router_lost_forward_model",
            "router_forward_queue_model", "router_double_resolve_model",
-           "router_single_disposition_model", "selfcheck"]
+           "router_single_disposition_model",
+           "straggle_claim_unguarded_model", "straggle_claim_model",
+           "selfcheck"]
 
 # A worker that fails to reach its next preemption point within this many
 # seconds is assumed to have entered a REAL blocking call (which the
@@ -650,12 +652,97 @@ def router_single_disposition_model(sched):
     return [dispose("error"), dispose("timeout")], check
 
 
+# --------------------------------------------------------------------------- #
+# The straggle-window models (cluster/chaos.py::StraggleResumer): the
+# launcher's only NEW thread in the elastic-fleet PR. A SIGSTOP'd host
+# has exactly one pending SIGCONT window, and two parties race for it —
+# the resumer thread (window elapsed: resume the host) and the launcher
+# poll loop (straggler-policy kill or fleet teardown: cancel the window,
+# then SIGKILL). The invariant: a window is disposed EXACTLY once, and a
+# cancelled window never signals — a SIGCONT landing after the kill
+# decision could resume a process mid-SIGKILL (or, later, a recycled
+# pid).
+
+def straggle_claim_unguarded_model(sched):
+    """The PRE-fix shape: both parties CHECK the entry is pending, then
+    act, with nothing making check+claim atomic. A preemption between
+    them lets the resumer SIGCONT a window the launcher already
+    cancelled on its kill path. Serial orders pass; one preemption
+    finds it."""
+    entry = {"state": "pending"}
+    state = {"signals": [], "cancelled": []}
+
+    def resumer():
+        if entry["state"] == "pending":   # saw it pending...
+            sched.point()                 # ... the cancel lands here
+            entry["state"] = "resumed"
+            state["signals"].append("SIGCONT")
+
+    def canceller():
+        if entry["state"] == "pending":
+            sched.point()
+            entry["state"] = "cancelled"
+            state["cancelled"].append("kill")
+
+    def check():
+        disposed = len(state["signals"]) + len(state["cancelled"])
+        assert disposed == 1, (
+            f"window disposed {disposed} times: signals="
+            f"{state['signals']} cancelled={state['cancelled']}")
+        if state["cancelled"]:
+            assert not state["signals"], (
+                "cancelled window still SIGCONT'd — a killed host got "
+                "resumed")
+
+    return [resumer, canceller], check
+
+
+def straggle_claim_model(sched):
+    """The SHIPPED pattern (`StraggleResumer._loop` / `.cancel`): the
+    state flip from `pending` IS the claim, taken under the lock; the
+    signal runs outside the lock but only by whoever claimed. The loser
+    finds the entry already disposed and does nothing. Exhaustively
+    clean at the bound that breaks the unguarded version."""
+    lock = sched.lock()
+    entry = {"state": "pending"}
+    state = {"signals": [], "cancelled": []}
+
+    def resumer():
+        with lock:
+            mine = entry["state"] == "pending"
+            if mine:
+                entry["state"] = "resumed"
+        if mine:                          # we own the disposition
+            state["signals"].append("SIGCONT")
+
+    def canceller():
+        with lock:
+            mine = entry["state"] == "pending"
+            if mine:
+                entry["state"] = "cancelled"
+        if mine:
+            state["cancelled"].append("kill")
+
+    def check():
+        disposed = len(state["signals"]) + len(state["cancelled"])
+        assert disposed == 1, (
+            f"window disposed {disposed} times: signals="
+            f"{state['signals']} cancelled={state['cancelled']}")
+        if state["cancelled"]:
+            assert not state["signals"], (
+                "cancelled window still SIGCONT'd — a killed host got "
+                "resumed")
+
+    return [resumer, canceller], check
+
+
 def selfcheck(max_preemptions=3):
     """The lint-tier schedule smoke: every planted bug — the serve
-    counter lost-update and the two router races (lost forward, double
-    disposition) — must be FOUND within the preemption bound, and every
-    fixed pattern must survive the same exhaustive exploration clean.
-    Returns a JSON-safe report with `ok`."""
+    counter lost-update, the two router races (lost forward, double
+    disposition) and the straggle-window claim race — must be FOUND
+    within the preemption bound, and every fixed pattern must survive
+    the same exhaustive exploration clean. Returns a JSON-safe report
+    with `ok`."""
     t0 = time.monotonic()
     broken = explore(lost_update_model, max_preemptions=max_preemptions)
     fixed = explore(fixed_counter_model, max_preemptions=max_preemptions)
@@ -667,12 +754,18 @@ def selfcheck(max_preemptions=3):
                       max_preemptions=max_preemptions)
     r_single = explore(router_single_disposition_model,
                        max_preemptions=max_preemptions)
+    s_unguarded = explore(straggle_claim_unguarded_model,
+                          max_preemptions=max_preemptions)
+    s_claim = explore(straggle_claim_model,
+                      max_preemptions=max_preemptions)
     router_fixed_clean = (r_queue.ok and r_queue.exhausted
                           and r_single.ok and r_single.exhausted)
+    straggle_fixed_clean = s_claim.ok and s_claim.exhausted
     return {
         "ok": (bool(broken.failures) and fixed.ok and fixed.exhausted
                and bool(r_lost.failures) and bool(r_double.failures)
-               and router_fixed_clean),
+               and router_fixed_clean
+               and bool(s_unguarded.failures) and straggle_fixed_clean),
         "lost_update_found": bool(broken.failures),
         "witness": broken.failures[0].schedule if broken.failures else None,
         "schedules_prefix": broken.runs,
@@ -687,9 +780,15 @@ def selfcheck(max_preemptions=3):
         "router_fixed_clean": router_fixed_clean,
         "schedules_router": (r_lost.runs + r_double.runs + r_queue.runs
                              + r_single.runs),
+        "straggle_claim_found": bool(s_unguarded.failures),
+        "straggle_claim_witness": (s_unguarded.failures[0].schedule
+                                   if s_unguarded.failures else None),
+        "straggle_fixed_clean": straggle_fixed_clean,
+        "schedules_straggle": s_unguarded.runs + s_claim.runs,
         "exhausted": (broken.exhausted and fixed.exhausted
                       and r_lost.exhausted and r_double.exhausted
-                      and r_queue.exhausted and r_single.exhausted),
+                      and r_queue.exhausted and r_single.exhausted
+                      and s_unguarded.exhausted and s_claim.exhausted),
         "max_preemptions": max_preemptions,
         "seconds": round(time.monotonic() - t0, 3),
     }

@@ -3,9 +3,13 @@
 PR 1's fault plans inject masked rows inside the jitted step — "device
 loss" there is arithmetic. Here the SAME declarative artifact drives real
 process destruction: at system scope an event's `worker` indexes a HOST of
-the multi-controller fleet, and `device_loss` means the launcher SIGKILLs
+the multi-controller fleet, `device_loss` means the launcher SIGKILLs
 that host's process the first time the cluster's observed step reaches
-`event.step`. Only `faults.plan.SYSTEM_KINDS` are legal at this scope
+`event.step`, and `straggle` means SIGSTOP now / SIGCONT `window_s`
+seconds later (`StraggleResumer`) — a host that is alive in the process
+table but not stepping, the exact input the launcher's straggler policy
+(`cluster/straggler.py`) exists to classify. Only
+`faults.plan.SYSTEM_KINDS` are legal at this scope
 (`FaultPlan.validate_system`).
 
 Fire-once discipline: recovery REPLAYS training steps (the fleet resumes
@@ -18,7 +22,11 @@ never re-injects. The plan stays deterministic data — `(plan, manifest)`
 fully determine what has been and will be injected.
 """
 
-__all__ = ["SystemFaultDriver"]
+import signal
+import threading
+import time
+
+__all__ = ["StraggleResumer", "SystemFaultDriver"]
 
 
 class SystemFaultDriver:
@@ -58,3 +66,101 @@ class SystemFaultDriver:
         """Whether every scheduled event has been injected (the launcher
         only declares a chaos run clean once the plan is spent)."""
         return len(self._fired) >= len(self.plan.events)
+
+
+class StraggleResumer:
+    """The SIGCONT side of a straggle window, on its own timer thread.
+
+    The launcher's poll loop must keep observing the fleet while a host
+    is stopped (that stall is the whole experiment), so the delayed
+    SIGCONT cannot block it — a single daemon thread sleeps until the
+    earliest pending window closes and resumes the host.
+
+    Concurrency contract (modeled in `analysis/schedule.py::
+    straggle_claim_model` / `straggle_claim_unguarded_model`): every
+    scheduled entry is disposed EXACTLY once — `resumed` by this thread
+    or `cancelled` by the launcher (straggler-policy kill, fleet
+    teardown) — and the disposition is claimed under the lock BEFORE
+    anyone signals, so a killed host can never receive a late SIGCONT
+    and a resumed host is never double-signaled. All state transitions
+    happen under `_cond`'s lock; the actual `send_signal` runs outside
+    it (signaling a dying process can stall in the kernel).
+    """
+
+    def __init__(self, *, clock=time.monotonic):
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._pending = []    # [{"host", "proc", "at", "state"}]
+        self._resumed = []
+        self._cancelled = 0
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="straggle-resumer")
+        self._thread.start()
+
+    def schedule(self, host, proc, window_s):
+        """Arrange SIGCONT for `proc` (host `host`) in `window_s` s."""
+        entry = {"host": int(host), "proc": proc,
+                 "at": self._clock() + float(window_s), "state": "pending"}
+        with self._cond:
+            self._pending.append(entry)
+            self._cond.notify()
+
+    def cancel(self, host=None):
+        """Cancel pending windows for `host` (None: all). Returns how
+        many were still pending — 0 means the resumer already claimed
+        them (the SIGCONT raced ahead; harmless before a SIGKILL)."""
+        cancelled = 0
+        with self._cond:
+            for entry in self._pending:
+                if (entry["state"] == "pending"
+                        and (host is None or entry["host"] == int(host))):
+                    entry["state"] = "cancelled"
+                    cancelled += 1
+            self._cancelled += cancelled
+            self._cond.notify()
+        return cancelled
+
+    def resumed(self):
+        """`[(host, resumed_at)]` windows this thread closed so far."""
+        with self._cond:
+            return list(self._resumed)
+
+    def stats(self):
+        with self._cond:
+            pending = sum(1 for e in self._pending
+                          if e["state"] == "pending")
+            return {"pending": pending, "resumed": len(self._resumed),
+                    "cancelled": self._cancelled}
+
+    def stop(self):
+        """Cancel everything and join the thread (launcher teardown)."""
+        self.cancel()
+        with self._cond:
+            self._stopping = True
+            self._cond.notify()
+        self._thread.join(timeout=5.0)
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._stopping:
+                    return
+                now = self._clock()
+                due = [e for e in self._pending
+                       if e["state"] == "pending" and e["at"] <= now]
+                for entry in due:
+                    entry["state"] = "resumed"  # claimed under the lock
+                self._pending = [e for e in self._pending
+                                 if e["state"] == "pending"]
+                if not due:
+                    waits = [e["at"] - now for e in self._pending]
+                    self._cond.wait(min(waits) if waits else None)
+                    continue
+            for entry in due:
+                try:
+                    entry["proc"].send_signal(signal.SIGCONT)
+                except (OSError, ValueError):
+                    pass  # the process died while stopped; moot
+                with self._cond:
+                    self._resumed.append((entry["host"], entry["at"]))

@@ -1,0 +1,119 @@
+"""Elastic shrink arithmetic — how a fleet survives at the SURVIVOR count.
+
+When a host dies the launcher no longer has to relaunch at full width:
+this module re-derives the run configuration for the shrunken fleet.
+Everything here is launcher-side, stdlib-only and STATIC — the traced
+per-step quorum clamp lives in `faults/quorum.py::effective_f`; this is
+its whole-fleet analogue, applied once per shrink so the relaunched
+hosts compile a fresh `(n', f')` contract instead of masking rows
+forever.
+
+The re-split holds the PER-HOST shares constant (`nb_workers / hosts`
+simulated workers and `nb_for_study / hosts` study slots per host) and
+scales totals to the survivor count, because the host runtime shards the
+sampled batch across the workers mesh axis and refuses ragged splits
+(`cluster/host.py`: `nb_sampled % workers_ax == 0`). `precheck` proves
+at LAUNCH time that every reachable survivor width down to the floor
+yields a legal config, so a shrink decision made mid-incident can never
+discover the arithmetic is impossible.
+"""
+
+__all__ = ["static_f_ceiling", "static_effective_f", "shrunk_spec",
+           "precheck"]
+
+# Static mirror of `faults/quorum.py::_F_CEILING` (same contracts: krum
+# needs n >= 2f+3, bulyan n >= 4f+3, the trimmed family n >= 2f+1;
+# generic minority bound otherwise). A parity test pins the two tables
+# to each other so they cannot drift apart.
+_F_CEILING = {
+    "krum": lambda n: (n - 3) // 2,
+    "bulyan": lambda n: (n - 3) // 4,
+    "brute": lambda n: (n - 1) // 2,
+    "trmean": lambda n: (n - 1) // 2,
+    "phocas": lambda n: (n - 1) // 2,
+    "meamed": lambda n: (n - 1) // 2,
+}
+
+
+def _base_name(name):
+    return name[len("native-"):] if name.startswith("native-") else name
+
+
+def static_f_ceiling(gar_name, n):
+    """Largest f `gar_name` tolerates at worker count `n` (python int)."""
+    ceiling = _F_CEILING.get(_base_name(gar_name), lambda m: (m - 1) // 2)
+    return max(int(ceiling(int(n))), 0)
+
+
+def static_effective_f(gar_name, n, f_decl):
+    """The declared f clamped to the GAR's breakdown ceiling at `n` —
+    `faults/quorum.py::effective_f` without the tracing."""
+    return max(min(int(f_decl), static_f_ceiling(gar_name, n)), 0)
+
+
+def shrunk_spec(base, survivors):
+    """Re-derive the run config for `survivors` hosts.
+
+    Args:
+      base: mapping with the LAUNCH-width run shape — `hosts`,
+        `nb_workers`, `nb_decl_byz`, `nb_real_byz`, `nb_for_study`,
+        `gar`.
+      survivors: host count after the shrink (1 <= survivors <= hosts).
+
+    Returns:
+      `{"hosts", "nb_workers", "nb_decl_byz", "nb_real_byz",
+      "nb_for_study"}` for the shrunken fleet: per-host shares held
+      constant, real Byzantine count clamped below the shrunk width,
+      declared f clamped to the GAR ceiling at the shrunk worker count.
+
+    Raises:
+      ValueError: the shrink arithmetic is impossible (ragged per-host
+        shares, no honest worker left, ragged sampled split).
+    """
+    hosts0 = int(base["hosts"])
+    survivors = int(survivors)
+    if not 1 <= survivors <= hosts0:
+        raise ValueError(f"survivor count {survivors} outside "
+                         f"[1, {hosts0}]")
+    nb_workers = int(base["nb_workers"])
+    nb_for_study = int(base["nb_for_study"])
+    if nb_workers % hosts0:
+        raise ValueError(f"nb_workers={nb_workers} does not split evenly "
+                         f"across {hosts0} hosts")
+    if nb_for_study % hosts0:
+        raise ValueError(f"nb_for_study={nb_for_study} does not split "
+                         f"evenly across {hosts0} hosts")
+    n = (nb_workers // hosts0) * survivors
+    study = (nb_for_study // hosts0) * survivors
+    real = min(int(base["nb_real_byz"]), max(n - 1, 0))
+    honests = n - real
+    if honests < 1:
+        raise ValueError(f"shrink to {survivors} hosts leaves no honest "
+                         f"worker (n={n}, real byz={real})")
+    f_decl = static_effective_f(base.get("gar", "average"),
+                               n, base["nb_decl_byz"])
+    sampled = max(honests, study)
+    if sampled % survivors:
+        raise ValueError(
+            f"shrink to {survivors} hosts gives nb_sampled={sampled} not "
+            f"divisible by the {survivors}-wide workers mesh axis")
+    return {"hosts": survivors, "nb_workers": n, "nb_decl_byz": f_decl,
+            "nb_real_byz": real, "nb_for_study": study}
+
+
+def precheck(base, min_hosts=1):
+    """Validate every reachable survivor width `min_hosts..hosts` at
+    launch time. Returns None when all are legal, else a message naming
+    the first width that is not — the launcher refuses to start an
+    elastic fleet whose shrink path could dead-end mid-incident."""
+    hosts0 = int(base["hosts"])
+    floor = max(int(min_hosts), 1)
+    if floor > hosts0:
+        return (f"min_hosts={floor} exceeds the launch width {hosts0}")
+    for survivors in range(floor, hosts0 + 1):
+        try:
+            shrunk_spec(base, survivors)
+        except ValueError as err:
+            return (f"elastic shrink to {survivors} hosts would be "
+                    f"illegal: {err}")
+    return None

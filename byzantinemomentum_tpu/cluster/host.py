@@ -218,7 +218,14 @@ def main(argv=None):
     telem.event("host_start", host=proc, procs=args.procs,
                 seed=args.seed, auto_resume=bool(args.auto_resume))
 
-    mesh = runtime.cluster_mesh()
+    # Pin the workers axis to the width the launcher spawned: under an
+    # elastic shrink the survivor fleet must compile the shrunken (n, f)
+    # contract, never a mesh silently widened by a stray rejoiner
+    try:
+        mesh = runtime.cluster_mesh(expected_workers=args.procs)
+    except runtime.ClusterUnavailable as err:
+        print(f"cluster-host: unavailable: {err}", flush=True)
+        return UNAVAILABLE_RC
     workers_ax = mesh.shape["workers"]
 
     # --- the same deterministic setup on every host --- #

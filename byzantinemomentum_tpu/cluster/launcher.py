@@ -27,6 +27,23 @@ local TCP coordinator on a probed free port) and supervises them:
   non-zero so an outer Jobs supervisor takes over with the same
   semantics). Every relaunched host reports the restart step it adopted;
   the launcher requires unanimity before declaring `restart_agreed`.
+* **elastic shrink** (`--elastic`) — the relaunch happens at the
+  SURVIVOR count instead of full width: `cluster/elastic.py` re-splits
+  `nb_workers`/`nb_for_study` across the shrunken fleet and re-clamps
+  the declared quorum `f` to the GAR ceiling at the shrunk worker count
+  (the static analogue of `faults/quorum.py`), the restart step still
+  comes from the off-slice mirror, and the shrink lands as a VERSIONED
+  membership event in `fleet.json` (the `serve/fleet/ring.py`
+  Membership discipline, persisted before any respawn — a retried
+  launcher replays the change log and adopts the shrunken width).
+* **straggler policy** (`--straggler-wait` / `--straggler-edges` /
+  `--quarantine`) — `cluster/straggler.py` folds the liveness view's
+  stale/alive edges (and the health block's SPC anomalies, at host
+  scope) into HEALTHY -> SUSPECT -> (recovered | KILLED): a host stale
+  past the bounded wait is killed-and-shrunk instead of wedging the
+  fleet until the watchdog fires. SIGSTOP/SIGCONT chaos windows
+  (`straggle` events, `cluster/chaos.py::StraggleResumer`) exercise
+  exactly this failure mode.
 * **artifact** — the outcome lands in a `CLUSTER.json`-shape artifact
   (`--bench-out`, default `<result-directory>/CLUSTER.json`): hosts,
   steps/s, recovery-step count, the cross-host lattice census verdict
@@ -40,6 +57,7 @@ import argparse
 import json
 import os
 import pathlib
+import signal
 import sys
 import time
 
@@ -84,7 +102,34 @@ def process_commandline(argv=None):
              "non-zero immediately and let an outer supervisor retry)")
     add("--fault-plan", type=str, default=None,
         help="System-scope FaultPlan JSON: device_loss events SIGKILL "
-             "the named HOST at the named step (cluster/chaos.py)")
+             "the named HOST at the named step; straggle events SIGSTOP "
+             "it for window_s seconds (cluster/chaos.py)")
+    add("--elastic", action="store_true", default=False,
+        help="On host loss, relaunch at the SURVIVOR count instead of "
+             "full width: nb_workers/nb_for_study re-split, quorum f "
+             "re-clamped (cluster/elastic.py), the shrink persisted as "
+             "a versioned membership event in fleet.json before any "
+             "respawn")
+    add("--min-hosts", type=int, default=1,
+        help="Elastic floor: never shrink below this many hosts (the "
+             "launcher halts with status below_min_hosts instead)")
+    add("--straggler-wait", type=float, default=None,
+        help="Bounded-wait-then-kill straggler policy "
+             "(cluster/straggler.py): seconds a SUSPECT host may stay "
+             "stale before the launcher kills it and recovers")
+    add("--straggler-edges", type=str, default=None,
+        help="Path of a `scripts/stale_edges.py --json` summary; its "
+             "machine-readable recommendation block sets the straggler "
+             "wait bound (p95 of observed recoveries x 1.25)")
+    add("--quarantine", action="store_true", default=False,
+        help="Host-scope health quarantine: sustained SPC anomalies in "
+             "a host's heartbeat health block (--health) make it "
+             "SUSPECT under the same bounded wait — drain-by-kill and "
+             "shrink/relaunch past it before it poisons the run")
+    add("--quarantine-anomaly-polls", type=int, default=3,
+        help="Consecutive anomalous polls before the quarantine arm "
+             "turns a host SUSPECT (the arena's hysteresis shape at "
+             "host scope: one bad window is not a verdict)")
     add("--connect-timeout", type=float, default=60.0)
     add("--heartbeat-stale", type=float, default=60.0,
         help="Seconds without a host heartbeat update before the "
@@ -139,6 +184,35 @@ class _Fleet:
             self.procs[host].kill()
         except OSError:
             pass
+
+    def stop(self, host):
+        """SIGSTOP (straggle chaos): the host stays in the process table
+        but stops stepping — alive-but-wedged, not dead."""
+        try:
+            self.procs[host].send_signal(signal.SIGSTOP)
+        except OSError:
+            pass
+
+    def stopped_hosts(self):
+        """Hosts whose process is NOT SCHEDULING (Linux state `T`:
+        SIGSTOP'd / traced) — decisive straggler-blame evidence, since a
+        wedged-but-runnable hostage never shows `T`. Empty wherever
+        /proc is unreadable (non-Linux: the policy falls back to its
+        suspect-duration ordering)."""
+        stopped = set()
+        for host, proc in enumerate(self.procs):
+            if proc.poll() is not None:
+                continue
+            try:
+                stat = pathlib.Path(f"/proc/{proc.pid}/stat").read_text()
+                # Field 3, after the parenthesized comm (which may
+                # itself contain spaces and parens)
+                state = stat.rsplit(") ", 1)[1].split(" ", 1)[0]
+            except (OSError, IndexError):
+                continue
+            if state in ("T", "t"):
+                stopped.add(host)
+        return frozenset(stopped)
 
     def teardown(self):
         for p in self.procs:
@@ -253,10 +327,22 @@ def main(argv=None):
                  else resdir / "CLUSTER.json")
 
     from byzantinemomentum_tpu.cluster import chaos as chaos_mod
+    from byzantinemomentum_tpu.cluster import elastic as elastic_mod
     from byzantinemomentum_tpu.cluster import manifest as manifest_mod
+    from byzantinemomentum_tpu.cluster import straggler as straggler_mod
     from byzantinemomentum_tpu.obs import Telemetry
     from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
     from byzantinemomentum_tpu.obs.trace import ClockOffsetTracker
+    from byzantinemomentum_tpu.serve.fleet import ring as ring_mod
+
+    # The LAUNCH-width run shape: every elastic re-derivation starts from
+    # here (args gets mutated in place on shrink so the spawn/liveness/
+    # census paths follow automatically)
+    initial_hosts = args.hosts
+    elastic_base = {"hosts": args.hosts, "nb_workers": args.nb_workers,
+                    "nb_decl_byz": args.nb_decl_byz,
+                    "nb_real_byz": args.nb_real_byz,
+                    "nb_for_study": args.nb_for_study, "gar": args.gar}
 
     plan = None
     if args.fault_plan is not None:
@@ -268,16 +354,61 @@ def main(argv=None):
             print(f"cluster: unable to load fault plan "
                   f"{args.fault_plan!r}: {err}")
             return 2
-        message = plan.validate_system(args.hosts)
+        message = plan.validate_system(initial_hosts)
         if message is not None:
             print(f"cluster: fault plan rejected: {message}")
             return 2
 
+    policy = None
+    if (args.straggler_wait is not None or args.straggler_edges
+            or args.quarantine):
+        try:
+            wait_s, wait_source = straggler_mod.resolve_wait_bound(
+                args.straggler_wait, args.straggler_edges)
+        except (OSError, ValueError) as err:
+            print(f"cluster: straggler wait bound unavailable: {err}")
+            return 2
+        policy = straggler_mod.StragglerPolicy(
+            wait_s, source=wait_source, quarantine=args.quarantine,
+            anomaly_enter=args.quarantine_anomaly_polls)
+
     manifest = manifest_mod.read_cluster_manifest(resdir)
+    membership = None
+    shrinks = []
+    if args.elastic:
+        message = elastic_mod.precheck(elastic_base, args.min_hosts)
+        if message is not None:
+            print(f"cluster: elastic refused: {message}")
+            return 2
+        shrinks = list((manifest.get("elastic") or {}).get("shrinks")
+                       or [])
+        payload = ring_mod.read_fleet_manifest(resdir)
+        if payload is not None:
+            # Recovery-path proof: a retried launcher reconstructs the
+            # fleet it must adopt from the persisted change LOG alone
+            membership = ring_mod.Membership.replay(payload)
+        else:
+            membership = ring_mod.Membership(vnodes=1)
+            for slot in range(args.hosts):
+                membership.bump("add", slot, role="host")
+            ring_mod.write_fleet_manifest(
+                resdir, membership, initial_hosts=initial_hosts)
+        width = len(membership.shards)
+        if width < 1:
+            print("cluster: elastic membership has no surviving hosts")
+            return 2
+        if width != args.hosts:
+            spec = elastic_mod.shrunk_spec(elastic_base, width)
+            for key, value in spec.items():
+                setattr(args, key, value)
     manifest["hosts"] = args.hosts
     driver = (chaos_mod.SystemFaultDriver(
-        plan, args.hosts, fired=manifest.get("fired_faults") or ())
+        plan, initial_hosts, fired=manifest.get("fired_faults") or ())
         if plan is not None else None)
+    resumer = (chaos_mod.StraggleResumer()
+               if plan is not None
+               and any(e.kind == "straggle" for e in plan.events)
+               else None)
 
     telem = Telemetry(resdir)
     telem.event("cluster_start", hosts=args.hosts, steps=args.nb_steps,
@@ -346,11 +477,14 @@ def main(argv=None):
                         status="launching",
                         fired_faults=(driver.fired() if driver else []))
         manifest_mod.write_cluster_manifest(resdir, manifest)
-        _clear_host_signals(resdir, args.hosts)
+        _clear_host_signals(resdir, initial_hosts)
         port = free_port()
         telem.event("fleet_launch", attempt=attempt, hosts=args.hosts,
                     coordinator_port=port, restart_step=restart_step)
         fleet = _spawn_fleet(args, resdir, mirror, port)
+        if policy is not None:
+            # A fresh attempt's hosts share nothing with the wedged one
+            policy.reset()
         agreed = False
         outcome = None
         killed_host = None
@@ -363,6 +497,21 @@ def main(argv=None):
                 running=running)
             observe_view(view, time.time())
             aggregate(view, "running")
+            # Straggler policy: bounded wait on stale/anomalous hosts,
+            # then kill the laggard — the kill flows into the ordinary
+            # host_lost recovery (and the elastic shrink) below
+            if policy is not None:
+                for ev in policy.observe(view, time.time(),
+                                         stopped=fleet.stopped_hosts()):
+                    telem.event(
+                        "straggler_" + ev["event"],
+                        **{k: v for k, v in ev.items() if k != "event"})
+                    if ev["event"] == "kill":
+                        if resumer is not None:
+                            # Claim any pending SIGCONT first: a killed
+                            # host must never be resumed
+                            resumer.cancel(ev["host"])
+                        fleet.kill(ev["host"])
             # Restart agreement: once every host has reported, the
             # adopted steps must be unanimous and equal the manifest's
             if not agreed and restart_step is not None:
@@ -385,16 +534,34 @@ def main(argv=None):
                     driver.mark(index)
                     manifest.update(fired_faults=driver.fired())
                     manifest_mod.write_cluster_manifest(resdir, manifest)
+                    if event.worker >= args.hosts:
+                        # An elastic shrink renumbered the fleet below
+                        # this event's target; spend it rather than let
+                        # it aim at a host that no longer exists
+                        telem.event("fault_skipped", kind=event.kind,
+                                    host=event.worker, reason="shrunk",
+                                    hosts=args.hosts)
+                        continue
                     telem.event("fault_injected", kind=event.kind,
                                 host=event.worker,
                                 at_step=view["max_step"],
-                                plan_step=event.step)
-                    fleet.kill(event.worker)
+                                plan_step=event.step,
+                                **({"window_s": event.window_s}
+                                   if event.kind == "straggle" else {}))
+                    if event.kind == "straggle":
+                        fleet.stop(event.worker)
+                        resumer.schedule(event.worker,
+                                         fleet.procs[event.worker],
+                                         event.window_s)
+                    else:
+                        fleet.kill(event.worker)
             if wedge_at is not None and not wedge_fuse.exists() \
                     and view["max_step"] is not None \
                     and view["max_step"] >= wedge_at:
                 wedge_fuse.write_text(str(view["max_step"]))
                 telem.event("wedge", step=view["max_step"])
+                if resumer is not None:
+                    resumer.cancel()
                 fleet.teardown()
                 while True:  # silent: the outer watchdog must kill us
                     time.sleep(60)
@@ -414,6 +581,11 @@ def main(argv=None):
         # the newest clock_offsets event, and a relaunch keeps refining
         if clock.estimate():
             telem.event("clock_offsets", **clock.as_event_data())
+        if resumer is not None:
+            # Pending SIGCONT windows die with the fleet (a stopped
+            # process takes SIGKILL just fine; resuming a recycled pid
+            # later would not be fine)
+            resumer.cancel()
         fleet.teardown()
         if outcome == "completed":
             break
@@ -435,7 +607,48 @@ def main(argv=None):
                                        if None not in (killed_at,
                                                        new_restart)
                                        else None)}
+        if args.elastic:
+            recovery["survivors"] = args.hosts - 1
         recoveries.append(recovery)
+        if args.elastic:
+            survivors = args.hosts - 1
+            if survivors < max(args.min_hosts, 1):
+                manifest.update(recoveries=recoveries, status="halted")
+                manifest_mod.write_cluster_manifest(resdir, manifest)
+                telem.event("fleet_halt", reason="below_min_hosts",
+                            survivors=survivors, min_hosts=args.min_hosts)
+                outcome = "below_min_hosts"
+                break
+            # The shrink is a versioned membership event, persisted
+            # BEFORE any respawn: slot ids are the ORIGINAL fleet's host
+            # indices; surviving slots keep their ids while the spawn
+            # renumbers proc ids densely over the survivors
+            slots = sorted(int(s) for s in membership.shards)
+            slot = slots[killed_host]
+            membership.bump("dead", slot, died_at_step=killed_at,
+                            attempt=attempt)
+            membership.bump("remove", slot)
+            spec = elastic_mod.shrunk_spec(elastic_base, survivors)
+            ring_mod.write_fleet_manifest(
+                resdir, membership, initial_hosts=initial_hosts,
+                config=spec)
+            for key, value in spec.items():
+                setattr(args, key, value)
+            shrinks.append({"attempt": attempt, "from": survivors + 1,
+                            "to": survivors, "killed_host": killed_host,
+                            "slot": slot, "died_at_step": killed_at,
+                            "membership_version": membership.version,
+                            "config": spec})
+            manifest["elastic"] = {"initial_hosts": initial_hosts,
+                                   "hosts": args.hosts,
+                                   "min_hosts": args.min_hosts,
+                                   "shrinks": shrinks}
+            telem.event("fleet_shrink", attempt=attempt,
+                        survivors=survivors, killed_host=killed_host,
+                        slot=slot,
+                        membership_version=membership.version,
+                        nb_workers=args.nb_workers,
+                        nb_decl_byz=args.nb_decl_byz)
         manifest.update(recoveries=recoveries, status="recovering")
         manifest_mod.write_cluster_manifest(resdir, manifest)
         telem.event("fleet_teardown", attempt=attempt,
@@ -451,6 +664,8 @@ def main(argv=None):
             break
 
     # ---------------- outcome -> artifact + exit code ---------------- #
+    if resumer is not None:
+        resumer.stop()
     census = _check_census(resdir, args.hosts)
     if outcome == "completed":
         from byzantinemomentum_tpu.obs.heartbeat import (
@@ -483,6 +698,15 @@ def main(argv=None):
                      "recoveries": recoveries,
                      "recovery_steps": recovery_steps,
                      "attempts": attempt},
+        "elastic": ({"initial_hosts": initial_hosts,
+                     "final_hosts": args.hosts,
+                     "min_hosts": args.min_hosts,
+                     "shrinks": shrinks,
+                     "membership_version": membership.version}
+                    if args.elastic else None),
+        "straggler": (policy.summary() if policy is not None else None),
+        "straggle_windows": (resumer.stats() if resumer is not None
+                             else None),
         "census": census,
         "zero_recompile": ({"warm_steps": args.recompile_check,
                             "asserted": outcome == "completed"}

@@ -147,7 +147,7 @@ def initialize(spec):
             f"declares {spec.num_processes}")
 
 
-def cluster_mesh(model_parallel=1):
+def cluster_mesh(model_parallel=1, expected_workers=None):
     """The global `(workers, model)` mesh over EVERY process's devices.
 
     The default `model_parallel=1` keeps every state buffer fully
@@ -156,6 +156,12 @@ def cluster_mesh(model_parallel=1):
     d-shards the state ACROSS hosts — the lattice census covers that
     layout's collectives (`analysis/lattice.py::multiprocess_cells`), but
     checkpointing it needs a gather pass this runtime does not do yet.
+
+    `expected_workers` pins the workers-axis extent to the fleet width
+    the launcher spawned: the mesh spans whatever devices actually
+    joined, so under an elastic shrink/relaunch a straggling old host
+    that somehow rejoined would silently widen the axis — better a loud
+    refusal than a program compiled for the wrong `(n, f)` contract.
     """
     import jax
 
@@ -166,7 +172,13 @@ def cluster_mesh(model_parallel=1):
             "cluster_mesh only supports model_parallel=1 for now: the "
             "host runtime reads and checkpoints the state from single "
             "processes, which requires it fully replicated")
-    return make_mesh(len(jax.devices()), model_parallel=model_parallel)
+    mesh = make_mesh(len(jax.devices()), model_parallel=model_parallel)
+    if expected_workers is not None \
+            and mesh.shape["workers"] != int(expected_workers):
+        raise ClusterUnavailable(
+            f"mesh workers axis spans {mesh.shape['workers']} devices "
+            f"but the launcher expects a {expected_workers}-host fleet")
+    return mesh
 
 
 def shutdown():

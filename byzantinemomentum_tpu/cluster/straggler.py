@@ -1,0 +1,222 @@
+"""Launcher-side straggler policy: bounded wait, then kill.
+
+A SIGSTOP'd (or livelocked, or swap-thrashing) host never exits, so the
+process table says "up" forever while gloo wedges the whole fleet on its
+next collective — without a policy the run hangs until the Jobs watchdog
+fires at whole-run scope. This state machine turns the PR 13
+`liveness_view` stale/alive edges (and the PR 15 health block riding the
+heartbeat) into a bounded per-host decision:
+
+    HEALTHY --stale / sustained anomaly--> SUSPECT
+    SUSPECT --fresh heartbeat / anomaly cleared--> HEALTHY  (recovered)
+    SUSPECT --wait bound exceeded--> KILLED
+
+The wait bound defaults to the `scripts/stale_edges.py` recommendation
+(p95 of observed stale->alive recoveries x 1.25): waiting that long
+clears ~95% of transient stalls, so anything older is overwhelmingly a
+corpse-in-waiting and the launcher kills it — the elastic shrink path
+(`cluster/elastic.py`) then rebuilds the fleet one host smaller instead
+of wedging.
+
+Two details matter for correctness:
+
+* **Kill the laggard, not its hostages.** A stopped host wedges its
+  PEERS too (they block in the next collective and also go stale), so at
+  the bound nearly every host looks suspect. The policy kills at most
+  one host per fleet attempt's observation stream, preferring a host the
+  launcher observed NOT SCHEDULING (Linux process state `T`, SIGSTOP'd —
+  decisive evidence, since a wedged-but-runnable hostage is never `T`);
+  among the remaining candidates, the one that has been suspect LONGEST,
+  tie-broken by oldest heartbeat — the host that stopped stepping first
+  is the culprit; its hostages come back on relaunch.
+* **Arm only past a warm step.** Compilation of step 1 (and of the
+  resume step after a relaunch) stalls heartbeats for tens of seconds —
+  legitimately. A host only becomes eligible for suspicion after the
+  policy has seen it ALIVE at a step beyond the first one it reported,
+  i.e. after the loop is demonstrably warm. Cold-start hangs stay the
+  Jobs watchdog's jurisdiction.
+
+The quarantine arm replays the arena's worker-quarantine hysteresis at
+host scope: `anomaly_enter` consecutive anomalous polls to enter SUSPECT
+(one bad window is not a verdict), `anomaly_clear` clean polls to leave.
+"""
+
+import json
+import pathlib
+
+__all__ = ["DEFAULT_WAIT_S", "StragglerPolicy", "resolve_wait_bound"]
+
+DEFAULT_WAIT_S = 30.0
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+
+
+class StragglerPolicy:
+    """Folds `liveness_view` polls into HEALTHY/SUSPECT/KILLED decisions.
+
+    The policy is a pure fold over `(view, now)` observations — it never
+    touches processes itself; the launcher acts on the returned `kill`
+    events (and then calls `reset()` when it relaunches the fleet, since
+    a fresh attempt's hosts share nothing with the wedged one).
+    """
+
+    def __init__(self, wait_s, *, source="flag", quarantine=False,
+                 anomaly_enter=3, anomaly_clear=2):
+        self.wait_s = float(wait_s)
+        self.source = str(source)
+        self.quarantine = bool(quarantine)
+        self.anomaly_enter = max(int(anomaly_enter), 1)
+        self.anomaly_clear = max(int(anomaly_clear), 1)
+        # Lifetime counters (survive reset(): the artifact reports them)
+        self.kills = []
+        self.recoveries = []
+        self.suspects_entered = 0
+        self.reset()
+
+    def reset(self):
+        """Forget per-attempt transient state (a relaunched fleet starts
+        every host HEALTHY and cold — arming is per-attempt too)."""
+        self._suspect = {}      # host -> {"since", "reason", "age"}
+        self._first_step = {}   # host -> first step its heartbeat showed
+        self._armed = set()     # hosts seen alive PAST their first step
+        self._anomaly_streak = {}
+        self._clean_streak = {}
+        self._killed = set()
+
+    def _arm(self, host, row):
+        step = row.get("step")
+        if not isinstance(step, int):
+            return
+        if host not in self._first_step:
+            self._first_step[host] = step
+        elif (row["status"] == "alive"
+              and step > self._first_step[host]):
+            self._armed.add(host)
+
+    def _enter(self, host, reason, row, now, events):
+        self._suspect[host] = {"since": now, "reason": reason,
+                               "age": row.get("age")}
+        self.suspects_entered += 1
+        events.append({"event": "suspect", "host": host, "reason": reason,
+                       "step": row.get("step"), "age": row.get("age")})
+
+    def _recover(self, host, row, now, events):
+        entry = self._suspect.pop(host)
+        record = {"event": "recovered", "host": host,
+                  "reason": entry["reason"], "step": row.get("step"),
+                  "suspect_s": round(now - entry["since"], 3)}
+        self.recoveries.append({k: v for k, v in record.items()
+                                if k != "event"})
+        events.append(record)
+
+    def observe(self, view, now, stopped=frozenset()):
+        """Fold one liveness poll. Returns the transition events — each
+        `{"event": "suspect"|"recovered"|"kill", "host": ..., ...}` —
+        with at most one `kill` per call; the launcher must act on it
+        (SIGKILL + teardown + shrink/relaunch). `stopped` holds hosts
+        the launcher observed not scheduling (process state `T`); they
+        are blamed FIRST when the bound expires."""
+        events = []
+        for host, row in view["hosts"].items():
+            status = row["status"]
+            if host in self._killed:
+                continue
+            if status in ("dead", "unknown"):
+                # Process-table death is the launcher's jurisdiction;
+                # no-signal-yet is pre-arming by definition
+                self._suspect.pop(host, None)
+                self._anomaly_streak.pop(host, None)
+                continue
+            self._arm(host, row)
+            if host not in self._armed:
+                continue
+            anomaly = bool(self.quarantine
+                           and isinstance(row.get("health"), dict)
+                           and row["health"].get("anomaly"))
+            if status == "stale":
+                if host not in self._suspect:
+                    self._enter(host, "stale", row, now, events)
+                continue
+            # status == "alive"
+            if anomaly:
+                streak = self._anomaly_streak.get(host, 0) + 1
+                self._anomaly_streak[host] = streak
+                self._clean_streak[host] = 0
+                if (host not in self._suspect
+                        and streak >= self.anomaly_enter):
+                    self._enter(host, "health", row, now, events)
+                continue
+            self._anomaly_streak[host] = 0
+            if host not in self._suspect:
+                continue
+            if self._suspect[host]["reason"] == "stale":
+                # A fresh heartbeat ends a stall immediately
+                self._recover(host, row, now, events)
+            else:
+                clean = self._clean_streak.get(host, 0) + 1
+                self._clean_streak[host] = clean
+                if clean >= self.anomaly_clear:
+                    self._recover(host, row, now, events)
+
+        expired = [(host, entry) for host, entry in self._suspect.items()
+                   if now - entry["since"] > self.wait_s]
+        if expired and not self._killed:
+            # One kill per ATTEMPT, not per poll: the teardown takes a
+            # poll or two to surface as a dead process, and in that
+            # window the hostages are still stale and past the bound —
+            # without this gate the policy would massacre them one per
+            # poll before the relaunch could save them.
+            # One kill per observation stream: a host observed NOT
+            # SCHEDULING is the laggard outright (its hostages are
+            # runnable, merely blocked); otherwise the longest-suspect
+            # host (oldest heartbeat breaks ties) — the host that
+            # stopped stepping first. The rest come back on relaunch.
+            def _blame(item):
+                host, entry = item
+                age = view["hosts"].get(host, {}).get("age")
+                return (now - entry["since"],
+                        age if age is not None else -1.0)
+
+            pool = ([item for item in expired if item[0] in stopped]
+                    or expired)
+            host, entry = max(pool, key=_blame)
+            self._suspect.pop(host)
+            self._killed.add(host)
+            record = {"event": "kill", "host": host,
+                      "reason": entry["reason"],
+                      "suspect_s": round(now - entry["since"], 3),
+                      "wait_s": self.wait_s,
+                      "not_scheduling": host in stopped}
+            self.kills.append({k: v for k, v in record.items()
+                               if k != "event"})
+            events.append(record)
+        return events
+
+    def summary(self):
+        """The artifact's straggler block."""
+        return {"wait_s": self.wait_s, "source": self.source,
+                "quarantine": self.quarantine,
+                "suspects_entered": self.suspects_entered,
+                "kills": list(self.kills),
+                "recoveries": list(self.recoveries)}
+
+
+def resolve_wait_bound(explicit=None, edges_path=None):
+    """The wait bound and where it came from: an explicit
+    `--straggler-wait` wins; else the machine-readable recommendation
+    block of a `scripts/stale_edges.py --json` summary; else the
+    conservative default. Returns `(wait_s, source)`."""
+    if explicit is not None:
+        return float(explicit), "flag"
+    if edges_path:
+        payload = json.loads(
+            pathlib.Path(edges_path).read_text(encoding="utf-8"))
+        rec = payload.get("recommendation") or {}
+        wait = rec.get("wait_s", payload.get("recommended_wait_s"))
+        if wait is not None:
+            basis = rec.get("basis", "recommended_wait_s")
+            return float(wait), f"stale-edges:{basis}"
+        raise ValueError(f"{edges_path} carries no recommendation "
+                         f"(no recoveries or deaths observed)")
+    return DEFAULT_WAIT_S, "default"

@@ -28,7 +28,7 @@ import pathlib
 
 __all__ = ["FaultEvent", "FaultPolicy", "FaultPlan", "KINDS", "MODES",
            "SYSTEM_KINDS", "straggler", "drop_worker", "corrupt_gradient",
-           "duplicate_submission", "device_loss"]
+           "duplicate_submission", "device_loss", "straggle"]
 
 # Fault taxonomy. `device_loss` is the permanent form of `drop_worker`:
 # from its step on, the worker never submits again (no duration).
@@ -40,12 +40,16 @@ KINDS = ("straggler", "drop_worker", "corrupt_gradient",
 MODES = ("nan", "zero", "scale")
 
 # Kinds a plan may carry at SYSTEM scope (`cluster/chaos.py`): there,
-# `worker` indexes a HOST process of a multi-controller fleet and
-# `device_loss` means SIGKILL — real lost hardware, not a masked row.
-# The in-step kinds (straggler/corruption/duplication) have no system
-# analogue yet; `validate_system` refuses them so a plan cannot silently
-# mean two different things.
-SYSTEM_KINDS = ("device_loss",)
+# `worker` indexes a HOST process of a multi-controller fleet,
+# `device_loss` means SIGKILL — real lost hardware, not a masked row —
+# and `straggle` means SIGSTOP now / SIGCONT after `window_s` wall-clock
+# seconds: a host that is alive-but-not-stepping, the failure mode the
+# launcher's straggler policy (`cluster/straggler.py`) must distinguish
+# from a corpse. The in-step kinds (straggler/corruption/duplication)
+# have no system analogue; `validate_system` refuses them — and
+# `validate` refuses the system kinds in-step — so a plan cannot
+# silently mean two different things.
+SYSTEM_KINDS = ("device_loss", "straggle")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +68,11 @@ class FaultEvent:
                              gradient instead of its own.
       device_loss          — permanently gone from `step` on (`duration`
                              ignored).
+      straggle             — SYSTEM scope only: the host is SIGSTOP'd when
+                             the fleet reaches `step` and SIGCONT'd
+                             `window_s` wall-clock seconds later (steps are
+                             meaningless to a stopped process, so the
+                             window is time, not `duration`).
     """
 
     kind: str
@@ -73,11 +82,17 @@ class FaultEvent:
     mode: str = "nan"
     scale: float = 10.0
     source: int = 0
+    window_s: float = 0.0
 
     def __post_init__(self):
-        if self.kind not in KINDS:
+        if self.kind not in KINDS and self.kind not in SYSTEM_KINDS:
             raise ValueError(
-                f"Unknown fault kind {self.kind!r}; expected one of {KINDS}")
+                f"Unknown fault kind {self.kind!r}; expected one of "
+                f"{KINDS + tuple(k for k in SYSTEM_KINDS if k not in KINDS)}")
+        if self.kind == "straggle" and self.window_s <= 0:
+            raise ValueError(
+                f"straggle needs a positive wall-clock window_s, got "
+                f"{self.window_s}")
         if self.worker < 0:
             raise ValueError(f"Negative worker index {self.worker}")
         if self.step < 0:
@@ -126,6 +141,12 @@ def duplicate_submission(worker, step, source, duration=1):
 def device_loss(worker, step):
     """Worker is permanently lost from `step` on."""
     return FaultEvent("device_loss", worker, step)
+
+
+def straggle(host, step, window_s):
+    """SYSTEM scope: host SIGSTOP'd at `step`, SIGCONT'd `window_s`
+    seconds later."""
+    return FaultEvent("straggle", host, step, window_s=float(window_s))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +204,10 @@ class FaultPlan:
         """None if the plan fits an (n = nb_workers, h = nb_honests) run,
         else a human-readable refusal (CLI contract, like `GAR.check`)."""
         for e in self.events:
+            if e.kind not in KINDS:
+                return (f"fault {e.kind!r} only exists at SYSTEM scope "
+                        f"(a jitted step cannot SIGSTOP a host); in-step "
+                        f"plans may only use {'/'.join(KINDS)}")
             if e.worker >= nb_workers:
                 return (f"fault {e.kind!r} targets worker {e.worker} but the "
                         f"run has only {nb_workers} workers")

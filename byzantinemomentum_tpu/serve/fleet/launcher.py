@@ -69,6 +69,10 @@ def process_commandline(argv=None):
         choices=("queue", "error"),
         help="Dead-arc policy: park lines behind the restart, or fail "
              "them fast")
+    add("--max-parked", type=int, default=1024,
+        help="Parked-line bound per dead arc under --on-dead queue: "
+             "past it further lines fail fast (each parked line is a "
+             "blocked client connection thread)")
     add("--max-batch", type=int, default=8)
     add("--max-delay-ms", type=float, default=2.0)
     add("--no-diagnostics", action="store_true", default=False)
@@ -206,6 +210,7 @@ class FleetLauncher:
             {s: (row["host"], row["port"])
              for s, row in self.membership.shards.items()},
             vnodes=self.args.vnodes, on_dead=self.args.on_dead,
+            max_parked=self.args.max_parked,
             liveness_hook=self._liveness_hook)
         self.server = RouterServer((self.host, self.args.port), self.router)
         self.server.serve_background()

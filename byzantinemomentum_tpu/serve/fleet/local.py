@@ -33,7 +33,8 @@ class LocalFleet:
     """N in-process shards + router. Use as a context manager."""
 
     def __init__(self, shards=2, *, vnodes=DEFAULT_VNODES,
-                 on_dead="queue", router_server=False, service=None):
+                 on_dead="queue", max_parked=1024, router_server=False,
+                 service=None):
         from byzantinemomentum_tpu.serve.frontend import AggregationServer
         from byzantinemomentum_tpu.serve.service import AggregationService
 
@@ -55,7 +56,7 @@ class LocalFleet:
         self.router = FleetRouter(
             {s: (row["host"], row["port"])
              for s, row in self.membership.shards.items()},
-            vnodes=vnodes, on_dead=on_dead)
+            vnodes=vnodes, on_dead=on_dead, max_parked=max_parked)
         self.server = None
         if router_server:
             self.server = RouterServer(("127.0.0.1", 0), self.router)

@@ -34,7 +34,12 @@ model in `analysis/schedule.py` and passes the BMT-T gate):
   Lines still queued behind a dead shard follow the `on_dead` policy:
   `"queue"` parks them until the launcher restarts the shard on its
   port (the arc revives, ownership never moved), `"error"` fails them
-  fast.
+  fast. The parked line is BOUNDED (`max_parked`): past the cap a dead
+  arc fails further lines fast instead of parking them — each parked
+  line is a blocked client connection thread holding its buffers for
+  up to `reply_timeout`, so an unbounded park under a flash crowd is a
+  memory/thread amplifier, not patience. Rejections count in
+  `stats()["parked_rejected"]`.
 * **health watcher** — probes dead arcs with short-lived ping
   connections and revives them; under the `"error"` policy it is the
   only revival path for a trafficless shard.
@@ -82,13 +87,16 @@ class FleetRouter:
     """Consistent-hash router over `shards`: {shard id: (host, port)}."""
 
     def __init__(self, shards, *, vnodes=DEFAULT_VNODES, on_dead="queue",
-                 reply_timeout=30.0, connect_timeout=2.0,
+                 max_parked=1024, reply_timeout=30.0, connect_timeout=2.0,
                  retry_interval=0.05, probe_interval=0.25,
                  trace_buffer=512, liveness_hook=None):
         if on_dead not in ("queue", "error"):
             raise ValueError(f"on_dead must be 'queue' or 'error', "
                              f"got {on_dead!r}")
+        if max_parked < 1:
+            raise ValueError(f"max_parked must be >= 1, got {max_parked}")
         self.on_dead = on_dead
+        self.max_parked = int(max_parked)
         self._addresses = {str(s): tuple(addr) for s, addr in shards.items()}
         self._ring = HashRing(sorted(self._addresses), vnodes=vnodes)
         self._reply_timeout = float(reply_timeout)
@@ -110,6 +118,7 @@ class FleetRouter:
         self._epochs = {s: 0 for s in self._addresses}
         self._errors = 0
         self._timeouts = 0
+        self._parked_rejected = 0
         self._anon = 0
         self._trace_buffer = int(trace_buffer)
         self._spans = []  # bounded [(route_ms, shard_rtt_ms, total_ms)]
@@ -210,6 +219,15 @@ class FleetRouter:
                 self._errors += 1
             return self._error_bytes(f"shard {shard} is dead "
                                      f"(on_dead=error)", shard=shard)
+        if not alive and self._queues[shard].qsize() >= self.max_parked:
+            # Bounded park: each parked line is a blocked connection
+            # thread; past the cap the dead arc fails fast instead of
+            # amplifying a flash crowd into unbounded queued memory
+            with self._lock:
+                self._parked_rejected += 1
+            return self._error_bytes(
+                f"shard {shard} is dead and its parked line is full "
+                f"({self.max_parked} lines)", shard=shard)
         item = _Item(raw, stamps={"recv": received})
         item.stamps["routed"] = time.monotonic()
         self._queues[shard].put(item)
@@ -384,6 +402,8 @@ class FleetRouter:
                 "dead": list(self._ring.dead),
                 "errors": self._errors,
                 "timeouts": self._timeouts,
+                "max_parked": self.max_parked,
+                "parked_rejected": self._parked_rejected,
                 "queued": {s: self._queues[s].qsize()
                            for s in sorted(self._addresses)},
             }

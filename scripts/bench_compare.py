@@ -408,6 +408,19 @@ def compare_cluster(old_payload, new_payload, tolerance):
                                                       else float("inf"))
             rows.append((f"recovery.{key} (info)", float(old), float(new),
                          delta))
+    # Elastic rounds (PR 17), both informational: re-executed steps after
+    # the shrink, and how long the straggler policy held a SUSPECT before
+    # killing (the realized bounded wait). The caller already refused
+    # pairs whose shrink rounds survived at different fleet sizes.
+    for block, key in (("shrink_round", "recovery_steps"),
+                       ("straggler_round", "suspect_s")):
+        old = (old_payload.get(block) or {}).get(key)
+        new = (new_payload.get(block) or {}).get(key)
+        if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+            delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                      else float("inf"))
+            rows.append((f"{block}.{key} (info)", float(old), float(new),
+                         delta))
     return rows, regressions
 
 
@@ -624,6 +637,18 @@ def main(argv=None):
             print(f"bench_compare: INCOMPARABLE — cluster run status "
                   f"{statuses[0]!r} vs {statuses[1]!r} (only ok runs "
                   f"carry comparable throughput)")
+            return 0
+        # Elastic shrink rounds only compare like-for-like: a round that
+        # survived at 3 hosts ran a DIFFERENT fleet than one surviving
+        # at 2 — its step rate and recovery cost measure another machine.
+        # One-sided presence stays comparable on the legacy metrics.
+        survivors = [(p.get("shrink_round") or {}).get("final_hosts")
+                     for p in payloads]
+        if all(s is not None for s in survivors) \
+                and survivors[0] != survivors[1]:
+            print(f"bench_compare: INCOMPARABLE — shrink rounds survived "
+                  f"at different fleet sizes ({survivors[0]} vs "
+                  f"{survivors[1]} hosts)")
             return 0
         rows, regressions = compare_cluster(old_payload, new_payload,
                                             args.tolerance)

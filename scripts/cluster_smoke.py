@@ -14,9 +14,26 @@ renders across rounds (`CLUSTER_r*.json`). An unavailable distributed
 runtime produces a clean `"status": "unavailable"` artifact and exit 0
 (the bench.py cpu-fallback discipline) — never an rc=124 hang.
 
+Two opt-in elastic rounds ride along (PR 17; `--smoke` alone stays the
+legacy pair, bit-identity included):
+
+* `--shrink-round` — an N-host fleet (default 4) loses a host to the
+  chaos plan and, under `--elastic`, resumes at the SURVIVOR count from
+  the off-slice mirror: quorum `f` re-clamped, `nb_workers`/study split
+  re-derived, the shrink persisted as a versioned membership event, and
+  the study CSV well-formed through the shrink (divergence past the
+  shrink is by design — the fleet got smaller).
+* `--straggler-round` — a host is SIGSTOP'd twice by `straggle` chaos
+  windows: a short window it must SURVIVE (stale -> suspect ->
+  recovered, zero kills inside the bounded wait) and a long window that
+  must get it KILLED within the bound (blamed by not-scheduling
+  process-state evidence, never a wedged hostage), after which the
+  elastic shrink completes the run one host smaller.
+
 Usage:
   python scripts/cluster_smoke.py --smoke            # 2 hosts, CI size
   python scripts/cluster_smoke.py --hosts 4 --steps 12 --out CLUSTER.json
+  python scripts/cluster_smoke.py --smoke --shrink-round --straggler-round
 """
 
 import argparse
@@ -58,6 +75,160 @@ def _launch(resdir, hosts, steps, extra, timeout):
     return proc, artifact, elapsed
 
 
+def _check_study(resdir, steps):
+    """The study CSV's well-formedness verdict: `(rows, problem)`. The
+    header and every row must carry the full schema, steps must run
+    contiguously 0..steps-1 — a shrunk fleet DIVERGES numerically past
+    the shrink (smaller quorum, re-split workers), but the trajectory it
+    writes must still be one contiguous, duplicate-free table."""
+    from byzantinemomentum_tpu.engine import STUDY_COLUMNS
+
+    try:
+        text = (resdir / "study").read_text()
+    except OSError as err:
+        return 0, f"study CSV unreadable: {err}"
+    lines = [line for line in text.split(os.linesep) if line]
+    header = "# " + "\t".join(STUDY_COLUMNS)
+    if not lines or lines[0] != header:
+        return 0, "study CSV header mismatch"
+    seen = []
+    for line in lines[1:]:
+        fields = line.split("\t")
+        if len(fields) != len(STUDY_COLUMNS):
+            return len(seen), (f"study row with {len(fields)} fields "
+                               f"(want {len(STUDY_COLUMNS)})")
+        try:
+            seen.append(int(fields[0]))
+        except ValueError:
+            return len(seen), f"unparsable step field {fields[0]!r}"
+    if seen != list(range(steps)):
+        return len(seen), (f"study steps not contiguous 0..{steps - 1}: "
+                           f"{seen[:4]}..{seen[-4:] if seen else []}")
+    return len(seen), None
+
+
+def _shrink_round(args, workdir):
+    """The partial-fleet survival proof. Returns (block, problem)."""
+    from byzantinemomentum_tpu.cluster import elastic
+    from byzantinemomentum_tpu.faults import FaultPlan
+    from byzantinemomentum_tpu.faults.plan import device_loss
+    from byzantinemomentum_tpu.serve.fleet import ring
+
+    hosts, steps, kill_step = args.shrink_hosts, 8, 5
+    nb = 2 * hosts  # uniform splits at EVERY survivor width >= 1
+    base = {"hosts": hosts, "nb_workers": nb, "nb_decl_byz": 2,
+            "nb_real_byz": 2, "nb_for_study": nb, "gar": "median"}
+    rdir = workdir / "shrink"
+    plan_path = workdir / "shrink-fault-plan.json"
+    FaultPlan(events=(device_loss(hosts - 1, kill_step),)).save(plan_path)
+    proc, art, _ = _launch(
+        rdir, hosts, steps,
+        ["--fault-plan", str(plan_path), "--auto-resume",
+         "--fleet-retries", "2", "--elastic",
+         "--nb-workers", str(nb), "--nb-for-study", str(nb)],
+        args.timeout)
+    if art is None or proc.returncode != 0 or art.get("status") != "ok":
+        print(proc.stdout[-2000:] + proc.stderr[-2000:], file=sys.stderr)
+        return None, (f"shrink fleet failed (rc={proc.returncode}, "
+                      f"status={(art or {}).get('status')})")
+    elastic_block = art.get("elastic") or {}
+    shrinks = elastic_block.get("shrinks") or []
+    if elastic_block.get("initial_hosts") != hosts \
+            or elastic_block.get("final_hosts") != hosts - 1 \
+            or len(shrinks) != 1:
+        return None, f"expected exactly one shrink {hosts}->{hosts - 1}, " \
+                     f"got {elastic_block}"
+    want = elastic.shrunk_spec(base, hosts - 1)
+    if shrinks[0].get("config") != want:
+        return None, (f"shrunk config {shrinks[0].get('config')} != "
+                      f"re-derived {want}")
+    payload = ring.read_fleet_manifest(rdir)
+    member = ring.Membership.replay(payload) if payload else None
+    if member is None or len(member.shards) != hosts - 1 \
+            or member.version != elastic_block.get("membership_version"):
+        return None, "fleet.json membership does not replay to the " \
+                     "shrunken fleet"
+    rows, problem = _check_study(rdir, steps)
+    if problem is not None:
+        return None, problem
+    recovery = art.get("recovery") or {}
+    return {"status": "ok", "hosts": hosts, "final_hosts": hosts - 1,
+            "kill_step": kill_step,
+            "died_at_step": shrinks[0].get("died_at_step"),
+            "recovery_steps": recovery.get("recovery_steps"),
+            "config": want,
+            "membership_version": member.version,
+            "study_rows": rows}, None
+
+
+def _straggler_round(args, workdir):
+    """The bounded-wait straggler proof. Returns (block, problem)."""
+    from byzantinemomentum_tpu.faults import FaultPlan
+    from byzantinemomentum_tpu.faults.plan import straggle
+
+    hosts, steps = args.straggler_hosts, 10
+    nb = 2 * hosts
+    victim = hosts - 1
+    wait = args.straggler_wait
+    # Short window: strictly inside the bound — the host must RECOVER
+    # (stale -> suspect -> fresh heartbeat), zero kills. Long window:
+    # far past it — the host must be killed at ~stale+bound, the pending
+    # SIGCONT cancelled, the fleet shrunk and completed.
+    short_s = wait / 2.0
+    rdir = workdir / "straggler"
+    plan_path = workdir / "straggle-fault-plan.json"
+    FaultPlan(events=(straggle(victim, 2, short_s),
+                      straggle(victim, 6, 30 * wait))).save(plan_path)
+    proc, art, _ = _launch(
+        rdir, hosts, steps,
+        ["--fault-plan", str(plan_path), "--auto-resume",
+         "--fleet-retries", "2", "--elastic",
+         "--heartbeat-stale", "2.0",
+         "--straggler-wait", str(wait),
+         "--nb-workers", str(nb), "--nb-for-study", str(nb)],
+        args.timeout)
+    if art is None or proc.returncode != 0 or art.get("status") != "ok":
+        print(proc.stdout[-2000:] + proc.stderr[-2000:], file=sys.stderr)
+        return None, (f"straggler fleet failed (rc={proc.returncode}, "
+                      f"status={(art or {}).get('status')})")
+    straggler = art.get("straggler") or {}
+    kills = straggler.get("kills") or []
+    recoveries = straggler.get("recoveries") or []
+    if len(kills) != 1:
+        return None, (f"expected exactly one straggler kill, got "
+                      f"{kills} (a merely-slow host must never die "
+                      f"inside the bound)")
+    kill = kills[0]
+    if kill.get("host") != victim:
+        return None, f"killed host {kill.get('host')}, not the " \
+                     f"SIGSTOP'd host {victim}"
+    # The bounded wait, with 1-core scheduling slack on top: the kill
+    # must land at ~(stale edge + bound), never "eventually"
+    if not kill.get("suspect_s") or kill["suspect_s"] > wait + 6.0:
+        return None, f"kill outside the bounded wait: {kill}"
+    if not any(r.get("host") == victim and r.get("reason") == "stale"
+               for r in recoveries):
+        return None, (f"short straggle window did not recover "
+                      f"(recoveries={recoveries})")
+    windows = art.get("straggle_windows") or {}
+    if not windows.get("resumed") or not windows.get("cancelled"):
+        return None, f"straggle windows not exercised: {windows}"
+    elastic_block = art.get("elastic") or {}
+    if elastic_block.get("final_hosts") != hosts - 1:
+        return None, f"straggler kill did not shrink the fleet: " \
+                     f"{elastic_block}"
+    recovery = art.get("recovery") or {}
+    return {"status": "ok", "hosts": hosts, "final_hosts": hosts - 1,
+            "wait_s": straggler.get("wait_s"),
+            "kills": len(kills), "killed_host": kill.get("host"),
+            "kill_reason": kill.get("reason"),
+            "not_scheduling": kill.get("not_scheduling"),
+            "suspect_s": kill.get("suspect_s"),
+            "recoveries": len(recoveries),
+            "windows": windows,
+            "recovery_steps": recovery.get("recovery_steps")}, None
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="cluster_smoke")
     parser.add_argument("--hosts", type=int, default=2)
@@ -76,11 +247,30 @@ def main(argv=None):
                              "a round)")
     parser.add_argument("--timeout", type=float, default=1200.0,
                         help="bound on EACH fleet run in seconds")
+    parser.add_argument("--shrink-round", action="store_true",
+                        help="elastic partial-fleet survival round: kill "
+                             "one host, resume at the SURVIVOR count")
+    parser.add_argument("--shrink-hosts", type=int, default=4,
+                        help="fleet size of the shrink round")
+    parser.add_argument("--straggler-round", action="store_true",
+                        help="bounded-wait straggler round: SIGSTOP "
+                             "windows, one survived, one killed-and-"
+                             "shrunk")
+    parser.add_argument("--straggler-hosts", type=int, default=3,
+                        help="fleet size of the straggler round")
+    parser.add_argument("--straggler-wait", type=float, default=8.0,
+                        help="bounded wait of the straggler round's "
+                             "policy in seconds")
     args = parser.parse_args(argv)
     if args.smoke:
         args.hosts, args.steps = 2, 6
     if args.hosts < 2:
         parser.error("the recovery proof needs at least 2 hosts")
+    if args.shrink_round and args.shrink_hosts < 3:
+        parser.error("the shrink round needs at least 3 hosts (the "
+                     "survivors must still be a fleet)")
+    if args.straggler_round and args.straggler_hosts < 2:
+        parser.error("the straggler round needs at least 2 hosts")
     # Default kill step: mid-run, and ODD so it lands between the
     # checkpoint-delta-2 milestones — the recovery then provably
     # re-executes at least one step instead of resuming for free
@@ -98,14 +288,20 @@ def main(argv=None):
         out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(json.dumps(payload, indent="\t", sort_keys=True)
                        + "\n")
-        print("cluster-smoke: " + json.dumps(
-            {"status": payload.get("status"),
-             "hosts": payload.get("hosts"),
-             "steps_per_sec": payload.get("steps_per_sec"),
-             "recovery_steps": (payload.get("recovery") or {}).get(
-                 "recovery_steps"),
-             "bit_identical": payload.get("bit_identical"),
-             "artifact": str(out)}), flush=True)
+        line = {"status": payload.get("status"),
+                "hosts": payload.get("hosts"),
+                "steps_per_sec": payload.get("steps_per_sec"),
+                "recovery_steps": (payload.get("recovery") or {}).get(
+                    "recovery_steps"),
+                "bit_identical": payload.get("bit_identical"),
+                "artifact": str(out)}
+        if payload.get("shrink_round") is not None:
+            line["shrink_recovery_steps"] = payload["shrink_round"].get(
+                "recovery_steps")
+        if payload.get("straggler_round") is not None:
+            line["straggler_kills"] = payload["straggler_round"].get(
+                "kills")
+        print("cluster-smoke: " + json.dumps(line), flush=True)
         if args.workdir is None and rc == 0:
             shutil.rmtree(workdir, ignore_errors=True)
         return rc
@@ -163,6 +359,26 @@ def main(argv=None):
     if not identical:
         artifact["status"] = "divergent_resume"
         return finish(artifact, 1)
+
+    # --- opt-in elastic rounds: shrink survival + straggler policy --- #
+    if args.shrink_round:
+        block, problem = _shrink_round(args, workdir)
+        if problem is not None:
+            print(f"cluster-smoke: shrink round: {problem}",
+                  file=sys.stderr)
+            artifact["shrink_round"] = {"status": "failed",
+                                        "problem": problem}
+            return finish(dict(artifact, status="shrink_failed"), 1)
+        artifact["shrink_round"] = block
+    if args.straggler_round:
+        block, problem = _straggler_round(args, workdir)
+        if problem is not None:
+            print(f"cluster-smoke: straggler round: {problem}",
+                  file=sys.stderr)
+            artifact["straggler_round"] = {"status": "failed",
+                                           "problem": problem}
+            return finish(dict(artifact, status="straggler_failed"), 1)
+        artifact["straggler_round"] = block
     return finish(artifact, 0)
 
 

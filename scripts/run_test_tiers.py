@@ -334,7 +334,9 @@ def main():
     print("cluster tier ...", flush=True)
     with telemetry.span("tier_cluster"):
         cluster_proc = subprocess.run(
-            [sys.executable, "scripts/cluster_smoke.py", "--smoke"],
+            [sys.executable, "scripts/cluster_smoke.py", "--smoke",
+             "--shrink-round", "--shrink-hosts", "3",
+             "--straggler-round", "--straggler-hosts", "2"],
             cwd=ROOT, capture_output=True, text=True,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
     cluster_tier = {"returncode": cluster_proc.returncode}
@@ -349,6 +351,10 @@ def main():
             cluster_tier["steps_per_sec"] = payload.get("steps_per_sec")
             cluster_tier["recovery_steps"] = payload.get("recovery_steps")
             cluster_tier["bit_identical"] = payload.get("bit_identical")
+            cluster_tier["shrink_recovery_steps"] = payload.get(
+                "shrink_recovery_steps")
+            cluster_tier["straggler_kills"] = payload.get(
+                "straggler_kills")
     if cluster_proc.returncode != 0:
         cluster_tier["tail"] = (cluster_proc.stdout
                                 + cluster_proc.stderr).splitlines()[-12:]

@@ -94,8 +94,14 @@ __all__ = ["run_loadgen", "run_hetero", "run_trace", "run_fleet",
 #             the worst realistic case for per-shard balance;
 #   churn     generational turnover (ids appear, age out, never
 #             return) — exercises store growth + eviction, and the ring
-#             mapping fresh ids across all arcs.
-FLEET_SCENARIOS = ("rotation", "zipf", "churn")
+#             mapping fresh ids across all arcs;
+#   flash     flash crowd — burst ARRIVAL, not key skew: a trickle of
+#             requests at low concurrency, then the whole remaining
+#             crowd at once at 4x the configured connection count.
+#             Exercises admission under a connection storm (accept
+#             queue, per-shard pipelining, microbatcher fill) where the
+#             other scenarios only vary WHICH keys arrive.
+FLEET_SCENARIOS = ("rotation", "zipf", "churn", "flash")
 
 
 def percentiles(latencies_ms):
@@ -438,6 +444,10 @@ def _scenario_bases(name, requests, population, rng):
         # generations never return (eviction-shaped traffic)
         return [f"ch{(k % population) + (k // (2 * population)) * population}"
                 for k in range(requests)]
+    if name == "flash":
+        # Deliberately uniform keys — the scenario's stress is in the
+        # ARRIVAL pattern (`_drive_flash`), not the key distribution
+        return [f"fl{k % population}" for k in range(requests)]
     raise ValueError(f"unknown fleet scenario {name!r} "
                      f"(have {FLEET_SCENARIOS})")
 
@@ -491,6 +501,45 @@ def _drive_router(host, port, payloads, connections=8):
     for thread in threads:
         thread.join()
     return time.perf_counter() - t0, latencies, errors[0]
+
+
+def _drive_flash(host, port, payloads, connections=8):
+    """The flash-crowd arrival shape: ~25% of the payloads trickle in at
+    a quarter of the configured concurrency (the calm before), then the
+    whole remaining crowd arrives at once at 4x concurrency — every
+    burst connection dials in the same instant, so the router's accept
+    path, per-shard pipelining and the shards' microbatchers absorb a
+    connection storm rather than a steady pool. Returns
+    (wall_s, latencies_ms, errors, burst_block); latencies/errors merge
+    both phases so the row keeps the standard scenario shape, and the
+    burst phase is broken out separately in `burst_block`."""
+    split = max(1, len(payloads) // 4)
+    trickle, crowd = payloads[:split], payloads[split:]
+    wall_t, lat_t, err_t = _drive_router(
+        host, port, trickle, connections=max(1, connections // 4))
+    burst_connections = connections * 4
+    wall_b, lat_b, err_b = _drive_router(
+        host, port, crowd, connections=burst_connections)
+    burst = {
+        "requests": len(crowd),
+        "connections": burst_connections,
+        "agg_per_sec": round(len(lat_b) / max(wall_b, 1e-9), 2),
+        "errors": err_b,
+        **({k: v for k, v in percentiles(lat_b).items()} if lat_b
+           else {}),
+    }
+    return wall_t + wall_b, lat_t + lat_b, err_t + err_b, burst
+
+
+def _drive_scenario(name, host, port, payloads, connections):
+    """Dispatch one named scenario through its arrival shape. Returns
+    (wall_s, latencies_ms, errors, extra_row_fields)."""
+    if name == "flash":
+        wall, lat, errors, burst = _drive_flash(host, port, payloads,
+                                                connections)
+        return wall, lat, errors, {"burst": burst}
+    wall, lat, errors = _drive_router(host, port, payloads, connections)
+    return wall, lat, errors, {}
 
 
 def _fleet_payloads(bases, n, d, f, gar, rng):
@@ -610,11 +659,11 @@ def run_fleet(*, shard_counts=(1, 2, 4), scenarios=FLEET_SCENARIOS,
             for name in scenarios:
                 bases = _scenario_bases(name, requests, population, rng)
                 payloads = _fleet_payloads(bases, n, d, f, gar, rng)
-                wall, lat, errors = _drive_router(host, int(port),
-                                                  payloads, connections)
+                wall, lat, errors, extra = _drive_scenario(
+                    name, host, int(port), payloads, connections)
                 scenario_rows[name]["external"] = {
                     "agg_per_sec": round(len(lat) / max(wall, 1e-9), 2),
-                    "errors": errors, **percentiles(lat)}
+                    "errors": errors, **percentiles(lat), **extra}
             shard_counts = ()
         for shards in shard_counts:
             with LocalFleet(shards, vnodes=vnodes, router_server=True,
@@ -627,12 +676,13 @@ def run_fleet(*, shard_counts=(1, 2, 4), scenarios=FLEET_SCENARIOS,
                     bases = _scenario_bases(name, requests, population,
                                             rng)
                     payloads = _fleet_payloads(bases, n, d, f, gar, rng)
-                    wall, lat, errors = _drive_router(
-                        "127.0.0.1", fleet.port, payloads, connections)
+                    wall, lat, errors, extra = _drive_scenario(
+                        name, "127.0.0.1", fleet.port, payloads,
+                        connections)
                     scenario_rows[name][str(shards)] = {
                         "agg_per_sec": round(len(lat) / max(wall, 1e-9),
                                              2),
-                        "errors": errors, **percentiles(lat)}
+                        "errors": errors, **percentiles(lat), **extra}
                 if shards == max(shard_counts):
                     ring = fleet.membership.ring()
                     spread = ring.spread(

@@ -42,7 +42,8 @@ sys.path.insert(0, str(ROOT))
 
 from byzantinemomentum_tpu.obs.recorder import load_records  # noqa: E402
 
-__all__ = ["stale_episodes", "summarize", "recommend_window", "main"]
+__all__ = ["stale_episodes", "summarize", "recommend_window",
+           "recommendation", "main"]
 
 # Safety margin over the observed recovery tail: clocks jitter, polls
 # quantize, and the recorded runs undersample the tail
@@ -124,6 +125,29 @@ def recommend_window(episodes):
     return None
 
 
+def recommendation(episodes):
+    """The machine-readable recommendation block the straggler policy
+    consumes directly (`cluster/straggler.py::resolve_wait_bound`):
+    the window, WHAT it was derived from, and the evidence counts —
+    censored episodes reported next to the p95 they were excluded from,
+    so a consumer can see how much of the record the number ignores."""
+    recovered = episodes["recovered"]
+    died = episodes["died"]
+    if recovered:
+        basis = "p95_recoveries"
+    elif died:
+        basis = "half_fastest_death"
+    else:
+        basis = None
+    block = {"wait_s": recommend_window(episodes), "basis": basis,
+             "recoveries": len(recovered), "deaths": len(died),
+             "censored": int(episodes.get("censored") or 0)}
+    if basis == "p95_recoveries":
+        block["margin"] = MARGIN
+        block["p95_recovery_s"] = round(_percentile(recovered, 0.95), 3)
+    return block
+
+
 def summarize(run_dirs):
     """The aggregate summary over one or more run directories (or direct
     telemetry file paths)."""
@@ -151,6 +175,10 @@ def summarize(run_dirs):
         # The explicit trade: a dead host costs the whole window before
         # recovery starts; a recovery inside the window costs nothing
         "wait_cost_per_dead_host_s": window,
+        # Structured form of the same recommendation, for machine
+        # consumers (`--straggler-edges` hands this file straight to the
+        # cluster launcher)
+        "recommendation": recommendation(merged),
     }
 
 

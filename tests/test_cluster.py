@@ -20,10 +20,15 @@ from byzantinemomentum_tpu import checkpoint
 from byzantinemomentum_tpu.cluster import (
     HostSpec, SystemFaultDriver, agree_restart_step, liveness_view,
     read_cluster_manifest, update_cluster_manifest, write_cluster_manifest)
-from byzantinemomentum_tpu.cluster.runtime import UNAVAILABLE_RC, free_port
+from byzantinemomentum_tpu.cluster import elastic
+from byzantinemomentum_tpu.cluster.chaos import StraggleResumer
+from byzantinemomentum_tpu.cluster.runtime import (
+    ClusterUnavailable, UNAVAILABLE_RC, cluster_mesh, free_port)
+from byzantinemomentum_tpu.cluster.straggler import (
+    DEFAULT_WAIT_S, StragglerPolicy, resolve_wait_bound)
 from byzantinemomentum_tpu.faults import FaultPlan
 from byzantinemomentum_tpu.faults.plan import (
-    corrupt_gradient, device_loss, drop_worker)
+    corrupt_gradient, device_loss, drop_worker, straggle)
 from byzantinemomentum_tpu.obs.heartbeat import (
     host_heartbeat_path, read_host_heartbeats, write_host_heartbeat)
 
@@ -163,6 +168,42 @@ def test_liveness_view_carries_health_block(tmp_path):
     assert "health" not in view["hosts"][1]
 
 
+def test_liveness_dead_heartbeat_never_resurrects(tmp_path):
+    """The process table outranks every heartbeat, in BOTH consumers:
+    once `running[host]` is False the view says dead no matter how
+    fresh (or future-stepped) the beat on disk looks, and the straggler
+    policy DROPS its suspicion of a dead host instead of killing a
+    corpse — death is the launcher's jurisdiction, not the policy's."""
+    now = time.time()
+    write_host_heartbeat(tmp_path, 0, {"step": 99})
+    view = liveness_view(tmp_path, 1, stale_after=30.0,
+                         running={0: False}, now=now)
+    assert view["hosts"][0]["status"] == "dead"
+    assert view["alive"] == []
+    policy = _armed_policy([0], wait_s=1.0)
+    policy.observe({"hosts": {0: _lv_row("stale", 2, 3.0)}}, 10.0)
+    # Way past the bound — but the host died first: no kill, ever
+    assert policy.observe({"hosts": {0: _lv_row("dead", 2, 99.0)}},
+                          50.0) == []
+    assert policy.observe({"hosts": {0: _lv_row("dead", 2, 99.0)}},
+                          60.0) == []
+    assert policy.kills == []
+
+
+def test_cluster_mesh_refuses_a_width_mismatch():
+    """`expected_workers` pins the mesh's workers axis to the fleet
+    width the launcher derived (elastic shrink re-derives it): a host
+    whose runtime sees a DIFFERENT device count than the membership
+    says must fail as `ClusterUnavailable` (-> `UNAVAILABLE_RC`), never
+    train on a silently mis-shaped mesh."""
+    width = len(__import__("jax").devices())
+    with cluster_mesh(expected_workers=width) as mesh:
+        assert mesh.shape["workers"] == width
+    with pytest.raises(ClusterUnavailable, match="expects"):
+        with cluster_mesh(expected_workers=width + 1):
+            pass
+
+
 # --------------------------------------------------------------------------- #
 # System-scope fault plans
 
@@ -201,6 +242,279 @@ def test_system_fault_driver_fires_once():
 def test_system_fault_driver_rejects_bad_plans():
     with pytest.raises(ValueError, match="system scope"):
         SystemFaultDriver(FaultPlan(events=(drop_worker(1, 1),)), 2)
+
+
+def test_straggle_events_are_system_scope_only():
+    """`straggle` (SIGSTOP window) exists at SYSTEM scope: legal in a
+    system plan (window preserved through JSON), refused by the in-step
+    validator, and refused without a positive window."""
+    plan = FaultPlan(events=(straggle(1, 3, 2.5),))
+    assert plan.validate_system(2) is None
+    assert "coordinator" in FaultPlan(
+        events=(straggle(0, 3, 2.5),)).validate_system(2)
+    assert "SYSTEM scope" in plan.validate(nb_workers=4, nb_honests=3)
+    with pytest.raises(ValueError, match="window"):
+        straggle(1, 3, 0.0)
+    raw = json.loads(plan.to_json())
+    assert raw["events"][0]["window_s"] == 2.5
+    loaded = FaultPlan.from_json(plan.to_json())
+    assert loaded.events[0].kind == "straggle"
+    assert loaded.events[0].window_s == 2.5
+
+
+# --------------------------------------------------------------------------- #
+# Elastic shrink arithmetic (cluster/elastic.py)
+
+def test_static_f_ceiling_matches_traced_quorum():
+    """The launcher-side static table and the in-jit traced clamp
+    (`faults/quorum.py::effective_f`) must never drift apart — a shrink
+    that re-declares f above what the per-step quorum would grant (or
+    below) would silently change the aggregation contract."""
+    from byzantinemomentum_tpu.faults import quorum
+
+    names = ("krum", "native-krum", "bulyan", "brute", "trmean",
+             "phocas", "meamed", "median", "average")
+    for name in names:
+        for n in range(1, 13):
+            for f_decl in range(0, 6):
+                assert elastic.static_effective_f(name, n, f_decl) == int(
+                    quorum.effective_f(name, n, f_decl)), (name, n, f_decl)
+
+
+def test_shrunk_spec_holds_shares_and_reclamps_quorum():
+    base = {"hosts": 4, "nb_workers": 8, "nb_decl_byz": 3,
+            "nb_real_byz": 2, "nb_for_study": 8, "gar": "krum"}
+    # Full width is the identity on totals (f already at krum's ceiling
+    # for n=8: (8-3)//2 = 2 < declared 3, so even THIS re-clamps)
+    full = elastic.shrunk_spec(base, 4)
+    assert full == {"hosts": 4, "nb_workers": 8, "nb_decl_byz": 2,
+                    "nb_real_byz": 2, "nb_for_study": 8}
+    spec = elastic.shrunk_spec(base, 3)
+    # Per-host shares constant: 2 workers + 2 study slots per host
+    assert spec == {"hosts": 3, "nb_workers": 6, "nb_decl_byz": 1,
+                    "nb_real_byz": 2, "nb_for_study": 6}
+    with pytest.raises(ValueError, match="split evenly"):
+        elastic.shrunk_spec(dict(base, nb_workers=7), 3)
+    with pytest.raises(ValueError, match="outside"):
+        elastic.shrunk_spec(base, 5)
+    # Ragged sampled split: honests no longer divisible by the mesh axis
+    ragged = {"hosts": 3, "nb_workers": 6, "nb_decl_byz": 1,
+              "nb_real_byz": 1, "nb_for_study": 3, "gar": "median"}
+    with pytest.raises(ValueError, match="workers mesh axis"):
+        elastic.shrunk_spec(ragged, 2)
+
+
+def test_elastic_precheck_proves_every_survivor_width():
+    base = {"hosts": 4, "nb_workers": 8, "nb_decl_byz": 2,
+            "nb_real_byz": 2, "nb_for_study": 8, "gar": "median"}
+    assert elastic.precheck(base, 1) is None
+    # Legal at launch, dead-ends at 3 survivors (honests=5 not divisible
+    # by the 3-wide mesh) — refused AT LAUNCH, not mid-incident …
+    bad = {"hosts": 4, "nb_workers": 12, "nb_decl_byz": 1,
+           "nb_real_byz": 4, "nb_for_study": 4, "gar": "median"}
+    assert "3 hosts" in elastic.precheck(bad, 1)
+    # … unless the floor keeps the shrink path above the bad width
+    assert elastic.precheck(bad, 4) is None
+    assert "exceeds" in elastic.precheck(base, 9)
+
+
+# --------------------------------------------------------------------------- #
+# Straggler policy (cluster/straggler.py)
+
+def _lv_row(status, step=None, age=0.0, health=None):
+    row = {"status": status, "step": step, "age": age}
+    if health is not None:
+        row["health"] = health
+    return row
+
+
+def test_straggler_policy_arms_only_past_warm_step():
+    policy = StragglerPolicy(5.0)
+    # Cold start: first observed step, then a stall — compile-shaped.
+    # The Jobs watchdog's jurisdiction, NEVER the policy's.
+    assert policy.observe({"hosts": {0: _lv_row("alive", 1)}}, 0.0) == []
+    assert policy.observe({"hosts": {0: _lv_row("stale", 1, 90.0)}},
+                          100.0) == []
+    assert policy.observe({"hosts": {0: _lv_row("stale", 1, 990.0)}},
+                          1000.0) == []  # however long it stalls
+    # A step PAST the first proves the loop is warm: arm, then suspect
+    assert policy.observe({"hosts": {0: _lv_row("alive", 2)}},
+                          1001.0) == []
+    events = policy.observe({"hosts": {0: _lv_row("stale", 2, 3.0)}},
+                            1004.0)
+    assert [e["event"] for e in events] == ["suspect"]
+    assert events[0]["host"] == 0 and events[0]["reason"] == "stale"
+
+
+def _armed_policy(hosts, wait_s=5.0, t0=0.0, **kwargs):
+    policy = StragglerPolicy(wait_s, **kwargs)
+    policy.observe({"hosts": {h: _lv_row("alive", 1) for h in hosts}}, t0)
+    policy.observe({"hosts": {h: _lv_row("alive", 2) for h in hosts}},
+                   t0 + 1.0)
+    return policy
+
+
+def test_straggler_policy_recovers_on_fresh_heartbeat():
+    policy = _armed_policy([0])
+    policy.observe({"hosts": {0: _lv_row("stale", 2, 3.0)}}, 10.0)
+    events = policy.observe({"hosts": {0: _lv_row("alive", 3)}}, 12.0)
+    assert [e["event"] for e in events] == ["recovered"]
+    assert events[0]["suspect_s"] == 2.0
+    assert policy.kills == []
+    assert policy.recoveries[0]["host"] == 0
+    assert policy.summary()["suspects_entered"] == 1
+
+
+def test_straggler_policy_kills_the_not_scheduling_host_once():
+    """At the bound every wedged host looks suspect; the one observed
+    NOT SCHEDULING (SIGSTOP'd) is blamed regardless of suspicion order,
+    exactly once per attempt — the hostages come back on relaunch."""
+    policy = _armed_policy([0, 1, 2], wait_s=5.0)
+    # Host 0 goes suspect FIRST (would win the duration tie-break) …
+    policy.observe({"hosts": {0: _lv_row("stale", 2, 3.0),
+                              1: _lv_row("alive", 3),
+                              2: _lv_row("alive", 3)}}, 10.0)
+    stale_all = {0: _lv_row("stale", 2, 5.0), 1: _lv_row("stale", 3, 4.0),
+                 2: _lv_row("stale", 3, 4.5)}
+    policy.observe({"hosts": stale_all}, 12.0)
+    # … but host 2 is the one the process table says is stopped
+    events = policy.observe({"hosts": stale_all}, 20.0,
+                            stopped=frozenset({2}))
+    kills = [e for e in events if e["event"] == "kill"]
+    assert len(kills) == 1
+    assert kills[0]["host"] == 2
+    assert kills[0]["not_scheduling"] is True
+    assert kills[0]["wait_s"] == 5.0
+    # One kill per attempt: the still-expired hostages survive the next
+    # polls (the teardown takes a poll or two to surface)
+    assert policy.observe({"hosts": stale_all}, 21.0,
+                          stopped=frozenset()) == []
+    assert len(policy.kills) == 1
+
+
+def test_straggler_policy_blames_longest_suspect_without_proc_evidence():
+    policy = _armed_policy([0, 1], wait_s=5.0)
+    policy.observe({"hosts": {0: _lv_row("alive", 3),
+                              1: _lv_row("stale", 2, 3.0)}}, 10.0)
+    policy.observe({"hosts": {0: _lv_row("stale", 3, 2.0),
+                              1: _lv_row("stale", 2, 5.0)}}, 12.0)
+    events = policy.observe({"hosts": {0: _lv_row("stale", 3, 10.0),
+                                       1: _lv_row("stale", 2, 13.0)}},
+                            20.0)
+    kills = [e for e in events if e["event"] == "kill"]
+    assert [k["host"] for k in kills] == [1]  # suspect longest
+    assert kills[0]["not_scheduling"] is False
+
+
+def test_straggler_policy_health_quarantine_hysteresis():
+    """The arena's quarantine hysteresis at host scope: `anomaly_enter`
+    consecutive anomalous polls to enter SUSPECT, `anomaly_clear` clean
+    polls to leave — one bad window is not a verdict, one good window is
+    not absolution."""
+    bad = {"anomaly": True}
+    policy = _armed_policy([0], wait_s=50.0, quarantine=True,
+                           anomaly_enter=3, anomaly_clear=2)
+    t = 10.0
+    for _ in range(2):
+        assert policy.observe(
+            {"hosts": {0: _lv_row("alive", 3, health=bad)}}, t) == []
+        t += 1.0
+    events = policy.observe(
+        {"hosts": {0: _lv_row("alive", 3, health=bad)}}, t)
+    assert [e["event"] for e in events] == ["suspect"]
+    assert events[0]["reason"] == "health"
+    # A single clean poll does not clear it …
+    assert policy.observe(
+        {"hosts": {0: _lv_row("alive", 4, health={"anomaly": False})}},
+        t + 1.0) == []
+    # … the second does
+    events = policy.observe(
+        {"hosts": {0: _lv_row("alive", 5, health={"anomaly": False})}},
+        t + 2.0)
+    assert [e["event"] for e in events] == ["recovered"]
+    # Without --quarantine the same stream is invisible to the policy
+    blind = _armed_policy([0], wait_s=50.0)
+    t = 10.0
+    for _ in range(5):
+        assert blind.observe(
+            {"hosts": {0: _lv_row("alive", 3, health=bad)}}, t) == []
+        t += 1.0
+
+
+def test_straggler_policy_reset_keeps_lifetime_counters():
+    policy = _armed_policy([0], wait_s=2.0)
+    policy.observe({"hosts": {0: _lv_row("stale", 2, 3.0)}}, 10.0)
+    events = policy.observe({"hosts": {0: _lv_row("stale", 2, 9.0)}},
+                            16.0)
+    assert [e["event"] for e in events] == ["kill"]
+    assert len(policy.kills) == 1
+    policy.reset()
+    # Per-attempt state gone: the relaunched host starts cold (unarmed),
+    # so an immediate stall is compile-shaped again, not suspect
+    assert policy.observe({"hosts": {0: _lv_row("stale", 4, 9.0)}},
+                          30.0) == []
+    # Lifetime counters survive for the artifact
+    summary = policy.summary()
+    assert len(summary["kills"]) == 1
+    assert summary["suspects_entered"] == 1
+
+
+def test_resolve_wait_bound_precedence(tmp_path):
+    assert resolve_wait_bound(7.5, None) == (7.5, "flag")
+    edges = tmp_path / "edges.json"
+    edges.write_text(json.dumps({
+        "recommended_wait_s": 3.0,
+        "recommendation": {"wait_s": 2.5, "basis": "p95_recoveries"}}))
+    assert resolve_wait_bound(None, edges) == (2.5,
+                                               "stale-edges:p95_recoveries")
+    # The flag still wins over the file
+    assert resolve_wait_bound(9.0, edges) == (9.0, "flag")
+    # Legacy summaries without the block fall back to the flat key
+    edges.write_text(json.dumps({"recommended_wait_s": 4.0}))
+    assert resolve_wait_bound(None, edges) == (
+        4.0, "stale-edges:recommended_wait_s")
+    # A summary with NO recommendation is an error, not a silent default
+    edges.write_text(json.dumps({
+        "recommendation": {"wait_s": None, "basis": None}}))
+    with pytest.raises(ValueError, match="no recommendation"):
+        resolve_wait_bound(None, edges)
+    assert resolve_wait_bound(None, None) == (DEFAULT_WAIT_S, "default")
+
+
+class _FakeProc:
+    def __init__(self):
+        self.signals = []
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def poll(self):
+        return None
+
+
+def test_straggle_resumer_disposes_each_window_exactly_once():
+    import signal as signal_mod
+
+    resumer = StraggleResumer()
+    try:
+        quick, parked = _FakeProc(), _FakeProc()
+        resumer.schedule(1, quick, 0.05)
+        deadline = time.time() + 5.0
+        while not resumer.resumed() and time.time() < deadline:
+            time.sleep(0.01)
+        assert [h for h, _ in resumer.resumed()] == [1]
+        assert quick.signals == [signal_mod.SIGCONT]
+        # A pending window cancelled (straggler kill) NEVER gets its
+        # SIGCONT; cancel reports it claimed the disposition
+        resumer.schedule(2, parked, 60.0)
+        assert resumer.cancel(2) == 1
+        assert resumer.cancel(2) == 0  # already disposed
+        stats = resumer.stats()
+        assert stats == {"pending": 0, "resumed": 1, "cancelled": 1}
+        assert parked.signals == []
+    finally:
+        resumer.stop()
+    assert parked.signals == []  # stop() resumes nothing cancelled
 
 
 # --------------------------------------------------------------------------- #

@@ -533,10 +533,37 @@ def test_random_walks_are_seeded():
     assert a.failures, "50 seeded walks find the 1-preemption race"
 
 
+def test_straggle_window_claim_race_found_and_shipped_fix_clean():
+    """The `StraggleResumer` disposition contract under the harness:
+    the unguarded check-then-act shape lets a cancelled window still
+    SIGCONT (double disposition) within ONE preemption, the witness
+    replays, serial orders pass (the bug IS the interleaving), and the
+    shipped claim-under-lock pattern is exhaustively clean at a deeper
+    bound."""
+    r = schedule.explore(schedule.straggle_claim_unguarded_model,
+                         max_preemptions=1)
+    assert r.failures and r.exhausted
+    assert min(f.preemptions for f in r.failures) == 1
+    witness = r.failures[0]
+    again = schedule.run_schedule(
+        schedule.straggle_claim_unguarded_model, witness.schedule)
+    assert again.schedule == witness.schedule
+    assert again.error == witness.error
+    assert "disposed 2 times" in again.error
+    serial = schedule.run_schedule(
+        schedule.straggle_claim_unguarded_model, "")
+    assert serial.ok and serial.preemptions == 0
+    clean = schedule.explore(schedule.straggle_claim_model,
+                             max_preemptions=3)
+    assert clean.exhausted and not clean.failures
+    assert clean.runs > 1  # the lock still leaves schedule choices
+
+
 def test_selfcheck_proves_the_pair_quickly():
     report = schedule.selfcheck()
     assert report["ok"]
     assert report["lost_update_found"] and report["fixed_clean"]
+    assert report["straggle_fixed_clean"]
     assert report["exhausted"]
     assert report["seconds"] < 10.0, "the tier smoke must stay cheap"
     # The witness is a replayable schedule string

@@ -20,6 +20,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -342,6 +343,99 @@ def test_router_stats_and_round_robin_anonymous():
         assert all(row["alive"] for row in per_shard.values())
         ping = fleet.ask({"op": "ping"})
         assert ping["ok"] and ping["router"] and ping["alive"] == 2
+
+
+def test_membership_replay_shrink_then_regrow_history():
+    """An elastic incident's full life in the change log: grow to 4,
+    shard dies, shrink past it (remove), later regrow under the same
+    name — `Membership.replay` folds the HISTORY alone back into the
+    identical ring, and a tampered (non-monotonic) log is refused."""
+    m = Membership()
+    for i in range(4):
+        m.bump("add", f"shard-{i}", host="127.0.0.1", port=7000 + i)
+    m.bump("dead", "shard-3")
+    m.bump("remove", "shard-3")
+    m.bump("add", "shard-3", host="127.0.0.1", port=7103)
+    assert m.version == 7
+    assert [h["change"] for h in m.history] == \
+        ["add"] * 4 + ["dead", "remove", "add"]
+    replayed = Membership.replay(m.as_dict())
+    assert replayed.version == m.version
+    assert sorted(replayed.shards) == sorted(m.shards)
+    for key in KEYS[:64]:
+        assert replayed.ring().owner(key) == m.ring().owner(key)
+    # the regrown shard is ALIVE (the old dead mark died with the
+    # remove), and the snapshot's fields survived the fold
+    assert replayed.shards["shard-3"]["alive"] is True
+    assert replayed.shards["shard-3"]["port"] == 7103
+    tampered = m.as_dict()
+    tampered["history"][5]["version"] = 99
+    with pytest.raises(ValueError, match="non-monotonic"):
+        Membership.replay(tampered)
+
+
+def test_fleet_parked_line_is_bounded():
+    """`on_dead="queue"` with `max_parked=1`: the forwarder holds one
+    parked batch while it retries the dead arc, ONE more line may wait
+    in the queue behind it, and the next fails FAST naming the full
+    parked line (counted in the router's stats). Both parked lines are
+    served after the restart — at-most-once, never re-sent."""
+    rng = np.random.default_rng(11)
+    with _fleet(2, on_dead="queue", max_parked=1) as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([("median", 5, 1, 32, True)])
+        base = "park-client"
+        victim = fleet.owner(base)
+        assert fleet.ask(_payload(base, rng))["ok"]
+        fleet.kill(victim)
+        parked = []
+        lines = []
+
+        def _park():
+            parked.append(fleet.ask(_payload(base, rng)))
+
+        # Line A: dequeued and HELD by the forwarder while it retries
+        # the dead arc. Wait until A has demonstrably ROUTED and left
+        # the queue, stable across two polls — merely seeing an empty
+        # queue is not enough (that is also what "A not asked yet"
+        # looks like, and proceeding early inverts the line order).
+        routed0 = fleet.router.stats()["shards"][victim]["routed"]
+        lines.append(threading.Thread(target=_park))
+        lines[-1].start()
+        deadline = time.monotonic() + 30.0
+        stable = 0
+        while stable < 2:
+            assert time.monotonic() < deadline, \
+                f"forwarder never parked line A: {fleet.router.stats()}"
+            stats = fleet.router.stats()
+            if (stats["shards"][victim]["routed"] > routed0
+                    and not stats["shards"][victim]["alive"]
+                    and stats["queued"][victim] == 0):
+                stable += 1
+            else:
+                stable = 0
+            time.sleep(0.02)
+        # Line B: fills the single parked slot in the queue itself (the
+        # forwarder never drains the queue while its held batch retries)
+        lines.append(threading.Thread(target=_park))
+        lines[-1].start()
+        deadline = time.monotonic() + 30.0
+        while (fleet.router.stats()["queued"][victim] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert fleet.router.stats()["queued"][victim] >= 1
+        # Line C: past the cap — fail fast, no unbounded amplification
+        overflow = fleet.ask(_payload(base, rng))
+        assert not overflow["ok"]
+        assert "parked line is full" in overflow["error"]
+        stats = fleet.router.stats()
+        assert stats["max_parked"] == 1
+        assert stats["parked_rejected"] == 1
+        fleet.restart(victim)
+        for line in lines:
+            line.join(timeout=60)
+            assert not line.is_alive()
+        assert len(parked) == 2 and all(r["ok"] for r in parked), parked
 
 
 # --------------------------------------------------------------------------- #

@@ -418,6 +418,34 @@ def test_loadgen_trace_mode_payload():
     assert "frac" in payload["overhead"]
 
 
+def _serve_loadgen():
+    import importlib.util
+    import pathlib
+    import sys
+    script = (pathlib.Path(__file__).resolve().parent.parent
+              / "scripts" / "serve_loadgen.py")
+    spec = importlib.util.spec_from_file_location("serve_loadgen", script)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("serve_loadgen", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_flash_scenario_keys_are_uniform():
+    """The flash-crowd scenario stresses the ARRIVAL pattern (trickle
+    then a connection burst in `_drive_flash`), deliberately NOT the key
+    distribution — its routing keys stay uniform so a latency cliff in
+    the burst phase can only come from arrival concentration."""
+    loadgen = _serve_loadgen()
+    assert loadgen.FLEET_SCENARIOS == ("rotation", "zipf", "churn",
+                                       "flash")
+    rng = np.random.default_rng(0)
+    bases = loadgen._scenario_bases("flash", 16, 4, rng)
+    assert bases == [f"fl{k % 4}" for k in range(16)]
+    with pytest.raises(ValueError, match="unknown fleet scenario"):
+        loadgen._scenario_bases("stampede", 16, 4, rng)
+
+
 # --------------------------------------------------------------------------- #
 # stale_edges (scripts/stale_edges.py, PR 15): the data-driven input the
 # straggler-host bounded-wait policy needs
@@ -497,6 +525,45 @@ def test_stale_edges_death_only_and_empty(tmp_path, capsys):
     empty.mkdir()
     assert stale_edges.main([str(empty)]) == 1
     assert "no telemetry records" in capsys.readouterr().out
+
+
+def test_stale_edges_machine_recommendation_block(tmp_path, capsys):
+    """The `recommendation` block is what the straggler policy's
+    `resolve_wait_bound` consumes: the window, its BASIS, and the
+    evidence counts — censored episodes reported next to the p95 they
+    were excluded from. `--json` prints exactly the machine line."""
+    stale_edges = _stale_edges()
+    t = 100.0
+    edges = [(t, h, None, "alive") for h in range(3)]
+    for dt in (0.5, 1.0, 2.0):
+        edges += [(t, 0, "alive", "stale"), (t + dt, 0, "stale", "alive")]
+        t += 5.0
+    edges += [(t, 1, "alive", "stale"), (t + 12.0, 1, "stale", "dead")]
+    t += 20.0
+    edges += [(t, 2, "alive", "stale")]  # censored
+    run = _liveness_stream(tmp_path, edges)
+    assert stale_edges.summarize([run])["recommendation"] == {
+        "wait_s": 2.5, "basis": "p95_recoveries", "recoveries": 3,
+        "deaths": 1, "censored": 1, "margin": 1.25, "p95_recovery_s": 2.0}
+    assert stale_edges.main(["--json", str(run)]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("stale-edges: ") and out.count("\n") == 1
+    payload = json.loads(out[len("stale-edges: "):])
+    assert payload["recommendation"]["wait_s"] == 2.5
+    # Death-only record: half the fastest death, no margin fields
+    death = tmp_path / "death"
+    death.mkdir()
+    _liveness_stream(death, [(10.0, 1, "alive", "stale"),
+                             (18.0, 1, "stale", "dead")])
+    assert stale_edges.summarize([death])["recommendation"] == {
+        "wait_s": 4.0, "basis": "half_fastest_death", "recoveries": 0,
+        "deaths": 1, "censored": 0}
+    # No resolved episodes at all: explicit Nones, --json exits non-zero
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rec = stale_edges.summarize([empty])["recommendation"]
+    assert rec["wait_s"] is None and rec["basis"] is None
+    assert stale_edges.main(["--json", str(empty)]) == 1
 
 
 def test_stale_edges_unknown_edge_censors(tmp_path):
