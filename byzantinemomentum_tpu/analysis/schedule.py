@@ -63,6 +63,8 @@ __all__ = ["Scheduler", "SchedLock", "SchedCondition", "DeadlockError",
            "router_forward_queue_model", "router_double_resolve_model",
            "router_single_disposition_model",
            "straggle_claim_unguarded_model", "straggle_claim_model",
+           "metrics_scrape_torn_model", "metrics_scrape_model",
+           "metrics_rotate_lost_model", "metrics_rotate_model",
            "selfcheck"]
 
 # A worker that fails to reach its next preemption point within this many
@@ -736,13 +738,137 @@ def straggle_claim_model(sched):
     return [resumer, canceller], check
 
 
+# --------------------------------------------------------------------------- #
+# The metrics-plane models (obs/metrics, r18): the two interleavings
+# that decide its design — a scrape reading a torn multi-field histogram
+# update, and a ring rotation overwriting a concurrent append — each as
+# the broken pattern the naive implementation would have, and the
+# shipped pattern, pinned schedule-clean.
+
+def metrics_scrape_torn_model(sched):
+    """The PRE-fix histogram update: `observe` bumps the bucket array
+    and the running count as two separate unlocked stores; a concurrent
+    scrape (`dump`) reading BETWEEN them exports a payload whose `count`
+    disagrees with its bucket counts — a torn snapshot the fleet merge
+    would then propagate into every downstream quantile. Serial orders
+    pass; one preemption finds it."""
+    hist = {"counts": [0], "count": 0}
+    seen = []
+
+    def observer():
+        hist["counts"][0] += 1        # the bucket-array store...
+        sched.point()                 # ... the scrape lands here ...
+        hist["count"] += 1            # ... before the count store
+
+    def scraper():
+        seen.append({"counts": list(hist["counts"]),
+                     "count": hist["count"]})
+
+    def check():
+        for snap in seen:
+            assert sum(snap["counts"]) == snap["count"], (
+                f"torn scrape: buckets {snap['counts']} vs count "
+                f"{snap['count']}")
+
+    return [observer, scraper], check
+
+
+def metrics_scrape_model(sched):
+    """The SHIPPED pattern (`Histogram.observe` / `.snapshot`): the
+    multi-field update and the snapshot copy each run under the metric's
+    lock, so every exported payload is internally coherent — and
+    repeated scrapes see a monotonic count — no matter how the scraper
+    interleaves with the bumper. Exhaustively clean at the bound that
+    breaks the unlocked version."""
+    lock = sched.lock()
+    hist = {"counts": [0], "count": 0}
+    seen = []
+
+    def observer():
+        with lock:
+            hist["counts"][0] += 1
+            sched.point()
+            hist["count"] += 1
+
+    def scraper():
+        for _ in range(2):
+            with lock:
+                seen.append({"counts": list(hist["counts"]),
+                             "count": hist["count"]})
+
+    def check():
+        for snap in seen:
+            assert sum(snap["counts"]) == snap["count"], (
+                f"torn scrape: buckets {snap['counts']} vs count "
+                f"{snap['count']}")
+        counts = [snap["count"] for snap in seen]
+        assert counts == sorted(counts), (
+            f"scraped counts regressed: {counts}")
+
+    return [observer, scraper], check
+
+
+def metrics_rotate_lost_model(sched):
+    """The PRE-fix ring rotation: a rotator thread reads the file, trims
+    to the newest lines, and writes the trimmed copy back while the
+    scraper appends concurrently. An append landing between the
+    rotator's read and its write-back is overwritten — the NEWEST
+    snapshot (the one an operator debugging a live incident needs most)
+    silently vanishes. Serial orders pass; one preemption finds it."""
+    file = {"lines": ["s0", "s1"]}
+
+    def appender():
+        file["lines"] = list(file["lines"]) + ["s2"]
+
+    def rotator():
+        kept = file["lines"][-1:]     # read + trim...
+        sched.point()                 # ... the append lands here ...
+        file["lines"] = kept          # ... and the write-back loses it
+
+    def check():
+        assert "s2" in file["lines"], (
+            f"rotation lost the newest snapshot: {file['lines']}")
+
+    return [appender, rotator], check
+
+
+def metrics_rotate_model(sched):
+    """The SHIPPED pattern (`MetricsScraper.scrape_once` +
+    `append_snapshot`): the ring has ONE writer — append and rotation
+    happen inside the same lock-held call — so no snapshot can land
+    between a rotation's read and its write-back; rotation only ever
+    drops lines OLDER than the newest append. Exhaustively clean at the
+    bound that breaks the unlocked version."""
+    lock = sched.lock()
+    file = {"lines": ["s0", "s1"]}
+
+    def appender():
+        with lock:
+            lines = list(file["lines"])
+            sched.point()
+            file["lines"] = lines + ["s2"]
+
+    def rotator():
+        with lock:
+            kept = file["lines"][-1:]
+            sched.point()
+            file["lines"] = kept
+
+    def check():
+        assert "s2" in file["lines"], (
+            f"rotation lost the newest snapshot: {file['lines']}")
+
+    return [appender, rotator], check
+
+
 def selfcheck(max_preemptions=3):
     """The lint-tier schedule smoke: every planted bug — the serve
     counter lost-update, the two router races (lost forward, double
-    disposition) and the straggle-window claim race — must be FOUND
-    within the preemption bound, and every fixed pattern must survive
-    the same exhaustive exploration clean. Returns a JSON-safe report
-    with `ok`."""
+    disposition), the straggle-window claim race and the two
+    metrics-plane races (torn scrape, rotation-lost append) — must be
+    FOUND within the preemption bound, and every fixed pattern must
+    survive the same exhaustive exploration clean. Returns a JSON-safe
+    report with `ok`."""
     t0 = time.monotonic()
     broken = explore(lost_update_model, max_preemptions=max_preemptions)
     fixed = explore(fixed_counter_model, max_preemptions=max_preemptions)
@@ -758,14 +884,26 @@ def selfcheck(max_preemptions=3):
                           max_preemptions=max_preemptions)
     s_claim = explore(straggle_claim_model,
                       max_preemptions=max_preemptions)
+    m_torn = explore(metrics_scrape_torn_model,
+                     max_preemptions=max_preemptions)
+    m_scrape = explore(metrics_scrape_model,
+                       max_preemptions=max_preemptions)
+    m_lost = explore(metrics_rotate_lost_model,
+                     max_preemptions=max_preemptions)
+    m_rotate = explore(metrics_rotate_model,
+                       max_preemptions=max_preemptions)
     router_fixed_clean = (r_queue.ok and r_queue.exhausted
                           and r_single.ok and r_single.exhausted)
     straggle_fixed_clean = s_claim.ok and s_claim.exhausted
+    metrics_fixed_clean = (m_scrape.ok and m_scrape.exhausted
+                           and m_rotate.ok and m_rotate.exhausted)
     return {
         "ok": (bool(broken.failures) and fixed.ok and fixed.exhausted
                and bool(r_lost.failures) and bool(r_double.failures)
                and router_fixed_clean
-               and bool(s_unguarded.failures) and straggle_fixed_clean),
+               and bool(s_unguarded.failures) and straggle_fixed_clean
+               and bool(m_torn.failures) and bool(m_lost.failures)
+               and metrics_fixed_clean),
         "lost_update_found": bool(broken.failures),
         "witness": broken.failures[0].schedule if broken.failures else None,
         "schedules_prefix": broken.runs,
@@ -785,10 +923,21 @@ def selfcheck(max_preemptions=3):
                                    if s_unguarded.failures else None),
         "straggle_fixed_clean": straggle_fixed_clean,
         "schedules_straggle": s_unguarded.runs + s_claim.runs,
+        "metrics_scrape_torn_found": bool(m_torn.failures),
+        "metrics_scrape_torn_witness": (m_torn.failures[0].schedule
+                                        if m_torn.failures else None),
+        "metrics_rotate_lost_found": bool(m_lost.failures),
+        "metrics_rotate_lost_witness": (m_lost.failures[0].schedule
+                                        if m_lost.failures else None),
+        "metrics_fixed_clean": metrics_fixed_clean,
+        "schedules_metrics": (m_torn.runs + m_scrape.runs + m_lost.runs
+                              + m_rotate.runs),
         "exhausted": (broken.exhausted and fixed.exhausted
                       and r_lost.exhausted and r_double.exhausted
                       and r_queue.exhausted and r_single.exhausted
-                      and s_unguarded.exhausted and s_claim.exhausted),
+                      and s_unguarded.exhausted and s_claim.exhausted
+                      and m_torn.exhausted and m_scrape.exhausted
+                      and m_lost.exhausted and m_rotate.exhausted),
         "max_preemptions": max_preemptions,
         "seconds": round(time.monotonic() - t0, 3),
     }

@@ -804,7 +804,12 @@ def main(argv=None):
         # Numerics flight recorder (--health): in-jit health vector out of
         # the step, host-side SPC monitor over it (obs/health)
         health_active = cfg.health and cfg.study
-        monitor = obs_mod.HealthMonitor() if health_active else None
+        # The monitor's anomaly/clear edges also bump metrics-plane
+        # counters (obs/metrics) so a scrape of the driver's registry
+        # carries the same signal the telemetry stream does
+        monitor = (obs_mod.HealthMonitor(
+            metrics=obs_mod.metrics.MetricsRegistry(source="driver"))
+            if health_active else None)
         if args.result_directory is not None:
             resdir = pathlib.Path(args.result_directory).resolve()
             try:

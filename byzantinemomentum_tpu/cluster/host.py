@@ -246,7 +246,8 @@ def main(argv=None):
     # vector this host reads off the replicated metrics; its summary
     # rides the host heartbeat's `health` block, which the liveness view
     # and the launcher's aggregated fleet heartbeat carry through
-    monitor = obs.HealthMonitor() if args.health else None
+    monitor = (obs.HealthMonitor(metrics=obs.metrics.MetricsRegistry(
+        source=f"host-{args.proc_id}")) if args.health else None)
     engine = build_engine(
         cfg=cfg, model_def=models_mod.build(args.model),
         loss=losses_mod.Loss("nll"), criterion=losses_mod.Criterion("top-k"),

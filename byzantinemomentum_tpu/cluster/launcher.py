@@ -13,6 +13,12 @@ local TCP coordinator on a probed free port) and supervises them:
   service-job form — SIGKILL of this launcher kills the fleet through the
   per-host stdin pipes, and the Jobs retry relaunches it with
   `--auto-resume`).
+* **metrics plane** (`--metrics-interval`, `obs/metrics`) — the
+  launcher folds the liveness view into its own registry (per-state
+  host gauges, a transition counter), a scraper thread rings windowed
+  snapshots into `metrics.jsonl` next to `heartbeat.json`, and a
+  loopback `MetricsEndpoint` answers the same `{"op": "metrics"}` pull
+  verb a serve shard speaks.
 * **chaos** — a system-scope `FaultPlan` (`--fault-plan`,
   `cluster/chaos.py`) SIGKILLs the planned host the first time the
   observed cluster step reaches the event's step; fired events persist in
@@ -126,10 +132,22 @@ def process_commandline(argv=None):
              "a host's heartbeat health block (--health) make it "
              "SUSPECT under the same bounded wait — drain-by-kill and "
              "shrink/relaunch past it before it poisons the run")
-    add("--quarantine-anomaly-polls", type=int, default=3,
+    add("--quarantine-anomaly-polls", type=int, default=None,
         help="Consecutive anomalous polls before the quarantine arm "
              "turns a host SUSPECT (the arena's hysteresis shape at "
-             "host scope: one bad window is not a verdict)")
+             "host scope: one bad window is not a verdict). Default: "
+             "the --quarantine-rates recommendation when given, else 3")
+    add("--quarantine-rates", type=str, default=None,
+        help="Path of a `scripts/quarantine_rates.py --json` summary; "
+             "its machine-readable recommendation block sets the "
+             "quarantine enter-threshold from observed anomaly-episode "
+             "lengths (an explicit --quarantine-anomaly-polls wins)")
+    add("--metrics-interval", type=float, default=2.0,
+        help="Metrics-plane snapshot cadence in seconds (obs/metrics): "
+             "the launcher folds liveness state into its registry, "
+             "appends merged snapshots to metrics.jsonl next to "
+             "heartbeat.json, and answers {'op': 'metrics'} on a "
+             "loopback exposition port; 0 disables the plane")
     add("--connect-timeout", type=float, default=60.0)
     add("--heartbeat-stale", type=float, default=60.0,
         help="Seconds without a host heartbeat update before the "
@@ -332,6 +350,9 @@ def main(argv=None):
     from byzantinemomentum_tpu.cluster import straggler as straggler_mod
     from byzantinemomentum_tpu.obs import Telemetry
     from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+    from byzantinemomentum_tpu.obs.metrics import (MetricsEndpoint,
+                                                   MetricsRegistry,
+                                                   MetricsScraper)
     from byzantinemomentum_tpu.obs.trace import ClockOffsetTracker
     from byzantinemomentum_tpu.serve.fleet import ring as ring_mod
 
@@ -365,12 +386,14 @@ def main(argv=None):
         try:
             wait_s, wait_source = straggler_mod.resolve_wait_bound(
                 args.straggler_wait, args.straggler_edges)
+            polls, polls_source = straggler_mod.resolve_anomaly_polls(
+                args.quarantine_anomaly_polls, args.quarantine_rates)
         except (OSError, ValueError) as err:
-            print(f"cluster: straggler wait bound unavailable: {err}")
+            print(f"cluster: straggler policy unavailable: {err}")
             return 2
         policy = straggler_mod.StragglerPolicy(
             wait_s, source=wait_source, quarantine=args.quarantine,
-            anomaly_enter=args.quarantine_anomaly_polls)
+            anomaly_enter=polls, anomaly_source=polls_source)
 
     manifest = manifest_mod.read_cluster_manifest(resdir)
     membership = None
@@ -414,6 +437,27 @@ def main(argv=None):
     telem.event("cluster_start", hosts=args.hosts, steps=args.nb_steps,
                 auto_resume=bool(args.auto_resume),
                 fault_events=(len(plan.events) if plan else 0))
+    # The launcher's metrics plane (obs/metrics): training hosts expose
+    # their numbers through heartbeats, the launcher folds the liveness
+    # view into ITS registry (state gauges + transition counter), the
+    # scraper rings the snapshots into metrics.jsonl next to
+    # heartbeat.json, and the loopback endpoint answers the same
+    # {"op": "metrics"} verb a serve shard does — one scrape protocol
+    metrics = MetricsRegistry(source="launcher")
+    m_polls = metrics.counter("cluster_liveness_polls")
+    m_transitions = metrics.counter("cluster_liveness_transitions")
+    m_hosts = {status: metrics.gauge(f"cluster_hosts_{status}")
+               for status in ("alive", "stale", "dead", "unknown")}
+    endpoint = scraper = None
+    if args.metrics_interval > 0:
+        endpoint = MetricsEndpoint(("127.0.0.1", 0), metrics.dump)
+        endpoint.serve_background()
+        scraper = MetricsScraper({}, resdir,
+                                 interval=args.metrics_interval,
+                                 local=metrics).start()
+        telem.event("metrics_endpoint", host="127.0.0.1",
+                    port=endpoint.port,
+                    interval_s=args.metrics_interval)
     # A live signal BEFORE the slow part (spawn + jax imports + compile),
     # so an outer Jobs watchdog never kills a fleet for starting up
     write_heartbeat(resdir, {"step": None, "status": "launching",
@@ -457,16 +501,22 @@ def main(argv=None):
     last_status = {}
 
     def observe_view(view, now):
+        counts = dict.fromkeys(m_hosts, 0)
         for host, row in view["hosts"].items():
             if row.get("updated") is not None:
                 clock.observe(host, row["updated"], now)
             status = row["status"]
+            counts[status] = counts.get(status, 0) + 1
             if last_status.get(host) != status:
                 if host in last_status or status != "unknown":
                     telem.event("liveness_transition", host=host,
                                 **{"from": last_status.get(host),
                                    "to": status, "step": row.get("step")})
+                    m_transitions.inc()
                 last_status[host] = status
+        m_polls.inc()
+        for status, gauge in m_hosts.items():
+            gauge.set(counts[status])
 
     while True:
         attempt += 1
@@ -721,6 +771,12 @@ def main(argv=None):
     telem.event("cluster_end", status=status,
                 steps_per_sec=steps_per_sec,
                 recovery_steps=recovery_steps, attempts=attempt)
+    if scraper is not None:
+        scraper.stop()
+        scraper.scrape_once()  # the run's end-state lands in the ring
+    if endpoint is not None:
+        endpoint.shutdown()
+        endpoint.server_close()
     telem.close()
     final_status = {"ok": "completed"}.get(status, status)
     final_beat = {

@@ -44,9 +44,11 @@ host scope: `anomaly_enter` consecutive anomalous polls to enter SUSPECT
 import json
 import pathlib
 
-__all__ = ["DEFAULT_WAIT_S", "StragglerPolicy", "resolve_wait_bound"]
+__all__ = ["DEFAULT_ANOMALY_POLLS", "DEFAULT_WAIT_S", "StragglerPolicy",
+           "resolve_anomaly_polls", "resolve_wait_bound"]
 
 DEFAULT_WAIT_S = 30.0
+DEFAULT_ANOMALY_POLLS = 3
 
 HEALTHY = "healthy"
 SUSPECT = "suspect"
@@ -62,12 +64,14 @@ class StragglerPolicy:
     """
 
     def __init__(self, wait_s, *, source="flag", quarantine=False,
-                 anomaly_enter=3, anomaly_clear=2):
+                 anomaly_enter=DEFAULT_ANOMALY_POLLS, anomaly_clear=2,
+                 anomaly_source="flag"):
         self.wait_s = float(wait_s)
         self.source = str(source)
         self.quarantine = bool(quarantine)
         self.anomaly_enter = max(int(anomaly_enter), 1)
         self.anomaly_clear = max(int(anomaly_clear), 1)
+        self.anomaly_source = str(anomaly_source)
         # Lifetime counters (survive reset(): the artifact reports them)
         self.kills = []
         self.recoveries = []
@@ -197,6 +201,8 @@ class StragglerPolicy:
         """The artifact's straggler block."""
         return {"wait_s": self.wait_s, "source": self.source,
                 "quarantine": self.quarantine,
+                "anomaly_enter": self.anomaly_enter,
+                "anomaly_source": self.anomaly_source,
                 "suspects_entered": self.suspects_entered,
                 "kills": list(self.kills),
                 "recoveries": list(self.recoveries)}
@@ -220,3 +226,26 @@ def resolve_wait_bound(explicit=None, edges_path=None):
         raise ValueError(f"{edges_path} carries no recommendation "
                          f"(no recoveries or deaths observed)")
     return DEFAULT_WAIT_S, "default"
+
+
+def resolve_anomaly_polls(explicit=None, rates_path=None):
+    """The quarantine enter-threshold and where it came from: an explicit
+    `--quarantine-anomaly-polls` wins; else the recommendation block of a
+    `scripts/quarantine_rates.py --json` summary (anomaly-episode-length
+    calibration over observed `health_anomaly`/`health_cleared` edge
+    streams); else the conservative default. Returns `(polls, source)` —
+    the same precedence ladder as `resolve_wait_bound`."""
+    if explicit is not None:
+        return int(explicit), "flag"
+    if rates_path:
+        payload = json.loads(
+            pathlib.Path(rates_path).read_text(encoding="utf-8"))
+        rec = payload.get("recommendation") or {}
+        polls = rec.get("anomaly_polls",
+                        payload.get("recommended_anomaly_polls"))
+        if polls is not None:
+            basis = rec.get("basis", "recommended_anomaly_polls")
+            return int(polls), f"quarantine-rates:{basis}"
+        raise ValueError(f"{rates_path} carries no recommendation "
+                         f"(no anomaly episodes observed)")
+    return DEFAULT_ANOMALY_POLLS, "default"

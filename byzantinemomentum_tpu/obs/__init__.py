@@ -41,6 +41,12 @@ used to guess liveness from study-CSV mtime). Three pieces:
   `--health`), `health_anomaly`/`health_cleared` events, the
   early-warning rollback trigger (`--rollback-on-anomaly`) and the
   bounded `health_blackbox.json` post-mortem ring.
+* **metrics** (`metrics/`) — the fleet metrics plane (r18): the
+  process-local registry (counters / gauges / mergeable fixed-bucket
+  histograms), the pull-based `{"op": "metrics"}` exposition verb on
+  every line-JSON port, the supervising scraper + `metrics.jsonl`
+  snapshot ring, and multi-window SLO burn-rate alerting
+  (`slo_burn`/`slo_ok` on the timeline).
 * **forensics** (`forensics.py`) — per-worker EWMA suspicion scores over
   the in-jit GAR diagnostics stream (`--gar-diagnostics`): selection-
   frequency deficit, distance z-score and NaN-quarantine history, with
@@ -96,6 +102,7 @@ from byzantinemomentum_tpu.obs.perf import (  # noqa: F401
 )
 from byzantinemomentum_tpu.obs import attrib  # noqa: F401
 from byzantinemomentum_tpu.obs import health  # noqa: F401
+from byzantinemomentum_tpu.obs import metrics  # noqa: F401
 from byzantinemomentum_tpu.obs import trace  # noqa: F401
 from byzantinemomentum_tpu.obs.health import (  # noqa: F401
     HealthMonitor,
@@ -109,7 +116,7 @@ __all__ = [
     "read_heartbeat", "read_host_heartbeats", "write_heartbeat",
     "write_host_heartbeat",
     "HealthMonitor", "SlidingRate", "StepTimer", "SuspicionTracker",
-    "attrib", "health", "load_blackbox", "trace",
+    "attrib", "health", "load_blackbox", "metrics", "trace",
     "flops_of_compiled", "host_rss_mb", "logical_flops", "mfu",
     "peak_flops",
 ]

@@ -9,7 +9,9 @@ Two modes:
   Includes the attribution smoke: a tiny named-scope program is traced on
   the CPU backend, its xplane parsed and attributed (`obs/attrib`), and
   the resulting artifact is printed as one `attribution: {...}` JSON line
-  for the tier harness to record.
+  for the tier harness to record. The metrics phase (r18) proves the
+  metrics plane the same way — scrape roundtrip, N-shard merge parity,
+  bump-cost sanity — printing one `metrics: {...}` line.
 * `<run_dir>`: render the one-page report (same as `scripts/obs_report.py`).
 """
 
@@ -85,9 +87,116 @@ def selfcheck():
     # into the timeline the assertions just pinned
     closed_loop_selfcheck()
     health_selfcheck()
+    metrics_selfcheck()
     attribution_selfcheck()
     print("obs selfcheck: OK")
     return 0
+
+
+def metrics_selfcheck():
+    """The metrics plane holds its three contracts: (a) scrape
+    ROUNDTRIP — a registry served through a `MetricsEndpoint` and
+    pulled with `scrape_target` comes back byte-identical to the local
+    `dump()`, and a `MetricsScraper` round lands it in the on-disk
+    ring; (b) merge PARITY — N shard registries observing disjoint
+    slices of one sample stream merge (bucket-wise) to bit-identical
+    quantiles with a single oracle registry that observed every sample;
+    (c) OVERHEAD — a counter bump plus a histogram observe stays
+    microseconds-scale (sanity ceiling only; the real 2% bound is the
+    paired loadgen run in `BENCH_metrics_r*.json`). Host-side stdlib
+    only — no engine, no jax. Prints one `metrics: {...}` JSON line the
+    tier harness records."""
+    import pathlib
+    import random
+    import time
+
+    from byzantinemomentum_tpu.obs.metrics import (LATENCY_MS_BOUNDS,
+                                                   MetricsEndpoint,
+                                                   MetricsRegistry,
+                                                   MetricsScraper,
+                                                   NullRegistry,
+                                                   load_snapshots,
+                                                   merge_payloads,
+                                                   quantile_from_buckets,
+                                                   scrape_target)
+
+    rng = random.Random(0x3E791C5)
+
+    # (b) merge parity first — the merged payload also feeds (a)'s ring
+    # assertion. 3 shards, disjoint slices, one oracle seeing it all.
+    samples = [rng.lognormvariate(1.5, 1.2) for _ in range(3000)]
+    oracle = MetricsRegistry(source="oracle")
+    shards = [MetricsRegistry(source=f"shard-{i}") for i in range(3)]
+    for index, value in enumerate(samples):
+        oracle.histogram("serve_request_ms").observe(value)
+        oracle.counter("serve_requests").inc()
+        shard = shards[index % len(shards)]
+        shard.histogram("serve_request_ms").observe(value)
+        shard.counter("serve_requests").inc()
+    merged = merge_payloads([shard.dump() for shard in shards])
+    oracle_dump = oracle.dump()
+    parity = []
+    for q in (0.5, 0.9, 0.99):
+        cells = [payload["metrics"]["serve_request_ms"]
+                 for payload in (merged, oracle_dump)]
+        got, want = (quantile_from_buckets(
+            tuple(cell["bounds"]), cell["counts"], q, cell["max"])
+            for cell in cells)
+        assert got == want, (q, got, want)  # bit-for-bit, never approx
+        parity.append((q, got))
+    assert merged["metrics"]["serve_requests"]["value"] == len(samples)
+    assert (merged["metrics"]["serve_request_ms"]["counts"]
+            == oracle_dump["metrics"]["serve_request_ms"]["counts"])
+
+    # (a) scrape roundtrip: endpoint -> pull verb -> exact payload, then
+    # a scraper round appends the merged view to the on-disk ring
+    endpoint = MetricsEndpoint(("127.0.0.1", 0), oracle.dump)
+    endpoint.serve_background()
+    try:
+        pulled = scrape_target("127.0.0.1", endpoint.port)
+        assert pulled == oracle.dump(), "scrape changed the payload"
+        with tempfile.TemporaryDirectory(
+                prefix="bmt-metrics-selfcheck-") as tmp:
+            scraper = MetricsScraper(
+                {"oracle": ("127.0.0.1", endpoint.port)}, pathlib.Path(tmp))
+            snapshot = scraper.scrape_once(now=1000.0)
+            assert snapshot["reached"] == ["oracle"], snapshot
+            ring = load_snapshots(pathlib.Path(tmp))
+            assert len(ring) == 1, ring
+            assert ring[0]["merged"]["metrics"]["serve_requests"]["value"] \
+                == len(samples)
+    finally:
+        endpoint.shutdown()
+        endpoint.server_close()
+
+    # (c) overhead sanity: one bump = counter inc + histogram observe;
+    # the ceiling is generous (mechanics proof — a pathological lock or
+    # ladder scan fails, scheduler noise does not)
+    live, null = MetricsRegistry(), NullRegistry()
+    bumps = 20000
+    costs = {}
+    for label, registry in (("live", live), ("null", null)):
+        counter = registry.counter("selfcheck_total")
+        hist = registry.histogram("selfcheck_ms",
+                                  bounds=LATENCY_MS_BOUNDS)
+        t0 = time.perf_counter()
+        for i in range(bumps):
+            counter.inc()
+            hist.observe(float(i % 97))
+        costs[label] = (time.perf_counter() - t0) / bumps * 1e6
+    assert costs["live"] < 1000.0, costs  # < 1 ms/bump: mechanics only
+
+    print("metrics: " + json.dumps({
+        "scrape_roundtrip": True,
+        "ring_snapshots": 1,
+        "merge_shards": len(shards),
+        "merge_samples": len(samples),
+        "merge_parity": {f"p{int(q * 100)}": value
+                         for q, value in parity},
+        "bump_us_live": round(costs["live"], 3),
+        "bump_us_null": round(costs["null"], 3),
+        "overhead_bound_frac": 0.02,
+    }, sort_keys=True))
 
 
 def health_selfcheck():

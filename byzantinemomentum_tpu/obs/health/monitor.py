@@ -90,10 +90,15 @@ class HealthMonitor:
       clear_after: consecutive in-control observations before an
         anomalous channel clears (`health_cleared`).
       ring: bounded blackbox depth (last K full health vectors).
+      metrics: optional `MetricsRegistry` (obs/metrics) — anomaly and
+        clear EDGES bump `health_anomaly_edges` / `health_cleared_edges`
+        counters so the metrics plane carries the same signal the
+        telemetry stream does (scrapeable without tailing telemetry).
     """
 
     def __init__(self, *, alpha=0.05, warmup=30, z_spike=6.0, z_run2=3.5,
-                 z_run4=2.5, z_clear=2.0, clear_after=10, ring=256):
+                 z_run4=2.5, z_clear=2.0, clear_after=10, ring=256,
+                 metrics=None):
         if not 0.0 < alpha <= 1.0:
             raise ValueError(f"EWMA alpha must be in (0, 1], got {alpha}")
         if warmup < 1:
@@ -121,6 +126,11 @@ class HealthMonitor:
         self._rollback_pending = False
         self._ring = collections.deque(maxlen=int(ring))
         self._edges = collections.deque(maxlen=64)
+        if metrics is not None and getattr(metrics, "enabled", False):
+            self._m_anomalies = metrics.counter("health_anomaly_edges")
+            self._m_cleared = metrics.counter("health_cleared_edges")
+        else:
+            self._m_anomalies = self._m_cleared = None
 
     # -------------------------------------------------------------- #
 
@@ -261,6 +271,10 @@ class HealthMonitor:
             self.anomalies_total += 1
             self.last_anomaly = dict(payload)
             self._rollback_pending = True
+            if self._m_anomalies is not None:
+                self._m_anomalies.inc()
+        elif self._m_cleared is not None:
+            self._m_cleared.inc()
         recorder.emit(name, **payload)
         self._edges.append({"kind": name, **payload})
 

@@ -1,0 +1,59 @@
+"""The fleet metrics plane: registry, exposition, scrape, SLOs.
+
+One metrics contract for the whole many-process system (r18):
+
+* **registry** (`registry.py`) — the process-local `MetricsRegistry`:
+  monotonic counters, gauges and fixed-bucket streaming histograms
+  (mergeable bucket arrays, exposition-time quantiles, no raw-sample
+  retention), dumped as one deterministic schema-versioned payload.
+* **scrape** (`scrape.py`) — the pull side: `scrape_target` speaks the
+  `{"op": "metrics"}` verb on the existing line-JSON ports,
+  `MetricsScraper` polls every child each interval, merges bucket-wise
+  and appends windowed snapshots to the run directory's `metrics.jsonl`
+  ring (torn-tail-tolerant, atomically rotated); `MetricsEndpoint` is
+  the launcher-side exposition port for cluster runs.
+* **slo** (`slo.py`) — declarative availability/latency objectives
+  evaluated as multi-window burn rates over the merged stream, with
+  `slo_burn`/`slo_ok` edges on the telemetry timeline and a summary
+  block on the one-pager.
+
+Import discipline: stdlib-only, like the rest of `obs`.
+"""
+
+from byzantinemomentum_tpu.obs.metrics.registry import (  # noqa: F401
+    DEPTH_BOUNDS,
+    LATENCY_MS_BOUNDS,
+    METRICS_SCHEMA,
+    OCCUPANCY_BOUNDS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+    merge_payloads,
+    quantile_from_buckets,
+)
+from byzantinemomentum_tpu.obs.metrics.scrape import (  # noqa: F401
+    METRICS_NAME,
+    MetricsEndpoint,
+    MetricsScraper,
+    append_snapshot,
+    load_snapshots,
+    scrape_target,
+)
+from byzantinemomentum_tpu.obs.metrics.slo import (  # noqa: F401
+    DEFAULT_SERVE_SLOS,
+    SLO,
+    BurnRateEvaluator,
+    window_rates,
+)
+
+__all__ = [
+    "DEPTH_BOUNDS", "LATENCY_MS_BOUNDS", "METRICS_SCHEMA",
+    "OCCUPANCY_BOUNDS", "Counter",
+    "Gauge", "Histogram", "MetricsRegistry", "NullRegistry",
+    "merge_payloads", "quantile_from_buckets",
+    "METRICS_NAME", "MetricsEndpoint", "MetricsScraper",
+    "append_snapshot", "load_snapshots", "scrape_target",
+    "DEFAULT_SERVE_SLOS", "SLO", "BurnRateEvaluator", "window_rates",
+]

@@ -1,0 +1,380 @@
+"""Process-local metrics registry: counters, gauges, streaming histograms.
+
+The repo's numbers used to live in scattered, incompatible places —
+`stats()` dicts, telemetry gauge streams, aggregated heartbeats, one-shot
+artifacts — the exact "monitoring glue" decay Sculley et al. name
+(PAPERS.md). This module is the single contract: every process owns ONE
+`MetricsRegistry`, instruments bump it in-line, and an exposition call
+(`dump()`) serializes the whole registry as one deterministic,
+schema-versioned payload that a PULLER fetches over the existing
+line-JSON protocols (`{"op": "metrics"}` on the serve frontend, the
+fleet router, and the cluster launcher's endpoint). Aggregation is the
+scraper's job (`obs/metrics/scrape.py`), never a push path — the Ray
+ownership discipline applied to metrics.
+
+Three metric kinds, all mergeable across processes:
+
+  Counter    monotonic int total (`inc`); merges by addition.
+  Gauge      last-set float (`set`); merges by addition — every gauge
+             here is an extensive quantity (queue depth, alive-host
+             count), so the fleet-wide value IS the sum.
+  Histogram  fixed-bucket streaming distribution (`observe`): a static
+             ladder of upper bounds + one overflow bucket, integer
+             bucket counts, running count/sum/min/max. No raw samples
+             are retained — memory is bounded by the ladder length —
+             and merging is bucket-wise addition, which is associative
+             and commutative, so a fleet scrape that merges N shard
+             payloads reports the same quantiles as a single process
+             that observed every sample (bit-for-bit: quantiles are
+             computed from integer cumulative counts over the SAME
+             static ladder, never from floats that could re-associate).
+
+Quantiles (`Histogram.quantile`, and `quantile_from_buckets` for
+payloads) are nearest-rank over the cumulative bucket counts, resolving
+to the bucket's upper bound — the `obs/trace` percentile stance with
+bounded memory. The overflow bucket resolves to the tracked max.
+
+Locking: one `threading.Lock` per metric, held only for the few-field
+update or the snapshot copy — submitter, resolver and scraper threads
+interleave freely without a registry-wide convoy. The interleaving
+contract (a scrape must never observe a torn multi-field histogram
+update) is pinned by the `metrics_scrape*` models in
+`analysis/schedule.py`.
+
+Stdlib-only, like the rest of `obs`: host-side consumers (launchers,
+report tooling) must import it without an accelerator stack.
+"""
+
+import bisect
+import math
+import threading
+
+__all__ = ["METRICS_SCHEMA", "LATENCY_MS_BOUNDS", "DEPTH_BOUNDS",
+           "OCCUPANCY_BOUNDS", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "NullRegistry", "merge_payloads",
+           "quantile_from_buckets"]
+
+# Version of the exposition payload; a merger refuses mixed schemas
+# instead of silently mis-adding fields that changed meaning.
+METRICS_SCHEMA = 1
+
+# Default ladders. Latency buckets follow a coarse exponential sweep —
+# sub-0.1 ms is scheduler noise on any host (bench_compare's serve
+# floor), 5 s is past every serve timeout. Depth buckets stay exact
+# through the microbatcher's realistic range (max_batch <= 32) and
+# coarsen past it.
+LATENCY_MS_BOUNDS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                     100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0)
+DEPTH_BOUNDS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                32.0, 48.0, 64.0, 96.0, 128.0, 256.0, 512.0)
+# Fractions in [0, 1] (batch occupancy): eighths resolve every batch
+# size the microbatcher's power-of-two bucket ladder can produce.
+OCCUPANCY_BOUNDS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+class Counter:
+    """Monotonic running total. `inc` rejects negative increments — a
+    counter that can go down is a gauge wearing the wrong type, and the
+    scraper's monotonicity contract (tests) depends on it."""
+
+    kind = "counter"
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"counter {self.name!r}: negative inc {n}")
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """Last-set extensive measurement (queue depth, alive hosts)."""
+
+    kind = "gauge"
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    def add(self, delta):
+        with self._lock:
+            self._value += float(delta)
+            return self._value
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "gauge", "value": self._value}
+
+
+def _bucket_index(bounds, value):
+    """The bucket a value lands in: first bound >= value, else overflow."""
+    return bisect.bisect_left(bounds, value)
+
+
+def quantile_from_buckets(bounds, counts, q, maximum=None):
+    """Nearest-rank quantile from a bucket array (payload-side twin of
+    `Histogram.quantile`): the upper bound of the bucket holding the
+    rank, the tracked `maximum` for the overflow bucket. None when
+    empty. Deterministic in the integer counts alone — merged buckets
+    yield bit-identical quantiles to the single-process fold."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = max(1, math.ceil(q * total))
+    cumulative = 0
+    for index, count in enumerate(counts):
+        cumulative += count
+        if cumulative >= rank:
+            if index < len(bounds):
+                return float(bounds[index])
+            return float(maximum) if maximum is not None else None
+    return float(maximum) if maximum is not None else None
+
+
+class Histogram:
+    """Fixed-bucket streaming histogram: bounded memory, mergeable."""
+
+    kind = "histogram"
+
+    def __init__(self, name, bounds=LATENCY_MS_BOUNDS):
+        bounds = tuple(float(b) for b in bounds)
+        if list(bounds) != sorted(set(bounds)):
+            raise ValueError(f"histogram {name!r}: bounds must be "
+                             f"strictly increasing, got {bounds}")
+        self.name = name
+        self.bounds = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, value):
+        value = float(value)
+        index = _bucket_index(self.bounds, value)
+        with self._lock:
+            self._counts[index] += 1
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    def quantile(self, q):
+        with self._lock:
+            counts, maximum = list(self._counts), self._max
+        return quantile_from_buckets(self.bounds, counts, q, maximum)
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "histogram", "bounds": list(self.bounds),
+                    "counts": list(self._counts), "count": self._count,
+                    "sum": self._sum, "min": self._min, "max": self._max}
+
+
+class MetricsRegistry:
+    """One process's (or subsystem's) named metrics + the exposition
+    dump. Get-or-create accessors are idempotent and type-checked: the
+    same name must always be the same kind (and, for histograms, the
+    same ladder) — a name that changes shape would silently poison
+    every merge downstream."""
+
+    enabled = True
+
+    def __init__(self, source=None):
+        self.source = source
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, name, factory, kind):
+        name = str(name)
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = factory(name)
+                self._metrics[name] = metric
+        if metric.kind != kind:
+            raise TypeError(f"metric {name!r} is a {metric.kind}, "
+                            f"asked for as a {kind}")
+        return metric
+
+    def counter(self, name):
+        return self._get(name, Counter, "counter")
+
+    def gauge(self, name):
+        return self._get(name, Gauge, "gauge")
+
+    def histogram(self, name, bounds=LATENCY_MS_BOUNDS):
+        metric = self._get(name, lambda n: Histogram(n, bounds),
+                           "histogram")
+        if metric.bounds != tuple(float(b) for b in bounds):
+            raise ValueError(f"histogram {name!r} re-registered with a "
+                             f"different ladder")
+        return metric
+
+    def dump(self):
+        """The exposition payload: schema-versioned, metrics sorted by
+        name — byte-stable for a fixed registry state, so snapshot
+        diffs and merge parity checks are exact."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        payload = {"schema": METRICS_SCHEMA, "kind": "metrics",
+                   "metrics": {name: metric.snapshot()
+                               for name, metric in metrics}}
+        if self.source is not None:
+            payload["source"] = str(self.source)
+        return payload
+
+
+class NullRegistry:
+    """The off switch: same surface, every operation a no-op — the
+    paired overhead run's baseline arm, and the default for callers
+    that opted out of metrics. `dump()` still answers (empty payload)
+    so the exposition verb never errors on a metrics-off process."""
+
+    enabled = False
+
+    def __init__(self, source=None):
+        self.source = source
+        self._counter = _NullCounter()
+        self._gauge = _NullGauge()
+        self._histogram = _NullHistogram()
+
+    def counter(self, name):
+        return self._counter
+
+    def gauge(self, name):
+        return self._gauge
+
+    def histogram(self, name, bounds=LATENCY_MS_BOUNDS):
+        return self._histogram
+
+    def dump(self):
+        payload = {"schema": METRICS_SCHEMA, "kind": "metrics",
+                   "metrics": {}}
+        if self.source is not None:
+            payload["source"] = str(self.source)
+        return payload
+
+
+class _NullCounter:
+    kind = "counter"
+    value = 0
+
+    def inc(self, n=1):
+        return 0
+
+
+class _NullGauge:
+    kind = "gauge"
+    value = 0.0
+
+    def set(self, value):
+        pass
+
+    def add(self, delta):
+        return 0.0
+
+
+class _NullHistogram:
+    kind = "histogram"
+    bounds = ()
+    count = 0
+
+    def observe(self, value):
+        pass
+
+    def quantile(self, q):
+        return None
+
+
+def merge_payloads(payloads):
+    """Merge N exposition payloads into one: counters and gauges add,
+    histograms add bucket-wise (same ladder required), min/max fold.
+    Associative and commutative by construction — the fleet scrape's
+    merge order can never change the reported distribution. Mixed
+    schemas or mismatched histogram ladders raise: silently adding
+    fields that changed meaning is how monitoring glue rots."""
+    merged = {}
+    sources = []
+    for payload in payloads:
+        if not isinstance(payload, dict) or payload.get("kind") != "metrics":
+            raise ValueError("merge_payloads: not a metrics payload")
+        if payload.get("schema") != METRICS_SCHEMA:
+            raise ValueError(f"merge_payloads: schema "
+                             f"{payload.get('schema')!r} != "
+                             f"{METRICS_SCHEMA}")
+        if payload.get("source") is not None:
+            sources.append(str(payload["source"]))
+        for name, cell in (payload.get("metrics") or {}).items():
+            kind = cell.get("type")
+            have = merged.get(name)
+            if have is None:
+                if kind == "histogram":
+                    merged[name] = {"type": "histogram",
+                                    "bounds": list(cell["bounds"]),
+                                    "counts": list(cell["counts"]),
+                                    "count": int(cell["count"]),
+                                    "sum": float(cell["sum"]),
+                                    "min": cell.get("min"),
+                                    "max": cell.get("max")}
+                else:
+                    merged[name] = {"type": kind, "value": cell["value"]}
+                continue
+            if have["type"] != kind:
+                raise ValueError(f"merge_payloads: metric {name!r} is a "
+                                 f"{have['type']} in one payload, a "
+                                 f"{kind} in another")
+            if kind == "histogram":
+                if have["bounds"] != list(cell["bounds"]):
+                    raise ValueError(f"merge_payloads: histogram "
+                                     f"{name!r} ladders differ")
+                have["counts"] = [a + b for a, b in
+                                  zip(have["counts"], cell["counts"])]
+                have["count"] += int(cell["count"])
+                have["sum"] += float(cell["sum"])
+                for key, pick in (("min", min), ("max", max)):
+                    theirs = cell.get(key)
+                    if theirs is not None:
+                        have[key] = (theirs if have[key] is None
+                                     else pick(have[key], theirs))
+            else:
+                have["value"] = have["value"] + cell["value"]
+    payload = {"schema": METRICS_SCHEMA, "kind": "metrics",
+               "metrics": {name: merged[name] for name in sorted(merged)}}
+    if sources:
+        payload["sources"] = sorted(sources)
+    return payload

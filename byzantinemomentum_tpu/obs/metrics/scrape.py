@@ -1,0 +1,270 @@
+"""Pull-based metrics collection: scraper thread, on-disk snapshot ring,
+and the launcher-side exposition endpoint.
+
+The ownership discipline (module note in `registry.py`): every process
+owns its registry and answers `{"op": "metrics"}` on its existing
+line-JSON port; THIS module is the one place aggregation happens. A
+`MetricsScraper` runs inside the supervising process (the serve-fleet
+launcher, the cluster launcher), polls every child endpoint each
+interval, merges the payloads bucket-wise (`merge_payloads`), and
+appends one windowed snapshot per scrape to `metrics.jsonl` next to
+`heartbeat.json` — then hands the merged snapshot to the SLO evaluator
+(`slo.py`), whose `slo_burn`/`slo_ok` edges ride the active telemetry
+recorder.
+
+`metrics.jsonl` is a RING, not a log: past `max_lines` lines the file
+is rewritten keeping the newest `max_lines // 2` snapshots, through the
+tmp + fsync + `os.replace` door every other run artifact uses — a
+reader never sees a half-rotated file, and a SIGKILL mid-append tears
+at most the final line, which `load_snapshots` skips (the
+`load_records` stance). The append-vs-rotate interleaving contract is
+pinned by the `metrics_rotate*` models in `analysis/schedule.py`.
+
+`MetricsEndpoint` is the launcher-side exposition server for cluster
+runs: training hosts expose their numbers through heartbeats (files,
+not sockets — they must not grow a listening port mid-step), so the
+launcher folds those into ITS registry and serves the merged view on a
+loopback line-JSON port, same verb, same payload schema as a serve
+shard. One scrape protocol end to end.
+
+Stdlib-only, like the rest of `obs`.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import socketserver
+import threading
+import time
+
+from byzantinemomentum_tpu.obs import recorder
+from byzantinemomentum_tpu.obs.metrics.registry import merge_payloads
+
+__all__ = ["METRICS_NAME", "append_snapshot", "load_snapshots",
+           "scrape_target", "MetricsScraper", "MetricsEndpoint"]
+
+METRICS_NAME = "metrics.jsonl"
+
+# Ring bound: at the scrapers' seconds-scale cadence this holds hours of
+# history while keeping the file re-read (report tooling, SLO replay)
+# trivially cheap.
+DEFAULT_MAX_LINES = 4096
+
+
+def append_snapshot(directory, snapshot, *, max_lines=DEFAULT_MAX_LINES,
+                    name=METRICS_NAME):
+    """Append one snapshot line; rotate the ring once past `max_lines`
+    (keep the newest half, atomically). Returns the path written. The
+    caller serializes appends (the scraper is the only writer); rotation
+    itself is crash-safe — `os.replace` lands whole or not at all."""
+    directory = pathlib.Path(directory)
+    path = directory / name
+    line = json.dumps(snapshot, ensure_ascii=False,
+                      separators=(",", ":")) + "\n"
+    with path.open("a", encoding="utf-8") as fd:
+        fd.write(line)
+        fd.flush()
+        os.fsync(fd.fileno())
+    try:
+        with path.open("r", encoding="utf-8") as fd:
+            lines = fd.readlines()
+    except OSError:
+        return path
+    if len(lines) > max_lines:
+        keep = lines[-(max_lines // 2):]
+        tmp = path.with_name(path.name + ".tmp")
+        with tmp.open("w", encoding="utf-8") as fd:
+            fd.writelines(keep)
+            fd.flush()
+            os.fsync(fd.fileno())
+        os.replace(tmp, path)
+    return path
+
+
+def load_snapshots(path, name=METRICS_NAME):
+    """Parse a `metrics.jsonl` (file path or run directory) into a list
+    of snapshot dicts, oldest first, skipping unparsable lines — a
+    SIGKILL can tear the final one. [] for a missing file."""
+    path = pathlib.Path(path)
+    if path.is_dir():
+        path = path / name
+    snapshots = []
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError:
+        return snapshots
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snapshot = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(snapshot, dict):
+            snapshots.append(snapshot)
+    return snapshots
+
+
+def scrape_target(host, port, timeout=5.0):
+    """One metrics pull over line JSON: returns the payload dict, or
+    raises OSError/ValueError — the caller decides whether a dead
+    target is an error or a gap (the scraper records it as a gap: a
+    dead shard's counters simply stop contributing, exactly as its
+    traffic did)."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        files = sock.makefile("rwb")
+        try:
+            files.write(json.dumps({"op": "metrics"}).encode("utf-8")
+                        + b"\n")
+            files.flush()
+            line = files.readline()
+        finally:
+            files.close()
+    if not line:
+        raise OSError("connection closed before the metrics reply")
+    reply = json.loads(line)
+    if not (isinstance(reply, dict) and reply.get("ok")
+            and isinstance(reply.get("metrics"), dict)):
+        raise ValueError(f"not a metrics reply: {reply!r}")
+    return reply["metrics"]
+
+
+class MetricsScraper:
+    """The supervising process's poll loop: scrape every target, merge,
+    append one snapshot to the run directory's ring, feed the SLO
+    evaluator, forward its edge events to the active recorder.
+
+    `targets` maps name -> (host, port); `local` optionally adds the
+    supervisor's own registry (the launcher's liveness/health fold) to
+    every merge. `scrape_once()` is the loop body, public so tests and
+    the selfcheck drive it deterministically without the thread."""
+
+    def __init__(self, targets, directory, *, interval=2.0, local=None,
+                 evaluator=None, max_lines=DEFAULT_MAX_LINES,
+                 timeout=5.0):
+        self.targets = dict(targets)
+        self.directory = pathlib.Path(directory)
+        self.interval = float(interval)
+        self.local = local
+        self.evaluator = evaluator
+        self.max_lines = int(max_lines)
+        self.timeout = float(timeout)
+        self.scrapes = 0
+        self.last_snapshot = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def scrape_once(self, now=None):
+        """One scrape round; returns the snapshot appended (also kept
+        as `last_snapshot`). Dead targets become gaps, not errors."""
+        now = time.time() if now is None else float(now)
+        payloads = []
+        reached, missed = [], []
+        for name in sorted(self.targets):
+            host, port = self.targets[name]
+            try:
+                payloads.append(scrape_target(host, port,
+                                              timeout=self.timeout))
+                reached.append(name)
+            except (OSError, ValueError):
+                missed.append(name)
+        if self.local is not None:
+            payloads.append(self.local.dump())
+        merged = merge_payloads(payloads) if payloads else None
+        snapshot = {"t": now, "kind": "metrics_snapshot",
+                    "targets": len(self.targets), "reached": reached,
+                    "missed": missed, "merged": merged}
+        with self._lock:
+            append_snapshot(self.directory, snapshot,
+                            max_lines=self.max_lines)
+            self.scrapes += 1
+            self.last_snapshot = snapshot
+        if self.evaluator is not None and merged is not None:
+            for event in self.evaluator.observe(snapshot):
+                recorder.emit(event.pop("event"), **event)
+        return snapshot
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.scrape_once()
+            except Exception:  # bmt: noqa[BMT-E05] the scraper must outlive any single bad scrape; the ring shows the gap
+                pass
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="metrics-scraper",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout + self.interval)
+            self._thread = None
+
+
+class _EndpointHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                line = self.rfile.readline()
+            except OSError:
+                return
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                request = json.loads(line)
+                op = request.get("op") if isinstance(request, dict) \
+                    else None
+            except ValueError:
+                op = None
+            if op == "ping":
+                reply = {"ok": True, "op": "ping"}
+            elif op == "metrics":
+                try:
+                    reply = {"ok": True,
+                             "metrics": self.server.provider()}
+                except Exception as err:  # bmt: noqa[BMT-E05] a failed dump must answer the puller, not kill the endpoint
+                    reply = {"ok": False, "error": str(err)}
+            else:
+                reply = {"ok": False,
+                         "error": f"unknown op {op!r} (ping|metrics)"}
+            try:
+                self.wfile.write(json.dumps(reply).encode("utf-8")
+                                 + b"\n")
+                self.wfile.flush()
+            except OSError:
+                return
+
+
+class MetricsEndpoint(socketserver.ThreadingTCPServer):
+    """Loopback line-JSON exposition server: answers `ping` and
+    `metrics` with whatever `provider()` returns (a registry's `dump`,
+    or the scraper's latest merge). The cluster launcher's pull port."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, address, provider):
+        self.provider = provider
+        super().__init__(tuple(address), _EndpointHandler)
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+    def serve_background(self):
+        thread = threading.Thread(target=self.serve_forever,
+                                  name="metrics-endpoint", daemon=True)
+        thread.start()
+        return thread

@@ -1,0 +1,232 @@
+"""Declarative SLOs over the merged metrics stream: multi-window
+burn-rate alerting.
+
+An SLO here is the standard error-budget formulation: an objective (the
+fraction of requests that must be GOOD over a long compliance period)
+turns into a budget (`1 - objective`), and the alerting question is not
+"is the error rate nonzero" but "how fast is the budget burning". The
+burn RATE over a window is `error_rate / budget` — burn 1.0 exhausts
+the budget exactly at the period's end; burn 14.4 exhausts a 30-day
+budget in 2 days. The multi-window discipline (Google SRE workbook)
+fires only when BOTH a slow window and a fast window exceed the
+threshold: the slow window proves the burn is sustained (no paging on a
+single bad scrape), the fast window proves it is still happening (the
+alert un-fires promptly once the bleeding stops).
+
+Two objective kinds, both evaluated from counter/histogram DELTAS
+between snapshots of the merged `metrics.jsonl` stream (totals are
+cumulative since process start; a window's traffic is the difference
+between its edge snapshots):
+
+  availability   bad = sum of error counters, total = a request counter
+  latency        bad = histogram observations ABOVE a threshold bucket
+                 boundary (integer bucket arithmetic — the same
+                 cumulative counts the quantiles use), total = the
+                 histogram's count
+
+`BurnRateEvaluator.observe(snapshot)` folds one snapshot and returns
+edge events — `slo_burn` on entering alert, `slo_ok` on leaving — which
+the scraper forwards to the telemetry recorder; `summary()` is the
+`obs_report` block. Time comes from the snapshots themselves (`t`), so
+replaying a recorded stream is deterministic.
+
+Stdlib-only, like the rest of `obs`.
+"""
+
+from byzantinemomentum_tpu.obs.metrics.registry import METRICS_SCHEMA
+
+__all__ = ["SLO", "BurnRateEvaluator", "DEFAULT_SERVE_SLOS",
+           "window_rates"]
+
+
+class SLO:
+    """One declarative objective.
+
+    kind         "availability" | "latency"
+    objective    good fraction target (e.g. 0.999)
+    total        counter name (availability) or histogram name (latency)
+    bad          error counter names (availability only)
+    threshold_ms latency bound; a histogram observation counts BAD when
+                 its bucket's upper bound exceeds this (latency only —
+                 pick a value ON the ladder to make the cut exact)
+    fast_s/slow_s  the two burn windows, seconds
+    burn_threshold thresholds both windows must exceed to fire
+    """
+
+    def __init__(self, name, *, kind="availability", objective=0.999,
+                 total="serve_requests", bad=("serve_rejected",),
+                 threshold_ms=None, fast_s=30.0, slow_s=300.0,
+                 burn_threshold=10.0):
+        if kind not in ("availability", "latency"):
+            raise ValueError(f"SLO {name!r}: unknown kind {kind!r}")
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"SLO {name!r}: objective must be in (0, 1)")
+        if kind == "latency" and threshold_ms is None:
+            raise ValueError(f"SLO {name!r}: latency SLOs need "
+                             f"threshold_ms")
+        self.name = str(name)
+        self.kind = kind
+        self.objective = float(objective)
+        self.total = str(total)
+        self.bad = tuple(bad or ())
+        self.threshold_ms = (None if threshold_ms is None
+                             else float(threshold_ms))
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.burn_threshold = float(burn_threshold)
+
+    @property
+    def budget(self):
+        return 1.0 - self.objective
+
+    def spec(self):
+        """JSON-safe description (rides the summary block)."""
+        out = {"name": self.name, "kind": self.kind,
+               "objective": self.objective, "total": self.total,
+               "fast_s": self.fast_s, "slow_s": self.slow_s,
+               "burn_threshold": self.burn_threshold}
+        if self.kind == "availability":
+            out["bad"] = list(self.bad)
+        else:
+            out["threshold_ms"] = self.threshold_ms
+        return out
+
+
+# The serve fleet's default objectives: availability over the frontline
+# request/reject counters, and a p-latency bound on the end-to-end
+# request histogram. Window pair sized for the scraper's seconds-scale
+# cadence (a production-minute deployment would scale both up together;
+# the burn arithmetic is cadence-free).
+DEFAULT_SERVE_SLOS = (
+    SLO("serve-availability", kind="availability", objective=0.999,
+        total="serve_requests",
+        bad=("serve_rejected", "router_errors", "router_timeouts")),
+    SLO("serve-latency", kind="latency", objective=0.99,
+        total="serve_request_ms", threshold_ms=100.0),
+)
+
+
+def _counter(snapshot, name):
+    cell = ((snapshot.get("merged") or {}).get("metrics") or {}).get(name)
+    if isinstance(cell, dict) and cell.get("type") == "counter":
+        return int(cell.get("value") or 0)
+    return 0
+
+
+def _latency_counts(snapshot, name, threshold_ms):
+    """(total, bad) observation counts for a latency SLO: integer sums
+    over the histogram's bucket array, BAD being every bucket whose
+    upper bound (or the overflow bucket) lies above the threshold."""
+    cell = ((snapshot.get("merged") or {}).get("metrics") or {}).get(name)
+    if not (isinstance(cell, dict) and cell.get("type") == "histogram"):
+        return 0, 0
+    bounds = cell.get("bounds") or []
+    counts = cell.get("counts") or []
+    total = sum(int(c) for c in counts)
+    bad = sum(int(c) for i, c in enumerate(counts)
+              if i >= len(bounds) or float(bounds[i]) > threshold_ms)
+    return total, bad
+
+
+def _totals(snapshot, slo):
+    if slo.kind == "latency":
+        return _latency_counts(snapshot, slo.total, slo.threshold_ms)
+    total = _counter(snapshot, slo.total)
+    bad = sum(_counter(snapshot, name) for name in slo.bad)
+    return total, bad
+
+
+def window_rates(history, slo, now):
+    """`{fast: burn | None, slow: burn | None}` over a snapshot history
+    (oldest first). Each window's burn is the bad/total DELTA rate
+    between `now` and the oldest in-window snapshot, divided by the
+    budget; None when the window has no earlier edge or no traffic."""
+    burns = {}
+    for label, window in (("fast", slo.fast_s), ("slow", slo.slow_s)):
+        edge = None
+        for snapshot in history:
+            if now - float(snapshot.get("t", 0.0)) <= window:
+                edge = snapshot
+                break
+        latest = history[-1] if history else None
+        if edge is None or latest is None or edge is latest:
+            burns[label] = None
+            continue
+        total0, bad0 = _totals(edge, slo)
+        total1, bad1 = _totals(latest, slo)
+        d_total, d_bad = total1 - total0, bad1 - bad0
+        if d_total <= 0:
+            burns[label] = None
+            continue
+        burns[label] = (max(d_bad, 0) / d_total) / slo.budget
+    return burns
+
+
+class BurnRateEvaluator:
+    """Folds merged snapshots into per-SLO alert state. Pure in the
+    snapshot stream — time is read from each snapshot's `t`, so a
+    recorded `metrics.jsonl` replays to the identical event sequence."""
+
+    def __init__(self, slos=DEFAULT_SERVE_SLOS):
+        self.slos = tuple(slos)
+        self._history = []
+        self._alerting = {slo.name: False for slo in self.slos}
+        self.burn_events = 0
+        self.ok_events = 0
+
+    def observe(self, snapshot):
+        """Fold one snapshot; returns edge events (`slo_burn` on
+        entering alert, `slo_ok` on leaving), each JSON-safe."""
+        now = float(snapshot.get("t", 0.0))
+        self._history.append(snapshot)
+        # Bound memory to the slow window (+ one pre-window edge so the
+        # slow delta always has its earlier snapshot).
+        horizon = max(slo.slow_s for slo in self.slos) if self.slos else 0
+        while (len(self._history) > 2
+               and now - float(self._history[1].get("t", 0.0)) > horizon):
+            self._history.pop(0)
+        events = []
+        for slo in self.slos:
+            burns = window_rates(self._history, slo, now)
+            fast, slow = burns["fast"], burns["slow"]
+            firing = (fast is not None and slow is not None
+                      and fast > slo.burn_threshold
+                      and slow > slo.burn_threshold)
+            was = self._alerting[slo.name]
+            if firing and not was:
+                self._alerting[slo.name] = True
+                self.burn_events += 1
+                events.append({"event": "slo_burn", "slo": slo.name,
+                               "burn_fast": round(fast, 3),
+                               "burn_slow": round(slow, 3),
+                               "threshold": slo.burn_threshold, "t": now})
+            elif was and not firing:
+                self._alerting[slo.name] = False
+                self.ok_events += 1
+                events.append({"event": "slo_ok", "slo": slo.name,
+                               "burn_fast": (None if fast is None
+                                             else round(fast, 3)),
+                               "burn_slow": (None if slow is None
+                                             else round(slow, 3)),
+                               "threshold": slo.burn_threshold, "t": now})
+        return events
+
+    def summary(self):
+        """The `obs_report` SLO block: per-objective current burn and
+        alert state, plus the lifetime edge counts."""
+        now = (float(self._history[-1].get("t", 0.0))
+               if self._history else 0.0)
+        rows = []
+        for slo in self.slos:
+            burns = (window_rates(self._history, slo, now)
+                     if self._history else {"fast": None, "slow": None})
+            rows.append({**slo.spec(),
+                         "burn_fast": (None if burns["fast"] is None
+                                       else round(burns["fast"], 3)),
+                         "burn_slow": (None if burns["slow"] is None
+                                       else round(burns["slow"], 3)),
+                         "alerting": self._alerting[slo.name]})
+        return {"schema": METRICS_SCHEMA, "slos": rows,
+                "burn_events": self.burn_events,
+                "ok_events": self.ok_events,
+                "snapshots": len(self._history)}

@@ -28,7 +28,7 @@ _TIMELINE_EVENTS = ("restart", "rollback", "divergence_giveup", "retry",
                     "run_start", "run_end", "suspect_worker",
                     "suspect_cleared", "serve_trace_snapshot",
                     "health_anomaly", "health_cleared", "health_flag",
-                    "health_blackbox")
+                    "health_blackbox", "slo_burn", "slo_ok")
 
 
 def _fmt_seconds(seconds):
@@ -244,6 +244,34 @@ def render_report(run_dir):
             parts.append(f"blackbox [{blackbox.get('reason')}] "
                          f"ring x{len(blackbox.get('ring') or [])}")
         lines.append("health: " + ", ".join(parts))
+
+    # Metrics plane + SLOs (obs/metrics): replay the run's metrics.jsonl
+    # ring through the burn-rate evaluator — the evaluator is pure in the
+    # snapshot stream, so the replayed alert story matches what the live
+    # scraper emitted — and render the per-objective summary block
+    from byzantinemomentum_tpu.obs.metrics import (BurnRateEvaluator,
+                                                   load_snapshots)
+    snapshots = load_snapshots(run_dir)
+    if snapshots:
+        evaluator = BurnRateEvaluator()
+        for snapshot in snapshots:
+            evaluator.observe(snapshot)
+        slo_summary = evaluator.summary()
+        merged = (snapshots[-1].get("merged") or {}).get("metrics") or {}
+        lines.append(f"metrics: {len(snapshots)} snapshot(s), "
+                     f"{len(merged)} merged metric(s), "
+                     f"slo burns x{slo_summary['burn_events']} "
+                     f"ok x{slo_summary['ok_events']}")
+        for row in slo_summary["slos"]:
+            state = "ALERTING" if row["alerting"] else "ok"
+            burns = ", ".join(
+                f"{label} {row[f'burn_{label}']:.3g}"
+                if row[f"burn_{label}"] is not None else f"{label} -"
+                for label in ("fast", "slow"))
+            lines.append(f"  slo {row['name']:<20} [{state}] "
+                         f"burn {burns} "
+                         f"(objective {row['objective']}, "
+                         f"threshold {row['burn_threshold']})")
 
     timeline = [r for r in records if r.get("kind") == "event"
                 and r.get("name") in _TIMELINE_EVENTS]

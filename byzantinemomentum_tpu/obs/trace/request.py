@@ -37,7 +37,11 @@ in-process API pays only `validate`).
 Completed traces land in a `TraceBuffer` — a bounded, thread-safe ring
 (old traces fall off; the buffer can never grow a long-lived server's
 heap) — whose `summary()` is the per-phase p50/p99 view served by
-`stats` and the SIGUSR1 snapshot. Stdlib only: the obs import
+`stats` and the SIGUSR1 snapshot. When the buffer is handed a
+`MetricsRegistry` (`obs/metrics`, r18), every completed trace also
+feeds per-phase `serve_phase_<name>_ms` histograms — the ring summary
+is a 512-trace window, the histograms are the process-lifetime
+distribution the fleet scraper merges. Stdlib only: the obs import
 discipline (no jax, no numpy) keeps every consumer host-only.
 """
 
@@ -45,6 +49,8 @@ import collections
 import itertools
 import threading
 import time
+
+from byzantinemomentum_tpu.obs.metrics.registry import LATENCY_MS_BOUNDS
 
 __all__ = ["REQUEST_PHASES", "ROUTER_PHASES", "RequestTrace",
            "TraceBuffer", "percentile", "phase_spans"]
@@ -210,18 +216,39 @@ class TraceBuffer:
     `maxlen` completed traces no matter how much traffic it serves.
     `add` is the serving hot path, so it stores the `RequestTrace`
     OBJECT (one lock + deque append); the dict conversion happens
-    lazily at `snapshot()`/`summary()` time, on the reader's clock."""
+    lazily at `snapshot()`/`summary()` time, on the reader's clock.
 
-    def __init__(self, maxlen=512):
+    `metrics` optionally feeds per-phase latency histograms
+    (`serve_phase_<name>_ms`, the LATENCY_MS ladder) on every add —
+    skipped entirely (no span math) when the registry is off, so the
+    paired-overhead baseline arm pays nothing here."""
+
+    def __init__(self, maxlen=512, *, metrics=None):
         if maxlen < 1:
             raise ValueError(f"Expected maxlen >= 1, got {maxlen}")
         self.maxlen = int(maxlen)
         self._ring = collections.deque(maxlen=self.maxlen)
         self._lock = threading.Lock()
         self._completed = 0
+        self._metrics = (metrics if metrics is not None
+                         and getattr(metrics, "enabled", False) else None)
+        self._phase_hists = {}
+
+    def _observe_phases(self, trace):
+        spans = (trace.spans_ms() if isinstance(trace, RequestTrace)
+                 else (trace.get("spans_ms") or {}))
+        for phase, ms in spans.items():
+            hist = self._phase_hists.get(phase)
+            if hist is None:
+                hist = self._metrics.histogram(
+                    f"serve_phase_{phase}_ms", bounds=LATENCY_MS_BOUNDS)
+                self._phase_hists[phase] = hist
+            hist.observe(ms)
 
     def add(self, trace):
         """Append one completed `RequestTrace` (or prebuilt record)."""
+        if self._metrics is not None:
+            self._observe_phases(trace)
         with self._lock:
             self._ring.append(trace)
             self._completed += 1

@@ -417,6 +417,10 @@ def main(argv=None):
                         help="completed traces the in-memory ring keeps "
                              "(the stats/SIGUSR1 summary window)")
     parser.add_argument("--heartbeat-interval", type=float, default=2.0)
+    parser.add_argument("--no-metrics", action="store_true",
+                        help="disable the metrics registry (obs/metrics; "
+                             "on by default — the paired-overhead "
+                             "baseline arm)")
     parser.add_argument("--result-directory", default=None,
                         help="run directory for heartbeat.json + "
                              "telemetry.jsonl (enables Jobs supervision)")
@@ -457,12 +461,25 @@ def main(argv=None):
     # packing slice stall the submitter/handler threads for more than the
     # whole max-delay budget; 1 ms keeps scheduler jitter out of p99
     sys.setswitchinterval(0.001)
+    # Metrics source = the result directory's basename: fleet shards run
+    # with --result-directory shards/shard-<i>, so the merged fleet
+    # payload's `sources` list names each contributing shard
+    import pathlib
+
+    from byzantinemomentum_tpu.obs.metrics import MetricsRegistry
+    if args.no_metrics:
+        metrics = False
+    else:
+        source = (pathlib.Path(args.result_directory).name
+                  if args.result_directory else "serve")
+        metrics = MetricsRegistry(source=source)
     service = AggregationService(
         max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
         diagnostics=not args.no_diagnostics,
         directory=args.result_directory,
         heartbeat_interval=args.heartbeat_interval,
-        tracing=not args.no_tracing, trace_buffer=args.trace_buffer)
+        tracing=not args.no_tracing, trace_buffer=args.trace_buffer,
+        metrics=metrics)
     if args.warmup:
         cells = []
         for spec in args.warmup:

@@ -31,6 +31,16 @@ after enqueue), flush (depth after the batch left) and resolver drain
 (depth as a batch resolves) — so an idle-then-burst profile is visible
 in the gauge sequence instead of only its flush-time residue.
 
+The same transitions feed the metrics plane (`obs/metrics`, r18): a
+`serve_queue_depth` registry gauge plus a `serve_queue_depth_dist`
+histogram observed at the SAME edges with the SAME values — the gauge
+edge stream in `telemetry.jsonl` and the registry's bucket counts are
+two projections of one sequence, so folding the recorded edges into
+the static ladder must reproduce the histogram exactly (pinned by a
+cross-check test). Batch sizes land on `serve_batch_size`, and the
+`serve_batches`/`serve_batched_requests` counters mirror onto registry
+counters of the same names.
+
 Request tracing (`obs/trace/request.py`): when a request carries a
 `RequestTrace`, the batcher stamps the two hand-offs it owns — `flush`
 (queue wait ends: the flusher picked the batch) and `resolver` (the
@@ -45,6 +55,7 @@ import threading
 import time
 
 from byzantinemomentum_tpu.obs import recorder
+from byzantinemomentum_tpu.obs.metrics import DEPTH_BOUNDS, NullRegistry
 
 __all__ = ["ServeRequest", "MicroBatcher"]
 
@@ -93,9 +104,13 @@ class MicroBatcher:
       max_batch: flush a cell at this many queued requests.
       max_delay: seconds the oldest request of a cell may wait before
         its batch flushes regardless of occupancy.
+      metrics: the owning service's `MetricsRegistry` (None = no-op
+        `NullRegistry`) — queue depth gauge + distribution, batch-size
+        histogram and the batch counters land there.
     """
 
-    def __init__(self, dispatch, resolve, *, max_batch=8, max_delay=0.002):
+    def __init__(self, dispatch, resolve, *, max_batch=8, max_delay=0.002,
+                 metrics=None):
         if max_batch < 1:
             raise ValueError(f"Expected max_batch >= 1, got {max_batch}")
         if max_delay < 0:
@@ -104,6 +119,14 @@ class MicroBatcher:
         self._resolve = resolve
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay)
+        metrics = metrics if metrics is not None else NullRegistry()
+        self._m_depth = metrics.gauge("serve_queue_depth")
+        self._m_depth_dist = metrics.histogram("serve_queue_depth_dist",
+                                               bounds=DEPTH_BOUNDS)
+        self._m_batches = metrics.counter("serve_batches")
+        self._m_batched = metrics.counter("serve_batched_requests")
+        self._m_batch_size = metrics.histogram("serve_batch_size",
+                                               bounds=DEPTH_BOUNDS)
         self._queues = collections.OrderedDict()  # cell -> deque[request]
         self._cond = threading.Condition()
         self._inflight = queue.Queue()
@@ -131,6 +154,8 @@ class MicroBatcher:
         # Depth on SUBMIT, not only on flush: an idle-then-burst queue
         # build-up is otherwise invisible (the gauge would only record
         # the post-flush residue)
+        self._m_depth.set(depth)  # bmt: noqa[BMT-T01] Gauge is internally locked (its own _lock serializes set/snapshot); the attribute binds once in __init__
+        self._m_depth_dist.observe(depth)
         if recorder.active() is not None:
             recorder.active().gauge("serve_queue_depth", depth,
                                     edge="submit")
@@ -197,6 +222,11 @@ class MicroBatcher:
                     if batch_stamps is None:
                         batch_stamps = {"flush": time.monotonic()}
                     r.trace.batch_stamps = batch_stamps
+            self._m_batches.inc()
+            self._m_batched.inc(len(batch))
+            self._m_batch_size.observe(len(batch))
+            self._m_depth.set(depth_after)  # bmt: noqa[BMT-T01] Gauge is internally locked; the attribute binds once in __init__
+            self._m_depth_dist.observe(depth_after)
             recorder.counter("serve_batches")
             recorder.counter("serve_batched_requests", len(batch))
             if recorder.active() is not None:
@@ -234,8 +264,11 @@ class MicroBatcher:
             # Depth on resolver DRAIN: with submit/flush above, every
             # queue transition lands on the gauge, so a depth timeline
             # can be read straight off the telemetry
+            depth = self.depth()
+            self._m_depth.set(depth)  # bmt: noqa[BMT-T01] Gauge is internally locked; the attribute binds once in __init__
+            self._m_depth_dist.observe(depth)
             if recorder.active() is not None:
-                recorder.active().gauge("serve_queue_depth", self.depth(),
+                recorder.active().gauge("serve_queue_depth", depth,
                                         edge="drain")
 
     # ------------------------------------------------------------------ #

@@ -26,9 +26,17 @@ with the `cluster/launcher.py` discipline:
   first), the router queues or errors its lines per `--on-dead`, and
   the shard restarts on ITS port with a FRESH store: ownership never
   moves, and a returning client re-warms no faster than a fresh id.
+* **one metrics plane** (`obs/metrics`, r18) — a `MetricsScraper`
+  thread polls every shard's `{"op": "metrics"}` port each
+  `--metrics-interval`, folds the in-process router registry in, merges
+  bucket-wise and appends windowed snapshots to the run's
+  `metrics.jsonl` ring; a `BurnRateEvaluator` watches the merged stream
+  and lands `slo_burn`/`slo_ok` edges on the telemetry timeline. A dead
+  shard is a GAP in the scrape (its counters stop moving), exactly as
+  its traffic is.
 
-Stdlib + ring/router + obs.heartbeat only — the launcher never imports
-jax (the shards do, in their own processes).
+Stdlib + ring/router + obs.heartbeat/metrics only — the launcher never
+imports jax (the shards do, in their own processes).
 """
 
 import argparse
@@ -42,6 +50,8 @@ import time
 from byzantinemomentum_tpu.cluster.runtime import free_port
 from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat, \
     write_heartbeat
+from byzantinemomentum_tpu.obs.metrics import BurnRateEvaluator, \
+    MetricsRegistry, MetricsScraper
 from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, \
     Membership, write_fleet_manifest
 from byzantinemomentum_tpu.serve.fleet.router import FleetRouter, \
@@ -78,6 +88,9 @@ def process_commandline(argv=None):
     add("--no-diagnostics", action="store_true", default=False)
     add("--no-tracing", action="store_true", default=False)
     add("--heartbeat-interval", type=float, default=2.0)
+    add("--metrics-interval", type=float, default=2.0,
+        help="Seconds between metrics scrapes of the shard fleet "
+             "(merged snapshots append to metrics.jsonl; 0 disables)")
     add("--poll", type=float, default=0.2,
         help="Supervision poll interval in seconds")
     add("--shard-retries", type=int, default=5,
@@ -126,6 +139,7 @@ class FleetLauncher:
         self.restarts = {}   # shard id -> count
         self.router = None
         self.server = None
+        self.scraper = None
 
     # -------------------------------------------------------------- #
 
@@ -211,9 +225,21 @@ class FleetLauncher:
              for s, row in self.membership.shards.items()},
             vnodes=self.args.vnodes, on_dead=self.args.on_dead,
             max_parked=self.args.max_parked,
-            liveness_hook=self._liveness_hook)
+            liveness_hook=self._liveness_hook,
+            metrics=MetricsRegistry(source="router"))
         self.server = RouterServer((self.host, self.args.port), self.router)
         self.server.serve_background()
+        if getattr(self.args, "metrics_interval", 0) > 0:
+            # The pull plane: shards are TCP targets (their frontends
+            # answer the metrics op), the in-process router registry
+            # folds in as `local`, and the merged snapshots + SLO burn
+            # edges land next to heartbeat.json
+            self.scraper = MetricsScraper(
+                {s: (row["host"], row["port"])
+                 for s, row in self.membership.shards.items()},
+                self.resdir, interval=self.args.metrics_interval,
+                local=self.router.metrics,
+                evaluator=BurnRateEvaluator()).start()
         self._persist()  # now the manifest names the router's real port
         return self.server.port
 
@@ -276,6 +302,8 @@ class FleetLauncher:
         return restarted
 
     def teardown(self):
+        if self.scraper is not None:
+            self.scraper.stop()
         if self.server is not None:
             self.server.shutdown()
             self.server.server_close()

@@ -21,6 +21,7 @@ returning clients re-warm from scratch.
 import json
 import socket
 
+from byzantinemomentum_tpu.obs.metrics import MetricsRegistry
 from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, \
     Membership
 from byzantinemomentum_tpu.serve.fleet.router import FleetRouter, \
@@ -46,7 +47,7 @@ class LocalFleet:
         self.servers = {}
         for index in range(int(shards)):
             shard = f"shard-{index}"
-            svc = AggregationService(**self._service_kwargs)
+            svc = AggregationService(**self._shard_kwargs(shard))
             server = AggregationServer(("127.0.0.1", 0), svc)
             server.serve_background()
             self.services[shard] = svc
@@ -56,7 +57,8 @@ class LocalFleet:
         self.router = FleetRouter(
             {s: (row["host"], row["port"])
              for s, row in self.membership.shards.items()},
-            vnodes=vnodes, on_dead=on_dead, max_parked=max_parked)
+            vnodes=vnodes, on_dead=on_dead, max_parked=max_parked,
+            metrics=MetricsRegistry(source="router"))
         self.server = None
         if router_server:
             self.server = RouterServer(("127.0.0.1", 0), self.router)
@@ -64,9 +66,29 @@ class LocalFleet:
 
     # -------------------------------------------------------------- #
 
+    def _shard_kwargs(self, shard):
+        """Service kwargs for one shard: the registries must be
+        INSTANCE-scoped with the shard's name as source — N services
+        share this process, and a process-global registry would fold
+        every shard's numbers into one stream before the scraper gets
+        to merge (and label) them."""
+        kwargs = dict(self._service_kwargs)
+        if kwargs.get("metrics", True) is True:
+            kwargs["metrics"] = MetricsRegistry(source=shard)
+        return kwargs
+
     @property
     def shards(self):
         return tuple(sorted(self.services))
+
+    def scrape_targets(self):
+        """{name: (host, port)} of every live exposition port (shards +
+        the router server when bound) — a `MetricsScraper`'s targets."""
+        targets = {s: ("127.0.0.1", server.port)
+                   for s, server in self.servers.items()}
+        if self.server is not None:
+            targets["router"] = ("127.0.0.1", self.server.port)
+        return targets
 
     @property
     def port(self):
@@ -99,7 +121,7 @@ class LocalFleet:
         """A fresh service (EMPTY suspicion store) on the SAME port —
         ownership never moves; state does not survive, by design."""
         port = self.membership.shards[shard]["port"]
-        svc = self._service_cls(**self._service_kwargs)
+        svc = self._service_cls(**self._shard_kwargs(shard))
         server = self._server_cls(("127.0.0.1", port), svc)
         server.serve_background()
         self.services[shard] = svc

@@ -61,6 +61,8 @@ import socketserver
 import threading
 import time
 
+from byzantinemomentum_tpu.obs.metrics import (LATENCY_MS_BOUNDS,
+                                               NullRegistry)
 from byzantinemomentum_tpu.obs.trace import ROUTER_PHASES, percentile, \
     phase_spans
 from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, HashRing
@@ -89,7 +91,7 @@ class FleetRouter:
     def __init__(self, shards, *, vnodes=DEFAULT_VNODES, on_dead="queue",
                  max_parked=1024, reply_timeout=30.0, connect_timeout=2.0,
                  retry_interval=0.05, probe_interval=0.25,
-                 trace_buffer=512, liveness_hook=None):
+                 trace_buffer=512, liveness_hook=None, metrics=None):
         if on_dead not in ("queue", "error"):
             raise ValueError(f"on_dead must be 'queue' or 'error', "
                              f"got {on_dead!r}")
@@ -120,6 +122,20 @@ class FleetRouter:
         self._timeouts = 0
         self._parked_rejected = 0
         self._anon = 0
+        # The metrics plane (obs/metrics): the router owns ITS registry
+        # — shard internals stay shard-local, a scraper pulls each
+        # process separately and merges. The counter names are the ones
+        # DEFAULT_SERVE_SLOS folds as availability errors.
+        self.metrics = metrics if metrics is not None else NullRegistry()
+        self._m_routed = self.metrics.counter("router_routed")
+        self._m_errors = self.metrics.counter("router_errors")
+        self._m_timeouts = self.metrics.counter("router_timeouts")
+        self._m_parked_rejected = self.metrics.counter(
+            "router_parked_rejected")
+        self._m_route = self.metrics.histogram("router_route_ms",
+                                               bounds=LATENCY_MS_BOUNDS)
+        self._m_rtt = self.metrics.histogram("router_shard_rtt_ms",
+                                             bounds=LATENCY_MS_BOUNDS)
         self._trace_buffer = int(trace_buffer)
         self._spans = []  # bounded [(route_ms, shard_rtt_ms, total_ms)]
         self._queues = {s: queue.Queue() for s in self._addresses}
@@ -201,6 +217,13 @@ class FleetRouter:
             return json.dumps(payload).encode("utf-8")
         if op == "stats":
             return json.dumps(self.stats()).encode("utf-8")
+        if op == "metrics":
+            # The router answers with ITS OWN registry, like every other
+            # process: the puller scrapes router and shards separately
+            # and does the merging itself (obs/metrics/scrape.py)
+            return json.dumps({"ok": True,
+                               "metrics": self.metrics.dump()}
+                              ).encode("utf-8")
         clients = request.get("clients")
         if clients:
             key = str(clients[0])
@@ -214,9 +237,11 @@ class FleetRouter:
             shard = self._ring.owner(key)
             alive = self._ring.alive(shard)
             self._routed[shard] += 1
+        self._m_routed.inc()
         if not alive and self.on_dead == "error":
             with self._lock:
                 self._errors += 1
+            self._m_errors.inc()
             return self._error_bytes(f"shard {shard} is dead "
                                      f"(on_dead=error)", shard=shard)
         if not alive and self._queues[shard].qsize() >= self.max_parked:
@@ -225,6 +250,7 @@ class FleetRouter:
             # amplifying a flash crowd into unbounded queued memory
             with self._lock:
                 self._parked_rejected += 1
+            self._m_parked_rejected.inc()
             return self._error_bytes(
                 f"shard {shard} is dead and its parked line is full "
                 f"({self.max_parked} lines)", shard=shard)
@@ -236,6 +262,7 @@ class FleetRouter:
         except queue.Empty:
             with self._lock:
                 self._timeouts += 1
+            self._m_timeouts.inc()
             return self._error_bytes(f"shard {shard} reply timeout "
                                      f"({self._reply_timeout}s)",
                                      shard=shard)
@@ -252,6 +279,8 @@ class FleetRouter:
         if spans is None:
             return
         total = (stamps["reply"] - stamps["recv"]) * 1000.0
+        self._m_route.observe(spans["route"])
+        self._m_rtt.observe(spans["shard_rtt"])
         with self._lock:
             self._spans.append((spans["route"], spans["shard_rtt"], total))
             if len(self._spans) > self._trace_buffer:
@@ -278,6 +307,7 @@ class FleetRouter:
     def _reply_error(self, item, message, shard=None):
         with self._lock:
             self._errors += 1
+        self._m_errors.inc()
         item.reply_q.put(self._error_bytes(message, **(
             {"shard": shard} if shard is not None else {})))
 

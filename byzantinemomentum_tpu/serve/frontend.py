@@ -9,6 +9,7 @@ response line per request line, in order:
           "cell": {...}, "verdicts": {...}, "latency_ms": 3.2,
           "trace": {"trace_id": "req-17", "spans_ms": {...}, ...}}
   {"op": "stats"}   -> {"ok": true, "stats": {...}}
+  {"op": "metrics"} -> {"ok": true, "metrics": {...}}   (registry dump)
   {"op": "ping"}    -> {"ok": true, "op": "ping"}
 
 Errors answer `{"ok": false, "error": "..."}` on the same line slot; a
@@ -108,6 +109,11 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"ok": True, "op": "ping"}
         if op == "stats":
             return {"ok": True, "stats": service.stats()}
+        if op == "metrics":
+            # The metrics-plane exposition verb (obs/metrics): the
+            # scraper pulls this shard's registry dump and does the
+            # merging ITSELF — no push path, no aggregation here
+            return {"ok": True, "metrics": service.metrics.dump()}
         if op != "aggregate":
             raise ValueError(f"unknown op {op!r}")
         trace_id = request.get("trace")

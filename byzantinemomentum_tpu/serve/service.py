@@ -43,6 +43,10 @@ from byzantinemomentum_tpu import utils
 from byzantinemomentum_tpu.obs import recorder
 from byzantinemomentum_tpu.obs.forensics import ClientSuspicionStore
 from byzantinemomentum_tpu.obs.heartbeat import write_heartbeat
+from byzantinemomentum_tpu.obs.metrics import (LATENCY_MS_BOUNDS,
+                                               OCCUPANCY_BOUNDS,
+                                               MetricsRegistry,
+                                               NullRegistry)
 from byzantinemomentum_tpu.obs.trace import RequestTrace, TraceBuffer
 from byzantinemomentum_tpu.serve.batching import MicroBatcher, ServeRequest
 from byzantinemomentum_tpu.serve.programs import (
@@ -119,12 +123,18 @@ class AggregationService:
         trace phase); `False` skips them entirely.
       trace_buffer: completed traces the in-memory ring keeps (the
         `stats`/SIGUSR1 summary window; old traces fall off).
+      metrics: the process-local metrics registry (`obs/metrics`, r18) —
+        the request/serve counters, the end-to-end and per-phase latency
+        histograms and the batcher's depth/occupancy distributions all
+        land here, and `{"op": "metrics"}` on the front end dumps it.
+        `True` builds a fresh registry, `False` a `NullRegistry` (the
+        paired-overhead baseline arm), or pass a registry instance.
     """
 
     def __init__(self, *, max_batch=8, max_delay_ms=2.0, buckets=N_BUCKETS,
                  diagnostics=True, directory=None, heartbeat_interval=2.0,
                  suspicion=None, admission=None, tracing=True,
-                 trace_buffer=512):
+                 trace_buffer=512, metrics=True):
         from byzantinemomentum_tpu.serve.admission import (
             ADMISSION_WEIGHTS, AdmissionPolicy)
 
@@ -132,7 +142,26 @@ class AggregationService:
         self.max_batch = int(max_batch)
         self.diagnostics = bool(diagnostics)
         self.tracing = bool(tracing)
-        self.traces = TraceBuffer(trace_buffer)
+        # The metrics plane (obs/metrics): instance-owned, never
+        # process-global — a LocalFleet runs N services in ONE process
+        # and each shard's numbers must stay its own. Hot-path handles
+        # are bound once here; a bump is one per-metric lock + int add.
+        if metrics is True:
+            metrics = MetricsRegistry(source="serve")
+        elif not metrics:
+            metrics = NullRegistry()
+        self.metrics = metrics
+        self._m_requests = metrics.counter("serve_requests")
+        self._m_served = metrics.counter("serve_served")
+        self._m_rejected = metrics.counter("serve_rejected")
+        self._m_masked = metrics.counter("serve_admission_masked")
+        self._m_downweighted = metrics.counter(
+            "serve_admission_downweighted")
+        self._m_latency = metrics.histogram("serve_request_ms",
+                                            bounds=LATENCY_MS_BOUNDS)
+        self._m_occupancy = metrics.histogram("serve_batch_occupancy",
+                                              bounds=OCCUPANCY_BOUNDS)
+        self.traces = TraceBuffer(trace_buffer, metrics=metrics)
         if isinstance(admission, dict):
             admission = AdmissionPolicy(**admission)
         self.admission = admission
@@ -166,7 +195,8 @@ class AggregationService:
                 self._telemetry = recorder.activate(Telemetry(self.directory))
         self.batcher = MicroBatcher(self._dispatch, self._resolve,
                                     max_batch=max_batch,
-                                    max_delay=max_delay_ms / 1000.0)
+                                    max_delay=max_delay_ms / 1000.0,
+                                    metrics=metrics)
         self._beat_stop = threading.Event()
         self._beat_thread = None
         if self.directory is not None and heartbeat_interval:
@@ -208,6 +238,7 @@ class AggregationService:
         except utils.UserException:
             with self._stats_lock:
                 self._rejected += 1
+            self._m_rejected.inc()
             recorder.counter("serve_rejected")
             raise
         n = matrix.shape[0]
@@ -226,12 +257,15 @@ class AggregationService:
                     self._admission_masked += masked
                     self._admission_downweighted += blended
                 if masked:
+                    self._m_masked.inc(masked)
                     recorder.counter("serve_admission_masked", masked)
                 if blended:
+                    self._m_downweighted.inc(blended)
                     recorder.counter("serve_admission_downweighted",
                                      blended)
         with self._stats_lock:
             self._requests += 1
+        self._m_requests.inc()
         recorder.counter("serve_requests")
         if trace is not None:
             trace.meta = {"gar": cell.gar, "n": n, "d": int(matrix.shape[1])}
@@ -339,6 +373,7 @@ class AggregationService:
             active[i, :r.n] = True if r.admitted is None else r.admitted
         for i in range(len(requests), B):
             G[i], active[i] = G[0], active[0]
+        self._m_occupancy.observe(len(requests) / B)
         if recorder.active() is not None:
             recorder.active().gauge("serve_batch_occupancy",
                                     len(requests) / B, cell=repr(cell))
@@ -404,15 +439,18 @@ class AggregationService:
                 # (response serialization, stats snapshot)
                 r.trace.stamp("done", at=done)
                 self.traces.add(r.trace)  # bmt: noqa[BMT-T01] TraceBuffer is internally locked (its own _lock serializes the ring)
+            latency_ms = (done - r.t_submit) * 1000.0
             result = AggregateResult(
                 aggregate=host["aggregate"][i, :r.d],
                 f_eff=int(host["f_eff"][i]),
                 n=r.n, cell=r.cell, verdicts=verdicts,
                 admission=r.admission,
-                latency_ms=(done - r.t_submit) * 1000.0,
+                latency_ms=latency_ms,
                 trace=r.trace)
             with self._stats_lock:
                 self._served += 1
+            self._m_served.inc()
+            self._m_latency.observe(latency_ms)
             if not r.future.done():
                 r.future.set_result(result)
 
@@ -440,6 +478,7 @@ class AggregationService:
                 "downweighted_rows": downweighted,
             },
             "queue_depth": self.batcher.depth(),
+            "metrics": {"enabled": self.metrics.enabled},
             "cache": self.cache.stats(),
             "suspicion": self.suspicion.summary(),
             "tracing": ({"enabled": True, **self.traces.summary()}

@@ -24,7 +24,12 @@ pairs are INCOMPARABLE. A `BENCH_serve_fleet.json` pair (`"kind":
 "serve_fleet"`, `--fleet`) compares aggregations/s per (scenario,
 shard-count) cell and fails on any recovery invariant flipping false;
 pairs from different fleet sizes, host core counts or isolation modes
-are INCOMPARABLE — a 4-shard rate says nothing about a 2-shard one. That is the phase-budget gate: a PR that regrows the relayout
+are INCOMPARABLE — a 4-shard rate says nothing about a 2-shard one. A
+`BENCH_metrics*.json` pair (`"kind": "metrics_overhead"`,
+`--metrics-overhead`) gates the metrics-plane registry cost: the
+paired on/off agg/s are rates, the overhead fraction is a cost, and
+the 2% `within_bound` acceptance bit flipping false fails regardless
+of tolerance; `--smoke` metrics artifacts are INCOMPARABLE. That is the phase-budget gate: a PR that regrows the relayout
 copies or host gaps the r5 packing work removed (PERF_NOTES.md) fails CI
 here instead of silently eating the win inside an unchanged steps/s
 tolerance band.
@@ -53,8 +58,9 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 __all__ = ["load_artifact", "compare", "compare_attribution",
-           "compare_cluster", "compare_health", "compare_serve",
-           "compare_serve_attribution", "compare_serve_fleet", "main"]
+           "compare_cluster", "compare_health", "compare_metrics",
+           "compare_serve", "compare_serve_attribution",
+           "compare_serve_fleet", "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -381,6 +387,51 @@ def compare_health(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+# The metrics-plane overhead is bounded at 2% by construction (the r18
+# acceptance bound); growth below half a percentage point absolute is
+# window noise and never fails the gate on its own
+_METRICS_OVERHEAD_FLOOR = 0.005
+
+
+def compare_metrics(old_payload, new_payload, tolerance):
+    """The metrics-plane overhead gate over two `BENCH_metrics*.json`
+    artifacts (`scripts/serve_loadgen.py --metrics-overhead`): the
+    paired registry-on/registry-off agg/s rates regress by DROPPING
+    past tolerance, the overhead fraction regresses by GROWING past
+    tolerance over an absolute floor (`_METRICS_OVERHEAD_FLOOR`), and
+    `within_bound` flipping false — the 2% acceptance bit itself — is
+    a regression regardless of tolerance. Cross-backend pairs and
+    `--smoke` artifacts are the caller's INCOMPARABLE case."""
+    rows = []
+    regressions = []
+    for key in ("agg_per_sec_metrics_off", "agg_per_sec_metrics_on"):
+        old, new = old_payload.get(key), new_payload.get(key)
+        if not (isinstance(old, (int, float)) and old > 0
+                and isinstance(new, (int, float))):
+            continue
+        delta = new / old - 1.0
+        rows.append((key, float(old), float(new), delta))
+        if delta < -tolerance:
+            regressions.append(rows[-1])
+    old = old_payload.get("overhead_frac")
+    new = new_payload.get("overhead_frac")
+    if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+        delta = (new / old - 1.0) if old > 0 else (0.0 if new <= old
+                                                   else float("inf"))
+        rows.append(("overhead_frac", float(old), float(new), delta))
+        if (new > old * (1.0 + tolerance)
+                and new - old > _METRICS_OVERHEAD_FLOOR):
+            regressions.append(rows[-1])
+    old = old_payload.get("within_bound")
+    new = new_payload.get("within_bound")
+    if isinstance(old, bool) and isinstance(new, bool):
+        rows.append(("within_bound", float(old), float(new),
+                     float(new) - float(old)))
+        if old and not new:
+            regressions.append(rows[-1])
+    return rows, regressions
+
+
 def compare_cluster(old_payload, new_payload, tolerance):
     """The multi-host gate over two `CLUSTER_r*.json` artifacts
     (`scripts/cluster_smoke.py`): cluster steps/s is a RATE (drop past
@@ -612,6 +663,41 @@ def main(argv=None):
             print(f"bench_compare: {len(regressions)} health metric(s) "
                   f"regressed past the {args.tolerance * 100:.1f}% "
                   f"tolerance")
+            return 1
+        return 0
+
+    is_metrics = [p.get("kind") == "metrics_overhead" for p in payloads]
+    if any(is_metrics):
+        # Metrics-plane overhead gate over two BENCH_metrics*.json
+        if not all(is_metrics):
+            print("bench_compare: INCOMPARABLE — one artifact is a "
+                  "metrics-overhead report, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — metrics runs from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        if any(p.get("smoke") for p in payloads):
+            print("bench_compare: INCOMPARABLE — a --smoke metrics "
+                  "artifact proves the harness, not the overhead")
+            return 0
+        rows, regressions = compare_metrics(old_payload, new_payload,
+                                            args.tolerance)
+        if not rows:
+            print("  no common metrics-overhead figures; nothing to "
+                  "compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.4f} -> {new:10.4f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} metrics-plane "
+                  f"figure(s) regressed past the "
+                  f"{args.tolerance * 100:.1f}% tolerance")
             return 1
         return 0
 

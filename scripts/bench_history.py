@@ -54,10 +54,10 @@ sys.path.insert(0, str(ROOT / "scripts"))
 from bench_compare import load_artifact, _rates  # noqa: E402
 
 __all__ = ["collect_cluster", "collect_fleet", "collect_history",
-           "collect_serve", "collect_serve_attrib", "collect_tournament",
-           "render_table", "main", "GAR_COLUMN", "CLUSTER_COLUMNS",
-           "FLEET_COLUMNS", "SERVE_COLUMNS", "SERVE_ATTRIB_COLUMNS",
-           "TOURNAMENT_COLUMNS"]
+           "collect_metrics", "collect_serve", "collect_serve_attrib",
+           "collect_tournament", "render_table", "main", "GAR_COLUMN",
+           "CLUSTER_COLUMNS", "FLEET_COLUMNS", "METRICS_COLUMNS",
+           "SERVE_COLUMNS", "SERVE_ATTRIB_COLUMNS", "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -339,6 +339,46 @@ def _health_stats(root, label):
             "backend": payload.get("backend")}
 
 
+# Metrics-plane trajectory columns (`scripts/serve_loadgen.py
+# --metrics-overhead` artifacts, r18): the paired on/off agg/s overhead
+# of the serving registry and whether it held the committed bound —
+# the metrics plane's own telemetry-discipline number, per round
+METRICS_COLUMNS = ("metrics ovh %", "metrics ok")
+
+
+def _metrics_stats(root, label):
+    """`{overhead_frac, within_bound, backend} | None` for one round's
+    metrics-overhead artifact: `BENCH_metrics_r*.json` per round, the
+    working tree's `BENCH_metrics.json` for the `current` row.
+    `--smoke` artifacts are INCOMPARABLE (harness proof, not a
+    measurement)."""
+    name = ("BENCH_metrics.json" if label == "current"
+            else f"BENCH_metrics_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "metrics_overhead" \
+            or payload.get("smoke"):
+        return None
+    overhead = payload.get("overhead_frac")
+    if not isinstance(overhead, (int, float)):
+        return None
+    within = payload.get("within_bound")
+    return {"overhead_frac": float(overhead),
+            "within_bound": within if isinstance(within, bool) else None,
+            "backend": payload.get("backend")}
+
+
+def collect_metrics(root, labels):
+    """{label: metrics-overhead stats} over the history rows (independent
+    instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _metrics_stats(root, label)) is not None}
+
+
 def collect_health(root, labels):
     """{label: health-overhead stats} over the history rows (independent
     instrument, same discipline as `collect_serve`)."""
@@ -376,6 +416,8 @@ def collect_history(root=ROOT):
                           ("CLUSTER_r*.json", r"CLUSTER_r(\d+)\.json$"),
                           ("BENCH_health_r*.json",
                            r"BENCH_health_r(\d+)\.json$"),
+                          ("BENCH_metrics_r*.json",
+                           r"BENCH_metrics_r(\d+)\.json$"),
                           ("BENCH_serve_fleet_r*.json",
                            r"BENCH_serve_fleet_r(\d+)\.json$")):
         for path in root.glob(glob):
@@ -391,6 +433,7 @@ def collect_history(root=ROOT):
             or (root / "TOURNAMENT.json").is_file()
             or (root / "CLUSTER.json").is_file()
             or (root / "BENCH_health.json").is_file()
+            or (root / "BENCH_metrics.json").is_file()
             or (root / "BENCH_serve_fleet.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
@@ -421,7 +464,8 @@ def _load_rates(path):
 
 
 def render_table(history, serve=None, tournament=None, cluster=None,
-                 serve_attrib=None, health=None, fleet=None):
+                 serve_attrib=None, health=None, fleet=None,
+                 metrics=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
@@ -436,6 +480,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     serve_attrib = serve_attrib or {}
     health = health or {}
     fleet = fleet or {}
+    metrics = metrics or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
@@ -444,7 +489,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     any_gar = any(gar is not None for _, _, _, gar in history)
     if not columns and not any_gar and not serve and not tournament \
             and not cluster and not serve_attrib and not health \
-            and not fleet:
+            and not fleet and not metrics:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -463,6 +508,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         columns = columns + list(HEALTH_COLUMNS)
     if fleet:
         columns = columns + list(FLEET_COLUMNS)
+    if metrics:
+        columns = columns + list(METRICS_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -493,6 +540,11 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         row_cluster = cluster.get(label)
         row_health = health.get(label)
         row_fleet = fleet.get(label)
+        row_metrics = metrics.get(label)
+        if row_metrics is not None and row_metrics.get("backend") not in (
+                None, "tpu"):
+            notes.append(f"  {label}: metrics overhead from a "
+                         f"backend={row_metrics['backend']} measurement")
         if row_fleet is not None and row_fleet.get("backend") not in (
                 None, "tpu"):
             notes.append(f"  {label}: fleet columns from a "
@@ -561,6 +613,15 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 if key == "rate":
                     return f"{value:>{w}.3f}"
                 return f"{int(value):>{w}d}"
+            if c in METRICS_COLUMNS:
+                if row_metrics is None:
+                    return f"{'-':>{w}}"
+                if c == "metrics ovh %":
+                    return f"{row_metrics['overhead_frac'] * 100:>{w}.2f}"
+                within = row_metrics.get("within_bound")
+                if within is None:
+                    return f"{'-':>{w}}"
+                return f"{int(within):>{w}d}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -602,6 +663,8 @@ def main(argv=None):
                             [label for label, *_ in history])
     fleet = collect_fleet(pathlib.Path(args.root),
                           [label for label, *_ in history])
+    metrics = collect_metrics(pathlib.Path(args.root),
+                              [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
@@ -612,11 +675,12 @@ def main(argv=None):
              "tournament": tournament.get(label),
              "cluster": cluster.get(label),
              "health": health.get(label),
-             "fleet": fleet.get(label)}
+             "fleet": fleet.get(label),
+             "metrics": metrics.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
     print(render_table(history, serve, tournament, cluster, serve_attrib,
-                       health, fleet))
+                       health, fleet, metrics))
     return 0
 
 

@@ -1,0 +1,254 @@
+#!/usr/bin/env python3
+"""Calibrate the host-quarantine enter threshold
+(`--quarantine-anomaly-polls`) from recorded anomaly/clear edge streams.
+
+The straggler policy's quarantine arm (`cluster/straggler.py`) marks a
+host SUSPECT after `anomaly_enter` consecutive launcher polls that saw
+the heartbeat's `health.anomaly` flag up — one bad window is not a
+verdict. The ROADMAP question behind that knob: how many polls does a
+TRANSIENT anomaly (one the monitor itself clears — a spike that passed,
+a baseline re-converging) stay visible for? Set the threshold below
+that and every transient quarantines a healthy host; set it far above
+and a genuinely sick host streams poisoned gradients for the whole
+margin.
+
+This script measures the transient side from recorded runs: the
+`health_anomaly` / `health_cleared` edges a `HealthMonitor`
+(`obs/health`) emitted are folded into monitor-level anomaly episodes
+(the heartbeat flag is up while ANY channel is anomalous, so an episode
+runs from the edge that raised the first channel to the clear that
+dropped the last), split into CLEARED episodes (transients — the false
+-positive pressure) and PERSISTENT ones (still open at end of stream —
+what quarantine exists to catch). Durations are converted to launcher
+polls at `--poll-interval`, and the recommended threshold is one poll
+past the 95th percentile of the cleared episodes' spans: ~95% of
+observed transients die out before the streak can fire (false-positive
+rate <= 5%), while a persistent anomaly pays just one extra poll. The
+cost per genuinely sick host (threshold x poll interval) is reported
+next to the number so the trade is explicit.
+
+Usage:
+  python scripts/quarantine_rates.py RUN_DIR [RUN_DIR ...] [--json]
+
+Each RUN_DIR is a run's result directory (its `telemetry.jsonl` holds
+the monitor stream); a direct path to a telemetry .jsonl file works
+too. Prints a human summary plus one parseable
+`quarantine-rates: {...}` line; `cluster/straggler.py::
+resolve_anomaly_polls` consumes the `--json` file directly
+(`--quarantine-rates` on the cluster launcher).
+"""
+
+import argparse
+import json
+import math
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from byzantinemomentum_tpu.obs.recorder import load_records  # noqa: E402
+
+__all__ = ["anomaly_episodes", "episode_polls", "recommend_polls",
+           "recommendation", "summarize", "main"]
+
+# The launcher's supervision poll interval (`--poll`): the clock the
+# anomaly streak is counted on
+DEFAULT_POLL_S = 0.2
+
+# Never fire on a single anomalous poll, whatever the record says — the
+# quarantine arm exists because one bad window is not a verdict
+FLOOR_POLLS = 2
+
+# The target false-positive rate: the threshold clears >= this fraction
+# of observed transient episodes
+FP_QUANTILE = 0.95
+
+
+def anomaly_episodes(records):
+    """Fold one telemetry stream into monitor-level anomaly episodes.
+
+    Returns `{"cleared": [durations_s], "persistent": int}`: an episode
+    opens at the `health_anomaly` edge that raised the FIRST anomalous
+    channel (the heartbeat flag's rising edge) and closes at the
+    `health_cleared` edge that dropped the LAST (the falling edge) —
+    per-channel edges inside an open episode extend it, they don't
+    nest. Episodes still open when the stream ends are PERSISTENT: the
+    monitor never cleared them, so a quarantine streak of any length
+    would (rightly) have caught them.
+    """
+    active = set()      # channels currently anomalous
+    opened_at = None    # t of the flag's rising edge
+    cleared = []
+    persistent = 0
+    for record in records:
+        name = record.get("name")
+        if record.get("kind") != "event" \
+                or name not in ("health_anomaly", "health_cleared"):
+            continue
+        data = record.get("data") or {}
+        channel, t = data.get("channel"), record.get("t")
+        if channel is None or t is None:
+            continue
+        if name == "health_anomaly":
+            if not active:
+                opened_at = float(t)
+            active.add(channel)
+            continue
+        active.discard(channel)
+        if not active and opened_at is not None:
+            cleared.append(max(0.0, float(t) - opened_at))
+            opened_at = None
+    if active and opened_at is not None:
+        persistent += 1
+    return {"cleared": sorted(cleared), "persistent": persistent}
+
+
+def episode_polls(duration_s, poll_s):
+    """Launcher polls a flag held up for `duration_s` spans: every poll
+    inside the window sees it, and the edge poll that caught the rise
+    counts too — the streak the quarantine arm would have accumulated."""
+    return int(math.floor(max(0.0, duration_s) / max(poll_s, 1e-9))) + 1
+
+
+def _percentile(values, q):
+    """Nearest-rank percentile of a sorted list (None when empty)."""
+    if not values:
+        return None
+    rank = max(1, math.ceil(q * len(values)))
+    return values[rank - 1]
+
+
+def _stats(values):
+    if not values:
+        return None
+    return {"count": len(values),
+            "min_s": round(values[0], 3),
+            "median_s": round(_percentile(values, 0.5), 3),
+            "p95_s": round(_percentile(values, 0.95), 3),
+            "max_s": round(values[-1], 3)}
+
+
+def recommend_polls(episodes, poll_s):
+    """The enter-threshold recommendation from measured episodes.
+
+    One poll past the p95 of cleared-episode spans when transients were
+    observed — the streak ~95% of them cannot reach; with only
+    persistent anomalies on record there is no false-positive pressure
+    to calibrate against, so the floor applies (fast quarantine, zero
+    observed transients sacrificed). None when the stream carries no
+    episodes at all."""
+    if episodes["cleared"]:
+        p95 = _percentile(episodes["cleared"], FP_QUANTILE)
+        return max(FLOOR_POLLS, episode_polls(p95, poll_s) + 1)
+    if episodes["persistent"]:
+        return FLOOR_POLLS
+    return None
+
+
+def recommendation(episodes, poll_s):
+    """The machine-readable block `cluster/straggler.py::
+    resolve_anomaly_polls` consumes: the threshold, WHAT it was derived
+    from, and the evidence counts."""
+    cleared = episodes["cleared"]
+    if cleared:
+        basis = f"fp_rate<={round(1.0 - FP_QUANTILE, 2)}"
+    elif episodes["persistent"]:
+        basis = "persistent_only_floor"
+    else:
+        basis = None
+    polls = recommend_polls(episodes, poll_s)
+    block = {"anomaly_polls": polls, "basis": basis,
+             "cleared": len(cleared),
+             "persistent": int(episodes["persistent"]),
+             "poll_interval_s": poll_s}
+    if cleared:
+        block["p95_cleared_s"] = round(
+            _percentile(cleared, FP_QUANTILE), 3)
+    if polls is not None:
+        block["cost_per_sick_host_s"] = round(polls * poll_s, 3)
+    return block
+
+
+def summarize(run_dirs, poll_s=DEFAULT_POLL_S):
+    """The aggregate summary over one or more run directories (or
+    direct telemetry file paths)."""
+    merged = {"cleared": [], "persistent": 0}
+    runs = 0
+    for run in run_dirs:
+        records = load_records(pathlib.Path(run))
+        if not records:
+            continue
+        runs += 1
+        episodes = anomaly_episodes(records)
+        merged["cleared"].extend(episodes["cleared"])
+        merged["persistent"] += episodes["persistent"]
+    merged["cleared"].sort()
+    polls = recommend_polls(merged, poll_s)
+    return {
+        "kind": "quarantine_rates",
+        "runs": runs,
+        "cleared_episodes": _stats(merged["cleared"]),
+        "persistent_episodes": merged["persistent"],
+        "poll_interval_s": poll_s,
+        "recommended_anomaly_polls": polls,
+        "recommendation": recommendation(merged, poll_s),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="quarantine_rates",
+        description="Calibrate the quarantine enter threshold from "
+                    "recorded health_anomaly/health_cleared edge "
+                    "streams")
+    parser.add_argument("runs", nargs="+",
+                        help="run directories (or telemetry .jsonl "
+                             "files) holding HealthMonitor anomaly/"
+                             "clear events")
+    parser.add_argument("--poll-interval", type=float,
+                        default=DEFAULT_POLL_S,
+                        help="launcher supervision poll interval in "
+                             "seconds (the cluster launcher's --poll; "
+                             f"default {DEFAULT_POLL_S})")
+    parser.add_argument("--json", action="store_true",
+                        help="print only the JSON summary line")
+    args = parser.parse_args(argv)
+    if args.poll_interval <= 0:
+        parser.error(f"non-positive poll interval {args.poll_interval}")
+
+    summary = summarize(args.runs, args.poll_interval)
+    line = "quarantine-rates: " + json.dumps(summary, sort_keys=True)
+    if args.json:
+        print(line)
+        return 0 if summary["runs"] else 1
+    if not summary["runs"]:
+        print("quarantine_rates: no telemetry records found under the "
+              "given paths")
+        return 1
+    print(f"anomaly episodes over {summary['runs']} run(s):")
+    stats = summary["cleared_episodes"]
+    if stats is None:
+        print("  cleared (transient)          (none observed)")
+    else:
+        print(f"  cleared (transient)          x{stats['count']}  "
+              f"min {stats['min_s']}s  median {stats['median_s']}s  "
+              f"p95 {stats['p95_s']}s  max {stats['max_s']}s")
+    if summary["persistent_episodes"]:
+        print(f"  persistent (never cleared)   "
+              f"x{summary['persistent_episodes']}")
+    rec = summary["recommendation"]
+    if summary["recommended_anomaly_polls"] is None:
+        print("  no anomaly episodes; no recommendation")
+    else:
+        print(f"  recommended enter threshold: "
+              f"{summary['recommended_anomaly_polls']} polls at "
+              f"{summary['poll_interval_s']}s ({rec['basis']}; a sick "
+              f"host streams ~{rec['cost_per_sick_host_s']}s before "
+              f"quarantine)")
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,7 @@ def main():
     obs_selfcheck = {"returncode": selfcheck.returncode}
     attribution = None
     health = None
+    metrics = None
     for line in selfcheck.stdout.splitlines():
         if line.startswith("attribution: "):
             try:
@@ -102,10 +103,19 @@ def main():
                 health = json.loads(line[len("health: "):])
             except ValueError:
                 pass
+        elif line.startswith("metrics: "):
+            # The metrics-plane phase (PR 18): scrape roundtrip, N-shard
+            # merge parity quantiles, per-bump cost sanity
+            try:
+                metrics = json.loads(line[len("metrics: "):])
+            except ValueError:
+                pass
     if attribution is not None:
         obs_selfcheck["attribution"] = attribution
     if health is not None:
         obs_selfcheck["health"] = health
+    if metrics is not None:
+        obs_selfcheck["metrics"] = metrics
     if selfcheck.returncode != 0:
         obs_selfcheck["tail"] = (selfcheck.stdout
                                  + selfcheck.stderr).splitlines()[-12:]

@@ -31,6 +31,14 @@ machine-readable `BENCH_serve.json` (`"kind": "serve"`) that
                     policy (exact n for non-masked rules, exact d for
                     every rule) would have compiled for the same stream.
 
+Since r18 the default payload also carries the single-process scenario
+battery — `serve.rotation` / `serve.zipf` / `serve.churn` /
+`serve.flash`: the `--fleet` population scenarios (same
+`_scenario_bases` key streams) driven straight through
+`service.submit` with no router or socket in the path, so the
+engine's behaviour under skew, churn and flash arrival is gated by
+`compare_serve` independently of the fleet plumbing.
+
 The p99 contract is also checked: a correctly-batched service bounds
 p99 by `max_delay` (the longest a request waits for batch-mates) plus
 one program execution (measured warm) — the artifact records the bound
@@ -50,6 +58,14 @@ committed rounds live as `ATTRIB_serve_r*.json`. Since r16 the payload
 also carries the `router` block: the 2-shard fleet router's `route` +
 `shard_rtt` spans and their tiling against the client-measured wall.
 
+Metrics-overhead mode (`--metrics-overhead`, r18): the metrics-plane
+acceptance measurement — paired saturation windows against TWO
+services (registry live vs `NullRegistry`; the registry is bound at
+construction, so unlike tracing it cannot be toggled on one service),
+median of per-pair throughput ratios, written as `BENCH_metrics.json`
+(`"kind": "metrics_overhead"`) with the 2% `bound_frac` acceptance
+bit, gated by `bench_compare.py compare_metrics`.
+
 Fleet mode (`--fleet`, r16): scenario traffic (`FLEET_SCENARIOS`)
 through a real consistent-hash `FleetRouter` TCP front door at each
 `--shards` count, plus the kill-safe failover round (shard killed
@@ -66,6 +82,7 @@ Usage:
   python scripts/serve_loadgen.py --requests 600 --rate 400
   python scripts/serve_loadgen.py --trace [--out ATTRIB_serve.json]
   python scripts/serve_loadgen.py --fleet --shards 1,2,4
+  python scripts/serve_loadgen.py --metrics-overhead
 
 All traffic runs against the in-process `AggregationService` (the same
 engine the socket front end wraps) on one cell, client ids attached, so
@@ -84,8 +101,8 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 __all__ = ["run_loadgen", "run_hetero", "run_trace", "run_fleet",
-           "run_router_trace", "pr8_policy_cells", "percentiles",
-           "FLEET_SCENARIOS", "main"]
+           "run_router_trace", "run_metrics_overhead", "pr8_policy_cells",
+           "percentiles", "FLEET_SCENARIOS", "main"]
 
 # Named fleet population scenarios (`--fleet`): how client ids arrive.
 #   rotation  uniform round-robin over a fixed population — the
@@ -173,13 +190,15 @@ def _open_loop(service, cohorts, gar, f, clients, rate, rng):
 
 def run_loadgen(*, requests=400, n=11, d=128, f=2, gar="krum",
                 max_batch=8, max_delay_ms=5.0, rate=None, seed=1,
-                repeats=2, heterogeneous=True, hetero_repeats=8):
+                repeats=2, heterogeneous=True, hetero_repeats=8,
+                population=64):
     """The measurement phases; returns the artifact payload (no file I/O
     here — tests call this directly). Throughput phases run `repeats`
     windows and keep the fastest — the standard damping for scheduler
     noise on shared/1-core CI hosts. `heterogeneous` adds the mixed
     -(n, d) workload phase (`run_hetero`) and its `compiles` policy
-    comparison to the artifact."""
+    comparison to the artifact. `population` sizes the key space of the
+    single-process scenario cells (`serve.rotation` etc.)."""
     import jax
 
     from byzantinemomentum_tpu.serve import AggregationService
@@ -194,7 +213,8 @@ def run_loadgen(*, requests=400, n=11, d=128, f=2, gar="krum",
     try:
         payload = _run_loadgen(requests, n, d, f, gar, max_batch,
                                max_delay_ms, rate, seed, repeats,
-                               AggregationService, jax.default_backend())
+                               AggregationService, jax.default_backend(),
+                               population=population)
         if heterogeneous:
             hetero = run_hetero(repeats_per_shape=hetero_repeats,
                                 max_batch=max_batch,
@@ -423,6 +443,102 @@ def run_trace(*, requests=400, n=11, d=128, f=2, gar="krum", max_batch=8,
                 "agg_per_sec_tracing_off": round(max(off_rates), 2),
                 "ratio_median": round(statistics.median(ratios), 4),
                 "frac": round(overhead, 4),
+            },
+        }
+    finally:
+        sys.setswitchinterval(old_switch)
+
+
+def run_metrics_overhead(*, requests=400, n=11, d=128, f=2, gar="krum",
+                         max_batch=8, max_delay_ms=5.0, seed=1,
+                         overhead_pairs=8, bound_frac=0.02):
+    """Metrics-plane overhead mode: the `BENCH_metrics.json` payload.
+
+    Unlike tracing (a runtime toggle), the registry is a CONSTRUCTOR
+    -time choice — hot-path handles are bound in `__init__` — so the
+    on/off arms are TWO services, one with a live `MetricsRegistry` and
+    one with the `NullRegistry`, both warmed, measured in interleaved
+    a_on/a_off/b_off/b_on saturation windows per pair (pairing cancels
+    host drift; the median of per-pair throughput ratios ignores
+    outlier windows — the same estimator `run_trace` uses for tracing
+    overhead). Both arms run with request TRACING disabled: with
+    tracing on, every completed trace feeds the per-phase
+    `serve_phase_*_ms` histograms (span math + 7 observes per request)
+    — a cost of the TRACING plane, measured and gated by the
+    ATTRIB_serve overhead number, not of the registry this bound
+    governs. What's measured here is the registry proper: the
+    per-request counter bumps and the latency/occupancy histogram
+    observes on the serving hot path. Acceptance: `overhead_frac <=
+    bound_frac` (the r18 2% ceiling on agg/s). The payload carries a
+    sample of the live arm's registry dump so the artifact proves the
+    measured service was actually metering, not silently running the
+    null registry."""
+    import statistics
+
+    import jax
+
+    from byzantinemomentum_tpu.serve import AggregationService
+
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    rng = np.random.default_rng(seed)
+    clients = tuple(f"client-{i}" for i in range(n))
+    count = max(100, requests // 4)
+    try:
+        with AggregationService(max_batch=max_batch,
+                                max_delay_ms=max_delay_ms,
+                                tracing=False, metrics=True) as svc_on, \
+             AggregationService(max_batch=max_batch,
+                                max_delay_ms=max_delay_ms,
+                                tracing=False, metrics=False) as svc_off:
+            svc_on.warmup([(gar, n, f, d, True)])
+            svc_off.warmup([(gar, n, f, d, True)])
+
+            def window(service):
+                t0 = time.perf_counter()
+                futures = [_submit(service, c, gar, f, clients)
+                           for c in _cohorts(rng, count, n, d)]
+                for fut in futures:
+                    fut.result(timeout=120)
+                return count / (time.perf_counter() - t0)
+
+            window(svc_on)   # warm the measurement path on both arms
+            window(svc_off)
+            ratios, on_rates, off_rates = [], [], []
+            for _ in range(overhead_pairs):
+                a_on = window(svc_on)
+                a_off = window(svc_off)
+                b_off = window(svc_off)
+                b_on = window(svc_on)
+                ratios.append((a_on + b_on) / (a_off + b_off))
+                on_rates += [a_on, b_on]
+                off_rates += [a_off, b_off]
+            overhead = max(0.0, 1.0 - statistics.median(ratios))
+            dump = svc_on.metrics.dump()
+
+        metered = dump["metrics"]
+        latency = metered.get("serve_request_ms", {})
+        return {
+            "kind": "metrics_overhead",
+            "backend": jax.default_backend(),
+            "config": {"requests": requests, "n": n, "d": d, "f": f,
+                       "gar": gar, "max_batch": max_batch,
+                       "max_delay_ms": max_delay_ms, "seed": seed,
+                       "window_requests": count},
+            "pairs": overhead_pairs,
+            "agg_per_sec_metrics_on": round(max(on_rates), 2),
+            "agg_per_sec_metrics_off": round(max(off_rates), 2),
+            "ratio_median": round(statistics.median(ratios), 4),
+            "overhead_frac": round(overhead, 4),
+            "bound_frac": bound_frac,
+            "within_bound": bool(overhead <= bound_frac),
+            "registry_sample": {
+                "schema": dump["schema"],
+                "source": dump.get("source"),
+                "names": sorted(metered),
+                "serve_requests":
+                    metered.get("serve_requests", {}).get("value", 0),
+                "serve_request_ms_count": latency.get("count", 0),
             },
         }
     finally:
@@ -792,8 +908,39 @@ def run_router_trace(*, requests=160, population=32, n=5, d=64, f=1,
     }
 
 
+def _scenario_cell(service, name, requests, population, n, d, f, gar,
+                   rng):
+    """One single-process scenario cell (r18): the `--fleet` population
+    scenarios (`FLEET_SCENARIOS`) driven straight through
+    `service.submit` — the SAME key streams (`_scenario_bases`), no
+    router or socket in the path, so a regression in one of these cells
+    is the engine itself (suspicion-store growth, admission, batcher
+    fill under churn/skew), not the fleet plumbing. Each request's
+    cohort is keyed by its scenario base id, batch-mates riding along
+    as `{base}.{j}`. flash = closed-loop trickle of the first quarter,
+    then the remainder as one saturation burst (the arrival stress,
+    same keys)."""
+    bases = _scenario_bases(name, requests, population, rng)
+    jobs = [(cohort, [base] + [f"{base}.{j}" for j in range(1, n)])
+            for cohort, base in zip(_cohorts(rng, requests, n, d), bases)]
+    trickle = jobs[:max(1, requests // 4)] if name == "flash" else []
+    burst = jobs[len(trickle):]
+    latencies = []
+    t0 = time.perf_counter()
+    for cohort, ids in trickle:
+        result = _submit(service, cohort, gar, f, ids).result(timeout=120)
+        latencies.append(result.latency_ms)
+    futures = [_submit(service, cohort, gar, f, ids)
+               for cohort, ids in burst]
+    latencies += [fut.result(timeout=120).latency_ms for fut in futures]
+    wall = time.perf_counter() - t0
+    return {"agg_per_sec": round(len(jobs) / wall, 2),
+            "population": population, **percentiles(latencies)}
+
+
 def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
-                 seed, repeats, AggregationService, backend):
+                 seed, repeats, AggregationService, backend,
+                 population=64):
     rng = np.random.default_rng(seed)
     clients = tuple(f"client-{i}" for i in range(n))
     cells = [(gar, n, f, d, True)]
@@ -838,6 +985,13 @@ def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
             rate = max(1.0, 0.5 * batched["agg_per_sec"])
         open_loop = _open_loop(service, _cohorts(rng, requests, n, d),
                                gar, f, clients, rate, rng)
+        # The PR 16/17 population scenarios through the single-process
+        # engine: compare_serve gates these cells like any other once a
+        # baseline artifact carries them
+        scenario_cells = {
+            f"serve.{name}": _scenario_cell(service, name, requests,
+                                            population, n, d, f, gar, rng)
+            for name in FLEET_SCENARIOS}
         stats = service.stats()
 
     speedup = round(batched["agg_per_sec"]
@@ -853,6 +1007,7 @@ def _run_loadgen(requests, n, d, f, gar, max_batch, max_delay_ms, rate,
             "serve.sequential": sequential,
             "serve.batched": batched,
             "serve.open_loop": open_loop,
+            **scenario_cells,
         },
         "speedup_batched_vs_sequential": speedup,
         "exec_ms": round(exec_ms, 3),
@@ -898,6 +1053,15 @@ def main(argv=None):
                              "attribution + tracing overhead, written as "
                              "ATTRIB_serve.json (obs/trace); includes the "
                              "2-shard router attribution block")
+    parser.add_argument("--metrics-overhead", action="store_true",
+                        help="metrics-plane overhead mode: paired "
+                             "registry-on/registry-off saturation windows "
+                             "(two services — the registry is bound at "
+                             "construction), written as BENCH_metrics.json "
+                             "with the 2%% acceptance bound")
+    parser.add_argument("--overhead-bound", type=float, default=0.02,
+                        help="acceptance ceiling for --metrics-overhead "
+                             "(fraction of agg/s; default 0.02)")
     parser.add_argument("--fleet", action="store_true",
                         help="sharded-fleet mode: scenario traffic through "
                              "a consistent-hash router at each --shards "
@@ -912,10 +1076,35 @@ def main(argv=None):
                              ".serve.fleet) instead of in-process shards; "
                              "skips the recovery round")
     parser.add_argument("--population", type=int, default=64,
-                        help="distinct routing keys per --fleet scenario")
+                        help="distinct routing keys per scenario (--fleet "
+                             "and the single-process scenario cells)")
     parser.add_argument("--connections", type=int, default=8,
                         help="closed-loop client connections for --fleet")
     args = parser.parse_args(argv)
+
+    if args.metrics_overhead:
+        kwargs = dict(requests=args.requests, n=args.n, d=args.d,
+                      f=args.f, gar=args.gar, max_batch=args.max_batch,
+                      max_delay_ms=args.max_delay_ms, seed=args.seed,
+                      bound_frac=args.overhead_bound)
+        if args.smoke:
+            kwargs.update(requests=min(args.requests, 120),
+                          d=min(args.d, 64), overhead_pairs=2)
+        payload = run_metrics_overhead(**kwargs)
+        if args.smoke:
+            payload["smoke"] = True
+        line = {k: payload[k] for k in
+                ("kind", "backend", "agg_per_sec_metrics_on",
+                 "agg_per_sec_metrics_off", "overhead_frac",
+                 "bound_frac", "within_bound")}
+        line["metered"] = len(payload["registry_sample"]["names"])
+        print(json.dumps(line))
+        if not args.smoke or args.out_smoke:
+            out = pathlib.Path(args.out) if args.out \
+                else ROOT / "BENCH_metrics.json"
+            out.write_text(json.dumps(payload, indent=2) + "\n")
+            print(f"serve_loadgen: wrote {out}")
+        return 0
 
     if args.fleet:
         kwargs = dict(requests=args.requests, population=args.population,
@@ -990,10 +1179,12 @@ def main(argv=None):
                   gar=args.gar, max_batch=args.max_batch,
                   max_delay_ms=args.max_delay_ms, rate=args.rate,
                   seed=args.seed, repeats=args.repeats,
-                  heterogeneous=not args.no_heterogeneous)
+                  heterogeneous=not args.no_heterogeneous,
+                  population=args.population)
     if args.smoke:
         kwargs.update(requests=min(args.requests, 80), d=min(args.d, 64),
-                      hetero_repeats=2)
+                      hetero_repeats=2,
+                      population=min(args.population, 16))
     payload = run_loadgen(**kwargs)
 
     line = {k: payload[k] for k in ("kind", "backend",

@@ -1,0 +1,548 @@
+"""Fleet metrics plane (PR 18): streaming histograms, pull-based
+exposition, and SLO burn-rate alerting.
+
+Covers the ISSUE 18 acceptance surface: bucket-wise histogram merging is
+associative/commutative and an N-shard merge reports BIT-IDENTICAL
+quantiles to the single-process oracle; counters stay monotonic under a
+live scrape race; the on-disk `metrics.jsonl` ring tolerates a torn tail
+and stays bounded under rotation; the burn-rate evaluator fires
+`slo_burn` within one slow window of a planted error burst and stays
+silent over a 300-snapshot clean stream; the batcher's queue-depth gauge
+edge stream folds to the same distribution as its
+`serve_queue_depth_dist` histogram (the identical-edge contract); the
+quarantine-threshold calibration (`scripts/quarantine_rates.py`) and its
+`resolve_anomaly_polls` precedence ladder; and the `bench_compare`
+metrics-overhead gate.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from byzantinemomentum_tpu import obs
+from byzantinemomentum_tpu.cluster.straggler import (DEFAULT_ANOMALY_POLLS,
+                                                     resolve_anomaly_polls)
+from byzantinemomentum_tpu.obs.health import HealthMonitor
+from byzantinemomentum_tpu.obs.metrics import (DEPTH_BOUNDS,
+                                               LATENCY_MS_BOUNDS,
+                                               BurnRateEvaluator, Histogram,
+                                               MetricsEndpoint,
+                                               MetricsRegistry,
+                                               MetricsScraper, NullRegistry,
+                                               SLO, append_snapshot,
+                                               load_snapshots,
+                                               merge_payloads,
+                                               quantile_from_buckets,
+                                               scrape_target)
+from byzantinemomentum_tpu.serve.batching import MicroBatcher, ServeRequest
+
+_SCRIPTS = pathlib.Path(__file__).resolve().parent.parent / "scripts"
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(name,
+                                                  _SCRIPTS / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault(name, module)
+    spec.loader.exec_module(module)
+    return module
+
+
+bench_compare = _load_script("bench_compare")
+quarantine_rates = _load_script("quarantine_rates")
+
+
+# --------------------------------------------------------------------------- #
+# Registry primitives
+
+
+def test_counter_monotonic_and_rejects_negative():
+    reg = MetricsRegistry()
+    c = reg.counter("requests")
+    assert c.inc() == 1
+    assert c.inc(41) == 42
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 42
+    # Idempotent get-or-create: same object, same running total
+    assert reg.counter("requests") is c
+
+
+def test_registry_type_collisions_raise():
+    reg = MetricsRegistry()
+    reg.counter("depth")
+    with pytest.raises(TypeError):
+        reg.gauge("depth")
+    reg.histogram("lat", bounds=LATENCY_MS_BOUNDS)
+    with pytest.raises(ValueError):
+        reg.histogram("lat", bounds=DEPTH_BOUNDS)  # different ladder
+    with pytest.raises(ValueError):
+        Histogram("bad", bounds=(1.0, 1.0, 2.0))   # non-increasing
+
+
+def test_histogram_quantiles_nearest_rank():
+    h = Histogram("lat", bounds=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) is None                 # empty
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    # ranks over cumulative counts resolve to bucket upper bounds
+    assert h.quantile(0.25) == 1.0
+    assert h.quantile(0.75) == 2.0
+    assert h.quantile(1.0) == 4.0
+    h.observe(99.0)                                # overflow bucket
+    assert h.quantile(1.0) == 99.0                 # resolves to tracked max
+    assert h.count == 5
+
+
+def test_null_registry_is_inert():
+    reg = NullRegistry(source="off")
+    assert reg.enabled is False
+    reg.counter("c").inc(5)
+    reg.gauge("g").set(3.0)
+    reg.histogram("h").observe(1.0)
+    assert reg.counter("c").value == 0
+    assert reg.histogram("h").quantile(0.5) is None
+    dump = reg.dump()
+    assert dump["metrics"] == {} and dump["source"] == "off"
+
+
+# --------------------------------------------------------------------------- #
+# Merging: associativity, commutativity, N-shard parity
+
+
+def _sharded(samples, shards):
+    """An oracle registry that saw every sample, plus `shards` registries
+    that split them round-robin."""
+    oracle = MetricsRegistry(source="oracle")
+    parts = [MetricsRegistry(source=f"shard-{i}") for i in range(shards)]
+    for i, value in enumerate(samples):
+        oracle.histogram("lat").observe(value)
+        oracle.counter("requests").inc()
+        parts[i % shards].histogram("lat").observe(value)
+        parts[i % shards].counter("requests").inc()
+    return oracle, parts
+
+
+def test_nshard_merge_matches_single_process_oracle_bitwise():
+    rng = np.random.default_rng(7)
+    samples = np.exp(rng.normal(1.5, 1.2, size=2000)).tolist()
+    oracle, parts = _sharded(samples, shards=5)
+    merged = merge_payloads([p.dump() for p in parts])
+    want = oracle.dump()["metrics"]["lat"]
+    got = merged["metrics"]["lat"]
+    assert got["counts"] == want["counts"]
+    assert got["count"] == want["count"] == len(samples)
+    assert got["min"] == want["min"] and got["max"] == want["max"]
+    for q in (0.5, 0.9, 0.99, 1.0):
+        assert quantile_from_buckets(
+            tuple(got["bounds"]), got["counts"], q, got["max"]
+        ) == quantile_from_buckets(
+            tuple(want["bounds"]), want["counts"], q, want["max"])
+    assert merged["metrics"]["requests"]["value"] == len(samples)
+    assert merged["sources"] == [f"shard-{i}" for i in range(5)]
+
+
+def test_merge_associative_and_commutative():
+    rng = np.random.default_rng(3)
+    _, parts = _sharded(rng.uniform(0.0, 50.0, size=300).tolist(), 3)
+    a, b, c = (p.dump() for p in parts)
+    left = merge_payloads([merge_payloads([a, b]), c])
+    right = merge_payloads([a, merge_payloads([b, c])])
+    shuffled = merge_payloads([c, a, b])
+    for other in (right, shuffled):
+        assert left["metrics"] == other["metrics"]
+
+
+def test_merge_refuses_schema_ladder_and_type_drift():
+    good = MetricsRegistry().dump()
+    bad_schema = dict(good, schema=99)
+    with pytest.raises(ValueError):
+        merge_payloads([good, bad_schema])
+    with pytest.raises(ValueError):
+        merge_payloads([{"kind": "not-metrics"}])
+
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.histogram("lat", bounds=(1.0, 2.0))
+    r2.histogram("lat", bounds=(1.0, 4.0))
+    with pytest.raises(ValueError):
+        merge_payloads([r1.dump(), r2.dump()])
+
+    r3, r4 = MetricsRegistry(), MetricsRegistry()
+    r3.counter("x")
+    r4.gauge("x")
+    with pytest.raises(ValueError):
+        merge_payloads([r3.dump(), r4.dump()])
+
+
+def test_counter_monotonic_under_scrape_race():
+    """Writers bump while a reader dumps: every successive exposition
+    value is non-decreasing and the final dump sees every increment."""
+    reg = MetricsRegistry()
+    counter = reg.counter("requests")
+    seen = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            seen.append(reg.dump()["metrics"]["requests"]["value"])
+
+    def writer():
+        for _ in range(5000):
+            counter.inc()
+
+    reader = threading.Thread(target=scraper)
+    writers = [threading.Thread(target=writer) for _ in range(4)]
+    reader.start()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    reader.join()
+    seen.append(reg.dump()["metrics"]["requests"]["value"])
+    assert seen == sorted(seen)            # monotone exposition
+    assert seen[-1] == 4 * 5000            # nothing torn, nothing lost
+
+
+# --------------------------------------------------------------------------- #
+# The on-disk ring + the scrape loop
+
+
+def test_load_snapshots_skips_torn_tail(tmp_path):
+    append_snapshot(tmp_path, {"t": 1.0, "kind": "metrics_snapshot"})
+    append_snapshot(tmp_path, {"t": 2.0, "kind": "metrics_snapshot"})
+    path = tmp_path / "metrics.jsonl"
+    with path.open("a", encoding="utf-8") as fd:
+        fd.write('{"t": 3.0, "kind": "metr')     # SIGKILL mid-append
+    snapshots = load_snapshots(tmp_path)
+    assert [s["t"] for s in snapshots] == [1.0, 2.0]
+    assert load_snapshots(tmp_path / "absent") == []
+
+
+def test_ring_rotation_keeps_newest_half(tmp_path):
+    for i in range(25):
+        append_snapshot(tmp_path, {"t": float(i)}, max_lines=20)
+    snapshots = load_snapshots(tmp_path)
+    assert len(snapshots) <= 20
+    # Rotation kept the NEWEST half and appends continued after it
+    assert snapshots[-1]["t"] == 24.0
+    assert [s["t"] for s in snapshots] == sorted(s["t"] for s in snapshots)
+
+
+def test_endpoint_scrape_and_dead_target_gap(tmp_path):
+    reg = MetricsRegistry(source="svc")
+    reg.counter("serve_requests").inc(10)
+    endpoint = MetricsEndpoint(("127.0.0.1", 0), reg.dump)
+    endpoint.serve_background()
+    try:
+        assert scrape_target("127.0.0.1", endpoint.port) == reg.dump()
+        scraper = MetricsScraper(
+            {"svc": ("127.0.0.1", endpoint.port),
+             "dead": ("127.0.0.1", 1)},           # nothing listens there
+            tmp_path, timeout=0.5)
+        snapshot = scraper.scrape_once(now=100.0)
+    finally:
+        endpoint.shutdown()
+        endpoint.server_close()
+    assert snapshot["reached"] == ["svc"]
+    assert snapshot["missed"] == ["dead"]         # a gap, not an error
+    merged = snapshot["merged"]["metrics"]
+    assert merged["serve_requests"]["value"] == 10
+    assert load_snapshots(tmp_path)[-1]["t"] == 100.0
+
+
+# --------------------------------------------------------------------------- #
+# SLO burn-rate alerting
+
+
+def _snapshot(t, total, bad):
+    reg = MetricsRegistry()
+    reg.counter("serve_requests").inc(total)
+    reg.counter("serve_rejected").inc(bad)
+    return {"t": float(t), "kind": "metrics_snapshot",
+            "merged": reg.dump()}
+
+
+_AVAIL = SLO("avail", kind="availability", objective=0.999,
+             total="serve_requests", bad=("serve_rejected",),
+             fast_s=30.0, slow_s=300.0, burn_threshold=10.0)
+
+
+def test_planted_burst_fires_within_one_slow_window():
+    """100% errors burn the 0.1% budget at rate 1000 >> 10: the alert
+    must rise before one slow window of bad traffic has elapsed."""
+    evaluator = BurnRateEvaluator([_AVAIL])
+    events, fired_at = [], None
+    total = bad = 0
+    for i in range(120):                  # 10 s cadence, 20 min stream
+        t = 10.0 * i
+        total += 100
+        if t >= 600.0:                    # burst starts at t=600
+            bad += 100
+        for event in evaluator.observe(_snapshot(t, total, bad)):
+            events.append(event)
+            if event["event"] == "slo_burn" and fired_at is None:
+                fired_at = t
+    assert fired_at is not None
+    assert fired_at - 600.0 <= _AVAIL.slow_s       # within one slow window
+    assert evaluator.burn_events == 1              # edge, not a level
+
+
+def test_clean_stream_fires_nothing():
+    evaluator = BurnRateEvaluator([_AVAIL])
+    events = []
+    total = 0
+    for i in range(300):
+        total += 50
+        events.extend(evaluator.observe(_snapshot(2.0 * i, total, 0)))
+    assert events == []
+    assert evaluator.burn_events == 0 and evaluator.ok_events == 0
+    summary = evaluator.summary()
+    row = summary["slos"][0]
+    assert row["alerting"] is False and row["burn_slow"] == 0.0
+
+
+def test_burst_then_recovery_emits_slo_ok():
+    evaluator = BurnRateEvaluator([_AVAIL])
+    names = []
+    total = bad = 0
+    for i in range(200):
+        t = 10.0 * i
+        total += 100
+        if 300.0 <= t < 700.0:
+            bad += 100
+        names.extend(e["event"]
+                     for e in evaluator.observe(_snapshot(t, total, bad)))
+    assert names.count("slo_burn") == 1
+    assert names.count("slo_ok") == 1
+    assert names.index("slo_burn") < names.index("slo_ok")
+
+
+def test_latency_slo_counts_buckets_above_threshold():
+    slo = SLO("lat", kind="latency", objective=0.9,
+              total="serve_request_ms", threshold_ms=10.0,
+              fast_s=30.0, slow_s=60.0, burn_threshold=5.0)
+    evaluator = BurnRateEvaluator([slo])
+
+    def snap(t, fast_n, slow_n):
+        reg = MetricsRegistry()
+        h = reg.histogram("serve_request_ms")
+        for _ in range(fast_n):
+            h.observe(1.0)
+        for _ in range(slow_n):
+            h.observe(400.0)              # above the 10 ms cut
+        return {"t": float(t), "merged": reg.dump()}
+
+    events = []
+    for i in range(20):
+        # cumulative totals: all-slow traffic from the start
+        events.extend(evaluator.observe(snap(10.0 * i, 5 * (i + 1),
+                                             20 * (i + 1))))
+    assert any(e["event"] == "slo_burn" for e in events)
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError):
+        SLO("x", kind="latency")          # latency needs threshold_ms
+    with pytest.raises(ValueError):
+        SLO("x", kind="unknown")
+    with pytest.raises(ValueError):
+        SLO("x", objective=1.0)
+
+
+# --------------------------------------------------------------------------- #
+# Gauge-edge vs histogram cross-check (the batcher's identical-edge
+# contract)
+
+
+def test_queue_depth_gauge_stream_folds_to_depth_histogram(tmp_path):
+    """Every telemetry `serve_queue_depth` gauge edge pairs with a
+    `serve_queue_depth_dist` observation of the SAME value: folding the
+    recorded gauge stream into a fresh histogram reproduces the
+    registry histogram's bucket counts exactly."""
+    reg = MetricsRegistry(source="svc")
+    done = threading.Event()
+
+    def dispatch(cell, batch):
+        return batch
+
+    def resolve(handle, batch):
+        for r in handle:
+            r.future.set_result(None)
+        done.set()
+
+    telemetry = obs.activate(obs.Telemetry(tmp_path))
+    try:
+        batcher = MicroBatcher(dispatch, resolve, max_batch=4,
+                               max_delay=0.005, metrics=reg)
+        matrix = np.zeros((3, 8), np.float32)
+        futures = [batcher.submit(ServeRequest("cell", 3, matrix, None))
+                   for _ in range(12)]
+        for f in futures:
+            f.result(timeout=30)
+        done.wait(timeout=30)
+        batcher.close()
+    finally:
+        obs.deactivate()
+        telemetry.close()
+
+    depths = [r["value"] for r in obs.load_records(tmp_path)
+              if r.get("kind") == "gauge"
+              and r.get("name") == "serve_queue_depth"]
+    assert depths                                   # the stream exists
+    folded = Histogram("check", bounds=DEPTH_BOUNDS)
+    for depth in depths:
+        folded.observe(depth)
+    cell = reg.dump()["metrics"]["serve_queue_depth_dist"]
+    assert cell["counts"] == folded.snapshot()["counts"]
+    assert cell["count"] == len(depths)
+
+
+def test_health_monitor_edges_bump_metrics_counters():
+    reg = MetricsRegistry()
+    monitor = HealthMonitor(warmup=5, metrics=reg)
+    base = {"var_ratio": 0.5, "update_ratio": 1e-3, "weight_norm": 6.0}
+    # The non-finite rule is warmup-exempt: a planted burst is an
+    # anomaly edge, its clearance a cleared edge
+    monitor.update(1, dict(base, nonfinite=0))
+    monitor.update(2, dict(base, nonfinite=3))
+    monitor.update(3, dict(base, nonfinite=0))
+    assert reg.counter("health_anomaly_edges").value == 1
+    assert reg.counter("health_cleared_edges").value == 1
+
+
+# --------------------------------------------------------------------------- #
+# Quarantine-threshold calibration (`scripts/quarantine_rates.py`)
+
+
+def _edge(t, name, channel):
+    return {"t": t, "kind": "event", "name": name,
+            "data": {"channel": channel, "step": 1, "value": 1.0}}
+
+
+def test_anomaly_episode_folding_spans_channels():
+    """Overlapping channel edges fold into ONE monitor-level episode
+    (the heartbeat flag is up while ANY channel is anomalous); an
+    episode still open at stream end is persistent."""
+    records = [
+        _edge(10.0, "health_anomaly", "var_ratio"),
+        _edge(10.4, "health_anomaly", "weight_norm"),   # extends, no nest
+        _edge(10.8, "health_cleared", "var_ratio"),
+        _edge(11.2, "health_cleared", "weight_norm"),   # closes at 1.2 s
+        _edge(20.0, "health_anomaly", "update_ratio"),
+        _edge(20.3, "health_cleared", "update_ratio"),  # 0.3 s transient
+        _edge(30.0, "health_anomaly", "var_ratio"),     # never cleared
+    ]
+    episodes = quarantine_rates.anomaly_episodes(records)
+    assert episodes["persistent"] == 1
+    assert [round(d, 3) for d in episodes["cleared"]] == [0.3, 1.2]
+
+
+def test_recommendation_thresholds():
+    polls = quarantine_rates.episode_polls
+    assert polls(0.0, 0.2) == 1
+    assert polls(1.1, 0.2) == 6
+    # p95 of the cleared spans + one poll of margin, floored at 2
+    episodes = {"cleared": [0.3, 0.5, 1.1], "persistent": 1}
+    assert quarantine_rates.recommend_polls(episodes, 0.2) == 7
+    assert quarantine_rates.recommend_polls(
+        {"cleared": [], "persistent": 2}, 0.2) == quarantine_rates.FLOOR_POLLS
+    assert quarantine_rates.recommend_polls(
+        {"cleared": [], "persistent": 0}, 0.2) is None
+    rec = quarantine_rates.recommendation(episodes, 0.2)
+    assert rec["anomaly_polls"] == 7 and rec["basis"] == "fp_rate<=0.05"
+    assert rec["cost_per_sick_host_s"] == pytest.approx(1.4)
+
+
+def test_summarize_and_resolve_precedence(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    lines = [json.dumps(_edge(t0, "health_anomaly", "var_ratio"))
+             + "\n" + json.dumps(_edge(t1, "health_cleared", "var_ratio"))
+             for t0, t1 in ((10.0, 10.3), (20.0, 20.5), (30.0, 31.1))]
+    (run / "telemetry.jsonl").write_text("\n".join(lines) + "\n")
+    summary = quarantine_rates.summarize([run], poll_s=0.2)
+    assert summary["kind"] == "quarantine_rates"
+    assert summary["recommended_anomaly_polls"] == 7
+    rates_path = tmp_path / "rates.json"
+    rates_path.write_text(json.dumps(summary))
+
+    # Precedence: explicit flag > rates file > default
+    assert resolve_anomaly_polls(5, str(rates_path)) == (5, "flag")
+    assert resolve_anomaly_polls(None, str(rates_path)) == (
+        7, "quarantine-rates:fp_rate<=0.05")
+    assert resolve_anomaly_polls(None, None) == (DEFAULT_ANOMALY_POLLS,
+                                                 "default")
+    # Legacy top-level field (no recommendation block) still resolves
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"recommended_anomaly_polls": 4}))
+    polls, source = resolve_anomaly_polls(None, str(legacy))
+    assert polls == 4 and source.startswith("quarantine-rates:")
+    # An empty recommendation (no episodes observed) is an error, not a
+    # silent default
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps(
+        quarantine_rates.summarize([run / "absent"], poll_s=0.2)))
+    with pytest.raises(ValueError):
+        resolve_anomaly_polls(None, str(empty))
+
+
+# --------------------------------------------------------------------------- #
+# The bench_compare metrics-overhead gate
+
+
+def _metrics_artifact(tmp_path, name, *, on=100.0, off=102.0,
+                      overhead=0.02, within=True, smoke=False,
+                      backend="cpu", kind="metrics_overhead"):
+    payload = {"kind": kind, "backend": backend,
+               "agg_per_sec_metrics_on": on,
+               "agg_per_sec_metrics_off": off,
+               "overhead_frac": overhead, "bound_frac": 0.02,
+               "within_bound": within}
+    if smoke:
+        payload["smoke"] = True
+    path = tmp_path / name
+    path.write_text(json.dumps({"n": 1, "rc": 0, "parsed": payload}))
+    return path
+
+
+def test_compare_metrics_pass_and_overhead_regression(tmp_path, capsys):
+    old = _metrics_artifact(tmp_path, "old.json", overhead=0.010)
+    good = _metrics_artifact(tmp_path, "good.json", overhead=0.012)
+    # +20% relative but only +0.002 absolute: under the floor, passes
+    assert bench_compare.main([str(old), str(good),
+                               "--tolerance", "0.05"]) == 0
+    bad = _metrics_artifact(tmp_path, "bad.json", overhead=0.019)
+    assert bench_compare.main([str(old), str(bad),
+                               "--tolerance", "0.05"]) == 1
+    assert "overhead_frac" in capsys.readouterr().out
+
+
+def test_compare_metrics_rate_drop_and_bound_flip(tmp_path, capsys):
+    old = _metrics_artifact(tmp_path, "old.json")
+    slow = _metrics_artifact(tmp_path, "slow.json", on=80.0, off=82.0)
+    assert bench_compare.main([str(old), str(slow),
+                               "--tolerance", "0.05"]) == 1
+    flipped = _metrics_artifact(tmp_path, "flip.json", overhead=0.021,
+                                within=False)
+    # within_bound True -> False fails regardless of tolerance
+    assert bench_compare.main([str(old), str(flipped),
+                               "--tolerance", "0.5"]) == 1
+    out = capsys.readouterr().out
+    assert "within_bound" in out
+
+
+def test_compare_metrics_incomparable_cases(tmp_path, capsys):
+    old = _metrics_artifact(tmp_path, "old.json")
+    smoke = _metrics_artifact(tmp_path, "smoke.json", smoke=True)
+    assert bench_compare.main([str(old), str(smoke)]) == 0
+    other_backend = _metrics_artifact(tmp_path, "tpu.json", backend="tpu")
+    assert bench_compare.main([str(old), str(other_backend)]) == 0
+    serve = _metrics_artifact(tmp_path, "serve.json", kind="serve")
+    assert bench_compare.main([str(old), str(serve)]) == 0
+    assert capsys.readouterr().out.count("INCOMPARABLE") == 3
