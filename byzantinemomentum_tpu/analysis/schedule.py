@@ -65,6 +65,8 @@ __all__ = ["Scheduler", "SchedLock", "SchedCondition", "DeadlockError",
            "straggle_claim_unguarded_model", "straggle_claim_model",
            "metrics_scrape_torn_model", "metrics_scrape_model",
            "metrics_rotate_lost_model", "metrics_rotate_model",
+           "incident_bundle_torn_model", "incident_bundle_model",
+           "router_splice_lost_model", "router_splice_model",
            "selfcheck"]
 
 # A worker that fails to reach its next preemption point within this many
@@ -861,14 +863,121 @@ def metrics_rotate_model(sched):
     return [appender, rotator], check
 
 
+def incident_bundle_torn_model(sched):
+    """The PRE-fix incident index claim (`obs/trace/incident.py`): two
+    edge events capture concurrently, each reading the shared next-n
+    counter and bumping it as separate unlocked steps. Both read the
+    same n, both write `incident-<n>.json`, and `os.replace` makes the
+    second silently OVERWRITE the first — one incident's evidence
+    vanishes exactly when two incidents coincide, which is exactly when
+    the evidence matters (a burn edge and the arc death that caused it
+    land together). Serial orders pass; one preemption finds it."""
+    state = {"n": 1}
+    files = {}   # name -> reason (the os.replace'd directory)
+
+    def capture(reason):
+        def worker():
+            n = state["n"]            # read the claim...
+            sched.point()             # ... the other capture lands here
+            state["n"] = n + 1        # ... then bump and write
+            files[f"incident-{n}"] = reason
+        return worker
+
+    def check():
+        assert len(files) == 2, (
+            f"a bundle was overwritten: only {sorted(files)} survive "
+            f"({files})")
+
+    return [capture("slo_burn"), capture("arc_dead")], check
+
+
+def incident_bundle_model(sched):
+    """The SHIPPED pattern (`IncidentRecorder.capture`): the index is
+    claimed — read AND bump — inside the recorder lock BEFORE any I/O,
+    so concurrent captures hold distinct n and their atomic renames can
+    never collide on a filename. Exhaustively clean at the bound that
+    breaks the unlocked claim."""
+    lock = sched.lock()
+    state = {"n": 1}
+    files = {}
+
+    def capture(reason):
+        def worker():
+            with lock:
+                n = state["n"]
+                sched.point()
+                state["n"] = n + 1
+            files[f"incident-{n}"] = reason
+        return worker
+
+    def check():
+        assert len(files) == 2, (
+            f"a bundle was overwritten: only {sorted(files)} survive "
+            f"({files})")
+
+    return [capture("slo_burn"), capture("arc_dead")], check
+
+
+def router_splice_lost_model(sched):
+    """The PRE-fix splice ring (`FleetRouter._record_trace` before the
+    joined buffer): two connection threads append their joined records
+    to a shared bounded list with an UNLOCKED read-extend-store (the
+    `list + [record]` rebind pattern). An append landing between the
+    other thread's read and its store is dropped — a joined trace
+    silently vanishes from the window and the critical-path histogram
+    undercounts the convoy. Serial orders pass; one preemption finds
+    it."""
+    ring = {"records": []}
+
+    def splice(record):
+        def worker():
+            records = list(ring["records"])   # read...
+            sched.point()                     # ... the other splice lands
+            ring["records"] = records + [record]   # ... rebind loses it
+        return worker
+
+    def check():
+        assert len(ring["records"]) == 2, (
+            f"a joined record was lost: {ring['records']}")
+
+    return [splice("t1"), splice("t2")], check
+
+
+def router_splice_model(sched):
+    """The SHIPPED pattern (`TraceBuffer.add` under its internal lock —
+    the joined ring IS a TraceBuffer): append and the completed-count
+    bump happen atomically per record, so concurrent connection threads
+    each land their whole record and the count matches the ring.
+    Exhaustively clean at the bound that breaks the unlocked rebind."""
+    lock = sched.lock()
+    ring = {"records": [], "completed": 0}
+
+    def splice(record):
+        def worker():
+            with lock:
+                ring["records"].append(record)
+                sched.point()
+                ring["completed"] += 1
+        return worker
+
+    def check():
+        assert len(ring["records"]) == 2, (
+            f"a joined record was lost: {ring['records']}")
+        assert ring["completed"] == 2, (
+            f"completed count diverged: {ring['completed']}")
+
+    return [splice("t1"), splice("t2")], check
+
+
 def selfcheck(max_preemptions=3):
     """The lint-tier schedule smoke: every planted bug — the serve
     counter lost-update, the two router races (lost forward, double
-    disposition), the straggle-window claim race and the two
-    metrics-plane races (torn scrape, rotation-lost append) — must be
-    FOUND within the preemption bound, and every fixed pattern must
-    survive the same exhaustive exploration clean. Returns a JSON-safe
-    report with `ok`."""
+    disposition), the straggle-window claim race, the two metrics-plane
+    races (torn scrape, rotation-lost append) and the two r19 causal-
+    plane races (torn incident bundle, lost splice) — must be FOUND
+    within the preemption bound, and every fixed pattern must survive
+    the same exhaustive exploration clean. Returns a JSON-safe report
+    with `ok`."""
     t0 = time.monotonic()
     broken = explore(lost_update_model, max_preemptions=max_preemptions)
     fixed = explore(fixed_counter_model, max_preemptions=max_preemptions)
@@ -892,18 +1001,30 @@ def selfcheck(max_preemptions=3):
                      max_preemptions=max_preemptions)
     m_rotate = explore(metrics_rotate_model,
                        max_preemptions=max_preemptions)
+    i_torn = explore(incident_bundle_torn_model,
+                     max_preemptions=max_preemptions)
+    i_bundle = explore(incident_bundle_model,
+                       max_preemptions=max_preemptions)
+    j_lost = explore(router_splice_lost_model,
+                     max_preemptions=max_preemptions)
+    j_splice = explore(router_splice_model,
+                       max_preemptions=max_preemptions)
     router_fixed_clean = (r_queue.ok and r_queue.exhausted
                           and r_single.ok and r_single.exhausted)
     straggle_fixed_clean = s_claim.ok and s_claim.exhausted
     metrics_fixed_clean = (m_scrape.ok and m_scrape.exhausted
                            and m_rotate.ok and m_rotate.exhausted)
+    incident_fixed_clean = (i_bundle.ok and i_bundle.exhausted
+                            and j_splice.ok and j_splice.exhausted)
     return {
         "ok": (bool(broken.failures) and fixed.ok and fixed.exhausted
                and bool(r_lost.failures) and bool(r_double.failures)
                and router_fixed_clean
                and bool(s_unguarded.failures) and straggle_fixed_clean
                and bool(m_torn.failures) and bool(m_lost.failures)
-               and metrics_fixed_clean),
+               and metrics_fixed_clean
+               and bool(i_torn.failures) and bool(j_lost.failures)
+               and incident_fixed_clean),
         "lost_update_found": bool(broken.failures),
         "witness": broken.failures[0].schedule if broken.failures else None,
         "schedules_prefix": broken.runs,
@@ -932,12 +1053,23 @@ def selfcheck(max_preemptions=3):
         "metrics_fixed_clean": metrics_fixed_clean,
         "schedules_metrics": (m_torn.runs + m_scrape.runs + m_lost.runs
                               + m_rotate.runs),
+        "incident_bundle_torn_found": bool(i_torn.failures),
+        "incident_bundle_torn_witness": (i_torn.failures[0].schedule
+                                         if i_torn.failures else None),
+        "router_splice_lost_found": bool(j_lost.failures),
+        "router_splice_lost_witness": (j_lost.failures[0].schedule
+                                       if j_lost.failures else None),
+        "incident_fixed_clean": incident_fixed_clean,
+        "schedules_incident": (i_torn.runs + i_bundle.runs + j_lost.runs
+                               + j_splice.runs),
         "exhausted": (broken.exhausted and fixed.exhausted
                       and r_lost.exhausted and r_double.exhausted
                       and r_queue.exhausted and r_single.exhausted
                       and s_unguarded.exhausted and s_claim.exhausted
                       and m_torn.exhausted and m_scrape.exhausted
-                      and m_lost.exhausted and m_rotate.exhausted),
+                      and m_lost.exhausted and m_rotate.exhausted
+                      and i_torn.exhausted and i_bundle.exhausted
+                      and j_lost.exhausted and j_splice.exhausted),
         "max_preemptions": max_preemptions,
         "seconds": round(time.monotonic() - t0, 3),
     }

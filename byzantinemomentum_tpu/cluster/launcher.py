@@ -500,6 +500,27 @@ def main(argv=None):
     clock = ClockOffsetTracker()
     last_status = {}
 
+    # Incident bundles (obs/trace/incident.py): a straggler KILL is a
+    # fleet edge event — freeze the evidence (registry dump, liveness
+    # view + clock offsets, membership version) the moment the policy
+    # pulls the trigger, not minutes later when someone reads the log.
+    # trigger() is enqueue-only, so the supervision loop never blocks
+    # on bundle I/O; the worker writes incidents/incident-<n>.json
+    # atomically and obs_report replays the causal story
+    from byzantinemomentum_tpu.obs.trace import (IncidentRecorder,
+                                                 merge_fleet_incidents)
+    incidents = IncidentRecorder(
+        resdir, source="cluster-launcher",
+        providers={
+            "metrics": metrics.dump,
+            "liveness": lambda: {"hosts": dict(last_status),
+                                 "clock_offsets": clock.estimate()},
+            "membership": lambda: (
+                {"version": membership.version,
+                 "hosts": sorted(membership.shards)}
+                if membership is not None else {"elastic": False}),
+        }).start()
+
     def observe_view(view, now):
         counts = dict.fromkeys(m_hosts, 0)
         for host, row in view["hosts"].items():
@@ -557,6 +578,10 @@ def main(argv=None):
                         "straggler_" + ev["event"],
                         **{k: v for k, v in ev.items() if k != "event"})
                     if ev["event"] == "kill":
+                        incidents.trigger(
+                            "straggler_kill",
+                            **{k: v for k, v in ev.items()
+                               if k != "event"})
                         if resumer is not None:
                             # Claim any pending SIGCONT first: a killed
                             # host must never be resumed
@@ -777,6 +802,8 @@ def main(argv=None):
     if endpoint is not None:
         endpoint.shutdown()
         endpoint.server_close()
+    incidents.stop()
+    merge_fleet_incidents(resdir)  # host bundles -> incidents/fleet.json
     telem.close()
     final_status = {"ok": "completed"}.get(status, status)
     final_beat = {

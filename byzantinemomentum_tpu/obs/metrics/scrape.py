@@ -143,13 +143,18 @@ class MetricsScraper:
     the selfcheck drive it deterministically without the thread."""
 
     def __init__(self, targets, directory, *, interval=2.0, local=None,
-                 evaluator=None, max_lines=DEFAULT_MAX_LINES,
-                 timeout=5.0):
+                 evaluator=None, on_event=None,
+                 max_lines=DEFAULT_MAX_LINES, timeout=5.0):
         self.targets = dict(targets)
         self.directory = pathlib.Path(directory)
         self.interval = float(interval)
         self.local = local
         self.evaluator = evaluator
+        # `on_event(name, event)` observes each evaluator edge (r19:
+        # the launcher hangs incident-bundle capture here). Called on
+        # the scraper thread, outside the scraper lock; exceptions are
+        # swallowed — an observer must not take the scrape loop down.
+        self.on_event = on_event
         self.max_lines = int(max_lines)
         self.timeout = float(timeout)
         self.scrapes = 0
@@ -185,7 +190,13 @@ class MetricsScraper:
             self.last_snapshot = snapshot
         if self.evaluator is not None and merged is not None:
             for event in self.evaluator.observe(snapshot):
-                recorder.emit(event.pop("event"), **event)
+                name = event.pop("event")
+                recorder.emit(name, **event)
+                if self.on_event is not None:
+                    try:
+                        self.on_event(name, event)
+                    except Exception:  # bmt: noqa[BMT-E05] an edge observer (incident capture) must not kill the scrape loop every target depends on
+                        pass
         return snapshot
 
     def _loop(self):

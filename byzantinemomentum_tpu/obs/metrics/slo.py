@@ -136,12 +136,16 @@ def _totals(snapshot, slo):
     return total, bad
 
 
-def window_rates(history, slo, now):
+def window_rates(history, slo, now, *, detail=False):
     """`{fast: burn | None, slow: burn | None}` over a snapshot history
     (oldest first). Each window's burn is the bad/total DELTA rate
     between `now` and the oldest in-window snapshot, divided by the
-    budget; None when the window has no earlier edge or no traffic."""
+    budget; None when the window has no earlier edge or no traffic.
+    With `detail=True` returns `(burns, deltas)` where deltas carries
+    each window's raw `{total, bad, span_s}` — the evidence an
+    incident bundle wants next to the burn number (r19)."""
     burns = {}
+    deltas = {}
     for label, window in (("fast", slo.fast_s), ("slow", slo.slow_s)):
         edge = None
         for snapshot in history:
@@ -151,15 +155,19 @@ def window_rates(history, slo, now):
         latest = history[-1] if history else None
         if edge is None or latest is None or edge is latest:
             burns[label] = None
+            deltas[label] = None
             continue
         total0, bad0 = _totals(edge, slo)
         total1, bad1 = _totals(latest, slo)
         d_total, d_bad = total1 - total0, bad1 - bad0
+        deltas[label] = {"total": d_total, "bad": max(d_bad, 0),
+                         "span_s": round(
+                             now - float(edge.get("t", 0.0)), 3)}
         if d_total <= 0:
             burns[label] = None
             continue
         burns[label] = (max(d_bad, 0) / d_total) / slo.budget
-    return burns
+    return (burns, deltas) if detail else burns
 
 
 class BurnRateEvaluator:
@@ -187,7 +195,8 @@ class BurnRateEvaluator:
             self._history.pop(0)
         events = []
         for slo in self.slos:
-            burns = window_rates(self._history, slo, now)
+            burns, deltas = window_rates(self._history, slo, now,
+                                         detail=True)
             fast, slow = burns["fast"], burns["slow"]
             firing = (fast is not None and slow is not None
                       and fast > slo.burn_threshold
@@ -196,10 +205,17 @@ class BurnRateEvaluator:
             if firing and not was:
                 self._alerting[slo.name] = True
                 self.burn_events += 1
+                # The burn edge carries its window deltas (raw bad/total
+                # counts behind each burn number): when the edge
+                # triggers an incident bundle, the evidence that tripped
+                # the alert rides inside the bundle's `data` instead of
+                # needing a metrics-history replay.
                 events.append({"event": "slo_burn", "slo": slo.name,
                                "burn_fast": round(fast, 3),
                                "burn_slow": round(slow, 3),
-                               "threshold": slo.burn_threshold, "t": now})
+                               "threshold": slo.burn_threshold,
+                               "window_fast": deltas["fast"],
+                               "window_slow": deltas["slow"], "t": now})
             elif was and not firing:
                 self._alerting[slo.name] = False
                 self.ok_events += 1

@@ -146,6 +146,18 @@ def render_report(run_dir):
     if fleet_lines:
         lines.extend(fleet_lines)
 
+    # Incident bundles (obs/trace/incident.py): every SLO-burn /
+    # arc-death / failover / straggler-kill capture in the directory
+    # (process-local `incidents/` plus per-shard and per-host trees),
+    # each replayed into its ordered causal story — burn edge ->
+    # dominant hop -> membership — with the evidence cells it froze.
+    # Rendered before the telemetry early-return: a fleet resdir holds
+    # bundles without any top-level telemetry.jsonl
+    from byzantinemomentum_tpu.obs.trace import render_incidents
+    incident_lines = render_incidents(run_dir)
+    if incident_lines:
+        lines.extend(incident_lines)
+
     if not records:
         # A telemetry-less directory can still hold a flight recording
         # (e.g. a --no-telemetry run's blackbox): render it standalone

@@ -22,6 +22,20 @@ discipline to the two surfaces that grew past it:
   run is the offset bound), with restarts, fired faults and liveness
   transitions as first-class timeline events. `obs_report` and
   `study.py` render it as the one-page fleet health view.
+* **incident** (`incident.py`, r19) — SLO-triggered incident bundles:
+  an edge event (`slo_burn`, router arc death/failover, a straggler
+  kill) triggers an atomic snapshot of the evidence already resident in
+  the process — trace ring, metrics-window deltas, health blackbox,
+  membership version — into `incidents/incident-<n>.json`;
+  `merge_fleet_incidents` folds the per-process bundles into one
+  fleet-scope index, and `obs_report` replays each bundle into the
+  ordered causal story (burn edge → dominant hop → arc event).
+
+The cross-process span join (`join_shard_trace`) lives in `request.py`:
+a shard's wire trace record nests clock-free inside the fleet router's
+measured envelope, turning the opaque `shard_rtt` lump into per-hop
+columns (`JOINED_HOPS`) with `dominant_hop` naming each trace's
+critical path.
 
 Import discipline: stdlib only at module scope (the obs contract) —
 host-only consumers (the report, the launcher, test harnesses) never
@@ -29,12 +43,22 @@ initialize an accelerator backend through this package.
 """
 
 from byzantinemomentum_tpu.obs.trace.request import (  # noqa: F401
+    JOINED_HOPS,
     REQUEST_PHASES,
     ROUTER_PHASES,
     RequestTrace,
     TraceBuffer,
+    dominant_hop,
+    join_shard_trace,
     percentile,
     phase_spans,
+)
+from byzantinemomentum_tpu.obs.trace.incident import (  # noqa: F401
+    INCIDENTS_DIRNAME,
+    IncidentRecorder,
+    load_incidents,
+    merge_fleet_incidents,
+    render_incidents,
 )
 from byzantinemomentum_tpu.obs.trace.fleet import (  # noqa: F401
     FLEET_TIMELINE_EVENTS,
@@ -46,8 +70,11 @@ from byzantinemomentum_tpu.obs.trace.fleet import (  # noqa: F401
 )
 
 __all__ = [
-    "REQUEST_PHASES", "ROUTER_PHASES", "RequestTrace", "TraceBuffer",
-    "percentile", "phase_spans",
+    "JOINED_HOPS", "REQUEST_PHASES", "ROUTER_PHASES", "RequestTrace",
+    "TraceBuffer", "dominant_hop", "join_shard_trace", "percentile",
+    "phase_spans",
     "FLEET_TIMELINE_EVENTS", "ClockOffsetTracker", "estimate_offsets",
     "fleet_timeline", "load_fleet", "render_fleet_report",
+    "INCIDENTS_DIRNAME", "IncidentRecorder", "load_incidents",
+    "merge_fleet_incidents", "render_incidents",
 ]

@@ -1,0 +1,409 @@
+"""SLO-triggered incident bundles: one atomic evidence file per edge.
+
+The fleet already *has* the evidence when something goes wrong — the
+router's joined trace ring knows the dominant hop, the metrics scraper
+holds the window deltas that tripped the burn alert, the health
+blackbox and the versioned membership file know which arc moved — but
+it is scattered across per-process rings that keep rotating after the
+incident. By the time a human looks, the interesting window fell off
+the buffers. An `IncidentRecorder` fixes the decay: an edge event
+(`slo_burn` from `obs/metrics/slo.py`, router arc death, a failover
+restart, a straggler `KILLED`) triggers a capture that snapshots every
+registered context provider and writes the lot as ONE atomic
+`incidents/incident-<n>.json` bundle — the flight recorder dump, taken
+at the instant of the edge, per process.
+
+Design points, in the order they bit:
+
+* **Triggers must be free.** The router liveness hook runs UNDER the
+  router lock; a burn edge fires on the scraper thread mid-scrape.
+  `trigger()` therefore only enqueues (a `queue.Queue.put`) and a
+  daemon worker does the slow part — calling providers and fsyncing the
+  bundle — strictly outside every caller lock.
+* **Bundles are atomic and torn-tolerant.** Writes go through the
+  heartbeat door (same-directory tmp → flush → fsync → `os.replace`),
+  so a SIGKILL mid-write leaves whole bundles plus at most one orphan
+  `.tmp` that `load_incidents` never reads. The reader still
+  `json.loads` defensively and skips anything unparsable — readers
+  never trust writers here.
+* **The index is claimed under a lock.** Two concurrent captures must
+  not both write `incident-<n>.json` for the same n (one bundle would
+  silently vanish under `os.replace`) — the torn-bundle-write
+  interleaving in `analysis/schedule.py::incident_bundle_model`, fixed
+  by claiming `n` inside `_lock` before any I/O.
+* **Evidence gathering never takes the fleet down.** A provider that
+  raises contributes an `{"error": ...}` cell instead of killing the
+  capture; the worker survives any single bad bundle.
+* **Bounded, rate-limited.** A flapping burn edge cannot fill the disk:
+  per-reason cooldown drops repeat captures inside `cooldown_s`, and
+  the directory is a ring (`limit` newest bundles survive) like every
+  other on-disk artifact in this repo.
+
+Fleet scope: each process (launcher, every shard, the cluster
+launcher) writes its OWN bundles under its result directory —
+evidence-locality, the Ray-annotation discipline. At teardown the
+launcher folds them into one ordered `incidents/fleet.json` index
+(`merge_fleet_incidents`), and `obs_report` (`render_incidents`)
+replays any bundle into the ordered causal story: burn edge → dominant
+hop → arc/membership transition.
+
+Stdlib only (the obs import discipline).
+"""
+
+import json
+import os
+import pathlib
+import queue
+import threading
+import time
+
+__all__ = ["INCIDENTS_DIRNAME", "IncidentRecorder", "load_incidents",
+           "merge_fleet_incidents", "render_incidents"]
+
+INCIDENTS_DIRNAME = "incidents"
+FLEET_INDEX_NAME = "fleet.json"
+
+
+class IncidentRecorder:
+    """Edge-triggered capture of atomic evidence bundles.
+
+    Args:
+      directory: the process's result directory; bundles land in
+        `<directory>/incidents/incident-<n>.json`.
+      providers: {context name: zero-arg callable} — each capture calls
+        every provider and stores its JSON-safe return under
+        `context[name]` (an exception becomes an `{"error": ...}`
+        cell). Typical providers: the trace-ring summary, the metrics
+        window, the health blackbox, the membership version.
+      limit: directory ring size — oldest bundles past it are deleted.
+      cooldown_s: minimum seconds between captures of the SAME reason
+        (a flapping edge dedupes to one bundle per window; drops count
+        in `dropped`).
+      source: stamped into each bundle (e.g. "launcher", "shard-2") so
+        the fleet merge can attribute evidence to its process.
+    """
+
+    def __init__(self, directory, *, providers=None, limit=64,
+                 cooldown_s=1.0, source=None):
+        if limit < 1:
+            raise ValueError(f"Expected limit >= 1, got {limit}")
+        self.directory = pathlib.Path(directory) / INCIDENTS_DIRNAME
+        self.providers = dict(providers or {})
+        self.limit = int(limit)
+        self.cooldown_s = float(cooldown_s)
+        self.source = str(source) if source is not None else None
+        self.captured = 0
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._n = self._next_index()
+        self._last = {}   # reason -> monotonic time of last capture
+        self._queue = queue.Queue()
+        self._thread = None
+
+    def _next_index(self):
+        """Resume numbering past any bundle a previous incarnation of
+        this process left behind (restarts must not overwrite
+        evidence)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 1
+        highest = 0
+        for name in names:
+            if name.startswith("incident-") and name.endswith(".json"):
+                stem = name[len("incident-"):-len(".json")]
+                if stem.isdigit():
+                    highest = max(highest, int(stem))
+        return highest + 1
+
+    # -------------------------------------------------------------- #
+    # the trigger side (any thread, any lock context)
+
+    def start(self):
+        """Start the capture worker. Idempotent; returns self."""
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="incident-capture",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def trigger(self, reason, **data):
+        """Request one capture. NON-BLOCKING and lock-free on the
+        caller side — safe from the router's liveness hook (which runs
+        under the router lock) and from the scraper thread. The worker
+        snapshots the providers and writes the bundle."""
+        self._queue.put((str(reason), data, time.time()))
+
+    def _loop(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            reason, data, t = item
+            try:
+                self.capture(reason, data, t=t)
+            except Exception:  # bmt: noqa[BMT-E05] evidence capture must outlive any single bad bundle — the worker serves every future edge
+                pass
+
+    # -------------------------------------------------------------- #
+    # the capture side (worker thread; public for deterministic tests)
+
+    def capture(self, reason, data=None, t=None):
+        """Snapshot every provider and write one atomic bundle.
+        Synchronous — tests and the selfcheck call it directly to skip
+        the worker thread. Returns the bundle path, or None when the
+        reason is inside its cooldown window."""
+        reason = str(reason)
+        now = time.monotonic()
+        with self._lock:
+            last = self._last.get(reason)
+            if last is not None and now - last < self.cooldown_s:
+                self.dropped += 1
+                return None
+            self._last[reason] = now
+            # Claim the index BEFORE any I/O: concurrent captures with
+            # distinct n can never collide on a filename, so no bundle
+            # silently vanishes under os.replace (the
+            # incident_bundle_model interleaving)
+            n = self._n
+            self._n += 1
+        context = {}
+        for name, provider in sorted(self.providers.items()):
+            try:
+                context[name] = provider()
+            except Exception as err:  # bmt: noqa[BMT-E05] one broken provider forfeits its cell, not the whole bundle — and never the process that triggered
+                context[name] = {"error": f"{type(err).__name__}: {err}"}
+        bundle = {
+            "kind": "incident",
+            "n": n,
+            "t": time.time() if t is None else float(t),
+            "reason": reason,
+            "data": dict(data or {}),
+            "context": context,
+        }
+        if self.source is not None:
+            bundle["source"] = self.source
+        self.directory.mkdir(parents=True, exist_ok=True)
+        path = self.directory / f"incident-{n}.json"
+        # The heartbeat door: same-directory tmp, fsync, atomic rename.
+        # A SIGKILL at any instant leaves whole bundles + at most one
+        # orphan tmp the loader never reads.
+        tmp = path.with_name(path.name + ".tmp")
+        with tmp.open("w", encoding="utf-8") as fd:
+            fd.write(json.dumps(bundle, ensure_ascii=False, indent=1))
+            fd.write("\n")
+            fd.flush()
+            os.fsync(fd.fileno())
+        os.replace(tmp, path)
+        with self._lock:
+            self.captured += 1
+        self._prune()
+        return path
+
+    def _prune(self):
+        """Ring the directory: delete the oldest bundles past `limit`."""
+        try:
+            names = [name for name in os.listdir(self.directory)
+                     if name.startswith("incident-")
+                     and name.endswith(".json")
+                     and name[len("incident-"):-len(".json")].isdigit()]
+        except OSError:
+            return
+        if len(names) <= self.limit:
+            return
+        names.sort(key=lambda s: int(s[len("incident-"):-len(".json")]))
+        for name in names[:len(names) - self.limit]:
+            try:
+                os.unlink(self.directory / name)
+            except OSError:
+                pass
+
+    def stop(self, timeout=5.0):
+        """Drain queued triggers, stop the worker. Idempotent."""
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def summary(self):
+        with self._lock:
+            return {"captured": self.captured, "dropped": self.dropped,
+                    "next_n": self._n, "limit": self.limit}
+
+
+# ------------------------------------------------------------------ #
+# fleet-scope reading / merging
+
+
+def _bundle_dirs(run_dir, fleet=True):
+    run_dir = pathlib.Path(run_dir)
+    dirs = [run_dir / INCIDENTS_DIRNAME]
+    if fleet:
+        dirs += sorted(run_dir.glob(f"shards/*/{INCIDENTS_DIRNAME}"))
+        dirs += sorted(run_dir.glob(f"hosts/*/{INCIDENTS_DIRNAME}"))
+    return dirs
+
+
+def load_incidents(run_dir, *, fleet=True):
+    """Every readable bundle under a run directory, ordered by
+    (wall time, index). `fleet=True` also crawls per-process
+    subdirectories (`shards/*/incidents`, `hosts/*/incidents`), tagging
+    each bundle with its process when the writer didn't. Torn or
+    half-written files are skipped — the atomic writer makes them
+    near-impossible, but a reader never trusts that."""
+    bundles = []
+    run_dir = pathlib.Path(run_dir)
+    for directory in _bundle_dirs(run_dir, fleet):
+        if not directory.is_dir():
+            continue
+        source = (directory.parent.name
+                  if directory.parent != run_dir else "launcher")
+        for path in directory.glob("incident-*.json"):
+            try:
+                bundle = json.loads(path.read_text(encoding="utf-8"))
+            except (OSError, ValueError):
+                continue   # torn / unreadable: skip, never raise
+            if not isinstance(bundle, dict):
+                continue
+            bundle.setdefault("source", source)
+            bundles.append(bundle)
+    bundles.sort(key=lambda b: (_num(b.get("t")), _num(b.get("n"))))
+    return bundles
+
+
+def _num(value, default=0.0):
+    return float(value) if isinstance(value, (int, float)) else default
+
+
+def merge_fleet_incidents(run_dir):
+    """Launcher-side fleet merge: fold every per-process bundle into
+    one ordered `incidents/fleet.json` index (atomic replace) so
+    fleet-scope tooling reads one file instead of crawling process
+    directories. Each row keeps the bundle headline — reason, source,
+    time, the edge data, and the dominant hop if the trace context
+    names one. Returns the index path, or None when no bundles
+    exist."""
+    bundles = load_incidents(run_dir, fleet=True)
+    if not bundles:
+        return None
+    rows = []
+    for bundle in bundles:
+        row = {"n": bundle.get("n"), "t": bundle.get("t"),
+               "reason": bundle.get("reason"),
+               "source": bundle.get("source"),
+               "data": bundle.get("data") or {}}
+        hop = _dominant_from_bundle(bundle)
+        if hop is not None:
+            row["dominant_hop"] = hop
+        rows.append(row)
+    directory = pathlib.Path(run_dir) / INCIDENTS_DIRNAME
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / FLEET_INDEX_NAME
+    payload = {"kind": "incident_index", "incidents": len(rows),
+               "rows": rows}
+    tmp = path.with_name(path.name + ".tmp")
+    with tmp.open("w", encoding="utf-8") as fd:
+        fd.write(json.dumps(payload, ensure_ascii=False, indent=1))
+        fd.write("\n")
+        fd.flush()
+        os.fsync(fd.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+# ------------------------------------------------------------------ #
+# the report side: replay a bundle into the ordered causal story
+
+
+def _critical_path_of(block):
+    """Find a critical_path histogram anywhere useful in a trace
+    context block (router stats carry it under `joined`)."""
+    if not isinstance(block, dict):
+        return None
+    for candidate in (block.get("joined"), block.get("tracing"), block):
+        if (isinstance(candidate, dict)
+                and isinstance(candidate.get("critical_path"), dict)
+                and candidate["critical_path"]):
+            return candidate["critical_path"]
+    return None
+
+
+def _dominant_from_bundle(bundle):
+    critical = _critical_path_of((bundle.get("context") or {}).get("trace"))
+    if not critical:
+        return None
+    return max(critical, key=lambda hop: _num(critical.get(hop)))
+
+
+def _story(bundle):
+    """One bundle → the ordered causal story line:
+    edge event → dominant hop → arc/membership transition."""
+    data = bundle.get("data") or {}
+    context = bundle.get("context") or {}
+    reason = str(bundle.get("reason", "?"))
+    if reason == "slo_burn":
+        edge = (f"slo_burn[{data.get('slo', '?')}] "
+                f"fast={_num(data.get('burn_fast')):.2f} "
+                f"slow={_num(data.get('burn_slow')):.2f}")
+    elif reason in ("arc_dead", "failover"):
+        edge = f"{reason}[{data.get('shard', '?')}]"
+    elif reason == "straggler_kill":
+        edge = (f"straggler_kill[{data.get('host', '?')}] "
+                f"{data.get('straggler_reason', data.get('why', ''))}"
+                ).rstrip()
+    else:
+        edge = reason
+    parts = [edge]
+    critical = _critical_path_of(context.get("trace"))
+    if critical:
+        hop = max(critical, key=lambda h: _num(critical.get(h)))
+        total = sum(int(_num(v)) for v in critical.values())
+        parts.append(f"dominant hop {hop} ({int(_num(critical[hop]))}"
+                     f"/{total} traces)")
+    membership = context.get("membership")
+    if isinstance(membership, dict) and membership:
+        dead = membership.get("dead")
+        arc = (f"membership v{membership.get('version', '?')}"
+               + (f" dead={list(dead)}" if dead else " all arcs alive"))
+        parts.append(arc)
+    return " -> ".join(parts)
+
+
+def render_incidents(run_dir, *, limit=8):
+    """The `obs_report` incidents section: every bundle of the run
+    (newest `limit`), each replayed into its one-line causal story plus
+    the evidence cells the bundle captured. Returns a list of lines
+    (empty when the run recorded no incidents)."""
+    bundles = load_incidents(run_dir, fleet=True)
+    if not bundles:
+        return []
+    t0 = _num(bundles[0].get("t"))
+    sources = {}
+    for bundle in bundles:
+        source = str(bundle.get("source", "?"))
+        sources[source] = sources.get(source, 0) + 1
+    lines = [f"incidents: {len(bundles)} bundle"
+             f"{'s' if len(bundles) != 1 else ''} ("
+             + ", ".join(f"{n} {src}" for src, n in sorted(sources.items()))
+             + ")"]
+    for bundle in bundles[-limit:]:
+        n = bundle.get("n", "?")
+        source = bundle.get("source", "?")
+        dt = _num(bundle.get("t")) - t0
+        lines.append(f"  incident-{n} [{source}] t+{dt:.1f}s "
+                     f"{bundle.get('reason', '?')}")
+        lines.append(f"    story: {_story(bundle)}")
+        context = bundle.get("context") or {}
+        missing = [name for name, cell in sorted(context.items())
+                   if isinstance(cell, dict) and "error" in cell]
+        present = [name for name in sorted(context)
+                   if name not in missing]
+        if present:
+            lines.append(f"    evidence: {', '.join(present)}"
+                         + (f" (failed: {', '.join(missing)})"
+                            if missing else ""))
+    if len(bundles) > limit:
+        lines.append(f"  ... {len(bundles) - limit} older bundle(s) "
+                     f"not shown")
+    return lines

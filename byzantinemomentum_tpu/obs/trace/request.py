@@ -43,6 +43,14 @@ feeds per-phase `serve_phase_<name>_ms` histograms — the ring summary
 is a 512-trace window, the histograms are the process-lifetime
 distribution the fleet scraper merges. Stdlib only: the obs import
 discipline (no jax, no numpy) keeps every consumer host-only.
+
+r19 adds the fleet-scope causal join: `join_shard_trace` splices a
+shard's wire trace record (the `"trace"` key riding every response
+since PR 13) into the router-measured envelope — clock-free, because
+the shard contributes DURATIONS that nest under the router's
+`shard_rtt`, never cross-host timestamps — and `dominant_hop` names
+each trace's critical path, aggregated per-window by `summary()` and
+process-lifetime by the `serve_critical_path_<hop>` registry counters.
 """
 
 import collections
@@ -52,8 +60,9 @@ import time
 
 from byzantinemomentum_tpu.obs.metrics.registry import LATENCY_MS_BOUNDS
 
-__all__ = ["REQUEST_PHASES", "ROUTER_PHASES", "RequestTrace",
-           "TraceBuffer", "percentile", "phase_spans"]
+__all__ = ["JOINED_HOPS", "REQUEST_PHASES", "ROUTER_PHASES",
+           "RequestTrace", "TraceBuffer", "dominant_hop",
+           "join_shard_trace", "percentile", "phase_spans"]
 
 # Span names in causal order: (phase, start stamp, end stamp). The first
 # two phases precede the queue hand-off and are absent when the caller
@@ -83,6 +92,33 @@ ROUTER_PHASES = (
     ("shard_rtt", "routed", "reply"),
 )
 
+# Hop columns of a JOINED router+shard trace (`join_shard_trace`), in
+# causal order. `route` is router-measured; `parked` is the dead-arc
+# park window the forwarder stamps on replayed lines (r19); every
+# `shard_*`/service hop is the shard's own monotonic duration spliced
+# out of the wire record; `wire_residual` is what remains of the
+# router-measured `shard_rtt` after the nested spans — forward/reply
+# wire time plus the router's connection-queue wait, the only hop
+# nobody times directly.
+JOINED_HOPS = ("route", "parked", "wire_residual", "shard_frontend",
+               "shard_queue", "pack", "dispatch", "resolver_wake",
+               "device", "resolve")
+
+# Shard-record phase -> joined hop column. `parse`+`validate` (frontend
+# decode + admission) fold into one `shard_frontend` hop; `queue`
+# surfaces as `shard_queue` — THE column the zipf hot-arc convoy lives
+# in, opaque inside `shard_rtt` before r19.
+_SHARD_HOP = {
+    "parse": "shard_frontend",
+    "validate": "shard_frontend",
+    "queue": "shard_queue",
+    "pack": "pack",
+    "dispatch": "dispatch",
+    "resolver_wake": "resolver_wake",
+    "device": "device",
+    "resolve": "resolve",
+}
+
 
 def phase_spans(stamps, phases):
     """{phase: ms} over a plain stamp dict for the given (phase, start,
@@ -97,6 +133,82 @@ def phase_spans(stamps, phases):
             return None
         spans[phase] = max(0.0, (t1 - t0) * 1000.0)
     return spans
+
+def dominant_hop(spans):
+    """The largest span of a {name: ms} dict (the trace's critical
+    path, hop-granular). Ties break to the earliest-inserted name so
+    the answer is deterministic; None on an empty dict."""
+    best, best_ms = None, -1.0
+    for name, ms in spans.items():
+        if ms > best_ms:
+            best, best_ms = name, ms
+    return best
+
+
+def join_shard_trace(stamps, shard_record):
+    """Splice a shard's wire trace record into the router-measured
+    envelope — the cross-process span join.
+
+    Clock-free by construction: the shard's record carries DURATIONS
+    from its own monotonic clock, never timestamps, so no cross-host
+    clock comparison happens. The shard spans nest inside the
+    router-measured `shard_rtt`; what the nesting leaves over —
+
+        wire_residual = shard_rtt - parked - sum(shard spans)
+
+    — is forward/reply wire time plus the router's connection queue,
+    clamped >= 0 (a scheduler quantum can make the shard's own timers
+    sum past the envelope by microseconds). A `parked`/`unparked` stamp
+    pair (dead-arc replay, `--on-dead queue`) becomes its own hop so
+    failover recovery latency is attributed instead of polluting the
+    wire column.
+
+    Returns the joined record:
+
+        {"trace_id", "spans_ms": {hop: ms}, "total_ms", "dominant"}
+
+    whose spans TILE the router's recv→reply wall (same contract as the
+    service phases), or None when the router stamps are incomplete or
+    the shard record is absent/malformed (non-dict, non-numeric or
+    negative spans, no recognizable phase) — the caller degrades to the
+    r16 opaque `shard_rtt` without severing the line."""
+    router_spans = phase_spans(stamps, ROUTER_PHASES)
+    if router_spans is None or not isinstance(shard_record, dict):
+        return None
+    shard_spans = shard_record.get("spans_ms")
+    if not isinstance(shard_spans, dict):
+        return None
+    hops = {"route": router_spans["route"]}
+    parked_ms = 0.0
+    t0, t1 = stamps.get("parked"), stamps.get("unparked")
+    if t0 is not None and t1 is not None:
+        parked_ms = max(0.0, (t1 - t0) * 1000.0)
+    if parked_ms > 0.0:
+        hops["parked"] = parked_ms
+    nested = 0.0
+    recognized = False
+    for phase, ms in shard_spans.items():
+        hop = _SHARD_HOP.get(phase)
+        if hop is None:
+            continue   # unknown phases pass through (schema growth)
+        if not isinstance(ms, (int, float)) or ms < 0.0:
+            return None
+        recognized = True
+        hops[hop] = hops.get(hop, 0.0) + float(ms)
+        nested += float(ms)
+    if not recognized:
+        return None
+    hops["wire_residual"] = max(
+        0.0, router_spans["shard_rtt"] - parked_ms - nested)
+    record = {"spans_ms": {k: round(v, 4) for k, v in hops.items()},
+              "total_ms": round(max(0.0, (stamps["reply"] - stamps["recv"])
+                                    * 1000.0), 4),
+              "dominant": dominant_hop(hops)}
+    trace_id = shard_record.get("trace_id")
+    if isinstance(trace_id, str):
+        record["trace_id"] = trace_id
+    return record
+
 
 _ids = itertools.count(1)
 
@@ -233,6 +345,7 @@ class TraceBuffer:
         self._metrics = (metrics if metrics is not None
                          and getattr(metrics, "enabled", False) else None)
         self._phase_hists = {}
+        self._crit_counters = {}
 
     def _observe_phases(self, trace):
         spans = (trace.spans_ms() if isinstance(trace, RequestTrace)
@@ -244,6 +357,20 @@ class TraceBuffer:
                     f"serve_phase_{phase}_ms", bounds=LATENCY_MS_BOUNDS)
                 self._phase_hists[phase] = hist
             hist.observe(ms)
+        # Critical-path extraction (r19): count the dominant phase onto
+        # the registry so a scrape answers "where is the convoy" live
+        # without replaying the ring
+        hop = dominant_hop(spans)
+        if hop is not None:
+            counter = self._crit_counters.get(hop)
+            if counter is None:
+                # Registry `_get` is idempotent under its own lock, so a
+                # concurrent first-observe races only on this cache slot
+                # (last-wins with the SAME handle — benign)
+                counter = self._metrics.counter(
+                    f"serve_critical_path_{hop}")
+                self._crit_counters[hop] = counter
+            counter.inc()
 
     def add(self, trace):
         """Append one completed `RequestTrace` (or prebuilt record)."""
@@ -299,4 +426,17 @@ class TraceBuffer:
                       if isinstance(r.get(key), (int, float))]
             if values:
                 out[label] = _dist(values)
+        # Critical-path histogram over the window: how many traces each
+        # hop/phase dominated. Joined records carry `dominant`
+        # pre-computed (the router names it at splice time); plain
+        # service records derive it here so the section exists for both.
+        critical = {}
+        for record in records:
+            hop = record.get("dominant") or dominant_hop(
+                record.get("spans_ms") or {})
+            if hop is not None:
+                critical[hop] = critical.get(hop, 0) + 1
+        if critical:
+            out["critical_path"] = dict(
+                sorted(critical.items(), key=lambda kv: -kv[1]))
         return out

@@ -25,7 +25,15 @@ ping/aggregate/stats over a real TCP connection, and (5) the trace
 phase (`obs/trace`): warm requests yield traces whose span sum tiles
 the client-measured end-to-end latency within tolerance, and the
 tracing-on vs tracing-off throughput overhead is measured, printed
-(`serve trace: {...}`, recorded by the tier harness) and bounded.
+(`serve trace: {...}`, recorded by the tier harness) and bounded,
+(6) the fleet phase (`serve/fleet`): a 2-shard in-process ring holds
+route determinism, shard-exact suspicion ownership and the
+kill/restart re-warm bound (`serve fleet: {...}`), and (7) the
+fleet-scope causal plane (r19): routed requests produce JOINED traces
+— shard spans spliced under the router envelope — whose spans tile the
+router-measured wall (`serve fleet trace: {...}`), and a planted SLO
+burn captures an incident bundle whose replayed causal story prints as
+a parseable `incident: {...}` line.
 
 A live serving process answers SIGUSR1 with a trace-ring snapshot
 (`traces-<completed>.json` in the result directory) — the serve twin of
@@ -367,6 +375,119 @@ def selfcheck(seed=1, requests=120, verbose=True):
                   f"{victim} kill/restart re-warm bound holds, routed "
                   f"rate {fleet_rate:.0f}/s vs direct "
                   f"{direct_rate:.0f}/s", flush=True)
+
+        # (7) fleet-scope causal plane (r19): the cross-process span
+        # join — shard spans spliced under the router envelope — must
+        # tile the router-measured wall, and a planted SLO burn must
+        # freeze an incident bundle obs_report can replay. Both halves
+        # print machine-parseable lines the tier harness records.
+        import pathlib
+        import tempfile
+
+        from byzantinemomentum_tpu.obs.metrics import SLO, \
+            BurnRateEvaluator
+        from byzantinemomentum_tpu.obs.trace import (IncidentRecorder,
+                                                     render_incidents)
+        from byzantinemomentum_tpu.serve.fleet.local import (ask_socket,
+                                                             fleet_socket)
+
+        gar, n, f, d = "median", 5, 1, 32
+        with LocalFleet(2, router_server=True,
+                        service={"max_batch": 4,
+                                 "max_delay_ms": 2.0}) as fleet:
+            for svc in fleet.services.values():
+                svc.warmup([(gar, n, f, d, True)])
+            sock, files = fleet_socket("127.0.0.1", fleet.port,
+                                       timeout=30)
+            try:
+                for k in range(24):
+                    base = f"jt-{k}"
+                    reply = ask_socket(files, {
+                        "op": "aggregate", "gar": gar, "f": f,
+                        "vectors": rng.standard_normal((n, d)).astype(
+                            np.float32).tolist(),
+                        "clients": [base] + [f"{base}.{j}"
+                                             for j in range(1, n)]})
+                    if not reply.get("ok"):
+                        raise AssertionError(
+                            f"fleet-trace request failed: {reply}")
+            finally:
+                sock.close()
+            records = fleet.router.joined_records()
+            if len(records) < 20:
+                raise AssertionError(
+                    f"span join landed only {len(records)}/24 records")
+            tile_errors = [abs(sum(r["spans_ms"].values())
+                               - r["total_ms"]) / r["total_ms"]
+                           for r in records if r["total_ms"] > 0]
+            join_tile = sum(tile_errors) / max(len(tile_errors), 1)
+            critical = {}
+            for record in records:
+                hop = record.get("dominant")
+                if hop:
+                    critical[hop] = critical.get(hop, 0) + 1
+            join_line = {
+                "joined": len(records),
+                "tile_error_frac": round(join_tile, 4),
+                "critical_path": dict(sorted(critical.items(),
+                                             key=lambda kv: -kv[1])),
+            }
+            print(f"serve fleet trace: {json.dumps(join_line)}",
+                  flush=True)
+            if join_tile > 0.15:
+                raise AssertionError(
+                    f"joined spans do not tile the router wall: mean "
+                    f"error {join_tile * 100:.1f}% > 15%")
+
+        # The planted burn: a synthetic snapshot stream trips the
+        # availability SLO (200 rejects in one window), the burn edge
+        # captures a bundle, and the replay names the causal story
+        def snap(t, total, bad):
+            return {"t": t, "merged": {"metrics": {
+                "bad_requests": {"type": "counter", "value": bad},
+                "all_requests": {"type": "counter", "value": total}}}}
+
+        slo = SLO("selfcheck-availability", objective=0.999,
+                  total="all_requests", bad=("bad_requests",),
+                  fast_s=30.0, slow_s=300.0, burn_threshold=10.0)
+        evaluator = BurnRateEvaluator([slo])
+        burns = []
+        for t, total, bad in ((0.0, 0, 0), (10.0, 400, 0),
+                              (20.0, 800, 200)):
+            burns += [e for e in evaluator.observe(snap(t, total, bad))
+                      if e["event"] == "slo_burn"]
+        if not burns:
+            raise AssertionError("planted SLO burn never fired")
+        with tempfile.TemporaryDirectory() as tmp:
+            recorder = IncidentRecorder(
+                pathlib.Path(tmp), source="selfcheck",
+                providers={
+                    "trace": lambda: {"critical_path": critical},
+                    "membership": lambda: {"version": 1, "dead": []}})
+            event = dict(burns[0])
+            bundle_path = recorder.capture(event.pop("event"), event)
+            if bundle_path is None:
+                raise AssertionError("incident capture hit its own "
+                                     "cooldown on the first bundle")
+            bundle = json.loads(pathlib.Path(bundle_path).read_text())
+            story = render_incidents(tmp)
+            if not any("story:" in line for line in story):
+                raise AssertionError(
+                    f"incident replay produced no story: {story}")
+            incident_line = {
+                "reason": bundle["reason"],
+                "slo": bundle["data"].get("slo"),
+                "burn_fast": bundle["data"].get("burn_fast"),
+                "evidence": sorted(bundle["context"]),
+                "story": next(line.split("story:", 1)[1].strip()
+                              for line in story if "story:" in line),
+            }
+            print(f"incident: {json.dumps(incident_line)}", flush=True)
+        if verbose:
+            print(f"serve selfcheck: span join tiles the router wall "
+                  f"({join_tile * 100:.2f}% off over {len(records)} "
+                  f"joined records), planted burn -> replayable "
+                  f"incident bundle", flush=True)
 
         stats = service.stats()
     finally:

@@ -1,4 +1,10 @@
-"""`python -m byzantinemomentum_tpu.serve.fleet` — launch the fleet."""
+"""`python -m byzantinemomentum_tpu.serve.fleet` — launch the fleet.
+
+The launched fleet carries the full r19 causal plane: the router
+splices each shard's wire trace record into joined per-hop spans, and
+SLO-burn / arc-death / failover edges drop atomic incident bundles
+under `<result-directory>/incidents/` (disable with `--no-incidents`).
+"""
 
 import sys
 

@@ -34,6 +34,16 @@ with the `cluster/launcher.py` discipline:
   and lands `slo_burn`/`slo_ok` edges on the telemetry timeline. A dead
   shard is a GAP in the scrape (its counters stop moving), exactly as
   its traffic is.
+* **incident bundles** (`obs/trace/incident.py`, r19) — every edge the
+  fleet already detects (an `slo_burn` from the scraper, an arc death
+  from the router's liveness hook, a failover restart from
+  supervision) triggers an atomic snapshot of the evidence in flight —
+  router trace summary incl. the joined critical path, the metrics
+  window + SLO state, per-shard heartbeats, the membership version —
+  into `incidents/incident-<n>.json`; teardown folds all per-process
+  bundles into `incidents/fleet.json`. Triggers are non-blocking
+  enqueues (the liveness hook runs under the router lock), captures
+  happen on a dedicated worker.
 
 Stdlib + ring/router + obs.heartbeat/metrics only — the launcher never
 imports jax (the shards do, in their own processes).
@@ -48,10 +58,13 @@ import sys
 import time
 
 from byzantinemomentum_tpu.cluster.runtime import free_port
+from byzantinemomentum_tpu.obs.health import load_blackbox
 from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat, \
     write_heartbeat
 from byzantinemomentum_tpu.obs.metrics import BurnRateEvaluator, \
     MetricsRegistry, MetricsScraper
+from byzantinemomentum_tpu.obs.trace import IncidentRecorder, \
+    merge_fleet_incidents
 from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, \
     Membership, write_fleet_manifest
 from byzantinemomentum_tpu.serve.fleet.router import FleetRouter, \
@@ -91,6 +104,10 @@ def process_commandline(argv=None):
     add("--metrics-interval", type=float, default=2.0,
         help="Seconds between metrics scrapes of the shard fleet "
              "(merged snapshots append to metrics.jsonl; 0 disables)")
+    add("--no-incidents", action="store_true", default=False,
+        help="Disable incident bundles (SLO burn / arc death / "
+             "failover edges snapshot trace+metrics+membership into "
+             "incidents/incident-<n>.json)")
     add("--poll", type=float, default=0.2,
         help="Supervision poll interval in seconds")
     add("--shard-retries", type=int, default=5,
@@ -140,6 +157,60 @@ class FleetLauncher:
         self.router = None
         self.server = None
         self.scraper = None
+        self.incidents = None
+
+    # -------------------------------------------------------------- #
+    # incident capture (r19): edge events snapshot the evidence that
+    # is otherwise rotating out of per-process rings
+
+    def _metrics_context(self):
+        if self.scraper is None:
+            return {"enabled": False}
+        snapshot = self.scraper.last_snapshot or {}
+        out = {"t": snapshot.get("t"),
+               "reached": snapshot.get("reached"),
+               "missed": snapshot.get("missed")}
+        merged = (snapshot.get("merged") or {}).get("metrics") or {}
+        out["counters"] = {
+            name: cell.get("value") for name, cell in merged.items()
+            if isinstance(cell, dict) and cell.get("type") == "counter"}
+        if self.scraper.evaluator is not None:
+            out["slo"] = self.scraper.evaluator.summary()
+        return out
+
+    def _health_context(self):
+        beats = {}
+        for shard in sorted(self.membership.shards):
+            beat = read_heartbeat(self.shards_dir / shard)
+            if beat is not None:
+                beats[shard] = {key: beat.get(key)
+                                for key in ("step", "status", "updated")}
+        context = {"heartbeats": beats}
+        blackbox = load_blackbox(self.resdir)
+        if blackbox is not None:
+            context["blackbox"] = blackbox
+        return context
+
+    def _membership_context(self):
+        return {"version": self.membership.version,
+                "shards": len(self.membership.shards),
+                "dead": sorted(self.router.dead_shards())
+                if self.router else [],
+                "restarts": dict(self.restarts)}
+
+    def _make_incidents(self):
+        return IncidentRecorder(self.resdir, source="launcher",
+                                providers={
+                                    "trace": lambda: self.router.stats(),
+                                    "metrics": self._metrics_context,
+                                    "health": self._health_context,
+                                    "membership": self._membership_context,
+                                }).start()
+
+    def _on_slo_event(self, name, event):
+        """Scraper-thread edge observer: a burn edge IS an incident."""
+        if name == "slo_burn" and self.incidents is not None:
+            self.incidents.trigger("slo_burn", **event)
 
     # -------------------------------------------------------------- #
 
@@ -156,6 +227,11 @@ class FleetLauncher:
         ring flips (called under the router lock; no router calls)."""
         self.membership.bump("alive" if alive else "dead", shard)
         self._persist()
+        if not alive and self.incidents is not None:
+            # trigger() only enqueues — the capture worker snapshots
+            # strictly outside this (router-held) lock context
+            self.incidents.trigger("arc_dead", shard=shard,
+                                   ring_version=self.membership.version)
 
     def _shard_cmd(self, shard, port):
         args = self.args
@@ -229,6 +305,8 @@ class FleetLauncher:
             metrics=MetricsRegistry(source="router"))
         self.server = RouterServer((self.host, self.args.port), self.router)
         self.server.serve_background()
+        if not getattr(self.args, "no_incidents", False):
+            self.incidents = self._make_incidents()
         if getattr(self.args, "metrics_interval", 0) > 0:
             # The pull plane: shards are TCP targets (their frontends
             # answer the metrics op), the in-process router registry
@@ -239,7 +317,8 @@ class FleetLauncher:
                  for s, row in self.membership.shards.items()},
                 self.resdir, interval=self.args.metrics_interval,
                 local=self.router.metrics,
-                evaluator=BurnRateEvaluator()).start()
+                evaluator=BurnRateEvaluator(),
+                on_event=self._on_slo_event).start()
         self._persist()  # now the manifest names the router's real port
         return self.server.port
 
@@ -298,12 +377,23 @@ class FleetLauncher:
                                    f"restart")
             self.router.mark_alive(shard)
             restarted.append(shard)
+            if self.incidents is not None:
+                self.incidents.trigger(
+                    "failover", shard=shard,
+                    restarts=self.restarts[shard],
+                    ring_version=self.membership.version)
         self.aggregate_heartbeat()
         return restarted
 
     def teardown(self):
         if self.scraper is not None:
             self.scraper.stop()
+        if self.incidents is not None:
+            # Drain queued triggers first, then fold every per-process
+            # bundle (launcher + shards) into the fleet-scope index
+            self.incidents.stop()
+            merge_fleet_incidents(self.resdir)
+            self.incidents = None
         if self.server is not None:
             self.server.shutdown()
             self.server.server_close()

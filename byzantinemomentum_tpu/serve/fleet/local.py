@@ -35,7 +35,7 @@ class LocalFleet:
 
     def __init__(self, shards=2, *, vnodes=DEFAULT_VNODES,
                  on_dead="queue", max_parked=1024, router_server=False,
-                 service=None):
+                 trace_buffer=512, service=None):
         from byzantinemomentum_tpu.serve.frontend import AggregationServer
         from byzantinemomentum_tpu.serve.service import AggregationService
 
@@ -58,6 +58,7 @@ class LocalFleet:
             {s: (row["host"], row["port"])
              for s, row in self.membership.shards.items()},
             vnodes=vnodes, on_dead=on_dead, max_parked=max_parked,
+            trace_buffer=trace_buffer,
             metrics=MetricsRegistry(source="router"))
         self.server = None
         if router_server:
@@ -128,6 +129,16 @@ class LocalFleet:
         self.servers[shard] = server
         self.membership.bump("alive", shard)
         self.router.mark_alive(shard)
+
+    def set_tracing(self, on):
+        """Flip the WHOLE fleet tracing plane at once — the router's
+        splice AND every live shard's request tracing. The paired
+        overhead arms of `ATTRIB_serve_fleet` toggle here so the off
+        arm pays neither shard stamps nor the router-side reply
+        parse."""
+        self.router.tracing = bool(on)
+        for svc in self.services.values():
+            svc.tracing = bool(on)
 
     def close(self):
         self.router.close()

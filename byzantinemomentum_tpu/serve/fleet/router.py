@@ -52,6 +52,22 @@ Tracing (PR 13 extension): each routed line stamps `recv` → `routed` →
 `reply`, tiling the router-path latency into the `route` (parse + ring)
 and `shard_rtt` (queue wait + forward + shard service time) legs that
 `ATTRIB_serve_r16.json` records; `stats` carries the live summary.
+
+Cross-process span join (r19): the shard's reply already carries its
+per-phase `RequestTrace` record (`"trace"`, PR 13 wire protocol) — the
+router used to drop it. With `tracing` on, the connection thread now
+splices that record into its own envelope via `join_shard_trace`
+(clock-free: shard durations nest under the router-measured
+`shard_rtt`; the residual is wire + connection queue), lands the joined
+record in a `TraceBuffer`, and counts the dominant hop onto
+`router_critical_path_<hop>` registry counters — `stats()["joined"]`
+and any metrics scrape answer "where is the convoy" live. A reply
+without a parseable trace record degrades to the r16 opaque
+`shard_rtt` row; the line is never severed over telemetry. The splice
+parses the reply bytes ONLY on this branch (gated by a cheap
+`b'"trace"' in reply` scan), so the forwarded bytes stay verbatim and
+the tracing-off arm pays nothing — the paired-overhead budget in
+`ATTRIB_serve_fleet_r19.json` holds the whole plane under 3%.
 """
 
 import json
@@ -63,8 +79,8 @@ import time
 
 from byzantinemomentum_tpu.obs.metrics import (LATENCY_MS_BOUNDS,
                                                NullRegistry)
-from byzantinemomentum_tpu.obs.trace import ROUTER_PHASES, percentile, \
-    phase_spans
+from byzantinemomentum_tpu.obs.trace import JOINED_HOPS, ROUTER_PHASES, \
+    TraceBuffer, join_shard_trace, percentile, phase_spans
 from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, HashRing
 
 __all__ = ["FleetRouter", "RouterServer"]
@@ -72,6 +88,39 @@ __all__ = ["FleetRouter", "RouterServer"]
 # Lines written back-to-back per forwarder flush: bounds per-group reply
 # latency while keeping the owner shard's microbatcher fed
 _PIPELINE = 64
+
+_JSON = json.JSONDecoder()
+
+
+def _extract_trace(reply):
+    """Parse ONLY the reply's top-level `"trace"` record out of the raw
+    bytes: `raw_decode` at the key's value, so the splice never pays to
+    re-parse the d-dimensional aggregate riding the same line — the
+    on-arm join cost stays flat in d. A quoted `"trace"` that is not a
+    key (next non-space char isn't `:`) is skipped; JSON string
+    escaping means the byte sequence `"trace"` cannot hide INSIDE a
+    string value, so a `:` match is a real key. Returns the decoded
+    value or None (caller degrades to the opaque row)."""
+    try:
+        text = reply.decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+    pos = text.find('"trace"')
+    while pos >= 0:
+        cursor = pos + 7
+        while cursor < len(text) and text[cursor] in " \t\r\n":
+            cursor += 1
+        if cursor < len(text) and text[cursor] == ":":
+            cursor += 1
+            while cursor < len(text) and text[cursor] in " \t\r\n":
+                cursor += 1
+            try:
+                value, _ = _JSON.raw_decode(text, cursor)
+            except ValueError:
+                return None
+            return value
+        pos = text.find('"trace"', pos + 1)
+    return None
 
 
 class _Item:
@@ -91,7 +140,8 @@ class FleetRouter:
     def __init__(self, shards, *, vnodes=DEFAULT_VNODES, on_dead="queue",
                  max_parked=1024, reply_timeout=30.0, connect_timeout=2.0,
                  retry_interval=0.05, probe_interval=0.25,
-                 trace_buffer=512, liveness_hook=None, metrics=None):
+                 trace_buffer=512, tracing=True, liveness_hook=None,
+                 metrics=None):
         if on_dead not in ("queue", "error"):
             raise ValueError(f"on_dead must be 'queue' or 'error', "
                              f"got {on_dead!r}")
@@ -138,6 +188,17 @@ class FleetRouter:
                                              bounds=LATENCY_MS_BOUNDS)
         self._trace_buffer = int(trace_buffer)
         self._spans = []  # bounded [(route_ms, shard_rtt_ms, total_ms)]
+        # The span-join plane (r19). `tracing` gates ONLY the splice
+        # (reply parse + joined ring + critical-path counters) — the
+        # opaque route/shard_rtt rows above stay on either way, they
+        # cost two clock reads per line. Critical-path counter handles
+        # are pre-bound per joined hop so the hot path never takes the
+        # registry lock.
+        self.tracing = bool(tracing)
+        self._joined = TraceBuffer(self._trace_buffer)
+        self._m_critical = {
+            hop: self.metrics.counter(f"router_critical_path_{hop}")
+            for hop in JOINED_HOPS}
         self._queues = {s: queue.Queue() for s in self._addresses}
         self._forwarders = [
             threading.Thread(target=self._forward_loop, args=(s,),
@@ -267,20 +328,41 @@ class FleetRouter:
                                      f"({self._reply_timeout}s)",
                                      shard=shard)
         item.stamps["reply"] = time.monotonic()
-        self._record_trace(item.stamps)
+        self._record_trace(item.stamps, reply, shard)
         return reply
 
     def _error_bytes(self, message, **extra):
         return json.dumps({"ok": False, "error": f"router: {message}",
                            **extra}).encode("utf-8")
 
-    def _record_trace(self, stamps):
+    def _join_reply(self, stamps, reply, shard):
+        """Cross-process splice on the connection thread: pull the
+        shard's trace record out of the reply bytes and nest it inside
+        this line's router envelope. Any malformed/absent record
+        returns None — the caller degrades to the opaque row."""
+        if b'"trace"' not in reply:
+            return None   # cheap scan: never json-parse untraced replies
+        joined = join_shard_trace(stamps, _extract_trace(reply))
+        if joined is not None:
+            joined["shard"] = shard   # which arc served — skew analysis
+        return joined
+
+    def _record_trace(self, stamps, reply=None, shard=None):
         spans = phase_spans(stamps, ROUTER_PHASES)
         if spans is None:
             return
         total = (stamps["reply"] - stamps["recv"]) * 1000.0
         self._m_route.observe(spans["route"])
         self._m_rtt.observe(spans["shard_rtt"])
+        if self.tracing and reply is not None:
+            joined = self._join_reply(stamps, reply, shard)
+            if joined is not None:
+                # TraceBuffer.add and the counters are internally
+                # locked — concurrent connection threads each land
+                # their whole record (the router_splice schedule model
+                # pins the unlocked variant losing records)
+                self._m_critical[joined["dominant"]].inc()
+                self._joined.add(joined)
         with self._lock:
             self._spans.append((spans["route"], spans["shard_rtt"], total))
             if len(self._spans) > self._trace_buffer:
@@ -359,9 +441,27 @@ class FleetRouter:
                                 shard)
                         batch = []
                         break
+                    # The batch PARKS on the dead arc: stamp when the
+                    # park began so the replayed trace attributes its
+                    # recovery wait as a `parked` hop instead of
+                    # inflating `wire_residual` (r19). setdefault — the
+                    # first failed attempt owns the stamp across
+                    # retries.
+                    parked_at = time.monotonic()
+                    for it in batch:
+                        if it.stamps is not None:
+                            it.stamps.setdefault("parked", parked_at)
                     self._wake.wait(self._retry_interval)
             if not batch:
                 continue
+            # Close any park window: the arc is back and these lines
+            # are about to replay. (reply_q.put/get below is the
+            # happens-before edge that publishes both stamps to the
+            # connection thread's splice.)
+            unparked_at = time.monotonic()
+            for it in batch:
+                if it.stamps is not None and "parked" in it.stamps:
+                    it.stamps.setdefault("unparked", unparked_at)
             try:
                 for it in batch:
                     files.write(it.raw + b"\n")
@@ -448,6 +548,10 @@ class FleetRouter:
                 "total": {"p50_ms": percentile([s[2] for s in spans], 50),
                           "p99_ms": percentile([s[2] for s in spans], 99)},
             }
+        if len(self._joined):
+            # The join-aware view: per-hop distributions + the
+            # critical-path histogram over the joined window
+            payload["joined"] = self._joined.summary()
         return payload
 
     def trace_spans(self):
@@ -455,6 +559,18 @@ class FleetRouter:
         the ATTRIB artifact aggregates."""
         with self._lock:
             return list(self._spans)
+
+    def joined_records(self):
+        """The joined cross-process trace records (oldest first) — the
+        per-hop rows `ATTRIB_serve_fleet` aggregates. Each record:
+        {"trace_id", "shard", "spans_ms": {hop: ms}, "total_ms",
+        "dominant"}."""
+        return self._joined.snapshot()
+
+    @property
+    def joined_completed(self):
+        """Joined traces ever spliced (monotonic, ring-independent)."""
+        return self._joined.completed
 
     def close(self, timeout=5.0):
         """Stop every thread; parked lines error. Idempotent."""

@@ -30,6 +30,25 @@ its `parse` span opened at the instant the raw line arrived (stamped
 BEFORE the JSON decode, so client-visible decode cost is attributed).
 Absent ids are auto-assigned server-side; a malformed id (object/array)
 answers an error on its line slot without severing the connection.
+
+The response's trace record is also the substrate of the fleet-scope
+span JOIN (r19): it carries only DURATIONS from this process's
+monotonic clock —
+
+  {"trace_id": "req-17",
+   "spans_ms": {"parse": .., "validate": .., "queue": .., "pack": ..,
+                "dispatch": .., "resolver_wake": .., "device": ..,
+                "resolve": ..},
+   "total_ms": .., "depth_at_submit": .., "batch_size": ..,
+   "batch_occupancy": .., "gar": .., "n": .., "d": .., "src": "shard-2"}
+
+— never wall-clock timestamps, so the fleet router can nest them
+clock-free inside its own measured `shard_rtt` envelope
+(`join_shard_trace`); `src` names the serving shard (the service's
+metrics source) so the join can cross-check routing against the
+shard's own identity. A frontend running with tracing off simply omits
+the key and the router degrades to its opaque row — the record is
+telemetry, never load-bearing protocol.
 """
 
 import json

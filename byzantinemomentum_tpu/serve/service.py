@@ -162,6 +162,10 @@ class AggregationService:
         self._m_occupancy = metrics.histogram("serve_batch_occupancy",
                                               bounds=OCCUPANCY_BOUNDS)
         self.traces = TraceBuffer(trace_buffer, metrics=metrics)
+        # Stamped into every trace's meta (r19): the wire trace record
+        # then names WHICH shard served, so the router's cross-process
+        # join can cross-check routing against the shard's own identity
+        self._trace_src = getattr(metrics, "source", None)
         if isinstance(admission, dict):
             admission = AdmissionPolicy(**admission)
         self.admission = admission
@@ -269,6 +273,8 @@ class AggregationService:
         recorder.counter("serve_requests")
         if trace is not None:
             trace.meta = {"gar": cell.gar, "n": n, "d": int(matrix.shape[1])}
+            if self._trace_src is not None:
+                trace.meta["src"] = self._trace_src
         return self.batcher.submit(ServeRequest(cell, n, matrix, client_ids,
                                                 admitted=admitted,
                                                 admission=admission,

@@ -24,7 +24,16 @@ pairs are INCOMPARABLE. A `BENCH_serve_fleet.json` pair (`"kind":
 "serve_fleet"`, `--fleet`) compares aggregations/s per (scenario,
 shard-count) cell and fails on any recovery invariant flipping false;
 pairs from different fleet sizes, host core counts or isolation modes
-are INCOMPARABLE — a 4-shard rate says nothing about a 2-shard one. A
+are INCOMPARABLE — a 4-shard rate says nothing about a 2-shard one. An
+`ATTRIB_serve_fleet*.json` pair (`"kind": "serve_fleet_attribution"`,
+`--fleet --trace`) gates the JOINED per-hop columns the same cost-wise
+way: route, wire residual, shard queue wait, pack, dispatch, device,
+resolve p50/p99 per (scenario, shard count) — growth past tolerance
+over the same absolute floor fails BY HOP NAME, so a convoy that moves
+from the device into the shard queue cannot hide inside an unchanged
+end-to-end p99; tiling error, the join overhead fraction and the zipf
+queue-skew are informational. Mixed-kind, cross-backend, cross-core and
+different-shard-count-set pairs are INCOMPARABLE. A
 `BENCH_metrics*.json` pair (`"kind": "metrics_overhead"`,
 `--metrics-overhead`) gates the metrics-plane registry cost: the
 paired on/off agg/s are rates, the overhead fraction is a cost, and
@@ -60,7 +69,8 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 __all__ = ["load_artifact", "compare", "compare_attribution",
            "compare_cluster", "compare_health", "compare_metrics",
            "compare_serve", "compare_serve_attribution",
-           "compare_serve_fleet", "main"]
+           "compare_serve_fleet", "compare_serve_fleet_attribution",
+           "main"]
 
 # Fields (headline + per-cell) holding a steps/s figure worth diffing
 _RATE_KEY = re.compile(r"^(value|steps_per_sec(_\w+)?)$")
@@ -349,6 +359,67 @@ def compare_serve_fleet(old_payload, new_payload, tolerance):
     return rows, regressions
 
 
+def compare_serve_fleet_attribution(old_payload, new_payload, tolerance):
+    """The fleet-attribution gate over two `ATTRIB_serve_fleet*.json`
+    payloads (`scripts/serve_loadgen.py --fleet --trace`): every JOINED
+    per-hop column — route, wire residual, shard queue wait, pack,
+    dispatch, device, resolve — is a COST per (scenario, shard count),
+    so the gate fails on p50/p99 GROWTH past `tolerance` over the
+    `_SERVE_ATTRIB_FLOOR_MS` absolute floor, named down to the hop
+    (`zipf.shards_4.hop.shard_queue.p99_ms`). That is the whole point
+    of the join: a convoy migrating from the device into a shard's
+    admission queue FAILS here by name instead of washing out inside a
+    stable end-to-end p99. Per-cell tiling error, the paired join
+    overhead fraction and the zipf queue-wait skew are INFORMATIONAL
+    (skew follows key popularity, not code). The caller treats
+    mixed-kind, cross-backend, cross-core and mismatched shard-count
+    sets as INCOMPARABLE before reaching here."""
+    def costs(payload):
+        out = {}
+        for scenario, counts in sorted(
+                (payload.get("scenarios") or {}).items()):
+            if not isinstance(counts, dict):
+                continue
+            for count, row in sorted(counts.items(),
+                                     key=lambda kv: (len(kv[0]), kv[0])):
+                for hop, cell in sorted(((row or {}).get("hops")
+                                         or {}).items()):
+                    if not isinstance(cell, dict):
+                        continue
+                    for key in ("p50_ms", "p99_ms"):
+                        value = cell.get(key)
+                        if isinstance(value, (int, float)):
+                            out[f"{scenario}.shards_{count}.hop."
+                                f"{hop}.{key}"] = float(value)
+        return out
+
+    old_costs, new_costs = costs(old_payload), costs(new_payload)
+    rows = []
+    regressions = []
+    for name in sorted(old_costs):
+        if name not in new_costs:
+            continue
+        old, new = old_costs[name], new_costs[name]
+        delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                   else float("inf"))
+        rows.append((name, old, new, delta))
+        if (new > old * (1.0 + tolerance)
+                and new - old > _SERVE_ATTRIB_FLOOR_MS):
+            regressions.append((name, old, new, delta))
+    for label, old, new in (
+            ("overhead.frac",
+             (old_payload.get("overhead") or {}).get("frac"),
+             (new_payload.get("overhead") or {}).get("frac")),
+            ("zipf_queue_skew.max_over_min",
+             (old_payload.get("zipf_queue_skew") or {}).get("max_over_min"),
+             (new_payload.get("zipf_queue_skew") or {}).get("max_over_min"))):
+        if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+            delta = (new / old - 1.0) if old > 0 else (0.0 if new <= 0
+                                                       else float("inf"))
+            rows.append((f"{label} (info)", float(old), float(new), delta))
+    return rows, regressions
+
+
 # The health-overhead fraction is an absolute few-percent figure; growth
 # below one percentage point is measurement noise on any host and never
 # fails the gate on its own
@@ -525,6 +596,49 @@ def main(argv=None):
     print(f"bench_compare: {pathlib.Path(old_path).name} -> "
           f"{pathlib.Path(new_path).name} "
           f"(tolerance {args.tolerance * 100:.1f}%)")
+
+    is_fleet_attr = [p.get("kind") == "serve_fleet_attribution"
+                     for p in payloads]
+    if any(is_fleet_attr):
+        # Fleet-attribution gate over two ATTRIB_serve_fleet*.json
+        # artifacts: joined per-hop columns per (scenario, shard count)
+        if not all(is_fleet_attr):
+            print("bench_compare: INCOMPARABLE — one artifact is a fleet "
+                  "attribution, the other is not")
+            return 0
+        backends = [p.get("backend") for p in payloads]
+        if backends[0] != backends[1]:
+            print(f"bench_compare: INCOMPARABLE — fleet attributions from "
+                  f"different backends ({backends[0]} vs {backends[1]})")
+            return 0
+        cores = [p.get("host_cores") for p in payloads]
+        if cores[0] != cores[1]:
+            print(f"bench_compare: INCOMPARABLE — fleet attributions from "
+                  f"hosts with different core counts ({cores[0]} vs "
+                  f"{cores[1]}) — hop latency is core-bound")
+            return 0
+        sizes = [sorted((p.get("config") or {}).get("shard_counts") or [],
+                        key=str) for p in payloads]
+        if sizes[0] != sizes[1]:
+            print(f"bench_compare: INCOMPARABLE — different fleet sizes "
+                  f"({sizes[0]} vs {sizes[1]} shards)")
+            return 0
+        rows, regressions = compare_serve_fleet_attribution(
+            old_payload, new_payload, args.tolerance)
+        if not rows:
+            print("  no common joined hop cells; nothing to compare")
+            return 0
+        flagged = {row[0] for row in regressions}
+        width = max(len(name) for name, *_ in rows)
+        for name, old, new, delta in rows:
+            flag = "  REGRESSED" if name in flagged else ""
+            print(f"  {name:<{width}}  {old:10.4f} -> {new:10.4f}  "
+                  f"{delta * 100:+7.2f}%{flag}")
+        if regressions:
+            print(f"bench_compare: {len(regressions)} joined hop(s) grew "
+                  f"past the {args.tolerance * 100:.1f}% tolerance")
+            return 1
+        return 0
 
     is_serve_attr = [p.get("kind") == "serve_attribution" for p in payloads]
     if any(is_serve_attr):

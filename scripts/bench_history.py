@@ -29,7 +29,12 @@ backend-noted like the attribution column. Per-round serve-attribution
 artifacts (`ATTRIB_serve_r*.json`, `serve_loadgen.py --trace`, working
 tree `ATTRIB_serve.json` as `current`) add the per-phase columns —
 queue-wait / device / resolve p50 ms — so "which phase ate the p99"
-reads off one table across rounds.
+reads off one table across rounds. Fleet-attribution artifacts
+(`ATTRIB_serve_fleet_r*.json`, `--fleet --trace`, r19) add the two
+JOINED hops only the cross-process splice can measure — shard-queue /
+wire-resid p50 ms from the zipf scenario at the largest shard count —
+so a convoy migrating between a shard's admission queue and the wire
+reads off the same table.
 
 Incomparability discipline (as `bench_compare.py`): a crashed round
 (`rc != 0`, no parsed payload — e.g. the BENCH_r05 down-tunnel crash), a
@@ -53,11 +58,12 @@ sys.path.insert(0, str(ROOT / "scripts"))
 
 from bench_compare import load_artifact, _rates  # noqa: E402
 
-__all__ = ["collect_cluster", "collect_fleet", "collect_history",
-           "collect_metrics", "collect_serve", "collect_serve_attrib",
-           "collect_tournament", "render_table", "main", "GAR_COLUMN",
-           "CLUSTER_COLUMNS", "FLEET_COLUMNS", "METRICS_COLUMNS",
-           "SERVE_COLUMNS", "SERVE_ATTRIB_COLUMNS", "TOURNAMENT_COLUMNS"]
+__all__ = ["collect_cluster", "collect_fleet", "collect_fleet_attrib",
+           "collect_history", "collect_metrics", "collect_serve",
+           "collect_serve_attrib", "collect_tournament", "render_table",
+           "main", "GAR_COLUMN", "CLUSTER_COLUMNS", "FLEET_COLUMNS",
+           "FLEET_ATTRIB_COLUMNS", "METRICS_COLUMNS", "SERVE_COLUMNS",
+           "SERVE_ATTRIB_COLUMNS", "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -310,6 +316,56 @@ def collect_fleet(root, labels):
             if (stats := _fleet_stats(root, label)) is not None}
 
 
+# Fleet-attribution trajectory columns (`scripts/serve_loadgen.py
+# --fleet --trace` artifacts, r19): the two JOINED hops only the
+# cross-process splice can see — the shard's admission-queue wait and
+# the wire residual (rtt minus everything the shard accounts for) —
+# rendered from the zipf scenario at the largest shard count, where the
+# hot-key convoy lives
+FLEET_ATTRIB_COLUMNS = ("shard-queue ms", "wire-resid ms")
+
+
+def _fleet_attrib_stats(root, label):
+    """`{shard_queue, wire_residual, shards, backend} | None` for one
+    round's fleet attribution: `ATTRIB_serve_fleet_r*.json` per round,
+    the working tree's `ATTRIB_serve_fleet.json` for the `current`
+    row."""
+    name = ("ATTRIB_serve_fleet.json" if label == "current"
+            else f"ATTRIB_serve_fleet_{label}.json")
+    path = pathlib.Path(root) / name
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) \
+            or payload.get("kind") != "serve_fleet_attribution":
+        return None
+    zipf = (payload.get("scenarios") or {}).get("zipf") or {}
+    counts = sorted((c for c in zipf if c.isdigit()), key=int)
+    if not counts:
+        return None
+    top = counts[-1]
+    hops = (zipf[top] or {}).get("hops") or {}
+
+    def p50(hop):
+        value = (hops.get(hop) or {}).get("p50_ms")
+        return float(value) if isinstance(value, (int, float)) else None
+
+    stats = {"shard_queue": p50("shard_queue"),
+             "wire_residual": p50("wire_residual"),
+             "shards": int(top), "backend": payload.get("backend")}
+    if stats["shard_queue"] is None and stats["wire_residual"] is None:
+        return None  # legacy/foreign payload with no renderable hop
+    return stats
+
+
+def collect_fleet_attrib(root, labels):
+    """{label: fleet-attribution stats} over the history rows
+    (independent instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _fleet_attrib_stats(root, label)) is not None}
+
+
 # Flight-recorder trajectory column (`scripts/health_overhead.py`
 # artifacts): the paired on/off steps/s overhead of the in-jit health
 # vector — the telemetry discipline's number, per round
@@ -419,7 +475,9 @@ def collect_history(root=ROOT):
                           ("BENCH_metrics_r*.json",
                            r"BENCH_metrics_r(\d+)\.json$"),
                           ("BENCH_serve_fleet_r*.json",
-                           r"BENCH_serve_fleet_r(\d+)\.json$")):
+                           r"BENCH_serve_fleet_r(\d+)\.json$"),
+                          ("ATTRIB_serve_fleet_r*.json",
+                           r"ATTRIB_serve_fleet_r(\d+)\.json$")):
         for path in root.glob(glob):
             m = re.search(pattern, path.name)
             if m:
@@ -434,7 +492,8 @@ def collect_history(root=ROOT):
             or (root / "CLUSTER.json").is_file()
             or (root / "BENCH_health.json").is_file()
             or (root / "BENCH_metrics.json").is_file()
-            or (root / "BENCH_serve_fleet.json").is_file()):
+            or (root / "BENCH_serve_fleet.json").is_file()
+            or (root / "ATTRIB_serve_fleet.json").is_file()):
         labels.append("current")
         paths.append(current if current.is_file() else None)
     for label, path in zip(labels, paths):
@@ -465,7 +524,7 @@ def _load_rates(path):
 
 def render_table(history, serve=None, tournament=None, cluster=None,
                  serve_attrib=None, health=None, fleet=None,
-                 metrics=None):
+                 metrics=None, fleet_attrib=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
@@ -481,6 +540,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     health = health or {}
     fleet = fleet or {}
     metrics = metrics or {}
+    fleet_attrib = fleet_attrib or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
@@ -489,7 +549,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     any_gar = any(gar is not None for _, _, _, gar in history)
     if not columns and not any_gar and not serve and not tournament \
             and not cluster and not serve_attrib and not health \
-            and not fleet and not metrics:
+            and not fleet and not metrics and not fleet_attrib:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -510,6 +570,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         columns = columns + list(FLEET_COLUMNS)
     if metrics:
         columns = columns + list(METRICS_COLUMNS)
+    if fleet_attrib:
+        columns = columns + list(FLEET_ATTRIB_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -541,6 +603,12 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         row_health = health.get(label)
         row_fleet = fleet.get(label)
         row_metrics = metrics.get(label)
+        row_fleet_attrib = fleet_attrib.get(label)
+        if row_fleet_attrib is not None and row_fleet_attrib.get(
+                "backend") not in (None, "tpu"):
+            notes.append(f"  {label}: joined hop columns from a "
+                         f"backend={row_fleet_attrib['backend']} fleet "
+                         f"attribution")
         if row_metrics is not None and row_metrics.get("backend") not in (
                 None, "tpu"):
             notes.append(f"  {label}: metrics overhead from a "
@@ -622,6 +690,14 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 if within is None:
                     return f"{'-':>{w}}"
                 return f"{int(within):>{w}d}"
+            if c in FLEET_ATTRIB_COLUMNS:
+                key = {"shard-queue ms": "shard_queue",
+                       "wire-resid ms": "wire_residual"}[c]
+                value = (None if row_fleet_attrib is None
+                         else row_fleet_attrib.get(key))
+                if value is None:
+                    return f"{'-':>{w}}"
+                return f"{value:>{w}.3f}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -665,6 +741,8 @@ def main(argv=None):
                           [label for label, *_ in history])
     metrics = collect_metrics(pathlib.Path(args.root),
                               [label for label, *_ in history])
+    fleet_attrib = collect_fleet_attrib(pathlib.Path(args.root),
+                                        [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
@@ -676,11 +754,12 @@ def main(argv=None):
              "cluster": cluster.get(label),
              "health": health.get(label),
              "fleet": fleet.get(label),
-             "metrics": metrics.get(label)}
+             "metrics": metrics.get(label),
+             "fleet_attrib": fleet_attrib.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
     print(render_table(history, serve, tournament, cluster, serve_attrib,
-                       health, fleet, metrics))
+                       health, fleet, metrics, fleet_attrib))
     return 0
 
 

@@ -269,6 +269,25 @@ def main():
                 continue
             serve_tier["fleet_shards"] = parsed.get("shards")
             serve_tier["fleet_speedup"] = parsed.get("fleet_speedup")
+    # The selfcheck's causal-plane phase (r19): the cross-process span
+    # join's tiling error + critical-path histogram (`serve fleet
+    # trace: {...}`), and the planted-burn incident replay — reason +
+    # one-line causal story — printed as `incident: {...}`
+    for line in serve_check.stdout.splitlines():
+        if line.startswith("serve fleet trace: {"):
+            try:
+                parsed = json.loads(line[len("serve fleet trace: "):])
+            except ValueError:
+                continue
+            serve_tier["join_tile_error"] = parsed.get("tile_error_frac")
+            serve_tier["join_critical_path"] = parsed.get("critical_path")
+        elif line.startswith("incident: {"):
+            try:
+                parsed = json.loads(line[len("incident: "):])
+            except ValueError:
+                continue
+            serve_tier["incident_reason"] = parsed.get("reason")
+            serve_tier["incident_story"] = parsed.get("story")
     for label, proc in (("selfcheck", serve_check), ("loadgen", serve_load)):
         if proc.returncode != 0:
             serve_tier[f"{label}_tail"] = (proc.stdout

@@ -101,8 +101,8 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 __all__ = ["run_loadgen", "run_hetero", "run_trace", "run_fleet",
-           "run_router_trace", "run_metrics_overhead", "pr8_policy_cells",
-           "percentiles", "FLEET_SCENARIOS", "main"]
+           "run_fleet_trace", "run_router_trace", "run_metrics_overhead",
+           "pr8_policy_cells", "percentiles", "FLEET_SCENARIOS", "main"]
 
 # Named fleet population scenarios (`--fleet`): how client ids arrive.
 #   rotation  uniform round-robin over a fixed population — the
@@ -908,6 +908,206 @@ def run_router_trace(*, requests=160, population=32, n=5, d=64, f=1,
     }
 
 
+def _joined_hop_rows(records):
+    """Aggregate joined trace records into per-hop distributions plus
+    the per-record tiling error against the router-measured wall."""
+    hops = {}
+    tile_errors = []
+    critical = {}
+    for record in records:
+        spans = record.get("spans_ms") or {}
+        for hop, ms in spans.items():
+            hops.setdefault(hop, []).append(float(ms))
+        total = float(record.get("total_ms") or 0.0)
+        if total > 0.0:
+            tile_errors.append(abs(sum(spans.values()) - total) / total)
+        hop = record.get("dominant")
+        if hop:
+            critical[hop] = critical.get(hop, 0) + 1
+    return hops, tile_errors, critical
+
+
+def _queue_wait_by_shard(records):
+    """Per-arc `shard_queue` p99 over joined records — the cross-arc
+    skew view where a zipf convoy shows up (the hot key's owner builds
+    queue wait the other arcs never see)."""
+    by_shard = {}
+    for record in records:
+        shard = record.get("shard")
+        queue_ms = (record.get("spans_ms") or {}).get("shard_queue")
+        if shard is not None and queue_ms is not None:
+            by_shard.setdefault(shard, []).append(float(queue_ms))
+    if not by_shard:
+        return None
+    p99 = {shard: round(float(np.percentile(values, 99)), 4)
+           for shard, values in sorted(by_shard.items())}
+    ordered = sorted(p99.values())
+    return {"per_shard_p99_ms": p99,
+            "counts": {shard: len(values)
+                       for shard, values in sorted(by_shard.items())},
+            "max_over_min": round(ordered[-1] / max(ordered[0], 1e-6), 3),
+            "max_over_median": round(
+                ordered[-1] / max(ordered[len(ordered) // 2], 1e-6), 3)}
+
+
+def run_fleet_trace(*, shard_counts=(1, 2, 4), scenarios=FLEET_SCENARIOS,
+                    requests=240, population=64, n=5, d=64, f=1,
+                    gar="median", max_batch=8, max_delay_ms=2.0,
+                    connections=8, seed=1, overhead_pairs=4,
+                    tile_tolerance=0.15, overhead_bound=0.03):
+    """Fleet-scope attribution mode (`--fleet --trace`): the
+    `ATTRIB_serve_fleet.json` payload (`"kind":
+    "serve_fleet_attribution"`).
+
+    Every scenario × shard count drives a real router front door with
+    the cross-process span JOIN on: each reply's shard trace record is
+    spliced under the router envelope (`join_shard_trace`), so the
+    per-hop columns — route, wire residual, SHARD QUEUE WAIT (its own
+    column at last — the zipf convoy's home), pack, dispatch, device,
+    resolve — come from joined records, not single-process proxies.
+    Three checks ride along: (1) per-record tiling — the joined spans
+    must sum to the router-measured client wall within
+    `tile_tolerance`; (2) the paired tracing on/off overhead of the
+    WHOLE plane (shard stamps + wire record + router splice) under
+    `overhead_bound`; (3) the zipf convoy must be VISIBLE as cross-arc
+    `shard_queue` p99 skew at the largest fleet."""
+    import os
+    import statistics
+
+    import jax
+
+    from byzantinemomentum_tpu.serve.fleet.local import LocalFleet
+
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    rng = np.random.default_rng(seed)
+    scenario_rows = {name: {} for name in scenarios}
+    zipf_skew = None
+    ring_buffer = max(1024, 2 * requests)
+    try:
+        for shards in sorted(shard_counts):
+            with LocalFleet(shards, router_server=True,
+                            trace_buffer=ring_buffer,
+                            service={"max_batch": max_batch,
+                                     "max_delay_ms": max_delay_ms,
+                                     "trace_buffer": ring_buffer}) \
+                    as fleet:
+                for svc in fleet.services.values():
+                    svc.warmup([(gar, n, f, d, True)])
+                for name in scenarios:
+                    bases = _scenario_bases(name, requests, population,
+                                            rng)
+                    payloads = _fleet_payloads(bases, n, d, f, gar, rng)
+                    before = fleet.router.joined_completed
+                    wall, lat, errors, extra = _drive_scenario(
+                        name, "127.0.0.1", fleet.port, payloads,
+                        connections)
+                    grown = fleet.router.joined_completed - before
+                    records = (fleet.router.joined_records()[-grown:]
+                               if grown else [])
+                    hops, tile_errors, critical = _joined_hop_rows(
+                        records)
+                    tile_mean = (float(np.mean(tile_errors))
+                                 if tile_errors else None)
+                    row = {
+                        "traced": len(records),
+                        "errors": errors,
+                        "agg_per_sec": round(
+                            len(lat) / max(wall, 1e-9), 2),
+                        "client_wall": percentiles(lat) if lat else None,
+                        "hops": {hop: {**percentiles(values),
+                                       "max_ms": round(
+                                           float(np.max(values)), 3)}
+                                 for hop, values in sorted(hops.items())},
+                        "tile": {
+                            "error_frac_mean": (round(tile_mean, 4)
+                                                if tile_mean is not None
+                                                else None),
+                            "within_tolerance": bool(
+                                tile_mean is not None
+                                and tile_mean <= tile_tolerance),
+                            "tolerance": tile_tolerance,
+                        },
+                        "critical_path": dict(sorted(
+                            critical.items(), key=lambda kv: -kv[1])),
+                    }
+                    skew = (_queue_wait_by_shard(records)
+                            if shards > 1 else None)
+                    if skew is not None:
+                        row["queue_wait_skew"] = skew
+                    if (name == "zipf" and shards == max(shard_counts)
+                            and skew is not None):
+                        zipf_skew = {"shards": shards, **skew}
+                    scenario_rows[name][str(shards)] = row
+
+        # Paired on/off overhead of the WHOLE tracing plane (shard
+        # stamps + wire trace record + router-side splice), measured on
+        # its own fleet at the canonical 2-shard point: interleaved
+        # on/off/off/on closed-loop windows, median of per-pair ratios
+        overhead_shards = min(2, max(shard_counts))
+        with LocalFleet(overhead_shards, router_server=True,
+                        trace_buffer=ring_buffer,
+                        service={"max_batch": max_batch,
+                                 "max_delay_ms": max_delay_ms}) as fleet:
+            for svc in fleet.services.values():
+                svc.warmup([(gar, n, f, d, True)])
+
+            def window(count=max(60, requests // 4)):
+                bases = _scenario_bases("rotation", count, population,
+                                        rng)
+                payloads = _fleet_payloads(bases, n, d, f, gar, rng)
+                wall, lat, errors, _ = _drive_scenario(
+                    "rotation", "127.0.0.1", fleet.port, payloads,
+                    connections)
+                if errors:
+                    raise RuntimeError(
+                        f"overhead window saw {errors} errors")
+                return len(lat) / max(wall, 1e-9)
+
+            window(40)  # warm the measurement path itself
+            ratios, on_rates, off_rates = [], [], []
+            for _ in range(overhead_pairs):
+                fleet.set_tracing(True)
+                a_on = window()
+                fleet.set_tracing(False)
+                a_off = window()
+                b_off = window()
+                fleet.set_tracing(True)
+                b_on = window()
+                ratios.append((a_on + b_on) / (a_off + b_off))
+                on_rates += [a_on, b_on]
+                off_rates += [a_off, b_off]
+            overhead = max(0.0, 1.0 - statistics.median(ratios))
+    finally:
+        sys.setswitchinterval(old_switch)
+
+    return {
+        "kind": "serve_fleet_attribution",
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        "isolation": "in_process",
+        "config": {"requests": requests, "population": population,
+                   "n": n, "d": d, "f": f, "gar": gar,
+                   "max_batch": max_batch,
+                   "max_delay_ms": max_delay_ms,
+                   "connections": connections, "seed": seed,
+                   "shard_counts": sorted(shard_counts)},
+        "tile_tolerance": tile_tolerance,
+        "scenarios": scenario_rows,
+        "zipf_queue_skew": zipf_skew,
+        "overhead": {
+            "pairs": overhead_pairs,
+            "shards": overhead_shards,
+            "agg_per_sec_tracing_on": round(max(on_rates), 2),
+            "agg_per_sec_tracing_off": round(max(off_rates), 2),
+            "ratio_median": round(statistics.median(ratios), 4),
+            "frac": round(overhead, 4),
+            "bound_frac": overhead_bound,
+            "within_bound": bool(overhead <= overhead_bound),
+        },
+    }
+
+
 def _scenario_cell(service, name, requests, population, n, d, f, gar,
                    rng):
     """One single-process scenario cell (r18): the `--fleet` population
@@ -1102,6 +1302,43 @@ def main(argv=None):
         if not args.smoke or args.out_smoke:
             out = pathlib.Path(args.out) if args.out \
                 else ROOT / "BENCH_metrics.json"
+            out.write_text(json.dumps(payload, indent=2) + "\n")
+            print(f"serve_loadgen: wrote {out}")
+        return 0
+
+    if args.fleet and args.trace:
+        # Fleet-scope attribution: cross-process span join per scenario
+        # x shard count -> ATTRIB_serve_fleet.json
+        kwargs = dict(requests=args.requests, population=args.population,
+                      n=args.n, d=args.d, f=args.f, gar=args.gar,
+                      max_batch=args.max_batch,
+                      max_delay_ms=args.max_delay_ms,
+                      connections=args.connections, seed=args.seed,
+                      shard_counts=tuple(int(c) for c in
+                                         args.shards.split(",") if c))
+        if args.smoke:
+            kwargs.update(requests=min(args.requests, 60),
+                          population=min(args.population, 16),
+                          d=min(args.d, 64), overhead_pairs=2,
+                          shard_counts=tuple(
+                              c for c in kwargs["shard_counts"] if c <= 2)
+                          or (1, 2))
+        payload = run_fleet_trace(**kwargs)
+        line = {k: payload[k] for k in ("kind", "backend", "host_cores",
+                                        "isolation")}
+        top = str(max(kwargs["shard_counts"]))
+        line["tile_error_frac"] = {
+            name: rows[top]["tile"]["error_frac_mean"]
+            for name, rows in payload["scenarios"].items()}
+        line["overhead_frac"] = payload["overhead"]["frac"]
+        line["overhead_within_bound"] = payload["overhead"]["within_bound"]
+        if payload["zipf_queue_skew"]:
+            line["zipf_queue_skew_max_over_min"] = \
+                payload["zipf_queue_skew"]["max_over_min"]
+        print(json.dumps(line))
+        if not args.smoke or args.out_smoke:
+            out = pathlib.Path(args.out) if args.out \
+                else ROOT / "ATTRIB_serve_fleet.json"
             out.write_text(json.dumps(payload, indent=2) + "\n")
             print(f"serve_loadgen: wrote {out}")
         return 0

@@ -766,3 +766,61 @@ def test_fixed_service_stats_model_is_race_free():
                          max_runs=3000)
     assert not r.failures, r.failures[:3]
     assert r.runs > 50  # three threads: a real schedule space was covered
+
+
+# --------------------------------------------------------------------------- #
+# r19 causal-plane surfaces: incident capture + router splice
+
+def test_torn_incident_bundle_found_then_fixed():
+    """The unlocked index claim loses a bundle under one preemption
+    (two coinciding edges overwrite the same `incident-<n>.json`), the
+    witness replays deterministically, and the shipped claim-under-lock
+    pattern survives the same exhaustive exploration clean."""
+    torn = schedule.explore(schedule.incident_bundle_torn_model,
+                            max_preemptions=2)
+    assert torn.failures, "the torn claim must be found"
+    witness = torn.failures[0]
+    again = schedule.run_schedule(schedule.incident_bundle_torn_model,
+                                  witness.schedule)
+    assert not again.ok and again.schedule == witness.schedule
+    # serial orders pass — only a preemption exposes it
+    serial = schedule.run_schedule(schedule.incident_bundle_torn_model,
+                                   "")
+    assert serial.ok and serial.preemptions == 0
+    clean = schedule.explore(schedule.incident_bundle_model,
+                             max_preemptions=2)
+    assert clean.exhausted and not clean.failures, clean.failures[:3]
+    assert clean.runs > 1
+
+
+def test_lost_router_splice_found_then_fixed():
+    """The unlocked read-extend-rebind ring drops a joined record under
+    one preemption (the critical-path histogram undercounts the convoy
+    exactly when two connection threads splice together); the shipped
+    TraceBuffer append-under-lock is exhaustively clean."""
+    lost = schedule.explore(schedule.router_splice_lost_model,
+                            max_preemptions=2)
+    assert lost.failures, "the lost splice must be found"
+    again = schedule.run_schedule(schedule.router_splice_lost_model,
+                                  lost.failures[0].schedule)
+    assert not again.ok
+    serial = schedule.run_schedule(schedule.router_splice_lost_model, "")
+    assert serial.ok
+    clean = schedule.explore(schedule.router_splice_model,
+                             max_preemptions=2)
+    assert clean.exhausted and not clean.failures, clean.failures[:3]
+
+
+def test_selfcheck_covers_the_causal_plane():
+    report = schedule.selfcheck()
+    assert report["ok"]
+    assert report["incident_bundle_torn_found"]
+    assert report["router_splice_lost_found"]
+    assert report["incident_fixed_clean"]
+    assert report["schedules_incident"] > 4
+    # both witnesses replay: the report is actionable, not a boolean
+    for model, key in ((schedule.incident_bundle_torn_model,
+                        "incident_bundle_torn_witness"),
+                       (schedule.router_splice_lost_model,
+                        "router_splice_lost_witness")):
+        assert not schedule.run_schedule(model, report[key]).ok

@@ -439,6 +439,105 @@ def test_fleet_parked_line_is_bounded():
 
 
 # --------------------------------------------------------------------------- #
+# Cross-process span join over the live router (r19)
+
+def test_fleet_joined_records_tile_and_name_the_hot_arc():
+    """Every reply's wire trace record splices under the router envelope
+    (`joined_completed` grows once per line), the joined spans TILE the
+    recv->reply wall clock-free, and a deterministic hot-key mix shows
+    up as routing-count skew with `shard_queue` a first-class per-arc
+    column — the zipf convoy's signature, measured where it happens."""
+    from byzantinemomentum_tpu.obs.trace import JOINED_HOPS
+
+    rng = np.random.default_rng(13)
+    with _fleet(2) as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([("median", 5, 1, 32, True)])
+        hot = "hot-client"
+        hot_owner = fleet.owner(hot)
+        cold = next(f"cold{k}" for k in range(10_000)
+                    if fleet.owner(f"cold{k}") != hot_owner)
+        bases = [hot] * 30 + [cold] * 10
+        before = fleet.router.joined_completed
+        for base in bases:
+            assert fleet.ask(_payload(base, rng))["ok"]
+        grown = fleet.router.joined_completed - before
+        assert grown == len(bases), "every reply must splice"
+        records = fleet.router.joined_records()[-grown:]
+        queue_by_shard = {}
+        for record in records:
+            spans = record["spans_ms"]
+            assert set(spans) <= set(JOINED_HOPS)
+            assert "shard_queue" in spans and "wire_residual" in spans
+            # clock-free tiling: shard durations + wire residual sum to
+            # the router-measured envelope (exact up to rounding — the
+            # residual is DEFINED as what the nesting leaves over)
+            assert sum(spans.values()) == pytest.approx(
+                record["total_ms"], abs=0.01)
+            queue_by_shard.setdefault(record["shard"], []).append(
+                spans["shard_queue"])
+        # the hot key's owner took exactly its 3/4 of the traffic —
+        # count skew is deterministic (WHICH arc waits longest on a
+        # loaded 1-core host is not, so assert routing, not p99 rank)
+        counts = {s: len(v) for s, v in queue_by_shard.items()}
+        assert counts == {hot_owner: 30, fleet.owner(cold): 10}
+        # the router's own stats surface the joined summary
+        joined = fleet.router.stats().get("joined")
+        assert joined and joined["completed"] >= grown
+        assert "shard_queue" in joined["phases_ms"]
+        assert sum(joined["critical_path"].values()) >= grown
+
+
+def test_parked_span_attribution_after_kill_recovery():
+    """A line parked through a dead arc (`--on-dead queue`) replays
+    after the restart with its outage attributed to a `parked` hop —
+    dominant, bracketing the recovery wait — instead of polluting the
+    wire-residual column. The joined record still tiles."""
+    rng = np.random.default_rng(17)
+    with _fleet(2, on_dead="queue", max_parked=4) as fleet:
+        for svc in fleet.services.values():
+            svc.warmup([("median", 5, 1, 32, True)])
+        base = "park-trace"
+        victim = fleet.owner(base)
+        assert fleet.ask(_payload(base, rng))["ok"]
+        fleet.kill(victim)
+        replies = []
+        line = threading.Thread(
+            target=lambda: replies.append(fleet.ask(_payload(base, rng))))
+        routed0 = fleet.router.stats()["shards"][victim]["routed"]
+        line.start()
+        # wait until the forwarder demonstrably HOLDS the line against
+        # the dead arc (routed grew, arc marked dead), stable across
+        # two polls — the same discipline as the bounded-park test
+        deadline = time.monotonic() + 30.0
+        stable = 0
+        while stable < 2:
+            assert time.monotonic() < deadline, \
+                f"line never parked: {fleet.router.stats()}"
+            stats = fleet.router.stats()
+            if (stats["shards"][victim]["routed"] > routed0
+                    and not stats["shards"][victim]["alive"]):
+                stable += 1
+            else:
+                stable = 0
+            time.sleep(0.02)
+        time.sleep(0.2)   # a park dwell long enough to dominate
+        fleet.restart(victim)
+        line.join(timeout=60)
+        assert not line.is_alive()
+        assert replies and replies[0]["ok"], replies
+        parked = [r for r in fleet.router.joined_records()
+                  if "parked" in r["spans_ms"]]
+        assert parked, "replayed line must carry a parked hop"
+        record = parked[-1]
+        assert record["shard"] == victim
+        assert record["spans_ms"]["parked"] >= 50.0
+        assert record["dominant"] == "parked"
+        assert sum(record["spans_ms"].values()) == pytest.approx(
+            record["total_ms"], abs=0.01)
+
+
+# --------------------------------------------------------------------------- #
 # Subprocess launcher (slow tier: real processes, real SIGKILL)
 
 @pytest.mark.slow
@@ -504,6 +603,21 @@ def test_launcher_kill_restart_and_orphan_discipline(tmp_path):
         versions = [h["version"] for h in after["history"]]
         assert versions == sorted(set(versions))
         Membership.replay(after)  # monotonic by construction
+
+        # r19: the kill-failover left replayable incident bundles (the
+        # capture worker is async — poll briefly for the drain)
+        from byzantinemomentum_tpu.obs.trace import load_incidents
+        deadline = time.monotonic() + 30
+        reasons = set()
+        while time.monotonic() < deadline:
+            reasons = {b["reason"] for b in load_incidents(tmp_path)}
+            if {"arc_dead", "failover"} <= reasons:
+                break
+            time.sleep(0.5)
+        assert {"arc_dead", "failover"} <= reasons, reasons
+        for bundle in load_incidents(tmp_path):
+            assert bundle["kind"] == "incident"
+            assert "membership" in bundle["context"]
 
         shard_pids = [row["pid"] for row in after["shards"].values()]
         os.kill(proc.pid, signal.SIGKILL)

@@ -1,0 +1,205 @@
+"""SLO-triggered incident bundles (`obs/trace/incident.py`, r19): the
+atomic capture door (tmp -> fsync -> replace, no torn bundle ever
+readable), per-reason cooldown and the bounded directory ring, the
+provider-failure cell discipline (evidence gathering never kills the
+capture), index numbering that survives restarts, the fleet-scope merge
+across per-process incident trees, the ordered causal-story rendering
+(`edge -> dominant hop -> membership`), and the `obs_report` incidents
+section riding the same loader.
+
+Stdlib + pytest only — every test is deterministic (synchronous
+`capture` with explicit wall times; the worker-thread test only checks
+drain-on-stop)."""
+
+import json
+import os
+
+import pytest
+
+from byzantinemomentum_tpu.obs.trace import (
+    IncidentRecorder, load_incidents, merge_fleet_incidents,
+    render_incidents)
+from byzantinemomentum_tpu.obs.trace.incident import (
+    FLEET_INDEX_NAME, INCIDENTS_DIRNAME)
+
+
+def _trace_context():
+    """A router-stats-shaped trace cell whose joined summary names
+    `shard_queue` as the dominant hop (4 of 6 traces)."""
+    return {"joined": {"critical_path": {"shard_queue": 4,
+                                         "wire_residual": 2}}}
+
+
+# --------------------------------------------------------------------------- #
+# Capture: atomicity, schema, provider discipline
+
+
+def test_capture_writes_atomic_schema_complete_bundle(tmp_path):
+    recorder = IncidentRecorder(
+        tmp_path, source="launcher", cooldown_s=0.0,
+        providers={"trace": _trace_context,
+                   "membership": lambda: {"version": 3, "dead": []}})
+    path = recorder.capture("slo_burn", {"slo": "avail",
+                                         "burn_fast": 120.0,
+                                         "burn_slow": 15.0}, t=100.0)
+    assert path is not None and path.parent.name == INCIDENTS_DIRNAME
+    bundle = json.loads(path.read_text())
+    assert bundle["kind"] == "incident"
+    assert bundle["n"] == 1 and bundle["t"] == 100.0
+    assert bundle["reason"] == "slo_burn"
+    assert bundle["data"]["slo"] == "avail"
+    assert bundle["source"] == "launcher"
+    assert bundle["context"]["membership"]["version"] == 3
+    # atomic door: no orphan tmp after a clean capture
+    assert not list(path.parent.glob("*.tmp"))
+    assert recorder.summary()["captured"] == 1
+
+
+def test_provider_failure_forfeits_its_cell_not_the_bundle(tmp_path):
+    def broken():
+        raise RuntimeError("scrape lost the socket")
+
+    recorder = IncidentRecorder(
+        tmp_path, cooldown_s=0.0,
+        providers={"metrics": broken, "membership": lambda: {"v": 1}})
+    path = recorder.capture("arc_dead", {"shard": "shard-1"})
+    bundle = json.loads(path.read_text())
+    assert bundle["context"]["membership"] == {"v": 1}
+    assert "RuntimeError" in bundle["context"]["metrics"]["error"]
+    # the report marks the failed cell without dropping the bundle
+    lines = render_incidents(tmp_path)
+    assert any("evidence: membership (failed: metrics)" in line
+               for line in lines)
+
+
+def test_cooldown_dedupes_flapping_reason_only(tmp_path):
+    recorder = IncidentRecorder(tmp_path, cooldown_s=60.0)
+    assert recorder.capture("slo_burn") is not None
+    assert recorder.capture("slo_burn") is None      # inside the window
+    assert recorder.capture("arc_dead") is not None  # distinct reason
+    summary = recorder.summary()
+    assert summary["captured"] == 2 and summary["dropped"] == 1
+
+
+def test_directory_ring_and_restart_safe_numbering(tmp_path):
+    recorder = IncidentRecorder(tmp_path, limit=3, cooldown_s=0.0)
+    for k in range(5):
+        recorder.capture(f"edge-{k}", t=float(k))
+    names = sorted(os.listdir(tmp_path / INCIDENTS_DIRNAME))
+    assert names == ["incident-3.json", "incident-4.json",
+                     "incident-5.json"]
+    # a restarted process resumes PAST the surviving evidence — a
+    # fresh recorder must never overwrite a prior incarnation's bundle
+    reborn = IncidentRecorder(tmp_path, limit=3, cooldown_s=0.0)
+    path = reborn.capture("post-restart")
+    assert path.name == "incident-6.json"
+
+
+def test_trigger_worker_drains_on_stop(tmp_path):
+    recorder = IncidentRecorder(tmp_path, cooldown_s=0.0).start()
+    recorder.trigger("slo_burn", slo="avail")
+    recorder.trigger("arc_dead", shard="shard-0")
+    recorder.stop()
+    reasons = sorted(b["reason"] for b in load_incidents(tmp_path))
+    assert reasons == ["arc_dead", "slo_burn"]
+    recorder.stop()  # idempotent
+
+
+# --------------------------------------------------------------------------- #
+# Loading: torn tolerance, fleet-scope crawl, ordering
+
+
+def test_loader_skips_torn_files_and_orders_by_time(tmp_path):
+    recorder = IncidentRecorder(tmp_path, cooldown_s=0.0)
+    recorder.capture("late", t=200.0)
+    recorder.capture("early", t=50.0)
+    directory = tmp_path / INCIDENTS_DIRNAME
+    # a SIGKILL mid-write leaves exactly these shapes behind
+    (directory / "incident-9.json.tmp").write_text('{"kind": "inci')
+    (directory / "incident-7.json").write_text('{"kind": "incident", ')
+    (directory / "incident-8.json").write_text('[1, 2]')  # not a dict
+    bundles = load_incidents(tmp_path)
+    assert [b["reason"] for b in bundles] == ["early", "late"]
+
+
+def test_fleet_crawl_tags_sources_and_merge_orders_rows(tmp_path):
+    IncidentRecorder(tmp_path, source="launcher", cooldown_s=0.0,
+                     providers={"trace": _trace_context}).capture(
+        "slo_burn", {"slo": "avail", "burn_fast": 40.0,
+                     "burn_slow": 12.0}, t=10.0)
+    IncidentRecorder(tmp_path / "shards" / "shard-1",
+                     cooldown_s=0.0).capture(
+        "arc_dead", {"shard": "shard-1"}, t=5.0)
+    IncidentRecorder(tmp_path / "hosts" / "h2", cooldown_s=0.0).capture(
+        "straggler_kill", {"host": "h2", "why": "stale"}, t=20.0)
+    bundles = load_incidents(tmp_path)
+    # per-process writers that did not stamp a source get their
+    # directory name; wall-time order joins the trees
+    assert [(b["reason"], b["source"]) for b in bundles] == [
+        ("arc_dead", "shard-1"), ("slo_burn", "launcher"),
+        ("straggler_kill", "h2")]
+    index = merge_fleet_incidents(tmp_path)
+    assert index.name == FLEET_INDEX_NAME
+    payload = json.loads(index.read_text())
+    assert payload["kind"] == "incident_index"
+    assert payload["incidents"] == 3
+    rows = payload["rows"]
+    assert [row["reason"] for row in rows] == ["arc_dead", "slo_burn",
+                                               "straggler_kill"]
+    # the merged headline carries the dominant hop when the bundle's
+    # trace context names one
+    assert rows[1]["dominant_hop"] == "shard_queue"
+    assert "dominant_hop" not in rows[0]
+    assert merge_fleet_incidents(tmp_path / "empty") is None
+
+
+# --------------------------------------------------------------------------- #
+# Rendering: the ordered causal story
+
+
+def test_render_replays_the_causal_story(tmp_path):
+    IncidentRecorder(
+        tmp_path, source="launcher", cooldown_s=0.0,
+        providers={"trace": _trace_context,
+                   "membership": lambda: {"version": 4,
+                                          "dead": ["shard-1"]}}).capture(
+        "slo_burn", {"slo": "avail", "burn_fast": 120.5,
+                     "burn_slow": 15.25}, t=30.0)
+    lines = render_incidents(tmp_path)
+    assert lines[0].startswith("incidents: 1 bundle (1 launcher)")
+    story = next(line for line in lines if "story:" in line)
+    # edge -> dominant hop -> membership transition, in that order
+    assert "slo_burn[avail] fast=120.50 slow=15.25" in story
+    assert story.index("slo_burn") < story.index("dominant hop "
+                                                 "shard_queue (4/6")
+    assert story.index("shard_queue") < story.index(
+        "membership v4 dead=['shard-1']")
+
+
+def test_render_elides_past_limit_and_empty_dir(tmp_path):
+    assert render_incidents(tmp_path) == []
+    recorder = IncidentRecorder(tmp_path, cooldown_s=0.0)
+    for k in range(5):
+        recorder.capture(f"edge-{k}", t=float(k))
+    lines = render_incidents(tmp_path, limit=2)
+    assert lines[0].startswith("incidents: 5 bundles")
+    shown = [line for line in lines if line.startswith("  incident-")]
+    assert len(shown) == 2 and "incident-5" in shown[-1]
+    assert lines[-1] == "  ... 3 older bundle(s) not shown"
+
+
+def test_obs_report_grows_an_incidents_section(tmp_path):
+    from byzantinemomentum_tpu.obs.report import render_report
+
+    IncidentRecorder(tmp_path, source="launcher", cooldown_s=0.0,
+                     providers={"trace": _trace_context}).capture(
+        "failover", {"shard": "shard-0", "restarts": 1}, t=7.0)
+    report = render_report(tmp_path)
+    assert "incidents: 1 bundle" in report
+    assert "story: failover[shard-0] -> dominant hop shard_queue" \
+        in report
+
+
+def test_recorder_rejects_bad_limit(tmp_path):
+    with pytest.raises(ValueError):
+        IncidentRecorder(tmp_path, limit=0)

@@ -574,3 +574,115 @@ def test_stale_edges_unknown_edge_censors(tmp_path):
     episodes = stale_edges.stale_episodes(load_records(run))
     assert episodes["recovered"] == [] and episodes["died"] == []
     assert episodes["censored"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# Cross-process span join (r19): shard records splice into the router
+# envelope clock-free
+
+def _router_stamps(**extra):
+    """recv -> routed -> reply at 0/1/21 ms (route 1 ms, rtt 20 ms)."""
+    base = 500.0
+    stamps = {"recv": base, "routed": base + 0.001, "reply": base + 0.021}
+    stamps.update({k: base + v for k, v in extra.items()})
+    return stamps
+
+
+def _shard_record(**overrides):
+    spans = {"parse": 0.5, "validate": 0.5, "queue": 5.0, "pack": 1.0,
+             "dispatch": 1.0, "resolver_wake": 1.0, "device": 2.0,
+             "resolve": 1.0}
+    spans.update(overrides)
+    return {"trace_id": "jt-1", "spans_ms": spans, "total_ms": 12.0}
+
+
+def test_join_shard_trace_tiles_exactly():
+    from byzantinemomentum_tpu.obs.trace import join_shard_trace
+    joined = join_shard_trace(_router_stamps(), _shard_record())
+    assert joined is not None
+    spans = joined["spans_ms"]
+    # parse+validate fold into one shard_frontend hop
+    assert spans["shard_frontend"] == pytest.approx(1.0, abs=1e-4)
+    assert spans["shard_queue"] == pytest.approx(5.0, abs=1e-4)
+    assert spans["route"] == pytest.approx(1.0, abs=1e-4)
+    # residual = rtt(20) - nested(12) = 8; spans tile recv->reply
+    assert spans["wire_residual"] == pytest.approx(8.0, abs=1e-4)
+    assert sum(spans.values()) == pytest.approx(joined["total_ms"],
+                                                abs=1e-3)
+    assert joined["dominant"] == "wire_residual"
+    assert joined["trace_id"] == "jt-1"
+
+
+def test_join_parked_stamp_pair_becomes_its_own_hop():
+    from byzantinemomentum_tpu.obs.trace import join_shard_trace
+    stamps = _router_stamps(parked=0.002, unparked=0.006)
+    joined = join_shard_trace(stamps, _shard_record())
+    spans = joined["spans_ms"]
+    assert spans["parked"] == pytest.approx(4.0, abs=1e-4)
+    # The park comes OUT of the wire residual, not the shard columns
+    assert spans["wire_residual"] == pytest.approx(4.0, abs=1e-4)
+    assert sum(spans.values()) == pytest.approx(joined["total_ms"],
+                                                abs=1e-3)
+    # No parked hop without both stamps / with zero dwell
+    assert "parked" not in join_shard_trace(
+        _router_stamps(parked=0.002), _shard_record())["spans_ms"]
+
+
+def test_join_wire_residual_clamps_nonnegative():
+    from byzantinemomentum_tpu.obs.trace import join_shard_trace
+    # Shard timers sum past the envelope (scheduler quantum): clamp
+    joined = join_shard_trace(_router_stamps(),
+                              _shard_record(device=40.0))
+    assert joined["spans_ms"]["wire_residual"] == 0.0
+
+
+def test_join_malformed_records_degrade_to_none():
+    from byzantinemomentum_tpu.obs.trace import join_shard_trace
+    stamps = _router_stamps()
+    assert join_shard_trace(stamps, None) is None
+    assert join_shard_trace(stamps, "not-a-dict") is None
+    assert join_shard_trace(stamps, {"spans_ms": [1, 2]}) is None
+    assert join_shard_trace(stamps, _shard_record(queue=-1.0)) is None
+    assert join_shard_trace(stamps, _shard_record(queue="5ms")) is None
+    # No recognizable phase at all
+    assert join_shard_trace(stamps, {"spans_ms": {"zstd": 1.0}}) is None
+    # Incomplete router envelope tiles nothing
+    partial = {"recv": 500.0, "reply": 500.021}
+    assert join_shard_trace(partial, _shard_record()) is None
+
+
+def test_join_unknown_phases_pass_through():
+    from byzantinemomentum_tpu.obs.trace import JOINED_HOPS, join_shard_trace
+    joined = join_shard_trace(_router_stamps(),
+                              _shard_record(zstd=3.0))
+    assert joined is not None
+    # The unknown phase is skipped, not summed and not a column
+    assert "zstd" not in joined["spans_ms"]
+    assert joined["spans_ms"]["wire_residual"] == pytest.approx(
+        8.0, abs=1e-4)
+    assert set(joined["spans_ms"]) <= set(JOINED_HOPS)
+    # Non-str trace ids are dropped rather than propagated
+    record = _shard_record()
+    record["trace_id"] = 7
+    assert "trace_id" not in join_shard_trace(_router_stamps(), record)
+
+
+def test_dominant_hop_deterministic():
+    from byzantinemomentum_tpu.obs.trace import dominant_hop
+    assert dominant_hop({}) is None
+    assert dominant_hop({"a": 1.0, "b": 3.0, "c": 2.0}) == "b"
+    # Ties break to the earliest-inserted name
+    assert dominant_hop({"x": 2.0, "y": 2.0}) == "x"
+
+
+def test_trace_buffer_summary_counts_critical_path():
+    from byzantinemomentum_tpu.obs.trace import join_shard_trace
+    buf = TraceBuffer(maxlen=16)
+    for _ in range(3):
+        buf.add(join_shard_trace(_router_stamps(), _shard_record()))
+    buf.add(join_shard_trace(_router_stamps(),
+                             _shard_record(queue=30.0)))
+    summary = buf.summary()
+    assert summary["critical_path"] == {"wire_residual": 3,
+                                        "shard_queue": 1}
+    assert summary["phases_ms"]["shard_queue"]["max"] >= 30.0
