@@ -32,11 +32,22 @@ properties of the source and of the lowering itself:
              replayable schedule strings, exhaustive bounded-preemption
              exploration, deadlock detection) that demonstrates the
              races the T-rules claim and pins the fixed code as
-             schedule-clean.
+             schedule-clean. `MODEL_COVERAGE` names the files each
+             model vouches for — the BMT-L06 covenant input.
+  locks      BMT-L whole-program lock discipline: an interprocedural
+             lock-order graph (call-graph + lock-set fixpoint across
+             modules) with deadlock-cycle detection (L01), transitive
+             blocking-under-lock (L02), lock-held callbacks (L03),
+             inconsistent order (L04), unlocked lazy init (L05) and
+             the mechanical thread-surface covenant (L06); blessed
+             hierarchy in `tests/goldens/locks.json`
+             (`scripts/bless_locks.py`), runtime cross-check via
+             `contracts.record_lock_edges` + `utils/locking.NamedLock`.
 
 CLI: `python -m byzantinemomentum_tpu.analysis <paths...>` lints (E- and
-T-families); `--check-lowerings` runs the drift gate; `--schedule-smoke`
-runs the interleaving-harness selfcheck; `--rules` prints the rule table.
+T-families); `--check-lowerings` runs the drift gate; `--check-locks`
+runs the BMT-L sweep + golden gate; `--schedule-smoke` runs the
+interleaving-harness selfcheck; `--rules` prints the rule table.
 Suppressions are per-line `# bmt: noqa[BMT-Exx] <reason>` and the reason
 is mandatory (an empty reason is itself a violation, `BMT-E00`).
 """
@@ -44,5 +55,9 @@ is mandatory (an empty reason is itself a violation, `BMT-E00`).
 from byzantinemomentum_tpu.analysis import lint  # noqa: F401 (jax-free)
 # Importing registers the BMT-T concurrency rules in lint.RULES (jax-free)
 from byzantinemomentum_tpu.analysis import concurrency  # noqa: F401
+# ... and the BMT-L lock-discipline rule ids (driver rules: the ids
+# validate noqas and fill the --rules table; the checks run in
+# locks.build/check, not the per-module pass)
+from byzantinemomentum_tpu.analysis import locks  # noqa: F401
 
-__all__ = ["lint", "concurrency"]
+__all__ = ["lint", "concurrency", "locks"]

@@ -2,12 +2,14 @@
 (jaxlint BMT-E rules AND the BMT-T concurrency rules — both AST
 families run in one pass); `--check-lowerings` runs the lattice drift
 gate (StableHLO fingerprints + BMT-H structural lint over every
-enumerated cell); `--schedule-smoke` runs the deterministic
-interleaving harness's selfcheck (the planted serve-counter lost-update
-must be found; the fixed pattern must be schedule-clean); `--rules`
-prints all three registries (E, H, T) in one table. Exit 0 = clean (or
-incomparable goldens), 1 = violations/drift/failed smoke, 2 = usage
-error."""
+enumerated cell); `--check-locks` runs the whole-program BMT-L sweep
+(interprocedural lock-order graph + deadlock/blocking rules) and gates
+the blessed hierarchy (`tests/goldens/locks.json`);
+`--schedule-smoke` runs the deterministic interleaving harness's
+selfcheck (the planted serve-counter lost-update must be found; the
+fixed pattern must be schedule-clean); `--rules` prints all four
+registries (E, H, T, L) in one table. Exit 0 = clean (or incomparable
+goldens), 1 = violations/drift/failed smoke, 2 = usage error."""
 
 import argparse
 import json
@@ -67,6 +69,32 @@ def _check_lowerings(goldens, as_json):
     return 0 if report["status"] in ("ok", "incomparable") else 1
 
 
+def _check_locks(goldens, as_json):
+    from byzantinemomentum_tpu.analysis import locks
+
+    report = (locks.check(goldens) if goldens else locks.check())
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(f"locks: {report['status']} ({report['locks']} locks, "
+              f"{report['edges']} edges, {report['cycles']} cycles, "
+              f"{report['files']} files, "
+              f"{report['suppressed']} suppressed)")
+        for v in report["violations"]:
+            print(f"  {v['path']}:{v['line']}: {v['rule']} {v['message']}")
+        for key, items in sorted(report.get("drift", {}).items()):
+            for item in items:
+                print(f"  {key}: {item}")
+        if report["status"] == "missing":
+            print("  no goldens — run scripts/bless_locks.py")
+        if report["status"] == "incomparable":
+            print(f"  blessed under python {report['blessed_python']} — "
+                  f"re-bless, not a drift failure")
+    # Same stance as the lowering gate: missing goldens fail,
+    # incomparable (toolchain bump) does not — but violations always do.
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m byzantinemomentum_tpu.analysis",
@@ -80,6 +108,10 @@ def main(argv=None):
     parser.add_argument("--check-lowerings", action="store_true",
                         help="compare StableHLO fingerprints against the "
                              "blessed goldens")
+    parser.add_argument("--check-locks", action="store_true",
+                        help="run the whole-program BMT-L lock sweep and "
+                             "compare the lock-order graph against the "
+                             "blessed hierarchy (tests/goldens/locks.json)")
     parser.add_argument("--schedule-smoke", action="store_true",
                         help="run the interleaving-harness selfcheck "
                              "(analysis/schedule.py): the planted "
@@ -94,9 +126,10 @@ def main(argv=None):
         _print_rules()
         return 0
     if (not args.paths and not args.check_lowerings
-            and not args.schedule_smoke):
+            and not args.check_locks and not args.schedule_smoke):
         parser.error("nothing to do: give paths to lint, "
-                     "--check-lowerings, --schedule-smoke, or --rules")
+                     "--check-lowerings, --check-locks, "
+                     "--schedule-smoke, or --rules")
 
     rc = 0
     if args.paths:
@@ -109,6 +142,11 @@ def main(argv=None):
         rc = 1 if violations else rc
     if args.check_lowerings:
         rc = max(rc, _check_lowerings(args.goldens, args.json))
+    if args.check_locks:
+        # `--goldens` belongs to whichever single gate runs; when both
+        # gates run it keeps its documented meaning (lowerings).
+        override = args.goldens if not args.check_lowerings else None
+        rc = max(rc, _check_locks(override, args.json))
     if args.schedule_smoke:
         from byzantinemomentum_tpu.analysis import schedule
         report = schedule.selfcheck()

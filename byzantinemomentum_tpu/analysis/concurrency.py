@@ -83,7 +83,8 @@ __all__ = ["ClassThreads", "module_classes"]
 # --------------------------------------------------------------------------- #
 # Shared syntactic helpers
 
-_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition",
+                             "NamedLock", "NamedCondition"})
 _EVENT_FACTORIES = frozenset({"Event", "Semaphore", "BoundedSemaphore",
                               "Barrier"})
 _QUEUE_FACTORIES = frozenset({"Queue", "SimpleQueue", "LifoQueue",
@@ -117,14 +118,21 @@ def _self_attr(node):
 
 
 def _imports_threading(tree):
+    # The named wrappers (utils/locking) put a module in scope exactly
+    # like a bare `import threading` would: they are locks.
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             if any(a.name.split(".")[0] in ("threading", "socketserver")
+                   or a.name.endswith(".locking")
                    for a in node.names):
                 return True
         elif isinstance(node, ast.ImportFrom):
-            if (node.module or "").split(".")[0] in ("threading",
-                                                     "socketserver"):
+            module = node.module or ""
+            if (module.split(".")[0] in ("threading", "socketserver")
+                    or module.endswith("locking")
+                    or any(a.name in ("locking", "NamedLock",
+                                      "NamedCondition")
+                           for a in node.names)):
                 return True
     return False
 

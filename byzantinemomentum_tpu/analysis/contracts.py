@@ -26,7 +26,8 @@ import contextlib
 
 __all__ = ["ContractError", "RecompileBudgetError", "count_compiles",
            "explain_cache_misses", "assert_recompile_budget",
-           "no_implicit_transfers"]
+           "no_implicit_transfers", "LockOrderError",
+           "record_lock_edges", "assert_lock_edges_subset"]
 
 
 class ContractError(AssertionError):
@@ -155,3 +156,67 @@ def no_implicit_transfers(scope="thread"):
         yield
     finally:
         jax.config.update("jax_transfer_guard", old)
+
+
+# --------------------------------------------------------------------------- #
+# Lock-order contract (BMT-L runtime cross-check)
+#
+# The static half (`analysis/locks.py`) derives the whole-program
+# lock-order graph from the source; this is the dynamic half. Every
+# shared lock is a `utils/locking.NamedLock`, which reports each
+# acquisition as `(held, taken)` pairs to an installed recorder. A
+# serving window recorded under `record_lock_edges` therefore yields the
+# set of ordering edges the process ACTUALLY exercised — and soundness of
+# the static graph means that set must be a subset of the blessed static
+# edges. An extra runtime edge is either a lock the analysis cannot see
+# (fix the analysis) or a code path taking locks in an order the graph
+# never blessed (fix the code); both are contract failures, not warnings.
+
+
+class LockOrderError(ContractError):
+    """The serving window exercised a lock-order edge the static
+    lock-order graph does not contain."""
+
+
+@contextlib.contextmanager
+def record_lock_edges():
+    """Record every NamedLock ordering edge exercised inside the window.
+
+    Yields a set that fills with `(held_name, taken_name)` pairs as
+    threads nest named locks; reads of the set are racy-but-monotone
+    (callers inspect it after the window closes). Restores any
+    previously installed recorder on exit, so windows nest."""
+    from byzantinemomentum_tpu.utils import locking
+
+    edges = set()
+    previous = locking.install_recorder(edges.add)
+    try:
+        yield edges
+    finally:
+        locking.uninstall_recorder(previous)
+
+
+def assert_lock_edges_subset(edges, static_edges=None, *, paths=None):
+    """Assert a recorded edge set is covered by the static graph.
+
+    `edges` is what `record_lock_edges` collected; `static_edges`
+    defaults to a fresh `locks.static_edges()` sweep over `paths` (the
+    repo, by default). Self-edges (same name held and taken — distinct
+    instances sharing a role name, e.g. two `metrics.counter` cells) are
+    ignored, matching the static graph's convention. Returns the number
+    of distinct runtime edges checked; raises `LockOrderError` listing
+    every uncovered edge otherwise."""
+    from byzantinemomentum_tpu.analysis import locks
+
+    if static_edges is None:
+        static_edges = locks.static_edges(paths=paths)
+    runtime = {(held, taken) for held, taken in edges if held != taken}
+    extra = sorted(runtime - set(static_edges))
+    if extra:
+        rendered = ", ".join(f"{a} -> {b}" for a, b in extra)
+        raise LockOrderError(
+            f"{len(extra)} runtime lock-order edge(s) missing from the "
+            f"static lock-order graph: {rendered} — either the analysis "
+            f"cannot see an acquisition site (extend locks.py) or a code "
+            f"path orders locks the blessed hierarchy never allowed")
+    return len(runtime)

@@ -43,6 +43,11 @@ class Rule:
     slug: str      # "broad-except"
     summary: str   # one line for the --rules table
     check: object  # callable(Module) -> iterable[Violation]
+    # Driver rules register an id (for the --rules table and BMT-E00
+    # unknown-id validation) but fire from their own whole-program
+    # driver, not the per-module pass — so BMT-E09 cannot decide whether
+    # a suppression naming one is dead and must skip it.
+    driver: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,9 +65,9 @@ class Violation:
 RULES = {}
 
 
-def rule(rule_id, slug, summary):
+def rule(rule_id, slug, summary, driver=False):
     def wrap(fn):
-        RULES[rule_id] = Rule(rule_id, slug, summary, fn)
+        RULES[rule_id] = Rule(rule_id, slug, summary, fn, driver)
         return fn
     return wrap
 
@@ -794,6 +799,90 @@ def _check_lock_in_hot_path(mod):
 
 
 # --------------------------------------------------------------------------- #
+# BMT-E11 — check-then-act lazy init inside a traced scope
+
+def _module_global_names(mod):
+    names = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.update(e.id for e in t.elts
+                             if isinstance(e, ast.Name))
+    return names
+
+
+def _assigns_name(body, name):
+    for node in body:
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)
+                    and sub.id == name):
+                return True
+    return False
+
+
+def _stores_subscript(body, dotted):
+    for node in body:
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.ctx, ast.Store)
+                    and _dotted(sub.value) == dotted):
+                return True
+    return False
+
+
+@rule("BMT-E11", "lazy-init-in-trace",
+      "check-then-act lazy initialization (`if x is None: x = ...` / "
+      "`if k not in cache: cache[k] = ...`) inside a traced scope — the "
+      "check evaluates once at trace time, so the fill is baked into "
+      "the jaxpr (or silently skipped on replay) and the unlocked "
+      "read-test-write is a data race besides")
+def _check_lazy_init_in_trace(mod):
+    out = []
+    globals_ = _module_global_names(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.If) or not mod.in_traced(node):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+            continue
+        op = test.ops[0]
+        if (isinstance(op, ast.Is)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None):
+            target = test.left
+            if (isinstance(target, ast.Name) and target.id in globals_
+                    and _assigns_name(node.body, target.id)):
+                out.append(Violation(
+                    mod.path, node.lineno, node.col_offset, "BMT-E11",
+                    f"lazy init of module global {target.id!r} in a "
+                    f"traced scope — the None-check evaluates once at "
+                    f"trace time; initialize eagerly at import, or hoist "
+                    f"the fill out of the traced function"))
+        elif isinstance(op, ast.NotIn):
+            container = _dotted(test.comparators[0])
+            if container is None:
+                continue
+            root = container.split(".")[0]
+            if ((root in globals_ or root == "self")
+                    and _stores_subscript(node.body, container)):
+                out.append(Violation(
+                    mod.path, node.lineno, node.col_offset, "BMT-E11",
+                    f"check-then-act cache fill on {container!r} in a "
+                    f"traced scope — the membership test traces once and "
+                    f"the store is a hidden side effect under jit; "
+                    f"populate the cache outside the trace"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # BMT-E09 — dead suppressions (annotations must not rot)
 
 @rule("BMT-E09", "dead-noqa",
@@ -811,7 +900,7 @@ def _dead_noqa_violations(mod, selected, fired):
     `all`-suppressions and unknown ids are out of scope (the latter are
     BMT-E00's)."""
     checkable = {rid for rid in selected if rid not in
-                 ("BMT-E00", "BMT-E09")}
+                 ("BMT-E00", "BMT-E09") and not selected[rid].driver}
     out = []
     for line, (ids, _reason) in sorted(mod.noqa.items()):
         for rid in sorted(ids):

@@ -1,0 +1,982 @@
+"""BMT-L — whole-program lock discipline over an interprocedural
+lock-order graph.
+
+`analysis/concurrency.py`'s BMT-T rules are per-class: they see `with
+self._lock:` around a blocking call in the SAME method, but are blind
+to `scrape_once` holding the scraper lock across a call into
+`append_snapshot` (a different module) that fsyncs. This module builds
+the missing whole-program picture:
+
+1. every parsed module's classes (via `concurrency.ClassThreads`) and
+   top-level functions become analysis *units*;
+2. cross-unit call edges are resolved through `self.method(...)`,
+   typed attributes (`self.batcher = MicroBatcher(...)` makes
+   `self.batcher.submit(...)` a call into `MicroBatcher.submit`), and
+   package-imported module functions;
+3. a bottom-up fixpoint computes, per unit, the transitive sets of
+   locks acquired, blocking calls reached, and callbacks invoked —
+   each with a `file:line` witness chain;
+4. a top-down pass emits every acquisition edge `(held -> taken)` in
+   the global lock-NAME graph plus the L-rule violations.
+
+Lock naming: a `NamedLock("router.ring")` / `NamedCondition(...)`
+literal is the lock's name; anonymous `threading.Lock()` attributes
+fall back to `ClassName.attr` (module-level locks to `modstem.VAR`).
+Names label *roles*, not instances — two Counters share the name
+`metrics.counter`, which is why self-edges (name -> same name) are
+dropped rather than reported as self-deadlock.
+
+The rules (all *driver* rules: they register for the `--rules` table
+and noqa validation, but fire from `build()`/`check()` here, not the
+per-module jaxlint pass):
+
+  BMT-L01  deadlock-cycle        SCC in the lock-order graph whose
+                                 edges are exercised by >= 2 distinct
+                                 thread roles (or any multi-instance
+                                 role) — an actual deadlock.
+  BMT-L02  blocking-under-lock   a curated-table blocking call
+                                 (fsync, socket send/recv/accept,
+                                 subprocess, time.sleep, future
+                                 .result, jax.device_get /
+                                 block_until_ready, bare queue.get)
+                                 reached while a lock is held —
+                                 directly or through the call graph.
+  BMT-L03  lock-held-callback    a user/registry callback (ctor-param
+                                 callable, *hook/on_*/observer name,
+                                 or `emit()`) invoked under a lock —
+                                 arbitrary foreign code inside the
+                                 critical section.
+  BMT-L04  inconsistent-order    both orders of a lock pair appear
+                                 but only ever on one single-instance
+                                 thread role — latent inversion, one
+                                 refactor away from L01.
+  BMT-L05  check-then-act-init   lazy init (`if x is None: x = ...` /
+                                 `if k not in d: d[k] = ...`) on a
+                                 module or object global with no lock
+                                 held, in a threading module.
+  BMT-L06  missing-schedule-model any file constructing Thread/Lock/
+                                 Condition must be named by an
+                                 `analysis/schedule.py` model
+                                 (`MODEL_COVERAGE`) or carry a
+                                 reasoned `# bmt: noqa[BMT-L06]`.
+
+Suppression uses the standard per-line `# bmt: noqa[BMT-L02] reason`
+(reason mandatory — enforced here exactly like jaxlint's BMT-E00).
+
+The blessed hierarchy lives in `tests/goldens/locks.json` (lock names,
+edge census, topological order): `check()` reports ok / drift /
+missing / incomparable (python-version coordinate mismatch), and
+`scripts/bless_locks.py` re-blesses, printing pruned/added census
+entries. The runtime half is `utils/locking.py` + `analysis/contracts.
+record_lock_edges`: actual named-lock acquisition edges observed while
+serving must be a subset of this static graph.
+"""
+
+import ast
+import dataclasses
+import json
+import pathlib
+import sys
+
+from byzantinemomentum_tpu.analysis import concurrency
+from byzantinemomentum_tpu.analysis.lint import (
+    Module, Violation, _dotted, _terminal, iter_python_files, rule)
+from byzantinemomentum_tpu.analysis.concurrency import (
+    _self_attr, module_classes)
+
+__all__ = ["build", "check", "bless", "census", "static_edges",
+           "LockGraph", "GOLDEN_PATH", "DEFAULT_PATHS"]
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_PATHS = (ROOT / "byzantinemomentum_tpu", ROOT / "scripts")
+GOLDEN_PATH = ROOT / "tests" / "goldens" / "locks.json"
+
+_WITNESS_CAP = 6
+
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition",
+                             "NamedLock", "NamedCondition"})
+_NAMED_FACTORIES = frozenset({"NamedLock", "NamedCondition"})
+_THREAD_FACTORIES = _LOCK_FACTORIES | {"Thread"}
+
+# The curated blocking-callable table (BMT-L02). Deliberately small and
+# named: every entry is an unbounded (or disk/network-bound) wait that
+# has no business inside a critical section. `.wait()`/`.join()` stay
+# BMT-T04's per-class domain.
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep() parks the thread",
+    "os.fsync": "os.fsync() waits on the disk",
+    "os.replace": "os.replace() waits on the filesystem",
+    "jax.device_get": "jax.device_get() blocks on device transfer",
+    "jax.block_until_ready": "jax.block_until_ready() waits on the device",
+    "socket.create_connection":
+        "socket.create_connection() waits on the network",
+}
+_BLOCKING_ATTRS = {
+    "sendall": "socket .sendall() waits on the network",
+    "recv": "socket .recv() waits on the network",
+    "recv_into": "socket .recv_into() waits on the network",
+    "accept": "socket .accept() waits on the network",
+    "connect": ".connect() waits on the network",
+    "fsync": ".fsync() waits on the disk",
+    "result": "future .result() is an unbounded wait",
+    "urlopen": "urlopen() waits on the network",
+    "getaddrinfo": "getaddrinfo() waits on the resolver",
+    "device_get": ".device_get() blocks on device transfer",
+    "block_until_ready": ".block_until_ready() waits on the device",
+}
+
+_CALLBACK_MARKERS = ("hook", "callback", "observer", "provider",
+                     "listener")
+
+
+# --------------------------------------------------------------------------- #
+# Rule registration (driver rules: the checks live in build(), below)
+
+def _driver_rule(rid, slug, summary):
+    @rule(rid, slug, summary, driver=True)
+    def _check(mod):
+        return ()
+    return _check
+
+
+_driver_rule("BMT-L01", "deadlock-cycle",
+             "a cycle in the whole-program lock-order graph reachable "
+             "from >= 2 thread roles — these threads can deadlock")
+_driver_rule("BMT-L02", "blocking-under-lock",
+             "a curated-table blocking call (fsync/socket/subprocess/"
+             "sleep/result/device_get/queue.get) reached while a lock "
+             "is held, directly or through the call graph")
+_driver_rule("BMT-L03", "lock-held-callback",
+             "a user/registry callback or emit() invoked under a lock "
+             "— foreign code runs inside the critical section")
+_driver_rule("BMT-L04", "inconsistent-lock-order",
+             "a lock pair acquired in both orders on a single thread "
+             "role — latent inversion, one refactor from a deadlock")
+_driver_rule("BMT-L05", "check-then-act-init",
+             "lazy check-then-act initialization of a module/object "
+             "global with no lock held in a threading module")
+_driver_rule("BMT-L06", "missing-schedule-model",
+             "a file constructing Thread/Lock/Condition that no "
+             "analysis/schedule.py model names (MODEL_COVERAGE) and "
+             "that carries no reasoned noqa")
+
+
+# --------------------------------------------------------------------------- #
+# Program model
+
+def _rel(path):
+    try:
+        return pathlib.Path(path).resolve().relative_to(ROOT).as_posix()
+    except ValueError:
+        return str(path)
+
+
+class _ClassInfo:
+    """Per-class extras the lock graph needs on top of ClassThreads."""
+
+    def __init__(self, modinfo, cls):
+        self.modinfo = modinfo
+        self.cls = cls
+        self.lock_names = {}    # lock attr -> global lock name
+        self.typed_attrs = {}   # attr -> class name it is constructed from
+        self.param_attrs = set()  # attrs assigned from an __init__ param
+        init = cls.methods.get("__init__")
+        params = set()
+        if init is not None:
+            args = init.args
+            params = {a.arg for a in (args.posonlyargs + args.args
+                                      + args.kwonlyargs)} - {"self"}
+        for method in cls.methods.values():
+            for stmt in ast.walk(method):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for target in stmt.targets:
+                    attr = _self_attr(target)
+                    if attr is None:
+                        continue
+                    value = stmt.value
+                    if isinstance(value, ast.Call):
+                        factory = _terminal(value.func)
+                        if attr in cls.lock_attrs:
+                            self.lock_names.setdefault(
+                                attr, self._lock_name(attr, factory, value))
+                        elif (factory and factory[0].isupper()
+                              and factory not in _THREAD_FACTORIES):
+                            self.typed_attrs.setdefault(attr, factory)
+                    elif (method is init and isinstance(value, ast.Name)
+                          and value.id in params):
+                        self.param_attrs.add(attr)
+        for attr in cls.lock_attrs:
+            self.lock_names.setdefault(attr, f"{cls.name}.{attr}")
+
+    def _lock_name(self, attr, factory, call):
+        if (factory in _NAMED_FACTORIES and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return call.args[0].value
+        return f"{self.cls.name}.{attr}"
+
+
+class _ModInfo:
+    def __init__(self, mod):
+        self.mod = mod
+        self.rel = _rel(mod.path)
+        self.stem = pathlib.Path(mod.path).stem
+        self.classes = []       # filled by the builder (needs registries)
+        self.funcs = {n.name: n for n in mod.tree.body
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        self.module_locks = {}  # module-level var -> lock name
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            factory = _terminal(node.value.func)
+            if factory not in _LOCK_FACTORIES:
+                continue
+            name = None
+            if (factory in _NAMED_FACTORIES and node.value.args
+                    and isinstance(node.value.args[0], ast.Constant)
+                    and isinstance(node.value.args[0].value, str)):
+                name = node.value.args[0].value
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.module_locks[target.id] = (
+                        name or f"{self.stem}.{target.id}")
+        # Names this module binds from package-internal imports: the
+        # visibility gate for by-name function resolution (bare names
+        # like `main` exist in every script; only resolve what the
+        # module can actually see).
+        self.pkg_names = set(self.funcs)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.level or (node.module or "").startswith(
+                        "byzantinemomentum_tpu"):
+                    self.pkg_names.update(
+                        a.asname or a.name for a in node.names)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("byzantinemomentum_tpu"):
+                        self.pkg_names.add(
+                            a.asname or a.name.split(".")[0])
+
+
+@dataclasses.dataclass
+class LockGraph:
+    """The whole-program result: lock names, acquisition edges (with
+    witness + exercising roles), cycles, and the L-rule violations that
+    survived noqa filtering."""
+    locks: set
+    edges: dict          # (held, taken) -> {"witness", "roles", "path", "line"}
+    cycles: list         # list of sorted lock-name lists (SCCs >= 2)
+    violations: list     # unsuppressed Violations
+    suppressed: int
+    files: int
+
+
+# --------------------------------------------------------------------------- #
+# Event extraction
+
+def _is_queueish(node):
+    t = _terminal(node)
+    return t is not None and (t.endswith("q") or "queue" in t.lower())
+
+
+def _blocking_reason(call, info):
+    """Why `call` is in the curated blocking table (None if it is not)."""
+    func = call.func
+    dotted = _dotted(func)
+    if dotted in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[dotted]
+    if dotted is not None and dotted.startswith("subprocess."):
+        return f"{dotted}() blocks on a child process"
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr in _BLOCKING_ATTRS:
+        return _BLOCKING_ATTRS[attr]
+    if attr == "get" and not call.args:
+        timeout = next((kw.value for kw in call.keywords
+                        if kw.arg == "timeout"), None)
+        bounded = timeout is not None and not (
+            isinstance(timeout, ast.Constant) and timeout.value is None)
+        receiver = _self_attr(func.value)
+        queueish = _is_queueish(func.value) or (
+            info is not None and receiver in info.cls.queue_attrs)
+        if queueish and not bounded:
+            return ".get() with no timeout parks on an empty queue"
+    return None
+
+
+def _is_callbackish(name):
+    low = name.lower()
+    return (any(m in low for m in _CALLBACK_MARKERS)
+            or low.startswith("on_") or low.endswith("_cb")
+            or low.endswith("_fn"))
+
+
+class _Unit:
+    """One analysis unit: a class method or a module function."""
+
+    def __init__(self, key, modinfo, info, name, fn, roles):
+        self.key = key            # ("C", rel, cls, meth) | ("F", rel, fn)
+        self.modinfo = modinfo
+        self.info = info          # _ClassInfo or None
+        self.name = name          # display name: "Cls.meth" / "func"
+        self.fn = fn
+        self.roles = frozenset(roles) or frozenset({"caller"})
+        self.acquires = []        # (lockname, node)
+        self.blocks = []          # (reason, node)
+        self.callbacks = []       # (desc, node)
+        self.calls = []           # (desc, node, [unit keys], same_class)
+
+    def held_at(self, node):
+        held = set()
+        info, mod = self.info, self.modinfo.mod
+        if info is not None:
+            held.update(info.lock_names.get(a, f"{info.cls.name}.{a}")
+                        for a in info.cls.locks_at(node, self.key[3]))
+        cur = mod.parent.get(node)
+        while cur is not None and cur is not self.fn:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    ce = item.context_expr
+                    if (isinstance(ce, ast.Name)
+                            and ce.id in self.modinfo.module_locks):
+                        held.add(self.modinfo.module_locks[ce.id])
+            cur = mod.parent.get(cur)
+        return held
+
+
+def _lock_of(expr, unit):
+    """The lock name `expr` denotes (a lock attribute or module lock),
+    or None."""
+    attr = _self_attr(expr)
+    if attr is not None and unit.info is not None:
+        return unit.info.lock_names.get(attr)
+    if isinstance(expr, ast.Name):
+        return unit.modinfo.module_locks.get(expr.id)
+    return None
+
+
+def _extract_events(unit, class_reg, func_reg):
+    info, modinfo = unit.info, unit.modinfo
+    cls = info.cls if info is not None else None
+    for node in ast.walk(unit.fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = _lock_of(item.context_expr, unit)
+                if name is not None:
+                    unit.acquires.append((name, node))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "acquire"):
+            name = _lock_of(func.value, unit)
+            if name is not None:
+                unit.acquires.append((name, node))
+                continue
+        reason = _blocking_reason(node, info)
+        if reason is not None:
+            unit.blocks.append((reason, node))
+            continue
+        # Same-class method call: summaries propagate through it, but
+        # violations stay attributed inside the callee (ClassThreads'
+        # inherited-locks already model the intra-class held set).
+        self_callee = _self_attr(func)
+        if cls is not None and self_callee in cls.methods:
+            unit.calls.append((f"{cls.name}.{self_callee}", node,
+                               [("C", modinfo.rel, cls.name, self_callee)],
+                               True))
+            continue
+        # Typed-attribute method call: self.batcher.submit(...)
+        if (isinstance(func, ast.Attribute) and info is not None):
+            owner = _self_attr(func.value)
+            if owner in info.typed_attrs:
+                targets = []
+                for ci in class_reg.get(info.typed_attrs[owner], ()):
+                    if func.attr in ci.cls.methods:
+                        targets.append(("C", ci.modinfo.rel,
+                                        ci.cls.name, func.attr))
+                if targets:
+                    unit.calls.append(
+                        (f"{info.typed_attrs[owner]}.{func.attr}",
+                         node, targets, False))
+                    continue
+        # Package-visible module function call.
+        terminal = _terminal(func)
+        resolved = False
+        if terminal in func_reg:
+            visible = terminal in modinfo.pkg_names
+            if not visible and isinstance(func, ast.Attribute):
+                root = (_dotted(func.value) or "").split(".")[0]
+                visible = root in modinfo.pkg_names
+            if visible:
+                unit.calls.append(
+                    (terminal, node,
+                     [("F", mi.rel, terminal) for mi, _ in
+                      func_reg[terminal]], False))
+                resolved = True
+        if resolved:
+            continue
+        # Callback heuristics: a ctor-param callable invoked directly,
+        # a callback-named attribute, or a bare emit().
+        desc = None
+        if isinstance(func, ast.Attribute):
+            owner_attr = _self_attr(func)
+            if owner_attr is not None and info is not None and (
+                    owner_attr in info.param_attrs
+                    or _is_callbackish(owner_attr)):
+                desc = f"self.{owner_attr}"
+            elif func.attr == "emit":
+                desc = f"{_dotted(func) or 'emit'}()"
+            elif _is_callbackish(func.attr):
+                desc = f".{func.attr}()"
+        elif isinstance(func, ast.Name) and _is_callbackish(func.id):
+            desc = f"{func.id}()"
+        if desc is not None:
+            unit.callbacks.append((desc, node))
+
+
+# --------------------------------------------------------------------------- #
+# The builder
+
+def _parse(paths):
+    mods = []
+    for f in iter_python_files(paths):
+        try:
+            mods.append(Module(str(f), f.read_text(encoding="utf-8")))
+        except (SyntaxError, OSError):
+            continue
+    return mods
+
+
+def _covered_files():
+    """Repo-relative paths named by analysis/schedule.py models."""
+    from byzantinemomentum_tpu.analysis import schedule
+    return schedule.covered_files()
+
+
+def _merge(dst, src, prefix):
+    """Merge transitive summary `src` into `dst` behind a witness hop;
+    returns True if anything new appeared."""
+    changed = False
+    for key, wit in src.items():
+        if key not in dst:
+            dst[key] = (prefix + wit)[:_WITNESS_CAP]
+            changed = True
+    return changed
+
+
+def build(paths=None):
+    """Parse `paths` (default: the package + scripts) and return the
+    whole-program `LockGraph`."""
+    paths = DEFAULT_PATHS if paths is None else paths
+    mods = _parse(paths)
+    infos = [_ModInfo(m) for m in mods]
+
+    class_reg = {}   # class name -> [_ClassInfo]
+    func_reg = {}    # function name -> [(modinfo, fn)]
+    for mi in infos:
+        for cls in module_classes(mi.mod):
+            ci = _ClassInfo(mi, cls)
+            mi.classes.append(ci)
+            class_reg.setdefault(cls.name, []).append(ci)
+        for name, fn in mi.funcs.items():
+            func_reg.setdefault(name, []).append((mi, fn))
+
+    units = {}
+    for mi in infos:
+        for ci in mi.classes:
+            for mname, fn in ci.cls.methods.items():
+                key = ("C", mi.rel, ci.cls.name, mname)
+                units[key] = _Unit(key, mi, ci, f"{ci.cls.name}.{mname}",
+                                   fn, ci.cls.roles.get(mname, ()))
+        for fname, fn in mi.funcs.items():
+            key = ("F", mi.rel, fname)
+            units[key] = _Unit(key, mi, None, fname, fn, ("caller",))
+    for unit in units.values():
+        _extract_events(unit, class_reg, func_reg)
+
+    # Bottom-up: transitive acquire/block/callback summaries.
+    acq_t = {k: {} for k in units}
+    blk_t = {k: {} for k in units}
+    cb_t = {k: {} for k in units}
+    for key, unit in units.items():
+        rel = unit.modinfo.rel
+        for name, node in unit.acquires:
+            acq_t[key].setdefault(
+                name, (f"{rel}:{node.lineno} takes {name}",))
+        for reason, node in unit.blocks:
+            blk_t[key].setdefault(
+                reason, (f"{rel}:{node.lineno} {reason}",))
+        for desc, node in unit.callbacks:
+            cb_t[key].setdefault(
+                desc, (f"{rel}:{node.lineno} calls {desc}",))
+    changed = True
+    while changed:
+        changed = False
+        for key, unit in units.items():
+            rel = unit.modinfo.rel
+            for desc, node, targets, _same in unit.calls:
+                hop = (f"{rel}:{node.lineno} calls {desc}",)
+                for t in targets:
+                    if t not in units:
+                        continue
+                    changed |= _merge(acq_t[key], acq_t[t], hop)
+                    changed |= _merge(blk_t[key], blk_t[t], hop)
+                    changed |= _merge(cb_t[key], cb_t[t], hop)
+
+    # Top-down: edges + L02/L03 violations.
+    locks = set()
+    for mi in infos:
+        locks.update(mi.module_locks.values())
+        for ci in mi.classes:
+            locks.update(ci.lock_names.values())
+    edges = {}
+    raw = []
+
+    def edge(held, taken, witness, roles, rel, line):
+        if held == taken:
+            return  # same NAME, not necessarily the same instance
+        meta = edges.get((held, taken))
+        if meta is None:
+            edges[(held, taken)] = {"witness": witness, "roles": set(roles),
+                                    "path": rel, "line": line}
+        else:
+            meta["roles"] |= roles
+
+    for key, unit in units.items():
+        rel = unit.modinfo.rel
+        for name, node in unit.acquires:
+            held = unit.held_at(node)
+            for h in sorted(held - {name}):
+                edge(h, name, (f"{rel}:{node.lineno} takes {name} "
+                               f"holding {h}",), unit.roles,
+                     rel, node.lineno)
+        for reason, node in unit.blocks:
+            held = unit.held_at(node)
+            if held:
+                raw.append(Violation(
+                    unit.modinfo.mod.path, node.lineno, node.col_offset,
+                    "BMT-L02",
+                    f"{unit.name} holds {', '.join(sorted(held))}: "
+                    f"{reason} — move the wait outside the lock"))
+        for desc, node in unit.callbacks:
+            held = unit.held_at(node)
+            if held:
+                raw.append(Violation(
+                    unit.modinfo.mod.path, node.lineno, node.col_offset,
+                    "BMT-L03",
+                    f"{unit.name} invokes callback {desc} while holding "
+                    f"{', '.join(sorted(held))} — foreign code runs "
+                    f"inside the critical section"))
+        for desc, node, targets, same in unit.calls:
+            if same:
+                continue  # intra-class: attributed inside the callee
+            held = unit.held_at(node)
+            if not held:
+                continue
+            for t in targets:
+                if t not in units:
+                    continue
+                for name, wit in acq_t[t].items():
+                    for h in sorted(held - {name}):
+                        edge(h, name,
+                             (f"{rel}:{node.lineno} calls {desc} "
+                              f"holding {h}",) + wit,
+                             unit.roles, rel, node.lineno)
+                for reason, wit in blk_t[t].items():
+                    raw.append(Violation(
+                        unit.modinfo.mod.path, node.lineno,
+                        node.col_offset, "BMT-L02",
+                        f"{unit.name} holds {', '.join(sorted(held))} "
+                        f"across a blocking call chain: "
+                        f"{' -> '.join(wit)}"))
+                for cbdesc, wit in cb_t[t].items():
+                    raw.append(Violation(
+                        unit.modinfo.mod.path, node.lineno,
+                        node.col_offset, "BMT-L03",
+                        f"{unit.name} holds {', '.join(sorted(held))} "
+                        f"across a callback chain: {' -> '.join(wit)}"))
+
+    cycles, cyc_violations = _cycle_violations(edges)
+    raw.extend(cyc_violations)
+    raw.extend(_l05_violations(infos))
+    raw.extend(_l06_violations(infos))
+
+    violations, suppressed = _filter_noqa(infos, raw)
+    return LockGraph(locks=locks, edges=edges, cycles=cycles,
+                     violations=violations, suppressed=suppressed,
+                     files=len(mods))
+
+
+# --------------------------------------------------------------------------- #
+# L01/L04 — cycles and inversions
+
+def _sccs(nodes, adjacency):
+    """Tarjan, iterative; returns SCCs as lists."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    out = []
+    counter = [0]
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adjacency.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adjacency.get(nxt,
+                                                                ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    scc.append(member)
+                    if member == node:
+                        break
+                out.append(scc)
+    return out
+
+
+def _multi_instance(role):
+    return not role.startswith("thread:")
+
+
+def _cycle_violations(edges):
+    adjacency = {}
+    nodes = set()
+    for (a, b) in edges:
+        adjacency.setdefault(a, set()).add(b)
+        nodes.update((a, b))
+    cycles = []
+    out = []
+    for scc in _sccs(nodes, adjacency):
+        if len(scc) < 2:
+            continue
+        members = sorted(scc)
+        cycles.append(members)
+        in_cycle = [(pair, meta) for pair, meta in sorted(edges.items())
+                    if pair[0] in scc and pair[1] in scc]
+        roles = set()
+        for _, meta in in_cycle:
+            roles |= meta["roles"]
+        deadlock = (len(roles) >= 2
+                    or any(_multi_instance(r) for r in roles))
+        rid = "BMT-L01" if deadlock else "BMT-L04"
+        witness = "; ".join(
+            f"{a} -> {b} at {meta['witness'][0]}"
+            for (a, b), meta in in_cycle[:4])
+        anchor = in_cycle[0][1]
+        if deadlock:
+            message = (f"lock-order cycle {' -> '.join(members)} "
+                       f"exercised by roles {{{', '.join(sorted(roles))}}}"
+                       f" — these threads can deadlock; witnesses: "
+                       f"{witness}")
+        else:
+            message = (f"lock pair {' -> '.join(members)} acquired in "
+                       f"both orders on single role "
+                       f"{{{', '.join(sorted(roles))}}} — latent "
+                       f"inversion; pick one order; witnesses: {witness}")
+        out.append(Violation(str(ROOT / anchor["path"]), anchor["line"],
+                             0, rid, message))
+    return cycles, out
+
+
+# --------------------------------------------------------------------------- #
+# L05 — check-then-act lazy init outside any lock
+
+def _l05_violations(infos):
+    out = []
+    for mi in infos:
+        mod = mi.mod
+        if not concurrency._imports_threading(mod.tree):
+            continue
+        globals_ = {n.targets[0].id for n in mod.tree.body
+                    if isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)}
+        by_cls = {}
+        for ci in mi.classes:
+            for mname, fn in ci.cls.methods.items():
+                by_cls[fn] = (ci, mname)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.If):
+                continue
+            hit = _l05_pattern(mod, node, globals_)
+            if hit is None:
+                continue
+            target, kind = hit
+            fn = mod.enclosing_function(node)
+            if fn is None or isinstance(fn, ast.Lambda):
+                continue
+            ci_m = by_cls.get(fn)
+            if kind == "attr":
+                # Object-attribute lazy init only matters when the class
+                # actually hands threads out.
+                if ci_m is None:
+                    continue
+                ci, mname = ci_m
+                if mname == "__init__" or not (
+                        ci.cls.entries or ci.cls.escapes
+                        or ci.cls.handler):
+                    continue
+            held = set()
+            if ci_m is not None:
+                ci, mname = ci_m
+                held.update(ci.cls.locks_at(node, mname))
+            cur = mod.parent.get(node)
+            while cur is not None and cur is not fn:
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    for item in cur.items:
+                        if (isinstance(item.context_expr, ast.Name)
+                                and item.context_expr.id
+                                in mi.module_locks):
+                            held.add(item.context_expr.id)
+                cur = mod.parent.get(cur)
+            if held:
+                continue
+            out.append(Violation(
+                mod.path, node.lineno, node.col_offset, "BMT-L05",
+                f"check-then-act lazy init of {target!r} with no lock "
+                f"held — two threads can both see it uninitialized and "
+                f"both fill it; guard the check+fill with one lock"))
+    return out
+
+
+def _l05_pattern(mod, node, globals_):
+    """(target, kind) for a lazy-init If, else None. kind is 'global'
+    (module global, rebound under `global`), 'dict' (module-level dict
+    fill) or 'attr' (`self.x` fill)."""
+    test = node.test
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return None
+    op = test.ops[0]
+    if (isinstance(op, ast.Is)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None):
+        target = test.left
+        if isinstance(target, ast.Name) and target.id in globals_:
+            fn = mod.enclosing_function(node)
+            declared = fn is not None and any(
+                isinstance(s, ast.Global) and target.id in s.names
+                for s in ast.walk(fn))
+            if declared and _body_assigns_name(node.body, target.id):
+                return target.id, "global"
+        attr = _self_attr(target)
+        if attr is not None and _body_assigns_attr(node.body, attr):
+            return f"self.{attr}", "attr"
+        return None
+    if isinstance(op, ast.NotIn):
+        container = test.comparators[0]
+        if (isinstance(container, ast.Name) and container.id in globals_
+                and _body_stores_subscript(node.body, container.id)):
+            return container.id, "dict"
+    return None
+
+
+def _body_assigns_name(body, name):
+    return any(isinstance(sub, ast.Name)
+               and isinstance(sub.ctx, ast.Store) and sub.id == name
+               for stmt in body for sub in ast.walk(stmt))
+
+
+def _body_assigns_attr(body, attr):
+    return any(_self_attr(sub) == attr
+               and isinstance(sub.ctx, ast.Store)
+               for stmt in body for sub in ast.walk(stmt)
+               if isinstance(sub, ast.Attribute))
+
+
+def _body_stores_subscript(body, name):
+    return any(isinstance(sub, ast.Subscript)
+               and isinstance(sub.ctx, (ast.Store,))
+               and isinstance(sub.value, ast.Name)
+               and sub.value.id == name
+               for stmt in body for sub in ast.walk(stmt))
+
+
+# --------------------------------------------------------------------------- #
+# L06 — the thread-surface covenant, made mechanical
+
+def _l06_violations(infos):
+    try:
+        covered = _covered_files()
+    except Exception:  # bmt: noqa[BMT-E05] a broken schedule import must degrade to "nothing is covered" (every thread file flags), not crash the sweep
+        covered = set()
+    out = []
+    for mi in infos:
+        if mi.rel in covered:
+            continue
+        first = None
+        for node in ast.walk(mi.mod.tree):
+            if (isinstance(node, ast.Call)
+                    and _terminal(node.func) in _THREAD_FACTORIES):
+                if first is None or node.lineno < first.lineno:
+                    first = node
+        if first is None:
+            continue
+        out.append(Violation(
+            mi.mod.path, first.lineno, first.col_offset, "BMT-L06",
+            f"{mi.rel} constructs {_terminal(first.func)} but no "
+            f"analysis/schedule.py model names it (MODEL_COVERAGE) — "
+            f"add a model for its interleavings or a reasoned noqa on "
+            f"this line"))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Suppression
+
+def _filter_noqa(infos, raw):
+    noqa = {mi.mod.path: mi.mod.noqa for mi in infos}
+    seen = set()
+    out = []
+    suppressed = 0
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.rule,
+                                        v.message)):
+        key = (v.path, v.line, v.rule, v.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        table = noqa.get(v.path, {})
+        entry = table.get(v.line)
+        if entry is not None:
+            ids, reason = entry
+            if (v.rule in ids or "all" in ids) and reason:
+                suppressed += 1
+                continue
+        out.append(v)
+    return out, suppressed
+
+
+# --------------------------------------------------------------------------- #
+# Golden census
+
+def _toolchain():
+    return f"{sys.version_info[0]}.{sys.version_info[1]}"
+
+
+def _topo_order(locks, edges):
+    """Kahn with lexicographic tie-break; members of cycles come last,
+    sorted (a clean repo has none)."""
+    indeg = {n: 0 for n in locks}
+    adjacency = {n: set() for n in locks}
+    for (a, b) in edges:
+        if b not in adjacency.get(a, set()):
+            adjacency.setdefault(a, set()).add(b)
+            indeg[b] = indeg.get(b, 0) + 1
+            indeg.setdefault(a, 0)
+    ready = sorted(n for n, d in indeg.items() if d == 0)
+    order = []
+    while ready:
+        node = ready.pop(0)
+        order.append(node)
+        for nxt in sorted(adjacency.get(node, ())):
+            indeg[nxt] -= 1
+            if indeg[nxt] == 0:
+                ready.append(nxt)
+        ready.sort()
+    order.extend(sorted(n for n in indeg if n not in set(order)))
+    return order
+
+
+def census(graph=None, paths=None):
+    """The blessable payload: toolchain coordinate, lock names, edge
+    census, topological order."""
+    graph = build(paths) if graph is None else graph
+    return {
+        "python": _toolchain(),
+        "locks": sorted(graph.locks),
+        "edges": sorted(f"{a} -> {b}" for (a, b) in graph.edges),
+        "order": _topo_order(graph.locks, graph.edges),
+    }
+
+
+def static_edges(paths=None, graph=None):
+    """The static acquisition-edge set as (held, taken) name pairs —
+    the superset the runtime log (contracts.record_lock_edges) must
+    stay inside."""
+    graph = build(paths) if graph is None else graph
+    return set(graph.edges)
+
+
+def check(path=GOLDEN_PATH, paths=None):
+    """Sweep + golden gate. Returns a dict with `status` in
+    ok | drift | missing | incomparable, the violation list, and the
+    census counters; `ok` requires status ok/incomparable AND zero
+    unsuppressed violations."""
+    graph = build(paths)
+    current = census(graph)
+    report = {
+        "locks": len(graph.locks),
+        "edges": len(graph.edges),
+        "cycles": len(graph.cycles),
+        "files": graph.files,
+        "violations": [v.as_dict() for v in graph.violations],
+        "suppressed": graph.suppressed,
+    }
+    path = pathlib.Path(path)
+    if not path.exists():
+        report["status"] = "missing"
+    else:
+        blessed = json.loads(path.read_text(encoding="utf-8"))
+        if blessed.get("python") != current["python"]:
+            report["status"] = "incomparable"
+            report["blessed_python"] = blessed.get("python")
+        else:
+            drift = {}
+            for field in ("locks", "edges"):
+                old = set(blessed.get(field, ()))
+                new = set(current[field])
+                if new - old:
+                    drift[f"{field}_added"] = sorted(new - old)
+                if old - new:
+                    drift[f"{field}_removed"] = sorted(old - new)
+            if drift:
+                report["status"] = "drift"
+                report["drift"] = drift
+            else:
+                report["status"] = "ok"
+    report["ok"] = (report["status"] in ("ok", "incomparable")
+                    and not graph.violations)
+    return report
+
+
+def bless(path=GOLDEN_PATH, paths=None):
+    """Write the current census as the blessed hierarchy; returns
+    (payload, changed, old_payload_or_None)."""
+    path = pathlib.Path(path)
+    old = None
+    if path.exists():
+        old = json.loads(path.read_text(encoding="utf-8"))
+    payload = census(paths=paths)
+    text = json.dumps(payload, indent=2, sort_keys=True) + "\n"
+    changed = old != payload
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text, encoding="utf-8")
+    return payload, changed, old

@@ -67,7 +67,9 @@ __all__ = ["Scheduler", "SchedLock", "SchedCondition", "DeadlockError",
            "metrics_rotate_lost_model", "metrics_rotate_model",
            "incident_bundle_torn_model", "incident_bundle_model",
            "router_splice_lost_model", "router_splice_model",
-           "selfcheck"]
+           "scrape_publish_torn_model", "scrape_publish_model",
+           "liveness_hook_racy_model", "liveness_hook_model",
+           "MODEL_COVERAGE", "covered_files", "selfcheck"]
 
 # A worker that fails to reach its next preemption point within this many
 # seconds is assumed to have entered a REAL blocking call (which the
@@ -180,7 +182,7 @@ class Scheduler:
                 finally:
                     state.done = True
                     self._main.release()
-            t = threading.Thread(target=body, daemon=True,
+            t = threading.Thread(target=body, daemon=True,  # bmt: noqa[BMT-L06] this IS the interleaving harness; its workers run one at a time under the scheduler's own handoff semaphores
                                  name=f"sched-{i}")
             threads.append(t)
             t.start()
@@ -969,6 +971,179 @@ def router_splice_model(sched):
     return [splice("t1"), splice("t2")], check
 
 
+def scrape_publish_torn_model(sched):
+    """The WRONG way to take the r20 L02 fix (`MetricsScraper.
+    scrape_once` held the scraper lock across the fsync'ing
+    `append_snapshot`): moving the append out by dropping the lock
+    entirely. Two scrape rounds (the scraper thread plus a test or
+    selfcheck driving `scrape_once` directly) bump `scrapes` with an
+    unlocked read-modify-write — one bump is lost and `last_snapshot`
+    no longer corresponds to the count. One preemption finds it."""
+    state = {"scrapes": 0, "last": None}
+    appended = []
+
+    def round_(tag):
+        def worker():
+            appended.append(tag)   # the (correctly) out-of-lock append
+            n = state["scrapes"]
+            sched.point()
+            state["scrapes"] = n + 1
+            state["last"] = tag
+        return worker
+
+    def check():
+        assert state["scrapes"] == len(appended), (
+            f"a scrape publish was lost: count {state['scrapes']} != "
+            f"{len(appended)} appends")
+
+    return [round_("a"), round_("b")], check
+
+
+def scrape_publish_model(sched):
+    """The SHIPPED snapshot-then-release pattern: the fsync'ing append
+    runs OUTSIDE the scraper lock (the disk wait no longer convoys
+    readers of `scrapes`/`last_snapshot`), then count and snapshot
+    publish together under the lock. Exhaustively clean at the bound
+    that breaks the unlocked variant."""
+    lock = sched.lock()
+    state = {"scrapes": 0, "last": None}
+    appended = []
+
+    def round_(tag):
+        def worker():
+            appended.append(tag)   # disk append, no lock held
+            sched.point()          # the other round may land here
+            with lock:
+                n = state["scrapes"]
+                sched.point()
+                state["scrapes"] = n + 1
+                state["last"] = tag
+        return worker
+
+    def check():
+        assert state["scrapes"] == len(appended) == 2, (
+            f"publish tore: count {state['scrapes']}, "
+            f"{len(appended)} appends")
+        assert state["last"] in appended
+
+    return [round_("a"), round_("b")], check
+
+
+def liveness_hook_racy_model(sched):
+    """The PRE-fix `FleetRouter._set_liveness`: the liveness hook ran
+    UNDER the hot ring lock, and the launcher's hook persists the
+    manifest under its own lock. An independent launcher path that
+    persists first and then inspects the ring takes the same two locks
+    in the opposite order — bounded exploration finds the deadlock
+    schedule (the harness reports an empty runnable set)."""
+    ring = sched.lock()
+    manifest = sched.lock()
+
+    def flip():                    # router: hook inside the ring lock
+        with ring:
+            with manifest:         # the hook persists the manifest
+                pass
+
+    def persist_then_inspect():    # launcher: persist, then read ring
+        with manifest:
+            with ring:
+                pass
+
+    def check():
+        pass
+
+    return [flip, persist_then_inspect], check
+
+
+def liveness_hook_model(sched):
+    """The SHIPPED split: liveness transitions serialize on a COLD
+    membership lock; the ring lock is only ever taken inside it (one
+    global order membership -> {ring, manifest}) and never spans the
+    hook. Two detectors reporting the same death dedupe on the
+    membership lock (persist-before-flip: exactly one persists, one
+    flips). The opposite-order launcher path from the racy model is
+    ruled out by the static lock-order graph instead (the only edges
+    are membership -> manifest and membership -> ring — acyclic), so
+    this model stays small enough to exhaust. Exhaustively clean."""
+    membership = sched.lock()
+    ring = sched.lock()
+    manifest = sched.lock()
+    state = {"alive": True, "flips": 0, "persists": 0}
+
+    def detect():                  # two watchers report the same death
+        def worker():
+            with membership:
+                # alive only ever changes under membership, so the
+                # dedupe check needs no ring acquisition
+                if not state["alive"]:
+                    return         # deduped: the flip already happened
+                with manifest:     # the hook, outside the ring lock
+                    state["persists"] += 1
+                with ring:
+                    state["alive"] = False
+                    state["flips"] += 1
+        return worker
+
+    def check():
+        assert state["flips"] == 1 and state["persists"] == 1, (
+            f"transition did not dedupe: {state}")
+        assert state["alive"] is False
+
+    return [detect(), detect()], check
+
+
+# --------------------------------------------------------------------------- #
+# The thread-surface covenant (BMT-L06): every file that constructs a
+# Thread/Lock/Condition must be named here by the model that pins its
+# synchronization pattern, or carry a reasoned per-line noqa. Paths are
+# repo-relative. Honest mapping only: a file listed under a model must
+# actually follow the pattern that model exercises.
+
+MODEL_COVERAGE = {
+    # The serve stats counters (PR 14's day-one fix) — and every other
+    # "one lock guards a handful of fields/dict entries" class: program
+    # cache, metric cells, telemetry writer, job-log rotation.
+    "lost_update_model": (
+        "byzantinemomentum_tpu/serve/service.py",),
+    "fixed_counter_model": (
+        "byzantinemomentum_tpu/serve/service.py",
+        "byzantinemomentum_tpu/serve/programs.py",
+        "byzantinemomentum_tpu/obs/metrics/registry.py",
+        "byzantinemomentum_tpu/obs/recorder.py",
+        "byzantinemomentum_tpu/utils/jobs.py"),
+    "router_forward_queue_model": (
+        "byzantinemomentum_tpu/serve/fleet/router.py",),
+    "router_single_disposition_model": (
+        "byzantinemomentum_tpu/serve/fleet/router.py",),
+    "straggle_claim_model": (
+        "byzantinemomentum_tpu/cluster/straggler.py",),
+    "metrics_scrape_model": (
+        "byzantinemomentum_tpu/obs/metrics/scrape.py",),
+    "metrics_rotate_model": (
+        "byzantinemomentum_tpu/obs/metrics/scrape.py",),
+    "incident_bundle_model": (
+        "byzantinemomentum_tpu/obs/trace/incident.py",),
+    "router_splice_model": (
+        "byzantinemomentum_tpu/serve/fleet/router.py",
+        "byzantinemomentum_tpu/obs/trace/request.py"),
+    # r20: the two day-one BMT-L fixes, pinned schedule-clean.
+    "scrape_publish_model": (
+        "byzantinemomentum_tpu/obs/metrics/scrape.py",
+        "byzantinemomentum_tpu/obs/metrics/slo.py"),
+    "liveness_hook_model": (
+        "byzantinemomentum_tpu/serve/fleet/router.py",
+        "byzantinemomentum_tpu/serve/fleet/launcher.py"),
+}
+
+
+def covered_files():
+    """Every repo-relative path some model vouches for."""
+    out = set()
+    for files in MODEL_COVERAGE.values():
+        out.update(files)
+    return out
+
+
 def selfcheck(max_preemptions=3):
     """The lint-tier schedule smoke: every planted bug — the serve
     counter lost-update, the two router races (lost forward, double
@@ -1009,6 +1184,14 @@ def selfcheck(max_preemptions=3):
                      max_preemptions=max_preemptions)
     j_splice = explore(router_splice_model,
                        max_preemptions=max_preemptions)
+    p_torn = explore(scrape_publish_torn_model,
+                     max_preemptions=max_preemptions)
+    p_publish = explore(scrape_publish_model,
+                        max_preemptions=max_preemptions)
+    h_racy = explore(liveness_hook_racy_model,
+                     max_preemptions=max_preemptions)
+    h_split = explore(liveness_hook_model,
+                      max_preemptions=max_preemptions)
     router_fixed_clean = (r_queue.ok and r_queue.exhausted
                           and r_single.ok and r_single.exhausted)
     straggle_fixed_clean = s_claim.ok and s_claim.exhausted
@@ -1016,6 +1199,8 @@ def selfcheck(max_preemptions=3):
                            and m_rotate.ok and m_rotate.exhausted)
     incident_fixed_clean = (i_bundle.ok and i_bundle.exhausted
                             and j_splice.ok and j_splice.exhausted)
+    locks_fixed_clean = (p_publish.ok and p_publish.exhausted
+                         and h_split.ok and h_split.exhausted)
     return {
         "ok": (bool(broken.failures) and fixed.ok and fixed.exhausted
                and bool(r_lost.failures) and bool(r_double.failures)
@@ -1024,7 +1209,9 @@ def selfcheck(max_preemptions=3):
                and bool(m_torn.failures) and bool(m_lost.failures)
                and metrics_fixed_clean
                and bool(i_torn.failures) and bool(j_lost.failures)
-               and incident_fixed_clean),
+               and incident_fixed_clean
+               and bool(p_torn.failures) and bool(h_racy.failures)
+               and locks_fixed_clean),
         "lost_update_found": bool(broken.failures),
         "witness": broken.failures[0].schedule if broken.failures else None,
         "schedules_prefix": broken.runs,
@@ -1062,6 +1249,15 @@ def selfcheck(max_preemptions=3):
         "incident_fixed_clean": incident_fixed_clean,
         "schedules_incident": (i_torn.runs + i_bundle.runs + j_lost.runs
                                + j_splice.runs),
+        "scrape_publish_torn_found": bool(p_torn.failures),
+        "scrape_publish_torn_witness": (p_torn.failures[0].schedule
+                                        if p_torn.failures else None),
+        "liveness_hook_deadlock_found": bool(h_racy.failures),
+        "liveness_hook_deadlock_witness": (h_racy.failures[0].schedule
+                                           if h_racy.failures else None),
+        "locks_fixed_clean": locks_fixed_clean,
+        "schedules_locks": (p_torn.runs + p_publish.runs + h_racy.runs
+                            + h_split.runs),
         "exhausted": (broken.exhausted and fixed.exhausted
                       and r_lost.exhausted and r_double.exhausted
                       and r_queue.exhausted and r_single.exhausted
@@ -1069,7 +1265,9 @@ def selfcheck(max_preemptions=3):
                       and m_torn.exhausted and m_scrape.exhausted
                       and m_lost.exhausted and m_rotate.exhausted
                       and i_torn.exhausted and i_bundle.exhausted
-                      and j_lost.exhausted and j_splice.exhausted),
+                      and j_lost.exhausted and j_splice.exhausted
+                      and p_torn.exhausted and p_publish.exhausted
+                      and h_racy.exhausted and h_split.exhausted),
         "max_preemptions": max_preemptions,
         "seconds": round(time.monotonic() - t0, 3),
     }

@@ -89,7 +89,7 @@ class StraggleResumer:
 
     def __init__(self, *, clock=time.monotonic):
         self._clock = clock
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()  # bmt: noqa[BMT-L06] deterministic single-waiter timer (injected clock, exercised directly by tests/test_cluster_chaos.py) — no model needed
         self._pending = []    # [{"host", "proc", "at", "state"}]
         self._resumed = []
         self._cancelled = 0
@@ -146,7 +146,7 @@ class StraggleResumer:
             with self._cond:
                 if self._stopping:
                     return
-                now = self._clock()
+                now = self._clock()  # bmt: noqa[BMT-L03] the clock is a constructor-injected test seam (time.monotonic in production) — pure reads, never calls back in
                 due = [e for e in self._pending
                        if e["state"] == "pending" and e["at"] <= now]
                 for entry in due:

@@ -132,7 +132,7 @@ def _watch_parent():
             pass
         os._exit(3)
 
-    threading.Thread(target=watch, name="parent-watch", daemon=True).start()
+    threading.Thread(target=watch, name="parent-watch", daemon=True).start()  # bmt: noqa[BMT-L06] lock-free parent-death watch: blocks on pipe EOF then os._exit — it shares no state to interleave
 
 
 def _run_census(resdir, proc_id):

@@ -49,6 +49,8 @@ import bisect
 import math
 import threading
 
+from byzantinemomentum_tpu.utils.locking import NamedLock
+
 __all__ = ["METRICS_SCHEMA", "LATENCY_MS_BOUNDS", "DEPTH_BOUNDS",
            "OCCUPANCY_BOUNDS", "Counter", "Gauge", "Histogram",
            "MetricsRegistry", "NullRegistry", "merge_payloads",
@@ -81,7 +83,7 @@ class Counter:
 
     def __init__(self, name):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = NamedLock("metrics.counter")
         self._value = 0
 
     def inc(self, n=1):
@@ -109,7 +111,7 @@ class Gauge:
 
     def __init__(self, name):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = NamedLock("metrics.gauge")
         self._value = 0.0
 
     def set(self, value):
@@ -169,7 +171,7 @@ class Histogram:
                              f"strictly increasing, got {bounds}")
         self.name = name
         self.bounds = bounds
-        self._lock = threading.Lock()
+        self._lock = NamedLock("metrics.histogram")
         self._counts = [0] * (len(bounds) + 1)
         self._count = 0
         self._sum = 0.0
@@ -216,7 +218,7 @@ class MetricsRegistry:
 
     def __init__(self, source=None):
         self.source = source
-        self._lock = threading.Lock()
+        self._lock = NamedLock("metrics.registry")
         self._metrics = {}
 
     def _get(self, name, factory, kind):

@@ -40,6 +40,7 @@ import time
 
 from byzantinemomentum_tpu.obs import recorder
 from byzantinemomentum_tpu.obs.metrics.registry import merge_payloads
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["METRICS_NAME", "append_snapshot", "load_snapshots",
            "scrape_target", "MetricsScraper", "MetricsEndpoint"]
@@ -161,7 +162,11 @@ class MetricsScraper:
         self.last_snapshot = None
         self._stop = threading.Event()
         self._thread = None
-        self._lock = threading.Lock()
+        # Guards the published pair (scrapes, last_snapshot) and the
+        # thread start — NOT the disk append: the fsync'ing
+        # `append_snapshot` runs outside it (BMT-L02 day-one fix,
+        # pinned by `schedule.scrape_publish_model`).
+        self._lock = NamedLock("scraper.publish")
 
     def scrape_once(self, now=None):
         """One scrape round; returns the snapshot appended (also kept
@@ -183,9 +188,13 @@ class MetricsScraper:
         snapshot = {"t": now, "kind": "metrics_snapshot",
                     "targets": len(self.targets), "reached": reached,
                     "missed": missed, "merged": merged}
+        # The append (fd write + fsync + possible rotation) stays OUT of
+        # the lock: the scraper thread is the only writer of the ring
+        # file, so only the published pair needs the critical section —
+        # a `stats()`/`last_snapshot` reader never waits on the disk.
+        append_snapshot(self.directory, snapshot,
+                        max_lines=self.max_lines)
         with self._lock:
-            append_snapshot(self.directory, snapshot,
-                            max_lines=self.max_lines)
             self.scrapes += 1
             self.last_snapshot = snapshot
         if self.evaluator is not None and merged is not None:
@@ -207,11 +216,12 @@ class MetricsScraper:
                 pass
 
     def start(self):
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._loop,
-                                            name="metrics-scraper",
-                                            daemon=True)
-            self._thread.start()
+        with self._lock:   # two starters must not both spawn (BMT-L05)
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                name="metrics-scraper",
+                                                daemon=True)
+                self._thread.start()
         return self
 
     def stop(self):

@@ -34,6 +34,7 @@ Stdlib-only, like the rest of `obs`.
 """
 
 from byzantinemomentum_tpu.obs.metrics.registry import METRICS_SCHEMA
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["SLO", "BurnRateEvaluator", "DEFAULT_SERVE_SLOS",
            "window_rates"]
@@ -181,10 +182,21 @@ class BurnRateEvaluator:
         self._alerting = {slo.name: False for slo in self.slos}
         self.burn_events = 0
         self.ok_events = 0
+        # `observe` folds on the scraper thread while `summary` reads
+        # from report/selfcheck callers — the window + alert state is
+        # cross-thread. Named so BMT-L reports say `slo.window`, not an
+        # anonymous Lock address.
+        self._lock = NamedLock("slo.window")
 
     def observe(self, snapshot):
         """Fold one snapshot; returns edge events (`slo_burn` on
-        entering alert, `slo_ok` on leaving), each JSON-safe."""
+        entering alert, `slo_ok` on leaving), each JSON-safe. The fold
+        is pure host arithmetic over the bounded window — holding
+        `slo.window` across it never waits on disk or network."""
+        with self._lock:
+            return self._observe(snapshot)
+
+    def _observe(self, snapshot):
         now = float(snapshot.get("t", 0.0))
         self._history.append(snapshot)
         # Bound memory to the slow window (+ one pre-window edge so the
@@ -230,6 +242,10 @@ class BurnRateEvaluator:
     def summary(self):
         """The `obs_report` SLO block: per-objective current burn and
         alert state, plus the lifetime edge counts."""
+        with self._lock:
+            return self._summary()
+
+    def _summary(self):
         now = (float(self._history[-1].get("t", 0.0))
                if self._history else 0.0)
         rows = []

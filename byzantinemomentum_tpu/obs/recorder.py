@@ -36,6 +36,8 @@ import pathlib
 import threading
 import time
 
+from byzantinemomentum_tpu.utils.locking import NamedLock
+
 __all__ = ["TELEMETRY_NAME", "Telemetry", "activate", "deactivate", "active",
            "emit", "span", "counter", "install_compile_listener",
            "load_records"]
@@ -70,7 +72,7 @@ class Telemetry:
         self.interval = max(1, int(interval))
         self.path = self.directory / filename
         self._fd = self.path.open("a", encoding="utf-8")
-        self._lock = threading.Lock()
+        self._lock = NamedLock("telemetry.file")
         self._ids = itertools.count(1)
         self._stack = []           # open span ids, innermost last
         self._counters = {}

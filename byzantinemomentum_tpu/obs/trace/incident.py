@@ -57,6 +57,8 @@ import queue
 import threading
 import time
 
+from byzantinemomentum_tpu.utils.locking import NamedLock
+
 __all__ = ["INCIDENTS_DIRNAME", "IncidentRecorder", "load_incidents",
            "merge_fleet_incidents", "render_incidents"]
 
@@ -94,7 +96,7 @@ class IncidentRecorder:
         self.source = str(source) if source is not None else None
         self.captured = 0
         self.dropped = 0
-        self._lock = threading.Lock()
+        self._lock = NamedLock("incident.cooldown")
         self._n = self._next_index()
         self._last = {}   # reason -> monotonic time of last capture
         self._queue = queue.Queue()
@@ -121,11 +123,12 @@ class IncidentRecorder:
 
     def start(self):
         """Start the capture worker. Idempotent; returns self."""
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._loop,
-                                            name="incident-capture",
-                                            daemon=True)
-            self._thread.start()
+        with self._lock:   # two starters must not both spawn (BMT-L05)
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                name="incident-capture",
+                                                daemon=True)
+                self._thread.start()
         return self
 
     def trigger(self, reason, **data):

@@ -59,6 +59,7 @@ import threading
 import time
 
 from byzantinemomentum_tpu.obs.metrics.registry import LATENCY_MS_BOUNDS
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["JOINED_HOPS", "REQUEST_PHASES", "ROUTER_PHASES",
            "RequestTrace", "TraceBuffer", "dominant_hop",
@@ -340,7 +341,7 @@ class TraceBuffer:
             raise ValueError(f"Expected maxlen >= 1, got {maxlen}")
         self.maxlen = int(maxlen)
         self._ring = collections.deque(maxlen=self.maxlen)
-        self._lock = threading.Lock()
+        self._lock = NamedLock("trace.buffer")
         self._completed = 0
         self._metrics = (metrics if metrics is not None
                          and getattr(metrics, "enabled", False) else None)

@@ -105,14 +105,24 @@ def selfcheck(seed=1, requests=120, verbose=True):
             for fut in futures:
                 fut.result(timeout=30)
 
-        with contracts.no_implicit_transfers(scope="process"):
-            contracts.assert_recompile_budget(
-                step, steps=10, budget=0,
-                label=f"warm serving loop ({10 * group} mixed-cell "
-                      f"requests)")
+        with contracts.record_lock_edges() as lock_edges:
+            with contracts.no_implicit_transfers(scope="process"):
+                contracts.assert_recompile_budget(
+                    step, steps=10, budget=0,
+                    label=f"warm serving loop ({10 * group} mixed-cell "
+                          f"requests)")
         if verbose:
             print(f"serve selfcheck: {10 * group} warm requests, "
                   f"0 recompiles, 0 implicit transfers", flush=True)
+        # (1b) every lock-order edge the warm window actually exercised
+        # must be in the static lock-order graph (BMT-L runtime
+        # cross-check): an uncovered edge means either the sweep cannot
+        # see an acquisition site or a code path inverted the blessed
+        # hierarchy — both are bugs, not noise
+        checked_edges = contracts.assert_lock_edges_subset(lock_edges)
+        if verbose:
+            print(f"serve selfcheck: {checked_edges} runtime lock-order "
+                  f"edge(s), all within the static graph", flush=True)
 
         # (2) heterogeneous-(n, d) traffic: every kernel family, >= 3 raw
         # n and >= 3 raw d each, ZERO compiles once the bucket programs
@@ -490,6 +500,7 @@ def selfcheck(seed=1, requests=120, verbose=True):
                   f"incident bundle", flush=True)
 
         stats = service.stats()
+        stats["lock_edges"] = checked_edges
     finally:
         service.close()
     return stats
@@ -512,7 +523,7 @@ def _watch_parent():
             pass
         os._exit(3)
 
-    threading.Thread(target=watch, name="parent-watch",
+    threading.Thread(target=watch, name="parent-watch",  # bmt: noqa[BMT-L06] lock-free parent-death watch: blocks on pipe EOF then os._exit — it shares no state to interleave
                      daemon=True).start()
 
 

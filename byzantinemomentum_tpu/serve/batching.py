@@ -56,6 +56,7 @@ import time
 
 from byzantinemomentum_tpu.obs import recorder
 from byzantinemomentum_tpu.obs.metrics import DEPTH_BOUNDS, NullRegistry
+from byzantinemomentum_tpu.utils.locking import NamedCondition
 
 __all__ = ["ServeRequest", "MicroBatcher"]
 
@@ -128,7 +129,7 @@ class MicroBatcher:
         self._m_batch_size = metrics.histogram("serve_batch_size",
                                                bounds=DEPTH_BOUNDS)
         self._queues = collections.OrderedDict()  # cell -> deque[request]
-        self._cond = threading.Condition()
+        self._cond = NamedCondition("batcher.cond")  # bmt: noqa[BMT-L06] the batcher handoff is pinned end-to-end by tests/test_serve.py's deterministic drain paths (single condition, no second lock)
         self._inflight = queue.Queue()
         self._closed = False
         self._flusher = threading.Thread(target=self._flush_loop,

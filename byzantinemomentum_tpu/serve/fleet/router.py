@@ -82,6 +82,7 @@ from byzantinemomentum_tpu.obs.metrics import (LATENCY_MS_BOUNDS,
 from byzantinemomentum_tpu.obs.trace import JOINED_HOPS, ROUTER_PHASES, \
     TraceBuffer, join_shard_trace, percentile, phase_spans
 from byzantinemomentum_tpu.serve.fleet.ring import DEFAULT_VNODES, HashRing
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["FleetRouter", "RouterServer"]
 
@@ -156,10 +157,17 @@ class FleetRouter:
         self._retry_interval = float(retry_interval)
         self._probe_interval = float(probe_interval)
         # `liveness_hook(shard, alive)` runs BEFORE the ring flips (the
-        # persist-before-change contract); it is called under the router
-        # lock and must not call back into the router.
+        # persist-before-change contract); it is called under the COLD
+        # membership lock — never the hot ring lock — and must not call
+        # back into the router.
         self._liveness_hook = liveness_hook
-        self._lock = threading.Lock()
+        # Lock split (BMT-L day-one fix): `router.ring` is the hot lock
+        # `handle_line` takes per line; `router.membership` serializes
+        # liveness transitions (dedupe + persist hook + flip), so the
+        # hook's disk I/O can never convoy the request path. Order:
+        # membership -> ring, and ring never takes anything inside it.
+        self._lock = NamedLock("router.ring")
+        self._membership = NamedLock("router.membership")
         self._closed = False
         self._wake = threading.Event()
         self._routed = {s: 0 for s in self._addresses}
@@ -221,18 +229,27 @@ class FleetRouter:
     def _set_liveness(self, shard, alive):
         """Flip one arc; persist-first via the hook; dedupes no-op
         flips so concurrent detectors (forwarder + watcher) record one
-        transition. Returns True when the state actually changed."""
-        with self._lock:
-            if self._ring.alive(shard) == alive:
-                return False
+        transition. Returns True when the state actually changed.
+
+        The transition serializes on `router.membership` end to end
+        (check -> hook -> flip), so two detectors still produce exactly
+        one persist and one flip — but the ring lock is only taken for
+        the reads and the flip itself, and the hook's manifest fsync no
+        longer runs under the lock every `handle_line` needs
+        (`schedule.liveness_hook_model` pins the interleaving)."""
+        with self._membership:
+            with self._lock:
+                if self._ring.alive(shard) == alive:
+                    return False
             if self._liveness_hook is not None:
-                self._liveness_hook(shard, alive)
-            if alive:
-                self._ring.mark_alive(shard)
-            else:
-                self._ring.mark_dead(shard)
-            self._epochs[shard] += 1
-            return True
+                self._liveness_hook(shard, alive)  # bmt: noqa[BMT-L03] persist-before-flip requires the hook inside the membership transition; membership is cold (liveness edges only) and the hook contract forbids calling back into the router
+            with self._lock:
+                if alive:
+                    self._ring.mark_alive(shard)
+                else:
+                    self._ring.mark_dead(shard)
+                self._epochs[shard] += 1
+                return True
 
     def _epoch(self, shard):
         with self._lock:

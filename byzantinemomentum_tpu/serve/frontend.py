@@ -70,7 +70,7 @@ class _Handler(socketserver.StreamRequestHandler):
         # writer resolves and writes them in request order, so replies
         # pipeline without ever reordering
         replies = queue.Queue()
-        writer = threading.Thread(target=self._write_loop, args=(replies,),
+        writer = threading.Thread(target=self._write_loop, args=(replies,),  # bmt: noqa[BMT-L06] per-connection writer drains one reply queue then exits; ordering is pinned by the queue itself (single producer, single consumer)
                                   name="serve-conn-writer", daemon=True)
         writer.start()
         try:

@@ -65,6 +65,7 @@ from byzantinemomentum_tpu import ops, utils
 from byzantinemomentum_tpu.faults import quorum
 from byzantinemomentum_tpu.obs import recorder
 from byzantinemomentum_tpu.ops import brute as brute_mod, diag
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["Cell", "ProgramCache", "OversizeRequest", "N_BUCKETS",
            "D_BUCKETS", "MASKED_GARS", "D_PAD_EXACT", "batch_bucket",
@@ -254,7 +255,7 @@ class ProgramCache:
         self.d_buckets = tuple(sorted(d_buckets))
         self._programs = {}
         self._warm = set()     # (cell, batch_bucket) pairs seen
-        self._lock = threading.Lock()
+        self._lock = NamedLock("programs.cache")
         self.hits = 0
         self.misses = 0
 

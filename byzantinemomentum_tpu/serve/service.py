@@ -51,6 +51,7 @@ from byzantinemomentum_tpu.obs.trace import RequestTrace, TraceBuffer
 from byzantinemomentum_tpu.serve.batching import MicroBatcher, ServeRequest
 from byzantinemomentum_tpu.serve.programs import (
     N_BUCKETS, ProgramCache, batch_bucket)
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["AggregationService", "AggregateResult"]
 
@@ -173,7 +174,7 @@ class AggregationService:
         if admission is not None:
             suspicion.setdefault("weights", ADMISSION_WEIGHTS)
         self.suspicion = ClientSuspicionStore(**suspicion)
-        self._suspicion_lock = threading.Lock()
+        self._suspicion_lock = NamedLock("service.suspicion")
         # One stats lock for the request/serve counters: they are bumped
         # from submitter (frontend handler) threads AND the resolver
         # thread and read by the heartbeat thread — `n += 1` is a
@@ -182,7 +183,7 @@ class AggregationService:
         # tests/test_concurrency.py demonstrates the loss on the pre-fix
         # pattern). `stats()` snapshots under the same lock so one
         # payload is internally coherent.
-        self._stats_lock = threading.Lock()
+        self._stats_lock = NamedLock("service.stats")
         self._requests = 0
         self._served = 0
         self._rejected = 0

@@ -70,6 +70,7 @@ import time
 from byzantinemomentum_tpu.utils import logging as _log
 # Host-only (no jax import): safe in supervisor threads
 from byzantinemomentum_tpu.obs.heartbeat import read_heartbeat as _read_heartbeat
+from byzantinemomentum_tpu.utils.locking import NamedLock
 
 __all__ = ["Jobs", "dict_to_cmdlist"]
 
@@ -133,7 +134,7 @@ class Jobs:
         self._queue = queue.Queue()
         self._threads = []
         self._started = False
-        self._rotate_lock = threading.Lock()
+        self._rotate_lock = NamedLock("jobs.rotate")
         self._devices = tuple(devices) * supercharge
 
     def submit(self, name, command):

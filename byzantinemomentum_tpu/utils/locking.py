@@ -1,0 +1,213 @@
+"""Named synchronization primitives — the runtime half of the BMT-L
+lock-discipline contract (`analysis/locks.py`).
+
+`NamedLock("router.ring")` behaves exactly like `threading.Lock()` but
+carries a stable, human-chosen name, so
+
+  * static BMT-L reports say `router.ring -> router.membership`, not
+    `<anonymous Lock at router.py:162>`;
+  * the runtime acquisition log (`install_recorder` below, surfaced
+    through `analysis/contracts.record_lock_edges`) emits the SAME
+    names the static lock-order graph uses, which is what makes the
+    runtime-subset-of-static cross-check a set comparison instead of a
+    heuristic join.
+
+Edge recording: while a recorder is installed, every thread keeps a
+thread-local stack of the named locks it currently holds; acquiring a
+named primitive while others are held emits one `(held, taken)` pair
+per held lock to the recorder. With NO recorder installed the wrapper
+does no bookkeeping at all — each acquisition pays one module-global
+None check on top of the raw lock, which is what lets the serve hot
+path (the pre-bound metrics counters take one of these per `inc`) use
+named locks unconditionally. Consequences of the lazy stance:
+
+  * `held_locks()` only reflects acquisitions made while a recorder
+    was installed — install the recorder BEFORE the traffic window;
+  * a lock already held when the recorder installs is invisible until
+    its next acquisition (each primitive tracks whether its CURRENT
+    hold was noted, so install/uninstall mid-hold never corrupts the
+    stack — an un-noted hold simply never pops).
+
+The module is stdlib-only and imports nothing from the package: `obs`,
+`serve` and `cluster` all sit above it.
+"""
+
+import threading
+
+__all__ = ["NamedLock", "NamedCondition", "install_recorder",
+           "uninstall_recorder", "held_locks"]
+
+
+_held = threading.local()
+_recorder = None            # callable((held_name, taken_name)) or None
+_recorder_lock = threading.Lock()  # bmt: noqa[BMT-L06] the recorder latch guards one module global; the wrapper itself is pinned by tests/test_locks.py's runtime-edge tests
+
+
+def _stack():
+    try:
+        return _held.stack
+    except AttributeError:
+        _held.stack = []
+        return _held.stack
+
+
+def _note_acquired(name):
+    stack = _stack()
+    rec = _recorder
+    if rec is not None:
+        for held in stack:
+            try:
+                rec((held, name))
+            except Exception:  # bmt: noqa[BMT-E05] a broken observer must not poison every lock acquisition in the process
+                pass
+    stack.append(name)
+
+
+def _note_released(name):
+    stack = _stack()
+    # Remove the LAST occurrence: releases normally pop in LIFO order,
+    # but out-of-order release is legal for bare acquire()/release()
+    for index in range(len(stack) - 1, -1, -1):
+        if stack[index] == name:
+            del stack[index]
+            return
+
+
+def install_recorder(callback):
+    """Install `callback((held, taken))` as the process-wide acquisition
+    observer; returns the previous recorder (restore it via
+    `uninstall_recorder`). One recorder at a time — last install wins,
+    which is all the selfcheck/test windows need."""
+    global _recorder
+    with _recorder_lock:
+        previous = _recorder
+        _recorder = callback
+    return previous
+
+
+def uninstall_recorder(previous=None):
+    """Remove the acquisition observer (restoring `previous`)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = previous
+
+
+def held_locks():
+    """Names of the locks the CALLING thread currently holds, innermost
+    last (diagnostics; the recorder sees the cross-thread picture).
+    Only populated while a recorder is installed — see the module
+    note."""
+    return tuple(_stack())
+
+
+class NamedLock:
+    """`threading.Lock` with a name and acquisition-edge recording.
+
+    `_noted` tracks whether the CURRENT hold was pushed onto the
+    thread-local stack: it is only read/written by the holder (the lock
+    is non-reentrant), so a recorder installed or removed mid-hold
+    cannot unbalance the bookkeeping."""
+
+    __slots__ = ("name", "_lock", "_noted")
+
+    def __init__(self, name):
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._noted = False
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and _recorder is not None:
+            self._noted = True
+            _note_acquired(self.name)
+        return ok
+
+    def release(self):
+        if self._noted:
+            self._noted = False
+            _note_released(self.name)
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"NamedLock({self.name!r})"
+
+
+class NamedCondition:
+    """`threading.Condition` with a name and acquisition-edge recording.
+
+    `wait()` pops the name for the duration of the wait (the underlying
+    lock really is released) and re-records the reacquisition on wake —
+    so a consumer parked in `wait()` never appears to hold the
+    condition in the runtime edge log. `_noted` is only touched while
+    the underlying lock is held (before the release inside `wait`, after
+    the reacquire on wake), so waiters cannot race it."""
+
+    __slots__ = ("name", "_cond", "_noted")
+
+    def __init__(self, name, lock=None):
+        self.name = str(name)
+        self._cond = threading.Condition(lock)
+        self._noted = False
+
+    def acquire(self, *args, **kwargs):
+        ok = self._cond.acquire(*args, **kwargs)
+        if ok and _recorder is not None:
+            self._noted = True
+            _note_acquired(self.name)
+        return ok
+
+    def release(self):
+        if self._noted:
+            self._noted = False
+            _note_released(self.name)
+        self._cond.release()
+
+    def wait(self, timeout=None):
+        if self._noted:
+            self._noted = False
+            _note_released(self.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if _recorder is not None:
+                self._noted = True
+                _note_acquired(self.name)
+
+    def wait_for(self, predicate, timeout=None):
+        if self._noted:
+            self._noted = False
+            _note_released(self.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            if _recorder is not None:
+                self._noted = True
+                _note_acquired(self.name)
+
+    def notify(self, n=1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"NamedCondition({self.name!r})"

@@ -79,7 +79,7 @@ def onetime(callback):
     """Thread-safe one-time latch: returns (trigger, is_triggered) where
     `trigger()` runs `callback` at most once (reference `tools/misc.py:259-302`
     — used for graceful SIGINT/SIGTERM exit)."""
-    lock = threading.Lock()
+    lock = threading.Lock()  # bmt: noqa[BMT-L06] one-shot latch (single lock, no nesting) for signal handlers — nothing to order
     state = {"done": False}
 
     def trigger(*args, **kwargs):

@@ -59,11 +59,12 @@ sys.path.insert(0, str(ROOT / "scripts"))
 from bench_compare import load_artifact, _rates  # noqa: E402
 
 __all__ = ["collect_cluster", "collect_fleet", "collect_fleet_attrib",
-           "collect_history", "collect_metrics", "collect_serve",
-           "collect_serve_attrib", "collect_tournament", "render_table",
-           "main", "GAR_COLUMN", "CLUSTER_COLUMNS", "FLEET_COLUMNS",
-           "FLEET_ATTRIB_COLUMNS", "METRICS_COLUMNS", "SERVE_COLUMNS",
-           "SERVE_ATTRIB_COLUMNS", "TOURNAMENT_COLUMNS"]
+           "collect_history", "collect_locks", "collect_metrics",
+           "collect_serve", "collect_serve_attrib", "collect_tournament",
+           "render_table", "main", "GAR_COLUMN", "CLUSTER_COLUMNS",
+           "FLEET_COLUMNS", "FLEET_ATTRIB_COLUMNS", "LOCKS_COLUMNS",
+           "METRICS_COLUMNS", "SERVE_COLUMNS", "SERVE_ATTRIB_COLUMNS",
+           "TOURNAMENT_COLUMNS"]
 
 _ROUND = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -442,6 +443,49 @@ def collect_health(root, labels):
             if (stats := _health_stats(root, label)) is not None}
 
 
+LOCKS_COLUMNS = ("locks", "lock edges")
+
+
+def _locks_stats(root, label):
+    """`{locks, edges} | None` for one round's lock-hierarchy census:
+    per-round rows read the tier artifact (`TESTS_{label}.json` ->
+    `locks_tier`, recorded by `run_test_tiers.py` since r20); the
+    `current` row reads the blessed census itself
+    (`tests/goldens/locks.json`). Counts, not names — the table tracks
+    whether the hierarchy is growing, the golden diff shows what."""
+    root = pathlib.Path(root)
+    if label == "current":
+        path = root / "tests" / "goldens" / "locks.json"
+        try:
+            payload = json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None
+        names = payload.get("locks") if isinstance(payload, dict) else None
+        edges = payload.get("edges") if isinstance(payload, dict) else None
+        if not isinstance(names, list) or not isinstance(edges, list):
+            return None
+        return {"locks": len(names), "edges": len(edges)}
+    path = root / f"TESTS_{label}.json"
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    tier = payload.get("locks_tier") if isinstance(payload, dict) else None
+    if not isinstance(tier, dict):
+        return None
+    names, edges = tier.get("locks"), tier.get("edges")
+    if not isinstance(names, int) or not isinstance(edges, int):
+        return None
+    return {"locks": names, "edges": edges}
+
+
+def collect_locks(root, labels):
+    """{label: lock-census counts} over the history rows (independent
+    instrument, same discipline as `collect_serve`)."""
+    return {label: stats for label in labels
+            if (stats := _locks_stats(root, label)) is not None}
+
+
 def collect_history(root=ROOT):
     """[(label, rates | None, reason | None, gar)] over every round
     artifact (sorted by round number) plus the working tree's
@@ -524,7 +568,7 @@ def _load_rates(path):
 
 def render_table(history, serve=None, tournament=None, cluster=None,
                  serve_attrib=None, health=None, fleet=None,
-                 metrics=None, fleet_attrib=None):
+                 metrics=None, fleet_attrib=None, locks=None):
     """The trajectory as one text table: rounds as rows, every cell name
     seen in any comparable round as a column (columns a round lacks show
     `-`, e.g. the pre-`cells` legacy artifacts), plus the `gar ms/step`
@@ -541,6 +585,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     fleet = fleet or {}
     metrics = metrics or {}
     fleet_attrib = fleet_attrib or {}
+    locks = locks or {}
     columns = []
     for _, rates, _, _ in history:
         for name in rates or ():
@@ -549,7 +594,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
     any_gar = any(gar is not None for _, _, _, gar in history)
     if not columns and not any_gar and not serve and not tournament \
             and not cluster and not serve_attrib and not health \
-            and not fleet and not metrics and not fleet_attrib:
+            and not fleet and not metrics and not fleet_attrib \
+            and not locks:
         lines = ["bench_history: no comparable rounds"]
         for label, _, reason, _ in history:
             lines.append(f"  {label}: INCOMPARABLE — {reason}")
@@ -572,6 +618,8 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         columns = columns + list(METRICS_COLUMNS)
     if fleet_attrib:
         columns = columns + list(FLEET_ATTRIB_COLUMNS)
+    if locks:
+        columns = columns + list(LOCKS_COLUMNS)
     label_w = max(len("round"), max(len(label) for label, _, _, _ in history))
     widths = [max(len(c), 9) for c in columns]
     header = "  ".join([f"{'round':<{label_w}}"]
@@ -604,6 +652,7 @@ def render_table(history, serve=None, tournament=None, cluster=None,
         row_fleet = fleet.get(label)
         row_metrics = metrics.get(label)
         row_fleet_attrib = fleet_attrib.get(label)
+        row_locks = locks.get(label)
         if row_fleet_attrib is not None and row_fleet_attrib.get(
                 "backend") not in (None, "tpu"):
             notes.append(f"  {label}: joined hop columns from a "
@@ -698,6 +747,12 @@ def render_table(history, serve=None, tournament=None, cluster=None,
                 if value is None:
                     return f"{'-':>{w}}"
                 return f"{value:>{w}.3f}"
+            if c in LOCKS_COLUMNS:
+                key = {"locks": "locks", "lock edges": "edges"}[c]
+                value = None if row_locks is None else row_locks.get(key)
+                if value is None:
+                    return f"{'-':>{w}}"
+                return f"{int(value):>{w}d}"
             if rates is not None and c in rates:
                 return f"{rates[c]:>{w}.3f}"
             return f"{'-':>{w}}"
@@ -743,6 +798,8 @@ def main(argv=None):
                               [label for label, *_ in history])
     fleet_attrib = collect_fleet_attrib(pathlib.Path(args.root),
                                         [label for label, *_ in history])
+    locks = collect_locks(pathlib.Path(args.root),
+                          [label for label, *_ in history])
     if args.json:
         print(json.dumps([
             {"round": label, "rates": rates, "reason": reason,
@@ -755,11 +812,12 @@ def main(argv=None):
              "health": health.get(label),
              "fleet": fleet.get(label),
              "metrics": metrics.get(label),
-             "fleet_attrib": fleet_attrib.get(label)}
+             "fleet_attrib": fleet_attrib.get(label),
+             "locks": locks.get(label)}
             for label, rates, reason, gar in history], indent=2))
         return 0
     print(render_table(history, serve, tournament, cluster, serve_attrib,
-                       health, fleet, metrics, fleet_attrib))
+                       health, fleet, metrics, fleet_attrib, locks))
     return 0
 
 

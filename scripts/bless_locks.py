@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""(Re)bless the lock-hierarchy goldens.
+
+Writes `tests/goldens/locks.json`: the whole-program lock census from
+`analysis/locks.py` — every named lock the interprocedural sweep can
+see, every acquisition-order edge (`held -> taken`), and the
+topological order those edges induce — plus the python toolchain
+coordinate the census is comparable under. The lint tier's gate
+(`python -m byzantinemomentum_tpu.analysis --check-locks`) fails on any
+unexplained change — run THIS script only when a lock-hierarchy change
+is intentional and reviewed, and commit the diff with the change that
+caused it.
+
+Locks and edges the sweep no longer derives are PRUNED (the file is the
+census, nothing else) and reported, so stale names cannot linger.
+
+Idempotent: blessing twice under one toolchain is byte-identical
+(sorted keys, no timestamps). Pure AST — no jax import, no backend.
+
+Usage: python scripts/bless_locks.py [--out PATH] [--check]
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from byzantinemomentum_tpu.analysis import locks  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", type=str, default=None,
+                        help="goldens path (default "
+                             "tests/goldens/locks.json)")
+    parser.add_argument("--check", action="store_true",
+                        help="only report drift against the existing "
+                             "goldens; do not rewrite")
+    args = parser.parse_args()
+    path = pathlib.Path(args.out) if args.out else locks.GOLDEN_PATH
+
+    if args.check:
+        report = locks.check(path)
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    payload, changed, old = locks.bless(path)
+    old_locks = set((old or {}).get("locks", ()))
+    old_edges = set((old or {}).get("edges", ()))
+    pruned = sorted((old_locks - set(payload["locks"]))
+                    | (old_edges - set(payload["edges"])))
+    added = sorted((set(payload["locks"]) - old_locks)
+                   | (set(payload["edges"]) - old_edges))
+    print(f"blessed {len(payload['locks'])} locks, "
+          f"{len(payload['edges'])} edges -> {path}"
+          + (" (changed)" if changed else " (unchanged)"))
+    if pruned:
+        print(f"pruned {len(pruned)} stale name(s)/edge(s) the sweep no "
+              f"longer derives:")
+        for key in pruned:
+            print(f"  pruned: {key}")
+    if added:
+        print(f"added {len(added)} new name(s)/edge(s):")
+        for key in added:
+            print(f"  added: {key}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

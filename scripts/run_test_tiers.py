@@ -180,6 +180,39 @@ def main():
                     schedule_smoke=schedule_smoke)
     print(f"  {lint_tier}", flush=True)
 
+    # Locks tier (PR 20): the whole-program BMT-L sweep — the
+    # interprocedural lock-order graph must carry zero unannotated
+    # violations AND match the blessed hierarchy
+    # (tests/goldens/locks.json) exactly; drift fails until re-blessed
+    # with the change that caused it. Own green bit + telemetry with
+    # the edge/cycle census.
+    print("locks tier ...", flush=True)
+    with telemetry.span("tier_locks"):
+        locks_proc = subprocess.run(
+            [sys.executable, "-m", "byzantinemomentum_tpu.analysis",
+             "--check-locks", "--json"],
+            cwd=ROOT, capture_output=True, text=True)
+    locks_tier = {"returncode": locks_proc.returncode,
+                  "tail": locks_proc.stdout.splitlines()[-4:]}
+    try:
+        locks_report = json.loads(locks_proc.stdout)
+        locks_tier.update(
+            status=locks_report.get("status"),
+            locks=locks_report.get("locks"),
+            edges=locks_report.get("edges"),
+            cycles=locks_report.get("cycles"),
+            l_rule_hits=len(locks_report.get("violations", ())),
+            suppressed=locks_report.get("suppressed"))
+        locks_tier.pop("tail", None)
+    except ValueError:
+        pass  # non-JSON output means the CLI crashed; returncode covers it
+    telemetry.event("locks_tier", returncode=locks_tier["returncode"],
+                    status=locks_tier.get("status"),
+                    edges=locks_tier.get("edges"),
+                    cycles=locks_tier.get("cycles"),
+                    l_rule_hits=locks_tier.get("l_rule_hits"))
+    print(f"  {locks_tier}", flush=True)
+
     # Lattice tier (PR 9): the builder-derived lowering-contract gate —
     # StableHLO fingerprints over the whole program lattice (GAR cells,
     # virtual-mesh sharded cells, serve cells, the donated update) PLUS
@@ -420,6 +453,7 @@ def main():
         "obs_selfcheck": obs_selfcheck,
         "bench_compare": bench_compare,
         "lint_tier": lint_tier,
+        "locks_tier": locks_tier,
         "lattice_tier": lattice_tier,
         "default_tier": default,
         "nopallas_tier": nopallas,
@@ -434,6 +468,7 @@ def main():
                       and obs_selfcheck["returncode"] == 0
                       and bench_compare["returncode"] == 0
                       and lint_tier["returncode"] == 0
+                      and locks_tier["returncode"] == 0
                       and lattice_tier["returncode"] == 0
                       and nopallas["failed"] == 0
                       and nopallas["returncode"] == 0

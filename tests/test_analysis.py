@@ -242,14 +242,19 @@ def outer(g, mesh, in_specs, out_specs):
 
 def test_rule_registry_complete():
     """Every registered E-rule id has a fixture pair here (E00, the
-    suppression-hygiene rule, is proven by the noqa tests below); the
+    suppression-hygiene rule, is proven by the noqa tests below; E11's
+    pair lives in tests/test_locks.py beside the lock family); the
     BMT-T concurrency family shares the registry (so noqa/E00/E09 apply
-    to it) and has its fixture pairs in tests/test_concurrency.py."""
+    to it) and has its fixture pairs in tests/test_concurrency.py; the
+    BMT-L family registers here for --rules/noqa but fires from the
+    whole-program locks.build sweep (fixtures in tests/test_locks.py)."""
     e_rules = {r for r in lint.RULES if r.startswith("BMT-E")}
     t_rules = {r for r in lint.RULES if r.startswith("BMT-T")}
-    assert e_rules == set(FIXTURES) | {"BMT-E00"}
+    l_rules = {r for r in lint.RULES if r.startswith("BMT-L")}
+    assert e_rules == set(FIXTURES) | {"BMT-E00", "BMT-E11"}
     assert t_rules == {f"BMT-T0{i}" for i in range(1, 6)}
-    assert e_rules | t_rules == set(lint.RULES)
+    assert l_rules == {f"BMT-L0{i}" for i in range(1, 7)}
+    assert e_rules | t_rules | l_rules == set(lint.RULES)
     for rule_id, rule in lint.RULES.items():
         assert rule.summary
 

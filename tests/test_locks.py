@@ -1,0 +1,560 @@
+"""Whole-program lock-discipline suite: the BMT-L rule family over the
+interprocedural lock-order graph (`analysis/locks.py`) — violating +
+clean fixture pair per rule, the planted two-thread inversion the graph
+must catch through the call graph, the L01-vs-L04 role split, the noqa
+contract, the blessed-hierarchy round trip (`scripts/bless_locks.py`),
+the runtime-edges-subset-of-static cross-check
+(`contracts.record_lock_edges` + `utils/locking.NamedLock`), the
+repo-wide clean gates (BMT-L and the BMT-E11 traced-scope lazy-init
+rule), and the CLI exit codes.
+
+Everything here is host-only (no jax import at module scope): the sweep
+is pure AST and the named-lock runtime is pure stdlib, so this file
+runs even where no backend initializes.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from byzantinemomentum_tpu.analysis import contracts, lint, locks
+from byzantinemomentum_tpu.analysis.__main__ import main as analysis_main
+from byzantinemomentum_tpu.utils import locking
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------------------- #
+# BMT-L fixtures: one violating + one clean pair per rule. The L01 pair
+# is the planted version of the PR 17 router liveness surface: a flip
+# thread takes ring -> manifest while a persist thread takes
+# manifest -> ring, each through a helper method — the inversion is only
+# visible interprocedurally.
+
+L_FIXTURES = {
+    "BMT-L01": (
+        """
+import threading
+
+class Router:
+    def __init__(self):
+        self._ring = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self._manifest = threading.Lock()
+        t1 = threading.Thread(target=self._flip_loop, daemon=True)
+        t2 = threading.Thread(target=self._persist_loop, daemon=True)
+        t1.start(); t2.start()
+
+    def _write_manifest(self):
+        with self._manifest:
+            pass
+
+    def _flip_loop(self):
+        while True:
+            with self._ring:
+                self._write_manifest()
+
+    def _persist_loop(self):
+        while True:
+            with self._manifest:
+                self._read_ring()
+
+    def _read_ring(self):
+        with self._ring:
+            pass
+""",
+        """
+import threading
+
+class Router:
+    def __init__(self):
+        self._ring = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self._manifest = threading.Lock()
+        t1 = threading.Thread(target=self._flip_loop, daemon=True)
+        t2 = threading.Thread(target=self._persist_loop, daemon=True)
+        t1.start(); t2.start()
+
+    def _write_manifest(self):
+        with self._manifest:
+            pass
+
+    def _flip_loop(self):
+        while True:
+            with self._ring:
+                self._write_manifest()
+
+    def _persist_loop(self):
+        while True:
+            with self._ring:
+                self._write_manifest()
+""",
+    ),
+    "BMT-L02": (
+        """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self.count = 0
+
+    def tick(self):
+        with self._lock:
+            self._pause()
+            self.count += 1
+
+    def _pause(self):
+        time.sleep(0.1)
+""",
+        """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self.count = 0
+
+    def tick(self):
+        self._pause()
+        with self._lock:
+            self.count += 1
+
+    def _pause(self):
+        time.sleep(0.1)
+""",
+    ),
+    "BMT-L03": (
+        """
+import threading
+
+class Store:
+    def __init__(self, on_change_hook):
+        self._lock = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self._hook = on_change_hook
+        self.value = 0
+
+    def set(self, value):
+        with self._lock:
+            self.value = value
+            self._hook(value)
+""",
+        """
+import threading
+
+class Store:
+    def __init__(self, on_change_hook):
+        self._lock = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self._hook = on_change_hook
+        self.value = 0
+
+    def set(self, value):
+        with self._lock:
+            self.value = value
+        self._hook(value)
+""",
+    ),
+    "BMT-L04": (
+        """
+import threading
+
+class Mover:
+    def __init__(self):
+        self._src = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self._dst = threading.Lock()
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            self._forward()
+            self._backward()
+
+    def _forward(self):
+        with self._src:
+            with self._dst:
+                pass
+
+    def _backward(self):
+        with self._dst:
+            with self._src:
+                pass
+""",
+        """
+import threading
+
+class Mover:
+    def __init__(self):
+        self._src = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+        self._dst = threading.Lock()
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            self._forward()
+            self._backward()
+
+    def _forward(self):
+        with self._src:
+            with self._dst:
+                pass
+
+    def _backward(self):
+        with self._src:
+            with self._dst:
+                pass
+""",
+    ),
+    "BMT-L05": (
+        """
+import threading
+
+_ENGINE = None
+
+def get_engine():
+    global _ENGINE
+    if _ENGINE is None:
+        _ENGINE = object()
+    return _ENGINE
+
+def loop():
+    while True:
+        get_engine()
+
+_t = threading.Thread(target=loop, daemon=True)  # bmt: noqa[BMT-L06] planted fixture
+""",
+        """
+import threading
+
+_ENGINE = None
+_ENGINE_LOCK = threading.Lock()  # bmt: noqa[BMT-L06] planted fixture
+
+def get_engine():
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = object()
+        return _ENGINE
+
+def loop():
+    while True:
+        get_engine()
+
+_t = threading.Thread(target=loop, daemon=True)
+""",
+    ),
+    "BMT-L06": (
+        """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+""",
+        """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)  # bmt: noqa[BMT-L06] bare spawn helper for tests; callers own the interleavings
+    t.start()
+    return t
+""",
+    ),
+}
+
+
+def _sweep(tmp_path, source, name="fixture.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return locks.build(paths=[path])
+
+
+@pytest.mark.parametrize("rule_id", sorted(L_FIXTURES))
+def test_l_rule_fixture_pair(rule_id, tmp_path):
+    """Every L-rule fires on its violating fixture and stays silent on
+    the clean one (and the clean one trips no OTHER L-rule either)."""
+    bad, good = L_FIXTURES[rule_id]
+    hits = {v.rule for v in _sweep(tmp_path, bad, "bad.py").violations}
+    assert rule_id in hits, f"{rule_id} missed its violating fixture"
+    clean = _sweep(tmp_path, good, "good.py").violations
+    assert clean == [], f"clean fixture not clean: {clean}"
+
+
+def test_l01_inversion_is_interprocedural():
+    """The planted two-lock/two-thread inversion is only visible through
+    the call graph (each second acquisition happens inside a helper
+    method); the report names both locks, both thread roles, and a
+    file:line witness for each direction of the cycle."""
+    import tempfile
+    bad, _ = L_FIXTURES["BMT-L01"]
+    tmp = pathlib.Path(tempfile.mkdtemp())
+    graph = _sweep(tmp, bad, "router.py")
+    assert graph.cycles == [["Router._manifest", "Router._ring"]]
+    hit = next(v for v in graph.violations if v.rule == "BMT-L01")
+    for needle in ("Router._ring", "Router._manifest",
+                   "thread:_flip_loop", "thread:_persist_loop",
+                   "router.py:"):
+        assert needle in hit.message, (needle, hit.message)
+    # Both directions carry a witness line, each inside a helper the
+    # entry loop never textually contains.
+    assert hit.message.count("router.py:") >= 2
+
+
+def test_l04_single_role_is_not_a_deadlock(tmp_path):
+    """Both orders on ONE thread role is latent (L04), not a deadlock
+    (L01): a single thread cannot deadlock against itself, but the next
+    refactor that adds a second role makes the inversion live."""
+    bad, _ = L_FIXTURES["BMT-L04"]
+    rules = {v.rule for v in _sweep(tmp_path, bad).violations}
+    assert "BMT-L04" in rules
+    assert "BMT-L01" not in rules
+
+
+def test_l02_noqa_reason_contract(tmp_path):
+    """A reasoned noqa suppresses the L02 (and counts as suppressed); a
+    reasonless one does NOT suppress — and the lint pass flags the empty
+    reason itself (BMT-E00), so there is no silent escape hatch."""
+    bad, _ = L_FIXTURES["BMT-L02"]
+    annotated = bad.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # bmt: noqa[BMT-L02] fixture poller cadence")
+    graph = _sweep(tmp_path, annotated, "annotated.py")
+    assert graph.violations == []
+    assert graph.suppressed >= 1
+    reasonless = bad.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # bmt: noqa[BMT-L02]")
+    graph = _sweep(tmp_path, reasonless, "reasonless.py")
+    assert "BMT-L02" in {v.rule for v in graph.violations}
+    assert "BMT-E00" in {v.rule for v in lint.lint_source(reasonless)}
+
+
+def test_census_orders_the_hierarchy(tmp_path):
+    """The census renders edges as `held -> taken` and the topo order
+    puts every held lock before what it nests."""
+    _, good = L_FIXTURES["BMT-L04"]
+    path = tmp_path / "mover.py"
+    path.write_text(good)
+    census = locks.census(paths=[path])
+    assert "Mover._src -> Mover._dst" in census["edges"]
+    order = census["order"]
+    assert order.index("Mover._src") < order.index("Mover._dst")
+    assert census["python"] == f"{sys.version_info[0]}.{sys.version_info[1]}"
+
+
+# --------------------------------------------------------------------------- #
+# The blessed hierarchy: golden statuses + the bless script round trip
+
+def test_check_statuses(tmp_path):
+    """missing -> fail; blessed-under-other-python -> incomparable (not
+    a drift failure); tampered census -> drift with the delta named."""
+    report = locks.check(path=tmp_path / "absent.json")
+    assert report["status"] == "missing" and not report["ok"]
+
+    golden = tmp_path / "locks.json"
+    locks.bless(path=golden)
+    assert locks.check(path=golden)["status"] == "ok"
+
+    payload = json.loads(golden.read_text())
+    payload["python"] = "0.0"
+    golden.write_text(json.dumps(payload))
+    report = locks.check(path=golden)
+    assert report["status"] == "incomparable" and report["ok"]
+
+    payload["python"] = f"{sys.version_info[0]}.{sys.version_info[1]}"
+    payload["locks"] = payload["locks"] + ["ghost.lock"]
+    golden.write_text(json.dumps(payload))
+    report = locks.check(path=golden)
+    assert report["status"] == "drift" and not report["ok"]
+    assert report["drift"]["locks_removed"] == ["ghost.lock"]
+
+
+@pytest.mark.slow
+def test_bless_script_round_trip(tmp_path):
+    """`scripts/bless_locks.py` is idempotent (second bless byte-
+    identical), prunes stale names with a report, and `--check` gates."""
+    golden = tmp_path / "locks.json"
+    script = ROOT / "scripts" / "bless_locks.py"
+    run = lambda *args: subprocess.run(
+        [sys.executable, str(script), *args],
+        capture_output=True, text=True, cwd=ROOT)
+
+    first = run("--out", str(golden))
+    assert first.returncode == 0, first.stderr
+    blessed_bytes = golden.read_bytes()
+    second = run("--out", str(golden))
+    assert second.returncode == 0 and "(unchanged)" in second.stdout
+    assert golden.read_bytes() == blessed_bytes
+
+    checked = run("--out", str(golden), "--check")
+    assert checked.returncode == 0, checked.stdout
+
+    payload = json.loads(golden.read_text())
+    payload["locks"] = payload["locks"] + ["ghost.lock"]
+    golden.write_text(json.dumps(payload))
+    assert run("--out", str(golden), "--check").returncode == 1
+    reblessed = run("--out", str(golden))
+    assert reblessed.returncode == 0
+    assert "pruned: ghost.lock" in reblessed.stdout
+    assert golden.read_bytes() == blessed_bytes
+
+
+# --------------------------------------------------------------------------- #
+# Runtime cross-check: NamedLock edge recording vs the static graph
+
+def test_named_lock_records_nesting_edges():
+    a = locking.NamedLock("router.membership")
+    b = locking.NamedLock("router.ring")
+    with contracts.record_lock_edges() as edges:
+        with a:
+            assert locking.held_locks() == ("router.membership",)
+            with b:
+                assert locking.held_locks() == ("router.membership",
+                                                "router.ring")
+    assert edges == {("router.membership", "router.ring")}
+    # The window closed: acquisitions no longer record.
+    with a:
+        with b:
+            pass
+    assert edges == {("router.membership", "router.ring")}
+
+
+def test_named_condition_wait_releases_the_name():
+    """A consumer parked in `wait()` must not appear to hold the
+    condition — the wait pops the name and re-records on wake."""
+    cond = locking.NamedCondition("batcher.cond")
+    seen = []
+    with contracts.record_lock_edges():
+        with cond:
+            assert locking.held_locks() == ("batcher.cond",)
+            cond.wait_for(
+                lambda: (seen.append(locking.held_locks()), True)[1])
+            assert locking.held_locks() == ("batcher.cond",)
+    assert seen == [()]
+
+
+def test_recorder_mid_hold_stays_balanced():
+    """A recorder installed while a lock is already held must not see a
+    phantom pop at that hold's release, and the NEXT acquisition records
+    normally (the `_noted` protocol)."""
+    lock = locking.NamedLock("service.stats")
+    lock.acquire()
+    with contracts.record_lock_edges() as edges:
+        lock.release()          # un-noted hold: no stack underflow
+        assert locking.held_locks() == ()
+        with lock:
+            assert locking.held_locks() == ("service.stats",)
+        assert locking.held_locks() == ()
+    assert edges == set()
+
+
+def test_runtime_edges_subset_of_static():
+    """The edge the serve fleet actually exercises (membership -> ring)
+    is in the static graph; the inverted order is not, and fails with
+    both names in the error."""
+    static = locks.static_edges()
+    assert ("router.membership", "router.ring") in static
+    assert contracts.assert_lock_edges_subset(
+        {("router.membership", "router.ring")}, static) == 1
+    # Self-edges are distinct instances sharing a role name — ignored.
+    assert contracts.assert_lock_edges_subset(
+        {("metrics.counter", "metrics.counter")}, static) == 0
+    with pytest.raises(contracts.LockOrderError) as err:
+        contracts.assert_lock_edges_subset(
+            {("router.ring", "router.membership")}, static)
+    assert "router.ring -> router.membership" in str(err.value)
+
+
+# --------------------------------------------------------------------------- #
+# Repo-wide gates + CLI
+
+def test_repo_lock_surface_is_clean():
+    """The committed hierarchy is green: zero unannotated L violations
+    and the census matches `tests/goldens/locks.json` exactly."""
+    report = locks.check()
+    assert report["violations"] == [], report["violations"]
+    assert report["status"] == "ok", report
+    assert report["ok"]
+
+
+def test_repo_is_e11_clean():
+    """No traced scope in the package lazily initializes a module
+    global (BMT-E11) — the pattern bakes first-call state into the
+    jaxpr and races under concurrent tracing."""
+    violations = lint.lint_paths(
+        [ROOT / "byzantinemomentum_tpu", ROOT / "scripts"],
+        rules={"BMT-E11", "BMT-E00"})
+    assert violations == [], lint.format_human(violations)
+
+
+E11_BAD = """
+import jax
+
+_TABLE = None
+
+@jax.jit
+def lookup(x):
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = build_table()
+    return x + _TABLE[0]
+"""
+
+E11_BAD_CACHE = """
+import jax
+
+_CACHE = {}
+
+@jax.jit
+def solve(x, k):
+    if k not in _CACHE:
+        _CACHE[k] = precompute(k)
+    return x * _CACHE[k]
+"""
+
+E11_GOOD = """
+import jax
+
+_TABLE = None
+
+def _ensure_table():
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = build_table()
+    return _TABLE
+
+@jax.jit
+def lookup(x):
+    return x + lookup_const(x)
+"""
+
+
+def test_e11_fixture_pair():
+    """BMT-E11 fires on both lazy-init shapes inside a traced scope
+    (`is None` global and `key not in dict` memo) and stays silent when
+    the init happens outside the trace."""
+    assert {v.rule for v in lint.lint_source(E11_BAD)} == {"BMT-E11"}
+    assert {v.rule for v in lint.lint_source(E11_BAD_CACHE)} == {"BMT-E11"}
+    assert lint.lint_source(E11_GOOD) == []
+
+
+def test_cli_check_locks(tmp_path, capsys):
+    """`--check-locks` exits 0 on the committed green hierarchy, 1 when
+    pointed at a missing golden, and `--rules` lists the L-family."""
+    assert analysis_main(["--check-locks"]) == 0
+    capsys.readouterr()
+    assert analysis_main(["--check-locks", "--goldens",
+                          str(tmp_path / "absent.json")]) == 1
+    out = capsys.readouterr().out
+    assert "missing" in out
+    capsys.readouterr()
+    assert analysis_main(["--rules"]) == 0
+    table = capsys.readouterr().out
+    for rule_id in ("BMT-L01", "BMT-L02", "BMT-L03", "BMT-L04",
+                    "BMT-L05", "BMT-L06", "BMT-E11"):
+        assert rule_id in table, f"--rules table is missing {rule_id}"
